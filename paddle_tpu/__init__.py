@@ -1,0 +1,67 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle-class
+capabilities, built from scratch on JAX/XLA/Pallas.
+
+Top-level namespace mirrors the reference `paddle.*` API surface (see
+SURVEY.md for the structural map). Compute lowers to XLA via jax.numpy with
+Pallas kernels for hot paths; distribution is SPMD over jax.sharding meshes.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core
+from .core import dtype as _dtype_mod
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+                         float32, float64, get_default_dtype, int8, int16,
+                         int32, int64, set_default_dtype, uint8)
+from .core.device import (CPUPlace, Place, TPUPlace, device_count, get_device,
+                          is_compiled_with_tpu, set_device)
+from .core.tensor import Parameter, Tensor, to_tensor
+from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.flags import get_flags, set_flags
+
+# ops (also installs Tensor methods)
+from .ops import *  # noqa: F401,F403
+from .ops import linalg as _ops_linalg
+
+# subsystem namespaces (populated as the framework grows)
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io_save import load, save  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from .nn.clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: E402
+                           ClipGradByValue)
+
+bool = bool_  # paddle.bool
+
+
+def disable_static(place=None):
+    """No-op: this framework is eager-first (reference parity shim)."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use "
+        "paddle_tpu.jit.to_static for compiled execution.")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    from .core.autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
+                 only_inputs, allow_unused)

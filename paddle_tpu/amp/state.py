@@ -1,0 +1,54 @@
+"""AMP autocast state + op lists.
+
+Reference parity: the O1 black/white op lists and O2 pure-low-precision
+mode (upstream python/paddle/amp/auto_cast.py — unverified, see SURVEY.md
+§2.2). TPU note: bf16 is the native MXU dtype; it needs no loss scaling,
+so GradScaler degrades to a pass-through unless float16 is requested.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Ops that are numerically safe & fast in low precision (run on the MXU).
+WHITE_LIST = {"matmul", "conv", "einsum", "bmm", "mm", "addmm",
+              "attention"}
+# Ops that must stay in fp32 (reductions / exp-family).
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "batch_norm", "exp",
+              "log", "mean", "sum", "cross_entropy", "norm", "cumsum"}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def cast_for_op(tensors, category):
+    """Called from the op layer: cast inputs per the active AMP level."""
+    if not _state.enabled:
+        return tensors
+    if category in _state.custom_black or category in BLACK_LIST:
+        return tensors
+    if _state.level == "O2" or category in WHITE_LIST or \
+            category in _state.custom_white:
+        out = []
+        for t in tensors:
+            d = jnp.dtype(t.dtype)
+            if d in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+                out.append(t.astype(_state.dtype))
+            else:
+                out.append(t)
+        return tuple(out)
+    return tensors

@@ -1,0 +1,12 @@
+"""paddle_tpu.autograd (paddle.autograd parity)."""
+from ..core.autograd import (PyLayer, PyLayerContext, backward,  # noqa: F401
+                             enable_grad, grad, is_grad_enabled, no_grad,
+                             set_grad_enabled)
+
+hessian = None  # higher-order via functional jax transforms (jit module)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit.functional_grad / jax.jacobian via the "
+        "functional path for higher-order derivatives.")

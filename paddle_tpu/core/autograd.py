@@ -1,0 +1,348 @@
+"""Eager reverse-mode autograd engine.
+
+Reference parity: the dygraph engine — AutogradMeta/GradNodeBase/
+egr::Backward/GradTensorHolder (upstream paddle/fluid/eager/ — unverified,
+see SURVEY.md §2.1, §3.1). TPU-native design: instead of hand-written
+per-op GradNodes, every differentiable op is executed through `jax.vjp`,
+which runs the forward *and* captures a pullback closure holding exactly
+the residuals JAX's AD rules need. The graph is a DAG of `TapeNode`s hung
+off output tensors; `backward()` does an iterative topological sweep,
+calling each pullback and accumulating cotangents (the GradTensorHolder
+role). Everything in here is pure Python over jax ops, so the same engine
+works unchanged under `jax.jit` tracing — that is what makes `to_static`
+a thin wrapper rather than a second execution engine.
+"""
+from __future__ import annotations
+
+import contextlib
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# grad-enabled state
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — usable as context manager or decorator."""
+
+    def __enter__(self):
+        self._prev = _grad_enabled
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _grad_enabled
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Tape nodes
+
+class TapeNode:
+    """One recorded differentiable op: inputs + vjp pullback + output slots."""
+
+    __slots__ = ("inputs", "in_versions", "vjp_fn", "multi_out", "out_refs",
+                 "out_info", "name", "__weakref__")
+
+    def __init__(self, inputs, vjp_fn, multi_out, name=""):
+        self.inputs = tuple(inputs)          # strong refs keep the graph alive
+        self.in_versions = tuple(t._version for t in inputs)
+        self.vjp_fn = vjp_fn
+        self.multi_out = multi_out
+        self.out_refs: list = []             # weakrefs to output Tensors
+        self.out_info: list = []             # (shape, dtype) per output
+        self.name = name
+
+    def add_output(self, tensor):
+        self.out_refs.append(weakref.ref(tensor))
+        self.out_info.append((tensor._data.shape, tensor._data.dtype))
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+
+
+def _check_versions(node: TapeNode):
+    for t, v in zip(node.inputs, node.in_versions):
+        if t._version != v:
+            raise RuntimeError(
+                f"one of the tensors needed for gradient computation "
+                f"(shape={list(t._data.shape)}) was modified in place "
+                f"(version {t._version}, expected {v}). Clone it before the "
+                f"in-place op, or avoid the in-place op.")
+
+
+# ---------------------------------------------------------------------------
+# The op applicator — every differentiable op goes through here.
+
+def apply(fn, *tensors, name: str = ""):
+    """Run `fn(*arrays)` eagerly; record a TapeNode if grad is required.
+
+    `fn` must be a pure function of the positional arrays (close over any
+    static arguments). Returns Tensor or tuple of Tensors mirroring fn's
+    output structure.
+    """
+    from .tensor import Tensor
+
+    arrs = tuple(t._data for t in tensors)
+    needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
+    if needs_grad:
+        out, vjp_fn = jax.vjp(fn, *arrs)
+        multi = isinstance(out, (tuple, list))
+        node = TapeNode(tensors, vjp_fn, multi, name=name)
+        if multi:
+            res = tuple(Tensor(o, stop_gradient=False, _node=node) for o in out)
+            for t in res:
+                node.add_output(t)
+            return res
+        t = Tensor(out, stop_gradient=False, _node=node)
+        node.add_output(t)
+        return t
+    out = fn(*arrs)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+# ---------------------------------------------------------------------------
+# Backward engine
+
+def _topo_order(roots):
+    """Iterative post-order over the node DAG; returns nodes forward-ordered."""
+    order, state = [], {}
+    stack = [(n, False) for n in roots if n is not None]
+    seen_root = set()
+    stack = []
+    for n in roots:
+        if n is not None and id(n) not in seen_root:
+            seen_root.add(id(n))
+            stack.append((n, False))
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        st = state.get(id(node))
+        if st is not None:
+            continue
+        state[id(node)] = 1
+        stack.append((node, True))
+        for t in node.inputs:
+            child = t._node
+            if child is not None and id(child) not in state:
+                stack.append((child, False))
+    return order
+
+
+def _accumulate(dst: dict, key, g):
+    if key in dst:
+        dst[key] = dst[key] + g
+    else:
+        dst[key] = g
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 sinks=None, accumulate_into_grad=True):
+    """Core engine. `sinks`: optional list of Tensors whose cotangents should
+    be collected and returned (paddle.grad); when given with
+    accumulate_into_grad=False, .grad fields are untouched.
+    """
+    from .tensor import Tensor
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    grads: dict[int, object] = {}     # id(Tensor) -> cotangent array
+    alive: dict[int, object] = {}     # id -> Tensor, pins ids
+    sink_ids = {id(t) for t in (sinks or [])}
+    sink_grads: dict[int, object] = {}
+
+    def deposit(t, g):
+        if t.stop_gradient:
+            return
+        if getattr(g, "dtype", None) == jax.dtypes.float0:
+            return  # non-differentiable (integer/key) input
+        for hook in t._hooks:
+            out = hook(Tensor(g))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else out
+        if id(t) in sink_ids:
+            _accumulate(sink_grads, id(t), g)
+        if accumulate_into_grad and (t._node is None or t._retain_grads):
+            t.grad = Tensor(g) if t.grad is None else Tensor(t.grad._data + g)
+        if t._node is not None:
+            _accumulate(grads, id(t), g)
+            alive[id(t)] = t
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            raise RuntimeError("backward() called on a tensor that does not "
+                               "require grad (stop_gradient=True, no graph).")
+        seed = (jnp.ones(t._data.shape, t._data.dtype) if g is None
+                else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+        deposit(t, seed)
+
+    order = _topo_order([t._node for t in tensors])
+
+    for node in reversed(order):
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time, but the "
+                "saved intermediate results have already been freed. Pass "
+                "retain_graph=True to backward() the first time.")
+        cotangents, any_grad = [], False
+        for ref, (shape, dtype) in zip(node.out_refs, node.out_info):
+            t = ref()
+            g = grads.pop(id(t), None) if t is not None else None
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            else:
+                any_grad = True
+            cotangents.append(g)
+        if not any_grad:
+            continue
+        _check_versions(node)
+        in_grads = node.vjp_fn(tuple(cotangents) if node.multi_out
+                               else cotangents[0])
+        for t, g in zip(node.inputs, in_grads):
+            if g is not None:
+                deposit(t, g)
+        if not retain_graph:
+            node.release()
+
+    return sink_grads
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward"""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — functional gradients without touching .grad.
+
+    create_graph (double backward) is not supported in the eager tape this
+    round; use `paddle_tpu.jit.grad`-style functional transforms for
+    higher-order derivatives.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported by the eager tape; use the "
+            "functional jax transform path (paddle_tpu.jit) for higher-order "
+            "gradients.")
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = False
+    sink_grads = run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                              sinks=inputs, accumulate_into_grad=False)
+    result = []
+    for t in inputs:
+        g = sink_grads.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is intended.")
+            result.append(None)
+        else:
+            result.append(Tensor(g))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# PyLayer — user-defined forward/backward (reference: paddle.autograd.PyLayer)
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads)."""
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _grad_enabled and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not needs_grad:
+            return outs
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if multi else [cots]
+            with no_grad():
+                gin = cls.backward(ctx, *[Tensor(c) for c in cot_list])
+            gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            out = []
+            it = iter(gin)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(it, None)
+                    out.append(None if g is None else
+                               (g._data if isinstance(g, Tensor) else g))
+            return out
+
+        node = TapeNode(tensor_inputs, vjp_fn, multi, name=cls.__name__)
+        results = []
+        for o in out_list:
+            t = o if isinstance(o, Tensor) else Tensor(o)
+            res = Tensor(t._data, stop_gradient=False, _node=node)
+            node.add_output(res)
+            results.append(res)
+        return tuple(results) if multi else results[0]

@@ -1,0 +1,99 @@
+"""Dtype surface for paddle_tpu.
+
+Mirrors the reference's dtype vocabulary (paddle/phi/common/data_type.h —
+upstream path, see SURVEY.md blocker notice) but maps directly onto JAX
+numpy dtypes. TPU note: 64-bit types are disabled by default in JAX; we
+keep 32-bit defaults (int64/float64 requests degrade to 32-bit unless
+jax_enable_x64 is set) — documented deviation from the reference's
+int64-default for Python ints.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exposed as paddle_tpu.float32, etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np.dtype | jnp dtype | None) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _STR_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    # jnp scalar types are fine as-is; np.dtype objects normalize via np.dtype
+    try:
+        return jnp.dtype(dtype).type
+    except TypeError:
+        raise ValueError(f"Cannot interpret {dtype!r} as a dtype")
+
+
+def is_floating_point(dtype) -> bool:
+    d = np.dtype(dtype)
+    return d.kind == "f" or d == np.dtype(bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+def is_complex(dtype) -> bool:
+    return np.dtype(dtype).kind == "c"
+
+
+def is_bool(dtype) -> bool:
+    return np.dtype(dtype).kind == "b"
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(dtype)
+    if d == np.dtype(bfloat16):
+        return "bfloat16"
+    return d.name
+
+
+# Default dtypes (paddle.get_default_dtype / set_default_dtype)
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {dtype_name(d)}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,80 @@
+"""Global FLAGS system.
+
+Reference parity: PHI_DEFINE_EXPORTED_* flags (upstream paddle/common/flags.h
+— unverified, see SURVEY.md §5.6) settable via FLAGS_* env vars and
+paddle.set_flags/get_flags. TPU-native: a plain registry; flags that map to
+JAX config knobs forward to them (e.g. check_nan_inf → jax_debug_nans).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+_REGISTRY: dict[str, dict] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = "",
+                on_set: Callable[[Any], None] | None = None):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        value = _parse(env, type(default))
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_,
+                       "on_set": on_set}
+    if env is not None and on_set is not None:
+        on_set(value)
+
+
+def _parse(s: str, ty):
+    if ty is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    if ty in (int, float):
+        return ty(s)
+    return s
+
+
+def set_flags(flags: dict[str, Any]):
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise KeyError(f"Unknown flag: {k}")
+        entry = _REGISTRY[k]
+        entry["value"] = v
+        if entry["on_set"] is not None:
+            entry["on_set"](v)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k.removeprefix("FLAGS_")
+        if key not in _REGISTRY:
+            raise KeyError(f"Unknown flag: {key}")
+        out[k] = _REGISTRY[key]["value"]
+    return out
+
+
+def flag(name: str) -> Any:
+    return _REGISTRY[name]["value"]
+
+
+def _set_debug_nans(v: bool):
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(v))
+
+
+# Core flag set (subset of the reference's, TPU-relevant ones only).
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf (maps to jax_debug_nans).",
+            on_set=_set_debug_nans)
+define_flag("use_stride_kernel", False, "No-op on TPU (XLA manages layout).")
+define_flag("allocator_strategy", "xla",
+            "Informational: XLA/PJRT owns device memory on TPU.")
+define_flag("eager_delete_tensor_gb", 0.0, "No-op: Python GC + XLA manage memory.")
+define_flag("benchmark", False, "Synchronize after each op when True.")
+define_flag("paddle_tpu_eager_jit", True,
+            "Micro-jit eager ops for dispatch speed (safe to disable).")
+define_flag("log_level", "INFO", "Framework logger level.")

@@ -1,0 +1,98 @@
+"""Framework RNG.
+
+Reference parity: paddle.seed + per-generator state (upstream
+python/paddle/framework/random.py — unverified, see SURVEY.md). TPU-native:
+a process-global threefry key + a monotonically increasing offset; every
+random op folds the offset into the base key, so the stream is (a)
+deterministic given the seed, (b) cheap (no key threading through user
+code), and (c) capturable/restorable — which recompute (activation
+checkpointing) and the distributed RNGStatesTracker rely on.
+
+Inside `jax.jit` tracing, folding a Python-int offset is a compile-time
+constant: each trace site gets a distinct, deterministic stream, and
+retracing with the same seed reproduces it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.random as jrandom
+
+
+class Generator:
+    """A named RNG stream: (seed, offset) pair."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._offset = 0
+        self._key = jrandom.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._offset = 0
+        self._key = jrandom.PRNGKey(self._seed)
+        return self
+
+    def next_key(self):
+        k = jrandom.fold_in(self._key, self._offset)
+        self._offset += 1
+        return k
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._offset = int(state["offset"])
+        self._key = jrandom.PRNGKey(self._seed)
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+# Trace-mode key stack: while `to_static`/jit traces a function, random ops
+# draw from a *traced* base key (an argument of the compiled function) so
+# each executed call gets fresh randomness without retracing. Entries are
+# [base_key, counter:list[int]].
+_trace_key_stack: list = []
+
+
+def push_trace_key(base_key):
+    _trace_key_stack.append([base_key, [0]])
+
+
+def pop_trace_key():
+    _trace_key_stack.pop()
+
+
+def in_trace_mode() -> bool:
+    return bool(_trace_key_stack)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reseed the global generator."""
+    return _default_generator.manual_seed(s)
+
+
+def next_key():
+    """Next PRNG key from the global stream (internal use by random ops)."""
+    if _trace_key_stack:
+        base, counter = _trace_key_stack[-1]
+        k = jrandom.fold_in(base, counter[0])
+        counter[0] += 1
+        return k
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

@@ -1,0 +1,291 @@
+"""The eager Tensor.
+
+Reference parity: paddle.Tensor — an eager value with autograd metadata
+(upstream phi::DenseTensor + egr::AutogradMeta; unverified, see SURVEY.md).
+TPU-native design: a thin wrapper over an immutable `jax.Array` (or a JAX
+tracer when running under `to_static`/`jax.jit`). "In-place" ops rebind
+`_data` and bump a version counter which the autograd engine checks, so
+reference in-place semantics are preserved on a functional substrate.
+
+Paddle semantics kept: `stop_gradient` defaults to True (Parameters set it
+False), `.grad` accumulates on leaves, `.numpy()`, `.item()`, rich dunders.
+Op methods (`t.matmul`, `t.sum`, `+`, ...) are installed by
+`paddle_tpu.ops` at import time to avoid circular imports.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from .device import get_jax_device, get_place
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "name",
+                 "persistable", "_retain_grads", "_version", "_hooks",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = "",
+                 _node=None):
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = _node
+        self.name = name
+        self.persistable = False
+        self._retain_grads = False
+        self._version = 0
+        self._hooks = []
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype.type
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return get_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        if _is_tracer(self._data):
+            raise RuntimeError("Tensor.numpy() is not allowed inside "
+                               "to_static/jit tracing (graph break).")
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        arr = self.numpy()
+        return arr.item(*args) if args else arr.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is "
+                             "ambiguous; use .any() or .all().")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .autograd import apply
+        return apply(jnp.copy, self, name="clone")
+
+    # -- device / dtype movement -------------------------------------------
+    def astype(self, dtype):
+        from .autograd import apply
+        d = dtypes.convert_dtype(dtype)
+        return apply(lambda a: a.astype(d), self, name="cast")
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and (a in dtypes._STR_TO_DTYPE):
+                out = out.astype(a)
+            elif isinstance(a, str):
+                from .device import Place
+                kind, _, idx = a.partition(":")
+                dev = Place(kind, int(idx) if idx else 0).jax_device
+                out = Tensor(jax.device_put(out._data, dev),
+                             stop_gradient=out.stop_gradient)
+            elif a in (dtypes.float16, dtypes.bfloat16, dtypes.float32,
+                       dtypes.float64, dtypes.int32, dtypes.int64,
+                       dtypes.bool_, dtypes.int8, dtypes.uint8):
+                out = out.astype(a)
+        return out
+
+    def pin_memory(self):
+        return self  # no host pinned memory concept under PJRT
+
+    def contiguous(self):
+        return self  # XLA owns layout
+
+    def is_contiguous(self):
+        return True
+
+    # -- in-place infrastructure -------------------------------------------
+    def _inplace_update(self, new_data):
+        self._data = new_data
+        self._version += 1
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(f"set_value shape mismatch: {arr.shape} vs "
+                             f"{self._data.shape}")
+        return self._inplace_update(arr)
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        return self._inplace_update(
+            jnp.full(self._data.shape, value, self._data.dtype))
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def scale_(self, scale=1.0, bias=0.0):
+        return self._inplace_update(self._data * scale + bias)
+
+    # -- misc --------------------------------------------------------------
+    def block_until_ready(self):
+        if not _is_tracer(self._data):
+            jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return (f"Tensor(shape={self.shape}, dtype="
+                    f"{dtypes.dtype_name(self.dtype)}, <traced>)")
+        prefix = (f"Tensor(shape={self.shape}, "
+                  f"dtype={dtypes.dtype_name(self.dtype)}, "
+                  f"place={get_place()}, "
+                  f"stop_gradient={self.stop_gradient},\n       ")
+        body = np.array2string(self.numpy(), prefix="       ")
+        return prefix + body + ")"
+
+    __str__ = __repr__
+
+    # NOTE: arithmetic dunders, indexing, and ~200 op methods are installed
+    # by paddle_tpu.ops._install_tensor_methods().
+
+
+class Parameter(Tensor):
+    """A trainable Tensor: stop_gradient defaults to False, persistable True.
+
+    Reference parity: paddle.base.framework.Parameter / EagerParamBase.
+    """
+
+    def __init__(self, data, trainable: bool = True, name: str = ""):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor — the universal eager constructor."""
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if d is not None and arr.dtype != jnp.dtype(d):
+            arr = arr.astype(d)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (jax.Array,)) and not _is_tracer(data):
+        arr = data if d is None else data.astype(d)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if _is_tracer(data):
+        return Tensor(data if d is None else data.astype(d),
+                      stop_gradient=stop_gradient)
+    np_arr = np.asarray(data)
+    if d is None:
+        if np_arr.dtype == np.float64:
+            np_arr = np_arr.astype(np.float32)  # 32-bit default (TPU-native)
+        elif np_arr.dtype == np.int64:
+            np_arr = np_arr.astype(np.int32)
+    else:
+        np_arr = np_arr.astype(np.dtype(d))
+    dev = get_jax_device() if place is None else place.jax_device
+    arr = jax.device_put(np_arr, dev)
+    return Tensor(arr, stop_gradient=stop_gradient)

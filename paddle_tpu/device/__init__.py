@@ -1,0 +1,42 @@
+"""paddle_tpu.device (paddle.device parity)."""
+from ..core.device import (CPUPlace, Place, TPUPlace, device_count,  # noqa: F401
+                           device_guard, get_device, get_place,
+                           is_compiled_with_tpu, set_device, synchronize)
+
+
+class _DeviceNamespace:
+    """paddle.device.cuda-style namespace for the TPU."""
+
+    @staticmethod
+    def device_count():
+        return device_count("tpu")
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        pass  # XLA/PJRT owns the device memory pool
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+tpu = _DeviceNamespace()
+cuda = _DeviceNamespace()  # API-compat alias so ported scripts run

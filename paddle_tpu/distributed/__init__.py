@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed — the Fleet-equivalent distributed stack.
+
+Reference parity: python/paddle/distributed (upstream, unverified; see
+SURVEY.md §2.3). Populated incrementally; `env` provides rank/world-size.
+"""
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401

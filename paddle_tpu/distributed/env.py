@@ -1,0 +1,45 @@
+"""Process-environment accessors (reference: PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env protocol — upstream
+python/paddle/distributed/parallel.py, unverified; see SURVEY.md §2.3).
+
+Under SPMD one process can drive many devices; "rank"/"world size" default
+to the jax process view and are overridden by the launcher's env vars.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_RANK_IN_NODE", "0"))
+
+
+def get_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def get_current_endpoint():
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -1,0 +1,3 @@
+"""paddle_tpu.framework — save/load + misc framework surface."""
+from .io_save import load, save  # noqa: F401
+from ..core.random import get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,63 @@
+"""paddle.save / paddle.load parity (upstream python/paddle/framework/io.py
+— unverified, see SURVEY.md §5.4): pickles nested containers, with tensors
+serialized as numpy payloads; loads back to device tensors.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, to_tensor
+
+
+class _TensorPayload:
+    def __init__(self, array, is_parameter, stop_gradient, name):
+        self.array = array
+        self.is_parameter = is_parameter
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data),
+                              isinstance(obj, Parameter),
+                              obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = to_tensor(obj.array, dtype=obj.array.dtype)
+        t.stop_gradient = obj.stop_gradient
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

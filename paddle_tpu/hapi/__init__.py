@@ -1,0 +1,3 @@
+"""paddle_tpu.hapi — high-level API (paddle.hapi parity)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,26 @@
+"""paddle_tpu.jit — dynamic-to-static compilation.
+
+Reference parity: @paddle.jit.to_static + SOT (upstream python/paddle/jit/
+— unverified, see SURVEY.md §2.2, §3.4). The reference needs an AST
+transformer + a bytecode interpreter + a second IR + an executor to turn
+eager Python into a graph. On the TPU-native substrate all of that
+collapses into `jax.jit`:
+
+- tracing the eager code (our ops are jax calls) *is* the graph capture;
+- jit's (shape, dtype) cache keys *are* the SOT guards;
+- data-dependent Python control flow raises a ConcretizationTypeError →
+  we fall back to eager execution, the analogue of a SOT graph break;
+- the "program" is a jaxpr/StableHLO module, compiled once by XLA.
+
+Autograd composes: the traced function is run through the framework's
+`apply`, so `loss.backward()` on a to_static output back-propagates through
+one compiled XLA computation (forward AND backward compiled).
+
+Buffer mutation (BatchNorm running stats) is functionalized: buffers enter
+the compiled function as inputs and their post-trace values are returned
+as extra outputs, then rebound into the live tensors.
+"""
+from __future__ import annotations
+
+from .to_static import ignore_module, not_to_static, to_static  # noqa: F401
+from .save_load import load, save  # noqa: F401

@@ -1,0 +1,126 @@
+"""jit.save / jit.load — deployment artifacts.
+
+Reference parity: paddle.jit.save/load producing an inference program +
+params (upstream python/paddle/jit/api.py — unverified, see SURVEY.md §2.2).
+TPU-native realization: the "program" is a serialized StableHLO module via
+`jax.export` — the XLA-world equivalent of the reference's inference
+program, loadable in any PJRT runtime — plus an .npz of parameters.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+class InputSpec:
+    """Reference parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else s for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_dtype(self):
+        from ..core.dtype import convert_dtype
+        shape = tuple(1 if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
+
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize `layer` (or function) to {path}.json/.npz/.stablehlo."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        named = list(layer.named_parameters()) + list(layer.named_buffers())
+        arrays = {n: np.asarray(t._data) for n, t in named}
+        np.savez(path + ".pdiparams.npz", **arrays)
+        fn = layer.forward
+        param_names = [n for n, _ in layer.named_parameters()]
+        buffer_names = [n for n, _ in layer.named_buffers()]
+
+        meta = {"type": "layer", "class": type(layer).__name__,
+                "params": param_names, "buffers": buffer_names}
+        if input_spec:
+            specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
+                     jax.ShapeDtypeStruct(tuple(s.shape),
+                                          jnp.dtype(s._data.dtype))
+                     for s in input_spec]
+
+            def pure(params, buffers, *inputs):
+                saved = []
+                for (n, t), arr in zip(named,
+                                       list(params) + list(buffers)):
+                    saved.append((t, t._data))
+                for (n, t), arr in zip(named, params + buffers):
+                    t._data = arr
+                try:
+                    layer.eval()
+                    out = layer(*[Tensor(a) for a in inputs])
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    return tuple(o._data for o in outs)
+                finally:
+                    for t, arr in saved:
+                        t._data = arr
+
+            params = [t._data for _, t in layer.named_parameters()]
+            buffers = [t._data for _, t in layer.named_buffers()]
+            try:
+                exported = jax.export.export(jax.jit(pure))(
+                    [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params],
+                    [jax.ShapeDtypeStruct(b.shape, b.dtype)
+                     for b in buffers], *specs)
+                with open(path + ".stablehlo", "wb") as f:
+                    f.write(exported.serialize())
+                meta["stablehlo"] = True
+            except Exception as e:  # export is best-effort; params always saved
+                meta["stablehlo"] = False
+                meta["export_error"] = str(e)[:500]
+        with open(path + ".pdmodel.json", "w") as f:
+            json.dump(meta, f)
+    else:
+        raise TypeError("jit.save expects a Layer (decorate functions with "
+                        "to_static and save the owning Layer)")
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference artifact (reference: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, path):
+        super().__init__()
+        with open(path + ".pdmodel.json") as f:
+            self._meta = json.load(f)
+        data = np.load(path + ".pdiparams.npz")
+        self._arrays = {k: jnp.asarray(data[k]) for k in data.files}
+        self._exported = None
+        if self._meta.get("stablehlo") and os.path.exists(
+                path + ".stablehlo"):
+            with open(path + ".stablehlo", "rb") as f:
+                self._exported = jax.export.deserialize(
+                    bytearray(f.read()))
+
+    def forward(self, *inputs):
+        if self._exported is None:
+            raise RuntimeError(
+                "No compiled program in this artifact (export failed at "
+                "save time); rebuild the original Layer and load the "
+                ".pdiparams.npz state_dict instead.")
+        params = [self._arrays[n] for n in self._meta["params"]]
+        buffers = [self._arrays[n] for n in self._meta["buffers"]]
+        outs = self._exported.call(params, buffers,
+                                   *[t._data for t in inputs])
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def state_dict(self, *a, **k):
+        return {n: Tensor(v) for n, v in self._arrays.items()}
+
+
+def load(path, **config):
+    return TranslatedLayer(path)

@@ -1,0 +1,238 @@
+"""to_static implementation (see paddle_tpu.jit docstring for the design)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.autograd import apply, is_grad_enabled
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+_NOT_TO_STATIC = set()
+
+
+def not_to_static(fn):
+    """Mark a function to always run eagerly (reference parity shim)."""
+    _NOT_TO_STATIC.add(fn)
+    return fn
+
+
+def ignore_module(modules):
+    pass  # all Python is traceable or falls back; nothing to ignore
+
+
+def _tree_flatten_tensors(obj):
+    """Flatten nested (list/tuple/dict) of Tensors + statics.
+
+    Returns (tensor_list, rebuild(tensors)->obj, static_signature).
+    """
+    tensors = []
+    statics = []
+
+    def walk(o):
+        if isinstance(o, Tensor):
+            idx = len(tensors)
+            tensors.append(o)
+            return ("T", idx)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [walk(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", {k: walk(v) for k, v in sorted(o.items())})
+        statics.append(o)
+        return ("S", o)
+
+    spec = walk(obj)
+
+    def rebuild(arrs, sp=spec):
+        def un(s):
+            tag = s[0]
+            if tag == "T":
+                return arrs[s[1]]
+            if tag == "S":
+                return s[1]
+            if tag == "dict":
+                return {k: un(v) for k, v in s[1].items()}
+            seq = [un(x) for x in s[1]]
+            return tuple(seq) if tag == "tuple" else seq
+        return un(sp)
+
+    def sig(s):
+        tag = s[0]
+        if tag == "T":
+            return ("T",)
+        if tag == "S":
+            v = s[1]
+            return ("S", v if isinstance(v, (int, float, str, bool,
+                                             type(None))) else repr(v))
+        if tag == "dict":
+            return ("dict", tuple((k, sig(v)) for k, v in s[1].items()))
+        return (tag, tuple(sig(x) for x in s[1]))
+
+    return tensors, rebuild, sig(spec)
+
+
+def _discover_layers(fn, args, kwargs, extra):
+    layers = []
+    seen = set()
+
+    def add(l):
+        if id(l) not in seen:
+            seen.add(id(l))
+            layers.append(l)
+
+    self_obj = getattr(fn, "__self__", None)
+    if isinstance(self_obj, Layer):
+        add(self_obj)
+    for a in list(args) + list(kwargs.values()) + list(extra):
+        if isinstance(a, Layer):
+            add(a)
+    # closure scan: layers referenced by the function body
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Layer):
+                add(v)
+    g = getattr(fn, "__globals__", None)
+    names = getattr(getattr(fn, "__code__", None), "co_names", ())
+    if g:
+        for n in names:
+            v = g.get(n)
+            if isinstance(v, Layer):
+                add(v)
+    return layers
+
+
+class StaticFunction:
+    """The compiled callable returned by to_static."""
+
+    def __init__(self, fn, build_strategy=None, backend=None,
+                 full_graph=False, layers=None):
+        self._fn = fn
+        self._layers = list(layers) if layers else None
+        self._jit_cache = {}
+        self._fallback_warned = False
+        functools.update_wrapper(self, fn,
+                                 assigned=("__name__", "__doc__",
+                                           "__qualname__"), updated=())
+
+    # descriptor protocol: decorating a method binds per-instance
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        bound = StaticFunction(self._fn.__get__(obj, objtype),
+                               layers=self._layers)
+        return bound
+
+    @property
+    def code(self):
+        return "<jax.jit-compiled; inspect via jax.make_jaxpr>"
+
+    def concrete_program_specs(self):
+        return list(self._jit_cache.keys())
+
+    def __call__(self, *args, **kwargs):
+        fn = self._fn
+        layers = self._layers or _discover_layers(fn, args, kwargs, ())
+        named_params = []
+        named_buffers = []
+        for li, layer in enumerate(layers):
+            for n, p in layer.named_parameters():
+                named_params.append((li, n, p))
+            for n, b in layer.named_buffers():
+                named_buffers.append((li, n, b))
+
+        in_tensors, rebuild_in, static_sig = _tree_flatten_tensors(
+            (args, kwargs))
+        cache_key = (static_sig, len(named_params), len(named_buffers),
+                     tuple((li, n) for li, n, _ in named_params))
+
+        jit_entry = self._jit_cache.get(cache_key)
+        if jit_entry is None:
+            jit_entry = self._build(fn, layers, named_params, named_buffers,
+                                    rebuild_in)
+            self._jit_cache[cache_key] = jit_entry
+        jit_fn, n_out_holder = jit_entry
+
+        key = _random.next_key()
+        param_tensors = [p for _, _, p in named_params]
+        buffer_tensors = [b for _, _, b in named_buffers]
+
+        try:
+            outs = apply(jit_fn, Tensor(key),
+                         *buffer_tensors, *param_tensors, *in_tensors,
+                         name="to_static")
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError):
+            # graph break → eager fallback (reference: SOT fallback)
+            self._jit_cache.pop(cache_key, None)
+            return fn(*args, **kwargs)
+
+        outs = list(outs) if isinstance(outs, tuple) else [outs]
+        n_out, rebuild_out = n_out_holder[0]
+        # rebind updated buffers
+        new_buf = outs[n_out:]
+        for b, nb in zip(buffer_tensors, new_buf):
+            b._inplace_update(nb._data)
+        return rebuild_out([t for t in outs[:n_out]])
+
+    def _build(self, fn, layers, named_params, named_buffers, rebuild_in):
+        n_buf = len(named_buffers)
+        n_par = len(named_params)
+        n_out_holder: list = []
+
+        def pure(key, *flat):
+            buf_arrays = flat[:n_buf]
+            par_arrays = flat[n_buf:n_buf + n_par]
+            in_arrays = flat[n_buf + n_par:]
+            # snapshot live state, substitute tracers
+            saved = []
+            for (li, n, t), arr in zip(
+                    list(named_buffers) + list(named_params),
+                    list(buf_arrays) + list(par_arrays)):
+                saved.append((t, t._data))
+                t._data = arr
+            _random.push_trace_key(key)
+            try:
+                args2, kwargs2 = rebuild_in(
+                    [Tensor(a, stop_gradient=True) for a in in_arrays])
+                result = fn(*args2, **kwargs2)
+                out_tensors, rebuild_out, _ = _tree_flatten_tensors(result)
+                new_buf = [t._data for _, _, t in named_buffers]
+                if not n_out_holder:
+                    n_out_holder.append(
+                        (len(out_tensors),
+                         lambda ts, rb=rebuild_out: rb(ts)))
+                return tuple(t._data for t in out_tensors) + tuple(new_buf)
+            finally:
+                _random.pop_trace_key()
+                for t, arr in saved:
+                    t._data = arr
+
+        return jax.jit(pure), n_out_holder
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=False, **kwargs):
+    """@paddle.jit.to_static parity. Works on functions, methods & Layers."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            obj.forward = StaticFunction(obj.forward, layers=[obj])
+            return obj
+        return StaticFunction(obj)
+
+    if function is not None:
+        return decorate(function)
+    return decorate

@@ -1,0 +1,3 @@
+"""paddle_tpu.linalg (paddle.linalg parity)."""
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.math import matmul  # noqa: F401

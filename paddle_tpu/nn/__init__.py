@@ -1,0 +1,30 @@
+"""paddle_tpu.nn — the neural-network layer library (paddle.nn parity)."""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid,
+                         Hardswish, Hardtanh, LeakyReLU, LogSoftmax, Maxout,
+                         Mish, PReLU, ReLU, ReLU6, Sigmoid, Silu, Softmax,
+                         Softplus, Softshrink, Softsign, Swish, Tanh,
+                         Tanhshrink, ThresholdedReLU)
+from .clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                        clip_grad_norm_)
+from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
+                     Dropout2D, Dropout3D, Embedding, Flatten, Identity,
+                     Linear, Pad1D, Pad2D, Pad3D, PixelShuffle, Upsample,
+                     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
+from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .layer import Layer, LayerList, ParameterList, ParamAttr, Sequential
+from .loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss,
+                   HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss,
+                   MSELoss, NLLLoss, SmoothL1Loss)
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                   GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+                   LayerNorm, LocalResponseNorm, RMSNorm, SpectralNorm,
+                   SyncBatchNorm)
+from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+                      AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
+                          TransformerDecoderLayer, TransformerEncoder,
+                          TransformerEncoderLayer)

@@ -1,0 +1,120 @@
+"""paddle_tpu.ops — the functional op surface.
+
+Aggregates all op modules and installs Tensor methods/dunders (the role of
+the generated pybind eager-method table in the reference — upstream
+paddle/fluid/pybind/eager_method.cc, unverified; see SURVEY.md §2.1).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import creation, indexing, linalg, logic, manipulation, math, random
+from .creation import *  # noqa: F401,F403
+from .linalg import (cholesky, cholesky_solve, corrcoef, cov, cross, cdist,
+                     det, dist, eig, eigh, eigvals, eigvalsh,
+                     householder_product, inv, lstsq, lu, matrix_exp,
+                     matrix_norm, matrix_power, matrix_rank, multi_dot, norm,
+                     pinv, qr, slogdet, solve, svd, svdvals, trace,
+                     triangular_solve, vector_norm)
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+# ---------------------------------------------------------------------------
+# Tensor method installation
+
+
+def _method(fn):
+    """Wrap a module-level op as a Tensor method (self is first arg)."""
+    def m(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    m.__name__ = fn.__name__
+    return m
+
+
+_METHOD_TABLE = {}
+for _mod in (math, manipulation, logic, linalg, creation):
+    for _name in dir(_mod):
+        if _name.startswith("_"):
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn) and getattr(_fn, "__module__", "").startswith(
+                "paddle_tpu"):
+            _METHOD_TABLE.setdefault(_name, _fn)
+
+# creation ops / helpers that don't take a tensor first arg must not become
+# methods
+for _bad in ("zeros", "ones", "full", "empty", "arange", "linspace",
+             "logspace", "eye", "meshgrid", "tril_indices", "triu_indices",
+             "scatter_nd", "broadcast_shape", "ensure_tensor", "to_tensor",
+             "apply", "unary_op", "binary_op", "amp_autocast", "Tensor",
+             "Parameter", "is_tensor", "getitem", "setitem",
+             "inplace_rebind"):
+    _METHOD_TABLE.pop(_bad, None)
+_METHOD_TABLE = {k: v for k, v in _METHOD_TABLE.items()
+                 if not isinstance(v, type)}
+
+
+def _install_tensor_methods():
+    for name, fn in _METHOD_TABLE.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, _method(fn))
+
+    # like-ops as methods drop the x arg naming confusion
+    Tensor.zeros_like = _method(creation.zeros_like)
+    Tensor.ones_like = _method(creation.ones_like)
+
+    # arithmetic dunders
+    Tensor.__add__ = _method(math.add)
+    Tensor.__radd__ = lambda self, other: math.add(other, self)
+    Tensor.__sub__ = _method(math.subtract)
+    Tensor.__rsub__ = lambda self, other: math.subtract(other, self)
+    Tensor.__mul__ = _method(math.multiply)
+    Tensor.__rmul__ = lambda self, other: math.multiply(other, self)
+    Tensor.__truediv__ = _method(math.divide)
+    Tensor.__rtruediv__ = lambda self, other: math.divide(other, self)
+    Tensor.__floordiv__ = _method(math.floor_divide)
+    Tensor.__rfloordiv__ = lambda self, other: math.floor_divide(other, self)
+    Tensor.__mod__ = _method(math.remainder)
+    Tensor.__rmod__ = lambda self, other: math.remainder(other, self)
+    Tensor.__pow__ = _method(math.pow)
+    Tensor.__rpow__ = lambda self, other: math.pow(other, self)
+    Tensor.__matmul__ = _method(math.matmul)
+    Tensor.__rmatmul__ = lambda self, other: math.matmul(other, self)
+    Tensor.__neg__ = _method(math.neg)
+    Tensor.__abs__ = _method(math.abs)
+    Tensor.__invert__ = _method(logic.logical_not)
+    Tensor.__and__ = _method(math.bitwise_and)
+    Tensor.__or__ = _method(math.bitwise_or)
+    Tensor.__xor__ = _method(math.bitwise_xor)
+    Tensor.__lshift__ = _method(math.bitwise_left_shift)
+    Tensor.__rshift__ = _method(math.bitwise_right_shift)
+
+    # comparisons (elementwise, like the reference; __hash__ stays id-based).
+    # `t == None` / `t != None` fall back to identity semantics so framework
+    # code using optional-tensor checks keeps working.
+    Tensor.__eq__ = lambda self, other: (False if other is None
+                                         else logic.equal(self, other))
+    Tensor.__ne__ = lambda self, other: (True if other is None
+                                         else logic.not_equal(self, other))
+    Tensor.__lt__ = _method(logic.less_than)
+    Tensor.__le__ = _method(logic.less_equal)
+    Tensor.__gt__ = _method(logic.greater_than)
+    Tensor.__ge__ = _method(logic.greater_equal)
+
+    # indexing
+    Tensor.__getitem__ = indexing.getitem
+    Tensor.__setitem__ = indexing.setitem
+
+    # frequently-used aliases matching reference method names
+    Tensor.mm = _method(math.mm)
+    Tensor.dot = _method(math.dot)
+    Tensor.norm = _method(norm)
+    Tensor.T = property(lambda self: manipulation.transpose(
+        self, list(range(self.ndim))[::-1]))
+    Tensor.mT = property(lambda self: manipulation.swapaxes(self, -1, -2))
+
+
+_install_tensor_methods()

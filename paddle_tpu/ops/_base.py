@@ -1,0 +1,88 @@
+"""Op-layer helpers: Tensor coercion, unary/binary wrappers, AMP hook.
+
+Reference parity note: this layer plays the role of the generated PHI API +
+dygraph ad_funcs (upstream paddle/phi/api + eager auto_code_generator output
+— unverified, see SURVEY.md §3.1): every op (a) optionally AMP-casts its
+inputs, (b) runs through the autograd applicator which records the vjp
+pullback, (c) dispatches to XLA via jax.numpy. There is no kernel registry:
+KernelFactory's (backend, dtype, layout) dispatch is what XLA/PJRT already
+does for us on TPU.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+
+_SCALAR_TYPES = (numbers.Number, np.bool_, np.number)
+
+
+def ensure_tensor(x, ref: Tensor | None = None):
+    """Coerce x to Tensor. Python scalars follow weak-type promotion against
+    `ref` (so float32 + 1.5 stays float32, like the reference)."""
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, _SCALAR_TYPES) and ref is not None:
+        # weak-typed: let jnp promote against ref dtype
+        return Tensor(jnp.asarray(x).astype(_promote_weak(x, ref)))
+    return to_tensor(x)
+
+
+def _promote_weak(scalar, ref: Tensor):
+    rd = jnp.dtype(ref.dtype)
+    if isinstance(scalar, (bool, np.bool_)):
+        return jnp.bool_ if rd.kind == "b" else rd
+    if isinstance(scalar, (int, np.integer)):
+        return rd  # int scalar adopts ref dtype (weak promotion)
+    # float scalar: adopt ref dtype if ref is floating, else default float
+    if rd.kind in ("f",) or rd == jnp.dtype(jnp.bfloat16):
+        return rd
+    return jnp.float32
+
+
+def unary_op(jfn, name=""):
+    def op(x, name_=None, **kw):
+        x = ensure_tensor(x)
+        if kw:
+            return apply(lambda a: jfn(a, **kw), x, name=name)
+        return apply(jfn, x, name=name)
+    op.__name__ = name or getattr(jfn, "__name__", "op")
+    return op
+
+
+def binary_op(jfn, name="", amp_category=None):
+    """Binary op; scalar operands stay in the closure for weak promotion."""
+    def op(x, y, name_=None):
+        xs = isinstance(x, _SCALAR_TYPES)
+        ys = isinstance(y, _SCALAR_TYPES)
+        if xs and ys:
+            return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+        if ys:
+            x = ensure_tensor(x)
+            return apply(lambda a: jfn(a, y), x, name=name)
+        if xs:
+            y = ensure_tensor(y)
+            return apply(lambda b: jfn(x, b), y, name=name)
+        x, y = ensure_tensor(x), ensure_tensor(y)
+        if amp_category is not None:
+            x, y = amp_autocast((x, y), amp_category)
+        return apply(jfn, x, y, name=name)
+    op.__name__ = name or getattr(jfn, "__name__", "op")
+    return op
+
+
+def amp_autocast(tensors, category):
+    """AMP O1 hook: cast inputs of white-listed ops to the autocast dtype.
+
+    Lazy import so ops work before amp is loaded. Reference parity:
+    the auto_cast op black/white lists (upstream python/paddle/amp/).
+    """
+    try:
+        from ..amp import state as amp_state
+    except ImportError:
+        return tensors
+    return amp_state.cast_for_op(tensors, category)

@@ -1,0 +1,180 @@
+"""Tensor creation ops (paddle.tensor.creation parity).
+
+Reference surface: upstream python/paddle/tensor/creation.py (unverified,
+see SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.device import get_jax_device
+from ..core.tensor import Tensor, to_tensor
+from ._base import ensure_tensor
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def _place(x):
+    return jax.device_put(x, get_jax_device())
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(_place(jnp.zeros(tuple(shape), _dt(dtype))))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(_place(jnp.ones(tuple(shape), _dt(dtype))))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtypes.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.int32
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(_place(jnp.full(tuple(shape), fill_value, _dt(dtype))))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)  # XLA has no uninitialized alloc; zeros is free-ish
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value,
+                                dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or dtypes.get_default_dtype()
+    d = _dt(dtype, default=dtypes.int32)
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    return Tensor(_place(jnp.arange(start, end, step, dtype=d)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    return Tensor(_place(jnp.linspace(start, stop, int(num), dtype=_dt(dtype))))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(_place(jnp.logspace(start, stop, int(num), base=base,
+                                      dtype=_dt(dtype))))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(_place(jnp.eye(num_rows, num_columns, dtype=_dt(dtype))))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if padding_value == 0:
+        return apply(lambda a: jnp.diag(a, k=offset), x, name="diag")
+
+    def f(a):
+        if a.ndim == 1:
+            n = a.shape[0] + int(np.abs(offset))
+            out = jnp.full((n, n), padding_value, a.dtype)
+            i = jnp.arange(a.shape[0])
+            r = i if offset >= 0 else i - offset
+            c = i + offset if offset >= 0 else i
+            return out.at[r, c].set(a)
+        return jnp.diag(a, k=offset)
+    return apply(f, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(jnp.int32))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col if col else row)
+    return Tensor(jnp.stack([r, c]).astype(jnp.int32))
+
+
+def meshgrid(*args, **kwargs):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and
+                                     isinstance(args[0], (list, tuple))
+                                     else args)]
+    return apply(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")),
+                 *ts, name="meshgrid")
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, int,
+                                               float)) else to_tensor(x)
+    out = apply(jnp.copy, x, name="assign")
+    if output is not None:
+        output._inplace_update(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int32))
+
+
+def complex(real, imag, name=None):
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs_, angle, name=None):
+    a, ang = ensure_tensor(abs_), ensure_tensor(angle)
+    return apply(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                 a, ang, name="polar")

@@ -1,0 +1,81 @@
+"""__getitem__ / __setitem__ with autograd, plus the in-place combinator.
+
+Reference parity: paddle.Tensor indexing (upstream
+python/paddle/base/variable_index.py — unverified, see SURVEY.md).
+In-place writes are functional `.at[].set` rewrites + version bump; the
+shadow-tensor trick keeps the autograd graph consistent: the recorded node
+holds a shadow alias of the *old* value, while the public tensor object is
+rebound to the new value (other nodes that captured the old value detect
+the version bump and raise, matching reference/torch semantics).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply, is_grad_enabled
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+
+def _convert_index(item):
+    """Normalize an index expression; Tensor indices become raw arrays."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    out = []
+    for it in item:
+        if isinstance(it, Tensor):
+            arr = it._data
+            if arr.dtype == jnp.bool_:
+                # boolean mask → dynamic shape; materialize eagerly
+                out.append(np.asarray(arr))
+            else:
+                out.append(arr)
+        elif isinstance(it, (list, np.ndarray)):
+            out.append(np.asarray(it))
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+def getitem(x, item):
+    idx = _convert_index(item)
+    return apply(lambda a: a[idx], x, name="getitem")
+
+
+def inplace_rebind(x: Tensor, fn, *others):
+    """Run `fn(shadow, *others) -> Tensor` and rebind x to the result in place.
+
+    The shadow carries x's old graph node so gradients flow through the
+    pre-mutation value; x's version bump invalidates any *other* nodes that
+    captured x, surfacing the classic in-place autograd hazard as an error.
+    """
+    if is_grad_enabled() and not x.stop_gradient and x._node is None:
+        raise RuntimeError(
+            "In-place operation on a leaf Tensor that requires grad is not "
+            "allowed (wrap in no_grad() for optimizer-style updates).")
+    shadow = Tensor(x._data, stop_gradient=x.stop_gradient, _node=x._node)
+    out = fn(shadow, *others)
+    x._data = out._data
+    x._node = out._node
+    if out._node is not None:
+        x.stop_gradient = False
+    x._version += 1
+    return x
+
+
+def setitem(x, item, value):
+    idx = _convert_index(item)
+    if isinstance(value, Tensor):
+        inplace_rebind(
+            x, lambda s, v: apply(
+                lambda a, b: a.at[idx].set(b.astype(a.dtype)), s, v,
+                name="setitem"),
+            value)
+    else:
+        val = np.asarray(value)
+        inplace_rebind(
+            x, lambda s: apply(
+                lambda a: a.at[idx].set(jnp.asarray(val).astype(a.dtype)), s,
+                name="setitem"))
+    return x

@@ -1,0 +1,277 @@
+"""Linear algebra ops (paddle.linalg parity).
+
+Reference surface: upstream python/paddle/tensor/linalg.py (unverified, see
+SURVEY.md §2.2). Decompositions lower to lax.linalg; on TPU, XLA picks
+MXU-friendly blocked algorithms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def f(a):
+        if axis is None and p == "fro":
+            return jnp.sqrt(jnp.sum(a * a))
+        if p == "fro":
+            return jnp.linalg.norm(a, ord="fro",
+                                   axis=tuple(axis) if isinstance(
+                                       axis, (list, tuple)) else axis,
+                                   keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=tuple(axis),
+                                   keepdims=keepdim)
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+            return r
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                           keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, x, name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                           keepdims=keepdim), x,
+                 name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(f, x, y, name="dist")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply(f, x, y, name="cdist")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else next(
+        (i for i, d in enumerate(x.shape) if d == 3), -1)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(f, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -1, -2), z, lower=False)
+    return apply(f, x, y, name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return apply(lambda a: jnp.linalg.qr(a, mode="r"), x, name="qr")
+    q, r = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    u, s, vh = apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x, name="svd")
+    return u, s, vh
+
+
+def svdvals(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x,
+                 name="svdvals")
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._data))  # CPU only (XLA lacks geev)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    w, v = apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x,
+                 name="eigh")
+    return w, v
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                 name="eigvalsh")
+
+
+def inv(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jnp.linalg.inv, x, name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian), x,
+                 name="pinv")
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular),
+        x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+    lu_, piv = apply(
+        lambda a: tuple(jax.scipy.linalg.lu_factor(a)), x, name="lu")
+    piv = piv.detach()
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_, piv, info
+    return lu_, piv
+
+
+def matrix_power(x, n, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x,
+                 name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol).astype(jnp.int32))
+
+
+def det(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    sign, logdet = apply(lambda a: tuple(jnp.linalg.slogdet(a)), x,
+                         name="slogdet")
+    return sign, logdet
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *ts,
+                 name="multi_dot")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else q
+        for k in range(t.shape[-1]):
+            v = a[..., :, k]
+            v = jnp.where(jnp.arange(m) < k, 0.0, v)
+            v = v.at[..., k].set(1.0)
+            tk = t[..., k]
+            H = (jnp.eye(m, dtype=a.dtype) -
+                 tk[..., None, None] * v[..., :, None] * v[..., None, :])
+            q = jnp.matmul(q, H)
+        return q[..., :, :n]
+    return apply(f, x, tau, name="householder_product")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    fw = fweights._data if fweights is not None else None
+    aw = aweights._data if aweights is not None else None
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), x, name="trace")
+
+
+def matrix_exp(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jax.scipy.linalg.expm, x, name="matrix_exp")

@@ -1,0 +1,208 @@
+"""Comparison / logic / search ops (paddle.tensor.logic + search parity).
+
+Reference surface: upstream python/paddle/tensor/logic.py + search.py
+(unverified, see SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ._base import ensure_tensor, binary_op, unary_op
+
+equal = binary_op(jnp.equal, "equal")
+not_equal = binary_op(jnp.not_equal, "not_equal")
+greater_than = binary_op(jnp.greater, "greater_than")
+greater_equal = binary_op(jnp.greater_equal, "greater_equal")
+less_than = binary_op(jnp.less, "less_than")
+less_equal = binary_op(jnp.less_equal, "less_equal")
+
+logical_and = binary_op(jnp.logical_and, "logical_and")
+logical_or = binary_op(jnp.logical_or, "logical_or")
+logical_xor = binary_op(jnp.logical_xor, "logical_xor")
+logical_not = unary_op(jnp.logical_not, "logical_not")
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.isclose(x._data, y._data, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = ensure_tensor(x), ensure_tensor(test_x)
+    return Tensor(jnp.isin(x._data, test_x._data, invert=invert))
+
+
+# ---------------------------------------------------------------------------
+# search / sort
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.argmax(x._data, axis=axis, keepdims=keepdim)
+                  .astype(jnp.int32))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.argmin(x._data, axis=axis, keepdims=keepdim)
+                  .astype(jnp.int32))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+    a = x._data
+    idx = jnp.argsort(-a if descending else a, axis=axis, stable=stable)
+    return Tensor(idx.astype(jnp.int32))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(f, x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        am = jnp.moveaxis(a, axis, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, k)
+        else:
+            v, i = jax.lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    vals, idx = apply(f, x, name="topk")
+    return vals, idx.detach().astype(jnp.int32)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(v, axis) if keepdim else v
+    vals = apply(f, x, name="kthvalue")
+    a = x._data
+    idx = jnp.take(jnp.argsort(a, axis=axis), k - 1, axis=axis)
+    if keepdim:
+        idx = jnp.expand_dims(idx, axis)
+    return vals, Tensor(idx.astype(jnp.int32))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    a = np.asarray(x._data)
+    from scipy import stats as _stats  # scipy ships with jax deps
+    m = _stats.mode(a, axis=axis, keepdims=keepdim)
+    vals = Tensor(jnp.asarray(m.mode.astype(a.dtype)))
+    return vals, Tensor(jnp.asarray(np.zeros_like(m.count, dtype=np.int32)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss._data, v._data, side=side)
+    else:
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            ss._data.reshape(-1, ss.shape[-1]),
+            v._data.reshape(-1, v.shape[-1]))
+        out = out.reshape(v._data.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int32))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)  # dynamic output shape → eager only
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(np.int32)))
+            for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        diff = (arr.take(range(1, arr.shape[axis]), axis=axis) !=
+                arr.take(range(0, arr.shape[axis] - 1), axis=axis))
+        keep = np.concatenate(
+            [[True], diff.reshape(diff.shape[axis], -1).any(axis=1)])
+        out = np.compress(keep, arr, axis=axis)
+        return Tensor(jnp.asarray(out))
+    out = arr[keep]
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.size))
+        res.append(Tensor(jnp.asarray(counts.astype(np.int32))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    x = ensure_tensor(input)
+    a = x._data
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(a), jnp.max(a)
+    else:
+        lo, hi = min, max
+    w = weight._data if weight is not None else None
+    hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w,
+                            density=density)
+    return Tensor(hist)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))

@@ -1,0 +1,586 @@
+"""Shape / layout manipulation ops (paddle.tensor.manipulation parity).
+
+Reference surface: upstream python/paddle/tensor/manipulation.py
+(unverified, see SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    s = tuple(_shape_list(shape))
+    return apply(lambda a: jnp.reshape(a, s), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._inplace_update(out._data)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(shape_or_dtype)
+    return apply(lambda a: jax.lax.bitcast_convert_type(a, d), x, name="view")
+
+
+def transpose(x, perm=None, name=None):
+    x = ensure_tensor(x)
+    p = tuple(perm) if perm is not None else None
+    return apply(lambda a: jnp.transpose(a, p), x, name="transpose")
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return apply(jnp.transpose, x, name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x,
+                 name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+transpose_ = swapaxes
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    sa = start_axis % nd
+    ea = stop_axis % nd
+
+    def f(a):
+        shape = a.shape[:sa] + (-1,) + a.shape[ea + 1:]
+        return jnp.reshape(a, shape)
+    return apply(f, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        return apply(lambda a: jnp.squeeze(a), x, name="squeeze")
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(a % max(x.ndim, 1) for a in axes)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return apply(lambda a: jnp.squeeze(a, axis=axes), x, name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_update(squeeze(x, axis)._data)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def f(a):
+        out_nd = a.ndim + len(axes)
+        out = a
+        for ax in sorted(ax % out_nd for ax in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(f, x, name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_update(unsqueeze(x, axis)._data)
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t_) for t_ in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *ts,
+                 name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t_) for t_ in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *ts, name="stack")
+
+
+def hstack(x, name=None):
+    ts = [ensure_tensor(t_) for t_ in x]
+    return apply(lambda *arrs: jnp.hstack(arrs), *ts, name="hstack")
+
+
+def vstack(x, name=None):
+    ts = [ensure_tensor(t_) for t_ in x]
+    return apply(lambda *arrs: jnp.vstack(arrs), *ts, name="vstack")
+
+
+def dstack(x, name=None):
+    ts = [ensure_tensor(t_) for t_ in x]
+    return apply(lambda *arrs: jnp.dstack(arrs), *ts, name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = axis % x.ndim
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s in (-1,))
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections)
+    outs = apply(
+        lambda a: tuple(jax.lax.slice_in_dim(a, int(offsets[i]),
+                                             int(offsets[i + 1]), axis=ax)
+                        for i in range(len(sections))),
+        x, name="split")
+    return list(outs)
+
+
+builtins_sum = sum  # keep python sum; paddle_tpu.sum shadows it at pkg level
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis % x.ndim]
+    outs = apply(
+        lambda a: tuple(jnp.squeeze(s, axis % a.ndim)
+                        for s in jnp.split(a, n, axis=axis)),
+        x, name="unbind")
+    return list(outs)
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = tuple(_shape_list(repeat_times))
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    s = _shape_list(shape)
+    xs = x.shape
+
+    def f(a):
+        target = list(s)
+        # -1 means keep the original dim (right-aligned broadcast)
+        off = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - off] if i >= off else 1
+        return jnp.broadcast_to(a, tuple(target))
+    return apply(f, x, name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t_) for t_ in inputs]
+    return list(apply(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *ts,
+                      name="broadcast_tensors"))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.roll(a, sh, axis=ax), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), x, index.detach(),
+                 name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def f(a, i):
+        idx_depth = i.shape[-1]
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply(f, x, index.detach(), name="gather_nd")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply(lambda a, i: jnp.take(a.reshape(-1), i, mode=m), x,
+                 index.detach(), name="take")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr,
+                 indices.detach(), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values, ref=arr)
+
+    def f(a, v, i):
+        vv = jnp.broadcast_to(v, i.shape) if v.ndim else jnp.full(
+            i.shape, v, a.dtype)
+        upd = a.at[_along_axis_index(a, i, axis)]
+        if reduce == "assign":
+            return upd.set(vv)
+        if reduce in ("add", "sum"):
+            return upd.add(vv)
+        if reduce in ("mul", "multiply"):
+            return upd.multiply(vv)
+        if reduce == "amax":
+            return upd.max(vv)
+        if reduce == "amin":
+            return upd.min(vv)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(f, arr, values, indices.detach(), name="put_along_axis")
+
+
+def _along_axis_index(a, i, axis):
+    axis = axis % a.ndim
+    idx = []
+    for d in range(a.ndim):
+        if d == axis:
+            idx.append(i)
+        else:
+            shape = [1] * a.ndim
+            shape[d] = a.shape[d]
+            r = jnp.arange(a.shape[d]).reshape(shape)
+            idx.append(jnp.broadcast_to(r, i.shape))
+    return tuple(idx)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """paddle.scatter: writes `updates` rows of x at `index` (axis 0)."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    updates = ensure_tensor(updates, ref=x)
+
+    def f(a, u, i):
+        if overwrite:
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply(f, x, updates, index.detach(), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_update(scatter(x, index, updates, overwrite)._data)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    updates = ensure_tensor(updates, ref=x)
+
+    def f(a, u, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply(f, x, updates, index.detach(), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index = ensure_tensor(index)
+    updates = ensure_tensor(updates)
+
+    def f(u, i):
+        zero = jnp.zeros(tuple(shape), u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return zero.at[idx].add(u)
+    return apply(f, updates, index.detach(), name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1), x,
+                 index.detach(), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    value = ensure_tensor(value, ref=x)
+
+    def f(a, v, i):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(am.at[i].add(vm), 0, axis)
+    return apply(f, x, value, index.detach(), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value, ref=x)
+    idx_ts = [ensure_tensor(i).detach() for i in indices]
+
+    def f(a, v, *idx):
+        ref = a.at[tuple(idx)]
+        return ref.add(v) if accumulate else ref.set(v)
+    return apply(f, x, value, *idx_ts, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # Dynamic output shape: not jit-compatible; eager-only (graph break in
+    # to_static, same as the reference's dynamic-shape ops on XLA).
+    data = x._data[np.asarray(mask._data)]
+    out = Tensor(data)
+    if not x.stop_gradient:
+        mask_arr = mask._data
+        out2 = apply(lambda a: a[np.asarray(mask_arr)], x,
+                     name="masked_select")
+        return out2
+    return out
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply(lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), x,
+                     mask.detach(), value, name="masked_fill")
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(value, a.dtype), a), x,
+                 mask.detach(), name="masked_fill")
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._inplace_update(masked_fill(x, mask, value)._data)
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+
+    def f(a, m, v):
+        flat_m = m.reshape(-1)
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        gathered = jnp.take(v.reshape(-1), jnp.clip(pos, 0, v.size - 1))
+        return jnp.where(flat_m, gathered, a.reshape(-1)).reshape(a.shape)
+    return apply(f, x, mask.detach(), value, name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x = ensure_tensor(x, ref=None)
+    y = ensure_tensor(y, ref=x)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition.detach(), x, y,
+                 name="where")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)  # dynamic shape → eager only
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int32))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    pad = _shape_list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-spec: [d0_l, d0_r, d1_l, d1_r, ...] paddle uses per-dim pairs
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims, torch-style
+        # (reversed pairs from the last dim)
+        n_pairs = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        for i in range(n_pairs):
+            dim = nd - 1 - i
+            cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply(f, x, name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+        return apply(lambda a: jnp.repeat(a, jnp.asarray(reps), axis=axis,
+                                          total_repeat_length=total), x,
+                     name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), x,
+                 name="repeat_interleave")
+
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    starts = _shape_list(starts)
+    ends = _shape_list(ends)
+
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+    return apply(f, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    x = ensure_tensor(x)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, _shape_list(starts), _shape_list(ends),
+                                _shape_list(strides)):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+    return apply(f, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    s = _shape_list(shape)
+    off = _shape_list(offsets) if offsets is not None else [0] * x.ndim
+    s = [x.shape[i] if v == -1 else v for i, v in enumerate(s)]
+    return apply(lambda a: jax.lax.dynamic_slice(a, off, s), x, name="crop")
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                 name="as_real")
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 name="as_complex")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, ensure_tensor(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                 name="tensordot")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, name="diagonal")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    x = ensure_tensor(input)
+
+    def f(a):
+        n = a.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        r = i if offset >= 0 else i - offset
+        c = i + offset if offset >= 0 else i
+        out = out.at[..., r, c].set(a)
+        src_dims = (out.ndim - 2, out.ndim - 1)
+        return jnp.moveaxis(out, src_dims, (dim1, dim2))
+    return apply(f, x, name="diag_embed")
+
+
+builtins_abs = abs
+
+
+def unfold(x, axis, size, step, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        dim = a.shape[axis]
+        n = (dim - size) // step + 1
+        starts = jnp.arange(n) * step
+        def get(s):
+            return jax.lax.dynamic_slice_in_dim(a, s, size, axis=axis)
+        out = jax.vmap(get)(starts)          # [n, ..., size@axis+1, ...]
+        out = jnp.moveaxis(out, axis + 1, -1)  # window size to last dim
+        return jnp.moveaxis(out, 0, axis)      # window count to axis
+    return apply(f, x, name="unfold")

@@ -1,0 +1,391 @@
+"""Elementwise math + reductions (paddle.tensor.math / stat parity).
+
+Reference surface: upstream python/paddle/tensor/math.py + stat.py
+(unverified, see SURVEY.md §2.2). All ops lower to jax.numpy → XLA; the
+autograd applicator records vjp pullbacks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ._base import ensure_tensor, unary_op, binary_op, amp_autocast
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+
+add = binary_op(jnp.add, "add")
+subtract = binary_op(jnp.subtract, "subtract")
+multiply = binary_op(jnp.multiply, "multiply")
+divide = binary_op(jnp.divide, "divide")
+floor_divide = binary_op(jnp.floor_divide, "floor_divide")
+remainder = binary_op(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = binary_op(jnp.power, "pow")
+maximum = binary_op(jnp.maximum, "maximum")
+minimum = binary_op(jnp.minimum, "minimum")
+fmax = binary_op(jnp.fmax, "fmax")
+fmin = binary_op(jnp.fmin, "fmin")
+atan2 = binary_op(jnp.arctan2, "atan2")
+hypot = binary_op(jnp.hypot, "hypot")
+logaddexp = binary_op(jnp.logaddexp, "logaddexp")
+heaviside = binary_op(jnp.heaviside, "heaviside")
+copysign = binary_op(jnp.copysign, "copysign")
+nextafter = binary_op(jnp.nextafter, "nextafter")
+ldexp = binary_op(jnp.ldexp, "ldexp")
+gcd = binary_op(jnp.gcd, "gcd")
+lcm = binary_op(jnp.lcm, "lcm")
+
+bitwise_and = binary_op(jnp.bitwise_and, "bitwise_and")
+bitwise_or = binary_op(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = binary_op(jnp.bitwise_xor, "bitwise_xor")
+bitwise_not = unary_op(jnp.bitwise_not, "bitwise_not")
+bitwise_left_shift = binary_op(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = binary_op(jnp.right_shift, "bitwise_right_shift")
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+
+exp = unary_op(jnp.exp, "exp")
+expm1 = unary_op(jnp.expm1, "expm1")
+log = unary_op(jnp.log, "log")
+log2 = unary_op(jnp.log2, "log2")
+log10 = unary_op(jnp.log10, "log10")
+log1p = unary_op(jnp.log1p, "log1p")
+sqrt = unary_op(jnp.sqrt, "sqrt")
+rsqrt = unary_op(lambda a: jax.lax.rsqrt(a), "rsqrt")
+square = unary_op(jnp.square, "square")
+abs = unary_op(jnp.abs, "abs")
+sign = unary_op(jnp.sign, "sign")
+floor = unary_op(jnp.floor, "floor")
+ceil = unary_op(jnp.ceil, "ceil")
+round = unary_op(jnp.round, "round")
+trunc = unary_op(jnp.trunc, "trunc")
+frac = unary_op(lambda a: a - jnp.trunc(a), "frac")
+sin = unary_op(jnp.sin, "sin")
+cos = unary_op(jnp.cos, "cos")
+tan = unary_op(jnp.tan, "tan")
+asin = unary_op(jnp.arcsin, "asin")
+acos = unary_op(jnp.arccos, "acos")
+atan = unary_op(jnp.arctan, "atan")
+sinh = unary_op(jnp.sinh, "sinh")
+cosh = unary_op(jnp.cosh, "cosh")
+tanh = unary_op(jnp.tanh, "tanh")
+asinh = unary_op(jnp.arcsinh, "asinh")
+acosh = unary_op(jnp.arccosh, "acosh")
+atanh = unary_op(jnp.arctanh, "atanh")
+erf = unary_op(jax.scipy.special.erf, "erf")
+erfinv = unary_op(jax.scipy.special.erfinv, "erfinv")
+reciprocal = unary_op(lambda a: 1.0 / a, "reciprocal")
+neg = unary_op(jnp.negative, "neg")
+negative = neg
+digamma = unary_op(jax.scipy.special.digamma, "digamma")
+lgamma = unary_op(jax.scipy.special.gammaln, "lgamma")
+gammaln = lgamma
+i0 = unary_op(jax.scipy.special.i0, "i0")
+i1 = unary_op(jax.scipy.special.i1, "i1")
+sigmoid = unary_op(jax.nn.sigmoid, "sigmoid")
+logit = unary_op(jax.scipy.special.logit, "logit")
+rad2deg = unary_op(jnp.rad2deg, "rad2deg")
+deg2rad = unary_op(jnp.deg2rad, "deg2rad")
+angle = unary_op(jnp.angle, "angle")
+conj = unary_op(jnp.conj, "conj")
+real = unary_op(jnp.real, "real")
+imag = unary_op(jnp.imag, "imag")
+
+isnan = unary_op(jnp.isnan, "isnan")
+isinf = unary_op(jnp.isinf, "isinf")
+isfinite = unary_op(jnp.isfinite, "isfinite")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), x, name="nan_to_num")
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s, b = scale, bias
+    if bias_after_scale:
+        out = apply(lambda a: a * s + b, x, name="scale")
+    else:
+        out = apply(lambda a: (a + b) * s, x, name="scale")
+    return out
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    idx = ensure_tensor(index)
+    ts = [ensure_tensor(t) for t in inputs]
+    return apply(
+        lambda i, *arrs: jnp.take_along_axis(
+            jnp.stack(arrs, 0), i.reshape(1, -1, *([1] * (arrs[0].ndim - 1))),
+            axis=0)[0],
+        idx, *ts, name="multiplex")
+
+# ---------------------------------------------------------------------------
+# reductions
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(jfn, name):
+    def op(x, axis=None, keepdim=False, name_=None, **kw):
+        x = ensure_tensor(x)
+        ax = _norm_axis(axis)
+        return apply(lambda a: jfn(a, axis=ax, keepdims=keepdim, **kw), x,
+                     name=name)
+    op.__name__ = name
+    return op
+
+
+sum = _reduction(jnp.sum, "sum")
+nansum = _reduction(jnp.nansum, "nansum")
+mean = _reduction(jnp.mean, "mean")
+nanmean = _reduction(jnp.nanmean, "nanmean")
+amax = _reduction(jnp.max, "amax")
+amin = _reduction(jnp.min, "amin")
+prod = _reduction(jnp.prod, "prod")
+all = _reduction(jnp.all, "all")
+any = _reduction(jnp.any, "any")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, name="min")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x,
+                 name="median")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax,
+                                        keepdims=keepdim), x, name="quantile")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                       keepdims=keepdim),
+                 x, name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), x,
+                 name="count_nonzero")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1)), x, name="cumsum")
+    return apply(lambda a: jnp.cumsum(a, axis=axis), x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dim is None:
+        return apply(lambda a: jnp.cumprod(a.reshape(-1)), x, name="cumprod")
+    return apply(lambda a: jnp.cumprod(a, axis=dim), x, name="cumprod")
+
+
+def _cum_argext(is_max, ax):
+    def f(a):
+        shape = [1] * a.ndim
+        shape[ax] = a.shape[ax]
+        idx = jnp.broadcast_to(
+            jnp.arange(a.shape[ax], dtype=jnp.int32).reshape(shape), a.shape)
+
+        def comb(x, y):
+            xv, xi = x
+            yv, yi = y
+            take_y = (yv >= xv) if is_max else (yv <= xv)
+            return jnp.where(take_y, yv, xv), jnp.where(take_y, yi, xi)
+
+        return jax.lax.associative_scan(comb, (a, idx), axis=ax)
+    return f
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    xx = x if axis is not None else apply(lambda a: a.reshape(-1), x)
+    ax = (axis if axis is not None else 0) % xx.ndim
+    vals, idx = apply(_cum_argext(True, ax), xx, name="cummax")
+    return vals, idx.detach()
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    xx = x if axis is not None else apply(lambda a: a.reshape(-1), x)
+    ax = (axis if axis is not None else 0) % xx.ndim
+    vals, idx = apply(_cum_argext(False, ax), xx, name="cummin")
+    return vals, idx.detach()
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                    append=app), x, name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        x = ensure_tensor(x)
+        return apply(lambda a, b: jnp.trapezoid(a, b, axis=axis), y, x,
+                     name="trapezoid")
+    return apply(lambda a: jnp.trapezoid(a, dx=dx if dx else 1.0, axis=axis),
+                 y, name="trapezoid")
+
+# ---------------------------------------------------------------------------
+# matmul-family (AMP white-listed)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    x, y = amp_autocast((x, y), "matmul")
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y, name="matmul")
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    x, y = amp_autocast((x, y), "matmul")
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, name="addmm")
+
+
+def einsum(equation, *operands):
+    ops = [ensure_tensor(o) for o in operands]
+    ops = list(amp_autocast(tuple(ops), "matmul"))
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *ops,
+                 name="einsum")
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.kron, x, y, name="kron")
+
+# ---------------------------------------------------------------------------
+# in-place variants (functional rewrite + version bump)
+
+
+def _make_inplace(fn_name, fn):
+    def op(x, *args, **kwargs):
+        from .indexing import inplace_rebind
+        return inplace_rebind(x, lambda s: fn(s, *args, **kwargs))
+    op.__name__ = fn_name
+    return op
+
+
+add_ = _make_inplace("add_", add)
+subtract_ = _make_inplace("subtract_", subtract)
+multiply_ = _make_inplace("multiply_", multiply)
+divide_ = _make_inplace("divide_", divide)
+clip_ = _make_inplace("clip_", clip)
+exp_ = _make_inplace("exp_", exp)
+sqrt_ = _make_inplace("sqrt_", sqrt)
+rsqrt_ = _make_inplace("rsqrt_", rsqrt)
+reciprocal_ = _make_inplace("reciprocal_", reciprocal)
+round_ = _make_inplace("round_", round)
+floor_ = _make_inplace("floor_", floor)
+ceil_ = _make_inplace("ceil_", ceil)
+neg_ = _make_inplace("neg_", neg)
+abs_ = _make_inplace("abs_", abs)
+sigmoid_ = _make_inplace("sigmoid_", sigmoid)
+tanh_ = _make_inplace("tanh_", tanh)

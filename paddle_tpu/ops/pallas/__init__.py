@@ -1,0 +1,4 @@
+"""TPU Pallas kernels (the PHI fused-kernel equivalent — SURVEY.md §2.1
+"PHI kernels — fusion"). Each kernel ships with an XLA fallback used off-TPU
+and as the numerical oracle in tests.
+"""

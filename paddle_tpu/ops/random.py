@@ -1,0 +1,160 @@
+"""Random ops, driven by the framework's global threefry stream.
+
+Reference surface: upstream python/paddle/tensor/random.py (unverified, see
+SURVEY.md §2.2). Determinism note (SURVEY.md §7 "hard parts"): the
+reference uses Philox; we use JAX threefry with a fold-in counter — streams
+differ bitwise from the reference, so loss parity is statistical, not
+bitwise. Within this framework, `paddle_tpu.seed(s)` makes every run
+reproducible, and the distributed RNGStatesTracker builds on
+get/set_rng_state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from ..core import dtype as dtypes
+from ..core.autograd import apply
+from ..core.device import get_jax_device
+from ..core.random import next_key
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+
+def _dt(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jrandom.uniform(next_key(), tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jrandom.normal(next_key(), tuple(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        mean_t = ensure_tensor(mean)
+        std_t = ensure_tensor(std, ref=mean_t)
+        shp = tuple(jnp.broadcast_shapes(tuple(mean_t.shape),
+                                         tuple(std_t.shape)))
+        k = next_key()
+        return apply(
+            lambda m, s: m + s * jrandom.normal(k, shp, m.dtype),
+            mean_t, std_t, name="normal")
+    shp = tuple(shape) if shape is not None else ()
+    d = dtypes.get_default_dtype()
+    return Tensor(mean + std * jrandom.normal(next_key(), shp, d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt(dtype)
+    return Tensor(jrandom.uniform(next_key(), tuple(shape), d,
+                                  minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = jrandom.uniform(next_key(), tuple(x.shape), x._data.dtype,
+                          minval=min, maxval=max)
+    return x._inplace_update(out)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype) or dtypes.int32
+    return Tensor(jrandom.randint(next_key(), tuple(shape), low, high,
+                                  dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, tuple(x.shape),
+                   dtype or x._data.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    if d == jnp.int64:
+        d = jnp.int32  # 32-bit default on TPU
+    return Tensor(jrandom.permutation(next_key(), n).astype(d))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    k = next_key()
+    return Tensor(
+        jrandom.bernoulli(k, x._data).astype(x._data.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    out = jrandom.bernoulli(next_key(), p, tuple(x.shape)).astype(
+        x._data.dtype)
+    return x._inplace_update(out)
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jrandom.poisson(next_key(), x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    k = next_key()
+    probs = x._data
+    logits = jnp.log(jnp.clip(probs, 1e-30, None))
+    if replacement:
+        out = jrandom.categorical(k, logits, axis=-1,
+                                  shape=(num_samples,) + probs.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jrandom.gumbel(k, probs.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int32))
+
+
+def rand_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return rand(tuple(x.shape), dtype or x._data.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randn(tuple(x.shape), dtype or x._data.dtype)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = mean + std * jrandom.normal(next_key(), tuple(x.shape),
+                                      x._data.dtype)
+    return x._inplace_update(out)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jrandom.exponential(next_key(), tuple(x.shape),
+                              x._data.dtype) / lam
+    return x._inplace_update(out)
+
+
+def binomial(count, prob, name=None):
+    count, prob = ensure_tensor(count), ensure_tensor(prob)
+    out = jrandom.binomial(next_key(), count._data.astype(jnp.float32),
+                           prob._data)
+    return Tensor(out.astype(jnp.int32))
+
+
+def standard_gamma(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jrandom.gamma(next_key(), x._data))
+
+
+def log_normal(mean=1.0, std=2.0, shape=(1,), name=None):
+    d = dtypes.get_default_dtype()
+    return Tensor(jnp.exp(mean + std * jrandom.normal(next_key(),
+                                                      tuple(shape), d)))

@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (paddle.optimizer parity)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
+                         Momentum, RMSProp)

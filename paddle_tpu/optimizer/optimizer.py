@@ -1,0 +1,207 @@
+"""Optimizer base.
+
+Reference parity: paddle.optimizer.Optimizer (upstream
+python/paddle/optimizer/optimizer.py — unverified, see SURVEY.md §2.2):
+parameter groups, LR schedulers, grad clip, regularization, accumulators,
+state_dict.
+
+TPU-native design: the update for ALL parameters is executed as ONE jitted
+pytree computation (`_fused_apply`) — the equivalent of the reference's
+multi-tensor fused adamw kernel (SURVEY.md §2.1 "adamw_kernel incl.
+multi-tensor"): one XLA executable updates every param/accumulator, keeping
+launch overhead O(1) instead of O(#params). LR / step scalars are traced
+arguments so scheduler ticks don't recompile.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class _L2DecayStub:
+    def __init__(self, coeff):
+        self.coeff = float(coeff)
+
+
+def _decay_coeff(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if isinstance(weight_decay, (int, float)):
+        return float(weight_decay)
+    return float(getattr(weight_decay, "coeff",
+                         getattr(weight_decay, "_coeff", 0.0)))
+
+
+class Optimizer:
+    _state_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in this framework (eager mode).")
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = _decay_coeff(weight_decay)
+        self._multi_precision = multi_precision
+        self._use_master_weights = multi_precision
+        self._step_count = 0
+        self._accum: dict[int, dict] = {}   # id(param) -> state dict
+        self._param_groups = self._build_groups(parameters)
+        # One XLA executable for the whole update; no buffer donation so
+        # user-held aliases of params stay valid (XLA still reuses memory).
+        self._fused = jax.jit(self._fused_apply)
+
+    # -- param groups -------------------------------------------------------
+    def _build_groups(self, parameters):
+        parameters = list(parameters)
+        if parameters and isinstance(parameters[0], dict):
+            groups = []
+            for g in parameters:
+                g = dict(g)
+                g["params"] = list(g["params"])
+                groups.append(g)
+            return groups
+        return [{"params": parameters}]
+
+    def _all_params(self):
+        for g in self._param_groups:
+            for p in g["params"]:
+                yield p
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("set_lr cannot override an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- state --------------------------------------------------------------
+    def _get_state(self, p: Tensor):
+        st = self._accum.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            if self._use_master_weights and jnp.dtype(p.dtype) != \
+                    jnp.dtype(jnp.float32):
+                master = getattr(p, "_master_weight", None)
+                st["master"] = master if master is not None \
+                    else p._data.astype(jnp.float32)
+            self._accum[id(p)] = st
+        return st
+
+    def _init_state(self, p: Tensor) -> dict:
+        return {}
+
+    # -- the per-param update rule (pure; subclasses override) --------------
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        raise NotImplementedError
+
+    # -- fused pytree apply --------------------------------------------------
+    def _fused_apply(self, params, grads, states, lr, step):
+        hp = self._hyperparams()
+        new_params, new_states = [], []
+        for p, g, s in zip(params, grads, states):
+            compute = s.get("master", p)
+            g = g.astype(compute.dtype)
+            np_, ns = self._update(compute, g, s, lr, step, hp)
+            if "master" in s:
+                ns["master"] = np_
+                np_ = np_.astype(p.dtype)
+            new_params.append(np_)
+            new_states.append(ns)
+        return new_params, new_states
+
+    def _hyperparams(self) -> dict:
+        return {"weight_decay": self._weight_decay}
+
+    # -- step ----------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = []
+        for group in self._param_groups:
+            for p in group["params"]:
+                if p.stop_gradient or p.grad is None:
+                    continue
+                params_grads.append((p, p.grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        if not params_grads:
+            return
+        self._step_count += 1
+        lr = self.get_lr()
+        ps = [p for p, _ in params_grads]
+        states = [self._get_state(p) for p in ps]
+        param_arrays = [p._data for p in ps]
+        grad_arrays = [g._data for _, g in params_grads]
+        new_params, new_states = self._fused(
+            param_arrays, grad_arrays, states,
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._step_count, jnp.int32))
+        for p, np_, ns in zip(ps, new_params, new_states):
+            p._inplace_update(np_)
+            self._accum[id(p)] = ns
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._all_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        idx = 0
+        for p in self._all_params():
+            st = self._accum.get(id(p))
+            if st is None:
+                continue
+            key = p.name or f"param_{idx}"
+            for sname, arr in st.items():
+                out[f"{key}.{sname}"] = Tensor(arr)
+            idx += 1
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("_step_count", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        idx = 0
+        for p in self._all_params():
+            key = p.name or f"param_{idx}"
+            st = self._get_state(p)
+            for sname in list(st.keys()):
+                k = f"{key}.{sname}"
+                if k in state:
+                    v = state[k]
+                    st[sname] = v._data if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+            idx += 1
+
+    set_dict = set_state_dict
+
+    def _create_accumulators(self, *a, **k):
+        pass  # reference-API shim (static graph concept)

@@ -1,0 +1,281 @@
+"""Concrete optimizers: SGD, Momentum, Adagrad, RMSProp, Adam, AdamW, Lamb,
+Adamax, Adadelta (reference: paddle.optimizer.* — upstream
+python/paddle/optimizer/, unverified; see SURVEY.md §2.2).
+
+Each `_update` is a pure jax function over (param, grad, state) executed
+inside the base class's single fused jit (SURVEY.md §2.1 multi-tensor
+adamw parity). Adam-family epsilon placement matches the reference:
+eps is added to sqrt(v_hat) *after* bias correction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        return param - lr * grad, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._momentum = float(momentum)
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "mu": self._momentum,
+                "nesterov": self._nesterov}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        wd, mu = hp["weight_decay"], hp["mu"]
+        if wd:
+            grad = grad + wd * param
+        v = mu * state["velocity"] + grad
+        if hp["nesterov"]:
+            new_p = param - lr * (grad + mu * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value
+                 =0.0, multi_precision=False, name=None):
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        m = state["moment"] + grad * grad
+        return param - lr * grad / (jnp.sqrt(m) + hp["eps"]), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        s = {"mean_square": jnp.zeros(p._data.shape, jnp.float32),
+             "moment": jnp.zeros(p._data.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p._data.shape, jnp.float32)
+        return s
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "rho": self._rho,
+                "eps": self._epsilon, "mu": self._momentum,
+                "centered": self._centered}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        wd, rho, eps, mu = (hp["weight_decay"], hp["rho"], hp["eps"],
+                            hp["mu"])
+        if wd:
+            grad = grad + wd * param
+        ms = rho * state["mean_square"] + (1 - rho) * grad * grad
+        out_state = {"mean_square": ms}
+        if hp["centered"]:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + eps)
+            out_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["moment"] + lr * grad / denom
+        out_state["moment"] = mom
+        return param - mom, out_state
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        s = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+             "moment2": jnp.zeros(p._data.shape, jnp.float32)}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+        return s
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "b1": self._beta1,
+                "b2": self._beta2, "eps": self._epsilon,
+                "amsgrad": self._amsgrad, "decoupled": False}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+        wd = hp["weight_decay"]
+        if wd and not hp["decoupled"]:
+            grad = grad + wd * param
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * grad * grad
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - b1 ** stepf
+        bc2 = 1 - b2 ** stepf
+        out = {"moment1": m1, "moment2": m2}
+        v = m2
+        if hp["amsgrad"]:
+            v = jnp.maximum(state["moment2_max"], m2)
+            out["moment2_max"] = v
+        m_hat = m1 / bc1
+        v_hat = v / bc2
+        update = m_hat / (jnp.sqrt(v_hat) + eps)
+        if wd and hp["decoupled"]:
+            update = update + wd * param
+        return param - lr * update, out
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference default coeff 0.01)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        self._apply_decay_fun = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         False, amsgrad, name)
+
+    def _hyperparams(self):
+        hp = super()._hyperparams()
+        hp["decoupled"] = True
+        return hp
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "b1": self._beta1,
+                "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        stepf = step.astype(jnp.float32)
+        lr_t = lr / (1 - b1 ** stepf)
+        return (param - lr_t * m / (u + eps),
+                {"moment": m, "inf_norm": u})
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._rho, self._epsilon = rho, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "rho": self._rho,
+                "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        rho, eps = hp["rho"], hp["eps"]
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * grad * grad
+        upd = (jnp.sqrt(state["avg_squared_update"] + eps) /
+               jnp.sqrt(asg + eps)) * grad
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return param - lr * upd, {"avg_squared_grad": asg,
+                                  "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "b1": self._beta1,
+                "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        b1, b2, eps, wd = hp["b1"], hp["b2"], hp["eps"], hp["weight_decay"]
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * grad * grad
+        stepf = step.astype(jnp.float32)
+        m_hat = m1 / (1 - b1 ** stepf)
+        v_hat = m2 / (1 - b2 ** stepf)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * param
+        w_norm = jnp.sqrt(jnp.sum(param * param))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr * trust * r, {"moment1": m1, "moment2": m2}

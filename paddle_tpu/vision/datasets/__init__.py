@@ -1,0 +1,135 @@
+"""Vision datasets (reference: paddle.vision.datasets — upstream
+python/paddle/vision/datasets/, unverified; see SURVEY.md §2.2).
+
+Zero-egress environment: loaders read local archives when present
+(`data_file=` arg); otherwise raise with a clear message. `FakeData`
+provides deterministic synthetic data for tests/benchmarks (the config-1
+CIFAR-10 milestone runs on it when the real archive is absent).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic labelled images."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, mode="train", transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        self.images = rng.standard_normal(
+            (num_samples,) + self.image_shape).astype(np.float32)
+        self.labels = rng.integers(0, num_classes,
+                                   (num_samples,)).astype(np.int32)
+        # make labels learnable: bias the mean of each image by its label
+        self.images += self.labels[:, None, None, None].astype(
+            np.float32) / num_classes
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "CIFAR-10 archive not found (no network access). Pass "
+                "data_file=/path/to/cifar-10-python.tar.gz, or use "
+                "paddle_tpu.vision.datasets.FakeData for synthetic data.")
+        self.data, self.labels = self._load(data_file, mode)
+
+    def _load(self, path, mode):
+        imgs, labels = [], []
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames()
+                     if ("data_batch" in n if mode == "train"
+                         else "test_batch" in n)]
+            for n in sorted(names):
+                f = tf.extractfile(n)
+                d = pickle.load(f, encoding="bytes")
+                imgs.append(d[b"data"].reshape(-1, 3, 32, 32))
+                labels.extend(d[b"labels"])
+        return (np.concatenate(imgs).astype(np.float32) / 255.0,
+                np.asarray(labels, dtype=np.int32))
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def _load(self, path, mode):
+        with tarfile.open(path) as tf:
+            name = "train" if mode == "train" else "test"
+            member = [n for n in tf.getnames() if n.endswith(name)][0]
+            d = pickle.load(tf.extractfile(member), encoding="bytes")
+            imgs = d[b"data"].reshape(-1, 3, 32, 32)
+            labels = d[b"fine_labels"]
+        return (imgs.astype(np.float32) / 255.0,
+                np.asarray(labels, dtype=np.int32))
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise FileNotFoundError(
+                "MNIST files not found (no network). Pass image_path/"
+                "label_path to local idx.gz files, or use FakeData.")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _read_images(path):
+        with gzip.open(path, "rb") as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        arr = np.frombuffer(data, np.uint8, offset=16).reshape(n, 1, 28, 28)
+        return arr.astype(np.float32) / 255.0
+
+    @staticmethod
+    def _read_labels(path):
+        with gzip.open(path, "rb") as f:
+            data = f.read()
+        return np.frombuffer(data, np.uint8, offset=8).astype(np.int32)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,156 @@
+"""Vision transforms (reference: paddle.vision.transforms — upstream,
+unverified; see SURVEY.md §2.2). Operate on numpy CHW float arrays (host
+side, pre-device-transfer, as the reference does on PIL/cv2 images).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Transpose", "Resize",
+           "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "BrightnessTransform", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (img - m) / s
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if img.ndim == 2:
+            img = img[None]
+        elif img.ndim == 3 and img.shape[-1] in (1, 3, 4) and \
+                self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        if img.max() > 2.0:
+            img = img / 255.0
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = jnp.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            out_shape = (arr.shape[0],) + self.size
+        else:
+            out_shape = self.size + (arr.shape[-1],) if arr.ndim == 3 \
+                else self.size
+        return np.asarray(jax.image.resize(arr, out_shape, "linear"))
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self._rng = np.random.default_rng(0)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, [(0, 0), (p, p), (p, p)], mode="constant")
+        h, w = img.shape[-2:]
+        th, tw = self.size
+        i = self._rng.integers(0, h - th + 1)
+        j = self._rng.integers(0, w - tw + 1)
+        return img[..., i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[-2:]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return img[..., i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+        self._rng = np.random.default_rng(0)
+
+    def __call__(self, img):
+        if self._rng.random() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+        self._rng = np.random.default_rng(0)
+
+    def __call__(self, img):
+        if self._rng.random() < self.prob:
+            return np.asarray(img)[..., ::-1, :].copy()
+        return img
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+        self._rng = np.random.default_rng(0)
+
+    def __call__(self, img):
+        if self.value <= 0:
+            return img
+        factor = self._rng.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.asarray(img) * factor
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+
+    def __call__(self, img):
+        p = self.padding
+        if isinstance(p, int):
+            cfg = [(0, 0), (p, p), (p, p)]
+        else:
+            cfg = [(0, 0), (p[1], p[3]), (p[0], p[2])]
+        return np.pad(np.asarray(img), cfg, mode="constant")

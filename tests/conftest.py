@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU mesh (SURVEY.md §4 —
+the single-host multi-device trick for distributed tests).
+
+NOTE the axon PJRT plugin's sitecustomize imports jax at interpreter
+startup, so JAX_PLATFORMS env edits here are too late — the value is baked
+into jax.config at import. `jax.config.update("jax_platforms", ...)` is
+the reliable override, and it also keeps tests independent of the TPU
+tunnel's availability. XLA_FLAGS is still read at (lazy) backend init, so
+setting it here works.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

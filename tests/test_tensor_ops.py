@@ -1,0 +1,307 @@
+"""NumPy-oracle op tests (the reference's OpTest pattern — SURVEY.md §4:
+inputs + NumPy reference implementation, forward check + gradient check
+against numeric/known analytic gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def t(arr, stop_gradient=True):
+    return P.to_tensor(np.asarray(arr), stop_gradient=stop_gradient)
+
+
+class TestCreation:
+    def test_to_tensor_dtypes(self):
+        assert P.to_tensor(1).dtype == P.int32
+        assert P.to_tensor(1.5).dtype == P.float32
+        assert P.to_tensor(True).dtype == P.bool_
+        assert P.to_tensor([1, 2]).shape == [2]
+
+    def test_zeros_ones_full(self):
+        assert np.allclose(P.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+        assert np.allclose(P.ones([4]).numpy(), 1)
+        assert np.allclose(P.full([2], 7.0).numpy(), 7)
+        assert P.full([2], 7).dtype == P.int32
+
+    def test_arange_linspace_eye(self):
+        assert np.allclose(P.arange(5).numpy(), np.arange(5))
+        assert np.allclose(P.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        assert np.allclose(P.linspace(0, 1, 5).numpy(),
+                           np.linspace(0, 1, 5))
+        assert np.allclose(P.eye(3).numpy(), np.eye(3))
+
+    def test_like_ops(self):
+        x = t(np.random.randn(3, 4).astype(np.float32))
+        assert P.zeros_like(x).shape == [3, 4]
+        assert np.allclose(P.ones_like(x).numpy(), 1)
+        assert np.allclose(P.full_like(x, 2.5).numpy(), 2.5)
+
+    def test_tril_triu_diag(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        assert np.allclose(P.tril(t(a)).numpy(), np.tril(a))
+        assert np.allclose(P.triu(t(a), 1).numpy(), np.triu(a, 1))
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        assert np.allclose(P.diag(t(v)).numpy(), np.diag(v))
+
+
+class TestElementwise:
+    def test_binary_ops(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(P.add(t(a), t(b)).numpy(), a + b, atol=1e-6)
+        assert np.allclose((t(a) - t(b)).numpy(), a - b, atol=1e-6)
+        assert np.allclose((t(a) * t(b)).numpy(), a * b, atol=1e-6)
+        assert np.allclose((t(a) / t(b)).numpy(), a / b, atol=1e-4)
+        assert np.allclose(P.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+
+    def test_scalar_promotion(self):
+        a = np.random.randn(3).astype(np.float32)
+        out = t(a) + 1
+        assert out.dtype == P.float32
+        assert np.allclose(out.numpy(), a + 1)
+        out = 2.0 * t(a)
+        assert out.dtype == P.float32
+        out = t(a) ** 2
+        assert np.allclose(out.numpy(), a ** 2, atol=1e-5)
+
+    def test_unary_ops(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.1
+        for name, ref in [("exp", np.exp), ("log", np.log),
+                          ("sqrt", np.sqrt), ("abs", np.abs),
+                          ("sin", np.sin), ("cos", np.cos),
+                          ("tanh", np.tanh), ("floor", np.floor),
+                          ("ceil", np.ceil)]:
+            got = getattr(P, name)(t(a)).numpy()
+            assert np.allclose(got, ref(a), atol=1e-4, rtol=1e-4), name
+
+    def test_clip(self):
+        a = np.random.randn(10).astype(np.float32)
+        assert np.allclose(P.clip(t(a), -0.5, 0.5).numpy(),
+                           np.clip(a, -0.5, 0.5))
+
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        assert np.allclose(P.matmul(t(a), t(b)).numpy(), a @ b, atol=1e-5)
+        assert np.allclose(
+            P.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b,
+            atol=1e-5)
+        assert np.allclose((t(a) @ t(b)).numpy(), a @ b, atol=1e-5)
+
+
+class TestReduction:
+    def test_sum_mean(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        assert np.allclose(P.sum(t(a)).numpy(), a.sum(), atol=1e-4)
+        assert np.allclose(P.sum(t(a), axis=1).numpy(), a.sum(1), atol=1e-5)
+        assert np.allclose(P.mean(t(a), axis=[0, 2]).numpy(),
+                           a.mean((0, 2)), atol=1e-5)
+        assert np.allclose(
+            P.sum(t(a), axis=-1, keepdim=True).numpy(),
+            a.sum(-1, keepdims=True), atol=1e-5)
+
+    def test_max_min_prod(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(P.max(t(a)).numpy(), a.max())
+        assert np.allclose(P.min(t(a), axis=0).numpy(), a.min(0))
+        assert np.allclose(P.prod(t(a), axis=1).numpy(), a.prod(1),
+                           atol=1e-5)
+
+    def test_std_var_median(self):
+        a = np.random.randn(50).astype(np.float32)
+        assert np.allclose(P.std(t(a)).numpy(), a.std(ddof=1), atol=1e-5)
+        assert np.allclose(P.var(t(a), unbiased=False).numpy(),
+                           a.var(), atol=1e-5)
+        assert np.allclose(P.median(t(a)).numpy(), np.median(a), atol=1e-6)
+
+    def test_cumsum_logsumexp(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(P.cumsum(t(a), axis=1).numpy(),
+                           np.cumsum(a, 1), atol=1e-5)
+        from scipy.special import logsumexp as ref_lse
+        assert np.allclose(P.logsumexp(t(a)).numpy(), ref_lse(a), atol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        assert P.reshape(t(a), [6, 4]).shape == [6, 4]
+        assert P.reshape(t(a), [-1]).shape == [24]
+        assert np.allclose(P.transpose(t(a), [2, 0, 1]).numpy(),
+                           a.transpose(2, 0, 1))
+        assert t(a).flatten().shape == [24]
+        assert t(a).flatten(start_axis=1).shape == [2, 12]
+
+    def test_squeeze_unsqueeze(self):
+        a = np.random.randn(1, 3, 1, 4).astype(np.float32)
+        assert P.squeeze(t(a)).shape == [3, 4]
+        assert P.squeeze(t(a), axis=0).shape == [3, 1, 4]
+        assert P.unsqueeze(t(np.zeros((3, 4), np.float32)), 1).shape == \
+            [3, 1, 4]
+        assert P.unsqueeze(t(np.zeros((3,), np.float32)),
+                           [0, 2]).shape == [1, 3, 1]
+
+    def test_concat_stack_split(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        assert np.allclose(P.concat([t(a), t(b)], axis=0).numpy(),
+                           np.concatenate([a, b], 0))
+        assert np.allclose(P.stack([t(a), t(b)], axis=1).numpy(),
+                           np.stack([a, b], 1))
+        parts = P.split(t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = P.split(t(a), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], np.int32)
+        assert np.allclose(P.gather(t(a), t(idx)).numpy(), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = P.scatter(t(a), t(idx), t(upd))
+        ref = a.copy()
+        ref[idx] = 1
+        assert np.allclose(out.numpy(), ref)
+
+    def test_where_masked(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        cond = a > 0
+        out = P.where(t(cond), t(a), t(np.zeros_like(a)))
+        assert np.allclose(out.numpy(), np.where(cond, a, 0))
+        mf = P.masked_fill(t(a), t(cond), -1.0)
+        assert np.allclose(mf.numpy(), np.where(cond, -1.0, a))
+
+    def test_indexing(self):
+        a = np.random.randn(4, 5, 6).astype(np.float32)
+        x = t(a)
+        assert np.allclose(x[1].numpy(), a[1])
+        assert np.allclose(x[1:3, ::2].numpy(), a[1:3, ::2])
+        assert np.allclose(x[..., -1].numpy(), a[..., -1])
+        assert np.allclose(x[:, None].numpy(), a[:, None])
+        idx = t(np.array([0, 2], np.int32))
+        assert np.allclose(x[idx].numpy(), a[[0, 2]])
+
+    def test_setitem(self):
+        a = np.zeros((4, 4), np.float32)
+        x = t(a.copy())
+        x[1] = 5.0
+        ref = a.copy()
+        ref[1] = 5
+        assert np.allclose(x.numpy(), ref)
+        x[0, 0] = 3.0
+        assert x.numpy()[0, 0] == 3.0
+
+    def test_pad_tile_flip(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        assert np.allclose(P.tile(t(a), [2, 1]).numpy(), np.tile(a, (2, 1)))
+        assert np.allclose(P.flip(t(a), axis=0).numpy(), a[::-1])
+        p = P.pad(t(a), [1, 1], value=0.0)
+        assert p.shape == [2, 5]
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert np.array_equal((t(a) > t(b)).numpy(), a > b)
+        assert np.array_equal((t(a) == t(b)).numpy(), a == b)
+        assert (t(a) != None) is True  # noqa: E711
+
+    def test_argmax_topk_sort(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        assert np.array_equal(P.argmax(t(a), axis=1).numpy(),
+                              a.argmax(1).astype(np.int32))
+        vals, idx = P.topk(t(a), 3, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :3]
+        assert np.allclose(vals.numpy(), ref, atol=1e-6)
+        s = P.sort(t(a), axis=1, descending=True)
+        assert np.allclose(s.numpy(), np.sort(a, 1)[:, ::-1])
+
+    def test_unique_nonzero(self):
+        a = np.array([3, 1, 2, 1, 3], np.int32)
+        u = P.unique(t(a))
+        assert np.array_equal(u.numpy(), np.unique(a))
+        nz = P.nonzero(t(np.array([0, 1, 0, 2], np.int32)))
+        assert np.array_equal(nz.numpy().ravel(), [1, 3])
+
+
+class TestLinalg:
+    def test_norms(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(P.norm(t(a)).numpy(),
+                           np.linalg.norm(a), atol=1e-5)
+        assert np.allclose(P.norm(t(a), p=1, axis=1).numpy(),
+                           np.abs(a).sum(1), atol=1e-5)
+
+    def test_solve_inv_det(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        assert np.allclose(P.linalg.solve(t(a), t(b)).numpy(),
+                           np.linalg.solve(a, b), atol=1e-3)
+        assert np.allclose(P.linalg.inv(t(a)).numpy(), np.linalg.inv(a),
+                           atol=1e-3)
+        assert np.allclose(P.linalg.det(t(a)).numpy(), np.linalg.det(a),
+                           rtol=1e-3)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        u, s, vh = P.linalg.svd(t(a))
+        assert np.allclose((u.numpy() * s.numpy()) @ vh.numpy(), a,
+                           atol=1e-4)
+        q, r = P.linalg.qr(t(a))
+        assert np.allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = P.linalg.cholesky(t(spd))
+        assert np.allclose(L.numpy() @ L.numpy().T, spd, atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(P.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                           a @ b, atol=1e-5)
+
+
+class TestRandom:
+    def test_seeded_reproducibility(self):
+        P.seed(42)
+        a = P.randn([4, 4]).numpy()
+        P.seed(42)
+        b = P.randn([4, 4]).numpy()
+        assert np.array_equal(a, b)
+        c = P.randn([4, 4]).numpy()
+        assert not np.array_equal(b, c)
+
+    def test_distributions(self):
+        P.seed(0)
+        u = P.uniform([10000], min=0.0, max=1.0).numpy()
+        assert 0.45 < u.mean() < 0.55
+        n = P.randn([10000]).numpy()
+        assert abs(n.mean()) < 0.05 and 0.9 < n.std() < 1.1
+        r = P.randint(0, 10, [1000]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        perm = P.randperm(100).numpy()
+        assert np.array_equal(np.sort(perm), np.arange(100))
+
+
+class TestInplaceAndVersioning:
+    def test_inplace_updates(self):
+        x = t(np.ones(3, np.float32))
+        x.add_(1.0)
+        assert np.allclose(x.numpy(), 2)
+        x.scale_(2.0)
+        assert np.allclose(x.numpy(), 4)
+
+    def test_inplace_on_leaf_requiring_grad_raises(self):
+        x = t(np.random.randn(3).astype(np.float32), stop_gradient=False)
+        with pytest.raises(RuntimeError, match="leaf"):
+            x.add_(1.0)
+
+    def test_version_guard(self):
+        x = t(np.random.randn(3).astype(np.float32), stop_gradient=False)
+        h = x * 2.0
+        y = h * h
+        h.add_(1.0)  # mutates a tensor needed for y's backward
+        with pytest.raises(RuntimeError, match="modified in place"):
+            y.backward(P.ones_like(y))
