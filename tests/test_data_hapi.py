@@ -1,0 +1,183 @@
+"""DataLoader + Model.fit tests, ending in the config-1 milestone:
+a conv net trained end-to-end via Model.fit (SURVEY.md §7 step 3 / call
+stack §3.3). Uses FakeData (CIFAR-shaped synthetic, learnable signal)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(SquaresDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+        assert np.allclose(y.numpy().ravel(), [0, 1, 4, 9])
+
+    def test_drop_last_and_shuffle(self):
+        dl = DataLoader(SquaresDataset(10), batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+        P.seed(0)
+        dl = DataLoader(SquaresDataset(10), batch_size=10, shuffle=True)
+        (x, _), = list(dl)
+        assert not np.array_equal(x.numpy().ravel(), np.arange(10))
+        assert np.array_equal(np.sort(x.numpy().ravel()), np.arange(10))
+
+    def test_num_workers_prefetch(self):
+        dl = DataLoader(SquaresDataset(20), batch_size=4, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 5
+        # order must be preserved
+        assert np.allclose(batches[0][0].numpy().ravel(), [0, 1, 2, 3])
+
+    def test_distributed_batch_sampler(self):
+        ds = SquaresDataset(20)
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                     rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                     rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert not set(i0) & set(i1)
+        assert len(i0) == len(i1) == 10
+
+    def test_tensor_dataset(self):
+        xs = P.randn([8, 3])
+        ys = P.arange(8)
+        dl = DataLoader(TensorDataset([xs, ys]), batch_size=4)
+        x, y = next(iter(dl))
+        assert x.shape == [4, 3]
+
+
+class TestSaveLoad:
+    def test_paddle_save_load(self, tmp_path):
+        net = nn.Linear(3, 2)
+        path = str(tmp_path / "model.pdparams")
+        P.save(net.state_dict(), path)
+        loaded = P.load(path)
+        net2 = nn.Linear(3, 2)
+        net2.set_state_dict(loaded)
+        assert np.allclose(net.weight.numpy(), net2.weight.numpy())
+
+    def test_nested_structures(self, tmp_path):
+        obj = {"a": P.randn([2, 2]), "b": [P.ones([3]), {"c": 1.5}]}
+        path = str(tmp_path / "obj.pd")
+        P.save(obj, path)
+        back = P.load(path)
+        assert np.allclose(back["a"].numpy(), obj["a"].numpy())
+        assert back["b"][1]["c"] == 1.5
+
+
+class SmallConvNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+            nn.MaxPool2D(2),
+            nn.Conv2D(8, 16, 3, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+        self.fc = nn.Linear(16, num_classes)
+
+    def forward(self, x):
+        return self.fc(self.features(x).flatten(1))
+
+
+class TestModelFit:
+    def test_train_batch_eager_vs_jit_consistency(self):
+        P.seed(0)
+        data = FakeData(num_samples=8, image_shape=(3, 8, 8), num_classes=4)
+        x = np.stack([data[i][0] for i in range(8)])
+        y = np.stack([data[i][1] for i in range(8)])
+
+        def run(jit_broken):
+            P.seed(42)
+            net = SmallConvNet(4)
+            model = P.Model(net)
+            model.prepare(P.optimizer.Adam(0.01,
+                                           parameters=net.parameters()),
+                          nn.CrossEntropyLoss())
+            model._jit_broken = jit_broken
+            losses = [model.train_batch([x], [y]) for _ in range(3)]
+            return losses
+
+        jit_losses = run(False)
+        eager_losses = run(True)
+        assert np.allclose(jit_losses, eager_losses, rtol=2e-2), \
+            (jit_losses, eager_losses)
+
+    def test_config1_milestone_fit_decreases_loss(self):
+        """Config-1 milestone: conv net on CIFAR-shaped data via Model.fit."""
+        P.seed(7)
+        train = FakeData(num_samples=64, image_shape=(3, 16, 16),
+                         num_classes=4, seed=3)
+        net = SmallConvNet(4)
+        model = P.Model(net)
+        model.prepare(
+            P.optimizer.Adam(0.005, parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        first_losses, last_losses = [], []
+
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                (first_losses if self.params.get("epoch0", True) else
+                 last_losses).append(logs["loss"])
+
+        rec = Rec()
+        model.fit(train, batch_size=16, epochs=4, verbose=0, shuffle=True,
+                  callbacks=[rec])
+        # loss at end below loss at start
+        losses = first_losses
+        head = np.mean(losses[:4])
+        tail = np.mean(losses[-4:])
+        assert tail < head * 0.9, (head, tail)
+
+    def test_evaluate_predict(self):
+        P.seed(1)
+        data = FakeData(num_samples=16, image_shape=(3, 8, 8),
+                        num_classes=4)
+        net = SmallConvNet(4)
+        model = P.Model(net)
+        model.prepare(P.optimizer.SGD(0.01, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        logs = model.evaluate(data, batch_size=8, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = model.predict(data, batch_size=8, stack_outputs=True)
+        assert preds[0].shape == (16, 4)
+
+    def test_model_save_load(self, tmp_path):
+        net = SmallConvNet(4)
+        model = P.Model(net)
+        model.prepare(P.optimizer.Adam(0.01, parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        net2 = SmallConvNet(4)
+        model2 = P.Model(net2)
+        model2.prepare(P.optimizer.Adam(0.01,
+                                        parameters=net2.parameters()),
+                       nn.CrossEntropyLoss())
+        model2.load(path)
+        assert np.allclose(net.fc.weight.numpy(), net2.fc.weight.numpy())

@@ -1,0 +1,255 @@
+"""nn layer tests — numpy-oracle forward checks + grad flow (reference
+OpTest/API-test pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return P.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLayerSystem:
+    def test_parameters_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2, bias_attr=False)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight"]
+        assert len(net.parameters()) == 3
+        assert not net.fc1.weight.stop_gradient
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4))
+        sd = net.state_dict()
+        assert "0.weight" in sd and "1._mean" in sd
+        net2 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                      net2.named_parameters()):
+            assert np.allclose(p1.numpy(), p2.numpy())
+
+    def test_train_eval_modes(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(t(np.zeros((1, 2))))
+        assert calls == [1]
+        h.remove()
+        lin(t(np.zeros((1, 2))))
+        assert calls == [1]
+
+    def test_layerlist_sequential(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(list(ll.parameters())) == 6
+        seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        out = seq(t(np.ones((5, 2))))
+        assert out.shape == [5, 1]
+
+
+class TestFunctional:
+    def test_activations_oracle(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        assert np.allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
+        assert np.allclose(F.sigmoid(t(x)).numpy(), 1 / (1 + np.exp(-x)),
+                           atol=1e-5)
+        sm = F.softmax(t(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        assert np.allclose(sm, e / e.sum(-1, keepdims=True), atol=1e-5)
+        assert np.allclose(sm.sum(-1), 1, atol=1e-5)
+
+    def test_linear_layout(self):
+        # reference weight layout [in, out]
+        x = np.random.randn(2, 3).astype(np.float32)
+        w = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        out = F.linear(t(x), t(w), t(b))
+        assert np.allclose(out.numpy(), x @ w + b, atol=1e-5)
+
+    def test_conv2d_oracle(self):
+        from scipy import signal
+        x = np.random.randn(1, 1, 5, 5).astype(np.float32)
+        w = np.random.randn(1, 1, 3, 3).astype(np.float32)
+        out = F.conv2d(t(x), t(w), padding=1).numpy()
+        ref = signal.correlate2d(x[0, 0], w[0, 0], mode="same")
+        assert np.allclose(out[0, 0], ref, atol=1e-4)
+
+    def test_conv2d_shapes(self):
+        x = t(np.random.randn(2, 3, 8, 8))
+        w = t(np.random.randn(6, 3, 3, 3))
+        assert F.conv2d(x, w).shape == [2, 6, 6, 6]
+        assert F.conv2d(x, w, stride=2, padding=1).shape == [2, 6, 4, 4]
+        wg = t(np.random.randn(6, 1, 3, 3))
+        assert F.conv2d(x, wg, padding=1, groups=3).shape == [2, 6, 8, 8]
+
+    def test_pooling(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2).numpy()
+        assert np.allclose(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(t(x), 2).numpy()
+        assert np.allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = F.adaptive_avg_pool2d(t(x), 1).numpy()
+        assert np.allclose(aap[0, 0, 0, 0], x.mean())
+
+    def test_layer_norm_oracle(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        out = F.layer_norm(t(x), 5).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        assert np.allclose(out, (x - mu) / np.sqrt(sd ** 2 + 1e-5),
+                           atol=1e-4)
+
+    def test_batch_norm_train_and_eval(self):
+        bn = nn.BatchNorm1D(4)
+        x = np.random.randn(16, 4).astype(np.float32) * 3 + 1
+        bn.train()
+        out = bn(t(x)).numpy()
+        assert abs(out.mean()) < 1e-4 and abs(out.std() - 1) < 1e-2
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(t(x))
+        assert out2.shape == [16, 4]
+
+    def test_dropout_train_eval(self):
+        x = t(np.ones((100, 100)))
+        out = F.dropout(x, 0.5, training=True).numpy()
+        frac = (out == 0).mean()
+        assert 0.4 < frac < 0.6
+        kept = out[out != 0]
+        assert np.allclose(kept, 2.0)  # upscale_in_train
+        assert np.allclose(F.dropout(x, 0.5, training=False).numpy(), 1.0)
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        idx = np.array([[1, 2], [3, 0]], np.int32)
+        out = F.embedding(P.to_tensor(idx), t(w))
+        assert np.allclose(out.numpy(), w[idx])
+
+    def test_cross_entropy_oracle(self):
+        logits = np.random.randn(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, (8,)).astype(np.int32)
+        loss = F.cross_entropy(t(logits), P.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(8), labels]).mean()
+        assert np.allclose(loss, ref, atol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, 1, -100, 2], np.int32)
+        loss = F.cross_entropy(t(logits), P.to_tensor(labels),
+                               ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        valid = [0, 1, 3]
+        ref = -np.log(p[valid, labels[valid]]).mean()
+        assert np.allclose(loss, ref, atol=1e-5)
+
+    def test_losses(self):
+        a = np.random.randn(6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        assert np.allclose(F.mse_loss(t(a), t(b)).numpy(),
+                           ((a - b) ** 2).mean(), atol=1e-6)
+        assert np.allclose(F.l1_loss(t(a), t(b)).numpy(),
+                           np.abs(a - b).mean(), atol=1e-6)
+        p_ = 1 / (1 + np.exp(-a))
+        lbl = (b > 0).astype(np.float32)
+        bce = F.binary_cross_entropy_with_logits(t(a), t(lbl)).numpy()
+        ref = -(lbl * np.log(p_) + (1 - lbl) * np.log(1 - p_)).mean()
+        assert np.allclose(bce, ref, atol=1e-5)
+
+
+class TestAttention:
+    def test_sdpa_oracle(self):
+        np.random.seed(0)
+        q = np.random.randn(2, 4, 2, 8).astype(np.float32)
+        k = np.random.randn(2, 4, 2, 8).astype(np.float32)
+        v = np.random.randn(2, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        # oracle
+        scale = 1 / np.sqrt(8)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        pr = e / e.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", pr, v)
+        assert np.allclose(out, ref, atol=1e-4)
+
+    def test_sdpa_causal(self):
+        q = np.random.randn(1, 5, 1, 4).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q),
+                                             is_causal=True).numpy()
+        # position 0 attends only to itself → output = v[0]
+        assert np.allclose(out[0, 0, 0], q[0, 0, 0], atol=1e-5)
+
+    def test_mha_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.randn(2, 6, 16))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+        mha.eval()
+        out2 = mha(x, x, x)
+        assert out2.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                           dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.randn(2, 5, 16))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+        # two layers must have independent params
+        p = list(enc.parameters())
+        assert len(p) == 2 * len(list(layer.parameters()))
+
+
+class TestGradFlow:
+    def test_mlp_grads_numeric(self):
+        np.random.seed(1)
+        net = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 1))
+        x = t(np.random.randn(5, 3))
+        loss = net(x).sum()
+        loss.backward()
+        w = net[0].weight
+        # numeric check on one weight entry
+        eps = 1e-3
+        orig = w.numpy().copy()
+        import jax.numpy as jnp
+        for idx in [(0, 0), (2, 3)]:
+            wp = orig.copy()
+            wp[idx] += eps
+            with P.no_grad():
+                w._inplace_update(jnp.asarray(wp))
+                up = float(net(x).sum().numpy())
+                wp[idx] -= 2 * eps
+                w._inplace_update(jnp.asarray(wp))
+                down = float(net(x).sum().numpy())
+                w._inplace_update(jnp.asarray(orig))
+            assert abs(w.grad.numpy()[idx] - (up - down) / (2 * eps)) < 1e-2
+
+    def test_conv_bn_grads_flow(self):
+        net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1),
+                            nn.BatchNorm2D(2), nn.ReLU())
+        x = t(np.random.randn(2, 1, 4, 4))
+        net(x).sum().backward()
+        for p in net.parameters():
+            assert p.grad is not None

@@ -1,0 +1,191 @@
+"""Optimizer tests: update-rule oracles + convergence + schedulers
+(reference test strategy, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import (SGD, Adam, AdamW, Lamb, Momentum, RMSProp,
+                                  lr as lr_mod)
+
+
+def make_param(val):
+    p = P.core.tensor.Parameter(P.to_tensor(
+        np.asarray(val, np.float32))._data)
+    return p
+
+
+def set_grad(p, g):
+    p.grad = P.to_tensor(np.asarray(g, np.float32))
+
+
+class TestUpdateRules:
+    def test_sgd_oracle(self):
+        p = make_param([1.0, 2.0])
+        set_grad(p, [0.5, 0.5])
+        SGD(learning_rate=0.1, parameters=[p]).step()
+        assert np.allclose(p.numpy(), [0.95, 1.95], atol=1e-6)
+
+    def test_momentum_oracle(self):
+        p = make_param([1.0])
+        opt = Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()  # v=1, p=1-0.1
+        assert np.allclose(p.numpy(), [0.9], atol=1e-6)
+        set_grad(p, [1.0])
+        opt.step()  # v=1.9, p=0.9-0.19
+        assert np.allclose(p.numpy(), [0.71], atol=1e-5)
+
+    def test_adam_oracle(self):
+        p = make_param([1.0])
+        opt = Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, parameters=[p])
+        set_grad(p, [0.5])
+        opt.step()
+        # step1: m=0.05, v=0.00025; m̂=0.5, v̂=0.25; upd=0.5/(0.5+eps)≈1
+        assert np.allclose(p.numpy(), [1.0 - 0.1 * (0.5 / (0.5 + 1e-8))],
+                           atol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p = make_param([1.0])
+        opt = AdamW(learning_rate=0.1, weight_decay=0.1, parameters=[p])
+        set_grad(p, [0.0])
+        opt.step()
+        # zero grad → update is pure decoupled decay: p -= lr*wd*p
+        assert np.allclose(p.numpy(), [1.0 - 0.1 * 0.1 * 1.0], atol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        p1, p2 = make_param([3.0]), make_param([4.0])
+        set_grad(p1, [3.0])
+        set_grad(p2, [4.0])  # global norm 5
+        opt = SGD(learning_rate=1.0, parameters=[p1, p2],
+                  grad_clip=P.ClipGradByGlobalNorm(1.0))
+        opt.step()
+        # grads scaled by 1/5
+        assert np.allclose(p1.numpy(), [3.0 - 0.6], atol=1e-5)
+        assert np.allclose(p2.numpy(), [4.0 - 0.8], atol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        p = make_param([1.0, 2.0])
+        opt = Adam(learning_rate=0.1, parameters=[p])
+        set_grad(p, [0.1, 0.2])
+        opt.step()
+        sd = opt.state_dict()
+        p2 = make_param([1.0, 2.0])
+        opt2 = Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        st = opt2._accum[id(p2)]
+        ref = opt._accum[id(p)]
+        assert np.allclose(np.asarray(st["moment1"]),
+                           np.asarray(ref["moment1"]))
+
+
+class TestConvergence:
+    @pytest.mark.parametrize("opt_cls,kw", [
+        (SGD, {"learning_rate": 0.1}),
+        (Momentum, {"learning_rate": 0.05}),
+        (Adam, {"learning_rate": 0.1}),
+        (AdamW, {"learning_rate": 0.1}),
+        (RMSProp, {"learning_rate": 0.05}),
+        (Lamb, {"learning_rate": 0.05, "lamb_weight_decay": 0.0}),
+    ])
+    def test_quadratic_convergence(self, opt_cls, kw):
+        P.seed(0)
+        target = np.array([3.0, -2.0], np.float32)
+        p = make_param([0.0, 0.0])
+        opt = opt_cls(parameters=[p], **kw)
+        for _ in range(200):
+            diff = p - P.to_tensor(target)
+            loss = (diff * diff).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.allclose(p.numpy(), target, atol=0.15), opt_cls.__name__
+
+    def test_linear_regression_with_layer(self):
+        P.seed(0)
+        true_w = np.array([[2.0], [-1.0]], np.float32)
+        x = np.random.randn(64, 2).astype(np.float32)
+        y = x @ true_w + 0.5
+        lin = nn.Linear(2, 1)
+        opt = Adam(learning_rate=0.1, parameters=lin.parameters())
+        for _ in range(150):
+            pred = lin(P.to_tensor(x))
+            loss = ((pred - P.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.allclose(lin.weight.numpy(), true_w, atol=0.1)
+        assert np.allclose(lin.bias.numpy(), [0.5], atol=0.1)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 5))
+            s.step()
+        assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert abs(s()) < 1e-6
+
+    def test_linear_warmup(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                end_lr=0.1)
+        assert s() < 0.02
+        for _ in range(10):
+            s.step()
+        assert abs(s() - 0.1) < 1e-9
+
+    def test_scheduler_drives_optimizer(self):
+        p = make_param([1.0])
+        sched = lr_mod.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        set_grad(p, [1.0])
+        opt.step()  # lr=1.0
+        assert np.allclose(p.numpy(), [0.0], atol=1e-6)
+        sched.step()
+        set_grad(p, [1.0])
+        opt.step()  # lr=0.1
+        assert np.allclose(p.numpy(), [-0.1], atol=1e-6)
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 0.1
+
+
+class TestAmpIntegration:
+    def test_master_weights_bf16(self):
+        import jax.numpy as jnp
+        lin = nn.Linear(4, 4)
+        opt = AdamW(learning_rate=0.01, parameters=lin.parameters())
+        model, opt = P.amp.decorate(lin, opt, level="O2", dtype="bfloat16")
+        assert model.weight.dtype == P.bfloat16
+        x = P.randn([2, 4]).astype("bfloat16")
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        # master weight state exists in fp32
+        st = opt._accum[id(model.weight)]
+        assert st["master"].dtype == jnp.float32
+
+    def test_grad_scaler_passthrough_bf16(self):
+        lin = nn.Linear(2, 2)
+        opt = SGD(0.1, parameters=lin.parameters())
+        scaler = P.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        with P.amp.auto_cast(level="O1"):
+            loss = lin(P.randn([3, 2])).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert scaler.get_loss_scaling() >= 1.0
