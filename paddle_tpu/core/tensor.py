@@ -26,6 +26,11 @@ def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+class GraphBreakError(RuntimeError):
+    """Raised when host-only Tensor access happens under jit tracing;
+    to_static catches this and falls back to eager (SOT graph break)."""
+
+
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "name",
                  "persistable", "_retain_grads", "_version", "_hooks",
@@ -80,8 +85,8 @@ class Tensor:
     # -- conversion --------------------------------------------------------
     def numpy(self):
         if _is_tracer(self._data):
-            raise RuntimeError("Tensor.numpy() is not allowed inside "
-                               "to_static/jit tracing (graph break).")
+            raise GraphBreakError("Tensor.numpy() is not allowed inside "
+                                  "to_static/jit tracing (graph break).")
         return np.asarray(self._data)
 
     def item(self, *args):

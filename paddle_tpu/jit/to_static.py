@@ -8,6 +8,7 @@ import jax.numpy as jnp
 
 from ..core import random as _random
 from ..core.autograd import apply, is_grad_enabled
+from ..core.tensor import GraphBreakError as _GraphBreakError
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
@@ -171,7 +172,8 @@ class StaticFunction:
         except (jax.errors.ConcretizationTypeError,
                 jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,
-                jax.errors.TracerIntegerConversionError):
+                jax.errors.TracerIntegerConversionError,
+                _GraphBreakError):
             # graph break → eager fallback (reference: SOT fallback)
             self._jit_cache.pop(cache_key, None)
             return fn(*args, **kwargs)
