@@ -1,7 +1,34 @@
 """paddle_tpu.distributed — the Fleet-equivalent distributed stack.
 
 Reference parity: python/paddle/distributed (upstream, unverified; see
-SURVEY.md §2.3). Populated incrementally; `env` provides rank/world-size.
+SURVEY.md §2.3). Collectives over mesh axes (ProcessGroupXLA), hybrid
+topology, fleet facade, sharding API, auto-parallel surface.
 """
 from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .collective import (ProcessGroup, ReduceOp, all_gather,  # noqa: F401
+                         all_gather_object, all_reduce, alltoall,
+                         alltoall_single, barrier, broadcast,
+                         broadcast_object_list, destroy_process_group,
+                         get_backend, get_group, is_initialized, new_group,
+                         recv, reduce, reduce_scatter, scatter, send, wait)
 from .env import get_rank, get_world_size  # noqa: F401
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .sharding_api import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+# auto-parallel surface
+from .auto_parallel.api import (ProcessMesh, Replicate, Shard, Partial,  # noqa: F401
+                                shard_tensor, reshard, dtensor_from_fn,
+                                shard_layer)
+
+
+def get_data_parallel_group():
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.get_data_parallel_group() if hcg else None
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference: paddle.distributed.spawn. Under SPMD one controller
+    drives all local devices, so local 'spawn' degenerates to a direct
+    call with rank 0; true multi-host uses the launch CLI."""
+    func(*args)

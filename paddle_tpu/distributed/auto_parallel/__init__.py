@@ -1,0 +1,3 @@
+"""paddle_tpu.distributed.auto_parallel (reference: semi-auto parallel API)."""
+from .api import (ProcessMesh, Replicate, Shard, Partial, shard_tensor,  # noqa: F401
+                  reshard, dtensor_from_fn, shard_layer)

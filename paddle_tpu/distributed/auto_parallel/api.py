@@ -1,0 +1,140 @@
+"""Semi-auto parallel API: ProcessMesh + placements + shard_tensor/reshard.
+
+Reference parity: paddle.distributed.{ProcessMesh,shard_tensor,reshard}
+with placements Shard(d)/Replicate()/Partial() (upstream
+python/paddle/distributed/auto_parallel/ — unverified, see SURVEY.md §2.3).
+
+TPU-native: this is the THINNEST layer of the whole rebuild — the
+reference needs dist-attr completion + partitioner + reshard passes
+(~120k LoC) to recover what jax.sharding expresses directly:
+ProcessMesh≅Mesh, placements≅PartitionSpec, shard_tensor≅device_put,
+reshard≅device_put with a new sharding (XLA emits the collective).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def is_replicated(self):
+        return True
+
+
+class Partial(Placement):
+    """Pending-reduction placement. jax has no 'partial at rest' state —
+    materializing a dtensor with Partial reduces it immediately (sum),
+    which preserves the observable semantics."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = arr.shape
+        self._ids = arr.reshape(-1).tolist()
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        grid = np.array([devs[i % len(devs)] for i in self._ids]
+                        ).reshape(self._shape)
+        self.jax_mesh = Mesh(grid, tuple(self.dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._ids
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self.dim_names})"
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements, ndim):
+    entries = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis = mesh.dim_names[mesh_dim]
+            if entries[pl.dim] is None:
+                entries[pl.dim] = axis
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (axis,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], axis)
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    t = data if isinstance(data, Tensor) else Tensor(
+        jax.numpy.asarray(np.asarray(data)))
+    spec = _placements_to_spec(mesh, placements, t._data.ndim)
+    sharded = jax.device_put(t._data, NamedSharding(mesh.jax_mesh, spec))
+    out = Tensor(sharded, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    spec = _placements_to_spec(mesh, placements, dist_tensor._data.ndim)
+    moved = jax.device_put(dist_tensor._data,
+                           NamedSharding(mesh.jax_mesh, spec))
+    out = Tensor(moved, stop_gradient=dist_tensor.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Apply shard_fn(name, layer, mesh) over sublayers to place params."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    else:
+        for p in layer.parameters():
+            sharded = shard_tensor(p, process_mesh,
+                                   [Replicate()] * len(process_mesh.shape))
+            p._data = sharded._data
+    return layer

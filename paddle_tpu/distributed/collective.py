@@ -1,0 +1,311 @@
+"""ProcessGroupXLA + the communication API.
+
+Reference parity: the ProcessGroup family and python communication surface
+(upstream paddle/fluid/distributed/collective/ +
+python/paddle/distributed/communication/ — unverified, see SURVEY.md §2.1,
+§5.8): all_reduce/all_gather/reduce_scatter/broadcast/scatter/reduce/
+alltoall/send/recv/barrier with group objects and async Task handles.
+
+TPU-native design (SURVEY.md §2.4 comm-backend row): a ProcessGroup wraps a
+**mesh axis** instead of an NCCL communicator. Collectives have two
+execution regimes:
+
+1. **Traced (SPMD)** — inside `shard_map`/fleet's compiled step, where the
+   group's axis name is live: each call lowers to the XLA collective
+   (psum/all_gather/ppermute/all_to_all) riding ICI/DCN. This is the perf
+   path; the XLA scheduler overlaps collectives with compute, which is the
+   role of the reference's dedicated comm streams.
+2. **Eager** — outside any trace. Semantics follow the SPMD programming
+   model: one Python process drives the whole mesh, so a tensor IS the
+   global value and reduction across a group of size N is either an
+   identity (value already global) or an explicit multi-device reduction
+   for sharded inputs. Used for correctness tests and param broadcast.
+
+Async `Task` parity: jax dispatch is already asynchronous; `.wait()` blocks
+on the array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._axis import current_axis_env
+
+# Reduce op enum (reference: paddle.distributed.ReduceOp)
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Async collective handle (reference: ProcessGroup::Task)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            self._tensor.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class ProcessGroup:
+    """A communication group == a named mesh axis (or explicit rank list).
+
+    Attributes:
+      axis_name: the mesh axis this group reduces over when traced.
+      ranks: global ranks in the group (for topology bookkeeping).
+    """
+
+    _next_id = 0
+
+    def __init__(self, ranks, axis_name=None, backend="xla"):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name
+        self.backend = backend
+        self.id = ProcessGroup._next_id
+        ProcessGroup._next_id += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks \
+            else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return (f"ProcessGroupXLA(axis={self.axis_name}, "
+                f"nranks={self.nranks})")
+
+
+_default_group: ProcessGroup | None = None
+_groups: dict[int, ProcessGroup] = {}
+
+
+def _ensure_default_group() -> ProcessGroup:
+    global _default_group
+    if _default_group is None:
+        n = len(jax.devices())
+        _default_group = ProcessGroup(list(range(n)), axis_name=None)
+    return _default_group
+
+
+def set_default_group(g: ProcessGroup):
+    global _default_group
+    _default_group = g
+
+
+def get_group(gid=0) -> ProcessGroup:
+    return _groups.get(gid, _ensure_default_group())
+
+
+def new_group(ranks=None, backend="xla", timeout=None, axis_name=None):
+    g = ProcessGroup(ranks if ranks is not None else
+                     list(range(len(jax.devices()))), axis_name=axis_name,
+                     backend=backend)
+    _groups[g.id] = g
+    return g
+
+
+def _group(group) -> ProcessGroup:
+    return group if group is not None else _ensure_default_group()
+
+
+def _traced_axis(group: ProcessGroup):
+    """Axis name to reduce over if we're inside shard_map with this group's
+    axis live; None otherwise."""
+    env = current_axis_env()
+    if group.axis_name is not None and group.axis_name in env:
+        return group.axis_name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collectives
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    axis = _traced_axis(g)
+    if axis is not None:
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a)}[op]
+        tensor._inplace_update(red(tensor._data, axis))
+        return Task(tensor)
+    # eager SPMD: single controller holds the global value → reduction over
+    # a replicated value is identity (sum semantics follow reference's
+    # "already reduced" view); nothing to move.
+    return Task(tensor)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        gathered = jax.lax.all_gather(tensor._data, ax)
+        if isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(Tensor(gathered[i]))
+        return Task(tensor)
+    if isinstance(tensor_list, list):
+        for _ in range(g.nranks):
+            tensor_list.append(Tensor(tensor._data))
+    return Task(tensor)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    for _ in range(g.nranks):
+        object_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        stacked = jnp.concatenate([t._data for t in tensor_list], axis=0) \
+            if isinstance(tensor_list, list) else tensor_list._data
+        out = jax.lax.psum_scatter(stacked, ax, tiled=True)
+        tensor._inplace_update(out)
+        return Task(tensor)
+    idx = 0  # eager: rank-0 view
+    src = tensor_list[idx] if isinstance(tensor_list, list) else tensor_list
+    tensor._inplace_update(src._data)
+    return Task(tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        # select src rank's value on every rank
+        idx = jax.lax.axis_index(ax)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        gathered = jax.lax.all_gather(tensor._data, ax)
+        tensor._inplace_update(gathered[src_local])
+        return Task(tensor)
+    return Task(tensor)  # eager: single controller — already everywhere
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None and tensor_list:
+        stacked = jnp.stack([t._data for t in tensor_list])
+        idx = jax.lax.axis_index(ax)
+        tensor._inplace_update(
+            jax.lax.dynamic_index_in_dim(stacked, idx, keepdims=False))
+        return Task(tensor)
+    if tensor_list:
+        tensor._inplace_update(tensor_list[0]._data)
+    return Task(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        stacked = jnp.stack([t._data for t in in_tensor_list])
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return Task()
+    out_tensor_list.extend(in_tensor_list)
+    return Task()
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        out = jax.lax.all_to_all(in_tensor._data, ax, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        if out_tensor is not None:
+            out_tensor._inplace_update(out)
+            return Task(out_tensor)
+        return Tensor(out)
+    if out_tensor is not None:
+        out_tensor._inplace_update(in_tensor._data)
+        return Task(out_tensor)
+    return Tensor(in_tensor._data)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        # point-to-point inside SPMD == ppermute ring hop
+        n = g.nranks
+        perm = [(i, dst % n) for i in range(n)]
+        jax.lax.ppermute(tensor._data, ax, perm)
+    return Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    ax = _traced_axis(g)
+    if ax is not None:
+        n = g.nranks
+        perm = [(src % n, i) for i in range(n)]
+        tensor._inplace_update(jax.lax.ppermute(tensor._data, ax, perm))
+    return Task(tensor)
+
+
+def barrier(group=None):
+    # drain outstanding work — XLA program order gives the sync semantics
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+
+
+def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                      use_calc_stream=False):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def is_initialized():
+    return _default_group is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _default_group = None
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def get_backend(group=None):
+    return _group(group).backend

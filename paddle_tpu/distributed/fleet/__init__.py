@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed.fleet (reference: paddle.distributed.fleet)."""
+from . import utils  # noqa: F401
+from .fleet import (Fleet, HybridParallelWrapper, distributed_model,  # noqa: F401
+                    distributed_optimizer, get_hybrid_group, init,
+                    is_initialized)
+from .hybrid_optimizer import (DygraphShardingOptimizer,  # noqa: F401
+                               DygraphShardingOptimizerV2,
+                               HybridParallelOptimizer)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
+                       SharedLayerDesc)
+from .random_ctl import (RNGStatesTracker, get_rng_state_tracker,  # noqa: F401
+                         model_parallel_random_seed)
+from .spmd import SPMDTrainer  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       build_mesh, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+# fleet.meta_parallel namespace parity
+from . import mp_layers as meta_parallel  # noqa: F401

@@ -1,0 +1,182 @@
+"""The Fleet facade.
+
+Reference parity: fleet.init / fleet.distributed_model /
+fleet.distributed_optimizer and the worker-info API (upstream
+python/paddle/distributed/fleet/fleet.py — unverified, see SURVEY.md §2.3,
+call stack §3.2).
+
+TPU-native flow: `init` builds the hybrid Mesh from strategy.hybrid_configs;
+`distributed_model` + `distributed_optimizer` return wrappers that feed the
+SPMD engine; `Model`/user loops then call `train_batch` and get ONE
+compiled XLA step with all parallelisms composed (pp handled by the
+pipeline runtime).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ...nn.layer import Layer
+from .. import env as dist_env
+from ..collective import set_default_group, new_group
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       set_hybrid_communicate_group,
+                       get_hybrid_communicate_group)
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: DistributedStrategy | None = None
+        self.hcg: HybridCommunicateGroup | None = None
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=20):
+    global _state
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dims_by_name = {"dp": int(hc["dp_degree"]), "pp": int(hc["pp_degree"]),
+                    "sharding": int(hc["sharding_degree"]),
+                    "sep": int(hc["sep_degree"]),
+                    "mp": int(hc["mp_degree"])}
+    # sharding strategy may also carry the degree
+    if strategy.sharding and strategy.sharding_configs["sharding_degree"] > 1:
+        dims_by_name["sharding"] = int(
+            strategy.sharding_configs["sharding_degree"])
+    n_dev = len(jax.devices())
+    specified = int(np.prod(list(dims_by_name.values())))
+    if specified == 1 and n_dev > 1:
+        dims_by_name["dp"] = n_dev  # pure-DP default, reference behavior
+    order = ["dp", "pp", "sharding", "sep", "mp"]
+    ref_names = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                 "sep": "sep", "mp": "model"}
+    topo = CommunicateTopology([ref_names[a] for a in order],
+                               [dims_by_name[a] for a in order])
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    set_default_group(new_group(list(range(topo.world_size()))))
+    _state.initialized = True
+    _state.strategy = strategy
+    _state.hcg = hcg
+    return Fleet()
+
+
+def is_initialized():
+    return _state.initialized
+
+
+def get_hybrid_group():
+    return _state.hcg
+
+
+def distributed_model(model: Layer):
+    if not _state.initialized:
+        raise RuntimeError("call fleet.init first")
+    from .pipeline import PipelineLayer, PipelineParallel
+
+    hcg = _state.hcg
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _state.strategy)
+    return HybridParallelWrapper(model, hcg, _state.strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if not _state.initialized:
+        raise RuntimeError("call fleet.init first")
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, _state.hcg,
+                                   strategy or _state.strategy)
+
+
+class HybridParallelWrapper(Layer):
+    """distributed_model product for non-pipeline models: eager forward is
+    the plain model; `train_batch(inputs, labels, optimizer, loss_fn)` runs
+    the compiled SPMD step (dp/sharding/mp/sp composed)."""
+
+    def __init__(self, model, hcg, strategy):
+        super().__init__()
+        self._layers = model
+        self._hcg = hcg
+        self._strategy = strategy
+        self._trainer = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _get_trainer(self, optimizer, loss_fn):
+        if self._trainer is None:
+            from .spmd import SPMDTrainer
+            stage = 0
+            st = self._strategy
+            if st is not None and st.sharding:
+                stage = int(st.sharding_configs["stage"])
+            elif st is not None and \
+                    st.hybrid_configs["sharding_degree"] > 1:
+                stage = 1
+            self._trainer = SPMDTrainer(
+                self._layers,
+                optimizer._inner if hasattr(optimizer, "_inner")
+                else optimizer,
+                loss_fn, self._hcg.mesh, st, sharding_stage=stage)
+        return self._trainer
+
+    def train_batch(self, inputs, labels, optimizer, loss_fn):
+        return self._get_trainer(optimizer, loss_fn).train_batch(inputs,
+                                                                 labels)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+
+class Fleet:
+    """The object returned by fleet.init — reference worker-info API."""
+
+    def __init__(self):
+        self._hcg = _state.hcg
+
+    @property
+    def strategy(self):
+        return _state.strategy
+
+    def worker_index(self):
+        return dist_env.get_rank()
+
+    def worker_num(self):
+        return dist_env.get_world_size()
+
+    def is_first_worker(self):
+        return dist_env.get_rank() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = dist_env.get_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def stop_worker(self):
+        pass

@@ -1,0 +1,55 @@
+"""HybridParallelOptimizer + DygraphShardingOptimizer facades.
+
+Reference parity: fleet/meta_optimizers/dygraph_optimizer/* (upstream,
+unverified; see SURVEY.md §2.3): grad clip across all mesh axes, sharding
+stage-1 optimizer.
+
+TPU-native: the SPMD engine computes GLOBAL gradients inside one program,
+so ClipGradByGlobalNorm's norm is already the global norm — the reference's
+cross-axis norm reduction is structural, not extra code. These classes keep
+API parity and tag the sharding stage for the engine.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Optimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def minimize(self, loss, *a, **k):
+        return self._inner.minimize(loss, *a, **k)
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, s):
+        return self._inner.set_state_dict(s)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """ZeRO-1 facade: tags stage=1; the SPMD engine shards optimizer
+    states over the sharding axis and XLA emits
+    reduce-scatter(grad) → sharded update → all-gather(param)."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        super().__init__(optimizer, hcg, strategy)
+        self.sharding_stage = 1
+
+
+class DygraphShardingOptimizerV2(DygraphShardingOptimizer):
+    sharding_stage = 2

@@ -1,0 +1,112 @@
+"""Autograd-aware model-parallel communication primitives.
+
+Reference parity: fleet/layers/mpu/mp_ops.py (_c_identity, _c_split,
+_c_concat, _mp_allreduce — upstream, unverified; see SURVEY.md §2.3).
+
+Dual lowering (see collective.py): under shard_map the mp axis is live →
+explicit lax collectives with correct custom gradients; under GSPMD/pjit
+(or eager) these are identities/sharding hints and the partitioner owns
+the communication.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+from .._axis import current_axis_env
+
+
+def _live(group):
+    return group is not None and group.axis_name in current_axis_env()
+
+
+def _identity(x, group=None):
+    """Forward identity; backward all-reduce (input of a column-parallel
+    matmul)."""
+    if not _live(group):
+        return x
+    ax = group.axis_name
+
+    @jax.custom_vjp
+    def f(a):
+        return a
+
+    def fwd(a):
+        return a, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, ax),)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, x, name="c_identity")
+
+
+def _mp_allreduce(x, group=None, use_calc_stream=True,
+                  use_model_parallel=True, op=None):
+    """Forward all-reduce; backward identity (output of a row-parallel
+    matmul)."""
+    if not _live(group):
+        return x
+    ax = group.axis_name
+
+    @jax.custom_vjp
+    def f(a):
+        return jax.lax.psum(a, ax)
+
+    def fwd(a):
+        return jax.lax.psum(a, ax), None
+
+    def bwd(_, g):
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, x, name="mp_allreduce")
+
+
+def _c_split(x, group=None, axis=-1):
+    """Forward: keep this rank's slice; backward: all-gather."""
+    if not _live(group):
+        return x
+    ax_name = group.axis_name
+    n = group.nranks
+
+    @jax.custom_vjp
+    def f(a):
+        idx = jax.lax.axis_index(ax_name)
+        size = a.shape[axis] // n
+        return jax.lax.dynamic_slice_in_dim(a, idx * size, size, axis=axis)
+
+    def fwd(a):
+        return f(a), None
+
+    def bwd(_, g):
+        return (jax.lax.all_gather(g, ax_name, axis=axis, tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, x, name="c_split")
+
+
+def _c_concat(x, group=None, axis=-1):
+    """Forward: all-gather along axis; backward: slice."""
+    if not _live(group):
+        return x
+    ax_name = group.axis_name
+    n = group.nranks
+
+    @jax.custom_vjp
+    def f(a):
+        return jax.lax.all_gather(a, ax_name, axis=axis, tiled=True)
+
+    def fwd(a):
+        return f(a), None
+
+    def bwd(_, g):
+        idx = jax.lax.axis_index(ax_name)
+        size = g.shape[axis] // n
+        return (jax.lax.dynamic_slice_in_dim(g, idx * size, size,
+                                             axis=axis),)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, x, name="c_concat")

@@ -1,0 +1,86 @@
+"""RNG state coordination for model parallelism.
+
+Reference parity: RNGStatesTracker + model_parallel_random_seed (upstream
+fleet/meta_parallel/parallel_layers/random.py — unverified, see SURVEY.md
+§2.3): dropout inside TP blocks must use a *distinct but deterministic*
+seed per mp rank ("local seed"), while non-sharded dropout uses the same
+seed everywhere ("global seed") — critical for loss parity.
+
+TPU-native: under GSPMD there is one logical program, so "same mask
+everywhere" is automatic; the tracker matters for explicit shard_map
+regions, where `get_states_tracker().rng_state(name)` folds the mp rank
+into the key stream.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: dict[str, dict] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already added")
+        if name in self.states_:
+            raise ValueError(f"state {name} already added")
+        self.seeds_.add(seed)
+        g = _random.Generator(seed)
+        self.states_[name] = g
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n in self.states_:
+                self.states_[n].set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added")
+        gen = self.states_[name]
+        global_gen = _random._default_generator
+        _random._default_generator = gen
+        try:
+            yield
+        finally:
+            _random._default_generator = global_gen
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    """Derive (global, local) seeds; local folds in the mp rank."""
+    from .topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    seed = seed if seed is not None else 100
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    _tracker.reset()
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    _random.seed(global_seed)
+
+
+def determinate_seed(name):
+    return _tracker.states_[name].initial_seed

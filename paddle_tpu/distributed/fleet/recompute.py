@@ -1,0 +1,118 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference parity: fleet.utils.recompute / recompute_sequential (upstream
+fleet/recompute/ — unverified, see SURVEY.md §2.3), incl. RNG-state
+save/restore so dropout masks match between the two forward passes.
+
+TPU-native: `jax.checkpoint` (remat) IS the mechanism — XLA rematerializes
+the segment in backward. RNG determinism across the two passes is free:
+random ops fold a counter into the traced base key, and remat replays the
+same folded keys. The offload variant maps to jax.checkpoint policies
+(dots_saveable etc.).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import random as _random
+from ...core.autograd import apply, is_grad_enabled
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+def recompute(function, *args, **kwargs):
+    """fleet.utils.recompute(function, *args) — checkpoint one segment."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    offload = kwargs.pop("offload", False)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    statics = [a if not isinstance(a, Tensor) else None for a in args]
+
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    layers = function if isinstance(function, Layer) else None
+    named = list(layers.named_parameters()) if layers is not None else []
+    policy = jax.checkpoint_policies.nothing_saveable if not offload else \
+        jax.checkpoint_policies.dots_saveable
+
+    def pure(params, key, *arrs):
+        saved = [(t, t._data) for _, t in named]
+        for (n, t), arr in zip(named, params):
+            t._data = arr
+        _random.push_trace_key(key)
+        try:
+            rebuilt = []
+            ti = 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    rebuilt.append(Tensor(arrs[ti]))
+                    ti += 1
+                else:
+                    rebuilt.append(a)
+            out = function(*rebuilt, **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._data for o in outs)
+        finally:
+            _random.pop_trace_key()
+            for t, arr in saved:
+                t._data = arr
+
+    ck = jax.checkpoint(pure, policy=policy)
+    key = _random.next_key()
+    param_tensors = [p for _, p in named]
+    outs = apply(lambda *arrs: ck(list(arrs[:len(named)]),
+                                  arrs[len(named)],
+                                  *arrs[len(named) + 1:]),
+                 *param_tensors, Tensor(key), *tensor_args,
+                 name="recompute")
+    if isinstance(outs, tuple) and len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """fleet.utils.recompute_sequential — checkpoint each segment of a
+    Sequential-like list."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if isinstance(functions, Layer):
+        functions = list(functions.children())
+    funcs = list(functions)
+    seg_size = max(1, len(funcs) // max(segments, 1))
+    out = args[0] if len(args) == 1 else args
+    i = 0
+    while i < len(funcs):
+        chunk = funcs[i:i + seg_size]
+
+        def seg_forward(x, _chunk=chunk):
+            for f in _chunk:
+                x = f(x)
+            return x
+
+        class _SegLayer(Layer):
+            def __init__(self, chunk):
+                super().__init__()
+                for j, c in enumerate(chunk):
+                    if isinstance(c, Layer):
+                        self.add_sublayer(str(j), c)
+
+            def forward(self, x):
+                return seg_forward(x)
+
+        seg = _SegLayer(chunk)
+        out = recompute(seg, out, **kwargs)
+        i += seg_size
+    return out
+
+
+class RecomputeLayer(Layer):
+    """Wrap any Layer so its forward is checkpointed (TPU-native sugar)."""
+
+    def __init__(self, inner: Layer, offload=False):
+        super().__init__()
+        self.inner = inner
+        self._offload = offload
+
+    def forward(self, *args):
+        return recompute(self.inner, *args, offload=self._offload)

@@ -1,0 +1,111 @@
+"""DistributedStrategy.
+
+Reference parity: fleet.DistributedStrategy (upstream
+fleet/base/distributed_strategy.py — unverified, see SURVEY.md §2.3),
+including `hybrid_configs` (dp/mp/pp/sharding/sep degrees), amp/recompute/
+sharding sub-configs. TPU-native: a plain Python config object (the
+reference's protobuf backing is a wire-format concern its static graph
+needed; SPMD compilation needs only the values).
+"""
+from __future__ import annotations
+
+import copy
+
+
+class _SubConfig(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+_DEFAULT_HYBRID = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "ep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    "mp_configs": {},
+    "pp_configs": {},
+}
+
+_DEFAULT_AMP = {
+    "init_loss_scaling": 32768.0,
+    "use_dynamic_loss_scaling": True,
+    "custom_white_list": [],
+    "custom_black_list": [],
+    "use_pure_fp16": False,
+    "use_fp16_guard": False,
+    "dtype": "bfloat16",
+    "level": "O1",
+}
+
+_DEFAULT_RECOMPUTE = {
+    "checkpoints": [],
+    "enable_offload": False,
+}
+
+_DEFAULT_SHARDING = {
+    "sharding_degree": 1,
+    "stage": 1,
+    "offload": False,
+    "comm_overlap": True,
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = _SubConfig(copy.deepcopy(_DEFAULT_AMP))
+        self.recompute = False
+        self.recompute_configs = _SubConfig(copy.deepcopy(_DEFAULT_RECOMPUTE))
+        self.sharding = False
+        self.sharding_configs = _SubConfig(copy.deepcopy(_DEFAULT_SHARDING))
+        self.hybrid_configs = _SubConfig(copy.deepcopy(_DEFAULT_HYBRID))
+        self.gradient_merge = False
+        self.gradient_merge_configs = _SubConfig({"k_steps": 1,
+                                                  "avg": True})
+        self.lamb = False
+        self.gradient_scale_configs = _SubConfig({"scale_strategy": "avg"})
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _SubConfig({})
+        self.pipeline = False
+        self.pipeline_configs = _SubConfig({
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B", "vpp_degree": 1})
+        self.heter_ccl_mode = False
+        self.fuse_grad_size_in_MB = 32
+
+    @property
+    def hybrid_parallel_order(self):
+        return self.hybrid_configs.get("order",
+                                       ["dp", "pp", "sharding", "sep", "mp"])
+
+    def __setattr__(self, k, v):
+        # hybrid_configs set with a plain dict merges into defaults
+        if k.endswith("_configs") and isinstance(v, dict) and \
+                not isinstance(v, _SubConfig):
+            cur = self.__dict__.get(k)
+            if isinstance(cur, _SubConfig):
+                merged = _SubConfig(cur)
+                merged.update(v)
+                object.__setattr__(self, k, merged)
+                return
+            object.__setattr__(self, k, _SubConfig(v))
+            return
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        hc = self.hybrid_configs
+        return (f"DistributedStrategy(dp={hc['dp_degree']}, "
+                f"mp={hc['mp_degree']}, pp={hc['pp_degree']}, "
+                f"sharding={hc['sharding_degree']} "
+                f"stage={self.sharding_configs['stage']}, "
+                f"sep={hc['sep_degree']})")

@@ -1,0 +1,244 @@
+"""Hybrid-parallel topology over a jax.sharding.Mesh.
+
+Reference parity: CommunicateTopology + HybridCommunicateGroup (upstream
+python/paddle/distributed/fleet/base/topology.py — unverified, see
+SURVEY.md §2.3): builds the dp/pp/sharding/sep/mp rank hypercube and
+per-axis communication groups.
+
+TPU-native design: the hypercube IS a `jax.sharding.Mesh` with axes
+("dp", "pp", "sharding", "sep", "mp") — axis order follows the reference's
+hybrid order so that mp (the most bandwidth-hungry) varies fastest →
+adjacent devices → ICI rings; dp varies slowest → DCN-friendly. Each
+"communication group" is a ProcessGroup naming a mesh axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..collective import ProcessGroup, new_group
+
+# canonical axis order, reference hybrid order (outermost → innermost)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(self._dims))
+        shaped = np.arange(self._world).reshape(self._dims)
+        self._rank_grid = shaped
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._rank_grid[coord])
+
+    def get_coord(self, rank):
+        coord = np.argwhere(self._rank_grid == rank)[0]
+        return dict(zip(self._parallel_names, (int(c) for c in coord)))
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose `axis_name` coordinate equals index."""
+        ax = self._parallel_names.index(axis_name)
+        taken = np.take(self._rank_grid, index, axis=ax)
+        return [int(r) for r in np.sort(taken.reshape(-1))]
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups, one per combination of the other axes."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_grid, ax, -1)
+        return [list(map(int, row)) for row in
+                moved.reshape(-1, self._dims[ax])]
+
+
+class HybridCommunicateGroup:
+    """Builds the device mesh + per-axis groups for this process.
+
+    Under SPMD there is one controller; "this rank" is rank 0's coordinate
+    unless PADDLE_TRAINER_ID says otherwise (multi-process mode).
+    """
+
+    def __init__(self, topology: CommunicateTopology, devices=None):
+        from .. import env as dist_env
+
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        # map reference names → mesh axis names
+        ref2axis = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                    "sep": "sep", "model": "mp"}
+        self._axis_names = tuple(ref2axis.get(n, n) for n in names)
+        dims = tuple(topology.get_dim(n) for n in names)
+        self._dims = dims
+
+        if devices is None:
+            devices = jax.devices()
+        n_needed = int(np.prod(dims))
+        if len(devices) < n_needed:
+            raise ValueError(
+                f"hybrid topology needs {n_needed} devices, have "
+                f"{len(devices)}. (Tests: use "
+                f"--xla_force_host_platform_device_count.)")
+        dev_grid = np.array(devices[:n_needed]).reshape(dims)
+        self.mesh = Mesh(dev_grid, self._axis_names)
+
+        self.global_rank = dist_env.get_rank()
+        self.nranks = n_needed
+        coord = topology.get_coord(self.global_rank)
+        self._coord = coord
+
+        self._groups = {}
+        for ref_name, axis in zip(names, self._axis_names):
+            ranks = topology.get_axis_list(
+                ref_name, coord[ref_name]) if False else None
+            # the group containing this rank along `axis`
+            my_groups = [g for g in topology.get_comm_list(ref_name)
+                         if self.global_rank in g]
+            self._groups[axis] = new_group(my_groups[0] if my_groups
+                                           else [0], axis_name=axis)
+
+        # degrees
+        name_of = dict(zip(self._axis_names, names))
+        self._dp_degree = self._degree("dp")
+        self._mp_degree = self._degree("mp")
+        self._pp_degree = self._degree("pp")
+        self._sharding_degree = self._degree("sharding")
+        self._sep_degree = self._degree("sep")
+
+    def _degree(self, axis):
+        if axis in self._axis_names:
+            return self._dims[self._axis_names.index(axis)]
+        return 1
+
+    # -- reference API ------------------------------------------------------
+    def get_parallel_mode(self):
+        if self._mp_degree > 1 or self._pp_degree > 1 or \
+                self._sharding_degree > 1 or self._sep_degree > 1:
+            return "hybrid"
+        if self._dp_degree > 1:
+            return "data"
+        return "single"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord.get("data", 0)
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_data_parallel_group_src_rank(self):
+        g = self._groups.get("dp")
+        return g.ranks[0] if g else 0
+
+    # model parallel
+    def get_model_parallel_rank(self):
+        return self._coord.get("model", 0)
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_model_parallel_group_src_rank(self):
+        g = self._groups.get("mp")
+        return g.ranks[0] if g else 0
+
+    # pipeline
+    def get_stage_id(self):
+        return self._coord.get("pipe", 0)
+
+    def get_pipe_parallel_rank(self):
+        return self._coord.get("pipe", 0)
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pp")
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord.get("sharding", 0)
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_sharding_parallel_group_src_rank(self):
+        g = self._groups.get("sharding")
+        return g.ranks[0] if g else 0
+
+    # sep (context parallel)
+    def get_sep_parallel_rank(self):
+        return self._coord.get("sep", 0)
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    # checks (reference: check-group sanity)
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups.get("mp")
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup | None:
+    return _hcg
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None) -> Mesh:
+    """Convenience: build a hybrid Mesh directly (TPU-native entry)."""
+    devices = devices if devices is not None else jax.devices()
+    dims = (dp, pp, sharding, sep, mp)
+    n = int(np.prod(dims))
+    grid = np.array(devices[:n]).reshape(dims)
+    return Mesh(grid, HYBRID_AXES)

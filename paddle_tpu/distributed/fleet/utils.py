@@ -1,0 +1,3 @@
+"""fleet.utils namespace (recompute + sequence-parallel re-exports)."""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import sequence_parallel as sequence_parallel_utils  # noqa: F401

@@ -1,0 +1,87 @@
+"""Process bootstrap + DataParallel.
+
+Reference parity: init_parallel_env / get_rank / get_world_size /
+DataParallel (upstream python/paddle/distributed/parallel.py — unverified,
+see SURVEY.md §2.3).
+
+TPU-native: `init_parallel_env` initializes `jax.distributed` when the
+PADDLE_* env protocol indicates a multi-host launch (coordination-service
+rendezvous replaces TCPStore), and installs a default ProcessGroup over
+all devices. DataParallel keeps the eager reference API; its gradient
+synchronization is structural under SPMD — the compiled step's dp-sharded
+batch makes XLA insert the grad all-reduce (the EagerReducer's bucketing
+== XLA collective scheduling).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import env as dist_env
+from .collective import ProcessGroup, new_group, set_default_group
+
+
+def init_parallel_env():
+    endpoints = dist_env.get_endpoints()
+    world = dist_env.get_world_size()
+    rank = dist_env.get_rank()
+    if world > 1 and endpoints and jax.process_count() == 1:
+        master = endpoints[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=master, num_processes=world,
+                process_id=rank)
+        except Exception:
+            pass  # single-host simulation: env set but no real peers
+    g = new_group(list(range(max(world, 1))))
+    set_default_group(g)
+    return g
+
+
+def get_rank(group=None):
+    return dist_env.get_rank()
+
+
+def get_world_size(group=None):
+    return dist_env.get_world_size()
+
+
+class DataParallel(Layer):
+    """Reference: paddle.DataParallel(model). Under SPMD the wrapper is a
+    transparent facade — grad sync is compiled into the step (see module
+    docstring); `no_sync` therefore is a no-op context manager kept for
+    API compatibility (gradient accumulation composes via the trainer's
+    accumulate_steps instead)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss
