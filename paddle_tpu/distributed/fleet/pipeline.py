@@ -221,14 +221,22 @@ def _pipeline_train_step(pp: PipelineParallel, opt, inputs: Tensor,
         blk_state_list.append({k: jnp.stack([s[k] for s in sts])
                                for k in keys})
 
+    rep = NamedSharding(mesh, P())
+    blk_sh = NamedSharding(mesh, P("pp"))
+    put = lambda sh: (lambda x: jax.device_put(x, sh))
     (loss_v, new_pre, new_post, new_blk, new_pre_st, new_post_st,
      new_blk_st) = fn(
-        key, [p._data for _, p in pre_named],
-        [p._data for _, p in post_named], blk_stacked,
-        pre_states, post_states, blk_state_list,
-        jnp.asarray(opt.get_lr(), jnp.float32),
-        jnp.asarray(opt._step_count, jnp.int32),
-        inputs._data, labels._data)
+        jax.device_put(key, rep),
+        [put(rep)(p._data) for _, p in pre_named],
+        [put(rep)(p._data) for _, p in post_named],
+        [put(blk_sh)(a) for a in blk_stacked],
+        jax.tree.map(put(rep), pre_states),
+        jax.tree.map(put(rep), post_states),
+        jax.tree.map(put(blk_sh), blk_state_list),
+        jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), rep),
+        jax.device_put(jnp.asarray(opt._step_count, jnp.int32), rep),
+        jax.device_put(inputs._data, rep),
+        jax.device_put(labels._data, rep))
 
     for (n, p), arr in zip(pre_named, new_pre):
         p._inplace_update(arr)
@@ -358,7 +366,7 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, per_stage, pre_named,
         # tree-prefix specs: one spec per argument subtree
         in_specs=(P(), P(), P(), blk_spec, batch_spec, batch_spec),
         out_specs=P(),
-        check_rep=False)
+        check_vma=False)
 
     def pure(key, pre, post, blk, pre_st, post_st, blk_st, lr, step_i,
              batch, labels):
