@@ -1,0 +1,102 @@
+"""Benchmark: LLaMA causal-LM training step on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: model-FLOPs utilization (MFU) of a compiled train step
+(fwd+bwd+fused AdamW in one XLA program) — the single-chip proxy for the
+north-star (BASELINE.json: ≥50% MFU target ⇒ vs_baseline = MFU / 0.50).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12,
+    "cpu": 1e12,  # nominal, so CPU smoke runs produce a number
+}
+
+
+def detect_peak():
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind.replace(" ", ""):
+            return v, kind
+    if d.platform in ("tpu", "axon"):
+        return 197e12, kind  # default to v5e
+    return PEAK_FLOPS["cpu"], kind
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion,
+                                   flops_per_token)
+
+    peak, kind = detect_peak()
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048, recompute=False,
+                          dtype="bfloat16")
+        batch, seq, iters = 8, 1024, 20
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4)
+        batch, seq, iters = 2, 128, 3
+
+    P.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    crit = LlamaPretrainingCriterion(cfg)
+    opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                            multi_precision=on_tpu)
+    m = P.Model(model)
+    m.prepare(opt, crit)
+
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = P.to_tensor(ids)
+
+    # warmup (compile)
+    m.train_batch([x], [x])
+    m.train_batch([x], [x])
+    jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = m.train_batch([x], [x])
+    import jax.numpy as _j
+    _j.zeros(()).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tok_per_s = tokens / dt
+    fpt = flops_per_token(cfg, seq)
+    mfu = tok_per_s * fpt / peak
+
+    print(json.dumps({
+        "metric": f"llama_{'bench' if on_tpu else 'smoke'}_mfu_{kind}",
+        "value": round(mfu, 4),
+        "unit": "MFU (model FLOPs utilization, fwd+bwd+opt)",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "tokens_per_sec": round(tok_per_s, 1),
+        "loss": float(loss),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

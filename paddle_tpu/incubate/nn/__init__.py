@@ -1,0 +1,2 @@
+"""incubate.nn"""
+from . import functional  # noqa: F401

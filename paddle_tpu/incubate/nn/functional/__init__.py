@@ -1,0 +1,200 @@
+"""Incubate fused functional ops.
+
+Reference parity: python/paddle/incubate/nn/functional/ — flash_attention,
+fused_rotary_position_embedding, fused_rms_norm, fused_linear,
+variable-length attention (upstream, unverified; see SURVEY.md §2.2
+"Incubate"). On TPU, "fused" means: shaped so XLA emits one fusion (or a
+Pallas kernel for attention) — there is no hand-written CUDA to mirror.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.autograd import apply
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....ops._base import ensure_tensor
+from ....ops.pallas.flash_attention import (flash_attention,  # noqa: F401
+                                            flash_attention_bshd)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style
+                                    =True, rotary_emb_base=10000.0,
+                                    name=None):
+    """RoPE applied to q/k ([B, S, H, D] layout, reference API)."""
+    q = ensure_tensor(q)
+
+    def make_sincos(seq, dim, dtype):
+        inv = 1.0 / (rotary_emb_base **
+                     (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)  # [S, D/2]
+        return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+    def rope_one(x, sin_, cos_, pos):
+        # x: [B, S, H, D]
+        d = x.shape[-1]
+        if sin_ is None:
+            sin_, cos_ = make_sincos(x.shape[1], d, jnp.float32)
+        else:
+            sin_ = sin_.reshape(sin_.shape[-2], sin_.shape[-1])
+            cos_ = cos_.reshape(cos_.shape[-2], cos_.shape[-1])
+            if sin_.shape[-1] == d:  # full-dim tables → take half
+                sin_ = sin_[..., : d // 2]
+                cos_ = cos_[..., : d // 2]
+        if pos is not None:
+            sin_ = jnp.take(sin_, pos, axis=0)  # [B, S, D/2]
+            cos_ = jnp.take(cos_, pos, axis=0)
+            sin_ = sin_[:, :, None, :]
+            cos_ = cos_[:, :, None, :]
+        else:
+            sin_ = sin_[None, :, None, :]
+            cos_ = cos_[None, :, None, :]
+        xf = x.astype(jnp.float32)
+        if use_neox_rotary_style:
+            x1 = xf[..., : d // 2]
+            x2 = xf[..., d // 2:]
+            out = jnp.concatenate(
+                [x1 * cos_ - x2 * sin_, x2 * cos_ + x1 * sin_], axis=-1)
+        else:
+            x1 = xf[..., 0::2]
+            x2 = xf[..., 1::2]
+            r1 = x1 * cos_ - x2 * sin_
+            r2 = x2 * cos_ + x1 * sin_
+            out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+        return out.astype(x.dtype)
+
+    sin_a = sin._data if isinstance(sin, Tensor) else sin
+    cos_a = cos._data if isinstance(cos, Tensor) else cos
+    pos_a = position_ids._data if isinstance(position_ids, Tensor) \
+        else position_ids
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        t = ensure_tensor(t)
+        outs.append(apply(lambda a: rope_one(a, sin_a, cos_a, pos_a), t,
+                          name="fused_rope"))
+    return tuple(outs)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + ensure_tensor(norm_bias)
+    return out, None  # (out, invvar) reference signature
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, name=None):
+    shape = tuple(ensure_tensor(x).shape[begin_norm_axis:])
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    w = ensure_tensor(weight)
+    if transpose_weight:
+        w = w.mT
+    return F.linear(x, w, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    import paddle_tpu as Pk
+    out = Pk.matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           name=None):
+    out = x if bias is None else x + ensure_tensor(bias)
+    out = F.dropout(out, dropout_rate, training=training)
+    out = out + ensure_tensor(residual)
+    d = out.shape[-1]
+    return F.layer_norm(out, d, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None,
+                               name=None):
+    """Fused MHA (reference: incubate fused_attention). Composed from
+    XLA-fusable pieces + the flash-attention core."""
+    import paddle_tpu as Pk
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    qkvw = ensure_tensor(qkv_weight)  # [3, H, D, E] reference layout
+    three, h, d, e = qkvw.shape
+    w2d = qkvw.reshape([3 * h * d, e]).mT  # [E, 3HD]
+    qkv = F.linear(x, w2d, None)
+    if qkv_bias is not None:
+        qkv = qkv + ensure_tensor(qkv_bias).reshape([3 * h * d])
+    b, s = x.shape[0], x.shape[1]
+    qkv = qkv.reshape([b, s, 3, h, d])
+    q, k, v = qkv.unbind(axis=2)
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate,
+                                         training=training)
+    out = out.reshape([b, s, h * d])
+    out = F.linear(out, ensure_tensor(linear_weight), linear_bias)
+    out = F.dropout(out, dropout_rate, training=training)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    x = ensure_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, ensure_tensor(linear1_weight), linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, ensure_tensor(linear2_weight), linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (reference: incubate.nn.functional.swiglu)."""
+    x = ensure_tensor(x)
+    if y is not None:
+        y = ensure_tensor(y)
+        return apply(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+    return apply(lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2]) *
+                 a[..., a.shape[-1] // 2:], x, name="swiglu")

@@ -1,0 +1,7 @@
+"""paddle_tpu.models — LLM model families (reference ecosystem: PaddleNLP)."""
+from .bert import (BertConfig, BertForMaskedLM,  # noqa: F401
+                   BertForSequenceClassification, BertModel)
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
+                    LlamaPretrainingCriterion, count_params,
+                    flops_per_token)

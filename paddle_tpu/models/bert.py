@@ -1,0 +1,147 @@
+"""BERT family (config-2 benchmark model: BERT-base AMP-O2 fine-tune on a
+single TPU chip).
+
+Reference parity: the classic BERT encoder (learned pos + token-type
+embeddings, post-LN transformer, pooler, MLM/classification heads).
+TPU-first engineering as in llama.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ..nn import (Dropout, Embedding, Layer, LayerList, LayerNorm, Linear,
+                  Tanh)
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return BertConfig(**{**dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=128, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0), **kw})
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = P.arange(s).unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = P.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids) +
+             self.position_embeddings(position_ids) +
+             self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertLayer(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        h = cfg.hidden_size
+        self.nh = cfg.num_attention_heads
+        self.hd = h // self.nh
+        self.q = Linear(h, h)
+        self.k = Linear(h, h)
+        self.v = Linear(h, h)
+        self.attn_out = Linear(h, h)
+        self.attn_norm = LayerNorm(h, cfg.layer_norm_eps)
+        self.ffn_in = Linear(h, cfg.intermediate_size)
+        self.ffn_out = Linear(cfg.intermediate_size, h)
+        self.ffn_norm = LayerNorm(h, cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.attn_dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q(x).reshape([b, s, self.nh, self.hd])
+        k = self.k(x).reshape([b, s, self.nh, self.hd])
+        v = self.v(x).reshape([b, s, self.nh, self.hd])
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_p,
+            training=self.training)
+        ctx = self.attn_out(ctx.reshape([b, s, self.nh * self.hd]))
+        x = self.attn_norm(x + self.dropout(ctx))
+        h = self.ffn_out(F.gelu(self.ffn_in(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = LayerList([BertLayer(cfg)
+                                  for _ in range(cfg.num_hidden_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] key-padding mask → additive [B, 1, 1, S]
+            am = (1.0 - attention_mask.astype("float32")) * -1e9
+            attention_mask = am.unsqueeze(1).unsqueeze(1)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.decoder = Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        return self.decoder(h)

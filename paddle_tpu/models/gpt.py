@@ -1,0 +1,156 @@
+"""GPT-3 family (config-4 benchmark model: GPT-3 1.3B ZeRO on v5e-8).
+
+Reference parity: PaddleNLP GPT architecture — learned positions, pre-LN
+transformer, GELU MLP, tied lm_head. TPU-first: same engineering notes as
+llama.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..nn import Dropout, Embedding, Layer, LayerList, LayerNorm, Linear
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int | None = None
+    max_position_embeddings: int = 2048
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    tensor_parallel: bool = False
+    recompute: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt3_1_3b(**kw):
+        return GPTConfig(**{**dict(hidden_size=2048, num_hidden_layers=24,
+                                   num_attention_heads=16), **kw})
+
+    @staticmethod
+    def tiny(**kw):
+        return GPTConfig(**{**dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0), **kw})
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.nh = cfg.num_attention_heads
+        self.hd = h // self.nh
+        self.drop = cfg.attention_dropout_prob
+        if cfg.tensor_parallel:
+            self.qkv_proj = ColumnParallelLinear(h, 3 * h,
+                                                 gather_output=False)
+            self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv_proj = Linear(h, 3 * h)
+            self.out_proj = Linear(h, h)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.nh, self.hd])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.drop,
+            training=self.training)
+        return self.out_proj(out.reshape([b, s, self.nh * self.hd]))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        if cfg.tensor_parallel:
+            self.fc_in = ColumnParallelLinear(cfg.hidden_size,
+                                              cfg.intermediate_size,
+                                              gather_output=False)
+            self.fc_out = RowParallelLinear(cfg.intermediate_size,
+                                            cfg.hidden_size,
+                                            input_is_parallel=True)
+        else:
+            self.fc_in = Linear(cfg.hidden_size, cfg.intermediate_size)
+            self.fc_out = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+
+    def _block(self, x):
+        x = x + self.drop(self.attn(self.ln_1(x)))
+        return x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
+                                                approximate=True)))
+
+    def forward(self, x):
+        if self.cfg.recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+
+            outer = self
+
+            class _Body(Layer):
+                def __init__(s):
+                    super().__init__()
+                    s.inner = outer
+
+                def forward(s, h):
+                    return s.inner._block(h)
+            return recompute(_Body(), x)
+        return self._block(x)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size,
+                                              cfg.hidden_size)
+        else:
+            self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(cfg)
+                            for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = P.arange(s).unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if cfg.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=False)
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        return self.lm_head(self.gpt(input_ids, position_ids))

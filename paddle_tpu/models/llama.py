@@ -1,0 +1,275 @@
+"""LLaMA-2 family — the flagship model of the north-star benchmark
+(BASELINE.json: Fleet sharding-stage3 LLaMA-2-7B on v5p-32 ≥50% MFU).
+
+Reference parity: the PaddleNLP LLaMA implementation's architecture
+(RMSNorm pre-norm, RoPE, GQA-capable attention, SwiGLU MLP, tied/untied
+lm_head, ParallelCrossEntropy) — built TPU-first:
+
+- bf16 matmuls on the MXU; fp32 RMSNorm statistics;
+- attention through the flash-attention entry (Pallas on TPU);
+- tensor parallelism via mpu layers (dist_spec hints → GSPMD, or explicit
+  collectives under shard_map);
+- sequence parallelism hooks on the block boundaries;
+- uniform decoder blocks → PipelineLayer-compatible;
+- `jax.checkpoint` recompute per block (recompute_granularity).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from ..core.tensor import Tensor
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           ParallelCrossEntropy,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding,
+                                           _mp_degree)
+from ..incubate.nn.functional import (fused_rotary_position_embedding,
+                                      swiglu)
+from ..nn import Embedding, Layer, LayerList, Linear, RMSNorm
+from ..nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int | None = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = False
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    recompute: bool = False
+    recompute_granularity: str = "full"
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**{**dict(
+            hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32), **kw})
+
+    @staticmethod
+    def llama2_13b(**kw):
+        return LlamaConfig(**{**dict(
+            hidden_size=5120, intermediate_size=13824,
+            num_hidden_layers=40, num_attention_heads=40), **kw})
+
+    @staticmethod
+    def tiny(**kw):
+        return LlamaConfig(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128), **kw})
+
+
+def _linear_cls(cfg, kind):
+    if cfg.tensor_parallel and _mp_degree() > 1:
+        return kind
+    return None
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads or cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        h = cfg.hidden_size
+        kv_out = self.num_kv_heads * self.head_dim
+        if cfg.tensor_parallel:
+            self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kv_out, has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(h, h, bias_attr=False)
+            self.k_proj = Linear(h, kv_out, bias_attr=False)
+            self.v_proj = Linear(h, kv_out, bias_attr=False)
+            self.o_proj = Linear(h, h, bias_attr=False)
+
+    def forward(self, x, position_ids=None, attn_mask=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        # under GSPMD shapes stay global; head counts are global
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        q = q.reshape([b, s, nh, hd])
+        k = k.reshape([b, s, nkv, hd])
+        v = v.reshape([b, s, nkv, hd])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids,
+            rotary_emb_base=self.cfg.rope_theta)
+        if cache is not None:
+            k = P.concat([cache[0], k], axis=1)
+            v = P.concat([cache[1], v], axis=1)
+            cache = (k, v)
+        if nkv != nh:  # GQA: repeat kv heads
+            rep = nh // nkv
+            k = k.unsqueeze(3).expand([b, k.shape[1], nkv, rep, hd]) \
+                 .reshape([b, k.shape[1], nh, hd])
+            v = v.unsqueeze(3).expand([b, v.shape[1], nkv, rep, hd]) \
+                 .reshape([b, v.shape[1], nh, hd])
+        causal = cache is None
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=causal,
+                                             training=self.training)
+        out = out.reshape([b, s, nh * hd])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        if cfg.tensor_parallel:
+            self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(m, h, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(h, m, bias_attr=False)
+            self.up_proj = Linear(h, m, bias_attr=False)
+            self.down_proj = Linear(m, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def _block(self, x, position_ids=None, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), position_ids,
+                               attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward(self, x, position_ids=None, attn_mask=None):
+        if self.cfg.recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+
+            class _Body(Layer):
+                def __init__(s):
+                    super().__init__()
+                    s.inner = self
+
+                def forward(s, h):
+                    return s.inner._block(h, position_ids, attn_mask)
+            return recompute(_Body(), x)
+        return self._block(x, position_ids, attn_mask)
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        if self.cfg.sequence_parallel:
+            from ..distributed.fleet.sequence_parallel import scatter
+            x = scatter(x, axis=1)
+        for layer in self.layers:
+            x = layer(x, position_ids, attn_mask)
+        if self.cfg.sequence_parallel:
+            from ..distributed.fleet.sequence_parallel import all_gather
+            x = all_gather(x, axis=1)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        if cfg.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=not cfg.tensor_parallel)
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+        if cfg.tie_word_embeddings:
+            self.lm_head.weight = self.llama.embed_tokens.weight
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        h = self.llama(input_ids, position_ids, attn_mask)
+        return self.lm_head(h)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shifted-causal-LM loss (reference: PaddleNLP pretraining criterion)."""
+
+    def __init__(self, cfg: LlamaConfig = None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.parallel = cfg is not None and cfg.tensor_parallel
+        if self.parallel:
+            self.pce = ParallelCrossEntropy(ignore_index=ignore_index)
+
+    def forward(self, logits, labels):
+        # logits [B, S, V]; labels [B, S] — predict token t+1
+        lg = logits[:, :-1, :]
+        lb = labels[:, 1:]
+        if self.parallel:
+            loss = self.pce(lg, lb)
+            mask = (lb != self.ignore_index).astype("float32")
+            return (loss * mask).sum() / P.maximum(
+                mask.sum(), P.to_tensor(1.0))
+        return F.cross_entropy(
+            lg.reshape([-1, lg.shape[-1]]), lb.reshape([-1]),
+            ignore_index=self.ignore_index)
+
+
+def count_params(cfg: LlamaConfig) -> int:
+    h, m, L, v = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    kv = (cfg.num_key_value_heads or cfg.num_attention_heads)
+    hd = h // cfg.num_attention_heads
+    attn = h * h + 2 * h * kv * hd + h * h
+    mlp = 3 * h * m
+    per_layer = attn + mlp + 2 * h
+    return v * h + L * per_layer + h + (0 if cfg.tie_word_embeddings
+                                        else v * h)
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token ≈ 6*N + attention term (for MFU accounting)."""
+    n = count_params(cfg)
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+    return 6.0 * n + attn_flops

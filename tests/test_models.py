@@ -1,0 +1,169 @@
+"""Model-family tests: forward shapes, causal-LM loss decreases, TP parity
+for LLaMA (the north-star model)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (BertConfig, BertForSequenceClassification,
+                               GPTConfig, GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM, LlamaPretrainingCriterion,
+                               count_params)
+
+
+def _reset_fleet():
+    from paddle_tpu.distributed.fleet.fleet import _state
+    from paddle_tpu.distributed.fleet.topology import \
+        set_hybrid_communicate_group
+    _state.initialized = False
+    _state.strategy = None
+    _state.hcg = None
+    set_hybrid_communicate_group(None)
+
+
+def batch(cfg_vocab, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg_vocab, (b, s)).astype(np.int32)
+    return P.to_tensor(ids)
+
+
+class TestLlama:
+    def test_forward_shape(self):
+        _reset_fleet()
+        P.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = batch(cfg.vocab_size)
+        out = m(ids)
+        assert out.shape == [2, 16, cfg.vocab_size]
+
+    def test_param_count_7b(self):
+        cfg = LlamaConfig.llama2_7b()
+        n = count_params(cfg)
+        assert 6.5e9 < n < 7.0e9  # ≈6.74B
+
+    def test_loss_decreases(self):
+        _reset_fleet()
+        P.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = P.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = batch(cfg.vocab_size, b=4, s=32)
+        losses = []
+        for _ in range(8):
+            loss = crit(m(ids), ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_gqa(self):
+        _reset_fleet()
+        P.seed(0)
+        cfg = LlamaConfig.tiny(num_key_value_heads=2)
+        m = LlamaForCausalLM(cfg)
+        out = m(batch(cfg.vocab_size))
+        assert out.shape == [2, 16, cfg.vocab_size]
+
+    def test_tp_training_via_fleet(self):
+        _reset_fleet()
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        P.seed(0)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"mp_degree": 4, "dp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = LlamaConfig.tiny(tensor_parallel=True)
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = P.optimizer.AdamW(1e-3, parameters=m.parameters())
+        model = fleet.distributed_model(m)
+        ids = batch(cfg.vocab_size, b=4, s=32)
+        l0 = model.train_batch([ids], [ids], opt, crit)
+        l1 = model.train_batch([ids], [ids], opt, crit)
+        assert float(l1.numpy()) < float(l0.numpy())
+        # q weight sharded over mp
+        spec = m.llama.layers[0].self_attn.q_proj.weight._data.sharding.spec
+        assert "mp" in [s for s in spec if s is not None]
+
+    def test_zero3_training_via_fleet(self):
+        _reset_fleet()
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        P.seed(0)
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 3, "sharding_degree": 8}
+        strategy.hybrid_configs = {"sharding_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = P.optimizer.AdamW(1e-3, parameters=m.parameters())
+        model = fleet.distributed_model(m)
+        ids = batch(cfg.vocab_size, b=8, s=32)
+        l0 = model.train_batch([ids], [ids], opt, crit)
+        l1 = model.train_batch([ids], [ids], opt, crit)
+        assert float(l1.numpy()) < float(l0.numpy())
+
+
+class TestGPT:
+    def test_forward_and_train(self):
+        _reset_fleet()
+        P.seed(0)
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        ids = batch(cfg.vocab_size, b=4, s=32)
+        out = m(ids)
+        assert out.shape == [4, 32, cfg.vocab_size]
+        opt = P.optimizer.AdamW(1e-3, parameters=m.parameters())
+        losses = []
+        for _ in range(5):
+            logits = m(ids)
+            loss = nn.functional.cross_entropy(
+                logits[:, :-1].reshape([-1, cfg.vocab_size]),
+                ids[:, 1:].reshape([-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestBert:
+    def test_classification(self):
+        _reset_fleet()
+        P.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForSequenceClassification(cfg)
+        ids = batch(cfg.vocab_size, b=4, s=24)
+        mask = P.ones([4, 24], dtype="int32")
+        logits = m(ids, attention_mask=mask)
+        assert logits.shape == [4, 2]
+
+    def test_amp_o2_fine_tune_step(self):
+        """Config-2 pattern: BERT AMP-O2 training step."""
+        _reset_fleet()
+        P.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForSequenceClassification(cfg)
+        opt = P.optimizer.AdamW(1e-4, parameters=m.parameters())
+        model, opt = P.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+        scaler = P.amp.GradScaler()
+        ids = batch(cfg.vocab_size, b=4, s=24)
+        labels = P.to_tensor(np.array([0, 1, 0, 1], np.int32))
+        losses = []
+        for _ in range(5):
+            with P.amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = model(ids)
+                loss = nn.functional.cross_entropy(
+                    logits.astype("float32"), labels)
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
