@@ -1,0 +1,114 @@
+"""Distributed checkpoint with automatic resharding.
+
+Reference parity: paddle.distributed.checkpoint.save_state_dict /
+load_state_dict (upstream python/paddle/distributed/checkpoint/ —
+unverified, see SURVEY.md §5.4): every rank writes its local shards plus
+global metadata; load reshards automatically when the mesh/degrees change.
+
+TPU-native: orbax/tensorstore is the shard store — jax global arrays
+already know their sharding, orbax writes per-shard OCDBT chunks, and
+restoring with a DIFFERENT NamedSharding performs the reshard (this is
+the mechanism the reference implements by hand with shard-merging logic).
+Falls back to a numpy .npz full-gather format when orbax is unavailable.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+
+def _to_arrays(state_dict):
+    flat = {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            flat[k] = v._data
+        elif isinstance(v, (int, float)):
+            flat[k] = np.asarray(v)
+        elif isinstance(v, dict):
+            for k2, v2 in _to_arrays(v).items():
+                flat[f"{k}.{k2}"] = v2
+        else:
+            flat[k] = np.asarray(v)
+    return flat
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    os.makedirs(path, exist_ok=True)
+    arrays = _to_arrays(state_dict)
+    meta = {k: {"shape": list(np.shape(a)),
+                "dtype": str(np.asarray(jax.device_get(a)).dtype
+                             if not isinstance(a, np.ndarray) else a.dtype)}
+            for k, a in arrays.items()}
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(os.path.abspath(path), "arrays"), arrays,
+                   force=True)
+        backend = "orbax"
+    except Exception:
+        np.savez(os.path.join(path, "arrays.npz"),
+                 **{k: np.asarray(jax.device_get(a))
+                    for k, a in arrays.items()})
+        backend = "npz"
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump({"backend": backend, "arrays": meta}, f)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None,
+                    offload=False):
+    """In-place restore into `state_dict`'s tensors; each tensor keeps its
+    CURRENT sharding — restoring onto a different mesh/degree reshards."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+
+    flat_targets = {}
+
+    def walk(d, prefix=""):
+        for k, v in d.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, Tensor):
+                flat_targets[key] = v
+            elif isinstance(v, dict):
+                walk(v, key + ".")
+    walk(state_dict)
+
+    if meta["backend"] == "orbax":
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        restore_args = {}
+        for k, t in flat_targets.items():
+            sharding = getattr(t._data, "sharding", None)
+            restore_args[k] = ocp.ArrayRestoreArgs(sharding=sharding) \
+                if sharding is not None and hasattr(
+                    sharding, "mesh") else ocp.RestoreArgs()
+        restored = ckptr.restore(
+            os.path.join(os.path.abspath(path), "arrays"),
+            restore_args=restore_args)
+    else:
+        data = np.load(os.path.join(path, "arrays.npz"))
+        restored = {k: data[k] for k in data.files}
+
+    missing = []
+    for k, t in flat_targets.items():
+        if k not in restored:
+            missing.append(k)
+            continue
+        arr = restored[k]
+        sharding = getattr(t._data, "sharding", None)
+        new = jax.numpy.asarray(arr).astype(t._data.dtype)
+        if sharding is not None and hasattr(sharding, "mesh"):
+            new = jax.device_put(new, sharding)  # reshard to live layout
+        t._inplace_update(new)
+    return missing

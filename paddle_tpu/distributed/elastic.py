@@ -1,0 +1,93 @@
+"""Elastic training manager.
+
+Reference parity: fleet.elastic.ElasticManager — etcd node registration,
+heartbeat leases, membership watch, rank reassignment, restart hooks
+(upstream python/paddle/distributed/fleet/elastic/ — unverified, see
+SURVEY.md §5.3).
+
+TPU-native: the KV/lease role of etcd is played by the framework's
+TCPStore (C++-backed, see paddle_tpu/core/native) or any dict-like store;
+liveness = heartbeat keys with TTL; on membership change the manager
+recomputes ranks and signals the launcher to restart from the latest
+checkpoint (orbax auto-resume).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, node_id=None, np_range=(1, 1),
+                 heartbeat_interval=2.0, ttl=6.0):
+        self.store = store  # needs set/get/delete/keys
+        self.node_id = node_id or os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", f"node-{os.getpid()}")
+        self.min_np, self.max_np = np_range
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_members: list[str] = []
+        self.on_change = None  # callback(new_members)
+
+    # -- membership ---------------------------------------------------------
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(f"heartbeat/{self.node_id}",
+                       str(time.time()).encode())
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            members = self.members()
+            if self._last_members and members != self._last_members:
+                if self.on_change is not None:
+                    self.on_change(members)
+            self._last_members = members
+            self._stop.wait(self.interval)
+
+    def members(self):
+        now = time.time()
+        out = []
+        for k in self.store.keys():
+            if not k.startswith("heartbeat/"):
+                continue
+            try:
+                ts = float(self.store.get(k).decode())
+            except Exception:
+                continue
+            if now - ts <= self.ttl:
+                out.append(k.split("/", 1)[1])
+        return sorted(out)
+
+    def rank_of(self, node_id=None):
+        m = self.members()
+        nid = node_id or self.node_id
+        return m.index(nid) if nid in m else -1
+
+    def health(self):
+        n = len(self.members())
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    def exit(self):
+        self._stop.set()
+        try:
+            self.store.delete(f"heartbeat/{self.node_id}")
+        except Exception:
+            pass

@@ -1,0 +1,13 @@
+"""paddle_tpu.distributed.launch — the process launcher.
+
+Reference parity: `python -m paddle.distributed.launch train.py`
+(upstream python/paddle/distributed/launch/ — unverified, see SURVEY.md
+§3.5): builds Job/Pod/Container model, spawns one process per (host),
+injects the PADDLE_* env protocol, aggregates logs, watches/restarts.
+
+TPU-native: one process drives all local chips (SPMD), so local "nproc
+per device" collapses to ONE container per host; multi-host rendezvous
+uses the jax.distributed coordination service (PADDLE_MASTER endpoint).
+The watcher implements elastic_level-style restart of failed containers.
+"""
+from .main import launch, main  # noqa: F401

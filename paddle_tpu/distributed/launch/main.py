@@ -1,0 +1,122 @@
+"""Launcher implementation (see package docstring)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class Container:
+    """One managed child process (reference: launch Job/Pod/Container)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(self.cmd, env=self.env,
+                                     stdout=self._log, stderr=self._log)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def build_env(rank, nnodes, master, base_env=None):
+    env = dict(base_env or os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nnodes),
+        "PADDLE_MASTER": master or "",
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8100 + rank}",
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"127.0.0.1:{8100 + r}" for r in range(nnodes)),
+        "PADDLE_RANK_IN_NODE": "0",
+    })
+    return env
+
+
+def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
+           max_restarts=0, elastic_level=0, run_mode="collective"):
+    """Spawn nnodes containers of `script` with the env protocol; watch &
+    restart per elastic_level (0: fail job; >=1: restart failed rank)."""
+    containers = []
+    for rank in range(nnodes):
+        cmd = [sys.executable, script, *script_args]
+        env = build_env(rank, nnodes, master)
+        c = Container(cmd, env, os.path.join(log_dir,
+                                             f"workerlog.{rank}"))
+        c.start()
+        containers.append(c)
+
+    def shutdown(*_):
+        for c in containers:
+            c.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    while True:
+        alive = 0
+        for rank, c in enumerate(containers):
+            rc = c.poll()
+            if rc is None:
+                alive += 1
+            elif rc != 0:
+                if elastic_level >= 1 and c.restarts < max_restarts:
+                    c.restarts += 1
+                    print(f"[launch] rank {rank} exited {rc}; restart "
+                          f"{c.restarts}/{max_restarts}", flush=True)
+                    c.start()
+                    alive += 1
+                else:
+                    print(f"[launch] rank {rank} failed with {rc}; "
+                          f"terminating job", flush=True)
+                    for other in containers:
+                        other.terminate()
+                    return rc
+        if alive == 0:
+            return 0
+        time.sleep(1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--master", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for reference-CLI parity; SPMD drives all "
+                        "local chips from one process")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    return launch(args.script, args.script_args, nnodes=args.nnodes,
+                  master=args.master, log_dir=args.log_dir,
+                  max_restarts=args.max_restarts,
+                  elastic_level=args.elastic_level,
+                  run_mode=args.run_mode)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

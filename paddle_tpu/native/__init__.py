@@ -1,0 +1,211 @@
+"""Native (C++) runtime components + ctypes bindings.
+
+Reference parity: the native runtime around the compute path — TCPStore
+rendezvous (paddle/fluid/distributed/store/) and DataLoader worker core
+(SURVEY.md §2.1/§2.2) — re-designed in compact C++17, built on demand with
+g++ (no pybind11 in this image; bindings are ctypes over a C ABI).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+def _build(src: str, out: str) -> str:
+    src_path = os.path.join(_DIR, src)
+    out_path = os.path.join(_DIR, out)
+    with _BUILD_LOCK:
+        if (not os.path.exists(out_path) or
+                os.path.getmtime(out_path) < os.path.getmtime(src_path)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src_path, "-o", out_path]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return out_path
+
+
+def _load(src, out):
+    return ctypes.CDLL(_build(src, out))
+
+
+# --------------------------------------------------------------------------
+# TCPStore
+
+
+class TCPStore:
+    """Reference parity: paddle.distributed's TCPStore rendezvous KV.
+
+    is_master=True starts the in-process master daemon; every instance is
+    also a client. Values are bytes; `add` is an atomic int64 counter —
+    the primitive barrier/rendezvous building block.
+    """
+
+    _lib = None
+
+    @classmethod
+    def lib(cls):
+        if cls._lib is None:
+            lib = _load("tcp_store.cpp", "libpd_store.so")
+            lib.pd_store_server_start.restype = ctypes.c_void_p
+            lib.pd_store_server_start.argtypes = [ctypes.c_int]
+            lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]
+            lib.pd_store_client_new.restype = ctypes.c_void_p
+            lib.pd_store_client_new.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int]
+            lib.pd_store_client_free.argtypes = [ctypes.c_void_p]
+            lib.pd_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_int]
+            lib.pd_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.pd_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.pd_store_keys.argtypes = [ctypes.c_void_p]
+            lib.pd_store_fetch.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p, ctypes.c_int]
+            lib.pd_store_delete.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p]
+            lib.pd_store_add.restype = ctypes.c_longlong
+            lib.pd_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_longlong]
+            cls._lib = lib
+        return cls._lib
+
+    def __init__(self, host="127.0.0.1", port=23457, is_master=False,
+                 world_size=1, timeout=None):
+        lib = self.lib()
+        self._server = None
+        if is_master:
+            self._server = lib.pd_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore master failed to bind :{port}")
+        self._client = lib.pd_store_client_new(host.encode(), port)
+        if not self._client:
+            if self._server:
+                lib.pd_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore cannot connect {host}:{port}")
+
+    def set(self, key: str, value: bytes):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self.lib().pd_store_set(self._client, key.encode(), value,
+                                     len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def _fetch(self, n: int) -> bytes:
+        buf = ctypes.create_string_buffer(n)
+        self.lib().pd_store_fetch(self._client, buf, n)
+        return buf.raw[:n]
+
+    def get(self, key: str) -> bytes:
+        n = self.lib().pd_store_get(self._client, key.encode())
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return self._fetch(n)
+
+    def wait(self, key: str) -> bytes:
+        n = self.lib().pd_store_wait(self._client, key.encode())
+        if n < 0:
+            raise RuntimeError("TCPStore.wait failed")
+        return self._fetch(n)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return int(self.lib().pd_store_add(self._client, key.encode(),
+                                           delta))
+
+    def delete(self, key: str):
+        self.lib().pd_store_delete(self._client, key.encode())
+
+    def keys(self):
+        n = self.lib().pd_store_keys(self._client)
+        if n < 0:
+            raise RuntimeError("TCPStore.keys failed")
+        raw = self._fetch(n).decode()
+        return [k for k in raw.split("\n") if k]
+
+    def close(self):
+        lib = self.lib()
+        if self._client:
+            lib.pd_store_client_free(self._client)
+            self._client = None
+        if self._server:
+            lib.pd_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Token loader
+
+
+class TokenLoader:
+    """C++ mmap+prefetch reader of flat token binaries → [B, S+1] int32
+    batches (LLM pretraining input pipeline; see data_loader.cpp)."""
+
+    _lib = None
+
+    @classmethod
+    def lib(cls):
+        if cls._lib is None:
+            lib = _load("data_loader.cpp", "libpd_loader.so")
+            lib.pd_loader_new.restype = ctypes.c_void_p
+            lib.pd_loader_new.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+                ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong,
+                ctypes.c_int]
+            lib.pd_loader_num_windows.restype = ctypes.c_longlong
+            lib.pd_loader_num_windows.argtypes = [ctypes.c_void_p]
+            lib.pd_loader_next.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(dtype=np.int32, flags="C")]
+            lib.pd_loader_free.argtypes = [ctypes.c_void_p]
+            cls._lib = lib
+        return cls._lib
+
+    def __init__(self, path, seq_len, batch_size, num_workers=2,
+                 prefetch=4, seed=0, dtype="uint16"):
+        dtype_size = np.dtype(dtype).itemsize
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self._h = self.lib().pd_loader_new(
+            str(path).encode(), seq_len, batch_size, num_workers, prefetch,
+            seed, dtype_size)
+        if not self._h:
+            raise RuntimeError(f"TokenLoader cannot open {path}")
+
+    @property
+    def num_windows(self):
+        return int(self.lib().pd_loader_num_windows(self._h))
+
+    def next(self):
+        out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+        rc = self.lib().pd_loader_next(self._h, out)
+        if rc != 0:
+            raise StopIteration
+        return out
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self._h:
+            self.lib().pd_loader_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,181 @@
+// Prefetching token-dataset reader.
+//
+// Reference parity: the DataLoader's native worker/prefetch machinery
+// (upstream C++ reader ops + multiprocess workers — see SURVEY.md §2.2
+// "Data"). TPU-native redesign: LLM pretraining reads fixed-length token
+// windows from a flat binary token file; this module mmaps the file and
+// runs a worker-thread pipeline that materializes [batch, seq_len+1]
+// int32 batches into a bounded ring buffer so the accelerator never waits
+// on host IO.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread data_loader.cpp -o libpd_loader.so
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<int32_t> data;
+};
+
+class TokenLoader {
+ public:
+  TokenLoader(const char* path, int64_t seq_len, int64_t batch_size,
+              int n_workers, int queue_cap, uint64_t seed, int dtype_size)
+      : seq_len_(seq_len),
+        batch_size_(batch_size),
+        cap_(queue_cap),
+        dtype_size_(dtype_size) {
+    fd_ = ::open(path, O_RDONLY);
+    if (fd_ < 0) return;
+    struct stat st {};
+    ::fstat(fd_, &st);
+    bytes_ = static_cast<size_t>(st.st_size);
+    base_ = static_cast<const uint8_t*>(
+        ::mmap(nullptr, bytes_, PROT_READ, MAP_PRIVATE, fd_, 0));
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      return;
+    }
+    ::madvise(const_cast<uint8_t*>(base_), bytes_, MADV_SEQUENTIAL);
+    n_tokens_ = static_cast<int64_t>(bytes_ / dtype_size_);
+    n_windows_ = n_tokens_ / (seq_len_ + 1);
+    running_.store(true);
+    rng_.seed(seed);
+    for (int i = 0; i < n_workers; ++i)
+      workers_.emplace_back([this] { worker_loop(); });
+  }
+
+  bool ok() const { return base_ != nullptr && n_windows_ > 0; }
+  int64_t num_windows() const { return n_windows_; }
+
+  // Blocks until a batch is ready; copies into out[batch, seq_len+1] i32.
+  bool next(int32_t* out) {
+    std::unique_lock<std::mutex> g(mu_);
+    cv_pop_.wait(g, [&] { return !queue_.empty() || !running_.load(); });
+    if (queue_.empty()) return false;
+    Batch b = std::move(queue_.front());
+    queue_.pop_front();
+    g.unlock();
+    cv_push_.notify_one();
+    std::memcpy(out, b.data.data(), b.data.size() * sizeof(int32_t));
+    return true;
+  }
+
+  void stop() {
+    running_.store(false);
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  ~TokenLoader() {
+    stop();
+    if (base_) ::munmap(const_cast<uint8_t*>(base_), bytes_);
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int64_t draw_window() {
+    std::lock_guard<std::mutex> g(rng_mu_);
+    return static_cast<int64_t>(rng_() % static_cast<uint64_t>(n_windows_));
+  }
+
+  int32_t token_at(int64_t idx) const {
+    const uint8_t* p = base_ + idx * dtype_size_;
+    switch (dtype_size_) {
+      case 2: {
+        uint16_t v;
+        std::memcpy(&v, p, 2);
+        return static_cast<int32_t>(v);
+      }
+      case 4: {
+        int32_t v;
+        std::memcpy(&v, p, 4);
+        return v;
+      }
+      default: {
+        return static_cast<int32_t>(*p);
+      }
+    }
+  }
+
+  void worker_loop() {
+    const int64_t window = seq_len_ + 1;
+    while (running_.load()) {
+      Batch b;
+      b.data.resize(batch_size_ * window);
+      for (int64_t i = 0; i < batch_size_; ++i) {
+        int64_t w = draw_window();
+        int64_t start = w * window;
+        for (int64_t t = 0; t < window; ++t)
+          b.data[i * window + t] = token_at(start + t);
+      }
+      std::unique_lock<std::mutex> g(mu_);
+      cv_push_.wait(g, [&] {
+        return queue_.size() < static_cast<size_t>(cap_) ||
+               !running_.load();
+      });
+      if (!running_.load()) return;
+      queue_.push_back(std::move(b));
+      g.unlock();
+      cv_pop_.notify_one();
+    }
+  }
+
+  int64_t seq_len_, batch_size_, cap_;
+  int dtype_size_;
+  int fd_ = -1;
+  size_t bytes_ = 0;
+  const uint8_t* base_ = nullptr;
+  int64_t n_tokens_ = 0, n_windows_ = 0;
+  std::atomic<bool> running_{false};
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<Batch> queue_;
+  std::mt19937_64 rng_;
+  std::mutex rng_mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pd_loader_new(const char* path, long long seq_len,
+                    long long batch_size, int n_workers, int queue_cap,
+                    unsigned long long seed, int dtype_size) {
+  auto* l = new TokenLoader(path, seq_len, batch_size, n_workers,
+                            queue_cap, seed, dtype_size);
+  if (!l->ok()) {
+    delete l;
+    return nullptr;
+  }
+  return l;
+}
+
+long long pd_loader_num_windows(void* h) {
+  return static_cast<TokenLoader*>(h)->num_windows();
+}
+
+int pd_loader_next(void* h, int32_t* out) {
+  return static_cast<TokenLoader*>(h)->next(out) ? 0 : -1;
+}
+
+void pd_loader_free(void* h) { delete static_cast<TokenLoader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,414 @@
+// TCPStore — rendezvous key-value store.
+//
+// Reference parity: the TCPStore/MasterDaemon rendezvous KV used by
+// init_parallel_env and the elastic manager (upstream
+// paddle/fluid/distributed/store/tcp_store.cc — unverified, see SURVEY.md
+// §2.1). Re-designed, not translated: a compact single-file C++17
+// implementation with a blocking master daemon thread, length-prefixed
+// binary protocol, and a C ABI consumed from Python via ctypes (this
+// image has no pybind11).
+//
+// Protocol: [u8 op][u32 klen][key][u32 vlen][value] -> [u32 len][payload]
+//   op: 1=SET 2=GET 3=DEL 4=ADD(i64 delta; returns new value) 5=KEYS
+//       6=WAIT(key; blocks until set) 7=PING
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread tcp_store.cpp -o libpd_store.so
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, DEL = 3, ADD = 4, KEYS = 5,
+                    WAIT = 6, PING = 7 };
+
+bool read_all(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_u32(int fd, uint32_t* v) {
+  uint32_t net;
+  if (!read_all(fd, &net, 4)) return false;
+  *v = ntohl(net);
+  return true;
+}
+
+bool write_u32(int fd, uint32_t v) {
+  uint32_t net = htonl(v);
+  return write_all(fd, &net, 4);
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_u32(fd, &len)) return false;
+  out->resize(len);
+  return len == 0 || read_all(fd, out->data(), len);
+}
+
+bool write_blob(int fd, const std::string& s) {
+  return write_u32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || write_all(fd, s.data(), s.size()));
+}
+
+class MasterDaemon {
+ public:
+  explicit MasterDaemon(int port) : port_(port) {}
+
+  bool start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    if (::listen(listen_fd_, 64) != 0) return false;
+    running_.store(true);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void stop() {
+    running_.store(false);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    cv_.notify_all();
+    {
+      // unblock serve() threads parked in read() on live connections
+      std::lock_guard<std::mutex> g(fds_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> g(workers_mu_);
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  ~MasterDaemon() { stop(); }
+
+ private:
+  void accept_loop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> g(fds_mu_);
+        client_fds_.push_back(fd);
+      }
+      std::lock_guard<std::mutex> g(workers_mu_);
+      workers_.emplace_back([this, fd] { serve(fd); });
+    }
+  }
+
+  void serve(int fd) {
+    while (running_.load()) {
+      uint8_t op;
+      if (!read_all(fd, &op, 1)) break;
+      std::string key, val;
+      if (op != PING && !read_blob(fd, &key)) break;
+      switch (op) {
+        case SET: {
+          if (!read_blob(fd, &val)) goto done;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            kv_[key] = val;
+          }
+          cv_.notify_all();
+          if (!write_blob(fd, "ok")) goto done;
+          break;
+        }
+        case GET: {
+          std::string out;
+          bool found;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = kv_.find(key);
+            found = it != kv_.end();
+            if (found) out = it->second;
+          }
+          if (!write_u32(fd, found ? 1 : 0)) goto done;
+          if (!write_blob(fd, out)) goto done;
+          break;
+        }
+        case DEL: {
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            kv_.erase(key);
+          }
+          if (!write_blob(fd, "ok")) goto done;
+          break;
+        }
+        case ADD: {
+          if (!read_blob(fd, &val)) goto done;
+          int64_t delta = 0;
+          std::memcpy(&delta, val.data(),
+                      std::min(val.size(), sizeof(delta)));
+          int64_t now;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            int64_t cur = 0;
+            auto it = kv_.find(key);
+            if (it != kv_.end() && it->second.size() == sizeof(int64_t))
+              std::memcpy(&cur, it->second.data(), sizeof(cur));
+            now = cur + delta;
+            std::string packed(sizeof(now), '\0');
+            std::memcpy(packed.data(), &now, sizeof(now));
+            kv_[key] = packed;
+          }
+          cv_.notify_all();
+          std::string packed(sizeof(now), '\0');
+          std::memcpy(packed.data(), &now, sizeof(now));
+          if (!write_blob(fd, packed)) goto done;
+          break;
+        }
+        case KEYS: {
+          std::string joined;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            for (auto& [k, _] : kv_) {
+              joined += k;
+              joined += '\n';
+            }
+          }
+          if (!write_blob(fd, joined)) goto done;
+          break;
+        }
+        case WAIT: {
+          std::unique_lock<std::mutex> g(mu_);
+          cv_.wait(g, [&] {
+            return !running_.load() || kv_.count(key) > 0;
+          });
+          std::string out = kv_.count(key) ? kv_[key] : "";
+          g.unlock();
+          if (!write_blob(fd, out)) goto done;
+          break;
+        }
+        case PING: {
+          if (!write_blob(fd, "pong")) goto done;
+          break;
+        }
+        default:
+          goto done;
+      }
+    }
+  done:
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      client_fds_.erase(
+          std::remove(client_fds_.begin(), client_fds_.end(), fd),
+          client_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::mutex fds_mu_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) != 0) {
+      ::close(fd_);
+      fd_ = -1;
+    } else {
+      int one = 1;
+      ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  bool set(const std::string& k, const std::string& v) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = SET;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, k) ||
+        !write_blob(fd_, v))
+      return false;
+    std::string ack;
+    return read_blob(fd_, &ack);
+  }
+
+  bool get(const std::string& k, std::string* out, bool* found) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = GET;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, k)) return false;
+    uint32_t f;
+    if (!read_u32(fd_, &f)) return false;
+    *found = f != 0;
+    return read_blob(fd_, out);
+  }
+
+  bool del(const std::string& k) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = DEL;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, k)) return false;
+    std::string ack;
+    return read_blob(fd_, &ack);
+  }
+
+  bool add(const std::string& k, int64_t delta, int64_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = ADD;
+    std::string packed(sizeof(delta), '\0');
+    std::memcpy(packed.data(), &delta, sizeof(delta));
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, k) ||
+        !write_blob(fd_, packed))
+      return false;
+    std::string res;
+    if (!read_blob(fd_, &res) || res.size() != sizeof(int64_t))
+      return false;
+    std::memcpy(out, res.data(), sizeof(int64_t));
+    return true;
+  }
+
+  bool keys(std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = KEYS;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, "")) return false;
+    return read_blob(fd_, out);
+  }
+
+  bool wait(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = WAIT;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, k)) return false;
+    return read_blob(fd_, out);
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+thread_local std::string g_last_result;
+
+}  // namespace
+
+extern "C" {
+
+void* pd_store_server_start(int port) {
+  auto* d = new MasterDaemon(port);
+  if (!d->start()) {
+    delete d;
+    return nullptr;
+  }
+  return d;
+}
+
+void pd_store_server_stop(void* h) {
+  delete static_cast<MasterDaemon*>(h);
+}
+
+void* pd_store_client_new(const char* host, int port) {
+  auto* c = new Client(host, port);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pd_store_client_free(void* h) { delete static_cast<Client*>(h); }
+
+int pd_store_set(void* h, const char* key, const char* data, int len) {
+  return static_cast<Client*>(h)->set(key, std::string(data, len)) ? 0 : -1;
+}
+
+// returns length (>=0) and stashes payload; -1 = missing, -2 = error
+int pd_store_get(void* h, const char* key) {
+  bool found = false;
+  if (!static_cast<Client*>(h)->get(key, &g_last_result, &found)) return -2;
+  if (!found) return -1;
+  return static_cast<int>(g_last_result.size());
+}
+
+int pd_store_wait(void* h, const char* key) {
+  if (!static_cast<Client*>(h)->wait(key, &g_last_result)) return -2;
+  return static_cast<int>(g_last_result.size());
+}
+
+int pd_store_keys(void* h) {
+  if (!static_cast<Client*>(h)->keys(&g_last_result)) return -2;
+  return static_cast<int>(g_last_result.size());
+}
+
+void pd_store_fetch(void* h, char* out, int len) {
+  std::memcpy(out, g_last_result.data(),
+              std::min<size_t>(len, g_last_result.size()));
+}
+
+int pd_store_delete(void* h, const char* key) {
+  return static_cast<Client*>(h)->del(key) ? 0 : -1;
+}
+
+long long pd_store_add(void* h, const char* key, long long delta) {
+  int64_t out = 0;
+  if (!static_cast<Client*>(h)->add(key, delta, &out)) return -1;
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,211 @@
+"""paddle_tpu.profiler — profiling/tracing.
+
+Reference parity: paddle.profiler.Profiler with scheduler windows,
+RecordEvent spans, export_chrome_tracing, summary tables, throughput timer
+(upstream python/paddle/profiler/ + C++ host/CUPTI tracers — unverified,
+see SURVEY.md §5.1).
+
+TPU-native: device timeline comes from `jax.profiler` (XPlane → perfetto/
+TensorBoard — the CUPTI-equivalent); host spans from
+jax.profiler.TraceAnnotation + a lightweight in-process event table that
+powers `summary()`.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Returns fn(step)->ProfilerState over cyclic windows."""
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+_events: list[dict] = []
+_event_stack: list = []
+
+
+class RecordEvent:
+    """Host-side span; nests; feeds summary() and chrome export."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        _event_stack.append(self)
+
+    def end(self):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        if _event_stack and _event_stack[-1] is self:
+            _event_stack.pop()
+        _events.append({"name": self.name, "ts": self._t0 / 1e3,
+                        "dur": (t1 - self._t0) / 1e3, "ph": "X",
+                        "pid": os.getpid(), "tid": 0})
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0],
+                           closed=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else
+            (lambda step: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._jax_tracing = False
+        self._logdir = None
+        self._timer_only = timer_only
+        self._step_times: list[float] = []
+        self._t_last = None
+
+    def start(self):
+        _events.clear()
+        self._state = self._scheduler(self._step)
+        self._maybe_toggle()
+        self._t_last = time.perf_counter()
+
+    def stop(self):
+        if self._jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        self._step += 1
+        new_state = self._scheduler(self._step)
+        if new_state != self._state:
+            self._state = new_state
+            self._maybe_toggle()
+
+    def _maybe_toggle(self):
+        want = self._state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN)
+        if want and not self._jax_tracing and not self._timer_only:
+            self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                          "/tmp/paddle_tpu_profile")
+            try:
+                jax.profiler.start_trace(self._logdir)
+                self._jax_tracing = True
+            except Exception:
+                pass
+        elif not want and self._jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    def export_chrome_tracing(self, dir_name, worker_name=None):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name,
+                            (worker_name or "worker") + ".json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": _events}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg = defaultdict(lambda: [0.0, 0])
+        for e in _events:
+            agg[e["name"]][0] += e["dur"] / 1e3
+            agg[e["name"]][1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}",
+                 "-" * 72]
+        for name, (total, calls) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{calls:>8}{total:>12.3f}"
+                         f"{total / calls:>12.3f}")
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            lines.append(f"steps: {len(self._step_times)}  avg "
+                         f"{avg * 1e3:.2f} ms  ips {1.0 / avg:.2f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof.export_chrome_tracing(dir_name, worker_name)
+    return handler
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,199 @@
+"""Aux subsystem tests: native TCPStore + TokenLoader, distributed
+checkpoint resharding, profiler, launcher env protocol, elastic manager,
+check_nan_inf flags."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+
+class TestNativeTCPStore:
+    def test_set_get_add_wait_keys(self):
+        from paddle_tpu.native import TCPStore
+        port = 23511
+        master = TCPStore(port=port, is_master=True)
+        client = TCPStore(port=port)
+        master.set("alpha", b"1")
+        assert client.get("alpha") == b"1"
+        assert client.add("cnt", 5) == 5
+        assert master.add("cnt", 2) == 7
+        client.set("beta", b"x")
+        assert sorted(master.keys()) == ["alpha", "beta", "cnt"]
+        assert client.wait("alpha") == b"1"
+        master.delete("alpha")
+        with pytest.raises(KeyError):
+            client.get("alpha")
+        client.close()
+        master.close()
+
+    def test_rendezvous_pattern(self):
+        from paddle_tpu.native import TCPStore
+        port = 23512
+        master = TCPStore(port=port, is_master=True)
+        # two "ranks" register and barrier via counter
+        r0 = TCPStore(port=port)
+        r1 = TCPStore(port=port)
+        assert r0.add("barrier", 1) == 1
+        assert r1.add("barrier", 1) == 2
+        for c in (r0, r1, master):
+            c.close()
+
+
+class TestNativeTokenLoader:
+    def test_batches(self, tmp_path):
+        from paddle_tpu.native import TokenLoader
+        tokens = np.arange(10000, dtype=np.uint16)
+        path = tmp_path / "tokens.bin"
+        tokens.tofile(path)
+        loader = TokenLoader(path, seq_len=31, batch_size=4,
+                             num_workers=2, seed=1)
+        assert loader.num_windows == 10000 // 32
+        b = loader.next()
+        assert b.shape == (4, 32)
+        # each row is a contiguous window
+        for row in b:
+            assert np.array_equal(row, np.arange(row[0], row[0] + 32))
+        loader.close()
+
+    def test_throughput_many_batches(self, tmp_path):
+        from paddle_tpu.native import TokenLoader
+        tokens = np.random.randint(0, 65535, 200000).astype(np.uint16)
+        path = tmp_path / "big.bin"
+        tokens.tofile(path)
+        loader = TokenLoader(path, seq_len=127, batch_size=8,
+                             num_workers=3)
+        for _ in range(50):
+            b = loader.next()
+            assert b.shape == (8, 128)
+        loader.close()
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        sd = net.state_dict()
+        path = str(tmp_path / "ckpt")
+        save_state_dict(sd, path)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        missing = load_state_dict(net2.state_dict(), path)
+        assert not missing
+        for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                      net2.named_parameters()):
+            assert np.allclose(p1.numpy(), p2.numpy())
+
+    def test_reshard_on_load(self, tmp_path):
+        """Save replicated → load onto a sharded mesh layout."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pp
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+        net = nn.Linear(8, 16, bias_attr=False)
+        ref = net.weight.numpy().copy()
+        path = str(tmp_path / "ckpt2")
+        save_state_dict(net.state_dict(), path)
+
+        net2 = nn.Linear(8, 16, bias_attr=False)
+        mesh = Mesh(np.array(jax.devices()), ("x",))
+        net2.weight._data = jax.device_put(
+            net2.weight._data, NamedSharding(mesh, Pp(None, "x")))
+        load_state_dict(net2.state_dict(), path)
+        assert np.allclose(net2.weight.numpy(), ref)
+        spec = net2.weight._data.sharding.spec
+        assert "x" in [s for s in spec if s is not None]
+
+
+class TestProfiler:
+    def test_record_events_and_summary(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, RecordEvent
+        prof = Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            with RecordEvent("forward"):
+                time.sleep(0.002)
+            with RecordEvent("backward"):
+                time.sleep(0.001)
+            prof.step()
+        prof.stop()
+        out = prof.summary()
+        assert "forward" in out and "backward" in out
+        path = prof.export_chrome_tracing(str(tmp_path))
+        data = json.load(open(path))
+        assert len(data["traceEvents"]) == 6
+
+    def test_scheduler_windows(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(5)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[2] == ProfilerState.RECORD
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+        assert states[4] == ProfilerState.CLOSED
+
+
+class TestLauncher:
+    def test_env_protocol_and_restart(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import launch
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "n = os.environ['PADDLE_TRAINERS_NUM']\n"
+            "print(f'rank={rank}/{n}', flush=True)\n"
+            "marker = f'/tmp/pd_launch_test_{rank}'\n"
+            "if rank == '1' and not os.path.exists(marker):\n"
+            "    open(marker, 'w').close()\n"
+            "    sys.exit(3)\n"
+            "sys.exit(0)\n")
+        marker = "/tmp/pd_launch_test_1"
+        if os.path.exists(marker):
+            os.unlink(marker)
+        rc = launch(str(script), nnodes=2, log_dir=str(tmp_path / "logs"),
+                    max_restarts=1, elastic_level=1)
+        assert rc == 0  # rank 1 failed once, was restarted, then passed
+        log0 = (tmp_path / "logs" / "workerlog.0").read_text()
+        assert "rank=0/2" in log0
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+class TestElastic:
+    def test_membership_and_ranks(self):
+        from paddle_tpu.distributed.elastic import ElasticManager
+        from paddle_tpu.native import TCPStore
+        port = 23513
+        master = TCPStore(port=port, is_master=True)
+        m1 = ElasticManager(TCPStore(port=port), node_id="a",
+                            heartbeat_interval=0.05, ttl=1.0)
+        m2 = ElasticManager(TCPStore(port=port), node_id="b",
+                            heartbeat_interval=0.05, ttl=1.0)
+        m1.register()
+        m2.register()
+        time.sleep(0.2)
+        assert m1.members() == ["a", "b"]
+        assert m1.rank_of("a") == 0 and m1.rank_of("b") == 1
+        m2.exit()
+        time.sleep(0.2)
+        assert m1.members() == ["a"]
+        m1.exit()
+        master.close()
+
+
+class TestNanInfCheck:
+    def test_flag_toggles_debug_nans(self):
+        import jax
+        P.set_flags({"FLAGS_check_nan_inf": True})
+        assert jax.config.jax_debug_nans
+        with pytest.raises(Exception):
+            (P.to_tensor([0.0]) / P.to_tensor([0.0])).numpy()
+        P.set_flags({"FLAGS_check_nan_inf": False})
+        assert not jax.config.jax_debug_nans
