@@ -1,0 +1,158 @@
+"""Long-context / sequence-axis attention parallelism.
+
+Reference parity (SURVEY.md §5.7): the SEP mesh axis with (b) Ulysses-style
+alltoall head/sequence re-partition and (c) ring/blockwise attention for
+context parallelism (reference ecosystem: PaddleNLP atop the sep axis).
+
+TPU-native design:
+- `ulysses_attention`: inside shard_map with the sep axis live, tokens are
+  sequence-sharded [B, S/n, H, D]; `all_to_all` re-partitions to
+  head-sharded [B, S, H/n, D], the full-sequence attention core runs
+  per-head (Pallas/XLA), and a second all_to_all restores sequence
+  sharding. Two alltoalls ride ICI — exactly the reference mechanism.
+- `ring_flash_attention`: K/V blocks rotate around the sep ring via
+  `ppermute` while each step merges partial attention with the numerically
+  stable online-softmax (log-sum-exp) combine; causal masking compares
+  global block offsets. The loop is a `lax.scan` with jax.checkpoint, so
+  backward re-runs the ring — activation memory stays O(S/n).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+from .._axis import current_axis_env
+from .topology import get_hybrid_communicate_group
+
+
+def _sep_group():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_sep_parallel_group() if hcg is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Ulysses
+
+
+def ulysses_attention(q, k, v, group=None, causal=False, scale=None):
+    """q,k,v: [B, S_local, H, D] sequence-sharded over the sep axis."""
+    group = group if group is not None else _sep_group()
+    from ...ops.pallas.flash_attention import _attention_ref
+
+    if group is None or group.axis_name not in current_axis_env():
+        return apply(lambda qa, ka, va: _attention_ref(qa, ka, va,
+                                                       causal=causal,
+                                                       scale=scale),
+                     q, k, v, name="attention")
+    ax = group.axis_name
+    n = group.nranks
+
+    def f(qa, ka, va):
+        def seq2head(x):
+            # [B, S/n, H, D] → [B, S, H/n, D]
+            b, sl, h, d = x.shape
+            x = x.reshape(b, sl, n, h // n, d)   # split head groups
+            x = jnp.moveaxis(x, 2, 0)            # [n, B, S/n, H/n, D]
+            # send head-group i to rank i; receive my group's seq block
+            # from every rank → leading dim indexes the SOURCE rank
+            x = jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                   tiled=False)
+            x = jnp.moveaxis(x, 0, 1)            # [B, n(block), S/n, ...]
+            return x.reshape(b, n * sl, h // n, d)  # block-major sequence
+
+        def head2seq(x):
+            # [B, S, H/n, D] → [B, S/n, H, D]
+            b, s, hn, d = x.shape
+            sl = s // n
+            x = x.reshape(b, n, sl, hn, d)       # block-major seq split
+            x = jnp.moveaxis(x, 1, 0)            # [n, B, S/n, H/n, D]
+            # send seq block i to rank i; leading dim → source head group
+            x = jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                   tiled=False)
+            x = jnp.moveaxis(x, 0, 2)            # [B, S/n, n(group), ...]
+            return x.reshape(b, sl, n * hn, d)
+
+        qh, kh, vh = seq2head(qa), seq2head(ka), seq2head(va)
+        out = _attention_ref(qh, kh, vh, causal=causal, scale=scale)
+        return head2seq(out)
+    return apply(f, q, k, v, name="ulysses_attention")
+
+
+# ---------------------------------------------------------------------------
+# Ring flash attention
+
+
+def _ring_attention_core(qa, ka, va, ax, n, causal, scale):
+    """Online-softmax ring attention over axis `ax` (n ranks).
+    qa/ka/va: local [B, S/n, H, D]."""
+    d = qa.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    my_idx = jax.lax.axis_index(ax)
+    sl = qa.shape[1]
+    q32 = qa.astype(jnp.float32)
+
+    def step(carry, i):
+        kv, acc, m_run, l_run = carry
+        k_blk, v_blk = kv
+        src = (my_idx - i) % n  # which rank's block we now hold
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * s
+        if causal:
+            qpos = my_idx * sl + jnp.arange(sl)
+            kpos = src * sl + jnp.arange(sl)
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        m_blk = jnp.max(logits, axis=-1)                  # [B,H,Q]
+        m_new = jnp.maximum(m_run, m_blk)
+        # guard fully-masked blocks (all -inf)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_run),
+                         jnp.exp(m_run - safe_m), 0.0)
+        l_new = l_run * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p,
+                        v_blk.astype(jnp.float32))
+        acc = acc * jnp.moveaxis(corr, 1, 2)[..., None] + pv
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = jax.lax.ppermute(k_blk, ax, perm)
+        v_next = jax.lax.ppermute(v_blk, ax, perm)
+        return ((k_next, v_next), acc, m_new, l_new), None
+
+    b, _, h, _ = qa.shape
+    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    # mark the carries as device-varying over the ring axis (shard_map VMA)
+    try:
+        pcast = jax.lax.pcast
+        acc0, m0, l0 = (pcast(t, (ax,), to="varying")
+                        for t in (acc0, m0, l0))
+    except AttributeError:
+        pass
+    carry0 = ((ka, va), acc0, m0, l0)
+    step_ck = jax.checkpoint(step)
+    (kv, acc, m_run, l_run), _ = jax.lax.scan(step_ck, carry0,
+                                              jnp.arange(n))
+    denom = jnp.moveaxis(jnp.maximum(l_run, 1e-30), 1, 2)[..., None]
+    return (acc / denom).astype(qa.dtype)
+
+
+def ring_flash_attention(q, k, v, group=None, causal=True, scale=None):
+    """Ring attention over the sep axis; eager fallback = full attention."""
+    group = group if group is not None else _sep_group()
+    from ...ops.pallas.flash_attention import _attention_ref
+
+    if group is None or group.axis_name not in current_axis_env():
+        return apply(lambda qa, ka, va: _attention_ref(
+            qa, ka, va, causal=causal, scale=scale), q, k, v,
+            name="attention")
+    ax = group.axis_name
+    n = group.nranks
+    return apply(functools.partial(_ring_attention_core, ax=ax, n=n,
+                                   causal=causal, scale=scale),
+                 q, k, v, name="ring_flash_attention")

@@ -1,0 +1,160 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference parity: the incubate MoE stack — gates (GShard/Switch top-k),
+`global_scatter`/`global_gather` alltoall dispatch, expert-parallel groups
+(upstream python/paddle/incubate/distributed/models/moe/ — unverified, see
+SURVEY.md §2.3 "Expert parallel").
+
+TPU-native design: experts live as ONE stacked weight tensor [E, ...] whose
+expert dim carries a partition hint over the expert-parallel mesh axis;
+token dispatch is the GShard einsum formulation (dispatch/combine one-hot
+tensors with capacity), which the GSPMD partitioner lowers to the same
+all_to_all the reference issues by hand. The explicit shard_map path
+(`global_scatter`/`global_gather`) is provided for the collective-level
+API.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..distributed._axis import current_axis_env
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Reference API: alltoall dispatch of tokens to expert owners."""
+    if group is not None and group.axis_name in current_axis_env():
+        return apply(
+            lambda a: jax.lax.all_to_all(a, group.axis_name, 0, 0,
+                                         tiled=True), x,
+            name="global_scatter")
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None):
+    if group is not None and group.axis_name in current_axis_env():
+        return apply(
+            lambda a: jax.lax.all_to_all(a, group.axis_name, 0, 0,
+                                         tiled=True), x,
+            name="global_gather")
+    return x
+
+
+class TopKGate(Layer):
+    """GShard-style noisy top-k gate with load-balancing aux loss."""
+
+    def __init__(self, d_model, num_experts, top_k=2,
+                 capacity_factor=1.25, eval_capacity_factor=2.0,
+                 noisy_gate=True):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.noisy_gate = noisy_gate
+        self.weight = self.create_parameter(
+            (d_model, num_experts),
+            default_initializer=I.XavierUniform())
+
+    def forward(self, x):
+        return F.linear(x, self.weight)
+
+
+class MoELayer(Layer):
+    """paddle.incubate MoELayer parity: gate + expert FFNs + dispatch.
+
+    experts: stacked SwiGLU-free FFN (w_in [E, D, M], w_out [E, M, D]).
+    The aux load-balance loss is exposed as `self.l_aux` after forward.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, gate=None, ep_axis="sharding",
+                 activation="gelu", recompute_interval=0):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.gate = gate or TopKGate(d_model, num_experts, top_k,
+                                     capacity_factor)
+        self.w_in = self.create_parameter(
+            (num_experts, d_model, d_hidden),
+            default_initializer=I.XavierUniform())
+        self.w_out = self.create_parameter(
+            (num_experts, d_hidden, d_model),
+            default_initializer=I.XavierUniform())
+        # expert dim partition hint for the SPMD engine
+        self.w_in.dist_spec = (ep_axis, None, None)
+        self.w_out.dist_spec = (ep_axis, None, None)
+        self.l_aux = None
+
+    def forward(self, x):
+        """x: [B, S, D] (or [N, D])."""
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x.unsqueeze(0)
+        b, s, d = x.shape
+        n_tokens = b * s
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * n_tokens / e))
+        logits = self.gate(x)  # [B, S, E]
+        act_name = self.activation
+
+        def moe_fn(xa, logits_a, w_in, w_out):
+            xt = xa.reshape(n_tokens, d)
+            lg = logits_a.reshape(n_tokens, e).astype(jnp.float32)
+            probs = jax.nn.softmax(lg, axis=-1)
+            # top-k selection
+            topv, topi = jax.lax.top_k(probs, self.top_k)
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+            # capacity assignment per (expert): position of token in its
+            # expert queue, computed per k-slot GShard-style
+            dispatch = jnp.zeros((n_tokens, e, capacity), jnp.float32)
+            combine = jnp.zeros((n_tokens, e, capacity), jnp.float32)
+            used = jnp.zeros((e,), jnp.int32)
+            for slot in range(self.top_k):
+                idx = topi[:, slot]                       # [N]
+                onehot = jax.nn.one_hot(idx, e)           # [N, E]
+                pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+                pos = jnp.sum(pos_in_e * onehot, axis=-1).astype(
+                    jnp.int32) + jnp.take(used, idx)
+                keep = pos < capacity
+                pos_c = jnp.clip(pos, 0, capacity - 1)
+                oh_cap = jax.nn.one_hot(pos_c, capacity) * \
+                    keep[:, None].astype(jnp.float32)
+                disp_slot = onehot[:, :, None] * oh_cap[:, None, :]
+                dispatch = dispatch + disp_slot
+                combine = combine + disp_slot * topv[:, slot][:, None,
+                                                              None]
+                used = used + jnp.sum(
+                    onehot * keep[:, None], axis=0).astype(jnp.int32)
+
+            # aux load-balancing loss (GShard): E * sum(me * ce)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(
+                jax.nn.one_hot(topi[:, 0], e).astype(jnp.float32), axis=0)
+            l_aux = jnp.sum(me * ce) * e
+
+            expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                                   xt.astype(jnp.float32))
+            h = jnp.einsum("ecd,edm->ecm", expert_in,
+                           w_in.astype(jnp.float32))
+            h = getattr(jax.nn, act_name)(h)
+            expert_out = jnp.einsum("ecm,emd->ecd", h,
+                                    w_out.astype(jnp.float32))
+            out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+            return out.reshape(b, s, d).astype(xa.dtype), l_aux
+
+        out, l_aux = apply(moe_fn, x, logits, self.w_in, self.w_out,
+                           name="moe")
+        self.l_aux = l_aux
+        if squeeze:
+            out = out.squeeze(0)
+        return out
