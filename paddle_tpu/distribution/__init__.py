@@ -1,0 +1,296 @@
+"""paddle_tpu.distribution (reference: paddle.distribution — upstream
+python/paddle/distribution/, unverified; see SURVEY.md §2.2 "Misc
+domains"). Sampling draws from the framework's global threefry stream.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from ..core.autograd import apply
+from ..core.random import next_key
+from ..core.tensor import Tensor
+from ..ops._base import ensure_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel",
+           "Laplace", "LogNormal", "Multinomial", "Poisson", "kl_divergence"]
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        import paddle_tpu as P
+        return P.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale, ref=self.loc)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def sample(self, shape=(), seed=0):
+        k = next_key()
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+        return apply(lambda m, s: m + s * jrandom.normal(k, shp), self.loc,
+                     self.scale, name="normal_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+        return apply(
+            lambda v, m, s: -((v - m) ** 2) / (2 * s * s) - jnp.log(s) -
+            0.5 * math.log(2 * math.pi), value, self.loc, self.scale,
+            name="normal_log_prob")
+
+    def entropy(self):
+        return apply(lambda s: 0.5 + 0.5 * math.log(2 * math.pi) +
+                     jnp.log(s), self.scale, name="normal_entropy")
+
+    def cdf(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+        return apply(lambda v, m, s: 0.5 * (1 + jax.scipy.special.erf(
+            (v - m) / (s * math.sqrt(2)))), value, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low)
+        self.high = ensure_tensor(high, ref=self.low)
+
+    def sample(self, shape=(), seed=0):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.low.shape)
+        return apply(lambda lo, hi: lo + (hi - lo) *
+                     jrandom.uniform(k, shp), self.low, self.high,
+                     name="uniform_sample")
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.low)
+        return apply(lambda v, lo, hi: jnp.where(
+            (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            value, self.low, self.high)
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits)
+
+    def sample(self, shape=(), seed=0):
+        k = next_key()
+        shp = tuple(shape)
+        out = jrandom.categorical(k, self.logits._data, axis=-1,
+                                  shape=shp + tuple(
+                                      self.logits.shape[:-1]))
+        return Tensor(out.astype(jnp.int32))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda lg, v: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, -1),
+                v[..., None].astype(jnp.int32), -1)[..., 0],
+            self.logits, value.detach(), name="categorical_log_prob")
+
+    def probs(self, value=None):
+        import paddle_tpu as P
+        p = P.nn.functional.softmax(self.logits, axis=-1)
+        if value is None:
+            return p
+        return p.gather(ensure_tensor(value).astype("int32"), axis=-1)
+
+    def entropy(self):
+        return apply(lambda lg: -jnp.sum(
+            jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), -1),
+            self.logits, name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+
+    def sample(self, shape=(), seed=0):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.probs_t.shape)
+        return Tensor(jrandom.bernoulli(
+            k, self.probs_t._data, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.probs_t)
+        return apply(lambda v, p: v * jnp.log(jnp.clip(p, 1e-12, 1)) +
+                     (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12, 1)),
+                     value, self.probs_t)
+
+    def entropy(self):
+        return apply(lambda p: -(p * jnp.log(jnp.clip(p, 1e-12, 1)) +
+                                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12,
+                                                            1))),
+                     self.probs_t)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = ensure_tensor(alpha)
+        self.beta = ensure_tensor(beta, ref=self.alpha)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.alpha.shape)
+        return Tensor(jrandom.beta(k, self.alpha._data, self.beta._data,
+                                   shp))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.alpha)
+        return apply(
+            lambda v, a, b: ((a - 1) * jnp.log(v) + (b - 1) *
+                             jnp.log1p(-v) - (
+                jax.scipy.special.gammaln(a) +
+                jax.scipy.special.gammaln(b) -
+                jax.scipy.special.gammaln(a + b))),
+            value, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = ensure_tensor(concentration)
+
+    def sample(self, shape=()):
+        k = next_key()
+        return Tensor(jrandom.dirichlet(k, self.concentration._data,
+                                        tuple(shape)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = ensure_tensor(rate)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.rate.shape)
+        return apply(lambda r: jrandom.exponential(k, shp) / r, self.rate)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.rate)
+        return apply(lambda v, r: jnp.log(r) - r * v, value, self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = ensure_tensor(concentration)
+        self.rate = ensure_tensor(rate, ref=self.concentration)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.concentration.shape)
+        return apply(lambda c, r: jrandom.gamma(k, c, shp) / r,
+                     self.concentration, self.rate)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale, ref=self.loc)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.loc.shape)
+        return apply(lambda m, s: m + s * jrandom.gumbel(k, shp),
+                     self.loc, self.scale)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale, ref=self.loc)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.loc.shape)
+        return apply(lambda m, s: m + s * jrandom.laplace(k, shp),
+                     self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+        return apply(lambda v, m, s: -jnp.abs(v - m) / s -
+                     jnp.log(2 * s), value, self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+
+    def sample(self, shape=()):
+        import paddle_tpu as P
+        return P.exp(self.base.sample(shape))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs_t = ensure_tensor(probs)
+
+    def sample(self, shape=()):
+        k = next_key()
+        out = jrandom.multinomial(
+            k, self.total_count,
+            self.probs_t._data, shape=tuple(shape) +
+            tuple(self.probs_t.shape[:-1]) if shape else None)
+        return Tensor(out)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = ensure_tensor(rate)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.rate.shape)
+        return Tensor(jrandom.poisson(k, self.rate._data, shp).astype(
+            jnp.float32))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return apply(
+            lambda m1, s1, m2, s2: (jnp.log(s2 / s1) +
+                                    (s1 * s1 + (m1 - m2) ** 2) /
+                                    (2 * s2 * s2) - 0.5),
+            p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        return apply(
+            lambda a, b: jnp.sum(
+                jax.nn.softmax(a, -1) * (jax.nn.log_softmax(a, -1) -
+                                         jax.nn.log_softmax(b, -1)), -1),
+            p.logits, q.logits, name="kl_categorical")
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,64 @@
+"""paddle_tpu.fft (reference: paddle.fft — upstream python/paddle/fft.py,
+unverified; see SURVEY.md §2.2). Direct lowering to jnp.fft → XLA FFT."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply
+from .ops._base import ensure_tensor
+
+
+def _wrap1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        x = ensure_tensor(x)
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=norm), x,
+                     name=name)
+    op.__name__ = name
+    return op
+
+
+def _wrapn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        x = ensure_tensor(x)
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=norm), x,
+                     name=name)
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrapn(jnp.fft.fft2, "fft2")
+ifft2 = _wrapn(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrapn(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrapn(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 name="ifftshift")

@@ -25,6 +25,8 @@ from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
                       AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
+                  SimpleRNNCell)
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
                           TransformerEncoderLayer)

@@ -1,0 +1,91 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Design (per /opt/skills/guides/pallas_guide.md): grid over
+(batch*heads, query blocks); each kernel instance streams K/V through VMEM
+in `block_k` chunks with the online-softmax accumulator in fp32; the
+q@k^T and p@v products hit the MXU (block sizes multiples of 128 on the
+lane dim). Causal masking prunes fully-masked K blocks via a dynamic
+fori_loop upper bound, so the causal kernel does ~half the FLOPs.
+
+The XLA reference in flash_attention.py is the numerical oracle; the
+interpret=True path runs this exact kernel on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                   seq_len):
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    bq, d = q.shape
+    qi = pl.program_id(1)
+    n_kb = seq_len // block_k
+
+    def body(i, carry):
+        m, l, acc = carry                             # [bq,1],[bq,1],[bq,D]
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        upper = jax.lax.div(qi * bq + bq + block_k - 1, block_k)
+        upper = jnp.minimum(upper, n_kb)
+    else:
+        upper = n_kb
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def fa_forward(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
+               interpret=False):
+    """q,k,v: [B, S, H, D] → out [B, S, H, D]."""
+    b, s, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0
+
+    def bh(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+
+    qb, kb, vb = bh(q), bh(k), bh(v)
+    kernel = functools.partial(_fa_fwd_kernel, scale=sc, causal=causal,
+                               block_k=block_k, seq_len=s)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,82 @@
+"""paddle_tpu.signal (reference: paddle.signal — stft/istft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.autograd import apply
+from .ops._base import ensure_tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        n = (a.shape[axis] - frame_length) // hop_length + 1
+        idx = (jnp.arange(frame_length)[None, :] +
+               hop_length * jnp.arange(n)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        out = moved[..., idx]  # [..., n, frame_length]
+        return jnp.moveaxis(out, (-2, -1), (axis if axis >= 0 else -2,
+                                            -1))
+    return apply(f, x, name="frame")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    x = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    warr = window._data if window is not None else jnp.ones((wl,))
+
+    def f(a):
+        sig = a
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (sig.ndim - 1) + [(pad, pad)]
+            sig = jnp.pad(sig, cfg, mode="reflect"
+                          if pad_mode == "reflect" else "constant")
+        n = (sig.shape[-1] - n_fft) // hop + 1
+        idx = (jnp.arange(n_fft)[None, :] + hop * jnp.arange(n)[:, None])
+        frames = sig[..., idx]  # [..., n, n_fft]
+        w = jnp.pad(warr, (0, n_fft - wl)) if wl < n_fft else warr
+        frames = frames * w
+        spec = jnp.fft.rfft(frames, n=n_fft) if onesided else \
+            jnp.fft.fft(frames, n=n_fft)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, time]
+    return apply(f, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    x = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    warr = window._data if window is not None else jnp.ones((wl,))
+
+    def f(spec):
+        sp = jnp.swapaxes(spec, -1, -2)  # [..., time, freq]
+        if normalized:
+            sp = sp * jnp.sqrt(n_fft)
+        frames = jnp.fft.irfft(sp, n=n_fft) if onesided else \
+            jnp.real(jnp.fft.ifft(sp, n=n_fft))
+        w = jnp.pad(warr, (0, n_fft - wl)) if wl < n_fft else warr
+        frames = frames * w
+        n = frames.shape[-2]
+        out_len = n_fft + hop * (n - 1)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,))
+        wsum = jnp.zeros((out_len,))
+        for i in range(n):
+            sl = slice(i * hop, i * hop + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(w * w)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: -(n_fft // 2)]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply(f, x, name="istft")

@@ -1,0 +1,108 @@
+"""RNN / distribution / fft / signal API tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+
+class TestRNN:
+    def test_lstm_shapes_and_train(self):
+        P.seed(0)
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = P.randn([4, 10, 8])
+        y, (h, c) = lstm(x)
+        assert y.shape == [4, 10, 16]
+        assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+        loss = y.mean()
+        loss.backward()
+        assert all(p.grad is not None for p in lstm.parameters())
+
+    def test_gru_bidirectional(self):
+        P.seed(0)
+        gru = nn.GRU(6, 12, direction="bidirect")
+        x = P.randn([2, 7, 6])
+        y, h = gru(x)
+        assert y.shape == [2, 7, 24]
+        assert h.shape == [2, 2, 12]
+
+    def test_lstm_cell_oracle(self):
+        """Single LSTM step vs numpy oracle."""
+        P.seed(0)
+        cell = nn.LSTMCell(4, 8)
+        x = P.randn([3, 4])
+        h, (h2, c2) = cell(x)
+        wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+        bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+        g = x.numpy() @ wi.T + bi + bh
+
+        def sig(a):
+            return 1 / (1 + np.exp(-a))
+        i, f, gg, o = (g[:, :8], g[:, 8:16], g[:, 16:24], g[:, 24:32])
+        c_ref = sig(i) * np.tanh(gg)
+        h_ref = sig(o) * np.tanh(c_ref)
+        assert np.allclose(h.numpy(), h_ref, atol=1e-4)
+
+    def test_simple_rnn(self):
+        P.seed(0)
+        rnn = nn.SimpleRNN(4, 8)
+        y, h = rnn(P.randn([2, 5, 4]))
+        assert y.shape == [2, 5, 8]
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        P.seed(0)
+        d = Normal(0.0, 1.0)
+        s = d.sample([10000])
+        assert abs(float(s.mean().numpy())) < 0.05
+        lp = d.log_prob(P.to_tensor(0.0))
+        assert np.allclose(float(lp.numpy()),
+                           -0.5 * np.log(2 * np.pi), atol=1e-5)
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 1.0))
+        assert np.allclose(float(kl.numpy()), 0.5, atol=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+        P.seed(0)
+        logits = P.to_tensor(np.log([0.7, 0.2, 0.1]).astype(np.float32))
+        d = Categorical(logits)
+        s = d.sample([5000])
+        frac0 = float((s == 0).astype("float32").mean().numpy())
+        assert 0.65 < frac0 < 0.75
+        ent = float(d.entropy().numpy())
+        ref = -(0.7 * np.log(0.7) + 0.2 * np.log(0.2) + 0.1 * np.log(0.1))
+        assert np.allclose(ent, ref, atol=1e-4)
+
+    def test_reparameterized_gradient(self):
+        from paddle_tpu.distribution import Normal
+        P.seed(0)
+        mu = P.to_tensor([0.5], stop_gradient=False)
+        d = Normal(mu, P.to_tensor([1.0]))
+        s = d.rsample([64])
+        s.mean().backward()
+        assert np.allclose(mu.grad.numpy(), [1.0], atol=1e-5)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.randn(16).astype(np.float32)
+        X = P.fft.fft(P.to_tensor(x))
+        back = P.fft.ifft(X)
+        assert np.allclose(np.real(back.numpy()), x, atol=1e-4)
+        assert np.allclose(X.numpy(), np.fft.fft(x), atol=1e-3)
+
+    def test_rfft(self):
+        x = np.random.randn(4, 32).astype(np.float32)
+        X = P.fft.rfft(P.to_tensor(x))
+        assert X.shape == [4, 17]
+        assert np.allclose(X.numpy(), np.fft.rfft(x), atol=1e-3)
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        x = np.sin(np.linspace(0, 50, 512)).astype(np.float32)
+        spec = P.signal.stft(P.to_tensor(x), n_fft=64, hop_length=16)
+        rec = P.signal.istft(spec, n_fft=64, hop_length=16, length=512)
+        assert np.allclose(rec.numpy(), x, atol=1e-3)
