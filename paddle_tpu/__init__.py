@@ -41,6 +41,8 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
+from . import static  # noqa: F401
 from . import vision  # noqa: F401
 
 from .framework.io_save import load, save  # noqa: E402

@@ -165,6 +165,28 @@ class Model:
                 self._amp_level = amp_configs.get("level", "O1")
         return self
 
+    def _make_stepper(self):
+        """When fleet is initialized, train through the mesh-aware SPMD
+        engine (DP/ZeRO/TP composed); otherwise the single-device jit
+        stepper. Reference flow §3.2→§3.3 unified behind Model.fit."""
+        from ..distributed import fleet as fleet_mod
+        if fleet_mod.is_initialized():
+            from ..distributed.fleet.fleet import _state
+            from ..distributed.fleet.spmd import SPMDTrainer
+            st = _state.strategy
+            stage = int(st.sharding_configs["stage"]) if st and st.sharding \
+                else 0
+            trainer = SPMDTrainer(self.network, self._optimizer, self._loss,
+                                  _state.hcg.mesh, st,
+                                  sharding_stage=stage)
+
+            class _FleetStepper:
+                def step(self_, inputs, labels):
+                    loss = trainer.train_batch(inputs, labels)
+                    return loss, []
+            return _FleetStepper()
+        return _JitStepper(self.network, self._loss, self._optimizer)
+
     # -- single-batch ops -----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
@@ -175,11 +197,11 @@ class Model:
 
         if not self._jit_broken and update and self._amp_level is None:
             if self._stepper is None:
-                self._stepper = _JitStepper(self.network, self._loss,
-                                            self._optimizer)
+                self._stepper = self._make_stepper()
             try:
                 loss, outs = self._stepper.step(inputs, labels)
-                self._update_metrics(outs, labels)
+                if outs:
+                    self._update_metrics(outs, labels)
                 return self._loss_value(loss)
             except (jax.errors.ConcretizationTypeError,
                     jax.errors.TracerBoolConversionError,

@@ -1,0 +1,96 @@
+"""paddle_tpu.sparse (reference: paddle.sparse COO/CSR ops — upstream
+paddle/phi/kernels/sparse/, unverified; see SURVEY.md §2.1).
+
+TPU-native: wraps jax.experimental.sparse BCOO (TPU-supported sparse
+format). Coverage is the core creation/convert/elementwise/matmul surface;
+sparse convs are out of the TPU north-star path (documented gap).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._base import ensure_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "matmul", "add", "multiply", "relu"]
+
+
+class SparseCooTensor:
+    """Thin wrapper over BCOO keeping reference accessor names."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def nnz(self):
+        return self._bcoo.nse
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, "
+                f"nnz={self._bcoo.nse})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    idx = ensure_tensor(indices)._data
+    vals = ensure_tensor(values)._data
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)  # [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(idx, axis=0))
+    b = jsparse.BCOO((vals, idx), shape=tuple(shape))
+    return SparseCooTensor(b)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    crows = np.asarray(ensure_tensor(crows)._data)
+    cols = np.asarray(ensure_tensor(cols)._data)
+    vals = ensure_tensor(values)._data
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                     jnp.asarray(cols, jnp.int32)], axis=1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def matmul(a, b):
+    if isinstance(a, SparseCooTensor):
+        dense = b.to_dense() if isinstance(b, SparseCooTensor) else \
+            ensure_tensor(b)
+        return Tensor(a._bcoo @ dense._data)
+    raise TypeError("sparse.matmul expects a SparseCooTensor lhs")
+
+
+def add(a, b):
+    return SparseCooTensor(_binary(a, b, jnp.add))
+
+
+def _binary(a, b, op):
+    dense = op(a._bcoo.todense(), b._bcoo.todense())
+    return jsparse.BCOO.fromdense(dense)
+
+
+def multiply(a, b):
+    return SparseCooTensor(_binary(a, b, jnp.multiply))
+
+
+def relu(x):
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+                     shape=x._bcoo.shape))

@@ -1,0 +1,77 @@
+"""paddle_tpu.static — static-graph compatibility shims.
+
+Reference parity: paddle.static.* (upstream python/paddle/static/ —
+unverified, see SURVEY.md §2.2). This framework is eager-first with
+jax.jit compilation (SURVEY.md §7 design stance: PIR/program machinery
+collapses into tracing); the static API surface maps onto the jit/export
+path so reference scripts keep working:
+
+- InputSpec → shape/dtype specs for to_static/jit.save
+- save/load_inference_model → jit.save/load (StableHLO artifact)
+- program_guard/default_main_program → no-op context shims
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit.save_load import InputSpec, TranslatedLayer  # noqa: F401
+from ..jit.save_load import load as _jit_load
+from ..jit.save_load import save as _jit_save
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Program", "program_guard", "default_main_program",
+           "default_startup_program", "name_scope", "device_guard"]
+
+
+class Program:
+    """Placeholder Program: compiled programs are jaxprs managed by jit."""
+
+    def __init__(self):
+        self._is_shim = True
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+from ..core.device import device_guard  # noqa: E402,F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    layer = kwargs.get("layer")
+    if layer is None:
+        raise ValueError(
+            "TPU-native save_inference_model exports a Layer: pass "
+            "layer=<nn.Layer> (the reference Program path does not exist "
+            "here); or use paddle_tpu.jit.save directly.")
+    specs = feed_vars if feed_vars else None
+    _jit_save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _jit_load(path_prefix)

@@ -1,0 +1,74 @@
+"""MobileNetV2 (reference: paddle.vision.models.mobilenet_v2)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer,
+                   Linear, ReLU6, Sequential)
+
+
+class ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride,
+                   padding=(kernel - 1) // 2, groups=groups,
+                   bias_attr=False),
+            BatchNorm2D(out_c), ReLU6())
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(inp, hidden, kernel=1))
+        layers += [
+            ConvBNReLU(hidden, hidden, stride=stride, groups=hidden),
+            Conv2D(hidden, oup, 1, bias_attr=False),
+            BatchNorm2D(oup)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        in_c = int(32 * scale)
+        features = [ConvBNReLU(3, in_c, stride=2)]
+        for t, c, n, s in cfg:
+            out_c = int(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        self.last_c = int(1280 * max(1.0, scale))
+        features.append(ConvBNReLU(in_c, self.last_c, kernel=1))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this env")
+    return MobileNetV2(scale=scale, **kwargs)
