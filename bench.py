@@ -57,24 +57,34 @@ def main():
                           num_attention_heads=4)
         batch, seq, iters = 2, 128, 3
 
-    P.seed(0)
-    model = LlamaForCausalLM(cfg)
-    if on_tpu:
-        model.to(dtype="bfloat16")
-    crit = LlamaPretrainingCriterion(cfg)
-    opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(),
-                            multi_precision=on_tpu)
-    m = P.Model(model)
-    m.prepare(opt, crit)
-
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    x = P.to_tensor(ids)
-
-    # warmup (compile)
-    m.train_batch([x], [x])
-    m.train_batch([x], [x])
-    jax.effects_barrier()
+    while True:
+        # Build everything inside the retry loop: the train step donates
+        # params/buffers/opt-states, so a failed execution can leave them
+        # deleted — a fresh model/optimizer is required for the retry.
+        P.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                multi_precision=on_tpu)
+        m = P.Model(model)
+        m.prepare(opt, crit)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        x = P.to_tensor(ids)
+        try:
+            # warmup (compile)
+            m.train_batch([x], [x])
+            m.train_batch([x], [x])
+            jax.effects_barrier()
+            break
+        except Exception as e:
+            # HBM headroom varies with what else has the chip; halve the
+            # batch rather than fail the bench outright.
+            if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 1:
+                raise
+            batch //= 2
 
     t0 = time.perf_counter()
     for _ in range(iters):

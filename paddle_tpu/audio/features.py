@@ -1,0 +1,94 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram / LogMel / MFCC.
+
+Reference parity: upstream python/paddle/audio/features/layers.py
+(unverified, see SURVEY.md §2.2). Built on paddle_tpu.signal.stft +
+audio.functional; each feature is a Layer whose forward is one fused
+XLA computation (rfft + filterbank matmul + log), MXU-friendly since
+the filterbank application is a plain matmul.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .. import signal as _signal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self._n_fft = n_fft
+        self._hop = hop_length or n_fft // 4
+        self._wl = win_length or n_fft
+        self._power = power
+        self._center = center
+        self._pad_mode = pad_mode
+        self.register_buffer(
+            "window", F.get_window(window, self._wl, dtype=dtype))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self._n_fft, self._hop, self._wl,
+                            window=self.window, center=self._center,
+                            pad_mode=self._pad_mode)
+        mag = Tensor(jnp.abs(spec._data))
+        if self._power == 1.0:
+            return mag
+        return Tensor(mag._data ** self._power)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.register_buffer("fbank", F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # [..., freq, time]
+        return Tensor(jnp.matmul(self.fbank._data, spec._data))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                   window, power, center, pad_mode, n_mels,
+                                   f_min, f_max, htk, norm, dtype)
+        self._ref, self._amin, self._top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return F.power_to_db(self._mel(x), self._ref, self._amin,
+                             self._top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels,
+                                                 dtype=dtype))
+
+    def forward(self, x):
+        mel = self._logmel(x)                # [..., n_mels, time]
+        return Tensor(jnp.einsum("mk,...mt->...kt", self.dct._data,
+                                 mel._data))

@@ -1,0 +1,129 @@
+"""paddle.audio.functional — mel/DCT/window math.
+
+Reference parity: upstream python/paddle/audio/functional/ (unverified,
+see SURVEY.md §2.2): hz_to_mel/mel_to_hz, mel_frequencies,
+fft_frequencies, compute_fbank_matrix, create_dct, power_to_db,
+get_window. Pure jnp — everything fuses under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
+        freq, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = jnp.where(f >= min_log_hz,
+                         min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                         mels)
+        out = mels
+    if isinstance(freq, Tensor):
+        return Tensor(out)
+    return float(out) if scalar else np.asarray(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
+        mel, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = jnp.where(m >= min_log_mel,
+                          min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                          freqs)
+        out = freqs
+    if isinstance(mel, Tensor):
+        return Tensor(out)
+    return float(out) if scalar else np.asarray(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return Tensor(mel_to_hz(Tensor(mels), htk)._data.astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2,
+                               dtype=dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (matches the reference layout)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis = basis * jnp.sqrt(2.0 / n_mels)
+        basis = basis.at[:, 0].set(basis[:, 0] * (1.0 / jnp.sqrt(2.0)))
+    else:
+        basis = basis * 2.0
+    return Tensor(basis.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    x = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else \
+        np.asarray(log_spec)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    n = win_length
+    sym = not fftbins
+    denom = n - 1 if sym else n
+    i = jnp.arange(n, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+             + 0.08 * jnp.cos(4 * math.pi * i / denom))
+    elif window in ("rect", "rectangular", "boxcar", "ones"):
+        w = jnp.ones((n,))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

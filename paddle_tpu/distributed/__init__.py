@@ -6,6 +6,7 @@ topology, fleet facade, sharding API, auto-parallel surface.
 """
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
+from . import rpc  # noqa: F401
 from .collective import (ProcessGroup, ReduceOp, all_gather,  # noqa: F401
                          all_gather_object, all_reduce, alltoall,
                          alltoall_single, barrier, broadcast,

@@ -10,6 +10,8 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F40
                         RowParallelLinear, VocabParallelEmbedding)
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
                        SharedLayerDesc)
+from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: F401
+                         UserDefinedRoleMaker)
 from .random_ctl import (RNGStatesTracker, get_rng_state_tracker,  # noqa: F401
                          model_parallel_random_seed)
 from .spmd import SPMDTrainer  # noqa: F401

@@ -61,6 +61,10 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level=20):
     set_hybrid_communicate_group(hcg)
     set_default_group(new_group(list(range(topo.world_size()))))
     _state.initialized = True
+    if role_maker is None:
+        from .role_maker import PaddleCloudRoleMaker
+        role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+    _state.role_maker = role_maker
     _state.strategy = strategy
     _state.hcg = hcg
     return Fleet()
@@ -153,16 +157,21 @@ class Fleet:
         return _state.strategy
 
     def worker_index(self):
-        return dist_env.get_rank()
+        rm = getattr(_state, "role_maker", None)
+        return rm.worker_index() if rm is not None else dist_env.get_rank()
 
     def worker_num(self):
-        return dist_env.get_world_size()
+        rm = getattr(_state, "role_maker", None)
+        return (rm.worker_num() if rm is not None
+                else dist_env.get_world_size())
 
     def is_first_worker(self):
-        return dist_env.get_rank() == 0
+        return self.worker_index() == 0
 
     def worker_endpoints(self, to_string=False):
-        eps = dist_env.get_endpoints()
+        rm = getattr(_state, "role_maker", None)
+        eps = (rm.get_trainer_endpoints() if rm is not None
+               else dist_env.get_endpoints())
         return ",".join(eps) if to_string else eps
 
     def get_hybrid_communicate_group(self):

@@ -1,0 +1,110 @@
+"""RoleMaker — cluster-environment introspection for fleet.
+
+Reference parity: upstream python/paddle/distributed/fleet/base/
+role_maker.py `PaddleCloudRoleMaker` / `UserDefinedRoleMaker` (unverified,
+see SURVEY.md §2.3): parses the PADDLE_* env protocol into
+rank/world-size/endpoint accessors that `fleet.init` and launch-spawned
+workers consume. The PS (parameter-server) roles are out of scope
+(SURVEY.md §7); only the collective path is realized.
+"""
+from __future__ import annotations
+
+import os
+
+from .. import env as _env
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2  # parameter-server role: out of scope, kept for API parity
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def is_worker(self):
+        raise NotImplementedError
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        raise NotImplementedError
+
+    def worker_num(self):
+        raise NotImplementedError
+
+    def get_trainer_endpoints(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Collective role maker over the PADDLE_* env protocol (the same
+    contract the launch CLI writes: PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_CURRENT_ENDPOINT)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._generate_role()
+
+    def _generate_role(self):
+        self._worker_index = _env.get_rank()
+        self._worker_num = _env.get_world_size()
+        self._endpoints = _env.get_endpoints() or []
+        self._current_endpoint = _env.get_current_endpoint()
+        self._role = Role.WORKER
+
+    def to_string(self):
+        return (f"PaddleCloudRoleMaker(role=WORKER "
+                f"index={self._worker_index} num={self._worker_num} "
+                f"endpoints={self._endpoints})")
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def worker_index(self):
+        return self._worker_index
+
+    def worker_num(self):
+        return self._worker_num
+
+    def node_num(self):
+        hosts = {ep.rsplit(":", 1)[0] for ep in self._endpoints}
+        return max(1, len(hosts))
+
+    def get_trainer_endpoints(self):
+        return list(self._endpoints)
+
+    def get_current_endpoint(self):
+        return self._current_endpoint
+
+    def get_local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE",
+                                  _env.get_local_rank()))
+
+    def get_local_device_ids(self):
+        v = os.environ.get("FLAGS_selected_devices", "")
+        return [int(x) for x in v.split(",") if x] or [0]
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicitly-specified topology (reference: UserDefinedRoleMaker)."""
+
+    def __init__(self, current_id=0, worker_num=1, worker_endpoints=None,
+                 role=Role.WORKER, **kwargs):
+        self._user = (current_id, worker_num, worker_endpoints or [], role)
+        super().__init__(is_collective=True, **kwargs)
+
+    def _generate_role(self):
+        cid, num, eps, role = self._user
+        self._worker_index = cid
+        self._worker_num = num
+        self._endpoints = eps
+        self._current_endpoint = eps[cid] if cid < len(eps) else None
+        self._role = role

@@ -1,0 +1,218 @@
+"""paddle.distributed.rpc — point-to-point remote procedure calls.
+
+Reference parity: upstream python/paddle/distributed/rpc/ (unverified, see
+SURVEY.md §2.3): `init_rpc(name, rank, world_size, master_endpoint)`,
+`rpc_sync(to, fn, args, kwargs, timeout)`, `rpc_async(...)` returning a
+future with `.wait()`, `get_worker_info(name)` / `get_all_worker_infos()`,
+`shutdown()`. The reference rides brpc; here the transport is a plain
+TCP socket server per worker (length-prefixed pickle frames) with the
+C++ TCPStore (paddle_tpu/native/tcp_store.cpp) as the rendezvous that
+maps worker names → endpoints — no external RPC framework needed, and
+nothing here touches the TPU compute path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+from ..native import TCPStore
+
+_DEFAULT_TIMEOUT = 120.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _State:
+    def __init__(self):
+        self.store = None
+        self.server = None
+        self.workers = {}          # name -> WorkerInfo
+        self.by_rank = {}          # rank -> WorkerInfo
+        self.current = None
+        self.pool = None
+        self.initialized = False
+
+
+_state = _State()
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _send_frame(conn, payload: bytes):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(conn) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+class _Server(threading.Thread):
+    """Per-worker daemon accepting (fn, args, kwargs) frames."""
+
+    def __init__(self):
+        super().__init__(daemon=True, name="pd-rpc-server")
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+
+    def run(self):
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        self.sock.close()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                frame = _recv_frame(conn)
+                kind, payload = frame[:1], frame[1:]
+                if kind == b"Q":  # quit ping
+                    _send_frame(conn, b"A")
+                    return
+                fn, args, kwargs = pickle.loads(payload)
+                try:
+                    result = fn(*args, **kwargs)
+                    _send_frame(conn, b"R" + pickle.dumps(result))
+                except Exception as e:  # ship the exception back
+                    _send_frame(conn, b"E" + pickle.dumps(e))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and exchange endpoints via TCPStore."""
+    if _state.initialized:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29431")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    _state.server = _Server()
+    _state.server.start()
+    _state.store = TCPStore(host, int(port), is_master=(rank == 0),
+                            world_size=world_size)
+    my_ip = os.environ.get("POD_IP", "127.0.0.1")
+    me = WorkerInfo(name, rank, my_ip, _state.server.port)
+    # Identify ourselves BEFORE publishing to the store: a fast peer can
+    # finish discovery and rpc into this worker while we are still waiting
+    # for the remaining registrations.
+    _state.current = me
+    _state.workers[name] = me
+    _state.by_rank[rank] = me
+    _state.store.set(f"/rpc/{rank}",
+                     pickle.dumps((name, rank, my_ip, _state.server.port)))
+    for r in range(world_size):
+        info = WorkerInfo(*pickle.loads(_state.store.wait(f"/rpc/{r}")))
+        _state.workers[info.name] = info
+        _state.by_rank[info.rank] = info
+    _state.pool = ThreadPoolExecutor(max_workers=8,
+                                     thread_name_prefix="pd-rpc-client")
+    _state.initialized = True
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    if name is None:
+        return _state.current
+    return _state.workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = _state.workers[to] if isinstance(to, str) else _state.by_rank[to]
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or _DEFAULT_TIMEOUT) as c:
+        _send_frame(c, b"C" + pickle.dumps((fn, args or (), kwargs or {})))
+        resp = _recv_frame(c)
+    kind, payload = resp[:1], resp[1:]
+    if kind == b"E":
+        raise pickle.loads(payload)
+    return pickle.loads(payload)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """Run fn(*args, **kwargs) on worker `to` (name or rank); block."""
+    if not _state.initialized:
+        raise RuntimeError("call init_rpc first")
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_TIMEOUT) -> Future:
+    """Like rpc_sync but returns a concurrent.futures.Future."""
+    if not _state.initialized:
+        raise RuntimeError("call init_rpc first")
+    return _state.pool.submit(_invoke, to, fn, args, kwargs, timeout)
+
+
+def shutdown():
+    """Barrier on the store, then stop the local server.
+
+    Rank 0 hosts the store master, so it must be the last one out: it
+    waits for every rank's arrival AND an ack that every non-master has
+    seen the release before closing the store server.
+    """
+    if not _state.initialized:
+        return
+    import time
+    ws = len(_state.by_rank)
+    me = _state.current.rank
+    n = _state.store.add("/rpc/shutdown", 1)
+    deadline = time.monotonic() + _DEFAULT_TIMEOUT
+    if me == 0:
+        while n < ws and time.monotonic() < deadline:
+            time.sleep(0.01)
+            n = _state.store.add("/rpc/shutdown", 0)
+        _state.store.set("/rpc/shutdown_done", b"1")
+        acks = 0
+        while acks < ws - 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+            acks = _state.store.add("/rpc/shutdown_ack", 0)
+    else:
+        _state.store.wait("/rpc/shutdown_done")
+        _state.store.add("/rpc/shutdown_ack", 1)
+    _state.server.stop()
+    _state.pool.shutdown(wait=False)
+    _state.store.close()
+    _state.__init__()

@@ -46,10 +46,21 @@ class _JitStepper:
         self._sig = None
 
     def _named_state(self):
-        train_p, frozen_p = [], []
+        # Dedup tied/shared parameters (e.g. tie_word_embeddings): the same
+        # Tensor may be reachable under several names, but each donated jit
+        # argument must be a distinct buffer.
+        train_p, frozen_p, seen = [], [], set()
         for n, p in self.network.named_parameters():
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
             (frozen_p if p.stop_gradient else train_p).append((n, p))
-        bufs = list(self.network.named_buffers())
+        bufs, seen_b = [], set()
+        for n, b in self.network.named_buffers():
+            if id(b) in seen_b:
+                continue
+            seen_b.add(id(b))
+            bufs.append((n, b))
         return train_p, frozen_p, bufs
 
     def _build(self, n_inputs, n_labels):
@@ -102,7 +113,11 @@ class _JitStepper:
                 for t, arr in saved:
                     t._data = arr
 
-        return jax.jit(pure), (train_p, frozen_p, bufs)
+        # Donate params/buffers/opt-states: they are consumed and replaced
+        # by the returned updated arrays, so XLA can update in place instead
+        # of double-buffering the whole model+optimizer footprint in HBM.
+        return (jax.jit(pure, donate_argnums=(1, 3, 4)),
+                (train_p, frozen_p, bufs))
 
     def step(self, inputs, labels):
         sig = (len(inputs), len(labels),
@@ -116,15 +131,26 @@ class _JitStepper:
         opt._step_count += 1
         states = [opt._get_state(t) for _, t in train_p]
         key = _random.next_key()
-        loss_v, out_arrays, new_buf, new_params, new_states = self._jit(
-            key,
-            [t._data for _, t in train_p],
-            [t._data for _, t in frozen_p],
-            [t._data for _, t in bufs],
-            states,
-            jnp.asarray(opt.get_lr(), jnp.float32),
-            jnp.asarray(opt._step_count, jnp.int32),
-            *[t._data for t in inputs + labels])
+        try:
+            loss_v, out_arrays, new_buf, new_params, new_states = \
+                self._jit(
+                    key,
+                    [t._data for _, t in train_p],
+                    [t._data for _, t in frozen_p],
+                    [t._data for _, t in bufs],
+                    states,
+                    jnp.asarray(opt.get_lr(), jnp.float32),
+                    jnp.asarray(opt._step_count, jnp.int32),
+                    *[t._data for t in inputs + labels])
+        except Exception as e:
+            # Donated buffers may already be invalidated by a failed
+            # execution — the model/optimizer cannot be trusted afterwards.
+            raise RuntimeError(
+                "jitted train step failed after its inputs were donated; "
+                "the model and optimizer state are invalid. Rebuild the "
+                "model (and reload a checkpoint) before retrying — e.g. "
+                "with a smaller batch if this was RESOURCE_EXHAUSTED. "
+                f"Original error: {e}") from e
         for (n, t), arr in zip(train_p, new_params):
             t._inplace_update(arr)
         for (n, t), ns in zip(train_p, new_states):

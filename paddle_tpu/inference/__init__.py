@@ -1,0 +1,155 @@
+"""paddle.inference — deployment predictor API.
+
+Reference parity: the AnalysisPredictor surface (upstream
+paddle/fluid/inference/ + python/paddle/inference/ — unverified, see
+SURVEY.md §2.1 "Inference engine"): `Config(prog_file, params_file)`,
+`create_predictor(config)`, named input/output handles with
+`copy_from_cpu`/`copy_to_cpu`, `predictor.run()`.
+
+TPU-native realization: the deployment artifact is the serialized
+StableHLO module written by `paddle_tpu.jit.save` (SURVEY.md §7 design
+stance: the inference "program" is StableHLO, runnable on any PJRT
+runtime; TensorRT/oneDNN subgraph engines are collapsed into XLA). The
+predictor wraps `paddle_tpu.jit.load` and keeps device arrays resident
+between `run()` calls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.save_load import TranslatedLayer
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    TPU = "tpu"
+    XPU = CUSTOM = "tpu"  # vendor places collapse to the PJRT device
+
+
+class Config:
+    """Holds the artifact path + execution options."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # jit.save writes {prefix}.pdmodel.json/.pdiparams.npz/.stablehlo;
+        # accept either the prefix or the .pdmodel.json path.
+        if prog_file and prog_file.endswith(".pdmodel.json"):
+            prog_file = prog_file[: -len(".pdmodel.json")]
+        self._prefix = prog_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
+        self._enabled_memory_optim = True
+
+    def set_prog_file(self, p):
+        self._prefix = p
+
+    def prog_file(self):
+        return self._prefix
+
+    def enable_use_gpu(self, *a, **k):  # reference compat: maps to TPU
+        self._device = "tpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._enabled_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA pipeline always optimizes
+
+    def enable_tensorrt_engine(self, *a, precision_mode=None, **k):
+        # TensorRT's role (fused low-precision subgraphs) is XLA's job on
+        # TPU; only the precision request is meaningful.
+        if precision_mode is not None:
+            self._precision = precision_mode
+
+    def summary(self):
+        return (f"Config(prefix={self._prefix}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class _IOHandle:
+    def __init__(self):
+        self._array = None
+
+    def copy_from_cpu(self, arr):
+        self._array = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._array)
+
+    def shape(self):
+        return tuple(self._array.shape) if self._array is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self._config = config
+        self._layer = TranslatedLayer(config.prog_file())
+        n_in = self._layer._meta.get("n_inputs")
+        if n_in is None:
+            # count from the exported signature: args beyond params+buffers
+            exp = self._layer._exported
+            if exp is not None:
+                n_named = (len(self._layer._meta["params"]) +
+                           len(self._layer._meta["buffers"]))
+                n_in = len(exp.in_avals) - n_named
+            else:
+                n_in = 1
+        self._in_names = [f"x{i}" for i in range(n_in)]
+        self._inputs = {n: _IOHandle() for n in self._in_names}
+        self._out_names = []
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Execute. Either feed via handles then run(), or pass a list of
+        numpy arrays directly (returns list of numpy outputs)."""
+        if inputs is not None:
+            for n, a in zip(self._in_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [Tensor(self._inputs[n]._array) for n in self._in_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, tuple) else (out,)
+        self._out_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._out_names, outs):
+            h = _IOHandle()
+            h._array = o.numpy()
+            self._outputs[n] = h
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu() for n in self._out_names]
+        return True
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -190,11 +190,17 @@ class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
+        # initializer_range=0.02 (LLaMA convention) — also keeps logits
+        # sane when the embedding is reused as a tied lm_head.
+        from ..nn.initializer import Normal
+        from ..nn.layer import ParamAttr
+        emb_attr = ParamAttr(initializer=Normal(0.0, 0.02))
         if cfg.tensor_parallel:
-            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
-                                                       cfg.hidden_size)
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size, weight_attr=emb_attr)
         else:
-            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=emb_attr)
         self.layers = LayerList([LlamaDecoderLayer(cfg)
                                  for _ in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
@@ -225,11 +231,31 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
                                   bias_attr=False)
         if cfg.tie_word_embeddings:
-            self.lm_head.weight = self.llama.embed_tokens.weight
+            if cfg.tensor_parallel:
+                # Under TP the embedding weight is a vocab shard and the
+                # head needs the mp identity/gather collectives; wiring the
+                # tied path through them is not implemented — fail loudly
+                # rather than train with silently-wrong gradients.
+                raise NotImplementedError(
+                    "tie_word_embeddings with tensor_parallel is not "
+                    "supported yet; untie or disable tensor_parallel")
+            # Share the embedding Parameter ([vocab, hidden]); the head
+            # contracts against its transpose.
+            self.lm_head = _TiedLMHead(self.llama.embed_tokens.weight)
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
         h = self.llama(input_ids, position_ids, attn_mask)
         return self.lm_head(h)
+
+
+class _TiedLMHead(Layer):
+    def __init__(self, embedding_weight):
+        super().__init__()
+        self.weight = embedding_weight  # [vocab, hidden], shared Parameter
+
+    def forward(self, x):
+        from ..ops.math import matmul
+        return matmul(x, self.weight, transpose_y=True)
 
 
 class LlamaPretrainingCriterion(Layer):

@@ -11,6 +11,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time as _time
 
 import numpy as np
 
@@ -82,7 +83,14 @@ class TCPStore:
             self._server = lib.pd_store_server_start(port)
             if not self._server:
                 raise RuntimeError(f"TCPStore master failed to bind :{port}")
+        # Non-master workers may race the master's bind: retry until the
+        # timeout (reference TCPStore clients block on connect the same way).
+        deadline = _time.monotonic() + (120.0 if timeout is None
+                                        else timeout)
         self._client = lib.pd_store_client_new(host.encode(), port)
+        while not self._client and _time.monotonic() < deadline:
+            _time.sleep(0.1)
+            self._client = lib.pd_store_client_new(host.encode(), port)
         if not self._client:
             if self._server:
                 lib.pd_store_server_stop(self._server)

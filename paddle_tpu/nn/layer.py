@@ -151,8 +151,13 @@ class Layer:
         return parameter
 
     # -- iteration ---------------------------------------------------------
-    def named_parameters(self, prefix="", include_sublayers=True):
-        seen = set()
+    def named_parameters(self, prefix="", include_sublayers=True,
+                         _seen=None):
+        # `_seen` is threaded through the recursion so tied parameters
+        # (one Tensor reachable under several names, e.g. tied embeddings)
+        # are yielded exactly once — every consumer (optimizer param
+        # groups, jit donation, summary) relies on uniqueness.
+        seen = set() if _seen is None else _seen
         for name, p in self._parameters.items():
             if p is not None and id(p) not in seen:
                 seen.add(id(p))
@@ -163,7 +168,8 @@ class Layer:
                 if layer is None:
                     continue
                 sub_prefix = f"{prefix}.{lname}" if prefix else lname
-                for item in layer.named_parameters(sub_prefix):
+                for item in layer.named_parameters(sub_prefix,
+                                                   _seen=seen):
                     yield item
 
     def parameters(self, include_sublayers=True):

@@ -1,0 +1,29 @@
+"""paddle.quantization — QAT + PTQ on the XLA substrate.
+
+Reference surface: upstream python/paddle/quantization/ (unverified, see
+SURVEY.md §2.2 "Misc domains"): `QuantConfig` (per-layer/type configs),
+`QAT.quantize(model)` inserting fake-quant (quantize-dequantize) layers,
+`PTQ.quantize(model)` inserting observers, `.convert()` producing an
+inference model with frozen scales, observers (AbsmaxObserver, EMA) and
+quanters (FakeQuanterWithAbsMaxObserver, channel-wise weight quanter).
+
+TPU-native realization: fake-quant is a pure jnp round/clip pipeline with a
+clipped straight-through estimator via `jax.custom_vjp`, so QAT trains
+under the same tape/vjp autograd as every other op and fuses under jit.
+Converted inference layers store int8 weights and dequantize inline —
+XLA folds the dequant into the matmul epilogue on TPU.
+"""
+from .config import QuantConfig
+from .observers import AbsmaxObserver, EMAObserver, BaseObserver
+from .quanters import (FakeQuanterWithAbsMaxObserver,
+                       FakeQuanterChannelWiseAbsMax, fake_quant)
+from .qat import QAT, QuantedLinear, QuantedConv2D
+from .ptq import PTQ, QuantizedInferenceLinear
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "BaseObserver", "AbsmaxObserver", "EMAObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
+    "fake_quant", "QuantedLinear", "QuantedConv2D",
+    "QuantizedInferenceLinear",
+]

@@ -1,0 +1,51 @@
+"""QuantConfig — which layers get quantized and with what quanters.
+
+Reference parity: upstream python/paddle/quantization/config.py
+(unverified, see SURVEY.md §2.2): `add_layer_config` (by instance),
+`add_type_config` (by layer class), `add_name_config`, plus a default
+global config; `_get_config_by_layer` resolves precedence
+instance > name > type > global.
+"""
+from __future__ import annotations
+
+
+class _SingleConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global = _SingleConfig(activation, weight)
+        self._by_layer = {}    # id(layer) -> _SingleConfig
+        self._by_name = {}     # layer full name -> _SingleConfig
+        self._by_type = {}     # class -> _SingleConfig
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer[id(l)] = _SingleConfig(activation, weight)
+
+    def add_name_config(self, name, activation=None, weight=None):
+        names = name if isinstance(name, (list, tuple)) else [name]
+        for n in names:
+            self._by_name[n] = _SingleConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._by_type[t] = _SingleConfig(activation, weight)
+
+    def _get_config_by_layer(self, layer, name=""):
+        if id(layer) in self._by_layer:
+            return self._by_layer[id(layer)]
+        if name and name in self._by_name:
+            return self._by_name[name]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global.activation or self._global.weight:
+            return self._global
+        return None

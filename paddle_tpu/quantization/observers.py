@@ -1,0 +1,72 @@
+"""PTQ observers — collect activation statistics during calibration.
+
+Reference parity: upstream python/paddle/quantization/observers/
+(unverified, see SURVEY.md §2.2): AbsmaxObserver and moving-average
+variants that watch tensors flowing through a layer and later report a
+quantization scale via `cal_thresholds()/scales()`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+class BaseObserver(Layer):
+    """Identity layer that records statistics of what passes through."""
+
+    def __init__(self, bit_length=8):
+        super().__init__()
+        self._bit_length = bit_length
+
+    def forward(self, x):
+        self._observe(np.asarray(jnp.abs(x._data).max()))
+        return x
+
+    def _observe(self, absmax: float):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class AbsmaxObserver(BaseObserver):
+    """scale = max |x| over all calibration batches."""
+
+    def __init__(self, bit_length=8):
+        super().__init__(bit_length)
+        self._max = 1e-9
+
+    def _observe(self, absmax):
+        self._max = max(self._max, float(absmax))
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average absmax (smoother for spiky activations)."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        super().__init__(bit_length)
+        self._moving_rate = moving_rate
+        self._ema = None
+
+    def _observe(self, absmax):
+        v = float(absmax)
+        self._ema = v if self._ema is None else (
+            self._moving_rate * self._ema + (1 - self._moving_rate) * v)
+
+    def scales(self):
+        return Tensor(jnp.asarray(max(self._ema or 1e-9, 1e-9), jnp.float32))

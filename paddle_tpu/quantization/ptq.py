@@ -1,0 +1,95 @@
+"""PTQ — post-training quantization via observer insertion + convert.
+
+Reference parity: upstream python/paddle/quantization/ptq.py (unverified,
+see SURVEY.md §2.2): `PTQ(config).quantize(model)` wraps configured layers
+with observers; the user runs calibration batches; `convert()` freezes the
+observed scales into an inference model with int8 weights.
+
+TPU-native note: the converted layer stores genuine int8 weights and
+dequantizes inline (`w_i8 * scale / 127`); XLA constant-folds the dequant
+into the matmul on TPU, so memory is quartered while compute stays on the
+MXU in the activation dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import common as nn_common
+from ..nn import functional as F
+from ..nn.layer import Layer
+from .config import QuantConfig
+from .observers import AbsmaxObserver
+from .quanters import fake_quant, quantize_to_int8
+
+
+class _ObservedLinear(Layer):
+    def __init__(self, layer: nn_common.Linear, q_config):
+        super().__init__()
+        self._layer = layer
+        obs_cls = q_config.activation or AbsmaxObserver
+        self.activation_observer = obs_cls()
+
+    def forward(self, x):
+        x = self.activation_observer(x)
+        return self._layer(x)
+
+
+class QuantizedInferenceLinear(Layer):
+    """Deployment linear: int8 weight + f32 per-channel scale. When an
+    activation scale was calibrated, inputs are snapped to the int8 grid
+    (quantize-dequantize) so the output matches true int8×int8 execution."""
+
+    def __init__(self, weight_i8, w_scale, bias, act_scale=None):
+        super().__init__()
+        self.register_buffer("weight_quant", Tensor(jnp.asarray(weight_i8)))
+        self.register_buffer("weight_scale", Tensor(jnp.asarray(w_scale)))
+        self.bias = bias
+        self._act_scale = act_scale
+
+    def forward(self, x):
+        if self._act_scale is not None:
+            x = fake_quant(x, Tensor(jnp.asarray(self._act_scale,
+                                                 jnp.float32)))
+        w = (self.weight_quant._data.astype(x._data.dtype) *
+             (self.weight_scale._data / 127.0).astype(x._data.dtype))
+        y = x @ Tensor(w)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig | None = None):
+        self._config = config or QuantConfig(activation=AbsmaxObserver,
+                                             weight=None)
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            raise NotImplementedError(
+                "copy-quantize not supported; pass inplace=True")
+        self._walk(model, "")
+        return model
+
+    def _walk(self, layer: Layer, prefix: str):
+        for name, child in list(layer._sub_layers.items()):
+            qname = f"{prefix}.{name}" if prefix else name
+            if type(child) is nn_common.Linear:
+                cfg = self._config._get_config_by_layer(child, qname)
+                if cfg is not None:
+                    layer._sub_layers[name] = _ObservedLinear(child, cfg)
+                    continue
+            self._walk(child, qname)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                if not isinstance(child, _ObservedLinear):
+                    continue
+                child.activation_observer.cal_thresholds()
+                act_scale = float(child.activation_observer.scales())
+                w = child._layer.weight.numpy()
+                w_i8, w_scale = quantize_to_int8(w, quant_axis=1)
+                parent._sub_layers[name] = QuantizedInferenceLinear(
+                    w_i8, w_scale, child._layer.bias, act_scale)
+        return model

@@ -1,0 +1,123 @@
+"""QAT — quantization-aware training via fake-quant layer substitution.
+
+Reference parity: upstream python/paddle/quantization/qat.py (unverified,
+see SURVEY.md §2.2): `QAT(config).quantize(model, inplace=True)` walks the
+model and swaps configured layers for quanted wrappers that fake-quant
+weights and activations in forward; training then proceeds normally (STE
+gradients), and `convert()` strips the quanters for deployment.
+"""
+from __future__ import annotations
+
+from ..nn import conv as nn_conv
+from ..nn import common as nn_common
+from ..nn import functional as F
+from ..nn.layer import Layer
+from .config import QuantConfig
+from .quanters import (FakeQuanterChannelWiseAbsMax,
+                       FakeQuanterWithAbsMaxObserver)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted weight and (optionally) activation."""
+
+    def __init__(self, layer: nn_common.Linear, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.weight_quanter = (q_config.weight() if q_config.weight
+                               else FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        self.activation_quanter = (q_config.activation()
+                                   if q_config.activation else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight_quanter(self.weight)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    """Conv2D with fake-quanted weight and (optionally) activation."""
+
+    def __init__(self, layer: nn_conv.Conv2D, q_config):
+        super().__init__()
+        self._layer = layer
+        self.weight_quanter = (q_config.weight() if q_config.weight
+                               else FakeQuanterChannelWiseAbsMax(quant_axis=0))
+        self.activation_quanter = (q_config.activation()
+                                   if q_config.activation else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight_quanter(self._layer.weight)
+        lay = self._layer
+        return F.conv2d(x, w, lay.bias, stride=lay.stride,
+                        padding=lay.padding, dilation=lay.dilation,
+                        groups=lay.groups, data_format=lay.data_format)
+
+
+_QAT_MAPPING = {
+    nn_common.Linear: QuantedLinear,
+    nn_conv.Conv2D: QuantedConv2D,
+}
+
+
+def _walk_and_replace(model: Layer, config: QuantConfig, mapping, factory,
+                      _prefix=""):
+    """Replace configured sublayers in-place (recursive, so name-based
+    configs see the fully qualified dotted path); returns replacement
+    count."""
+    count = 0
+    for name, child in list(model._sub_layers.items()):
+        qname = f"{_prefix}.{name}" if _prefix else name
+        cls = None
+        for src, dst in mapping.items():
+            if type(child) is src:
+                cls = dst
+                break
+        cfg = (config._get_config_by_layer(child, qname)
+               if cls is not None else None)
+        if cls is not None and cfg is not None:
+            model._sub_layers[name] = factory(cls, child, cfg)
+            count += 1
+        else:
+            count += _walk_and_replace(child, config, mapping, factory,
+                                       _prefix=qname)
+    return count
+
+
+class QAT:
+    def __init__(self, config: QuantConfig | None = None):
+        self._config = config or QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver,
+            weight=None)
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            raise NotImplementedError(
+                "copy-quantize not supported; pass inplace=True")
+        _walk_and_replace(model, self._config, _QAT_MAPPING,
+                          lambda cls, child, cfg: cls(child, cfg))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Freeze: swap quanted layers back to plain layers whose weights
+        are the (fake-)quantized values — deployment-ready float graph."""
+        from ..core.tensor import Parameter
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                if isinstance(child, QuantedLinear):
+                    lin = nn_common.Linear.__new__(nn_common.Linear)
+                    Layer.__init__(lin)
+                    w = child.weight_quanter(child.weight.detach())
+                    lin.in_features, lin.out_features = w.shape
+                    lin.weight = Parameter(w._data)
+                    lin.bias = child.bias
+                    parent._sub_layers[name] = lin
+                elif isinstance(child, QuantedConv2D):
+                    src = child._layer
+                    w = child.weight_quanter(src.weight.detach())
+                    src.weight = Parameter(w._data)
+                    parent._sub_layers[name] = src
+        return model

@@ -1,0 +1,116 @@
+"""Fake quantizers (quantize-dequantize with straight-through gradients).
+
+Reference parity: upstream python/paddle/quantization/quanters/abs_max.py
+`FakeQuanterWithAbsMaxObserver` (unverified, see SURVEY.md §2.2) — a QAT
+quanter that tracks a moving-average absmax scale and applies
+quantize-dequantize in the forward pass; gradients flow through via STE.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, _is_tracer
+from ..nn.layer import Layer
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant_jax(x, scale, qmax):
+    """quantize-dequantize: round(clip(x/step)) * step, step = scale/qmax."""
+    step = scale / qmax
+    q = jnp.clip(jnp.round(x / step), -qmax - 1, qmax)
+    return q * step
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant_jax(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(qmax, res, g):
+    # clipped STE: pass gradient only where x was inside the clip range.
+    x, scale = res
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_quant_jax.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Functional quantize-dequantize with clipped-STE gradient."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(lambda a, s: _fake_quant_jax(a, s, qmax), x, scale,
+                 name="fake_quant")
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT activation quanter: moving-average absmax scale + fake quant.
+
+    The scale is a (non-trainable) buffer updated from batch statistics in
+    eager forward; under jit tracing the stored scale is used as-is (state
+    updates are frozen at trace time, matching the reference's inference
+    behavior of a converted model).
+    """
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self._qmax = float(2 ** (bit_length - 1) - 1)
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training and not _is_tracer(x._data):
+            absmax = jnp.max(jnp.abs(x._data)).astype(jnp.float32)
+            r = self._moving_rate
+            state = self.state._data * r + 1.0
+            scale = (self.scale._data * self.state._data * r + absmax) / state
+            self.scale._data = jnp.maximum(scale, 1e-9)
+            self.state._data = state
+        return fake_quant(x, Tensor(self.scale._data),
+                          bit_length=self._bit_length)
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        return Tensor(self.scale._data)
+
+
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """Weight quanter: per-output-channel absmax, recomputed each forward
+    (weights are known — no moving average needed, mirroring the reference's
+    channel-wise weight quanter)."""
+
+    def __init__(self, quant_axis=1, bit_length=8, dtype="float32"):
+        super().__init__()
+        self._quant_axis = quant_axis
+        self._bit_length = bit_length
+        self._qmax = float(2 ** (bit_length - 1) - 1)
+
+    def forward(self, w):
+        axes = tuple(i for i in range(w.ndim) if i != self._quant_axis)
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(w._data)),
+                        axis=axes, keepdims=True)
+        scale = jnp.maximum(scale.astype(jnp.float32), 1e-9)
+        return fake_quant(w, Tensor(scale), bit_length=self._bit_length)
+
+    def quant_axis(self):
+        return self._quant_axis
+
+
+def quantize_to_int8(arr, quant_axis=None):
+    """Real quantization for PTQ convert: returns (int8 values, f32 scale)."""
+    arr = np.asarray(arr, dtype=np.float32)
+    if quant_axis is None:
+        scale = np.maximum(np.abs(arr).max(), 1e-9)
+    else:
+        axes = tuple(i for i in range(arr.ndim) if i != quant_axis)
+        scale = np.maximum(np.abs(arr).max(axis=axes, keepdims=True), 1e-9)
+    q = np.clip(np.round(arr / scale * 127.0), -128, 127).astype(np.int8)
+    return q, np.asarray(scale, np.float32)

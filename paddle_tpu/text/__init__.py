@@ -1,0 +1,120 @@
+"""paddle.text — NLP domain utilities.
+
+Reference parity: upstream python/paddle/text/ (unverified, see SURVEY.md
+§2.2 "Misc domains"): `ViterbiDecoder`/`viterbi_decode` plus dataset
+loaders. Datasets require downloads (this environment has zero egress),
+so the loaders accept a local `data_file` and raise a clear error
+otherwise.
+
+TPU-native note: Viterbi is a classic sequential DP — realized as a
+`lax.scan` over time steps (max-product forward + backtrace), so the
+whole decode compiles to one XLA program instead of a Python loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing"]
+
+
+def _viterbi_jax(potentials, lengths, trans, include_bos_eos_tag):
+    """potentials [B,T,N], lengths [B], trans [N,N] -> (scores, paths)."""
+    b, t, n = potentials.shape
+
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 = BOS, N-1 = EOS
+        bos_mask = jnp.full((n,), -1e4).at[:n - 2].set(0.0)
+        init = potentials[:, 0, :] + trans[n - 2][None, :]
+    else:
+        init = potentials[:, 0, :]
+
+    def step(carry, xs):
+        alpha, idx = carry
+        emit, t_idx = xs  # emit [B,N]
+        # score[b, i, j] = alpha[b, i] + trans[i, j]
+        score = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(score, axis=1)                  # [B,N]
+        alpha_new = jnp.max(score, axis=1) + emit              # [B,N]
+        # frozen past sequence end
+        active = (t_idx < lengths)[:, None]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n)[None, :])
+        return (alpha_new, idx), best_prev
+
+    xs = (jnp.moveaxis(potentials[:, 1:, :], 1, 0),
+          jnp.arange(1, t))
+    (alpha, _), backptrs = jax.lax.scan(step, (init, 0), xs)
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1)                       # [B]
+
+    def back(carry, bp):
+        # carry = tag at time k+1; bp[k] maps it to the tag at time k,
+        # which is both the next carry and the emitted path element.
+        prev = jnp.take_along_axis(bp, carry[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(back, last_tag, backptrs,
+                               reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             last_tag[:, None]], axis=1)       # [B,T]
+    return scores, paths.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    potentials = to_tensor(potentials) if not isinstance(potentials, Tensor) \
+        else potentials
+    transition_params = to_tensor(transition_params) \
+        if not isinstance(transition_params, Tensor) else transition_params
+    lengths = to_tensor(lengths) if not isinstance(lengths, Tensor) \
+        else lengths
+    return apply(
+        lambda p, tr, ln: _viterbi_jax(p, ln, tr, include_bos_eos_tag),
+        potentials, transition_params, lengths, name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Reference parity: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else to_tensor(transitions)
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)
+
+
+class UCIHousing:
+    """Reference parity: paddle.text.datasets.UCIHousing, from a local
+    whitespace-separated file (no network in this environment)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            raise ValueError(
+                "this environment has no network access; pass data_file= "
+                "pointing at a local housing.data copy")
+        raw = np.loadtxt(data_file, dtype=np.float32)
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        split = int(0.8 * len(x))
+        sl = slice(0, split) if mode == "train" else slice(split, None)
+        self.data = list(zip(x[sl], y[sl]))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]

@@ -1,6 +1,22 @@
-"""paddle_tpu.vision.models."""
+"""paddle_tpu.vision.models — the reference's model zoo, TPU-native."""
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
+                       densenet169, densenet201, densenet264)
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
-from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
-                     resnet152, wide_resnet50_2, wide_resnet101_2)
+from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+from .mobilenetv3 import (MobileNetV3, mobilenet_v3_large,  # noqa: F401
+                          mobilenet_v3_small)
+from .resnet import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
+                     resnet101, resnet152, resnext50_32x4d,
+                     resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+                     resnext152_32x4d, resnext152_64x4d,
+                     wide_resnet50_2, wide_resnet101_2)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,  # noqa: F401
+                           shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                           shufflenet_v2_x2_0)
+from .squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
+                         squeezenet1_1)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,84 @@
+"""DenseNet-121/161/169/201/264.
+
+Reference parity: paddle.vision.models.densenet121 et al. (upstream
+python/paddle/vision/models/densenet.py — unverified, SURVEY.md §2.2).
+"""
+from ... import nn
+from ...ops import manipulation as M
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size=4):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.norm1(x)))
+        y = self.conv2(self.relu(self.norm2(y)))
+        return M.concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(cin)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, num_classes=1000):
+        super().__init__()
+        init_c, growth, blocks = _CFG[layers]
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x)).flatten(1)
+        return self.classifier(x)
+
+
+def _make(layers):
+    def f(pretrained=False, **kw):
+        assert not pretrained
+        return DenseNet(layers, **kw)
+    f.__name__ = f"densenet{layers}"
+    return f
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)
+densenet264 = _make(264)

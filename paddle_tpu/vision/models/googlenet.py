@@ -1,0 +1,90 @@
+"""GoogLeNet (Inception v1).
+
+Reference parity: paddle.vision.models.googlenet (upstream
+python/paddle/vision/models/googlenet.py — unverified, SURVEY.md §2.2).
+Returns (main, aux1, aux2) logits in train mode like the reference.
+"""
+from ... import nn
+from ...ops import manipulation as M
+
+
+def _conv(cin, cout, k, **kw):
+    return nn.Sequential(nn.Conv2D(cin, cout, k, **kw), nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _conv(cin, c1, 1)
+        self.b2 = nn.Sequential(_conv(cin, c3r, 1),
+                                _conv(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv(cin, c5r, 1),
+                                _conv(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv(cin, pp, 1))
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                        axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _conv(cin, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x)).flatten(1)
+        return self.fc2(self.drop(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_aux=True):
+        super().__init__()
+        self.with_aux = with_aux
+        self.stem = nn.Sequential(
+            _conv(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv(64, 64, 1), _conv(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.drop = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        if with_aux:
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = self.aux1(x) if self.with_aux and self.training else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x) if self.with_aux and self.training else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        out = self.fc(self.drop(self.avgpool(x).flatten(1)))
+        if self.training and self.with_aux:
+            return out, a1, a2
+        return out
+
+
+def googlenet(pretrained=False, **kw):
+    assert not pretrained
+    return GoogLeNet(**kw)

@@ -1,0 +1,131 @@
+"""Inception v3.
+
+Reference parity: paddle.vision.models.inception_v3 (upstream
+python/paddle/vision/models/inceptionv3.py — unverified, SURVEY.md §2.2).
+Compact faithful topology (A/B/C/D/E blocks); aux head omitted in eval.
+"""
+from ... import nn
+from ...ops import manipulation as M
+
+
+def _conv(cin, cout, k, **kw):
+    return nn.Sequential(nn.Conv2D(cin, cout, k, bias_attr=False, **kw),
+                         nn.BatchNorm2D(cout), nn.ReLU())
+
+
+def _cat(xs):
+    return M.concat(xs, axis=1)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, cin, pool_c):
+        super().__init__()
+        self.b1 = _conv(cin, 64, 1)
+        self.b5 = nn.Sequential(_conv(cin, 48, 1),
+                                _conv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_conv(cin, 64, 1),
+                                _conv(64, 96, 3, padding=1),
+                                _conv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv(cin, pool_c, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _InceptionB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _conv(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_conv(cin, 64, 1),
+                                 _conv(64, 96, 3, padding=1),
+                                 _conv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _conv(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv(cin, c7, 1), _conv(c7, c7, (1, 7), padding=(0, 3)),
+            _conv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _conv(cin, c7, 1), _conv(c7, c7, (7, 1), padding=(3, 0)),
+            _conv(c7, c7, (1, 7), padding=(0, 3)),
+            _conv(c7, c7, (7, 1), padding=(3, 0)),
+            _conv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv(cin, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)])
+
+
+class _InceptionD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_conv(cin, 192, 1),
+                                _conv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _conv(cin, 192, 1), _conv(192, 192, (1, 7), padding=(0, 3)),
+            _conv(192, 192, (7, 1), padding=(3, 0)),
+            _conv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _conv(cin, 320, 1)
+        self.b3_stem = _conv(cin, 384, 1)
+        self.b3_a = _conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = nn.Sequential(_conv(cin, 448, 1),
+                                     _conv(448, 384, 3, padding=1))
+        self.bd_a = _conv(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _conv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv(cin, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        sd = self.bd_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s3), self.b3_b(s3)]),
+                     _cat([self.bd_a(sd), self.bd_b(sd)]),
+                     self.bp(x)])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv(3, 32, 3, stride=2), _conv(32, 32, 3),
+            _conv(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _conv(64, 80, 1), _conv(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.drop = nn.Dropout(0.5)
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        return self.fc(self.drop(self.avgpool(x).flatten(1)))
+
+
+def inception_v3(pretrained=False, **kw):
+    assert not pretrained
+    return InceptionV3(**kw)

@@ -1,0 +1,51 @@
+"""MobileNetV1.
+
+Reference parity: paddle.vision.models.mobilenet_v1 (upstream
+python/paddle/vision/models/mobilenetv1.py — unverified, SURVEY.md §2.2).
+"""
+from ... import nn
+
+
+def _conv_bn(cin, cout, k, stride=1, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class _DepthwiseSep(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = _conv_bn(cin, cin, 3, stride=stride, groups=cin)
+        self.pw = _conv_bn(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+               (1024, 2), (1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2)]
+        cin = c(32)
+        for cout, stride in cfg:
+            layers.append(_DepthwiseSep(cin, c(cout), stride))
+            cin = c(cout)
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        return self.fc(self.pool(self.features(x)).flatten(1))
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    assert not pretrained
+    return MobileNetV1(scale=scale, **kw)

@@ -1,0 +1,111 @@
+"""MobileNetV3 small/large.
+
+Reference parity: paddle.vision.models.mobilenet_v3_small/_large (upstream
+python/paddle/vision/models/mobilenetv3.py — unverified, SURVEY.md §2.2).
+"""
+from ... import nn
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(cin, cout, k, stride=1, groups=1, act=None):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class _SEModule(nn.Layer):
+    def __init__(self, c, reduction=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, c // reduction, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(c // reduction, c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_conv_bn(cin, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, groups=exp,
+                               act=act))
+        if use_se:
+            layers.append(_SEModule(exp))
+        layers.append(_conv_bn(exp, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+_LARGE = [
+    # k, exp, c, se, act, s
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_c, scale=1.0, num_classes=1000):
+        super().__init__()
+        cin = _make_divisible(16 * scale)
+        layers = [_conv_bn(3, cin, 3, stride=2, act="hardswish")]
+        for k, exp, c, se, act, s in config:
+            cout = _make_divisible(c * scale)
+            layers.append(_InvertedResidual(
+                cin, _make_divisible(exp * scale), cout, k, s, se, act))
+            cin = cout
+        last_exp = _make_divisible(config[-1][1] * scale)
+        layers.append(_conv_bn(cin, last_exp, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(last_exp, last_c), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        return self.classifier(x)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    assert not pretrained
+    return MobileNetV3(_LARGE, 1280, scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    assert not pretrained
+    return MobileNetV3(_SMALL, 1024, scale=scale, **kw)

@@ -1,0 +1,103 @@
+"""ShuffleNetV2.
+
+Reference parity: paddle.vision.models.shufflenet_v2_* (upstream
+python/paddle/vision/models/shufflenetv2.py — unverified, SURVEY.md §2.2).
+Channel shuffle is a reshape/transpose pair — pure layout ops XLA folds.
+"""
+from ... import nn
+from ...ops import manipulation as M
+
+_CFG = {
+    "0.5": (24, (48, 96, 192), 1024),
+    "1.0": (24, (116, 232, 464), 1024),
+    "1.5": (24, (176, 352, 704), 1024),
+    "2.0": (24, (244, 488, 976), 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+def _conv_bn(cin, cout, k, stride=1, groups=1, act=True):
+    pad = k // 2
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(cin // 2, branch, 1),
+                _conv_bn(branch, branch, 3, stride, groups=branch,
+                         act=False),
+                _conv_bn(branch, branch, 1))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(cin, cin, 3, stride, groups=cin, act=False),
+                _conv_bn(cin, branch, 1))
+            self.branch2 = nn.Sequential(
+                _conv_bn(cin, branch, 1),
+                _conv_bn(branch, branch, 3, stride, groups=branch,
+                         act=False),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale="1.0", num_classes=1000):
+        super().__init__()
+        init_c, stages, final_c = _CFG[str(scale)]
+        self.conv1 = _conv_bn(3, init_c, 3, stride=2)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        cin = init_c
+        for cout, rep in zip(stages, _REPEATS):
+            blocks.append(_InvertedResidual(cin, cout, stride=2))
+            for _ in range(rep - 1):
+                blocks.append(_InvertedResidual(cout, cout, stride=1))
+            cin = cout
+        self.stages = nn.Sequential(*blocks)
+        self.conv5 = _conv_bn(cin, final_c, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(final_c, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stages(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def _make(scale):
+    def f(pretrained=False, **kw):
+        assert not pretrained
+        return ShuffleNetV2(scale, **kw)
+    f.__name__ = f"shufflenet_v2_x{scale.replace('.', '_')}"
+    return f
+
+
+shufflenet_v2_x0_5 = _make("0.5")
+shufflenet_v2_x1_0 = _make("1.0")
+shufflenet_v2_x1_5 = _make("1.5")
+shufflenet_v2_x2_0 = _make("2.0")

@@ -181,3 +181,28 @@ class TestModelFit:
                        nn.CrossEntropyLoss())
         model2.load(path)
         assert np.allclose(net.fc.weight.numpy(), net2.fc.weight.numpy())
+
+
+class TestTiedParameters:
+    def test_train_batch_with_tied_embeddings(self):
+        """Shared Parameters must not be donated twice into the jit step
+        (regression: tie_word_embeddings crashed with 'Attempt to donate
+        the same buffer twice')."""
+        import numpy as np
+
+        import paddle_tpu as P
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+
+        P.seed(0)
+        cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        opt = P.optimizer.AdamW(1e-3, parameters=model.parameters())
+        m = P.Model(model)
+        m.prepare(opt, crit)
+        ids = P.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 16)).astype(np.int32))
+        l1 = float(m.train_batch([ids], [ids]))
+        l2 = float(m.train_batch([ids], [ids]))
+        assert np.isfinite(l1) and np.isfinite(l2)

@@ -1,0 +1,102 @@
+"""paddle.inference predictor + RoleMaker tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+from paddle_tpu.jit.save_load import InputSpec
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class TestPredictor:
+    def test_save_then_predict(self, tmp_path):
+        P.seed(0)
+        net = _Net()
+        net.eval()
+        x = np.random.default_rng(0).standard_normal((2, 8)) \
+            .astype(np.float32)
+        expect = net(P.to_tensor(x)).numpy()
+
+        prefix = str(tmp_path / "model")
+        P.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+
+        config = Config(prefix)
+        predictor = create_predictor(config)
+        names = predictor.get_input_names()
+        assert len(names) == 1
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert predictor.run()
+        out_name = predictor.get_output_names()[0]
+        got = predictor.get_output_handle(out_name).copy_to_cpu()
+        np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+    def test_run_with_direct_inputs(self, tmp_path):
+        P.seed(1)
+        net = _Net()
+        net.eval()
+        prefix = str(tmp_path / "m2")
+        P.jit.save(net, prefix, input_spec=[InputSpec([3, 8], "float32")])
+        x = np.ones((3, 8), np.float32)
+        outs = create_predictor(Config(prefix)).run([x])
+        assert outs[0].shape == (3, 4)
+
+    def test_config_surface(self):
+        c = Config("some/prefix")
+        c.enable_use_gpu(100, 0)
+        c.enable_memory_optim()
+        c.switch_ir_optim(True)
+        c.enable_tensorrt_engine(precision_mode=PrecisionType.Bfloat16)
+        assert "bfloat16" in c.summary()
+
+
+class TestRoleMaker:
+    def test_paddlecloud_from_env(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv(
+            "PADDLE_TRAINER_ENDPOINTS",
+            "10.0.0.1:6170,10.0.0.1:6171,10.0.0.2:6170,10.0.0.2:6171")
+        monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "10.0.0.2:6170")
+        rm = PaddleCloudRoleMaker(is_collective=True)
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert not rm.is_first_worker()
+        assert rm.node_num() == 2
+        assert len(rm.get_trainer_endpoints()) == 4
+
+    def test_user_defined(self):
+        from paddle_tpu.distributed.fleet import UserDefinedRoleMaker
+        rm = UserDefinedRoleMaker(
+            current_id=0, worker_num=2,
+            worker_endpoints=["127.0.0.1:1", "127.0.0.1:2"])
+        assert rm.is_first_worker()
+        assert rm.get_current_endpoint() == "127.0.0.1:1"
+
+    def test_fleet_init_attaches_role_maker(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.fleet import _state
+        from paddle_tpu.distributed.fleet.topology import \
+            set_hybrid_communicate_group
+        _state.initialized = False
+        set_hybrid_communicate_group(None)
+        try:
+            fleet.init(is_collective=True)
+            assert _state.role_maker is not None
+            assert _state.role_maker.is_worker()
+        finally:
+            _state.initialized = False
+            set_hybrid_communicate_group(None)

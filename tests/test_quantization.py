@@ -1,0 +1,222 @@
+"""Quantization tests — QAT fake-quant training + PTQ calibrate/convert.
+
+Mirrors the reference's test strategy (SURVEY.md §4): NumPy oracles for the
+quantize-dequantize math, loss-goes-down for QAT trainability, and
+closeness of the converted int8 model to the float model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import nn
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver, EMAObserver,
+                                     FakeQuanterChannelWiseAbsMax,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantConfig, QuantedConv2D,
+                                     QuantedLinear, QuantizedInferenceLinear,
+                                     fake_quant)
+
+
+def _np_fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    step = scale / qmax
+    return np.clip(np.round(x / step), -qmax - 1, qmax) * step
+
+
+class TestFakeQuant:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        scale = np.float32(2.5)
+        out = fake_quant(P.to_tensor(x), P.to_tensor(scale))
+        np.testing.assert_allclose(out.numpy(), _np_fake_quant(x, scale),
+                                   rtol=1e-6)
+
+    def test_ste_gradient_clips(self):
+        # gradient passes inside [-scale, scale], zero outside
+        x = P.to_tensor(np.array([0.5, -0.3, 4.0, -5.0], np.float32))
+        x.stop_gradient = False
+        scale = P.to_tensor(np.float32(1.0))
+        out = fake_quant(x, scale)
+        out.backward(P.to_tensor(np.ones(4, np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.array([1, 1, 0, 0], np.float32))
+
+
+class TestQAT:
+    def _model(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.relu = nn.ReLU()
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(self.relu(self.fc1(x)))
+        return Net()
+
+    def test_quantize_replaces_layers(self):
+        model = self._model()
+        QAT().quantize(model, inplace=True)
+        assert isinstance(model.fc1, QuantedLinear)
+        assert isinstance(model.fc2, QuantedLinear)
+
+    def test_qat_trains(self):
+        P.seed(0)
+        model = self._model()
+        qat = QAT()
+        qat.quantize(model, inplace=True)
+        opt = P.optimizer.Adam(0.01, parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        x = P.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+        y = P.to_tensor(rng.integers(0, 4, 16).astype(np.int64))
+        loss_fn = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(30):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+    def test_convert_freezes_quantized_weights(self):
+        P.seed(0)
+        model = self._model()
+        qat = QAT()
+        qat.quantize(model, inplace=True)
+        x = np.random.default_rng(0).standard_normal((4, 8)) \
+            .astype(np.float32)
+
+        # NumPy oracle: plain linears over channel-wise fake-quanted weights
+        # (convert drops the activation quanters).
+        def fq_w(w):
+            scale = np.maximum(np.abs(w).max(axis=0, keepdims=True), 1e-9)
+            return _np_fake_quant(w, scale)
+
+        w1, b1 = model.fc1.weight.numpy(), model.fc1.bias.numpy()
+        w2, b2 = model.fc2.weight.numpy(), model.fc2.bias.numpy()
+        expect = np.maximum(x @ fq_w(w1) + b1, 0) @ fq_w(w2) + b2
+
+        qat.convert(model, inplace=True)
+        assert type(model.fc1) is nn.Linear
+        model.eval()
+        y_conv = model(P.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y_conv, expect, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_qat(self):
+        P.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        model = Net()
+        QAT().quantize(model, inplace=True)
+        assert isinstance(model.conv, QuantedConv2D)
+        x = P.to_tensor(np.random.default_rng(0)
+                        .standard_normal((2, 3, 8, 8)).astype(np.float32))
+        x.stop_gradient = False
+        out = model(x)
+        out.sum().backward()
+        assert model.conv._layer.weight.grad is not None
+
+
+class TestPTQ:
+    def test_calibrate_convert_close_to_float(self):
+        P.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        model = Net()
+        rng = np.random.default_rng(0)
+        xs = [rng.standard_normal((8, 16)).astype(np.float32)
+              for _ in range(4)]
+        ref = [model(P.to_tensor(x)).numpy() for x in xs]
+
+        ptq = PTQ()
+        ptq.quantize(model, inplace=True)
+        for x in xs:  # calibration
+            model(P.to_tensor(x))
+        ptq.convert(model, inplace=True)
+        assert isinstance(model.fc, QuantizedInferenceLinear)
+        assert model.fc.weight_quant.numpy().dtype == np.int8
+        for x, r in zip(xs, ref):
+            out = model(P.to_tensor(x)).numpy()
+            # int8 per-channel weight quantization: ~1% relative error
+            assert np.abs(out - r).max() < 0.05 * np.abs(r).max() + 0.05
+
+    def test_observers(self):
+        obs = AbsmaxObserver()
+        obs(P.to_tensor(np.array([1.0, -3.0], np.float32)))
+        obs(P.to_tensor(np.array([2.0, -0.5], np.float32)))
+        assert abs(float(obs.scales()) - 3.0) < 1e-6
+
+        ema = EMAObserver(moving_rate=0.5)
+        ema(P.to_tensor(np.array([4.0], np.float32)))
+        ema(P.to_tensor(np.array([2.0], np.float32)))
+        assert abs(float(ema.scales()) - 3.0) < 1e-6
+
+    def test_name_config_uses_qualified_path(self):
+        class Inner(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.block1 = Inner()
+                self.block2 = Inner()
+
+            def forward(self, x):
+                return self.block2(self.block1(x))
+
+        net = Net()
+        cfg = QuantConfig()
+        cfg.add_name_config("block1.fc",
+                            activation=FakeQuanterWithAbsMaxObserver)
+        QAT(cfg).quantize(net, inplace=True)
+        assert isinstance(net.block1.fc, QuantedLinear)
+        assert type(net.block2.fc) is nn.Linear  # untouched
+
+    def test_convert_handles_conv(self):
+        P.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        net = Net()
+        qat = QAT()
+        qat.quantize(net, inplace=True)
+        qat.convert(net, inplace=True)
+        assert type(net.conv) is nn.Conv2D
+        x = P.to_tensor(np.zeros((1, 3, 4, 4), np.float32))
+        assert tuple(net(x).shape) == (1, 4, 4, 4)
+
+    def test_quant_config_precedence(self):
+        lin1, lin2 = nn.Linear(2, 2), nn.Linear(2, 2)
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Linear, activation=AbsmaxObserver)
+        cfg.add_layer_config(lin1, activation=EMAObserver)
+        assert cfg._get_config_by_layer(lin1).activation is EMAObserver
+        assert cfg._get_config_by_layer(lin2).activation is AbsmaxObserver

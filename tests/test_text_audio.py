@@ -1,0 +1,99 @@
+"""paddle.text (Viterbi) + paddle.audio (features) tests.
+
+Viterbi is checked against a brute-force NumPy oracle enumerating all
+tag paths (small N, T) — the reference's OpTest pattern (SURVEY.md §4).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import audio, text
+
+
+def _brute_force_viterbi(pot, trans, length, bos_eos):
+    t, n = pot.shape
+    t = length
+    best_score, best_path = -1e30, None
+    for path in itertools.product(range(n), repeat=t):
+        score = 0.0
+        if bos_eos:
+            score += trans[n - 2, path[0]]
+        score += pot[0, path[0]]
+        for i in range(1, t):
+            score += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        if bos_eos:
+            score += trans[path[-1], n - 1]
+        if score > best_score:
+            best_score, best_path = score, path
+    return best_score, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_bruteforce(self, bos_eos):
+        rng = np.random.default_rng(0)
+        b, t, n = 3, 5, 4
+        pot = rng.standard_normal((b, t, n)).astype(np.float32)
+        trans = rng.standard_normal((n, n)).astype(np.float32)
+        lengths = np.array([5, 5, 5], np.int64)
+        scores, paths = text.viterbi_decode(
+            P.to_tensor(pot), P.to_tensor(trans), P.to_tensor(lengths),
+            include_bos_eos_tag=bos_eos)
+        for i in range(b):
+            es, ep = _brute_force_viterbi(pot[i], trans, 5, bos_eos)
+            assert abs(float(scores.numpy()[i]) - es) < 1e-4
+            assert list(paths.numpy()[i]) == ep
+
+    def test_decoder_layer(self):
+        rng = np.random.default_rng(1)
+        trans = rng.standard_normal((4, 4)).astype(np.float32)
+        dec = text.ViterbiDecoder(P.to_tensor(trans),
+                                  include_bos_eos_tag=False)
+        pot = P.to_tensor(rng.standard_normal((2, 3, 4)).astype(np.float32))
+        lens = P.to_tensor(np.array([3, 3], np.int64))
+        scores, paths = dec(pot, lens)
+        assert paths.shape == [2, 3]
+
+
+class TestAudio:
+    def test_mel_hz_roundtrip(self):
+        freqs = np.array([100.0, 440.0, 1000.0, 4000.0], np.float32)
+        mels = audio.functional.hz_to_mel(freqs)
+        back = audio.functional.mel_to_hz(mels)
+        np.testing.assert_allclose(back, freqs, rtol=1e-4)
+
+    def test_fbank_shape_and_partition(self):
+        fb = audio.functional.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert fb.sum(axis=1).min() > 0  # every filter nonempty
+
+    def test_spectrogram_parseval(self):
+        # rectangular window, no centering: power spectrum sums match
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 512)).astype(np.float32)
+        spec = audio.Spectrogram(n_fft=512, hop_length=512,
+                                 window="rect", center=False, power=2.0)
+        s = spec(P.to_tensor(x)).numpy()[0, :, 0]
+        # Parseval for rfft: sum|X|^2 (with symmetric doubling) = N*sum x^2
+        total = s[0] + s[-1] + 2 * s[1:-1].sum()
+        np.testing.assert_allclose(total, 512 * (x ** 2).sum(),
+                                   rtol=1e-3)
+
+    def test_logmel_and_mfcc_shapes(self):
+        rng = np.random.default_rng(0)
+        x = P.to_tensor(rng.standard_normal((2, 2048)).astype(np.float32))
+        lm = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=32)
+        out = lm(x)
+        assert out.shape[0] == 2 and out.shape[1] == 32
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=32)
+        out2 = mfcc(x)
+        assert out2.shape[0] == 2 and out2.shape[1] == 13
+
+    def test_dct_orthonormal(self):
+        d = audio.functional.create_dct(13, 40).numpy()
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
