@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels (forward + backward).
 
 Design (per /opt/skills/guides/pallas_guide.md): grid over
 (batch*heads, query blocks); each kernel instance streams K/V through VMEM
@@ -7,8 +7,20 @@ q@k^T and p@v products hit the MXU (block sizes multiples of 128 on the
 lane dim). Causal masking prunes fully-masked K blocks via a dynamic
 fori_loop upper bound, so the causal kernel does ~half the FLOPs.
 
+Backward (FlashAttention-2 style): the forward saves the per-row
+logsumexp broadcast over a 128-lane minor dim (the TPU-native layout for
+per-row scalars — [bq, 1] columns tile badly). Two kernels:
+  - dq: grid over q blocks, streams K/V, recomputes p from (q, k, lse).
+  - dkv: grid over k blocks, streams Q/dO, accumulates dk/dv. All
+    contractions are expressed via dot_general dimension numbers so no
+    in-kernel transposes are needed (everything stays q-row-major).
+delta = rowsum(dO * O) is computed outside in XLA (bandwidth-bound
+elementwise; XLA fuses it) and passed in pre-broadcast.
+Causal pruning: dq loops k in [0, ceil((qi+1)·bq / bk)); dkv loops q in
+[floor(ki·bk / bq), n_qb) — each kernel touches only live blocks.
+
 The XLA reference in flash_attention.py is the numerical oracle; the
-interpret=True path runs this exact kernel on CPU for tests.
+interpret=True path runs these exact kernels on CPU for tests.
 """
 from __future__ import annotations
 
@@ -20,8 +32,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                   seq_len):
+LANES = 128
+
+
+def _stat_cols(stat, n):
+    """Broadcast a [rows, LANES] per-row stat to [rows, n] columns."""
+    if n <= LANES:
+        return stat[:, :n]
+    assert n % LANES == 0
+    return jnp.tile(stat, (1, n // LANES))
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                   block_k, seq_len):
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
     bq, d = q.shape
     qi = pl.program_id(1)
@@ -59,33 +82,184 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
         upper = n_kb
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))          # [bq, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, (bq, LANES))
+
+
+def _bh(x, b, h, s, d):
+    return jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
 
 
 def fa_forward(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
-               interpret=False):
-    """q,k,v: [B, S, H, D] → out [B, S, H, D]."""
+               interpret=False, return_lse=False):
+    """q,k,v: [B, S, H, D] → out [B, S, H, D] (+ lse [B*H, S, LANES])."""
     b, s, h, d = q.shape
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0
 
-    def bh(x):
-        return jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
-
-    qb, kb, vb = bh(q), bh(k), bh(v)
+    qb, kb, vb = (_bh(x, b, h, s, d) for x in (q, k, v))
     kernel = functools.partial(_fa_fwd_kernel, scale=sc, causal=causal,
                                block_k=block_k, seq_len=s)
-    out = pl.pallas_call(
+    if not return_lse:
+        kernel = functools.partial(kernel, lse_ref=None)
+    out_shape = [jax.ShapeDtypeStruct((b * h, s, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
+    if return_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda i, j: (i, j, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=out_shape,
         grid=(b * h, s // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=out_specs,
         interpret=interpret,
     )(qb, kb, vb)
-    return jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)
+    out = jnp.moveaxis(res[0].reshape(b, h, s, d), 1, 2)
+    if return_lse:
+        return out, res[1]
+    return out
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, scale, causal, block_k, seq_len):
+    q = q_ref[0].astype(jnp.float32)                      # [bq, D]
+    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+    lse = lse_ref[0]                                      # [bq, LANES] f32
+    delta = delta_ref[0]                                  # [bq, LANES] f32
+    bq, d = q.shape
+    qi = pl.program_id(1)
+    n_kb = seq_len // block_k
+    lse_t = _stat_cols(lse, block_k)                      # [bq, block_k]
+    delta_t = _stat_cols(delta, block_k)
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse_t)                            # [bq, block_k]
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_t)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        upper = jnp.minimum(
+            jax.lax.div(qi * bq + bq + block_k - 1, block_k), n_kb)
+    else:
+        upper = n_kb
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
+    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
+    v = v_ref[0].astype(jnp.float32)                      # [bk, D]
+    bk, d = k.shape
+    ki = pl.program_id(1)
+    n_qb = seq_len // block_q
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]  # [bq, LANES]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (1, bk), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - _stat_cols(lse, bk))              # [bq, bk]
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        # dv += p^T @ do   (contract over q rows — dim 0 on both)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - _stat_cols(delta, bk))
+        # dk += ds^T @ q
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    lower = jax.lax.div(ki * bk, block_q) if causal else 0
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, n_qb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
+                block_k=128, interpret=False):
+    """FlashAttention-2 backward. q,k,v,o,do: [B,S,H,D]; lse: [B*H,S,LANES].
+
+    Returns (dq, dk, dv) in the input dtype.
+    """
+    b, s, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0
+
+    qb, kb, vb, ob, dob = (_bh(x, b, h, s, d) for x in (q, k, v, o, do))
+    # delta = rowsum(dO * O), broadcast to the lane-minor layout in XLA
+    delta = jnp.sum(ob.astype(jnp.float32) * dob.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [B*H, S, 1]
+    delta = jnp.broadcast_to(delta, (b * h, s, LANES))
+
+    row = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+    full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+    stat_row = pl.BlockSpec((1, block_q, LANES), lambda i, j: (i, j, 0))
+    stat_full = pl.BlockSpec((1, s, LANES), lambda i, j: (i, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
+                          block_k=block_k, seq_len=s),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, s // block_q),
+        in_specs=[row, full, full, row, stat_row, stat_row],
+        out_specs=row,
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    col = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
+                          block_q=block_q, seq_len=s),
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        grid=(b * h, s // block_k),
+        in_specs=[full, col, col, full, stat_full, stat_full],
+        out_specs=[col, col],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    def unbh(x):
+        return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
+    return unbh(dq), unbh(dk), unbh(dv)

@@ -58,28 +58,35 @@ def _use_pallas(q_shape, head_dim) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
-    return _flash_fwd_impl(q, k, v, causal, scale)
-
-
-def _flash_fwd_impl(q, k, v, causal, scale):
-    if _use_pallas(q.shape, q.shape[-1]):
-        try:
-            from ._fa_kernel import fa_forward
-            return fa_forward(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
-    return _attention_ref(q, k, v, causal=causal, scale=scale)
+    out, _ = _flash_fwd_vjp(q, k, v, causal, scale)
+    return out
 
 
 def _flash_fwd_vjp(q, k, v, causal, scale):
-    out = _flash_fwd_impl(q, k, v, causal, scale)
-    return out, (q, k, v)
+    """Single dispatch point for the forward: Pallas kernel (with lse
+    residual for the Pallas backward) on TPU-supported shapes, XLA
+    reference otherwise (residual lse=None selects the recompute vjp)."""
+    if _use_pallas(q.shape, q.shape[-1]):
+        try:
+            from ._fa_kernel import fa_forward
+            out, lse = fa_forward(q, k, v, causal=causal, scale=scale,
+                                  return_lse=True)
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    out = _attention_ref(q, k, v, causal=causal, scale=scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd_vjp(causal, scale, res, g):
-    q, k, v = res
-    # Recompute-based backward through the XLA reference (Pallas bwd kernel
-    # lands with the perf pass; numerics identical).
+    q, k, v, out, lse = res
+    if lse is not None:
+        # Pallas FlashAttention-2 backward (dq/dk/dv kernels, lse saved
+        # from the forward — no softmax recompute through XLA).
+        from ._fa_kernel import fa_backward
+        return fa_backward(q, k, v, out, lse, g, causal=causal, scale=scale)
+    # Recompute-based backward through the XLA reference (off-TPU or
+    # kernel-unsupported shapes; numerics identical).
     _, vjp_fn = jax.vjp(
         lambda q_, k_, v_: _attention_ref(q_, k_, v_, causal=causal,
                                           scale=scale), q, k, v)
