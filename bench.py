@@ -8,7 +8,6 @@ north-star (BASELINE.json: ≥50% MFU target ⇒ vs_baseline = MFU / 0.50).
 from __future__ import annotations
 
 import json
-import os
 import subprocess
 import sys
 import time

@@ -87,59 +87,83 @@ def ulysses_attention(q, k, v, group=None, causal=False, scale=None):
 
 
 def _ring_attention_core(qa, ka, va, ax, n, causal, scale):
-    """Online-softmax ring attention over axis `ax` (n ranks).
-    qa/ka/va: local [B, S/n, H, D]."""
-    d = qa.shape[-1]
-    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    """Ring attention over axis `ax` (n ranks); qa/ka/va: [B, S/n, H, D].
+
+    Each ring step runs the flash-attention core (Pallas kernel on TPU,
+    with its Pallas backward and lse output — flash_core_lse) on the K/V
+    block currently held, and merges the per-block normalized output via
+    the numerically stable logsumexp streaming combine. Causal masking is
+    resolved at BLOCK granularity with lax.switch: blocks strictly below
+    the diagonal run the dense (non-causal) kernel, the diagonal block
+    runs the causal kernel, and blocks above are skipped outright — so
+    the causal ring does ~half the work and never materializes a mask.
+    The lse cotangent flows through the combine; flash_core_lse's
+    backward folds it into the kernel's delta term.
+    """
+    from ...ops.pallas.flash_attention import flash_core_lse
+
+    b, sl, h, d = qa.shape
     my_idx = jax.lax.axis_index(ax)
-    sl = qa.shape[1]
-    q32 = qa.astype(jnp.float32)
 
     def step(carry, i):
-        kv, acc, m_run, l_run = carry
-        k_blk, v_blk = kv
+        (k_blk, v_blk), acc, lse_run = carry
         src = (my_idx - i) % n  # which rank's block we now hold
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
-                            k_blk.astype(jnp.float32)) * s
+
+        def blk(blk_causal):
+            def run(q_, k_, v_):
+                out, lse = flash_core_lse(q_, k_, v_, blk_causal, scale)
+                return out.astype(jnp.float32), lse
+            return run
+
+        full, diag = blk(False), blk(True)
+
+        def skip(q_, k_, v_):
+            z = jnp.zeros((b, sl, h, d), jnp.float32)
+            l = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
+            try:  # match the varying-axis type of the kernel branches
+                z, l = (jax.lax.pcast(t, (ax,), to="varying")
+                        for t in (z, l))
+            except AttributeError:
+                pass
+            return z, l
+
         if causal:
-            qpos = my_idx * sl + jnp.arange(sl)
-            kpos = src * sl + jnp.arange(sl)
-            mask = qpos[:, None] >= kpos[None, :]
-            logits = jnp.where(mask[None, None], logits, -jnp.inf)
-        m_blk = jnp.max(logits, axis=-1)                  # [B,H,Q]
-        m_new = jnp.maximum(m_run, m_blk)
-        # guard fully-masked blocks (all -inf)
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(logits - safe_m[..., None])
-        p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        corr = jnp.where(jnp.isfinite(m_run),
-                         jnp.exp(m_run - safe_m), 0.0)
-        l_new = l_run * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p,
-                        v_blk.astype(jnp.float32))
-        acc = acc * jnp.moveaxis(corr, 1, 2)[..., None] + pv
+            case = jnp.where(src == my_idx, 1,
+                             jnp.where(src < my_idx, 0, 2))
+            out_blk, lse_blk = jax.lax.switch(case, [full, diag, skip],
+                                              qa, k_blk, v_blk)
+        else:
+            out_blk, lse_blk = full(qa, k_blk, v_blk)
+
+        # streaming combine of normalized partials:
+        #   out = Σ_i exp(lse_i − lse_tot) · out_i
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        safe_new = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+        c_old = jnp.where(jnp.isfinite(lse_run),
+                          jnp.exp(lse_run - safe_new), 0.0)
+        c_blk = jnp.where(jnp.isfinite(lse_blk),
+                          jnp.exp(lse_blk - safe_new), 0.0)
+
+        def bshc(c):  # [B,H,S] → [B,S,H,1]
+            return jnp.moveaxis(c, 1, 2)[..., None]
+        acc = acc * bshc(c_old) + out_blk * bshc(c_blk)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_next = jax.lax.ppermute(k_blk, ax, perm)
         v_next = jax.lax.ppermute(v_blk, ax, perm)
-        return ((k_next, v_next), acc, m_new, l_new), None
+        return ((k_next, v_next), acc, lse_new), None
 
-    b, _, h, _ = qa.shape
     acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
-    m0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    lse0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
     # mark the carries as device-varying over the ring axis (shard_map VMA)
     try:
         pcast = jax.lax.pcast
-        acc0, m0, l0 = (pcast(t, (ax,), to="varying")
-                        for t in (acc0, m0, l0))
+        acc0, lse0 = (pcast(t, (ax,), to="varying") for t in (acc0, lse0))
     except AttributeError:
         pass
-    carry0 = ((ka, va), acc0, m0, l0)
+    carry0 = ((ka, va), acc0, lse0)
     step_ck = jax.checkpoint(step)
-    (kv, acc, m_run, l_run), _ = jax.lax.scan(step_ck, carry0,
-                                              jnp.arange(n))
-    denom = jnp.moveaxis(jnp.maximum(l_run, 1e-30), 1, 2)[..., None]
-    return (acc / denom).astype(qa.dtype)
+    (kv, acc, lse_run), _ = jax.lax.scan(step_ck, carry0, jnp.arange(n))
+    return acc.astype(qa.dtype)
 
 
 def ring_flash_attention(q, k, v, group=None, causal=True, scale=None):

@@ -216,8 +216,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
-                block_k=128, interpret=False):
+                block_k=128, interpret=False, dlse=None):
     """FlashAttention-2 backward. q,k,v,o,do: [B,S,H,D]; lse: [B*H,S,LANES].
+
+    dlse (optional [B*H, S] f32): cotangent of the logsumexp output, for
+    callers that consume lse downstream (ring attention's streaming
+    combine). Since d lse/d s_j = p_j, it folds into the existing kernels
+    as ds = p·(dp − (delta − dlse)) — an XLA-side delta adjustment only.
 
     Returns (dq, dk, dv) in the input dtype.
     """
@@ -231,6 +236,8 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
     # delta = rowsum(dO * O), broadcast to the lane-minor layout in XLA
     delta = jnp.sum(ob.astype(jnp.float32) * dob.astype(jnp.float32),
                     axis=-1, keepdims=True)              # [B*H, S, 1]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (b * h, s, LANES))
 
     row = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))

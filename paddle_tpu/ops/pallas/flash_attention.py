@@ -45,12 +45,18 @@ def _attention_ref(q, k, v, mask=None, causal=False, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+# Tests set this True to run the Pallas kernels in interpret mode off-TPU
+# (exercises the exact kernel code paths without hardware).
+_FORCE_INTERPRET = False
+
+
 def _use_pallas(q_shape, head_dim) -> bool:
-    try:
-        if jax.default_backend() not in ("tpu", "axon"):
+    if not _FORCE_INTERPRET:
+        try:
+            if jax.default_backend() not in ("tpu", "axon"):
+                return False
+        except Exception:
             return False
-    except Exception:
-        return False
     # MXU-friendly shapes only; fallback handles the rest
     b, s, h, d = q_shape
     return (d in (64, 128, 256)) and s % 128 == 0 and s >= 128
@@ -58,42 +64,95 @@ def _use_pallas(q_shape, head_dim) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
-    out, _ = _flash_fwd_vjp(q, k, v, causal, scale)
-    return out
-
-
-def _flash_fwd_vjp(q, k, v, causal, scale):
-    """Single dispatch point for the forward: Pallas kernel (with lse
-    residual for the Pallas backward) on TPU-supported shapes, XLA
-    reference otherwise (residual lse=None selects the recompute vjp)."""
+    # Primal (no-grad) body: do NOT request the lse output — pallas_call
+    # is opaque to XLA DCE, so asking for lse here would write a dead
+    # [B*H, S, 128] f32 buffer on every inference forward.
     if _use_pallas(q.shape, q.shape[-1]):
         try:
             from ._fa_kernel import fa_forward
-            out, lse = fa_forward(q, k, v, causal=causal, scale=scale,
-                                  return_lse=True)
-            return out, (q, k, v, out, lse)
+            return fa_forward(q, k, v, causal=causal, scale=scale,
+                              interpret=_FORCE_INTERPRET)
         except Exception:
             pass
-    out = _attention_ref(q, k, v, causal=causal, scale=scale)
-    return out, (q, k, v, None, None)
+    return _attention_ref(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_fwd_vjp(q, k, v, causal, scale):
+    # Training forward: one dispatch point shared with flash_core_lse
+    # (the lse residual feeds the Pallas backward).
+    (out, _lse), res = _flash_lse_fwd(q, k, v, causal, scale)
+    return out, res
 
 
 def _flash_bwd_vjp(causal, scale, res, g):
-    q, k, v, out, lse = res
-    if lse is not None:
-        # Pallas FlashAttention-2 backward (dq/dk/dv kernels, lse saved
-        # from the forward — no softmax recompute through XLA).
-        from ._fa_kernel import fa_backward
-        return fa_backward(q, k, v, out, lse, g, causal=causal, scale=scale)
-    # Recompute-based backward through the XLA reference (off-TPU or
-    # kernel-unsupported shapes; numerics identical).
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: _attention_ref(q_, k_, v_, causal=causal,
-                                          scale=scale), q, k, v)
-    return vjp_fn(g)
+    return _flash_lse_bwd(causal, scale, res, (g, None))
 
 
 _flash_core.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+# ---------------------------------------------------------------------------
+# flash attention that also returns the per-row logsumexp — the primitive
+# ring attention (fleet/long_context.py) builds its streaming combine on.
+
+
+def _attention_ref_lse(q, k, v, causal=False, scale=None):
+    """XLA reference returning (out, lse[B,H,S] f32)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)    # [B,H,Sq]
+    probs = jnp.exp(logits - jnp.where(jnp.isfinite(lse), lse,
+                                       0.0)[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_core_lse(q, k, v, causal, scale):
+    (out, lse), _ = _flash_lse_fwd(q, k, v, causal, scale)
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale):
+    b, s, h, d = q.shape
+    if _use_pallas(q.shape, d):
+        try:
+            from ._fa_kernel import fa_forward
+            out, lse_l = fa_forward(q, k, v, causal=causal, scale=scale,
+                                    return_lse=True,
+                                    interpret=_FORCE_INTERPRET)
+            lse = lse_l[:, :, 0].reshape(b, h, s)
+            return (out, lse), (q, k, v, out, lse_l)
+        except Exception:
+            pass
+    out, lse = _attention_ref_lse(q, k, v, causal=causal, scale=scale)
+    return (out, lse), (q, k, v, None, None)
+
+
+def _flash_lse_bwd(causal, scale, res, gs):
+    g_out, g_lse = gs
+    q, k, v, out, lse_l = res
+    b, s, h, d = q.shape
+    if lse_l is not None:
+        from ._fa_kernel import fa_backward
+        dlse = g_lse.reshape(b * h, s) if g_lse is not None else None
+        return fa_backward(q, k, v, out, lse_l, g_out, causal=causal,
+                           scale=scale, interpret=_FORCE_INTERPRET,
+                           dlse=dlse)
+    if g_lse is None:
+        g_lse = jnp.zeros((b, h, s), jnp.float32)
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: _attention_ref_lse(q_, k_, v_, causal=causal,
+                                              scale=scale), q, k, v)
+    return vjp_fn((g_out, g_lse))
+
+
+flash_core_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention_bshd(q, k, v, mask=None, causal=False, dropout_p=0.0,
