@@ -19,9 +19,26 @@ TPU-native design: the schedule is a DIFFERENTIABLE COLLECTIVE SCAN inside
   activation memory (the reason the reference needs 1F1B rather than
   GPipe); compute-bubble fraction matches 1F1B at (S-1)/(M+S-1);
 - stage bodies must be structurally identical blocks (the transformer
-  case); embedding/head run on all ranks and are masked to stage 0 / S-1
-  (cheap relative to blocks). Interleaved/virtual-pp = multiple block
-  chunks per tick (vpp_degree).
+  case); embedding runs ONLY on stage 0 and head+loss ONLY on the last
+  stage, via `lax.cond` on the stage index — other stages skip those
+  FLOPs at runtime (dedicated stage placement, reference: pp_layers
+  SharedLayerDesc head/embedding stages);
+- **interleaved virtual pipeline** (`num_virtual_pipeline_stages` = V,
+  reference: PipelineParallelWithInterleave): blocks are split into S·V
+  chunks; physical stage s owns chunks {v·S+s} (Megatron placement).
+  The single ring buffer still works: at tick t stage s serves local
+  tick u = t−s, chunk v(u) = (u//S) mod V, microbatch
+  m(u) = (u mod S) + S·(u//(S·V)) — the (S−1)→0 ppermute wrap carries an
+  activation finishing chunk v straight into chunk v+1. Total ticks
+  M·V + S − 1 of 1/V-stage work each, so the fill/drain waste drops from
+  (S−1)/(M+S−1) to (S−1)/(M·V+S−1) — the same bubble/V win as the
+  reference's interleaved 1F1B. Requires M % S == 0 (as upstream);
+- **4D composition**: the scan is `shard_map`-manual over 'pp' ONLY
+  (`axis_names={'pp'}`); dp / sharding (ZeRO) / mp (TP) stay GSPMD auto
+  axes — batch sharded over ('dp','sharding'), TP weights carry their
+  `dist_spec` dims, ZeRO shards params/states/grads on a free dim — so
+  one XLA program runs PP×TP×ZeRO×DP with the partitioner inserting
+  every non-pp collective.
 """
 from __future__ import annotations
 
@@ -78,6 +95,8 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self.num_stages = num_stages
         self.recompute_interval = recompute_interval
+        self.num_virtual_pipeline_stages = max(
+            int(num_virtual_pipeline_stages or 1), 1)
         descs = list(layers)
         built = [d.build_layer() if isinstance(d, LayerDesc) else d
                  for d in descs]
@@ -95,15 +114,20 @@ class PipelineLayer(Layer):
         self._pre = LayerList(built[:best_start])
         self._blocks = LayerList(built[best_start:best_start + best_len])
         self._post = LayerList(built[best_start + best_len:])
-        if num_stages and best_len % num_stages != 0:
+        chunks = (num_stages or 1) * self.num_virtual_pipeline_stages
+        if num_stages and best_len % chunks != 0:
             raise ValueError(
-                f"block count {best_len} must divide pp stages "
-                f"{num_stages} (uniform segmentation)")
+                f"block count {best_len} must divide pp stages × virtual "
+                f"stages = {chunks} (uniform segmentation)")
 
     # reference-API surface
     def get_stage_from_index(self, idx):
-        per = len(self._blocks) // (self.num_stages or 1)
-        return min(idx // max(per, 1), (self.num_stages or 1) - 1)
+        """Physical stage owning block idx. Under interleaving, chunk
+        ℓ = idx // pc lives on stage ℓ mod S (Megatron placement)."""
+        S = self.num_stages or 1
+        V = self.num_virtual_pipeline_stages
+        pc = max(len(self._blocks) // (S * V), 1)
+        return min((idx // pc) % S, S - 1)
 
     def forward(self, x, *args):
         for l in self._pre:
@@ -171,21 +195,75 @@ class PipelineParallel(Layer):
         return self._layers.set_state_dict(*a, **k)
 
 
+def _zero_stage(pp) -> int:
+    st = pp._strategy
+    if st is not None and getattr(st, "sharding", False):
+        return int(st.sharding_configs.get("stage", 1))
+    return 0
+
+
+def _pp_param_spec(param, tail_shape, stage, sharding_degree) -> P:
+    """Spec for a stacked block-param leaf: 'pp' on the stack dim, then
+    the param's own TP dist_spec dims, then (ZeRO-3) 'sharding' on the
+    largest free divisible dim."""
+    explicit = getattr(param, "dist_spec", None)
+    tail = list(explicit) if explicit is not None \
+        else [None] * len(tail_shape)
+    if stage >= 3 and sharding_degree > 1:
+        for d in np.argsort([-s for s in tail_shape]):
+            if tail[d] is None and tail_shape[d] % sharding_degree == 0 \
+                    and tail_shape[d] >= sharding_degree:
+                tail[d] = "sharding"
+                break
+    return P("pp", *tail)
+
+
+def _pp_state_spec(pspec: P, shape, stage, sharding_degree) -> P:
+    """Optimizer-state spec for a stacked leaf (ZeRO-1 shards states even
+    when params stay whole within the stage). Handles leaves whose rank
+    differs from the param's (e.g. per-block scalars stacked to [n])."""
+    tshape = shape[1:]
+    ptail = list(pspec)[1:]
+    if len(ptail) == len(tshape) and any(s is not None for s in ptail):
+        return P("pp", *ptail)
+    tail = [None] * len(tshape)
+    if stage >= 1 and sharding_degree > 1:
+        for d in np.argsort([-s for s in tshape]):
+            if tshape[d] % sharding_degree == 0 and \
+                    tshape[d] >= sharding_degree:
+                tail[d] = "sharding"
+                break
+    return P("pp", *tail)
+
+
 def _pipeline_train_step(pp: PipelineParallel, opt, inputs: Tensor,
                          labels: Tensor):
     """Compile & run one pipelined training step.
 
-    Layout: blocks' params stacked on a leading dim sharded over 'pp';
-    pre/post params replicated; microbatches replicated (cheap host-side
-    split; the batch dim is usually dp-sharded at a higher level).
+    Layout: block params stacked on a leading dim sharded over 'pp' (in
+    interleaved chunk order when V>1); pre/post params on their TP/ZeRO
+    specs; microbatches host-split to [M, mb, ...] with the mb dim
+    sharded over ('dp','sharding') so data parallelism rides through the
+    pipeline program.
     """
+    from .spmd import param_spec
+
     mesh = pp._hcg.mesh
     S = pp._hcg.get_pipe_parallel_world_size()
     M = max(pp.accumulate_steps, 1)
     layers = pp._layers
+    V = getattr(layers, "num_virtual_pipeline_stages", 1)
     blocks = list(layers._blocks)
     n_blocks = len(blocks)
-    per_stage = n_blocks // max(S, 1)
+    if V > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved pipeline (V={V}) requires accumulate_steps "
+            f"({M}) % pp_degree ({S}) == 0 (reference constraint)")
+    pc = n_blocks // (max(S, 1) * V)  # blocks per chunk
+    # interleaved placement: stage s owns chunks {v·S+s}; stack blocks so
+    # the P('pp') slice hands stage s its V chunks in v-major order
+    perm = [(v * S + s) * pc + i
+            for s in range(max(S, 1)) for v in range(V) for i in range(pc)]
 
     pre_named = [(n, p) for l in layers._pre
                  for n, p in l.named_parameters()]
@@ -199,44 +277,87 @@ def _pipeline_train_step(pp: PipelineParallel, opt, inputs: Tensor,
     key = _random.next_key()
     bshape = inputs._data.shape
     assert bshape[0] % M == 0, "batch must divide accumulate_steps"
+    mb = bshape[0] // M
 
-    sig = (tuple(bshape), tuple(labels._data.shape), M, S)
+    zstage = _zero_stage(pp)
+    axd = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sharding_degree = axd.get("sharding", 1)
+    data_degree = axd.get("dp", 1) * sharding_degree
+
+    ns = lambda spec: NamedSharding(mesh, spec)
+    pre_specs = [param_spec(p, tuple(p._data.shape), zstage,
+                            sharding_degree, axd.get("mp", 1))
+                 for _, p in pre_named]
+    post_specs = [param_spec(p, tuple(p._data.shape), zstage,
+                             sharding_degree, axd.get("mp", 1))
+                  for _, p in post_named]
+    blk_specs = [_pp_param_spec(blk_params[n][0],
+                                tuple(blk_params[n][0]._data.shape),
+                                zstage, sharding_degree)
+                 for n in blk_names]
+
+    sig = (tuple(bshape), tuple(labels._data.shape), M, S, V, zstage)
     if pp._jit is None or pp._sig != sig:
-        pp._jit = _build_pipeline_jit(pp, opt, mesh, S, M, per_stage,
+        pp._jit = _build_pipeline_jit(pp, opt, mesh, S, M, V, pc,
                                       pre_named, post_named, blk_names,
-                                      blocks, loss_fn)
+                                      blocks, loss_fn, zstage,
+                                      sharding_degree, pre_specs,
+                                      post_specs, blk_specs)
         pp._sig = sig
     fn = pp._jit
 
-    blk_stacked = [jnp.stack([p._data for p in blk_params[n]])
+    blk_stacked = [jnp.stack([blk_params[n][g]._data for g in perm])
                    for n in blk_names]
     opt._step_count += 1
     pre_states = [opt._get_state(p) for _, p in pre_named]
     post_states = [opt._get_state(p) for _, p in post_named]
-    # block states: stacked like params
+    # block states: stacked like params (same perm)
     blk_state_list = []
     for n in blk_names:
-        sts = [opt._get_state(p) for p in blk_params[n]]
+        sts = [opt._get_state(blk_params[n][g]) for g in perm]
         keys = sts[0].keys()
         blk_state_list.append({k: jnp.stack([s[k] for s in sts])
                                for k in keys})
 
-    rep = NamedSharding(mesh, P())
-    blk_sh = NamedSharding(mesh, P("pp"))
+    rep = ns(P())
+    pre_sh = [ns(s) for s in pre_specs]
+    post_sh = [ns(s) for s in post_specs]
+    blk_sh = [ns(s) for s in blk_specs]
+    # microbatch-major batch: [M, mb, ...], mb sharded over data axes
+    if data_degree > 1 and mb % data_degree == 0:
+        mb_spec = P(None, ("dp", "sharding"))
+    else:
+        mb_spec = P()
+        if data_degree > 1:
+            import sys
+            sys.stderr.write(
+                f"paddle_tpu pipeline: micro-batch size {mb} is not "
+                f"divisible by dp×sharding={data_degree}; batch will be "
+                "REPLICATED across the data axes (data parallelism "
+                "disabled for this step)\n")
+    micro_in = jax.device_put(
+        inputs._data.reshape((M, mb) + tuple(bshape[1:])), ns(mb_spec))
+    micro_lab = jax.device_put(
+        labels._data.reshape((M, labels._data.shape[0] // M) +
+                             tuple(labels._data.shape[1:])), ns(mb_spec))
+
     put = lambda sh: (lambda x: jax.device_put(x, sh))
     (loss_v, new_pre, new_post, new_blk, new_pre_st, new_post_st,
      new_blk_st) = fn(
         jax.device_put(key, rep),
-        [put(rep)(p._data) for _, p in pre_named],
-        [put(rep)(p._data) for _, p in post_named],
-        [put(blk_sh)(a) for a in blk_stacked],
+        [put(sh)(p._data) for sh, (_, p) in zip(pre_sh, pre_named)],
+        [put(sh)(p._data) for sh, (_, p) in zip(post_sh, post_named)],
+        [put(sh)(a) for sh, a in zip(blk_sh, blk_stacked)],
         jax.tree.map(put(rep), pre_states),
         jax.tree.map(put(rep), post_states),
-        jax.tree.map(put(blk_sh), blk_state_list),
+        [jax.tree.map(
+            lambda leaf, sp=sh.spec: jax.device_put(
+                leaf, ns(_pp_state_spec(sp, leaf.shape, zstage,
+                                        sharding_degree))), st)
+         for sh, st in zip(blk_sh, blk_state_list)],
         jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), rep),
         jax.device_put(jnp.asarray(opt._step_count, jnp.int32), rep),
-        jax.device_put(inputs._data, rep),
-        jax.device_put(labels._data, rep))
+        micro_in, micro_lab)
 
     for (n, p), arr in zip(pre_named, new_pre):
         p._inplace_update(arr)
@@ -247,21 +368,27 @@ def _pipeline_train_step(pp: PipelineParallel, opt, inputs: Tensor,
     for (n, p), st in zip(post_named, new_post_st):
         opt._accum[id(p)] = st
     for name, arr, st in zip(blk_names, new_blk, new_blk_st):
-        for i, p in enumerate(blk_params[name]):
-            p._inplace_update(arr[i])
-            opt._accum[id(p)] = {k: v[i] for k, v in st.items()}
+        for j, g in enumerate(perm):
+            blk_params[name][g]._inplace_update(arr[j])
+            opt._accum[id(blk_params[name][g])] = {k: v[j]
+                                                   for k, v in st.items()}
     return Tensor(loss_v)
 
 
-def _build_pipeline_jit(pp, opt, mesh, S, M, per_stage, pre_named,
-                        post_named, blk_names, blocks, loss_fn):
+def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
+                        post_named, blk_names, blocks, loss_fn, zstage,
+                        sharding_degree, pre_specs, post_specs, blk_specs):
     from jax import shard_map
 
     layers = pp._layers
     block0 = blocks[0]
 
-    def stage_body(blk_local, x):
-        """Apply this stage's `per_stage` blocks (scan over leading dim)."""
+    def chunk_body(blk_local, v, x):
+        """Apply chunk v's `pc` blocks (dynamic slice of the local [V·pc,
+        ...] stack, then scan)."""
+        chunk = [jax.lax.dynamic_slice_in_dim(a, v * pc, pc, axis=0)
+                 for a in blk_local]
+
         def one_block(h, block_arrs):
             named = dict(block0.named_parameters())
             saved = [(p, p._data) for p in named.values()]
@@ -275,108 +402,151 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, per_stage, pre_named,
             return out._data, None
 
         body = one_block
-        if pp._layers.recompute_interval:
+        if layers.recompute_interval:
             body = jax.checkpoint(one_block)
-        h, _ = jax.lax.scan(body, x, tuple(blk_local))
+        h, _ = jax.lax.scan(body, x, tuple(chunk))
         return h
 
-    def apply_section(named, params, x):
+    def apply_section(named, section, params, x):
         saved = [(p, p._data) for _, p in named]
         for (n, p), arr in zip(named, params):
             p._data = arr
         try:
             out = x
-            section = layers._pre if named is pre_named else layers._post
             for l in section:
                 out = l(out)
         finally:
             for p, arr in saved:
                 p._data = arr
-        return out
+        return out._data if isinstance(out, Tensor) else out
 
-    def spmd_loss(key, pre, post, blk, batch, labels):
-        """Runs INSIDE shard_map: 'pp' axis live; blk leaves are local
-        [per_stage, ...] slices."""
+    def spmd_loss(key, pre, post, blk, micro, mlab):
+        """Runs INSIDE shard_map, manual over 'pp' only (dp/sharding/mp
+        are GSPMD auto axes). blk leaves are local [V·pc, ...] slices in
+        v-major chunk order; micro/mlab are [M, mb, ...] with mb
+        dp-sharded by the partitioner.
+
+        Embedding and head run BATCHED outside the tick scan: in lockstep
+        SPMD, per-stage specialization saves no wall-clock (every device
+        waits for the loaded stage anyway), while batching all M
+        microbatches into one embedding matmul / one head matmul is
+        strictly better MXU utilization than M+S-1 per-tick passes — and
+        it keeps collectives out of conditional control flow, which would
+        deadlock GSPMD's auto-axis resharding (cond predicates here vary
+        across pp). Gradient single-counting: the loss is masked to the
+        last stage and psum'd, so only one pp rank's head/embedding path
+        carries cotangents; the shard_map transpose of the replicated
+        param inputs then psums to the correct total."""
         _random.push_trace_key(key)
         try:
             sid = jax.lax.axis_index("pp")
-            micro = batch.reshape((M, batch.shape[0] // M) +
-                                  batch.shape[1:])
-            mlab = labels.reshape((M, labels.shape[0] // M) +
-                                  labels.shape[1:])
-            T = M + S - 1
+            T = M * V + S - 1
+            mb = micro.shape[1]
+
+            # batched embedding for ALL microbatches
+            flat = micro.reshape((M * mb,) + micro.shape[2:])
+            emb = apply_section(pre_named, layers._pre, pre, Tensor(flat))
+            emb_all = emb.reshape((M, mb) + emb.shape[1:])
+
+            def sched(u):
+                """(chunk, microbatch) this stage serves at local tick u
+                (clipped into range; validity handled by the mask)."""
+                uc = jnp.clip(u, 0, M * V - 1)
+                v = (uc // S) % V
+                m = (uc % S) + S * (uc // (S * V))
+                return v, m
 
             def tick(carry, t):
-                act, loss_acc = carry
-                m_in = jnp.clip(t, 0, M - 1)
-                raw = jax.lax.dynamic_index_in_dim(micro, m_in, 0,
-                                                   keepdims=False)
-                embedded = apply_section(
-                    pre_named, pre,
-                    Tensor(raw))
-                emb = embedded._data if isinstance(embedded, Tensor) \
-                    else embedded
-                x = jnp.where(sid == 0, emb.astype(act.dtype), act)
-                h = stage_body(blk, x)
-                # last stage: head + loss for microbatch t-(S-1)
-                m_out = jnp.clip(t - (S - 1), 0, M - 1)
-                lab = jax.lax.dynamic_index_in_dim(mlab, m_out, 0,
-                                                   keepdims=False)
-                logits = apply_section(post_named, post, Tensor(h))
-                lg = logits._data if isinstance(logits, Tensor) else logits
-                if loss_fn is not None:
-                    l_t = loss_fn(Tensor(lg), Tensor(lab))
-                    l_val = l_t._data if isinstance(l_t, Tensor) else l_t
-                else:
-                    l_val = jnp.mean(lg)
-                valid = (t >= S - 1) & (sid == S - 1)
-                loss_acc = loss_acc + jnp.where(valid,
-                                                l_val.astype(jnp.float32),
-                                                0.0)
-                # rotate activations forward one stage
+                act, out_buf = carry
+                u = t - sid
+                in_window = (u >= 0) & (u < M * V)
+                v, m = sched(u)
+                # stage 0, chunk 0: inject the precomputed embedding
+                e = jax.lax.dynamic_index_in_dim(emb_all, m, 0,
+                                                 keepdims=False)
+                x = jnp.where((sid == 0) & (v == 0) & in_window,
+                              e.astype(act.dtype), act)
+                h = chunk_body(blk, v, x)
+                # collect retiring outputs into an [M, mb, ...] buffer
+                # (carry, not stacked ys — T-tick stacking would hold
+                # M·V+S-1 activation buffers when only M are consumed)
+                retire = (sid == S - 1) & (v == V - 1) & in_window
+                upd = jax.lax.dynamic_update_slice_in_dim(
+                    out_buf, h[None].astype(out_buf.dtype), m, axis=0)
+                out_buf = jnp.where(retire, upd, out_buf)
+                # rotate activations forward one stage; the (S-1)→0 wrap
+                # carries chunk v's output into chunk v+1 (or retires it)
                 act_next = jax.lax.ppermute(
                     h, "pp", [(i, (i + 1) % S) for i in range(S)])
-                return (act_next, loss_acc), None
+                return (act_next, out_buf), None
 
-            # activation buffer: shape after embedding
-            raw0 = micro[0]
-            emb0 = apply_section(pre_named, pre, Tensor(raw0))
-            emb0 = emb0._data if isinstance(emb0, Tensor) else emb0
-            act0 = jnp.zeros_like(emb0)
-            (act, loss_acc), _ = jax.lax.scan(
-                tick, (act0, jnp.zeros((), jnp.float32)), jnp.arange(T))
-            # share the last-stage loss with everyone, average microbatches
-            total = jax.lax.psum(loss_acc, "pp") / M
-            data_axes = tuple(a for a in ("dp", "sharding")
-                              if a in mesh.axis_names and
-                              mesh.shape[a] > 1)
-            if data_axes:
-                total = jax.lax.pmean(total, data_axes)
-            return total
+            act0 = jnp.zeros_like(emb_all[0])
+            (act, out_buf), _ = jax.lax.scan(
+                tick, (act0, jnp.zeros_like(emb_all)), jnp.arange(T))
+
+            # broadcast the last stage's outputs to every rank (one psum)
+            mask = (sid == S - 1).astype(out_buf.dtype)
+            h_all = jax.lax.psum(out_buf * mask, "pp")
+            # head + loss PER MICROBATCH (static loop): reference grad-
+            # accumulation semantics — sum of per-microbatch losses / M —
+            # which differs from one merged-batch loss for non-uniform
+            # weightings (e.g. ignore_index masked means); also keeps the
+            # transient logits at [mb, ...] instead of [M·mb, ...]
+            lval = jnp.zeros((), jnp.float32)
+            for m in range(M):
+                lg = apply_section(post_named, layers._post, post,
+                                   Tensor(h_all[m]))
+                if loss_fn is not None:
+                    l_t = loss_fn(Tensor(lg), Tensor(mlab[m]))
+                    l_m = (l_t._data if isinstance(l_t, Tensor)
+                           else l_t).astype(jnp.float32)
+                else:
+                    l_m = jnp.mean(lg).astype(jnp.float32)
+                lval = lval + l_m
+            lval = lval / M
+            # mask + psum: count the replicated head loss exactly once so
+            # backward doesn't S-multiply the head/embedding grads
+            return jax.lax.psum(jnp.where(sid == S - 1, lval, 0.0), "pp")
         finally:
             _random.pop_trace_key()
 
-    blk_spec = P("pp")  # leading (block) dim split across stages
-    data_axes = tuple(a for a in ("dp", "sharding")
-                      if a in mesh.axis_names and mesh.shape[a] > 1)
-    batch_spec = P(data_axes) if data_axes else P()
-
     smapped = shard_map(
         spmd_loss, mesh=mesh,
-        # tree-prefix specs: one spec per argument subtree
-        in_specs=(P(), P(), P(), blk_spec, batch_spec, batch_spec),
+        # tree-prefix specs: one spec per argument subtree; only the
+        # manual 'pp' placement appears — dp/sharding/mp ride through as
+        # GSPMD auto axes from the arguments' own shardings
+        in_specs=(P(), P(), P(), P("pp"), P(), P()),
         out_specs=P(),
+        axis_names=frozenset({"pp"}),
         check_vma=False)
 
     def pure(key, pre, post, blk, pre_st, post_st, blk_st, lr, step_i,
-             batch, labels):
+             micro, mlab):
         def loss_of(pre_, post_, blk_):
-            with axis_env(*mesh.axis_names):
-                return smapped(key, pre_, post_, blk_, batch, labels)
+            with axis_env("pp"):
+                return smapped(key, pre_, post_, blk_, micro, mlab)
 
         loss_v, grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
             list(pre), list(post), list(blk))
         g_pre, g_post, g_blk = grads
+
+        if zstage >= 2 and sharding_degree > 1:
+            # ZeRO-2: grads live sharded like states → reduce-scatter.
+            # Build from the params' OWN specs so TP (mp) dims survive —
+            # a P()-based constraint would all-gather TP-sharded grads.
+            from .spmd import state_spec
+            g_pre = [jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, state_spec(ps, g.shape, zstage,
+                                                  sharding_degree)))
+                     for g, ps in zip(g_pre, pre_specs)]
+            g_post = [jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, state_spec(ps, g.shape, zstage,
+                                                  sharding_degree)))
+                      for g, ps in zip(g_post, post_specs)]
+            g_blk = [jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, _pp_state_spec(ps, g.shape, zstage,
+                                                      sharding_degree)))
+                     for g, ps in zip(g_blk, blk_specs)]
 
         new_pre, new_pre_st = opt._fused_apply(list(pre), g_pre,
                                                list(pre_st), lr, step_i)

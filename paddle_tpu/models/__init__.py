@@ -2,6 +2,7 @@
 from .bert import (BertConfig, BertForMaskedLM,  # noqa: F401
                    BertForSequenceClassification, BertModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
-from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
+from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
+                    LlamaForCausalLMPipe, LlamaModel,
                     LlamaPretrainingCriterion, count_params,
                     flops_per_token)

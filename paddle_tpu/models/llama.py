@@ -282,6 +282,68 @@ class LlamaPretrainingCriterion(Layer):
             ignore_index=self.ignore_index)
 
 
+class _LlamaPipeEmbed(Layer):
+    """Pipeline pre-section: token embedding (reference:
+    LlamaForCausalLMPipe's LlamaEmbeddingPipe)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        from ..nn.initializer import Normal
+        from ..nn.layer import ParamAttr
+        emb_attr = ParamAttr(initializer=Normal(0.0, 0.02))
+        if cfg.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size, weight_attr=emb_attr)
+        else:
+            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=emb_attr)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class _LlamaPipeHead(Layer):
+    """Pipeline post-section: final norm + LM head (reference:
+    LlamaForCausalLMPipe's LlamaRMSNormPipe + LlamaLMHead)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        if cfg.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=not cfg.tensor_parallel)
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, x):
+        return self.lm_head(self.norm(x))
+
+
+def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages=None,
+                         num_virtual_pipeline_stages=1, loss_fn=None,
+                         **kwargs):
+    """LLaMA as a PipelineLayer (reference: PaddleNLP
+    LlamaForCausalLMPipe): embedding pre-section, N decoder blocks, norm+
+    head post-section. Composes with TP (tensor_parallel=True) and ZeRO
+    via the pipeline runtime's GSPMD auto axes."""
+    from ..distributed.fleet.pipeline import LayerDesc, PipelineLayer
+    if cfg.tie_word_embeddings:
+        raise NotImplementedError(
+            "tie_word_embeddings is not supported in the pipeline form")
+    return PipelineLayer(
+        layers=[_LlamaPipeEmbed(cfg)] +
+               [LayerDesc(LlamaDecoderLayer, cfg)
+                for _ in range(cfg.num_hidden_layers)] +
+               [_LlamaPipeHead(cfg)],
+        num_stages=num_stages,
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+        loss_fn=loss_fn if loss_fn is not None
+        else LlamaPretrainingCriterion(cfg),
+        **kwargs)
+
+
 def count_params(cfg: LlamaConfig) -> int:
     h, m, L, v = (cfg.hidden_size, cfg.intermediate_size,
                   cfg.num_hidden_layers, cfg.vocab_size)
