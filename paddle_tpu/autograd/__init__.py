@@ -1,12 +1,106 @@
-"""paddle_tpu.autograd (paddle.autograd parity)."""
+"""paddle_tpu.autograd (paddle.autograd parity).
+
+Reference parity: paddle.autograd — backward/grad/PyLayer plus the
+functional jacobian/hessian API (upstream python/paddle/autograd/
+autograd.py — unverified; see SURVEY.md §2.2 Autograd API). Higher-order
+derivatives run on the eager tape's create_graph path: the first backward
+is recorded on the tape (each pullback re-traced through `jax.vjp`), so a
+second sweep differentiates it.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
 from ..core.autograd import (PyLayer, PyLayerContext, backward,  # noqa: F401
                              enable_grad, grad, is_grad_enabled, no_grad,
                              set_grad_enabled)
 
-hessian = None  # higher-order via functional jax transforms (jit module)
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _rows_of(ys, xs):
+    """d ys[i] / d xs for every flat index i of ys; each row flattened over
+    xs. Returns [ny, nx] Tensor (one backward sweep per row, graph kept)."""
+    from ..core.tensor import Tensor
+
+    ny = _numel(ys.shape)
+    rows = []
+    for i in range(ny):
+        seed = jnp.zeros((ny,), ys._data.dtype).at[i].set(1.0)
+        seed = seed.reshape(ys._data.shape)
+        (gx,) = grad([ys], [xs], grad_outputs=[Tensor(seed)],
+                     retain_graph=True, allow_unused=True)
+        if gx is None:
+            rows.append(jnp.zeros((_numel(xs.shape),), xs._data.dtype))
+        else:
+            rows.append(gx._data.reshape(-1))
+    return Tensor(jnp.stack(rows))
+
+
+class _LazyMatrix:
+    """Materialized Jacobian/Hessian with the reference's indexable
+    surface (J[:], J[0, 1], .numpy(), .shape)."""
+
+    def __init__(self, tensor):
+        self._t = tensor
+
+    @property
+    def shape(self):
+        return self._t.shape
+
+    def __getitem__(self, idx):
+        return self._t[idx]
+
+    def numpy(self):
+        return self._t.numpy()
+
+    def __repr__(self):
+        return f"Jacobian({self._t!r})"
 
 
 def jacobian(ys, xs, batch_axis=None):
-    raise NotImplementedError(
-        "Use paddle_tpu.jit.functional_grad / jax.jacobian via the "
-        "functional path for higher-order derivatives.")
+    """paddle.autograd.jacobian: d ys / d xs.
+
+    batch_axis=None → shape [ys.numel, xs.numel];
+    batch_axis=0    → shape [B, ys.numel//B, xs.numel//B] (per-sample
+    block diagonal, reference semantics).
+    Tuple xs → tuple of Jacobians.
+    """
+    if isinstance(xs, (tuple, list)):
+        return tuple(jacobian(ys, x, batch_axis) for x in xs)
+    full = _rows_of(ys, xs)
+    if batch_axis is None:
+        return _LazyMatrix(full)
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    B = int(ys.shape[0])
+    ny = _numel(ys.shape) // B
+    nx = _numel(xs.shape) // B
+    arr = full._data.reshape(B, ny, B, nx)
+    diag = jnp.stack([arr[b, :, b, :] for b in range(B)])
+    from ..core.tensor import Tensor
+    return _LazyMatrix(Tensor(diag))
+
+
+def hessian(ys, xs, batch_axis=None):
+    """paddle.autograd.hessian: d² ys / d xs² for scalar (or per-sample
+    scalar) ys. Uses create_graph to differentiate the first backward."""
+    if isinstance(xs, (tuple, list)):
+        raise NotImplementedError("tuple xs for hessian not supported yet")
+    (g,) = grad([ys], [xs], create_graph=True, retain_graph=True)
+    full = _rows_of(g, xs)
+    if batch_axis is None:
+        return _LazyMatrix(full)
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    B = int(xs.shape[0])
+    nx = _numel(xs.shape) // B
+    arr = full._data.reshape(B, nx, B, nx)
+    diag = jnp.stack([arr[b, :, b, :] for b in range(B)])
+    from ..core.tensor import Tensor
+    return _LazyMatrix(Tensor(diag))

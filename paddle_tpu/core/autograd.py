@@ -66,9 +66,9 @@ class TapeNode:
     """One recorded differentiable op: inputs + vjp pullback + output slots."""
 
     __slots__ = ("inputs", "in_versions", "vjp_fn", "multi_out", "out_refs",
-                 "out_info", "name", "__weakref__")
+                 "out_info", "name", "fn", "tensor_vjp", "__weakref__")
 
-    def __init__(self, inputs, vjp_fn, multi_out, name=""):
+    def __init__(self, inputs, vjp_fn, multi_out, name="", fn=None):
         self.inputs = tuple(inputs)          # strong refs keep the graph alive
         self.in_versions = tuple(t._version for t in inputs)
         self.vjp_fn = vjp_fn
@@ -76,6 +76,8 @@ class TapeNode:
         self.out_refs: list = []             # weakrefs to output Tensors
         self.out_info: list = []             # (shape, dtype) per output
         self.name = name
+        self.fn = fn          # forward fn, kept for create_graph re-trace
+        self.tensor_vjp = None  # PyLayer: Tensor-level backward (create_graph)
 
     def add_output(self, tensor):
         self.out_refs.append(weakref.ref(tensor))
@@ -84,6 +86,8 @@ class TapeNode:
     def release(self):
         self.vjp_fn = None
         self.inputs = ()
+        self.fn = None
+        self.tensor_vjp = None
 
 
 def _check_versions(node: TapeNode):
@@ -113,7 +117,7 @@ def apply(fn, *tensors, name: str = ""):
     if needs_grad:
         out, vjp_fn = jax.vjp(fn, *arrs)
         multi = isinstance(out, (tuple, list))
-        node = TapeNode(tensors, vjp_fn, multi, name=name)
+        node = TapeNode(tensors, vjp_fn, multi, name=name, fn=fn)
         if multi:
             res = tuple(Tensor(o, stop_gradient=False, _node=node) for o in out)
             for t in res:
@@ -165,18 +169,43 @@ def _accumulate(dst: dict, key, g):
         dst[key] = g
 
 
+def _make_pullback(node: TapeNode):
+    """A pure array function computing node's vjp FROM SCRATCH: re-traces
+    jax.vjp(fn, *inputs) so the input-dependence of the residuals is
+    differentiable — the requirement for create_graph (double backward)."""
+    n_in = len(node.inputs)
+    fwd = node.fn
+    multi = node.multi_out
+
+    def pullback(*args):
+        ins, cots = args[:n_in], args[n_in:]
+        _, vjp_fn = jax.vjp(fwd, *ins)
+        return vjp_fn(tuple(cots) if multi else cots[0])
+
+    return pullback
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
-                 sinks=None, accumulate_into_grad=True):
+                 sinks=None, accumulate_into_grad=True, create_graph=False):
     """Core engine. `sinks`: optional list of Tensors whose cotangents should
     be collected and returned (paddle.grad); when given with
     accumulate_into_grad=False, .grad fields are untouched.
+
+    create_graph=True runs every pullback through `apply()` — the vjp is
+    re-traced as a function of (inputs, cotangents), so the backward pass
+    itself lands on the tape and is differentiable (double backward,
+    reference: paddle.grad(create_graph=True), SURVEY.md §2.2 Autograd).
+    Cotangents are then Tensors and accumulate via tape-recorded adds.
     """
     from .tensor import Tensor
+
+    if create_graph:
+        retain_graph = True  # residual re-trace needs the graph intact
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
 
-    grads: dict[int, object] = {}     # id(Tensor) -> cotangent array
+    grads: dict[int, object] = {}     # id(Tensor) -> cotangent (array|Tensor)
     alive: dict[int, object] = {}     # id -> Tensor, pins ids
     sink_ids = {id(t) for t in (sinks or [])}
     sink_grads: dict[int, object] = {}
@@ -184,16 +213,22 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     def deposit(t, g):
         if t.stop_gradient:
             return
-        if getattr(g, "dtype", None) == jax.dtypes.float0:
+        garr = g._data if isinstance(g, Tensor) else g
+        if getattr(garr, "dtype", None) == jax.dtypes.float0:
             return  # non-differentiable (integer/key) input
         for hook in t._hooks:
-            out = hook(Tensor(g))
+            out = hook(g if isinstance(g, Tensor) else Tensor(g))
             if out is not None:
-                g = out._data if isinstance(out, Tensor) else out
+                g = out if create_graph else \
+                    (out._data if isinstance(out, Tensor) else out)
         if id(t) in sink_ids:
             _accumulate(sink_grads, id(t), g)
         if accumulate_into_grad and (t._node is None or t._retain_grads):
-            t.grad = Tensor(g) if t.grad is None else Tensor(t.grad._data + g)
+            if create_graph:
+                t.grad = g if t.grad is None else t.grad + g
+            else:
+                t.grad = Tensor(g) if t.grad is None \
+                    else Tensor(t.grad._data + g)
         if t._node is not None:
             _accumulate(grads, id(t), g)
             alive[id(t)] = t
@@ -202,14 +237,19 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         if t.stop_gradient and t._node is None:
             raise RuntimeError("backward() called on a tensor that does not "
                                "require grad (stop_gradient=True, no graph).")
-        seed = (jnp.ones(t._data.shape, t._data.dtype) if g is None
-                else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+        if g is None:
+            seed = jnp.ones(t._data.shape, t._data.dtype)
+            seed = Tensor(seed) if create_graph else seed
+        elif create_graph:
+            seed = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        else:
+            seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         deposit(t, seed)
 
     order = _topo_order([t._node for t in tensors])
 
     for node in reversed(order):
-        if node.vjp_fn is None:
+        if node.vjp_fn is None and node.tensor_vjp is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time, but the "
                 "saved intermediate results have already been freed. Pass "
@@ -220,14 +260,31 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             g = grads.pop(id(t), None) if t is not None else None
             if g is None:
                 g = jnp.zeros(shape, dtype)
+                if create_graph:
+                    g = Tensor(g)
             else:
                 any_grad = True
             cotangents.append(g)
         if not any_grad:
             continue
         _check_versions(node)
-        in_grads = node.vjp_fn(tuple(cotangents) if node.multi_out
-                               else cotangents[0])
+        if create_graph:
+            cot_ts = [c if isinstance(c, Tensor) else Tensor(c)
+                      for c in cotangents]
+            if node.fn is not None:
+                in_grads = apply(_make_pullback(node), *node.inputs, *cot_ts,
+                                 name=f"vjp[{node.name}]")
+                if not isinstance(in_grads, tuple):
+                    in_grads = (in_grads,)
+            elif node.tensor_vjp is not None:
+                in_grads = node.tensor_vjp(cot_ts)
+            else:
+                raise RuntimeError(
+                    f"node '{node.name}' does not support create_graph "
+                    "(no re-traceable forward)")
+        else:
+            in_grads = node.vjp_fn(tuple(cotangents) if node.multi_out
+                                   else cotangents[0])
         for t, g in zip(node.inputs, in_grads):
             if g is not None:
                 deposit(t, g)
@@ -250,23 +307,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """paddle.grad — functional gradients without touching .grad.
 
-    create_graph (double backward) is not supported in the eager tape this
-    round; use `paddle_tpu.jit.grad`-style functional transforms for
-    higher-order derivatives.
+    create_graph=True records the backward pass on the tape so the result
+    is itself differentiable (double backward / jacobian / hessian).
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the eager tape; use the "
-            "functional jax transform path (paddle_tpu.jit) for higher-order "
-            "gradients.")
     outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
     if retain_graph is None:
         retain_graph = False
     sink_grads = run_backward(outputs, grad_outputs, retain_graph=retain_graph,
-                              sinks=inputs, accumulate_into_grad=False)
+                              sinks=inputs, accumulate_into_grad=False,
+                              create_graph=create_graph)
     result = []
     for t in inputs:
         g = sink_grads.get(id(t))
@@ -278,7 +330,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "is intended.")
             result.append(None)
         else:
-            result.append(Tensor(g))
+            result.append(g if isinstance(g, Tensor) else Tensor(g))
     return result
 
 
@@ -338,7 +390,22 @@ class PyLayer(metaclass=PyLayerMeta):
                                (g._data if isinstance(g, Tensor) else g))
             return out
 
+        def tensor_vjp(cot_tensors):
+            """create_graph path: run the user backward with grad ENABLED on
+            Tensor cotangents so a differentiable backward lands on the tape
+            (reference: PyLayer double backward when backward() is composed
+            of differentiable ops)."""
+            gin = cls.backward(ctx, *(cot_tensors if multi
+                                      else [cot_tensors[0]]))
+            gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            out, it = [], iter(gin)
+            for a in args:
+                if isinstance(a, Tensor):
+                    out.append(next(it, None))
+            return out
+
         node = TapeNode(tensor_inputs, vjp_fn, multi, name=cls.__name__)
+        node.tensor_vjp = tensor_vjp
         results = []
         for o in out_list:
             t = o if isinstance(o, Tensor) else Tensor(o)

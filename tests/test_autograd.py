@@ -179,3 +179,104 @@ class TestPyLayer:
         assert np.allclose(y.numpy(), [8.0])
         y.backward()
         assert np.allclose(x.grad.numpy(), [12.0])
+
+
+class TestHigherOrder:
+    """create_graph double backward vs jax.grad∘jax.grad oracles
+    (VERDICT r1 item 7)."""
+
+    def test_grad_of_grad_scalar(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x ** 3 + 2.0 * x)
+
+        xv = np.array([1.5, -2.0, 0.5], dtype=np.float32)
+        x = t(xv)
+        y = (x ** 3 + 2.0 * x).sum()
+        (g,) = P.grad([y], [x], create_graph=True)
+        assert not g.stop_gradient
+        g2 = P.grad([g.sum()], [x])[0]
+        oracle = jax.grad(lambda a: jnp.sum(jax.grad(f)(a)))(jnp.asarray(xv))
+        assert np.allclose(g2.numpy(), np.asarray(oracle), atol=1e-5)
+
+    def test_grad_of_grad_through_matmul(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        av = rng.standard_normal((3, 4)).astype(np.float32)
+        bv = rng.standard_normal((4, 2)).astype(np.float32)
+
+        def f(a, b):
+            return jnp.sum(jnp.tanh(a @ b) ** 2)
+
+        a, b = t(av), t(bv)
+        y = (P.tanh(P.matmul(a, b)) ** 2).sum()
+        (ga,) = P.grad([y], [a], create_graph=True)
+        gg = P.grad([(ga * ga).sum()], [b])[0]
+        oracle = jax.grad(
+            lambda a_, b_: jnp.sum(jax.grad(f, argnums=0)(a_, b_) ** 2),
+            argnums=1)(jnp.asarray(av), jnp.asarray(bv))
+        assert np.allclose(gg.numpy(), np.asarray(oracle), atol=1e-4)
+
+    def test_backward_after_create_graph_grad(self):
+        """x.grad accumulation through a second .backward() on a
+        create_graph first-order grad."""
+        x = t([2.0])
+        y = (x ** 4).sum()
+        (g,) = P.grad([y], [x], create_graph=True)   # 4x^3 = 32
+        g.sum().backward()                           # d/dx 4x^3 = 12x^2
+        assert np.allclose(x.grad.numpy(), [48.0])
+
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        xv = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        x = t(xv)
+        y = x ** 2
+        J = jacobian(y, x)
+        assert list(J.shape) == [3, 3]
+        assert np.allclose(J.numpy(), np.diag(2 * xv), atol=1e-5)
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+        xv = np.array([1.0, 2.0], dtype=np.float32)
+        x = t(xv)
+        y = (x ** 3).sum()
+        H = hessian(y, x)
+        assert np.allclose(H.numpy(), np.diag(6 * xv), atol=1e-4)
+
+    def test_hessian_nondiagonal(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.autograd import hessian
+
+        rng = np.random.default_rng(1)
+        xv = rng.standard_normal((4,)).astype(np.float32)
+        x = t(xv)
+        y = ((x ** 2).sum()) * x.sum()
+        H = hessian(y, x)
+        oracle = jax.hessian(
+            lambda a: jnp.sum(a ** 2) * jnp.sum(a))(jnp.asarray(xv))
+        assert np.allclose(H.numpy(), np.asarray(oracle), atol=1e-4)
+
+    def test_pylayer_double_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor
+                return 2.0 * x * gy
+
+        x = t([3.0])
+        y = Square.apply(x).sum()
+        (g,) = P.grad([y], [x], create_graph=True)   # 2x = 6
+        g2 = P.grad([g.sum()], [x])[0]               # 2
+        assert np.allclose(g2.numpy(), [2.0])
