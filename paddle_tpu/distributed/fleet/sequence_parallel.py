@@ -94,6 +94,20 @@ def reduce_scatter(x, group=None, axis=0):
 
         f.defvjp(fwd, bwd)
         return apply(f, x, name="sp_reduce_scatter")
+    if group is not None:
+        # GSPMD: the reduce is the partitioner's job; constrain the output
+        # to sequence-sharded layout so the activation actually lives
+        # split (Megatron-SP's memory saving) instead of replicated.
+        spec = [None] * x.ndim
+        spec[axis] = "mp"
+
+        def f(a):
+            try:
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(_current_mesh(), P(*spec)))
+            except Exception:
+                return a
+        return apply(f, x, name="sp_reduce_scatter")
     return x
 
 
