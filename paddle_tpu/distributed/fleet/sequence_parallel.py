@@ -30,6 +30,23 @@ def _live(group):
     return group is not None and group.axis_name in current_axis_env()
 
 
+def _constrain(x, group, shard_axis, name):
+    """GSPMD layout hint: shard dim `shard_axis` of x over the group's
+    mesh axis (None = fully replicated). The partitioner then emits the
+    matching collective around adjacent TP matmuls."""
+    spec = [None] * x.ndim
+    if shard_axis is not None:
+        spec[shard_axis] = group.axis_name
+
+    def f(a):
+        try:
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(_current_mesh(), P(*spec)))
+        except Exception:
+            return a
+    return apply(f, x, name=name)
+
+
 def scatter(x, group=None, axis=0):
     """Sequence-dim scatter: keep this rank's sequence chunk.
     fwd: split; bwd: all-gather."""
@@ -38,17 +55,7 @@ def scatter(x, group=None, axis=0):
         from .mp_ops import _c_split
         return _c_split(x, group, axis=axis)
     if group is not None:
-        # GSPMD hint: shard the sequence dim over mp
-        spec = [None] * x.ndim
-        spec[axis] = "mp"
-
-        def f(a):
-            try:
-                return jax.lax.with_sharding_constraint(
-                    a, NamedSharding(_current_mesh(), P(*spec)))
-            except Exception:
-                return a
-        return apply(f, x, name="sp_scatter")
+        return _constrain(x, group, axis, "sp_scatter")
     return x
 
 
@@ -59,15 +66,7 @@ def all_gather(x, group=None, axis=0):
         from .mp_ops import _c_concat
         return _c_concat(x, group, axis=axis)
     if group is not None:
-        spec = [None] * x.ndim
-
-        def f(a):
-            try:
-                return jax.lax.with_sharding_constraint(
-                    a, NamedSharding(_current_mesh(), P(*spec)))
-            except Exception:
-                return a
-        return apply(f, x, name="sp_allgather")
+        return _constrain(x, group, None, "sp_allgather")
     return x
 
 
@@ -98,16 +97,7 @@ def reduce_scatter(x, group=None, axis=0):
         # GSPMD: the reduce is the partitioner's job; constrain the output
         # to sequence-sharded layout so the activation actually lives
         # split (Megatron-SP's memory saving) instead of replicated.
-        spec = [None] * x.ndim
-        spec[axis] = "mp"
-
-        def f(a):
-            try:
-                return jax.lax.with_sharding_constraint(
-                    a, NamedSharding(_current_mesh(), P(*spec)))
-            except Exception:
-                return a
-        return apply(f, x, name="sp_reduce_scatter")
+        return _constrain(x, group, axis, "sp_reduce_scatter")
     return x
 
 

@@ -1,0 +1,633 @@
+"""Dynamic-to-static AST lowering (dy2static).
+
+Reference parity: the AST-transform half of paddle.jit.dy2static
+(upstream python/paddle/jit/dy2static/ — convert_call, convert_ifelse,
+convert_while_loop; ~100k LoC with SOT — unverified; see SURVEY.md §2.2
+Dy2Static, §3.4). TPU-native design: instead of generating Program ops,
+tensor-dependent Python control flow is rewritten to runtime-dispatch
+helpers that lower onto XLA's structured control flow —
+
+- ``if``      → ``jax.lax.cond`` (both branches traced, one selected on
+  device; predicates that are concrete Python values take the plain
+  Python path with zero tracing overhead),
+- ``while``   → ``jax.lax.while_loop`` (carry = the names the body
+  assigns; Python-number carries are promoted to traced scalars),
+- ``for i in range(...)`` with traced bounds → ``lax.while_loop`` with
+  the index in the carry (static bounds keep the unrolled Python loop).
+
+The transform is best-effort and safe: constructs it can't lower
+(break/continue, mixed returns, zero-arg super(), global/nonlocal) are
+left untouched — tracing then raises and `to_static` falls back to eager,
+recording the graph-break reason (the SOT-fallback contract).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import GraphBreakError, Tensor
+
+__all__ = ["transform", "if_", "while_", "for_range", "UNDEF", "peek"]
+
+
+class _Undef:
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+
+
+def peek(loc, name):
+    """Pre-bind a maybe-undefined branch output var (reference:
+    dy2static UndefinedVar)."""
+    return loc.get(name, UNDEF)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _to_bool(x):
+    v = _unwrap(x)
+    if isinstance(v, (bool, int, float, np.bool_)):
+        return bool(v)
+    return bool(np.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# runtime pytree: Tensors/arrays → leaves; Python numbers promoted when
+# `promote` (loop carries must be traced); everything else static.
+
+def _flatten(obj, promote=False):
+    arrs = []
+
+    def walk(o):
+        if isinstance(o, Tensor):
+            arrs.append(o._data)
+            return ("T", len(arrs) - 1)
+        if isinstance(o, (jax.Array, jnp.ndarray, np.ndarray)) or \
+                isinstance(o, jax.core.Tracer):
+            arrs.append(jnp.asarray(o))
+            return ("A", len(arrs) - 1)
+        if promote and isinstance(o, (bool, int, float)) and \
+                not isinstance(o, _Undef):
+            arrs.append(jnp.asarray(o))
+            return ("A", len(arrs) - 1)
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [walk(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", [(k, walk(v)) for k, v in o.items()])
+        return ("S", o)
+
+    spec = walk(obj)
+
+    def rebuild(flat, sp=spec):
+        def un(s):
+            tag = s[0]
+            if tag == "T":
+                return Tensor(flat[s[1]], stop_gradient=True)
+            if tag == "A":
+                return flat[s[1]]
+            if tag == "S":
+                return s[1]
+            if tag == "dict":
+                return {k: un(v) for k, v in s[1]}
+            seq = [un(x) for x in s[1]]
+            return tuple(seq) if tag == "tuple" else seq
+        return un(sp)
+
+    def sig(s):
+        tag = s[0]
+        if tag in ("T", "A"):
+            return ("arr",)
+        if tag == "S":
+            v = s[1]
+            return ("S", v if isinstance(v, (int, float, str, bool,
+                                             type(None), _Undef))
+                    else f"<{type(v).__name__}>")
+        if tag == "dict":
+            return ("dict", tuple((k, sig(v)) for k, v in s[1]))
+        return (tag, tuple(sig(x) for x in s[1]))
+
+    return arrs, rebuild, sig(spec)
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers the generated code calls
+
+def if_(pred, true_fn, false_fn, args):
+    p = _unwrap(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return (true_fn if _to_bool(p) else false_fn)(*args)
+    p = jnp.asarray(p)
+    if p.shape != ():
+        raise GraphBreakError(
+            f"if-predicate must be a scalar, got shape {p.shape}")
+    arrs, rebuild, _ = _flatten(args)
+    box = {}
+
+    def wrap(fn, tag):
+        def g(flat):
+            out = fn(*rebuild(list(flat)))
+            oarrs, orebuild, osig = _flatten(out, promote=True)
+            box[tag] = (osig, orebuild)
+            return tuple(oarrs)
+        return g
+
+    try:
+        res = jax.lax.cond(p.astype(bool), wrap(true_fn, "t"),
+                           wrap(false_fn, "f"), tuple(arrs))
+    except GraphBreakError:
+        raise
+    except Exception as e:  # structure/dtype divergence between branches
+        raise GraphBreakError(
+            f"if-branches not loweable to lax.cond: {e}") from None
+    if box["t"][0] != box["f"][0]:
+        raise GraphBreakError(
+            "if-branches produce diverging non-tensor values: "
+            f"{box['t'][0]} vs {box['f'][0]}")
+    return box["t"][1](list(res))
+
+
+def while_(cond_fn, body_fn, args):
+    args = tuple(args)
+    # Python-unroll while the condition stays concrete (static trip
+    # counts compile to straight-line XLA — cheaper and reverse-
+    # differentiable); lower to lax.while_loop the moment it traces.
+    while True:
+        c = cond_fn(*args)
+        if _is_traced(c):
+            break
+        if not _to_bool(c):
+            return args
+        args = tuple(body_fn(*args))
+    arrs, rebuild, isig = _flatten(args, promote=True)
+
+    def cond_w(flat):
+        v = jnp.asarray(_unwrap(cond_fn(*rebuild(list(flat)))))
+        if v.shape != ():
+            raise GraphBreakError(
+                f"while-condition must be a scalar, got shape {v.shape}")
+        return v.astype(bool)
+
+    def body_w(flat):
+        out = body_fn(*rebuild(list(flat)))
+        oarrs, _, osig = _flatten(tuple(out), promote=True)
+        if osig != isig:
+            raise GraphBreakError(
+                "while-body changes the structure/static values of its "
+                f"loop vars: {isig} vs {osig}")
+        return tuple(oarrs)
+
+    try:
+        res = jax.lax.while_loop(cond_w, body_w, tuple(arrs))
+    except GraphBreakError:
+        raise
+    except Exception as e:
+        raise GraphBreakError(
+            f"while not loweable to lax.while_loop: {e}") from None
+    return rebuild(list(res))
+
+
+def for_range(rargs, body_fn, prior, args):
+    """``for i in range(*rargs)`` with carry `args`. Returns
+    (final_i, *carry); when the loop never runs, final_i keeps `prior`
+    (the target's binding before the loop — Python leaves it untouched)."""
+    args = tuple(args)
+    rargs = tuple(_unwrap(r) for r in rargs)
+    if len(rargs) == 1:
+        start, stop, step = 0, rargs[0], 1
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], 1
+    else:
+        start, stop, step = rargs
+    if not any(isinstance(v, jax.core.Tracer) for v in (start, stop, step)):
+        i_last = prior
+        for i in range(int(np.asarray(start)), int(np.asarray(stop)),
+                       int(np.asarray(step))):
+            args = tuple(body_fn(i, *args))
+            i_last = i
+        return (i_last,) + args
+
+    start = jnp.asarray(start)
+    stop = jnp.asarray(stop)
+    step = jnp.asarray(step)
+    arrs, rebuild, isig = _flatten(args, promote=True)
+
+    def cond_w(carry):
+        i, flat = carry
+        return jnp.where(step > 0, i < stop, i > stop)
+
+    def body_w(carry):
+        i, flat = carry
+        out = body_fn(i, *rebuild(list(flat)))
+        oarrs, _, osig = _flatten(tuple(out), promote=True)
+        if osig != isig:
+            raise GraphBreakError(
+                "for-body changes the structure/static values of its "
+                f"loop vars: {isig} vs {osig}")
+        return (i + step, tuple(oarrs))
+
+    try:
+        i_fin, res = jax.lax.while_loop(cond_w, body_w, (start, tuple(arrs)))
+    except GraphBreakError:
+        raise
+    except Exception as e:
+        raise GraphBreakError(
+            f"for-range not loweable to lax.while_loop: {e}") from None
+    ran = jnp.where(step > 0, start < stop, start > stop)
+    p = _unwrap(prior)
+    if isinstance(p, (bool, int, float, jax.Array, np.ndarray)) and \
+            not isinstance(p, _Undef):
+        i_final = jnp.where(ran, i_fin - step, jnp.asarray(p))
+    else:
+        # no numeric prior to fall back to under trace; only correct
+        # when the loop body runs at least once
+        i_final = i_fin - step
+    return (i_final,) + tuple(rebuild(list(res)))
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+
+
+def _assigned_names(nodes):
+    """Names bound by statements (not descending into nested scopes)."""
+    names = set()
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+        # Attribute/Subscript targets mutate objects, not names
+
+    def walk(n):
+        if isinstance(n, _SCOPE_NODES):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                names.add(n.name)
+            return
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                collect_target(t)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            collect_target(n.target)
+        elif isinstance(n, ast.For):
+            collect_target(n.target)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            collect_target(n.optional_vars)
+        elif isinstance(n, ast.NamedExpr):
+            collect_target(n.target)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for a in n.names:
+                names.add(a.asname or a.name.split(".")[0])
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for n in nodes:
+        walk(n)
+    return names
+
+
+def _contains(nodes, kinds, top_only_kinds=()):
+    """Any node of `kinds` inside (not descending into nested scopes)?"""
+    found = []
+
+    def walk(n, top):
+        if isinstance(n, _SCOPE_NODES):
+            return
+        if isinstance(n, kinds):
+            found.append(n)
+            return
+        if top_only_kinds and isinstance(n, top_only_kinds) and not top:
+            return  # don't descend past nested loops for break/continue
+        for c in ast.iter_child_nodes(n):
+            walk(c, False)
+
+    for n in nodes:
+        walk(n, True)
+    return bool(found)
+
+
+def _has_loop_escape(body):
+    """break/continue that would escape THIS loop (i.e. not inside a
+    nested loop)."""
+    found = []
+
+    def walk(n):
+        if isinstance(n, _SCOPE_NODES + (ast.For, ast.While,
+                                         ast.AsyncFor)):
+            return
+        if isinstance(n, (ast.Break, ast.Continue)):
+            found.append(n)
+            return
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for n in body:
+        walk(n)
+    return bool(found)
+
+
+def _has_return(nodes):
+    return _contains(nodes, (ast.Return,))
+
+
+def _has_object_store(nodes):
+    """Attribute/subscript stores (self.x = …, x[i] = …) inside the block.
+    These are object mutations, not name rebinds: under lax.cond BOTH
+    branches trace (and a loop body traces once), so the mutation would
+    fire at the wrong time/count — must block lowering and fall back."""
+    found = []
+
+    def targets_of(n):
+        if isinstance(n, ast.Assign):
+            return n.targets
+        if isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            return [n.target]
+        return []
+
+    def walk(n):
+        if isinstance(n, _SCOPE_NODES):
+            return
+        for t in targets_of(n):
+            for sub in ast.walk(t):
+                if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
+                        isinstance(sub.ctx, (ast.Store,)):
+                    found.append(sub)
+        for c in ast.iter_child_nodes(n):
+            walk(c)
+
+    for n in nodes:
+        walk(n)
+    return bool(found)
+
+
+def _blockers(nodes):
+    return _contains(nodes, (ast.Global, ast.Nonlocal, ast.Delete,
+                             ast.Yield, ast.YieldFrom, ast.Await)) or \
+        _has_object_store(nodes)
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _call_helper(helper, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("__jst"), attr=helper,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _fn_def(name, params, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=p)
+                                                 for p in params],
+                           vararg=None, kwonlyargs=[], kw_defaults=[],
+                           kwarg=None, defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+def _peek_stmts(names):
+    """name = __jst.peek(locals(), 'name') for each maybe-undefined var."""
+    out = []
+    for n in names:
+        out.append(ast.Assign(
+            targets=[_name(n, ast.Store())],
+            value=_call_helper("peek", [
+                ast.Call(func=_name("locals"), args=[], keywords=[]),
+                ast.Constant(n)])))
+    return out
+
+
+def _public(names):
+    """Drop transformer-internal helper names from a carry set."""
+    return sorted(n for n in names if not n.startswith("__jst"))
+
+
+class _CFTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    # -- if ----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse or []
+        if _blockers(body) or _blockers(orelse):
+            return node
+        i = self._uid()
+        tname, fname = f"__jst_true_{i}", f"__jst_false_{i}"
+
+        ret_b = _has_return(body)
+        ret_o = _has_return(orelse)
+        if ret_b or ret_o:
+            # only the clean both-branches-end-in-return form lowers
+            def clean(blk):
+                return (blk and isinstance(blk[-1], ast.Return)
+                        and not _has_return(blk[:-1]))
+            if not (clean(body) and clean(orelse)):
+                return node
+            # names the branch bodies rebind become params so reads
+            # before the rebind hit the param, not an unbound local
+            names = _public(_assigned_names(body[:-1]) |
+                            _assigned_names(orelse[:-1]))
+
+            def retval(r):
+                return r.value if r.value is not None else \
+                    ast.Constant(None)
+            tdef = _fn_def(tname, names, body[:-1] +
+                           [ast.Return(retval(body[-1]))])
+            fdef = _fn_def(fname, names, orelse[:-1] +
+                           [ast.Return(retval(orelse[-1]))])
+            call = _call_helper("if_", [node.test, _name(tname),
+                                        _name(fname), _tuple_of(names)])
+            self.changed = True
+            return _peek_stmts(names) + [tdef, fdef, ast.Return(call)]
+
+        names = _public(_assigned_names(body) | _assigned_names(orelse))
+        ret_tuple = ast.Return(_tuple_of(names))
+        tdef = _fn_def(tname, names, (body or [ast.Pass()]) + [ret_tuple])
+        fdef = _fn_def(fname, names, (orelse or [ast.Pass()]) +
+                       [ast.Return(_tuple_of(names))])
+        call = _call_helper("if_", [node.test, _name(tname), _name(fname),
+                                    _tuple_of(names)])
+        if names:
+            assign = ast.Assign(
+                targets=[_tuple_of(names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        self.changed = True
+        return _peek_stmts(names) + [tdef, fdef, assign]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        body = node.body
+        if node.orelse or _blockers(body) or _has_return(body) or \
+                _has_loop_escape(body) or _assigned_names([node.test]):
+            # a walrus in the test would rebind inside the generated cond
+            # fn and the update would be lost — leave untransformed
+            return node
+        names = _public(_assigned_names(body))
+        i = self._uid()
+        cname, bname = f"__jst_cond_{i}", f"__jst_body_{i}"
+        cdef = _fn_def(cname, names, [ast.Return(node.test)])
+        bdef = _fn_def(bname, names, body + [ast.Return(_tuple_of(names))])
+        call = _call_helper("while_", [_name(cname), _name(bname),
+                                       _tuple_of(names)])
+        if names:
+            assign = ast.Assign(
+                targets=[_tuple_of(names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        self.changed = True
+        return _peek_stmts(names) + [cdef, bdef, assign]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        body = node.body
+        if (node.orelse or _blockers(body) or _has_return(body) or
+                _has_loop_escape(body) or
+                not isinstance(node.target, ast.Name) or
+                not (isinstance(node.iter, ast.Call) and
+                     isinstance(node.iter.func, ast.Name) and
+                     node.iter.func.id == "range" and
+                     not node.iter.keywords) or
+                _assigned_names([node.iter])):
+            return node
+        tgt = node.target.id
+        names = _public(_assigned_names(body) - {tgt})
+        i = self._uid()
+        bname = f"__jst_forbody_{i}"
+        bdef = _fn_def(bname, [tgt] + names,
+                       body + [ast.Return(_tuple_of(names))])
+        call = _call_helper("for_range", [
+            ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+            _name(bname), _name(tgt), _tuple_of(names)])
+        assign = ast.Assign(
+            targets=[_tuple_of([tgt] + names, ast.Store())], value=call)
+        self.changed = True
+        return _peek_stmts([tgt] + names) + [bdef, assign]
+
+
+# ---------------------------------------------------------------------------
+# transform entry
+
+def transform(fn):
+    """Return fn with tensor-dependent control flow lowered, or fn itself
+    when nothing needs (or survives) transformation. Raises on source
+    unavailability so the caller can record the reason."""
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if bound_self is not None else fn
+
+    code = raw.__code__
+    if "__class__" in code.co_freevars and "super" in code.co_names:
+        raise GraphBreakError("zero-arg super() is not re-compilable")
+
+    src = textwrap.dedent(inspect.getsource(raw))
+    mod = ast.parse(src)
+    fdef = mod.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise GraphBreakError("source is not a function definition")
+    fdef.decorator_list = []
+
+    tr = _CFTransformer()
+    new_body = []
+    for stmt in fdef.body:
+        r = tr.visit(stmt)
+        new_body.extend(r if isinstance(r, list) else [r])
+    if not tr.changed:
+        return fn
+    fdef.body = new_body
+
+    # Build against the ORIGINAL globals dict (live — later rebinding of
+    # a module-level name must be seen, exactly as the untransformed fn
+    # would) and the ORIGINAL closure cells (live values, not snapshots).
+    # The function is compiled inside a factory whose params mirror the
+    # free variables so the compiler emits cell references; we then
+    # discard the factory and rebind the inner code object onto raw's
+    # real cells via types.FunctionType.
+    inner_name = fdef.name
+    freevars = [v for v in code.co_freevars]
+    if freevars:
+        factory = _fn_def("__jst_factory", freevars,
+                          [fdef, ast.Return(_name(fdef.name))])
+        mod.body = [factory]
+    else:
+        mod.body = [fdef]
+    ast.fix_missing_locations(mod)
+
+    import types
+
+    import paddle_tpu.jit.dy2static as _jst_mod
+    g = raw.__globals__
+    g["__jst"] = _jst_mod
+    filename = f"<dy2static:{raw.__qualname__}>"
+    top_code = compile(mod, filename, "exec")
+    if freevars:
+        factory_code = next(
+            c for c in top_code.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == "__jst_factory")
+        inner_code = next(
+            c for c in factory_code.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == inner_name)
+        cellmap = dict(zip(code.co_freevars, raw.__closure__))
+        try:
+            closure = tuple(cellmap[n] for n in inner_code.co_freevars)
+        except KeyError as e:
+            raise GraphBreakError(f"free variable {e} not in original "
+                                  "closure")
+        new_fn = types.FunctionType(inner_code, g, raw.__name__,
+                                    raw.__defaults__, closure)
+    else:
+        inner_code = next(
+            c for c in top_code.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == inner_name)
+        new_fn = types.FunctionType(inner_code, g, raw.__name__,
+                                    raw.__defaults__)
+    new_fn.__kwdefaults__ = raw.__kwdefaults__
+    if bound_self is not None:
+        return new_fn.__get__(bound_self, type(bound_self))
+    return new_fn

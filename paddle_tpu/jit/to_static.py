@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 
@@ -37,6 +39,15 @@ def _tree_flatten_tensors(obj):
         if isinstance(o, Tensor):
             idx = len(tensors)
             tensors.append(o)
+            return ("T", idx)
+        if isinstance(o, (jax.Array, jax.core.Tracer, _np.ndarray)):
+            # raw arrays (promoted dy2static loop carries, numpy args)
+            # must ride the traced path, never the static signature — a
+            # tracer buried in a static would leak out of the jit, and a
+            # large numpy array keyed by its summarized repr() would
+            # alias distinct values onto one stale compiled constant
+            idx = len(tensors)
+            tensors.append(Tensor(jnp.asarray(o), stop_gradient=True))
             return ("T", idx)
         if isinstance(o, (list, tuple)):
             return (type(o).__name__, [walk(x) for x in o])
@@ -119,16 +130,45 @@ class StaticFunction:
         self._layers = list(layers) if layers else None
         self._jit_cache = {}
         self._fallback_warned = False
+        self._traced_fn = None       # dy2static-transformed fn (lazy)
+        self._transform_note = None
+        self.graph_break_reasons = []
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__",
                                            "__qualname__"), updated=())
 
-    # descriptor protocol: decorating a method binds per-instance
+    def _get_traced(self):
+        """The fn actually traced under jit: tensor-dependent control flow
+        lowered to lax.cond/while_loop by the AST pass (dy2static); falls
+        back to the original fn when the source can't be transformed."""
+        if self._traced_fn is None:
+            from . import dy2static
+            try:
+                self._traced_fn = dy2static.transform(self._fn)
+            except Exception as e:
+                self._transform_note = f"dy2static transform skipped: {e!r}"
+                self.graph_break_reasons.append(self._transform_note)
+                self._traced_fn = self._fn
+        return self._traced_fn
+
+    # descriptor protocol: decorating a method binds per-instance; the
+    # bound StaticFunction is cached in the INSTANCE dict so the jit
+    # cache and dy2static transform survive across calls, and the cache
+    # entry dies with the instance (no global registry to leak)
     def __get__(self, obj, objtype=None):
         if obj is None:
             return self
-        bound = StaticFunction(self._fn.__get__(obj, objtype),
-                               layers=self._layers)
+        key = "_jst_bound_" + self._fn.__name__
+        try:
+            d = obj.__dict__
+        except AttributeError:  # __slots__ instance — uncached
+            return StaticFunction(self._fn.__get__(obj, objtype),
+                                  layers=self._layers)
+        bound = d.get(key)
+        if not isinstance(bound, StaticFunction):
+            bound = StaticFunction(self._fn.__get__(obj, objtype),
+                                   layers=self._layers)
+            d[key] = bound
         return bound
 
     @property
@@ -156,8 +196,8 @@ class StaticFunction:
 
         jit_entry = self._jit_cache.get(cache_key)
         if jit_entry is None:
-            jit_entry = self._build(fn, layers, named_params, named_buffers,
-                                    rebuild_in)
+            jit_entry = self._build(self._get_traced(), layers,
+                                    named_params, named_buffers, rebuild_in)
             self._jit_cache[cache_key] = jit_entry
         jit_fn, n_out_holder = jit_entry
 
@@ -173,8 +213,13 @@ class StaticFunction:
                 jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError,
-                _GraphBreakError):
-            # graph break → eager fallback (reference: SOT fallback)
+                _GraphBreakError) as e:
+            # graph break → eager fallback (reference: SOT fallback),
+            # with the reason recorded for diagnosis (bounded: a
+            # permanently-falling-back fn must not grow the list forever)
+            self.graph_break_reasons.append(
+                f"{type(e).__name__}: {e}")
+            del self.graph_break_reasons[:-50]
             self._jit_cache.pop(cache_key, None)
             return fn(*args, **kwargs)
 

@@ -1,0 +1,334 @@
+"""Dy2static control-flow lowering tests (VERDICT r1 item 5): tensor-
+dependent if/while/for compile to lax.cond/while_loop — no eager
+fallback — and match eager outputs; untransformable code still falls
+back with the reason recorded."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.jit import to_static
+
+
+def t(arr):
+    return P.to_tensor(np.asarray(arr, dtype=np.float32))
+
+
+def _compiled_ok(st):
+    """Assert the StaticFunction actually compiled (no graph break)."""
+    assert st._jit_cache, "function never compiled"
+    assert not st.graph_break_reasons, st.graph_break_reasons
+
+
+class TestTensorIf:
+    def test_if_else_assign(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        xp, xn = t([1.0, 2.0]), t([-1.0, -2.0])
+        assert np.allclose(f(xp).numpy(), [2.0, 4.0])
+        assert np.allclose(f(xn).numpy(), [1.0, 2.0])
+        _compiled_ok(f)
+
+    def test_if_no_else(self):
+        @to_static
+        def f(x):
+            y = x + 1.0
+            if x.sum() > 0:
+                y = y * 10.0
+            return y
+
+        assert np.allclose(f(t([1.0])).numpy(), [20.0])
+        assert np.allclose(f(t([-1.0])).numpy(), [0.0])
+        _compiled_ok(f)
+
+    def test_if_both_return(self):
+        @to_static
+        def f(x):
+            if x.mean() > 0:
+                return x - 1.0
+            else:
+                return x + 1.0
+
+        assert np.allclose(f(t([2.0])).numpy(), [1.0])
+        assert np.allclose(f(t([-2.0])).numpy(), [-1.0])
+        _compiled_ok(f)
+
+    def test_elif_chain(self):
+        @to_static
+        def f(x):
+            s = x.sum()
+            if s > 1.0:
+                y = x * 3.0
+            elif s > 0.0:
+                y = x * 2.0
+            else:
+                y = x
+            return y
+
+        assert np.allclose(f(t([2.0])).numpy(), [6.0])
+        assert np.allclose(f(t([0.5])).numpy(), [1.0])
+        assert np.allclose(f(t([-1.0])).numpy(), [-1.0])
+        _compiled_ok(f)
+
+    def test_python_if_untouched(self):
+        """Static predicates keep Python semantics (incl. side effects)."""
+        log = []
+
+        @to_static
+        def f(x, flag=True):
+            if flag:
+                log.append("hit")
+                y = x * 2.0
+            else:
+                y = x
+            return y
+
+        assert np.allclose(f(t([3.0])).numpy(), [6.0])
+        assert log == ["hit"]
+        _compiled_ok(f)
+
+    def test_grad_through_cond(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = (x ** 2).sum()
+            else:
+                y = (x ** 3).sum()
+            return y
+
+        x = P.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        f(x).backward()
+        assert np.allclose(x.grad.numpy(), [4.0])  # d/dx x² = 2x
+        xn = P.to_tensor(np.array([-2.0], np.float32), stop_gradient=False)
+        f(xn).backward()
+        assert np.allclose(xn.grad.numpy(), [12.0])  # d/dx x³ = 3x²
+        _compiled_ok(f)
+
+
+class TestTensorWhile:
+    def test_while_tensor_cond(self):
+        @to_static
+        def f(x):
+            while x.sum() < 100.0:
+                x = x * 2.0
+            return x
+
+        out = f(t([1.0, 2.0]))
+        # eager oracle
+        v = np.array([1.0, 2.0])
+        while v.sum() < 100.0:
+            v = v * 2.0
+        assert np.allclose(out.numpy(), v)
+        _compiled_ok(f)
+
+    def test_while_multiple_carries(self):
+        @to_static
+        def f(x):
+            i = 0
+            while x.sum() < 50.0:
+                x = x + x
+                i = i + 1
+            return x, i
+
+        out, i = f(t([1.0]))
+        assert np.allclose(out.numpy(), [64.0])
+        assert int(np.asarray(i._data if isinstance(i, P.Tensor) else i)) \
+            == 6
+        _compiled_ok(f)
+
+    def test_python_while_unrolled(self):
+        @to_static
+        def f(x):
+            n = 3
+            while n > 0:
+                x = x + 1.0
+                n -= 1
+            return x
+
+        assert np.allclose(f(t([0.0])).numpy(), [3.0])
+        _compiled_ok(f)
+
+
+class TestTensorForRange:
+    def test_for_tensor_bound(self):
+        @to_static
+        def f(x, n):
+            for _ in range(n):
+                x = x * 2.0
+            return x
+
+        n = P.to_tensor(np.asarray(3, np.int32))
+        assert np.allclose(f(t([1.0]), n).numpy(), [8.0])
+        _compiled_ok(f)
+
+    def test_for_static_bound_unrolled(self):
+        @to_static
+        def f(x):
+            for i in range(4):
+                x = x + float(i)
+            return x
+
+        assert np.allclose(f(t([0.0])).numpy(), [6.0])
+        _compiled_ok(f)
+
+    def test_nested_if_in_while(self):
+        @to_static
+        def f(x):
+            while x.sum() < 10.0:
+                if x.sum() < 5.0:
+                    x = x * 3.0
+                else:
+                    x = x + 1.0
+            return x
+
+        v = np.array([1.0])
+        while v.sum() < 10.0:
+            v = v * 3.0 if v.sum() < 5.0 else v + 1.0
+        assert np.allclose(f(t([1.0])).numpy(), v)
+        _compiled_ok(f)
+
+
+class TestGraphBreakFallback:
+    def test_fallback_records_reason(self):
+        @to_static
+        def f(x):
+            n = int(np.asarray(x.sum().numpy()))  # forces concretization
+            return x * float(n)
+
+        out = f(t([2.0, 1.0]))
+        assert np.allclose(out.numpy(), [6.0, 3.0])  # eager fallback ran
+        assert f.graph_break_reasons, "fallback reason not recorded"
+
+    def test_break_keeps_python_semantics_eagerly(self):
+        """break in a tensor-cond loop → untransformed → fallback."""
+        @to_static
+        def f(x):
+            while x.sum() < 100.0:
+                x = x * 2.0
+                if x.sum() > 20.0:
+                    break
+            return x
+
+        out = f(t([1.0]))
+        assert np.allclose(out.numpy(), [32.0])
+        assert f.graph_break_reasons
+
+
+class TestReviewedEdgeCases:
+    def test_attr_store_in_branch_falls_back(self):
+        """Object mutation in a tensor-pred branch must NOT lower (both
+        lax.cond branches trace → the mutation would misfire)."""
+        class Counter:
+            hits = 0
+
+        c = Counter()
+
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                c.hits = c.hits + 1
+                y = x
+            else:
+                y = -x
+            return y
+
+        assert np.allclose(f(t([1.0])).numpy(), [1.0])
+        assert c.hits == 1
+        assert np.allclose(f(t([-1.0])).numpy(), [1.0])
+        assert c.hits == 1  # false branch must not bump it
+        assert f.graph_break_reasons  # fell back, reason recorded
+
+    def test_empty_static_range_keeps_prior_binding(self):
+        @to_static
+        def f(x):
+            i = 3
+            for i in range(0):
+                x = x + 1.0
+            return x * float(i)
+
+        assert np.allclose(f(t([2.0])).numpy(), [6.0])
+
+    def test_empty_traced_range_keeps_prior_binding(self):
+        @to_static
+        def f(x, n):
+            i = 3
+            for i in range(n):
+                x = x + 1.0
+            return x * i
+
+        n0 = P.to_tensor(np.asarray(0, np.int32))
+        assert np.allclose(f(t([2.0]), n0).numpy(), [6.0])
+        n2 = P.to_tensor(np.asarray(2, np.int32))
+        assert np.allclose(f(t([2.0]), n2).numpy(), [4.0])
+        _compiled_ok(f)
+
+    def test_walrus_in_while_test_falls_back(self):
+        @to_static
+        def f(x):
+            n = 3
+            while (n := n - 1) >= 0:
+                x = x + 1.0
+            return x
+
+        assert np.allclose(f(t([0.0])).numpy(), [3.0])
+
+    def test_live_globals_seen_after_transform(self):
+        g = globals()
+        g["_live_threshold"] = 100.0
+
+        @to_static
+        def f(x):
+            while x.sum() < _live_threshold:
+                x = x * 2.0
+            return x
+
+        assert np.allclose(f(t([1.0])).numpy(), [128.0])
+        # rebinding the global is seen by the NEXT trace (a compiled
+        # program keeps its trace-time constants — jit semantics); a new
+        # input signature forces the retrace
+        g["_live_threshold"] = 5.0
+        assert np.allclose(f(t([1.0, 1.0])).numpy(), [4.0, 4.0])
+
+    def test_live_closure_cells(self):
+        box = {"mult": 2.0}
+
+        def outer():
+            thresh = 10.0
+
+            @to_static
+            def f(x):
+                while x.sum() < thresh:
+                    x = x * box["mult"]
+                return x
+            return f
+
+        f = outer()
+        assert np.allclose(f(t([1.0])).numpy(), [16.0])
+
+    def test_bound_method_cache(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            @to_static
+            def step(self, x):
+                if x.sum() > 0:
+                    return self.fc(x)
+                else:
+                    return -self.fc(x)
+
+        m = M()
+        s1, s2 = m.step, m.step
+        assert s1 is s2  # bound StaticFunction cached per instance
+        x = t(np.ones((2, 4)))
+        out1 = m.step(x)
+        assert m.step._jit_cache  # compiled, cache retained across access
+        assert np.allclose(out1.numpy(), m.step(x).numpy())
