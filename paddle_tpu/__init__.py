@@ -49,6 +49,7 @@ from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import vision  # noqa: F401
 
+from .distributed.parallel import DataParallel  # noqa: E402
 from .framework.io_save import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .nn.clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: E402

@@ -68,7 +68,10 @@ _PLATFORM_ALIASES = {
 def _jax_device_for(kind: str, index: int):
     for platform in _PLATFORM_ALIASES.get(kind, (kind,)):
         try:
-            devs = jax.devices(platform)
+            # LOCAL devices only: in the multi-controller regime the
+            # global list leads with process 0's devices, which other
+            # processes cannot address — eager data must live locally
+            devs = jax.local_devices(backend=platform)
         except RuntimeError:
             continue
         if devs:

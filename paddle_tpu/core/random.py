@@ -19,17 +19,28 @@ import jax.random as jrandom
 
 
 class Generator:
-    """A named RNG stream: (seed, offset) pair."""
+    """A named RNG stream: (seed, offset) pair.
+
+    The device key is created LAZILY: materializing a PRNGKey initializes
+    the jax backend, and that must not happen at import time — the launch
+    CLI runs where no accelerator exists, and a multi-controller worker
+    must call jax.distributed.initialize() before any backend touch."""
 
     def __init__(self, seed: int = 0):
         self._seed = seed
         self._offset = 0
-        self._key = jrandom.PRNGKey(seed)
+        self._key_cache = None
+
+    @property
+    def _key(self):
+        if self._key_cache is None:
+            self._key_cache = jrandom.PRNGKey(self._seed)
+        return self._key_cache
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
         self._offset = 0
-        self._key = jrandom.PRNGKey(self._seed)
+        self._key_cache = None
         return self
 
     def next_key(self):
@@ -43,7 +54,7 @@ class Generator:
     def set_state(self, state):
         self._seed = int(state["seed"])
         self._offset = int(state["offset"])
-        self._key = jrandom.PRNGKey(self._seed)
+        self._key_cache = None
 
     @property
     def initial_seed(self):

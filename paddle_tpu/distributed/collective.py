@@ -137,6 +137,77 @@ def _traced_axis(group: ProcessGroup):
 
 
 # ---------------------------------------------------------------------------
+# multi-controller eager regime (reference: the true multi-process world
+# of ProcessGroupNCCL). When `jax.process_count() > 1`, each controller
+# holds only its local value, so eager collectives must move real data:
+# the group becomes a one-device-per-process mesh and the op runs as a
+# tiny compiled shard_map program over the Gloo (CPU) / ICI-DCN (TPU)
+# transport that jax.distributed.initialize established.
+
+_xp_meshes: dict = {}
+_xp_jits: dict = {}
+
+
+def _multiproc(g: ProcessGroup) -> bool:
+    try:
+        return jax.process_count() > 1 and g.nranks > 1
+    except Exception:
+        return False
+
+
+def _xp_mesh(g: ProcessGroup):
+    from jax.sharding import Mesh
+    key = tuple(g.ranks)
+    m = _xp_meshes.get(key)
+    if m is None:
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[r] for r in g.ranks]
+        m = Mesh(np.array(devs), ("world",))
+        _xp_meshes[key] = m
+    return m
+
+
+def _xp_global(g: ProcessGroup, arr):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(_xp_mesh(g), P("world"))
+    return jax.make_array_from_process_local_data(
+        sh, np.asarray(arr)[None])
+
+
+def _xp_reduce(g: ProcessGroup, arr, op):
+    from jax.sharding import PartitionSpec as P
+    if op == ReduceOp.PROD:  # no pprod primitive — gather & fold locally
+        return np.prod(_xp_gather(g, arr), axis=0)
+    key = (tuple(g.ranks), "red", op)
+    f = _xp_jits.get(key)
+    if f is None:
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
+        f = jax.jit(jax.shard_map(
+            lambda a: red(a, "world"), mesh=_xp_mesh(g),
+            in_specs=P("world"), out_specs=P("world")))
+        _xp_jits[key] = f
+    out = f(_xp_global(g, arr))
+    return np.asarray(out.addressable_shards[0].data)[0]
+
+
+def _xp_gather(g: ProcessGroup, arr):
+    """Returns the [nranks, ...] stack of every process's value (local)."""
+    from jax.sharding import PartitionSpec as P
+    key = (tuple(g.ranks), "gather")
+    f = _xp_jits.get(key)
+    if f is None:
+        f = jax.jit(jax.shard_map(
+            lambda a: jax.lax.all_gather(a[0], "world")[None],
+            mesh=_xp_mesh(g), in_specs=P("world"), out_specs=P("world")))
+        _xp_jits[key] = f
+    out = f(_xp_global(g, arr))
+    return np.asarray(out.addressable_shards[0].data)[0]
+
+
+# ---------------------------------------------------------------------------
 # collectives
 
 
@@ -148,6 +219,10 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                ReduceOp.MIN: jax.lax.pmin,
                ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a)}[op]
         tensor._inplace_update(red(tensor._data, axis))
+        return Task(tensor)
+    if _multiproc(g):
+        tensor._inplace_update(jnp.asarray(
+            _xp_reduce(g, tensor._data, op)))
         return Task(tensor)
     # eager SPMD: single controller holds the global value → reduction over
     # a replicated value is identity (sum semantics follow reference's
@@ -163,6 +238,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         if isinstance(tensor_list, list):
             for i in range(g.nranks):
                 tensor_list.append(Tensor(gathered[i]))
+        return Task(tensor)
+    if _multiproc(g):
+        rows = _xp_gather(g, tensor._data)
+        if isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(Tensor(jnp.asarray(rows[i])))
         return Task(tensor)
     if isinstance(tensor_list, list):
         for _ in range(g.nranks):
@@ -202,6 +283,14 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         gathered = jax.lax.all_gather(tensor._data, ax)
         tensor._inplace_update(gathered[src_local])
         return Task(tensor)
+    if _multiproc(g):
+        me = g.get_group_rank(jax.process_index())
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        contrib = tensor._data if me == src_local \
+            else jnp.zeros_like(tensor._data)
+        tensor._inplace_update(jnp.asarray(
+            _xp_reduce(g, contrib, ReduceOp.SUM)))
+        return Task(tensor)
     return Task(tensor)  # eager: single controller — already everywhere
 
 
@@ -236,6 +325,13 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
                                  tiled=False)
         for i in range(g.nranks):
             out_tensor_list.append(Tensor(out[i]))
+        return Task()
+    if _multiproc(g) and in_tensor_list:
+        me = g.get_group_rank(jax.process_index())
+        stacked = jnp.stack([t._data for t in in_tensor_list])
+        rows = _xp_gather(g, stacked)  # [nranks, nranks, ...]
+        for r in range(g.nranks):
+            out_tensor_list.append(Tensor(jnp.asarray(rows[r][me])))
         return Task()
     out_tensor_list.extend(in_tensor_list)
     return Task()
@@ -280,6 +376,11 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    g = _group(group)
+    if _multiproc(g):
+        # a real cross-process rendezvous: every rank must enter
+        _xp_reduce(g, np.zeros((), np.float32), ReduceOp.SUM)
+        return
     # drain outstanding work — XLA program order gives the sync semantics
     jnp.zeros(()).block_until_ready()
 

@@ -28,14 +28,36 @@ def init_parallel_env():
     endpoints = dist_env.get_endpoints()
     world = dist_env.get_world_size()
     rank = dist_env.get_rank()
-    if world > 1 and endpoints and jax.process_count() == 1:
-        master = endpoints[0]
-        try:
-            jax.distributed.initialize(
-                coordinator_address=master, num_processes=world,
-                process_id=rank)
-        except Exception:
-            pass  # single-host simulation: env set but no real peers
+    # NOTE: must not call jax.process_count()/devices() before
+    # jax.distributed.initialize — any backend query would initialize XLA
+    # and make multi-controller registration impossible. Probe the
+    # coordination client state instead.
+    from jax._src import distributed as _jdist
+    already = getattr(_jdist.global_state, "client", None) is not None
+    if world > 1 and not already:
+        # PADDLE_MASTER (launcher --master) is the coordination-service
+        # address; the rank-0 trainer endpoint is the fallback
+        master = os.environ.get("PADDLE_MASTER") or \
+            (endpoints[0] if endpoints else None)
+        if master:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=master, num_processes=world,
+                    process_id=rank)
+            except Exception as e:
+                # single-host simulation: env set but no live peers —
+                # keep going single-process, but say so
+                import sys
+                sys.stderr.write(
+                    f"paddle_tpu: jax.distributed.initialize failed "
+                    f"({e!r}); continuing single-process\n")
+            else:
+                # multi-controller: jax.devices()[0] is process 0's device
+                # — NON-addressable elsewhere; eager arrays must land on a
+                # local device or every np.asarray/compute on other ranks
+                # dies on a cross-process fetch
+                jax.config.update("jax_default_device",
+                                  jax.local_devices()[0])
     g = new_group(list(range(max(world, 1))))
     set_default_group(g)
     return g
@@ -63,13 +85,55 @@ class DataParallel(Layer):
         self._layers = layers
         self.group = group
         self.find_unused_parameters = find_unused_parameters
+        self._sync_enabled = True
+        # multi-controller (true multi-process) regime: grad sync cannot
+        # be structural — hook every param so backward() all-reduces its
+        # gradient across processes (the EagerReducer role)
+        from .collective import ReduceOp, _ensure_default_group, \
+            _multiproc, all_reduce
+        g = group if group is not None else _ensure_default_group()
+        if _multiproc(g):
+            from ..core.tensor import Tensor as _T
+            dirty: set = set()  # params with unsynced no_sync() grads
+
+            def make_sync(p):
+                def sync(grad):
+                    if not self._sync_enabled:
+                        dirty.add(id(p))
+                        return grad
+                    if id(p) in dirty and p.grad is not None:
+                        # DDP contract: the first synced backward reduces
+                        # the WHOLE accumulated gradient, not just this
+                        # contribution. deposit() will do
+                        # p.grad += returned, so return
+                        # avg(prev + g) - prev.
+                        total = _T(p.grad._data + grad._data)
+                        all_reduce(total, op=ReduceOp.AVG, group=g)
+                        dirty.discard(id(p))
+                        return _T(total._data - p.grad._data)
+                    dirty.discard(id(p))
+                    all_reduce(grad, op=ReduceOp.AVG, group=g)
+                    return grad
+                return sync
+            for p in layers.parameters():
+                if not p.stop_gradient:
+                    p.register_hook(make_sync(p))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     def no_sync(self):
         import contextlib
-        return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._sync_enabled
+            self._sync_enabled = False
+            try:
+                yield
+            finally:
+                self._sync_enabled = prev
+        return ctx()
 
     def state_dict(self, *a, **k):
         return self._layers.state_dict(*a, **k)

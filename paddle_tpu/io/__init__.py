@@ -270,7 +270,7 @@ def default_collate_fn(batch):
         return P.stack(batch, axis=0)
     if isinstance(sample, np.ndarray):
         return to_tensor(np.stack(batch, axis=0))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, (int, float, np.integer, np.floating)):
         return to_tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
         return tuple(default_collate_fn([b[i] for b in batch])
@@ -278,6 +278,115 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     return batch
+
+
+# ---------------------------------------------------------------------------
+# process workers (reference: DataLoader num_workers subprocesses +
+# use_shared_memory — upstream python/paddle/io/dataloader/worker.py,
+# unverified; see SURVEY.md §2.2 Data). Workers parallelize the
+# Python-heavy dataset[i] transforms across real processes (no GIL);
+# numpy payloads ride a shared-memory segment per batch, pickles only
+# carry descriptors. Collation and the jax device put stay in the parent
+# — forked children never touch the accelerator runtime.
+
+def _shm_pack(samples):
+    """Replace ndarray leaves with shm descriptors; returns (spec, shm_name)
+    or (samples, None) when nothing is packable."""
+    from multiprocessing import shared_memory
+
+    arrays = []
+
+    def scan(o):
+        if isinstance(o, Tensor):
+            o = np.asarray(o._data)
+        if isinstance(o, np.ndarray) and o.nbytes > 0:
+            arrays.append(np.ascontiguousarray(o))
+            return ("A", len(arrays) - 1, o.shape, str(o.dtype))
+        if isinstance(o, (list, tuple)):
+            return (type(o).__name__, [scan(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", [(k, scan(v)) for k, v in o.items()])
+        return ("S", o)
+
+    spec = [scan(s) for s in samples]
+    if not arrays:
+        return samples, None, None
+    offsets = []
+    total = 0
+    for a in arrays:
+        offsets.append(total)
+        total += a.nbytes
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
+    for a, off in zip(arrays, offsets):
+        # write straight into the segment — tobytes() would materialize a
+        # second full copy of every batch in the worker's hot path
+        view = np.frombuffer(shm.buf, dtype=a.dtype, count=a.size,
+                             offset=off).reshape(a.shape)
+        np.copyto(view, a)
+        del view
+    name = shm.name
+    # the PARENT owns the segment's lifetime (it unlinks after reading);
+    # unregister from this process's resource_tracker so worker exit
+    # doesn't whine about (or destroy) a segment it no longer owns
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return spec, name, offsets
+
+
+def _shm_unpack(spec, shm_name, offsets):
+    from multiprocessing import shared_memory
+    if shm_name is None:
+        return spec
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        def un(s):
+            tag = s[0]
+            if tag == "A":
+                _, idx, shape, dtype = s
+                n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+                off = offsets[idx]
+                return np.frombuffer(
+                    bytes(shm.buf[off:off + n]), dtype=dtype).reshape(shape)
+            if tag == "S":
+                return s[1]
+            if tag == "dict":
+                return {k: un(v) for k, v in s[1]}
+            seq = [un(x) for x in s[1]]
+            return tuple(seq) if tag == "tuple" else seq
+
+        return [un(s) for s in spec]
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except Exception:
+            pass
+
+
+def _process_worker(wid, num_workers, dataset, index_q, result_q,
+                    worker_init_fn, use_shm):
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn:
+        worker_init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        i, indices = item
+        try:
+            samples = [dataset[j] for j in indices]
+            if use_shm:
+                spec, name, offsets = _shm_pack(samples)
+                result_q.put((i, "shm" if name else "raw",
+                              (spec, name, offsets) if name else samples))
+            else:
+                result_q.put((i, "raw", samples))
+        except Exception as e:  # surface dataset errors to the parent
+            result_q.put((i, "err", f"{type(e).__name__}: {e}"))
 
 
 class DataLoader:
@@ -292,6 +401,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -395,7 +505,82 @@ class DataLoader:
                     cond.wait(timeout=60.0)
             yield results.pop(i)
 
+    def _iter_procs(self):
+        """Real subprocess workers (fork): dataset[i] runs GIL-free in
+        parallel; batches return via shared memory; parent collates."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        index_q = ctx.Queue()
+        result_q = ctx.Queue(
+            maxsize=max(self.num_workers * self.prefetch_factor, 2))
+        for item in enumerate(batches):
+            index_q.put(item)
+        for _ in range(self.num_workers):
+            index_q.put(None)
+        procs = [ctx.Process(
+            target=_process_worker,
+            args=(w, self.num_workers, self.dataset, index_q, result_q,
+                  self.worker_init_fn, self.use_shared_memory),
+            daemon=True) for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        results: dict[int, object] = {}
+        try:
+            for want in range(len(batches)):
+                while want not in results:
+                    try:
+                        i, kind, payload = result_q.get(timeout=120.0)
+                    except _queue.Empty:
+                        dead = [p.exitcode for p in procs
+                                if p.exitcode not in (None, 0)]
+                        raise RuntimeError(
+                            f"DataLoader worker(s) died (exitcodes "
+                            f"{dead}) or stalled >120s") from None
+                    if kind == "err":
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {i}: "
+                            f"{payload}")
+                    if kind == "shm":
+                        spec, name, offsets = payload
+                        results[i] = _shm_unpack(spec, name, offsets)
+                    else:
+                        results[i] = payload
+                yield self.collate_fn(results.pop(want))
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            # drain queued payloads and release their shm segments — the
+            # workers unregistered them from their resource_tracker, so
+            # nothing else will ever unlink a leaked one (early break /
+            # error would otherwise fill /dev/shm across epochs)
+            from multiprocessing import shared_memory
+            while True:
+                try:
+                    _, kind, payload = result_q.get_nowait()
+                except (_queue.Empty, OSError, ValueError):
+                    break
+                if kind == "shm":
+                    try:
+                        seg = shared_memory.SharedMemory(name=payload[1])
+                        seg.close()
+                        seg.unlink()
+                    except Exception:
+                        pass
+            index_q.close()
+            result_q.close()
+
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            import multiprocessing as mp
+            if not self._iterable and self.batch_sampler is not None \
+                    and "fork" in mp.get_all_start_methods():
+                return self._iter_procs()
+            # IterableDataset (single stream) or no fork (non-Linux):
+            # threaded prefetch fallback
             return self._iter_prefetch()
         return self._iter_sync()

@@ -381,6 +381,12 @@ def _has_object_store(nodes):
                 if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
                         isinstance(sub.ctx, (ast.Store,)):
                     found.append(sub)
+        # a bare-call statement (self.log.append(x), print(...)) is the
+        # mutating/IO idiom — it would fire at trace time in BOTH cond
+        # branches (or once per compile in a loop body), so it blocks too
+        if isinstance(n, ast.Expr) and isinstance(n.value, ast.Call):
+            found.append(n)
+            return
         for c in ast.iter_child_nodes(n):
             walk(c)
 

@@ -158,7 +158,10 @@ class StaticFunction:
     def __get__(self, obj, objtype=None):
         if obj is None:
             return self
-        key = "_jst_bound_" + self._fn.__name__
+        # key includes THIS descriptor's identity: base and subclass may
+        # both decorate the same method name, and super().forward() must
+        # not resolve to the subclass's cached bound wrapper
+        key = f"_jst_bound_{self._fn.__name__}_{id(self):x}"
         try:
             d = obj.__dict__
         except AttributeError:  # __slots__ instance — uncached

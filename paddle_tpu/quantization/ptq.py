@@ -36,9 +36,13 @@ class _ObservedLinear(Layer):
 
 
 class QuantizedInferenceLinear(Layer):
-    """Deployment linear: int8 weight + f32 per-channel scale. When an
-    activation scale was calibrated, inputs are snapped to the int8 grid
-    (quantize-dequantize) so the output matches true int8×int8 execution."""
+    """Deployment linear: int8 weight + f32 per-channel scale.
+
+    With a calibrated activation scale the layer executes a TRUE
+    int8×int8→int32 matmul (`lax.dot_general` with
+    preferred_element_type=int32 — the MXU's int8 mode on TPU, 2× the
+    bf16 throughput) and rescales the int32 accumulator; weight-only
+    quantization dequantizes the weight into the activation dtype."""
 
     def __init__(self, weight_i8, w_scale, bias, act_scale=None):
         super().__init__()
@@ -48,12 +52,28 @@ class QuantizedInferenceLinear(Layer):
         self._act_scale = act_scale
 
     def forward(self, x):
+        import jax
+
         if self._act_scale is not None:
-            x = fake_quant(x, Tensor(jnp.asarray(self._act_scale,
-                                                 jnp.float32)))
-        w = (self.weight_quant._data.astype(x._data.dtype) *
-             (self.weight_scale._data / 127.0).astype(x._data.dtype))
-        y = x @ Tensor(w)
+            s_x = jnp.float32(self._act_scale) / 127.0
+
+            def int8_matmul(xa, w_i8, w_scale):
+                x_i8 = jnp.clip(jnp.round(xa / s_x), -127, 127) \
+                    .astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    x_i8, w_i8,
+                    dimension_numbers=(((xa.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                scale = s_x * (w_scale / 127.0)
+                return acc.astype(jnp.float32) * scale
+
+            from ..core.autograd import apply
+            y = apply(int8_matmul, x, self.weight_quant,
+                      self.weight_scale, name="int8_linear")
+        else:
+            w = (self.weight_quant._data.astype(x._data.dtype) *
+                 (self.weight_scale._data / 127.0).astype(x._data.dtype))
+            y = x @ Tensor(w)
         if self.bias is not None:
             y = y + self.bias
         return y

@@ -1,0 +1,110 @@
+"""Process-based DataLoader workers (VERDICT r1 weak-7 / item 10):
+dataset transforms run in real subprocesses (GIL-free), batches return
+via shared memory, order/content match the sync loader, worker errors
+propagate."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+
+class TransformDS(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        x = np.random.default_rng(i).standard_normal((16, 16))
+        for _ in range(5):
+            x = x @ np.eye(16) + i * 0.001
+        return x.astype(np.float32), i
+
+
+class PidDS(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        wi = get_worker_info()
+        return np.asarray([os.getpid(), wi.id if wi else -1], np.int64)
+
+
+class BadDS(Dataset):
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        if i == 2:
+            raise ValueError("boom")
+        return np.zeros(2, np.float32)
+
+
+class TestProcessWorkers:
+    @pytest.mark.parametrize("shm", [True, False])
+    def test_content_and_order_match_sync(self, shm):
+        ds = TransformDS()
+        sync = list(DataLoader(ds, batch_size=4, num_workers=0))
+        par = list(DataLoader(ds, batch_size=4, num_workers=3,
+                              use_shared_memory=shm))
+        assert len(sync) == len(par) == 4
+        for (sa, sb), (pa, pb) in zip(sync, par):
+            assert np.allclose(sa.numpy(), pa.numpy())
+            assert np.array_equal(sb.numpy(), pb.numpy())
+
+    def test_workers_are_processes_with_worker_info(self):
+        out = list(DataLoader(PidDS(), batch_size=1, num_workers=2))
+        pids = {int(b.numpy()[0, 0]) for b in out}
+        wids = {int(b.numpy()[0, 1]) for b in out}
+        assert os.getpid() not in pids, "transforms ran in the parent"
+        assert wids <= {0, 1} and -1 not in wids
+
+    def test_worker_init_fn_runs_in_child(self, tmp_path):
+        stamp = str(tmp_path / "w")
+
+        def init_fn(wid):
+            open(f"{stamp}{wid}.{os.getpid()}", "w").write("x")
+
+        list(DataLoader(TransformDS(), batch_size=4, num_workers=2,
+                        worker_init_fn=init_fn))
+        marks = [f for f in os.listdir(tmp_path) if f.startswith("w")]
+        assert len(marks) == 2
+        assert all(int(m.split(".")[1]) != os.getpid() for m in marks)
+
+    def test_worker_error_propagates(self):
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(BadDS(), batch_size=1, num_workers=2))
+
+    def test_dict_samples_via_shm(self):
+        class DictDS(Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return {"x": np.full((3,), float(i), np.float32),
+                        "meta": i}
+
+        out = list(DataLoader(DictDS(), batch_size=2, num_workers=2))
+        assert len(out) == 3
+        assert np.allclose(out[1]["x"].numpy(),
+                           [[2.0] * 3, [3.0] * 3])
+        assert np.array_equal(out[1]["meta"].numpy(), [2, 3])
+
+
+class TestShmHygiene:
+    def test_early_break_leaks_no_shm(self):
+        import gc
+        import glob
+        import time
+
+        before = set(glob.glob("/dev/shm/psm_*"))
+        dl = DataLoader(TransformDS(), batch_size=2, num_workers=2,
+                        use_shared_memory=True)
+        it = iter(dl)
+        next(it)
+        it.close()  # early termination — finally must drain & unlink
+        gc.collect()
+        time.sleep(0.3)
+        after = set(glob.glob("/dev/shm/psm_*"))
+        assert after <= before, f"leaked shm segments: {after - before}"

@@ -332,3 +332,39 @@ class TestReviewedEdgeCases:
         out1 = m.step(x)
         assert m.step._jit_cache  # compiled, cache retained across access
         assert np.allclose(out1.numpy(), m.step(x).numpy())
+
+    def test_subclass_override_and_super_call(self):
+        import paddle_tpu.nn as nn
+
+        class A(nn.Layer):
+            @to_static
+            def forward(self, x):
+                return x + 1.0
+
+        class B(A):
+            @to_static
+            def forward(self, x):
+                return super().forward(x) * 2.0
+
+        b = B()
+        out = b.forward(t([1.0]))
+        assert np.allclose(out.numpy(), [4.0])  # (1+1)*2, no recursion
+        a = A()
+        assert np.allclose(a.forward(t([1.0])).numpy(), [2.0])
+
+    def test_mutating_call_in_branch_falls_back(self):
+        log = []
+
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                log.append(1)
+                y = x
+            else:
+                y = -x
+            return y
+
+        assert np.allclose(f(t([1.0])).numpy(), [1.0])
+        assert np.allclose(f(t([-1.0])).numpy(), [1.0])
+        assert log == [1]  # appended exactly once, by the taken branch
+        assert f.graph_break_reasons

@@ -1,0 +1,118 @@
+"""True multi-process execution proof (VERDICT r1 item 6): the launch CLI
+spawns 2 OS processes, jax.distributed connects them (Gloo over CPU), the
+eager collectives move real data between controllers, DataParallel grad
+sync gives loss parity with the single-process oracle, and the elastic
+path survives a worker crash + restart (SURVEY.md §4 trick 1, §3.5)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = os.path.join(REPO, "tests", "workers")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _clean_env():
+    env = os.environ.copy()
+    # the workers must see a plain single-device CPU world of their own
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            env.pop(k)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+class TestLaunchMultiProcess:
+    def test_two_process_collectives_and_dp_parity(self, tmp_path):
+        port = _free_port()
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+               "--log_dir", str(tmp_path / "logs"),
+               os.path.join(WORKERS, "mp_worker.py"), str(tmp_path)]
+        r = subprocess.run(cmd, env=_clean_env(), cwd=REPO, timeout=300,
+                           capture_output=True, text=True)
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
+        assert r.returncode == 0, (r.stdout, r.stderr, logs)
+
+        res = [json.load(open(tmp_path / f"result.{rk}.json"))
+               for rk in range(2)]
+        # both ranks agree on the (global) loss sequence
+        assert np.allclose(res[0]["losses"], res[1]["losses"]), res
+
+        # single-process oracle: full batch, same init
+        import paddle_tpu as P
+        import paddle_tpu.nn as nn
+        P.seed(0)
+        net = nn.Linear(4, 2)
+        opt = P.optimizer.SGD(0.1, parameters=net.parameters())
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((8, 4)).astype(np.float32)
+        Y = rng.standard_normal((8, 2)).astype(np.float32)
+        oracle = []
+        for _ in range(2):
+            loss = ((net(P.to_tensor(X)) - P.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            oracle.append(float(loss.numpy()))
+        assert np.allclose(res[0]["losses"], oracle, rtol=2e-3,
+                           atol=2e-4), (res[0]["losses"], oracle)
+
+        # no_sync accumulation phase: first synced backward must reduce
+        # the whole accumulated grad (DDP contract)
+        assert np.isclose(res[0]["probe"], res[1]["probe"]), res
+        P.seed(1)
+        net2 = nn.Linear(4, 2)
+        opt2 = P.optimizer.SGD(0.1, parameters=net2.parameters())
+        per = 4
+        for m in [slice(0, 2), slice(2, 3), slice(3, 4)]:
+            rows = np.r_[np.arange(m.start, m.stop),
+                         per + np.arange(m.start, m.stop)]
+            loss = ((net2(P.to_tensor(X[rows])) -
+                     P.to_tensor(Y[rows])) ** 2).mean()
+            loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        probe_oracle = float(((net2(P.to_tensor(X)) -
+                               P.to_tensor(Y)) ** 2).mean().numpy())
+        assert np.isclose(res[0]["probe"], probe_oracle, rtol=2e-3), \
+            (res[0]["probe"], probe_oracle)
+
+    def test_elastic_crash_restart_reregister(self, tmp_path):
+        from paddle_tpu.native import TCPStore
+        store_port = _free_port()
+        master = TCPStore("127.0.0.1", store_port, is_master=True)
+        try:
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--nnodes", "2", "--max_restarts", "2",
+                   "--elastic_level", "1",
+                   "--log_dir", str(tmp_path / "logs"),
+                   os.path.join(WORKERS, "elastic_worker.py"),
+                   str(store_port), str(tmp_path)]
+            r = subprocess.run(cmd, env=_clean_env(), cwd=REPO,
+                               timeout=300, capture_output=True, text=True)
+            assert r.returncode == 0, (r.stdout, r.stderr)
+            # the launcher really did restart rank 1
+            assert "restart" in r.stdout, r.stdout
+            # rank 1 crashed exactly once (marker) and then re-registered
+            # (generation counter observed by rank 0 → job completed)
+            assert (tmp_path / "crashed.1").exists()
+        finally:
+            master.close()

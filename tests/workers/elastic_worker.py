@@ -1,0 +1,55 @@
+"""Elastic worker (launched by tests/test_multiprocess.py): registers a
+heartbeat with the shared TCPStore; rank 1 crashes once, is restarted by
+the launcher (elastic_level>=1), re-registers, and bumps a generation
+counter; rank 0 waits to OBSERVE the re-registration, then releases
+everyone."""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed.elastic import ElasticManager  # noqa: E402
+from paddle_tpu.native import TCPStore  # noqa: E402
+
+
+def main():
+    store_port = int(sys.argv[1])
+    marker_dir = sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    store = TCPStore("127.0.0.1", store_port, is_master=False)
+    mgr = ElasticManager(store, node_id=f"rank{rank}", np_range=(2, 2),
+                         heartbeat_interval=0.3, ttl=1.5)
+    mgr.register()
+
+    marker = os.path.join(marker_dir, f"crashed.{rank}")
+    if rank == 1:
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            time.sleep(0.8)  # heartbeat a little, then die
+            os._exit(1)      # simulated crash: no heartbeat cleanup
+        store.add("rank1_generation", 1)  # restarted: announce rebirth
+
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if rank == 0:
+            if store.add("rank1_generation", 0) >= 1 and \
+                    "rank1" in mgr.members():
+                store.set("done", b"1")
+                break
+        else:
+            try:
+                store.get("done")
+                break
+            except KeyError:
+                pass
+        time.sleep(0.2)
+    else:
+        sys.exit(2)
+    mgr.exit()
+
+
+if __name__ == "__main__":
+    main()

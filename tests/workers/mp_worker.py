@@ -1,0 +1,100 @@
+"""Multi-process worker (launched by the launch CLI in
+tests/test_multiprocess.py): true multi-controller collectives + a
+2-step DataParallel run. Writes per-rank results for the test to check."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as P  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert jax.process_count() == world, \
+        f"jax.distributed not initialized: {jax.process_count()} != {world}"
+
+    # -- collective semantics across real processes -------------------------
+    t = P.to_tensor(np.array([float(rank + 1)], np.float32))
+    dist.all_reduce(t)
+    assert np.allclose(t.numpy(), [sum(r + 1 for r in range(world))]), \
+        ("all_reduce", t.numpy())
+
+    b = P.to_tensor(np.array([float(rank)], np.float32))
+    dist.broadcast(b, src=1)
+    assert np.allclose(b.numpy(), [1.0]), ("broadcast", b.numpy())
+
+    gl = []
+    dist.all_gather(gl, P.to_tensor(np.array([float(rank)], np.float32)))
+    got = np.stack([x.numpy() for x in gl]).ravel()
+    assert np.allclose(got, np.arange(world)), ("all_gather", got)
+
+    mx = P.to_tensor(np.array([float(rank)], np.float32))
+    dist.all_reduce(mx, op=dist.ReduceOp.MAX)
+    assert np.allclose(mx.numpy(), [world - 1.0]), ("max", mx.numpy())
+
+    dist.barrier()
+
+    # -- 2-step DataParallel loss parity ------------------------------------
+    P.seed(0)  # identical init on every rank
+    net = nn.Linear(4, 2)
+    model = P.DataParallel(net) if hasattr(P, "DataParallel") \
+        else dist.parallel.DataParallel(net)
+    opt = P.optimizer.SGD(0.1, parameters=net.parameters())
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    Y = rng.standard_normal((8, 2)).astype(np.float32)
+    per = X.shape[0] // world
+    sl = slice(rank * per, (rank + 1) * per)
+    losses = []
+    for _ in range(2):
+        pred = model(P.to_tensor(X[sl]))
+        loss = ((pred - P.to_tensor(Y[sl])) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # report the GLOBAL loss (mean over ranks) for the parity check
+        lg = P.to_tensor(loss.numpy())
+        dist.all_reduce(lg, op=dist.ReduceOp.AVG)
+        losses.append(float(lg.numpy()))
+
+    # -- no_sync gradient accumulation (DDP contract) -----------------------
+    # 2 microbatches under no_sync + 1 synced: the first synced backward
+    # must reduce the WHOLE accumulated gradient
+    P.seed(1)
+    net2 = nn.Linear(4, 2)
+    model2 = P.DataParallel(net2)
+    opt2 = P.optimizer.SGD(0.1, parameters=net2.parameters())
+    micros = [slice(0, 2), slice(2, 3), slice(3, 4)]  # within local shard
+
+    def local_rows(m):
+        base = rank * per
+        return slice(base + m.start, base + m.stop)
+
+    with model2.no_sync():
+        for m in micros[:2]:
+            pred = model2(P.to_tensor(X[local_rows(m)]))
+            ((pred - P.to_tensor(Y[local_rows(m)])) ** 2).mean().backward()
+    pred = model2(P.to_tensor(X[local_rows(micros[2])]))
+    ((pred - P.to_tensor(Y[local_rows(micros[2])])) ** 2).mean().backward()
+    opt2.step()
+    opt2.clear_grad()
+    probe = float(((net2(P.to_tensor(X)) - P.to_tensor(Y)) ** 2)
+                  .mean().numpy())
+
+    with open(os.path.join(out_dir, f"result.{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "losses": losses, "probe": probe}, f)
+
+
+if __name__ == "__main__":
+    main()
