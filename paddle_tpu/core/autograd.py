@@ -15,6 +15,8 @@ a thin wrapper rather than a second execution engine.
 from __future__ import annotations
 
 import contextlib
+import functools
+import os
 import weakref
 
 import jax
@@ -101,6 +103,51 @@ def _check_versions(node: TapeNode):
 
 
 # ---------------------------------------------------------------------------
+# Micro-jit dispatch (SURVEY.md §7 hard-part 1: eager per-op overhead).
+#
+# The naive eager path re-traces `jax.vjp(fn, ...)` through Python on
+# EVERY op call (~hundreds of µs). When `fn` has a stable identity
+# (module-level op, cached scalar closure), we instead dispatch through
+# two jits cached by (fn, abstract args):
+#   fwd:  jit(fn)                      — one cached XLA program
+#   bwd:  jit(vjp(fn)∘pullback)        — re-derives the pullback INSIDE
+#         the jit from the saved inputs (rematerialization: trades a
+#         recompute for not holding residuals), cached the same way.
+# Steady-state Python cost per op drops to two cached-jit dispatches.
+# Unstable fns (per-call lambdas) keep the legacy vjp path — a jit cache
+# keyed on a fresh lambda would never hit and leak entries.
+
+_MICROJIT = os.environ.get("PADDLE_TPU_EAGER_MICROJIT", "1") != "0"
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _mj_fwd(fn, args):
+    return fn(*args)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _mj_bwd(fn, args, multi, cots):
+    _, vjp_fn = jax.vjp(fn, *args)
+    return vjp_fn(tuple(cots) if multi else cots[0])
+
+
+def _is_stable(fn) -> bool:
+    if getattr(fn, "_pt_stable", False):
+        return True
+    return getattr(fn, "__closure__", None) is None and \
+        getattr(fn, "__name__", "<lambda>") != "<lambda>"
+
+
+def mark_stable(fn):
+    """Tag fn as identity-stable so apply() may micro-jit it."""
+    try:
+        fn._pt_stable = True
+    except (AttributeError, TypeError):
+        pass
+    return fn
+
+
+# ---------------------------------------------------------------------------
 # The op applicator — every differentiable op goes through here.
 
 def apply(fn, *tensors, name: str = ""):
@@ -113,12 +160,23 @@ def apply(fn, *tensors, name: str = ""):
     from .tensor import Tensor
 
     arrs = tuple(t._data for t in tensors)
+    microjit = _MICROJIT and _is_stable(fn) and \
+        not any(isinstance(a, jax.core.Tracer) for a in arrs)
     needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
     if needs_grad:
-        out, vjp_fn = jax.vjp(fn, *arrs)
-        multi = isinstance(out, (tuple, list))
-        node = TapeNode(tensors, vjp_fn, multi, name=name, fn=fn)
-        if multi:
+        if microjit:
+            # lazy backward: the pullback is derived inside a cached jit
+            # at backward time (see _mj_bwd) — vjp_fn stays None
+            out = _mj_fwd(fn, arrs)
+            node = TapeNode(tensors, None,
+                            isinstance(out, (tuple, list)), name=name,
+                            fn=fn)
+        else:
+            out, vjp_fn = jax.vjp(fn, *arrs)
+            node = TapeNode(tensors, vjp_fn,
+                            isinstance(out, (tuple, list)), name=name,
+                            fn=fn)
+        if node.multi_out:
             res = tuple(Tensor(o, stop_gradient=False, _node=node) for o in out)
             for t in res:
                 node.add_output(t)
@@ -126,7 +184,7 @@ def apply(fn, *tensors, name: str = ""):
         t = Tensor(out, stop_gradient=False, _node=node)
         node.add_output(t)
         return t
-    out = fn(*arrs)
+    out = _mj_fwd(fn, arrs) if microjit else fn(*arrs)
     if isinstance(out, (tuple, list)):
         return tuple(Tensor(o) for o in out)
     return Tensor(out)
@@ -249,7 +307,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     order = _topo_order([t._node for t in tensors])
 
     for node in reversed(order):
-        if node.vjp_fn is None and node.tensor_vjp is None:
+        if node.vjp_fn is None and node.tensor_vjp is None and \
+                node.fn is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time, but the "
                 "saved intermediate results have already been freed. Pass "
@@ -282,9 +341,15 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 raise RuntimeError(
                     f"node '{node.name}' does not support create_graph "
                     "(no re-traceable forward)")
-        else:
+        elif node.vjp_fn is not None:
             in_grads = node.vjp_fn(tuple(cotangents) if node.multi_out
                                    else cotangents[0])
+        else:
+            # micro-jit lazy backward: cached jit re-derives the pullback
+            # from the saved inputs (remat — no residuals were kept)
+            in_grads = _mj_bwd(node.fn,
+                               tuple(t._data for t in node.inputs),
+                               node.multi_out, tuple(cotangents))
         for t, g in zip(node.inputs, in_grads):
             if g is not None:
                 deposit(t, g)

@@ -207,6 +207,29 @@ def _xp_gather(g: ProcessGroup, arr):
     return np.asarray(out.addressable_shards[0].data)[0]
 
 
+def _xp_alltoall(g: ProcessGroup, stacked):
+    """True all-to-all: rank r's row k goes to rank k (O(world) data per
+    link — NOT a gather of everything). `stacked` is this rank's
+    [nranks, ...] input; returns this rank's [nranks, ...] output."""
+    from jax.sharding import PartitionSpec as P
+    key = (tuple(g.ranks), "a2a")
+    f = _xp_jits.get(key)
+    if f is None:
+        f = jax.jit(jax.shard_map(
+            lambda a: jax.lax.all_to_all(a, "world", split_axis=1,
+                                         concat_axis=0, tiled=True),
+            mesh=_xp_mesh(g), in_specs=P("world"),
+            out_specs=P(None, "world")))
+        _xp_jits[key] = f
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(_xp_mesh(g), P("world"))
+    garr = jax.make_array_from_process_local_data(
+        sh, np.asarray(stacked)[None])
+    out = f(garr)
+    local = np.asarray(out.addressable_shards[0].data)  # [n, 1, ...]
+    return local[:, 0]
+
+
 # ---------------------------------------------------------------------------
 # collectives
 
@@ -327,11 +350,10 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
             out_tensor_list.append(Tensor(out[i]))
         return Task()
     if _multiproc(g) and in_tensor_list:
-        me = g.get_group_rank(jax.process_index())
         stacked = jnp.stack([t._data for t in in_tensor_list])
-        rows = _xp_gather(g, stacked)  # [nranks, nranks, ...]
+        rows = _xp_alltoall(g, stacked)
         for r in range(g.nranks):
-            out_tensor_list.append(Tensor(jnp.asarray(rows[r][me])))
+            out_tensor_list.append(Tensor(jnp.asarray(rows[r])))
         return Task()
     out_tensor_list.extend(in_tensor_list)
     return Task()

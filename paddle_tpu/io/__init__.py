@@ -13,6 +13,7 @@ num_workers>0 enables the prefetch pipeline; 0 = synchronous iteration.
 from __future__ import annotations
 
 import itertools
+import os
 import queue as _queue
 import threading
 
@@ -289,9 +290,11 @@ def default_collate_fn(batch):
 # carry descriptors. Collation and the jax device put stay in the parent
 # — forked children never touch the accelerator runtime.
 
-def _shm_pack(samples):
+def _shm_pack(samples, seg_name=None):
     """Replace ndarray leaves with shm descriptors; returns (spec, shm_name)
-    or (samples, None) when nothing is packable."""
+    or (samples, None) when nothing is packable. `seg_name` gives the
+    segment a loader-scoped deterministic name so the parent can sweep
+    leftovers even when a terminate() loses the queue descriptor."""
     from multiprocessing import shared_memory
 
     arrays = []
@@ -316,7 +319,8 @@ def _shm_pack(samples):
     for a in arrays:
         offsets.append(total)
         total += a.nbytes
-    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1),
+                                     name=seg_name)
     for a, off in zip(arrays, offsets):
         # write straight into the segment — tobytes() would materialize a
         # second full copy of every batch in the worker's hot path
@@ -368,10 +372,11 @@ def _shm_unpack(spec, shm_name, offsets):
 
 
 def _process_worker(wid, num_workers, dataset, index_q, result_q,
-                    worker_init_fn, use_shm):
+                    worker_init_fn, use_shm, shm_token=None):
     _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
     if worker_init_fn:
         worker_init_fn(wid)
+    seq = 0
     while True:
         item = index_q.get()
         if item is None:
@@ -380,7 +385,9 @@ def _process_worker(wid, num_workers, dataset, index_q, result_q,
         try:
             samples = [dataset[j] for j in indices]
             if use_shm:
-                spec, name, offsets = _shm_pack(samples)
+                seg = f"{shm_token}_{wid}_{seq}" if shm_token else None
+                seq += 1
+                spec, name, offsets = _shm_pack(samples, seg)
                 result_q.put((i, "shm" if name else "raw",
                               (spec, name, offsets) if name else samples))
             else:
@@ -509,9 +516,12 @@ class DataLoader:
         """Real subprocess workers (fork): dataset[i] runs GIL-free in
         parallel; batches return via shared memory; parent collates."""
         import multiprocessing as mp
+        import uuid
 
         ctx = mp.get_context("fork")
         batches = list(self.batch_sampler)
+        shm_token = f"pdtpu{os.getpid()}_{uuid.uuid4().hex[:8]}" \
+            if self.use_shared_memory else None
         index_q = ctx.Queue()
         result_q = ctx.Queue(
             maxsize=max(self.num_workers * self.prefetch_factor, 2))
@@ -522,7 +532,7 @@ class DataLoader:
         procs = [ctx.Process(
             target=_process_worker,
             args=(w, self.num_workers, self.dataset, index_q, result_q,
-                  self.worker_init_fn, self.use_shared_memory),
+                  self.worker_init_fn, self.use_shared_memory, shm_token),
             daemon=True) for w in range(self.num_workers)]
         for p in procs:
             p.start()
@@ -535,9 +545,11 @@ class DataLoader:
                     except _queue.Empty:
                         dead = [p.exitcode for p in procs
                                 if p.exitcode not in (None, 0)]
+                        if not dead:
+                            continue  # slow dataset, workers healthy
                         raise RuntimeError(
                             f"DataLoader worker(s) died (exitcodes "
-                            f"{dead}) or stalled >120s") from None
+                            f"{dead})") from None
                     if kind == "err":
                         raise RuntimeError(
                             f"DataLoader worker failed on batch {i}: "
@@ -554,10 +566,12 @@ class DataLoader:
                     p.terminate()
             for p in procs:
                 p.join(timeout=5)
-            # drain queued payloads and release their shm segments — the
-            # workers unregistered them from their resource_tracker, so
-            # nothing else will ever unlink a leaked one (early break /
-            # error would otherwise fill /dev/shm across epochs)
+            # release undelivered shm segments — the workers unregistered
+            # them from their resource_tracker, so nothing else will ever
+            # unlink a leaked one (early break / error / a terminate()
+            # that loses a queue descriptor would fill /dev/shm across
+            # epochs). Loader-scoped names make leftovers discoverable
+            # even when the descriptor never reached the queue.
             from multiprocessing import shared_memory
             while True:
                 try:
@@ -567,6 +581,16 @@ class DataLoader:
                 if kind == "shm":
                     try:
                         seg = shared_memory.SharedMemory(name=payload[1])
+                        seg.close()
+                        seg.unlink()
+                    except Exception:
+                        pass
+            if shm_token is not None:
+                import glob as _glob
+                for path in _glob.glob(f"/dev/shm/{shm_token}_*"):
+                    try:
+                        seg = shared_memory.SharedMemory(
+                            name=os.path.basename(path))
                         seg.close()
                         seg.unlink()
                     except Exception:

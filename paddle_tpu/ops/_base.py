@@ -44,10 +44,48 @@ def _promote_weak(scalar, ref: Tensor):
     return jnp.float32
 
 
+import functools
+
+from ..core.autograd import mark_stable
+
+
+@functools.lru_cache(maxsize=8192, typed=True)
+def _scalar_rhs(jfn, y):
+    """Identity-stable closure for op(tensor, python_scalar) — the hottest
+    eager pattern (x * 2.0). Stability lets apply() micro-jit it.
+    typed=True: 2 and 2.0 and True hash equal but must NOT share a
+    closure — the baked scalar's type drives weak-type promotion."""
+    return mark_stable(lambda a: jfn(a, y))
+
+
+@functools.lru_cache(maxsize=8192, typed=True)
+def _scalar_lhs(jfn, x):
+    return mark_stable(lambda b: jfn(x, b))
+
+
+@functools.lru_cache(maxsize=8192, typed=True)
+def _unary_kw(jfn, kw_items):
+    kw = dict(kw_items)
+    return mark_stable(lambda a: jfn(a, **kw))
+
+
+def _hashable(v):
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
 def unary_op(jfn, name=""):
+    mark_stable(jfn)
+
     def op(x, name_=None, **kw):
         x = ensure_tensor(x)
         if kw:
+            items = tuple(sorted(kw.items()))
+            if all(_hashable(v) for _, v in items):
+                return apply(_unary_kw(jfn, items), x, name=name)
             return apply(lambda a: jfn(a, **kw), x, name=name)
         return apply(jfn, x, name=name)
     op.__name__ = name or getattr(jfn, "__name__", "op")
@@ -56,6 +94,8 @@ def unary_op(jfn, name=""):
 
 def binary_op(jfn, name="", amp_category=None):
     """Binary op; scalar operands stay in the closure for weak promotion."""
+    mark_stable(jfn)
+
     def op(x, y, name_=None):
         xs = isinstance(x, _SCALAR_TYPES)
         ys = isinstance(y, _SCALAR_TYPES)
@@ -63,10 +103,14 @@ def binary_op(jfn, name="", amp_category=None):
             return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
         if ys:
             x = ensure_tensor(x)
-            return apply(lambda a: jfn(a, y), x, name=name)
+            fn = _scalar_rhs(jfn, y) if _hashable(y) else \
+                (lambda a: jfn(a, y))
+            return apply(fn, x, name=name)
         if xs:
             y = ensure_tensor(y)
-            return apply(lambda b: jfn(x, b), y, name=name)
+            fn = _scalar_lhs(jfn, x) if _hashable(x) else \
+                (lambda b: jfn(x, b))
+            return apply(fn, y, name=name)
         x, y = ensure_tensor(x), ensure_tensor(y)
         if amp_category is not None:
             x, y = amp_autocast((x, y), amp_category)

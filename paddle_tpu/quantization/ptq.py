@@ -65,7 +65,9 @@ class QuantizedInferenceLinear(Layer):
                     dimension_numbers=(((xa.ndim - 1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32)
                 scale = s_x * (w_scale / 127.0)
-                return acc.astype(jnp.float32) * scale
+                # rescale in f32 for accuracy, return in the input's
+                # dtype (a bf16 pipeline must stay bf16 downstream)
+                return (acc.astype(jnp.float32) * scale).astype(xa.dtype)
 
             from ..core.autograd import apply
             y = apply(int8_matmul, x, self.weight_quant,

@@ -98,7 +98,8 @@ class TestShmHygiene:
         import glob
         import time
 
-        before = set(glob.glob("/dev/shm/psm_*"))
+        before = set(glob.glob("/dev/shm/psm_*") +
+                     glob.glob("/dev/shm/pdtpu*"))
         dl = DataLoader(TransformDS(), batch_size=2, num_workers=2,
                         use_shared_memory=True)
         it = iter(dl)
@@ -106,5 +107,6 @@ class TestShmHygiene:
         it.close()  # early termination — finally must drain & unlink
         gc.collect()
         time.sleep(0.3)
-        after = set(glob.glob("/dev/shm/psm_*"))
+        after = set(glob.glob("/dev/shm/psm_*") +
+                    glob.glob("/dev/shm/pdtpu*"))
         assert after <= before, f"leaked shm segments: {after - before}"

@@ -305,3 +305,16 @@ class TestInplaceAndVersioning:
         h.add_(1.0)  # mutates a tensor needed for y's backward
         with pytest.raises(RuntimeError, match="modified in place"):
             y.backward(P.ones_like(y))
+
+
+class TestScalarClosureTyping:
+    def test_int_scalar_after_float_scalar_keeps_int_dtype(self):
+        """typed=True scalar-closure cache: 2 and 2.0 hash equal but must
+        not share a baked closure (weak-type promotion differs)."""
+        f = P.to_tensor(np.array([1.0], np.float32)) * 2.0
+        assert f.numpy().dtype == np.float32
+        i = P.to_tensor(np.array([1, 2], np.int32)) * 2
+        assert i.numpy().dtype == np.int32, i.numpy().dtype
+        assert np.array_equal(i.numpy(), [2, 4])
+        b = P.to_tensor(np.array([True, False])) * True
+        assert np.array_equal(np.asarray(b.numpy(), bool), [True, False])

@@ -43,6 +43,15 @@ def main():
     dist.all_reduce(mx, op=dist.ReduceOp.MAX)
     assert np.allclose(mx.numpy(), [world - 1.0]), ("max", mx.numpy())
 
+    # alltoall: rank r sends row k to rank k → receives [k*10+r for k]
+    ins = [P.to_tensor(np.array([rank * 10.0 + k], np.float32))
+           for k in range(world)]
+    outs = []
+    dist.alltoall(ins, outs)
+    got = np.stack([o.numpy() for o in outs]).ravel()
+    want = np.array([k * 10.0 + rank for k in range(world)])
+    assert np.allclose(got, want), ("alltoall", got, want)
+
     dist.barrier()
 
     # -- 2-step DataParallel loss parity ------------------------------------
