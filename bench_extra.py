@@ -1,0 +1,90 @@
+"""Milestone-config benches beyond the headline bench.py (BASELINE.md
+"Milestone configs"): currently config 2 — BERT-base dynamic-graph
+fine-tune with AMP-O2 on a single TPU chip. Records tokens/sec (+ MFU
+proxy) to BENCH_extra.json and captures a jax.profiler trace artifact.
+
+Usage: python bench_extra.py [--trace]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bert_amp_o2(trace: bool = False):
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = BertConfig()  # BERT-base defaults
+        batch, seq, iters = 32, 128, 20
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=256)
+        batch, seq, iters = 4, 32, 3
+
+    P.seed(0)
+    model = BertForSequenceClassification(cfg)
+    opt = P.optimizer.AdamW(2e-5, parameters=model.parameters(),
+                            multi_precision=True)
+    crit = P.nn.CrossEntropyLoss()
+    m = P.Model(model)
+    m.prepare(opt, crit, amp_configs="O2")
+
+    rng = np.random.default_rng(0)
+    ids = P.to_tensor(rng.integers(0, cfg.vocab_size,
+                                   (batch, seq)).astype(np.int32))
+    labels = P.to_tensor(rng.integers(0, 2, (batch,)).astype(np.int64))
+
+    m.train_batch([ids], [labels])  # compile
+    m.train_batch([ids], [labels])
+    jax.effects_barrier()
+
+    if trace:
+        import os
+        os.makedirs("traces", exist_ok=True)
+        with jax.profiler.trace("traces/bert_amp_o2"):
+            for _ in range(3):
+                m.train_batch([ids], [labels])
+            jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = m.train_batch([ids], [labels])
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * seq * iters / dt
+    # 6N FLOPs/token proxy (fine-tune fwd+bwd)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    mfu = tok_s * 6 * n_params / (197e12 if on_tpu else 1e12)
+    return {
+        "metric": "bert_base_amp_o2_finetune"
+                  + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec (fwd+bwd+opt, AMP-O2)",
+        "mfu_6N_proxy": round(mfu, 4),
+        "batch": batch, "seq": seq,
+        "loss": float(np.asarray(loss)) if not isinstance(loss, float)
+        else loss,
+    }
+
+
+def main():
+    trace = "--trace" in sys.argv
+    rec = bert_amp_o2(trace=trace)
+    print(json.dumps(rec))
+    with open("BENCH_extra.json", "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,20 @@ def _mj_bwd(fn, args, multi, cots):
     return vjp_fn(tuple(cots) if multi else cots[0])
 
 
+def _under_outer_ad(arrs) -> bool:
+    """True when any arg is a JVP tracer — i.e. an enclosing jax AD
+    transform (value_and_grad in a compiled stepper) is differentiating
+    this code."""
+    try:
+        from jax._src.interpreters import ad as _ad
+    except ImportError:  # jax internals moved — fail safe to tape mode
+        return False
+    kinds = tuple(t for t in (getattr(_ad, "JVPTracer", None),
+                              getattr(_ad, "LinearizeTracer", None))
+                  if t is not None)
+    return bool(kinds) and any(isinstance(a, kinds) for a in arrs)
+
+
 def _is_stable(fn) -> bool:
     if getattr(fn, "_pt_stable", False):
         return True
@@ -163,6 +177,18 @@ def apply(fn, *tensors, name: str = ""):
     microjit = _MICROJIT and _is_stable(fn) and \
         not any(isinstance(a, jax.core.Tracer) for a in arrs)
     needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
+    if needs_grad and _under_outer_ad(arrs):
+        # An OUTER jax transform (the compiled steppers' value_and_grad)
+        # owns differentiation here. Recording a tape would call jax.vjp
+        # at JVP tracers — a second-order linearization that (a) cannot
+        # see custom_vjp rules from inside the replayed jaxpr, silently
+        # knocking Pallas kernels down to their XLA fallback, and (b)
+        # bloats the traced program. Run fn plainly; the outer AD
+        # differentiates it with every custom_vjp rule intact.
+        out = fn(*arrs)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor(o, stop_gradient=False) for o in out)
+        return Tensor(out, stop_gradient=False)
     if needs_grad:
         if microjit:
             # lazy backward: the pullback is derived inside a cached jit

@@ -28,8 +28,72 @@ def get_data_parallel_group():
     return hcg.get_data_parallel_group() if hcg else None
 
 
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """Reference: paddle.distributed.spawn. Under SPMD one controller
-    drives all local devices, so local 'spawn' degenerates to a direct
-    call with rank 0; true multi-host uses the launch CLI."""
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **kwargs):
+    """Reference: paddle.distributed.spawn — fork `nprocs` worker
+    processes, each with the PADDLE_* env protocol and a shared
+    jax.distributed coordinator, and run `func(*args)` in each (the
+    multi-controller regime; init_parallel_env inside `func` connects
+    the ranks). nprocs<=1 (or -1 on a single-controller SPMD setup)
+    degenerates to a direct call — one controller already drives all
+    local devices."""
+    if nprocs is None or nprocs <= 1:
+        func(*args)
+        return
+
+    import multiprocessing as mp
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    master = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    ctx = mp.get_context("spawn")  # children must NOT inherit a live
+    #                                XLA backend — they init their own;
+    #                                func must be module-level (picklable)
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_main, args=(func, args, rank,
+                                                  nprocs, master),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    # poll rather than join sequentially: a crashed rank leaves its
+    # siblings blocked in collectives forever — on first failure,
+    # terminate the rest instead of hanging
+    import time as _time
+    failed = []
+    while True:
+        alive = False
+        for rank, p in enumerate(procs):
+            rc = p.exitcode
+            if rc is None:
+                alive = True
+            elif rc != 0 and (rank, rc) not in failed:
+                failed.append((rank, rc))
+        if failed:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=10)
+            raise RuntimeError(f"spawn worker(s) failed: {failed}")
+        if not alive:
+            return
+        _time.sleep(0.2)
+
+
+def _spawn_main(func, args, rank, nprocs, master):
+    """Top-level child entry (must be picklable for the spawn context)."""
+    import os
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8200 + rank}",
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"127.0.0.1:{8200 + r}" for r in range(nprocs)),
+    })
     func(*args)

@@ -38,10 +38,11 @@ def _to_list(x):
 class _JitStepper:
     """Compiles loss-forward+backward+optimizer-update into one XLA call."""
 
-    def __init__(self, network, loss_fn, optimizer):
+    def __init__(self, network, loss_fn, optimizer, amp_level=None):
         self.network = network
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.amp_level = amp_level
         self._jit = None
         self._sig = None
 
@@ -84,6 +85,16 @@ class _JitStepper:
                         t._data = arr
                     for (n, t), arr in zip(bufs, buffers):
                         t._data = arr
+                    if self.amp_level:
+                        # AMP inside the trace: the auto_cast op hooks
+                        # emit traced casts, so the compiled program IS
+                        # the mixed-precision program
+                        from .. import amp as amp_mod
+                        with amp_mod.auto_cast(level=self.amp_level):
+                            return _forward_loss()
+                    return _forward_loss()
+
+                def _forward_loss():
                     outs = network(*inputs)
                     outs = outs if isinstance(outs, (list, tuple)) else \
                         [outs]
@@ -189,6 +200,10 @@ class Model:
                 self._amp_level = amp_configs
             else:
                 self._amp_level = amp_configs.get("level", "O1")
+        # a cached stepper baked the previous optimizer/loss/amp_level
+        # into its compiled program — re-preparing must invalidate it
+        self._stepper = None
+        self._jit_broken = False
         return self
 
     def _make_stepper(self):
@@ -211,7 +226,8 @@ class Model:
                     loss = trainer.train_batch(inputs, labels)
                     return loss, []
             return _FleetStepper()
-        return _JitStepper(self.network, self._loss, self._optimizer)
+        return _JitStepper(self.network, self._loss, self._optimizer,
+                           amp_level=self._amp_level)
 
     # -- single-batch ops -----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
@@ -221,7 +237,7 @@ class Model:
         labels = [to_tensor(x) if not isinstance(x, Tensor) else x
                   for x in _to_list(labels)]
 
-        if not self._jit_broken and update and self._amp_level is None:
+        if not self._jit_broken and update:
             if self._stepper is None:
                 self._stepper = self._make_stepper()
             try:

@@ -116,3 +116,37 @@ class TestLaunchMultiProcess:
             assert (tmp_path / "crashed.1").exists()
         finally:
             master.close()
+
+
+def _spawn_worker(out_dir):
+    """Module-level so the spawn context can pickle it."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as P
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    t = P.to_tensor(np.array([float(rank + 1)], np.float32))
+    dist.all_reduce(t)
+    with open(os.path.join(out_dir, f"spawn.{rank}"), "w") as f:
+        f.write(str(float(t.numpy()[0])))
+
+
+class TestSpawn:
+    def test_spawn_two_workers_allreduce(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        # run in a clean subprocess: spawn children must not inherit this
+        # test process's 8-device CPU config / initialized backend
+        code = (
+            "import tests.test_multiprocess as m\n"
+            "import paddle_tpu.distributed as dist\n"
+            f"dist.spawn(m._spawn_worker, args=({str(tmp_path)!r},), "
+            "nprocs=2)\n")
+        r = subprocess.run([sys.executable, "-c", code], env=_clean_env(),
+                           cwd=REPO, timeout=240, capture_output=True,
+                           text=True)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        vals = [float(open(tmp_path / f"spawn.{rk}").read())
+                for rk in range(2)]
+        assert vals == [3.0, 3.0], vals
