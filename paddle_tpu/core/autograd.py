@@ -131,17 +131,28 @@ def _mj_bwd(fn, args, multi, cots):
     return vjp_fn(tuple(cots) if multi else cots[0])
 
 
+def _ad_tracer_types():
+    global _AD_TRACERS
+    if _AD_TRACERS is None:
+        try:
+            from jax._src.interpreters import ad as _ad
+            _AD_TRACERS = tuple(
+                t for t in (getattr(_ad, "JVPTracer", None),
+                            getattr(_ad, "LinearizeTracer", None))
+                if t is not None)
+        except ImportError:  # jax internals moved — fail safe to tape
+            _AD_TRACERS = ()
+    return _AD_TRACERS
+
+
+_AD_TRACERS = None
+
+
 def _under_outer_ad(arrs) -> bool:
-    """True when any arg is a JVP tracer — i.e. an enclosing jax AD
-    transform (value_and_grad in a compiled stepper) is differentiating
-    this code."""
-    try:
-        from jax._src.interpreters import ad as _ad
-    except ImportError:  # jax internals moved — fail safe to tape mode
-        return False
-    kinds = tuple(t for t in (getattr(_ad, "JVPTracer", None),
-                              getattr(_ad, "LinearizeTracer", None))
-                  if t is not None)
+    """True when any arg is a JVP/linearize tracer — i.e. an enclosing
+    jax AD transform (value_and_grad in a compiled stepper) is
+    differentiating this code."""
+    kinds = _ad_tracer_types()
     return bool(kinds) and any(isinstance(a, kinds) for a in arrs)
 
 
@@ -179,16 +190,27 @@ def apply(fn, *tensors, name: str = ""):
     needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
     if needs_grad and _under_outer_ad(arrs):
         # An OUTER jax transform (the compiled steppers' value_and_grad)
-        # owns differentiation here. Recording a tape would call jax.vjp
-        # at JVP tracers — a second-order linearization that (a) cannot
+        # owns differentiation here. Eagerly calling jax.vjp at JVP
+        # tracers would be a second-order linearization that (a) cannot
         # see custom_vjp rules from inside the replayed jaxpr, silently
         # knocking Pallas kernels down to their XLA fallback, and (b)
-        # bloats the traced program. Run fn plainly; the outer AD
-        # differentiates it with every custom_vjp rule intact.
+        # bloats the traced program. Run fn plainly — the outer AD
+        # differentiates it with every custom_vjp rule intact — but keep
+        # a LAZY tape node (fn only), so an inner paddle.grad/backward
+        # inside the traced loss (gradient penalties) still works via
+        # the lazy-vjp path.
         out = fn(*arrs)
-        if isinstance(out, (tuple, list)):
-            return tuple(Tensor(o, stop_gradient=False) for o in out)
-        return Tensor(out, stop_gradient=False)
+        node = TapeNode(tensors, None, isinstance(out, (tuple, list)),
+                        name=name, fn=fn)
+        if node.multi_out:
+            res = tuple(Tensor(o, stop_gradient=False, _node=node)
+                        for o in out)
+            for t in res:
+                node.add_output(t)
+            return res
+        t = Tensor(out, stop_gradient=False, _node=node)
+        node.add_output(t)
+        return t
     if needs_grad:
         if microjit:
             # lazy backward: the pullback is derived inside a cached jit

@@ -88,12 +88,7 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **kwargs):
 def _spawn_main(func, args, rank, nprocs, master):
     """Top-level child entry (must be picklable for the spawn context)."""
     import os
-    os.environ.update({
-        "PADDLE_TRAINER_ID": str(rank),
-        "PADDLE_TRAINERS_NUM": str(nprocs),
-        "PADDLE_MASTER": master,
-        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8200 + rank}",
-        "PADDLE_TRAINER_ENDPOINTS": ",".join(
-            f"127.0.0.1:{8200 + r}" for r in range(nprocs)),
-    })
+
+    from .launch.main import worker_env
+    os.environ.update(worker_env(rank, nprocs, master, base_port=8200))
     func(*args)

@@ -179,5 +179,27 @@ class ParallelCrossEntropy(Layer):
                 return jnp.where(mask, loss, 0.0)
             return apply(f, input, label.detach().astype(jnp.int32),
                          name="parallel_cross_entropy")
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+
+        # GSPMD TRACED regime: logits carry a vocab-sharded layout. The
+        # gather (take_along_axis) inside plain cross_entropy trips an
+        # XLA SPMD partitioner CHECK when the mp auto-axis lives inside a
+        # manual-pp shard_map (the 4D pipeline path); a one-hot masked
+        # reduce is partitioner-safe and XLA fuses it without
+        # materializing the one-hot. Eager (concrete) calls keep the
+        # gather-based path — unfused eager one-hot would allocate a full
+        # [.., V] float buffer.
+        import jax
+        if not isinstance(input._data, jax.core.Tracer):
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+        ignore = self.ignore_index
+
+        def f(logits, lab):
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            safe = jnp.where(lab == ignore, 0, lab)
+            oh = jax.nn.one_hot(safe, logits.shape[-1], dtype=lsm.dtype)
+            nll = -(oh * lsm).sum(-1)
+            return jnp.where(lab != ignore, nll, 0.0)
+
+        return apply(f, input, label.detach().astype(jnp.int32),
+                     name="parallel_cross_entropy")

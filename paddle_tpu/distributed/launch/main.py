@@ -37,17 +37,23 @@ class Container:
                 self.proc.kill()
 
 
-def build_env(rank, nnodes, master, base_env=None):
-    env = dict(base_env or os.environ)
-    env.update({
+def worker_env(rank, nnodes, master, base_port=8100):
+    """The PADDLE_* env protocol for one worker — the single source of
+    truth shared by the launch CLI and distributed.spawn."""
+    return {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nnodes),
         "PADDLE_MASTER": master or "",
-        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8100 + rank}",
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
         "PADDLE_TRAINER_ENDPOINTS": ",".join(
-            f"127.0.0.1:{8100 + r}" for r in range(nnodes)),
+            f"127.0.0.1:{base_port + r}" for r in range(nnodes)),
         "PADDLE_RANK_IN_NODE": "0",
-    })
+    }
+
+
+def build_env(rank, nnodes, master, base_env=None):
+    env = dict(base_env or os.environ)
+    env.update(worker_env(rank, nnodes, master))
     return env
 
 

@@ -212,6 +212,15 @@ class Model:
         stepper. Reference flow §3.2→§3.3 unified behind Model.fit."""
         from ..distributed import fleet as fleet_mod
         if fleet_mod.is_initialized():
+            if self._amp_level is not None:
+                # SPMDTrainer has no AMP hook yet — run the eager path
+                # (which honors auto_cast) rather than silently training
+                # in full precision
+                import warnings
+                warnings.warn("AMP with fleet runs the eager path this "
+                              "round; the compiled SPMD stepper ignores "
+                              "amp_configs")
+                return None
             from ..distributed.fleet.fleet import _state
             from ..distributed.fleet.spmd import SPMDTrainer
             st = _state.strategy
@@ -239,7 +248,9 @@ class Model:
 
         if not self._jit_broken and update:
             if self._stepper is None:
-                self._stepper = self._make_stepper()
+                self._stepper = self._make_stepper() or "eager"
+            if self._stepper == "eager":  # fleet+AMP: eager path
+                return self._train_batch_eager(inputs, labels, update)
             try:
                 loss, outs = self._stepper.step(inputs, labels)
                 if outs:

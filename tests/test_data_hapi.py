@@ -206,3 +206,35 @@ class TestTiedParameters:
         l1 = float(m.train_batch([ids], [ids]))
         l2 = float(m.train_batch([ids], [ids]))
         assert np.isfinite(l1) and np.isfinite(l2)
+
+
+class TestInnerGradInStepper:
+    def test_gradient_penalty_loss_compiles(self):
+        """A loss that calls paddle.grad INSIDE the compiled stepper
+        (gradient penalty) — the lazy tape under outer AD must support
+        it."""
+        import paddle_tpu.nn as nn
+        P.seed(0)
+        net = nn.Linear(4, 1)
+        opt = P.optimizer.SGD(0.05, parameters=net.parameters())
+
+        def gp_loss(pred, x_in, y):
+            mse = ((pred - y) ** 2).mean()
+            (gx,) = P.grad([pred.sum()], [x_in], retain_graph=True,
+                           allow_unused=False)
+            return mse + 0.1 * (gx ** 2).sum()
+
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((8, 4)).astype(np.float32)
+        yv = rng.standard_normal((8, 1)).astype(np.float32)
+
+        losses = []
+        for _ in range(3):
+            x = P.to_tensor(xv, stop_gradient=False)
+            pred = net(x)
+            loss = gp_loss(pred, x, P.to_tensor(yv))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]

@@ -283,3 +283,36 @@ class TestPipelineComposition:
         losses, snap = _run_pipe_losses(strat, pipe, x, y)
         ref = _dense_ref_losses(pipe, snap, x, y, M=2)
         assert np.allclose(losses, ref, rtol=5e-3, atol=1e-3), (losses, ref)
+
+
+class TestLlamaPipe4D:
+    def test_llama_pipe_pp_tp_trains(self):
+        """The real model path (VocabParallelEmbedding + TP head +
+        ParallelCrossEntropy) through PP×TP×DP — regression for the XLA
+        SPMD-partitioner CHECK crash on the gather-based CE inside the
+        manual-pp shard_map."""
+        import paddle_tpu.models.llama as L
+        _reset_fleet()
+        P.seed(0)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_hidden_layers=2,
+                            num_attention_heads=4,
+                            max_position_embeddings=64,
+                            tensor_parallel=True)
+        pipe = L.LlamaForCausalLMPipe(cfg, num_stages=2)
+        opt = P.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        model = fleet.distributed_model(pipe)
+        ids = P.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 16)).astype(np.int32))
+        l1 = float(model.train_batch((ids, ids), opt).numpy())
+        l2 = float(model.train_batch((ids, ids), opt).numpy())
+        assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1, (l1, l2)
+        for p in pipe.parameters():
+            p._data.block_until_ready()
