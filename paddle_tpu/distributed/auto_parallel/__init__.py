@@ -1,3 +1,4 @@
 """paddle_tpu.distributed.auto_parallel (reference: semi-auto parallel API)."""
-from .api import (ProcessMesh, Replicate, Shard, Partial, shard_tensor,  # noqa: F401
-                  reshard, dtensor_from_fn, shard_layer)
+from .api import (Engine, Partial, ProcessMesh, Replicate,  # noqa: F401
+                  Shard, Strategy, dtensor_from_fn, reshard, shard_layer,
+                  shard_tensor, to_static)

@@ -138,3 +138,170 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
                                    [Replicate()] * len(process_mesh.shape))
             p._data = sharded._data
     return layer
+
+
+class Strategy:
+    """Reference: paddle.distributed.Strategy (auto-parallel training
+    options). Thin config holder; the GSPMD partitioner replaces the
+    reference's planner/SPMD rules."""
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = _Cfg(cfg.get("sharding", {}))
+        self.pipeline = _Cfg(cfg.get("pipeline", {}))
+        self.amp = _Cfg(cfg.get("amp", {}))
+        self.gradient_merge = _Cfg(cfg.get("gradient_merge", {}))
+
+
+class _Cfg:
+    def __init__(self, d):
+        self.enable = bool(d.get("enable", False))
+        for k, v in d.items():
+            setattr(self, k, v)
+
+
+class Engine:
+    """Reference: paddle.distributed.auto_parallel Engine — the
+    train/eval driver for semi-auto parallel models (upstream
+    python/paddle/distributed/auto_parallel/engine.py, unverified; see
+    SURVEY.md §2.3 Auto-parallel row).
+
+    TPU-native: the reference Engine plans a distributed program from
+    the user's sharding annotations; here the annotations ARE
+    jax.shardings (shard_tensor placements on parameters), so the Engine
+    reduces to the fleet SPMD compiled stepper over the current hybrid
+    mesh — planning is GSPMD's job.
+    """
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy or Strategy()
+        self._trainer = None
+
+    def _ensure_trainer(self):
+        if self._trainer is None:
+            from ..fleet.fleet import _state
+            from ..fleet.spmd import SPMDTrainer
+            from ..fleet.strategy import DistributedStrategy
+            if not _state.initialized:
+                from .. import fleet
+                fleet.init(is_collective=True)
+            # overlay the Engine-level Strategy onto the fleet strategy:
+            # SPMDTrainer reads sharding/amp/gradient_merge from ONE
+            # strategy object (the single source of truth for stage/amp
+            # derivation)
+            st = _state.strategy or DistributedStrategy()
+            if self.strategy.sharding.enable:
+                st.sharding = True
+                st.sharding_configs["stage"] = int(
+                    getattr(self.strategy.sharding, "stage", 1))
+            if self.strategy.amp.enable:
+                st.amp = True
+                level = getattr(self.strategy.amp, "level", "O1")
+                st.amp_configs["level"] = level.upper() \
+                    if isinstance(level, str) else level
+            if self.strategy.gradient_merge.enable:
+                st.gradient_merge = True
+                st.gradient_merge_configs["k_steps"] = int(
+                    getattr(self.strategy.gradient_merge, "k_steps", 1))
+                st.gradient_merge_configs["avg"] = bool(
+                    getattr(self.strategy.gradient_merge, "avg", True))
+            self._trainer = SPMDTrainer(
+                self.model, self.optimizer, self.loss, _state.hcg.mesh,
+                st)
+        return self._trainer
+
+    # -- reference API surface ----------------------------------------------
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=10, verbose=0):
+        trainer = self._ensure_trainer()
+        history = []
+        for ep in range(epochs):
+            for step, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                inputs, labels = self._split_batch(batch)
+                loss = trainer.train_batch(inputs, labels)
+                history.append(float(loss.numpy()))
+        return history
+
+    def _place(self, tensors):
+        """After fit() the params live sharded on the mesh — eager
+        eval/predict inputs must join them (replicated) or every op
+        sees mixed device sets."""
+        if self._trainer is None or not self._trainer._placed:
+            return tensors
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._trainer.mesh, P())
+        for t in tensors:
+            t._data = jax.device_put(t._data, sh)
+        return tensors
+
+    def evaluate(self, valid_data, batch_size=None, steps=None):
+        from ...core.autograd import no_grad
+        losses = []
+        self.model.eval()  # dropout off, norms frozen
+        try:
+            with no_grad():
+                for step, batch in enumerate(valid_data):
+                    if steps is not None and step >= steps:
+                        break
+                    inputs, labels = self._split_batch(batch)
+                    inputs = self._place(inputs)
+                    labels = self._place(labels)
+                    outs = self.model(*inputs)
+                    outs = outs if isinstance(outs, (list, tuple)) \
+                        else [outs]
+                    if self.loss is not None:
+                        loss = self.loss(*(list(outs) + labels))
+                        losses.append(float(loss.numpy()))
+        finally:
+            self.model.train()
+        return {"loss": losses}
+
+    def predict(self, test_data, steps=None):
+        from ...core.autograd import no_grad
+        outs_all = []
+        self.model.eval()
+        try:
+            with no_grad():
+                for step, batch in enumerate(test_data):
+                    if steps is not None and step >= steps:
+                        break
+                    inputs, _ = self._split_batch(batch,
+                                                  allow_no_label=True)
+                    inputs = self._place(inputs)
+                    outs = self.model(*inputs)
+                    outs = outs if isinstance(outs, (list, tuple)) \
+                        else [outs]
+                    outs_all.append([o.numpy() for o in outs])
+        finally:
+            self.model.train()
+        return outs_all
+
+    @staticmethod
+    def _split_batch(batch, allow_no_label=False):
+        from ...core.tensor import Tensor, to_tensor
+
+        def tt(x):
+            return x if isinstance(x, Tensor) else to_tensor(x)
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            ins, labs = batch
+            ins = ins if isinstance(ins, (list, tuple)) else [ins]
+            labs = labs if isinstance(labs, (list, tuple)) else [labs]
+            return [tt(x) for x in ins], [tt(x) for x in labs]
+        if allow_no_label:
+            ins = batch if isinstance(batch, (list, tuple)) else [batch]
+            return [tt(x) for x in ins], []
+        raise ValueError("batch must be (inputs, labels)")
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Reference: paddle.distributed.to_static — returns an Engine-backed
+    static trainer for the annotated model."""
+    return Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)

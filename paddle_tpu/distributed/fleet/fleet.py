@@ -115,18 +115,12 @@ class HybridParallelWrapper(Layer):
     def _get_trainer(self, optimizer, loss_fn):
         if self._trainer is None:
             from .spmd import SPMDTrainer
-            stage = 0
-            st = self._strategy
-            if st is not None and st.sharding:
-                stage = int(st.sharding_configs["stage"])
-            elif st is not None and \
-                    st.hybrid_configs["sharding_degree"] > 1:
-                stage = 1
+            # stage/amp/gradient_merge derivation lives in SPMDTrainer
             self._trainer = SPMDTrainer(
                 self._layers,
                 optimizer._inner if hasattr(optimizer, "_inner")
                 else optimizer,
-                loss_fn, self._hcg.mesh, st, sharding_stage=stage)
+                loss_fn, self._hcg.mesh, self._strategy)
         return self._trainer
 
     def train_batch(self, inputs, labels, optimizer, loss_fn):

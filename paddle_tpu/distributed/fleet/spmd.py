@@ -85,20 +85,41 @@ class SPMDTrainer:
     """Compiled hybrid-parallel train step over a Mesh."""
 
     def __init__(self, layer: Layer, optimizer, loss_fn, mesh: Mesh,
-                 strategy=None, sharding_stage=None):
+                 strategy=None, sharding_stage=None, amp_level=None):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         st = strategy
-        self.stage = sharding_stage if sharding_stage is not None else (
-            int(st.sharding_configs["stage"]) if st is not None and
-            st.sharding else 0)
+        if sharding_stage is not None:
+            self.stage = sharding_stage
+        elif st is not None and st.sharding:
+            self.stage = int(st.sharding_configs["stage"])
+        elif st is not None and \
+                st.hybrid_configs.get("sharding_degree", 1) > 1:
+            self.stage = 1  # sharding axis without explicit config = ZeRO-1
+        else:
+            self.stage = 0
+        # AMP: explicit arg wins; else the strategy's amp switch (so
+        # fleet.distributed_model users get mixed precision too)
+        if amp_level is None and st is not None and \
+                getattr(st, "amp", False):
+            amp_level = st.amp_configs.get("level", "O1")
+        self.amp_level = amp_level
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.sharding_degree = ax.get("sharding", 1)
         self.mp_degree = ax.get("mp", 1)
         self.dp_degree = ax.get("dp", 1)
-        self._jit = None
+        # gradient merge (reference: fleet gradient_merge dist pass):
+        # accumulate k micro-steps' grads in f32 accumulators, apply the
+        # optimizer on the k-th — two cached program flavors
+        gm = bool(getattr(st, "gradient_merge", False)) if st else False
+        self.k_steps = int(st.gradient_merge_configs.k_steps) if gm else 1
+        self.gm_avg = bool(st.gradient_merge_configs.get("avg", True)) \
+            if gm else True
+        self._gacc = None
+        self._micro = 0
+        self._jits = {}
         self._sig = None
         self._placed = False
 
@@ -134,7 +155,8 @@ class SPMDTrainer:
             self.sharding_degree))
 
     # -- compiled step -------------------------------------------------------
-    def _build(self, n_inputs, n_labels, states_tree_shapes):
+    def _build(self, n_inputs, n_labels, states_tree_shapes,
+               do_update=True):
         layer, opt, loss_fn = self.layer, self.optimizer, self.loss_fn
         train_named = self._train_named
         frozen_named = self._frozen_named
@@ -142,8 +164,11 @@ class SPMDTrainer:
         stage = self.stage
         sharding_degree = self.sharding_degree
         mesh = self.mesh
+        k = self.k_steps
+        gm_avg = self.gm_avg
 
-        def pure(key, params, frozen, buffers, states, lr, step_i, *batch):
+        def pure(key, params, frozen, buffers, states, gacc, lr, step_i,
+                 *batch):
             inputs = [Tensor(a) for a in batch[:n_inputs]]
             labels = [Tensor(a) for a in batch[n_inputs:]]
             all_t = ([t for _, t in train_named] +
@@ -159,6 +184,16 @@ class SPMDTrainer:
                         t._data = arr
                     for (n, t), arr in zip(buf_named, buffers):
                         t._data = arr
+                    if self.amp_level:
+                        # AMP inside the trace — the compiled program IS
+                        # the mixed-precision program (same contract as
+                        # the single-device _JitStepper)
+                        from ... import amp as amp_mod
+                        with amp_mod.auto_cast(level=self.amp_level):
+                            return _fwd_loss()
+                    return _fwd_loss()
+
+                def _fwd_loss():
                     outs = layer(*inputs)
                     outs = outs if isinstance(outs, (list, tuple)) else \
                         [outs]
@@ -178,6 +213,20 @@ class SPMDTrainer:
                                 ps, g.shape, stage, sharding_degree)))
                         for g, ps in zip(grads, self._pspecs)]
 
+                if k > 1:
+                    # merge this micro-step into the f32 accumulators
+                    merged = [ga + g.astype(ga.dtype)
+                              for ga, g in zip(gacc, grads)]
+                    if not do_update:
+                        # params/states untouched — return only the
+                        # accumulators (no pointless whole-model copy)
+                        return loss_v, new_buf, merged
+                    grads = [(m / k if gm_avg else m).astype(g.dtype)
+                             for m, g in zip(merged, grads)]
+                    new_gacc = [jnp.zeros_like(m) for m in merged]
+                else:
+                    new_gacc = list(gacc)
+
                 if opt._grad_clip is not None:
                     pg = [(t, Tensor(g)) for (n, t), g in
                           zip(train_named, grads)]
@@ -186,7 +235,7 @@ class SPMDTrainer:
 
                 new_params, new_states = opt._fused_apply(
                     list(params), grads, list(states), lr, step_i)
-                return loss_v, new_buf, new_params, new_states
+                return loss_v, new_buf, new_params, new_states, new_gacc
             finally:
                 _random.pop_trace_key()
                 for t, arr in saved:
@@ -203,9 +252,15 @@ class SPMDTrainer:
             for st, sp in zip(states_tree_shapes[0], self._pspecs)]
         batch_sh = [ns(batch_spec(nd)) for nd in states_tree_shapes[1]]
 
+        gacc_sh = [self._state_sharding(sp, tuple(p._data.shape))
+                   for (_, p), sp in zip(self._train_named, self._pspecs)] \
+            if self.k_steps > 1 else []
         in_shardings = (ns(P()), param_sh, frozen_sh, buf_sh, state_sh,
-                        ns(P()), ns(P()), *batch_sh)
-        out_shardings = (ns(P()), buf_sh, param_sh, state_sh)
+                        gacc_sh, ns(P()), ns(P()), *batch_sh)
+        if do_update:
+            out_shardings = (ns(P()), buf_sh, param_sh, state_sh, gacc_sh)
+        else:
+            out_shardings = (ns(P()), buf_sh, gacc_sh)
 
         return jax.jit(pure, in_shardings=in_shardings,
                        out_shardings=out_shardings)
@@ -220,28 +275,52 @@ class SPMDTrainer:
                   for t in labels]
         states = [opt._get_state(p) for _, p in self._train_named]
         batch_ndims = [t._data.ndim for t in inputs + labels]
+        self._micro += 1
+        do_update = self.k_steps == 1 or self._micro % self.k_steps == 0
         sig = (len(inputs), len(labels),
                tuple(tuple(t.shape) for t in inputs + labels),
                tuple(tuple(sorted(s.keys())) for s in states))
-        if self._jit is None or self._sig != sig:
-            self._jit = self._build(len(inputs), len(labels),
-                                    (states, batch_ndims))
+        if self._sig != sig:
+            self._jits = {}
             self._sig = sig
-        opt._step_count += 1
+        fn = self._jits.get(do_update)
+        if fn is None:
+            fn = self._build(len(inputs), len(labels),
+                             (states, batch_ndims), do_update=do_update)
+            self._jits[do_update] = fn
+        if self.k_steps > 1 and self._gacc is None:
+            self._gacc = [
+                jax.device_put(
+                    jnp.zeros(p._data.shape, jnp.float32),
+                    self._state_sharding(sp, tuple(p._data.shape)))
+                for (_, p), sp in zip(self._train_named, self._pspecs)]
+        gacc = self._gacc if self.k_steps > 1 else []
+        if do_update:
+            opt._step_count += 1
         key = _random.next_key()
         batch_arrays = [
             jax.device_put(t._data, NamedSharding(
                 self.mesh, batch_spec(t._data.ndim)))
             for t in inputs + labels]
-        loss_v, new_buf, new_params, new_states = self._jit(
+        out = fn(
             key,
             [p._data for _, p in self._train_named],
             [p._data for _, p in self._frozen_named],
             [b._data for _, b in self._buf_named],
             states,
+            gacc,
             jnp.asarray(opt.get_lr(), jnp.float32),
             jnp.asarray(opt._step_count, jnp.int32),
             *batch_arrays)
+        if not do_update:
+            loss_v, new_buf, new_gacc = out
+            self._gacc = list(new_gacc)
+            for (n, b), arr in zip(self._buf_named, new_buf):
+                b._inplace_update(arr)
+            return Tensor(loss_v)
+        loss_v, new_buf, new_params, new_states, new_gacc = out
+        if self.k_steps > 1:
+            self._gacc = list(new_gacc)
         for (n, p), arr in zip(self._train_named, new_params):
             p._inplace_update(arr)
         for (n, p), st in zip(self._train_named, new_states):

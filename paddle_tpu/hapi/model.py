@@ -212,23 +212,11 @@ class Model:
         stepper. Reference flow §3.2→§3.3 unified behind Model.fit."""
         from ..distributed import fleet as fleet_mod
         if fleet_mod.is_initialized():
-            if self._amp_level is not None:
-                # SPMDTrainer has no AMP hook yet — run the eager path
-                # (which honors auto_cast) rather than silently training
-                # in full precision
-                import warnings
-                warnings.warn("AMP with fleet runs the eager path this "
-                              "round; the compiled SPMD stepper ignores "
-                              "amp_configs")
-                return None
             from ..distributed.fleet.fleet import _state
             from ..distributed.fleet.spmd import SPMDTrainer
-            st = _state.strategy
-            stage = int(st.sharding_configs["stage"]) if st and st.sharding \
-                else 0
             trainer = SPMDTrainer(self.network, self._optimizer, self._loss,
-                                  _state.hcg.mesh, st,
-                                  sharding_stage=stage)
+                                  _state.hcg.mesh, _state.strategy,
+                                  amp_level=self._amp_level)
 
             class _FleetStepper:
                 def step(self_, inputs, labels):
@@ -248,9 +236,7 @@ class Model:
 
         if not self._jit_broken and update:
             if self._stepper is None:
-                self._stepper = self._make_stepper() or "eager"
-            if self._stepper == "eager":  # fleet+AMP: eager path
-                return self._train_batch_eager(inputs, labels, update)
+                self._stepper = self._make_stepper()
             try:
                 loss, outs = self._stepper.step(inputs, labels)
                 if outs:

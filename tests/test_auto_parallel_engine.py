@@ -1,0 +1,91 @@
+"""auto_parallel Engine tests (reference Engine.fit/evaluate/predict over
+annotated models — SURVEY.md §2.3 Auto-parallel)."""
+import numpy as np
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+
+def _reset_fleet():
+    from paddle_tpu.distributed.fleet.fleet import _state
+    from paddle_tpu.distributed.fleet.topology import \
+        set_hybrid_communicate_group
+    _state.initialized = False
+    _state.strategy = None
+    _state.hcg = None
+    set_hybrid_communicate_group(None)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(P.nn.functional.relu(self.fc1(x)))
+
+
+def _data(n_batches=4, bs=8):
+    rng = np.random.default_rng(0)
+    return [(rng.standard_normal((bs, 8)).astype(np.float32),
+             rng.integers(0, 4, (bs,)).astype(np.int64))
+            for _ in range(n_batches)]
+
+
+class TestEngine:
+    def test_fit_evaluate_predict(self):
+        _reset_fleet()
+        P.seed(0)
+        net = MLP()
+        opt = P.optimizer.Adam(0.05, parameters=net.parameters())
+        engine = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt)
+        hist = engine.fit(_data(), epochs=2)
+        assert len(hist) == 8
+        # same 4 batches per epoch: epoch-2 total < epoch-1 total
+        assert sum(hist[4:]) < sum(hist[:4]), hist
+        ev = engine.evaluate(_data(2))
+        assert len(ev["loss"]) == 2
+        pr = engine.predict([b[0] for b in _data(2)])
+        assert len(pr) == 2 and pr[0][0].shape == (8, 4)
+        _reset_fleet()
+
+    def test_fit_with_sharding_strategy(self):
+        _reset_fleet()
+        P.seed(0)
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 2, "sharding_degree": 8}
+        s.hybrid_configs = {"sharding_degree": 8}
+        fleet.init(is_collective=True, strategy=s)
+        net = MLP()
+        opt = P.optimizer.Adam(0.05, parameters=net.parameters())
+        engine = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                        strategy=Strategy({"sharding": {"enable": True,
+                                                        "stage": 2}}))
+        hist = engine.fit(_data() * 2, epochs=1)
+        assert sum(hist[4:]) < sum(hist[:4]), hist
+        _reset_fleet()
+
+
+class TestEngineGradientMerge:
+    def test_engine_gradient_merge_wired(self):
+        """Engine-level gradient_merge must reach the SPMDTrainer."""
+        _reset_fleet()
+        try:
+            P.seed(0)
+            net = MLP()
+            opt = P.optimizer.SGD(0.1, parameters=net.parameters())
+            engine = Engine(net, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                            strategy=Strategy(
+                                {"gradient_merge": {"enable": True,
+                                                    "k_steps": 2}}))
+            trainer = engine._ensure_trainer()
+            assert trainer.k_steps == 2
+            hist = engine.fit(_data() * 2, epochs=1)
+            assert len(hist) == 8
+        finally:
+            _reset_fleet()

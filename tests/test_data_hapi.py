@@ -238,3 +238,43 @@ class TestInnerGradInStepper:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
+
+
+class TestFleetAmpCompiled:
+    def test_fleet_amp_o1_trains_compiled(self):
+        """fleet + AMP goes through the compiled SPMD stepper (not the
+        per-op eager fallback) and the loss decreases."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from tests.test_distributed import _reset_fleet
+        _reset_fleet()
+        P.seed(3)
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=s)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(P.nn.functional.relu(self.fc1(x)))
+
+        net = Net()
+        opt = P.optimizer.Adam(0.05, parameters=net.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        m = P.Model(net)
+        m.prepare(opt, nn.CrossEntropyLoss(), amp_configs="O1")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = rng.integers(0, 4, (16,)).astype(np.int64)
+        try:
+            l1 = m.train_batch([P.to_tensor(x)], [P.to_tensor(y)])
+            l2 = m.train_batch([P.to_tensor(x)], [P.to_tensor(y)])
+            assert m._stepper is not None
+            assert l2 < l1, (l1, l2)
+        finally:
+            _reset_fleet()
