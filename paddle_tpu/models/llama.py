@@ -15,6 +15,7 @@ lm_head, ParallelCrossEntropy) — built TPU-first:
 """
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -45,6 +46,13 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-6
     rope_theta: float = 10000.0
     use_flash_attention: bool = True
+    # fuse the LM head into a chunked cross entropy (reference:
+    # use_fused_linear_cross_entropy): the [B,S,V] logits are never
+    # materialized — each sequence chunk's head matmul + CE runs under
+    # jax.checkpoint, so peak memory is one chunk's logits. Required for
+    # long sequences (s=8192 OOMs a 16G chip on the logits alone).
+    fuse_linear_cross_entropy: bool = False
+    loss_chunk_size: int = 1024
     tie_word_embeddings: bool = False
     tensor_parallel: bool = False
     sequence_parallel: bool = False
@@ -126,9 +134,20 @@ class LlamaAttention(Layer):
             v = v.unsqueeze(3).expand([b, v.shape[1], nkv, rep, hd]) \
                  .reshape([b, v.shape[1], nh, hd])
         causal = cache is None
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=causal,
-                                             training=self.training)
+        if self.cfg.use_flash_attention:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=causal,
+                training=self.training)
+        else:
+            # honor the config switch: plain XLA attention (debug /
+            # numerics-comparison path, reference flag parity)
+            from ..core.autograd import apply as _apply
+            if attn_mask is not None:
+                out = _apply(_ref_attn_fn(causal, True), q, k, v,
+                             attn_mask.detach(), name="attention_ref")
+            else:
+                out = _apply(_ref_attn_fn(causal, False), q, k, v,
+                             name="attention_ref")
         out = out.reshape([b, s, nh * hd])
         out = self.o_proj(out)
         if cache is not None:
@@ -245,6 +264,14 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
         h = self.llama(input_ids, position_ids, attn_mask)
+        if self.cfg.fuse_linear_cross_entropy and self.training:
+            # fused mode: the criterion applies the head chunk-by-chunk
+            # fused with the CE (logits never materialize); eval/predict
+            # still returns real logits below. The explicit marker — not
+            # a shape test — tells the criterion this is hidden, so a
+            # model with hidden_size == vocab_size can't misroute.
+            h._fused_hidden = True
+            return h
         return self.lm_head(h)
 
 
@@ -259,16 +286,32 @@ class _TiedLMHead(Layer):
 
 
 class LlamaPretrainingCriterion(Layer):
-    """Shifted-causal-LM loss (reference: PaddleNLP pretraining criterion)."""
+    """Shifted-causal-LM loss (reference: PaddleNLP pretraining criterion;
+    fused mode = use_fused_linear_cross_entropy)."""
 
-    def __init__(self, cfg: LlamaConfig = None, ignore_index=-100):
+    def __init__(self, cfg: LlamaConfig = None, ignore_index=-100,
+                 lm_head_weight=None):
         super().__init__()
         self.ignore_index = ignore_index
         self.parallel = cfg is not None and cfg.tensor_parallel
+        self.vocab_size = cfg.vocab_size if cfg is not None else None
+        self.fuse = cfg is not None and cfg.fuse_linear_cross_entropy
+        self.chunk = cfg.loss_chunk_size if cfg is not None else 1024
+        # plain object attr: Layer.__setattr__ would register the head
+        # weight as this criterion's own parameter (double-counting it)
+        object.__setattr__(self, "_head_w", lm_head_weight)
         if self.parallel:
             self.pce = ParallelCrossEntropy(ignore_index=ignore_index)
 
+    def bind(self, model):
+        """Grab the LM head weight for fused mode (model built after the
+        criterion, the common construction order)."""
+        object.__setattr__(self, "_head_w", model.lm_head.weight)
+        return self
+
     def forward(self, logits, labels):
+        if self.fuse and getattr(logits, "_fused_hidden", False):
+            return self._fused_loss(logits, labels)
         # logits [B, S, V]; labels [B, S] — predict token t+1
         lg = logits[:, :-1, :]
         lb = labels[:, 1:]
@@ -280,6 +323,80 @@ class LlamaPretrainingCriterion(Layer):
         return F.cross_entropy(
             lg.reshape([-1, lg.shape[-1]]), lb.reshape([-1]),
             ignore_index=self.ignore_index)
+
+    def _fused_loss(self, hidden, labels):
+        """Chunked head-matmul + CE: each sequence chunk's [B,C,V] logits
+        live only inside a jax.checkpoint region (recomputed in backward)
+        — the full [B,S,V] buffer never exists. One-hot masked reduce
+        keeps it GSPMD-partitionable under TP."""
+        if self._head_w is None:
+            raise RuntimeError(
+                "fuse_linear_cross_entropy needs the LM head weight: "
+                "LlamaPretrainingCriterion(cfg).bind(model)")
+        from ..core.autograd import apply as _apply
+        return _apply(_fused_ce_fn(self.ignore_index, self.vocab_size,
+                                   int(self.chunk)),
+                      hidden, self._head_w,
+                      labels.detach().astype("int32"), name="fused_ce")
+
+
+@functools.lru_cache(maxsize=8)
+def _ref_attn_fn(causal, with_mask):
+    """Identity-stable XLA reference attention (use_flash_attention=False)."""
+    from ..core.autograd import mark_stable
+    from ..ops.pallas.flash_attention import _attention_ref
+    if with_mask:
+        return mark_stable(
+            lambda qa, ka, va, ma: _attention_ref(qa, ka, va, mask=ma,
+                                                  causal=causal))
+    return mark_stable(
+        lambda qa, ka, va: _attention_ref(qa, ka, va, causal=causal))
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_ce_fn(ignore, V, C):
+    """Identity-stable (micro-jit cacheable) chunked head+CE kernel."""
+    import jax
+
+    from ..core.autograd import mark_stable
+
+    def f(h, w, lab):
+        hq = h[:, :-1, :]
+        yb = lab[:, 1:]
+        B, Sm, H = hq.shape
+        wv = w if w.shape[-1] == V else w.T  # tied head is [V,H]
+        c = min(C, Sm)
+        n = Sm // c
+
+        def chunk_loss(h_c, y_c):
+            lg = jnp.einsum(
+                "bch,hv->bcv", h_c, wv,
+                preferred_element_type=jnp.float32)
+            lsm = jax.nn.log_softmax(lg, axis=-1)
+            safe = jnp.where(y_c == ignore, 0, y_c)
+            oh = jax.nn.one_hot(safe, V, dtype=lsm.dtype)
+            nll = -(oh * lsm).sum(-1)
+            m = (y_c != ignore).astype(jnp.float32)
+            return (nll * m).sum(), m.sum()
+
+        ck = jax.checkpoint(chunk_loss)
+
+        def body(carry, xs):
+            s_, c_ = ck(*xs)
+            return (carry[0] + s_, carry[1] + c_), None
+
+        xs = (jnp.moveaxis(
+                  hq[:, :n * c, :].reshape(B, n, c, H), 1, 0),
+              jnp.moveaxis(yb[:, :n * c].reshape(B, n, c), 1, 0))
+        (tot, cnt), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0)), xs)
+        if Sm > n * c:  # uneven tail chunk
+            s_, c_ = ck(hq[:, n * c:, :], yb[:, n * c:])
+            tot = tot + s_
+            cnt = cnt + c_
+        return tot / jnp.maximum(cnt, 1.0)
+
+    return mark_stable(f)
 
 
 class _LlamaPipeEmbed(Layer):
@@ -332,6 +449,11 @@ def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages=None,
     if cfg.tie_word_embeddings:
         raise NotImplementedError(
             "tie_word_embeddings is not supported in the pipeline form")
+    if cfg.fuse_linear_cross_entropy:
+        raise NotImplementedError(
+            "fuse_linear_cross_entropy is not supported in the pipeline "
+            "form yet — the pipe head materializes logits, which would "
+            "silently defeat the flag's purpose")
     return PipelineLayer(
         layers=[_LlamaPipeEmbed(cfg)] +
                [LayerDesc(LlamaDecoderLayer, cfg)

@@ -167,3 +167,72 @@ class TestBert:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
+
+
+class TestFusedLinearCrossEntropy:
+    def test_fused_loss_and_grads_match_unfused(self):
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+        P.seed(0)
+        base = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64)
+        cfgF = LlamaConfig(**base, fuse_linear_cross_entropy=True,
+                           loss_chunk_size=16)
+        cfgU = LlamaConfig(**base)
+        mF = LlamaForCausalLM(cfgF)
+        snap = {n: p.numpy().copy() for n, p in mF.named_parameters()}
+        P.seed(0)
+        mU = LlamaForCausalLM(cfgU)
+        mU.set_state_dict({n: P.to_tensor(a) for n, a in snap.items()})
+
+        critF = LlamaPretrainingCriterion(cfgF).bind(mF)
+        critU = LlamaPretrainingCriterion(cfgU)
+        ids = P.to_tensor(np.random.default_rng(0).integers(
+            0, 128, (2, 40)).astype(np.int32))  # 39 = 2*16 + 7 tail
+
+        lF = critF(mF(ids), ids)
+        lU = critU(mU(ids), ids)
+        assert np.allclose(lF.numpy(), lU.numpy(), rtol=1e-5), \
+            (lF.numpy(), lU.numpy())
+
+        lF.backward()
+        lU.backward()
+        for (n, pF), (_, pU) in zip(mF.named_parameters(),
+                                    mU.named_parameters()):
+            gF = pF.grad.numpy() if pF.grad is not None else None
+            gU = pU.grad.numpy() if pU.grad is not None else None
+            assert (gF is None) == (gU is None), n
+            if gF is not None:
+                assert np.allclose(gF, gU, rtol=1e-4, atol=1e-5), n
+
+    def test_fused_eval_still_returns_logits(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        P.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=4,
+                          max_position_embeddings=32,
+                          fuse_linear_cross_entropy=True)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = P.to_tensor(np.zeros((1, 8), np.int32))
+        out = m(ids)
+        assert out.shape[-1] == 128
+
+    def test_no_flash_matches_flash(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        base = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=4,
+                    max_position_embeddings=32)
+        P.seed(0)
+        mF = LlamaForCausalLM(LlamaConfig(**base))
+        snap = {n: p.numpy().copy() for n, p in mF.named_parameters()}
+        P.seed(0)
+        mN = LlamaForCausalLM(LlamaConfig(**base,
+                                          use_flash_attention=False))
+        mN.set_state_dict({n: P.to_tensor(a) for n, a in snap.items()})
+        ids = P.to_tensor(np.random.default_rng(1).integers(
+            0, 64, (2, 16)).astype(np.int32))
+        np.testing.assert_allclose(mF(ids).numpy(), mN(ids).numpy(),
+                                   rtol=1e-4, atol=1e-5)
