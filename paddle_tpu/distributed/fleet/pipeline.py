@@ -549,11 +549,14 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
                      for g, ps in zip(g_blk, blk_specs)]
 
         new_pre, new_pre_st = opt._fused_apply(list(pre), g_pre,
-                                               list(pre_st), lr, step_i)
+                                               list(pre_st), lr, step_i,
+                                               use_pallas=False)
         new_post, new_post_st = opt._fused_apply(list(post), g_post,
-                                                 list(post_st), lr, step_i)
+                                                 list(post_st), lr, step_i,
+                                                 use_pallas=False)
         new_blk, new_blk_st = opt._fused_apply(list(blk), g_blk,
-                                               list(blk_st), lr, step_i)
+                                               list(blk_st), lr, step_i,
+                                               use_pallas=False)
         return (loss_v, new_pre, new_post, new_blk, new_pre_st,
                 new_post_st, new_blk_st)
 

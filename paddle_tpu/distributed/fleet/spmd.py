@@ -234,7 +234,8 @@ class SPMDTrainer:
                     grads = [g._data for _, g in pg]
 
                 new_params, new_states = opt._fused_apply(
-                    list(params), grads, list(states), lr, step_i)
+                    list(params), grads, list(states), lr, step_i,
+                    use_pallas=False)
                 return loss_v, new_buf, new_params, new_states, new_gacc
             finally:
                 _random.pop_trace_key()

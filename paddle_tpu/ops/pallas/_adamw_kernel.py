@@ -1,0 +1,133 @@
+"""Pallas TPU fused AdamW update kernel.
+
+Reference parity: the multi-tensor fused `adamw_kernel` (upstream
+paddle/phi/kernels/gpu/adamw_kernel.cu — unverified, SURVEY.md §2.1
+"adamw_kernel incl. multi-tensor").
+
+TPU-native design: the whole AdamW update for one parameter leaf runs in
+ONE HBM pass — read {grad, master, m1, m2}, write {param, master, m1, m2}
+— with the bf16→f32/f32→bf16 master-weight casts fused into the same
+vector loop instead of standalone convert fusions (PERF.md measured ~7%
+of device step time in convert/copy/bitcast traffic). The bf16 param is
+WRITE-ONLY: the f32 master is the source of truth, so the kernel never
+reads the low-precision copy.
+
+Layout: the leaf is viewed as [size // 128, 128] (lane-minor); the grid
+blocks over rows. Leaves whose size is not lane-divisible fall back to
+the XLA update (optimizer/optimizer.py keeps that path).
+
+Scalar arguments (lr and the step-dependent bias corrections) ride in
+SMEM so scheduler ticks don't recompile or touch VMEM tiles.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_BLOCK_ROWS = 512  # 512×128 f32 tile = 256 KiB per ref; ≤8 refs ≈ 2 MiB VMEM
+
+
+def _adamw_kernel(sc_ref, g_ref, mw_ref, m1_ref, m2_ref, *outs,
+                  b1, b2, eps, wd, decoupled, has_master):
+    if has_master:
+        p_out, mw_out, m1_out, m2_out = outs
+    else:
+        p_out, m1_out, m2_out = outs
+        mw_out = None
+    lr = sc_ref[0, 0]
+    bc1 = sc_ref[0, 1]        # 1 - b1**step
+    sbc2 = sc_ref[0, 2]       # sqrt(1 - b2**step)
+    g = g_ref[...].astype(jnp.float32)
+    p = mw_ref[...]
+    if wd and not decoupled:
+        g = g + wd * p
+    m1 = b1 * m1_ref[...] + (1.0 - b1) * g
+    m2 = b2 * m2_ref[...] + (1.0 - b2) * g * g
+    # m_hat/(sqrt(v_hat)+eps) with v_hat=m2/bc2 == (m1/bc1)/(sqrt(m2)/sbc2+eps)
+    upd = (m1 / bc1) / (jnp.sqrt(m2) / sbc2 + eps)
+    if wd and decoupled:
+        upd = upd + wd * p
+    new = p - lr * upd
+    if mw_out is not None:
+        mw_out[...] = new
+    p_out[...] = new.astype(p_out.dtype)
+    m1_out[...] = m1
+    m2_out[...] = m2
+
+
+def adamw_eligible(shape, dtype, state) -> bool:
+    n = 1
+    for s in shape:
+        n *= s
+    return (n % LANES == 0 and n > 0 and
+            "moment1" in state and "moment2" in state and
+            "moment2_max" not in state)
+
+
+def adamw_update(param, grad, state, lr, step, *, b1, b2, eps, wd,
+                 decoupled, interpret=None):
+    """One fused-Pallas AdamW step for one leaf.
+
+    param: the model-dtype array (bf16 under AMP-O2; only written).
+    state: {"moment1", "moment2"[, "master"]} f32 arrays.
+    Returns (new_param, new_state) exactly like Optimizer._update.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    master = state.get("master")
+    src = master if master is not None else param.astype(jnp.float32)
+    n = param.size
+    rows = n // LANES
+    br = min(rows, _BLOCK_ROWS)
+
+    stepf = step.astype(jnp.float32)
+    scalars = jnp.stack([
+        lr.astype(jnp.float32) if hasattr(lr, "astype")
+        else jnp.asarray(lr, jnp.float32),
+        1.0 - b1 ** stepf,
+        jnp.sqrt(1.0 - b2 ** stepf),
+    ]).reshape(1, 3)
+
+    view = lambda a: a.reshape(rows, LANES)
+    vec = pl.BlockSpec((br, LANES), lambda i: (i, 0))
+    smem = pl.BlockSpec((1, 3), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+    out_shape = [jax.ShapeDtypeStruct((rows, LANES), param.dtype)]
+    out_specs = [vec]
+    has_master = master is not None
+    if has_master:
+        out_shape.append(jax.ShapeDtypeStruct((rows, LANES), jnp.float32))
+        out_specs.append(vec)
+    out_shape += [jax.ShapeDtypeStruct((rows, LANES), jnp.float32)] * 2
+    out_specs += [vec, vec]
+
+    kernel = functools.partial(_adamw_kernel, b1=float(b1), b2=float(b2),
+                               eps=float(eps), wd=float(wd),
+                               decoupled=bool(decoupled),
+                               has_master=has_master)
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[smem, vec, vec, vec, vec],
+        out_specs=out_specs,
+        interpret=interpret,
+    )(scalars, view(grad), view(src),
+      view(state["moment1"]), view(state["moment2"]))
+
+    shp = param.shape
+    new_p = res[0].reshape(shp)
+    i = 1
+    new_state = {}
+    if has_master:
+        new_state["master"] = res[1].reshape(shp)
+        i = 2
+    new_state["moment1"] = res[i].reshape(shp)
+    new_state["moment2"] = res[i + 1].reshape(shp)
+    return new_p, new_state

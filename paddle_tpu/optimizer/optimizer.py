@@ -112,7 +112,10 @@ class Optimizer:
         raise NotImplementedError
 
     # -- fused pytree apply --------------------------------------------------
-    def _fused_apply(self, params, grads, states, lr, step):
+    def _fused_apply(self, params, grads, states, lr, step,
+                     use_pallas=None):
+        # use_pallas is consumed by optimizers with a Pallas fast path
+        # (Adam/AdamW); the base XLA-fused update ignores it.
         hp = self._hyperparams()
         new_params, new_states = [], []
         for p, g, s in zip(params, grads, states):

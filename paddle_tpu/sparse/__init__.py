@@ -1,9 +1,13 @@
 """paddle_tpu.sparse (reference: paddle.sparse COO/CSR ops — upstream
 paddle/phi/kernels/sparse/, unverified; see SURVEY.md §2.1).
 
-TPU-native: wraps jax.experimental.sparse BCOO (TPU-supported sparse
-format). Coverage is the core creation/convert/elementwise/matmul surface;
-sparse convs are out of the TPU north-star path (documented gap).
+TPU-native design: COO wraps jax.experimental.sparse BCOO and CSR wraps
+BCSR — the two formats XLA can lower sparse contractions for. Zero-
+preserving unary math runs on the value buffer only (no densification);
+`add`/`multiply` are sparse-native (index concatenation + duplicate
+summing / pattern intersection); `masked_matmul` is the SDDMM primitive
+`bcoo_dot_general_sampled` (the reference's paddle.sparse.masked_matmul).
+Sparse NN layers live in `paddle_tpu.sparse.nn`.
 """
 from __future__ import annotations
 
@@ -16,8 +20,15 @@ from jax.experimental import sparse as jsparse
 from ..core.tensor import Tensor
 from ..ops._base import ensure_tensor
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "matmul", "add", "multiply", "relu"]
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "matmul", "masked_matmul", "mv", "addmm",
+    "add", "subtract", "multiply", "divide", "relu",
+    "sin", "tan", "asin", "atan", "sinh", "asinh", "tanh", "atanh",
+    "sqrt", "square", "log1p", "abs", "expm1", "neg", "pow", "cast",
+    "transpose", "reshape", "coalesce", "is_same_shape", "sum",
+    "softmax", "nn",
+]
 
 
 class SparseCooTensor:
@@ -30,6 +41,10 @@ class SparseCooTensor:
     def shape(self):
         return list(self._bcoo.shape)
 
+    @property
+    def dtype(self):
+        return self._bcoo.data.dtype
+
     def indices(self):
         return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
 
@@ -39,18 +54,78 @@ class SparseCooTensor:
     def to_dense(self):
         return Tensor(self._bcoo.todense())
 
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sort_indices(self._bcoo.sum_duplicates())))
+
     def nnz(self):
         return self._bcoo.nse
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(
+            jsparse.bcoo_sort_indices(self._bcoo.sum_duplicates()))
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, "
                 f"nnz={self._bcoo.nse})")
 
 
+class SparseCsrTensor:
+    """CSR over jax BCSR (reference: paddle SparseCsrTensor)."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.data.dtype
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def nnz(self):
+        return self._bcsr.nse
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, "
+                f"nnz={self._bcsr.nse})")
+
+
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       stop_gradient=True):
     idx = ensure_tensor(indices)._data
     vals = ensure_tensor(values)._data
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
     idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)  # [nnz, ndim]
     if shape is None:
         shape = tuple(int(m) + 1 for m in jnp.max(idx, axis=0))
@@ -60,37 +135,208 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
                       stop_gradient=True):
-    crows = np.asarray(ensure_tensor(crows)._data)
-    cols = np.asarray(ensure_tensor(cols)._data)
+    crows_a = jnp.asarray(ensure_tensor(crows)._data, jnp.int32)
+    cols_a = jnp.asarray(ensure_tensor(cols)._data, jnp.int32)
     vals = ensure_tensor(values)._data
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    idx = jnp.stack([jnp.asarray(rows, jnp.int32),
-                     jnp.asarray(cols, jnp.int32)], axis=1)
-    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCSR(
+        (vals, cols_a, crows_a), shape=tuple(shape)))
 
+
+def _is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def _rewrap(x, bcoo):
+    """Return a result in x's format."""
+    if isinstance(x, SparseCsrTensor):
+        return SparseCooTensor(bcoo).to_sparse_csr()
+    return SparseCooTensor(bcoo)
+
+
+# -- contractions ------------------------------------------------------------
 
 def matmul(a, b):
+    """sparse @ dense (COO or CSR lhs; reference paddle.sparse.matmul)."""
+    if isinstance(a, SparseCsrTensor):
+        dense = b.to_dense() if _is_sparse(b) else ensure_tensor(b)
+        return Tensor(jsparse.bcsr_dot_general(
+            a._bcsr, dense._data,
+            dimension_numbers=(((len(a.shape) - 1,), (0,)), ((), ()))))
     if isinstance(a, SparseCooTensor):
-        dense = b.to_dense() if isinstance(b, SparseCooTensor) else \
-            ensure_tensor(b)
+        dense = b.to_dense() if _is_sparse(b) else ensure_tensor(b)
         return Tensor(a._bcoo @ dense._data)
-    raise TypeError("sparse.matmul expects a SparseCooTensor lhs")
+    raise TypeError("sparse.matmul expects a sparse lhs")
 
+
+def masked_matmul(x, y, mask):
+    """SDDMM: (x @ y) sampled at `mask`'s sparsity pattern (reference
+    paddle.sparse.masked_matmul → cusparseSDDMM; here XLA's
+    bcoo_dot_general_sampled keeps the product unmaterialized)."""
+    xd = ensure_tensor(x)._data
+    yd = ensure_tensor(y)._data
+    m = _coo(mask)
+    out = jsparse.bcoo_dot_general_sampled(
+        xd, yd, m.indices,
+        dimension_numbers=(((xd.ndim - 1,), (0,)), ((), ())))
+    return _rewrap(mask, jsparse.BCOO((out, m.indices), shape=m.shape))
+
+
+def mv(a, x):
+    """sparse matrix × dense vector."""
+    vec = ensure_tensor(x)._data
+    return Tensor(_coo(a) @ vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta·input + alpha·(x @ y) with a sparse x (reference addmm)."""
+    prod = matmul(x, y)
+    return Tensor(beta * ensure_tensor(input)._data + alpha * prod._data)
+
+
+# -- elementwise binary (sparse-native) --------------------------------------
 
 def add(a, b):
-    return SparseCooTensor(_binary(a, b, jnp.add))
+    # union of patterns: concatenate (values, indices) then merge dups
+    ca, cb = _coo(a), _coo(b)
+    out = jsparse.BCOO(
+        (jnp.concatenate([ca.data, cb.data]),
+         jnp.concatenate([ca.indices, cb.indices])), shape=ca.shape)
+    return _rewrap(a, jsparse.bcoo_sort_indices(out.sum_duplicates(
+        nse=ca.nse + cb.nse)))
 
 
-def _binary(a, b, op):
-    dense = op(a._bcoo.todense(), b._bcoo.todense())
-    return jsparse.BCOO.fromdense(dense)
+def subtract(a, b):
+    cb = _coo(b)
+    return add(a, SparseCooTensor(
+        jsparse.BCOO((-cb.data, cb.indices), shape=cb.shape)))
 
 
 def multiply(a, b):
-    return SparseCooTensor(_binary(a, b, jnp.multiply))
+    return _rewrap(a, jsparse.bcoo_multiply_sparse(_coo(a), _coo(b)))
 
 
-def relu(x):
+def divide(a, b):
+    """Same-pattern value division (the reference's defined case)."""
+    ca, cb = _coo(a).sum_duplicates(), _coo(b).sum_duplicates()
+    ca = jsparse.bcoo_sort_indices(ca)
+    cb = jsparse.bcoo_sort_indices(cb)
+    return _rewrap(a, jsparse.BCOO((ca.data / cb.data, ca.indices),
+                                   shape=ca.shape))
+
+
+# -- zero-preserving unary math (value-buffer only) --------------------------
+
+def _unary(fn):
+    def op(x, *args):
+        if isinstance(x, SparseCsrTensor):
+            b = x._bcsr
+            return SparseCsrTensor(jsparse.BCSR(
+                (fn(b.data, *args), b.indices, b.indptr), shape=b.shape))
+        b = x._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data, *args), b.indices), shape=b.shape))
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+tanh = _unary(jnp.tanh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    return _unary(lambda v: v.astype(vd) if vd is not None else v)(x)
+
+
+# -- structure ops -----------------------------------------------------------
+
+def transpose(x, perm):
+    return _rewrap(x, jsparse.bcoo_transpose(_coo(x), permutation=perm))
+
+
+def reshape(x, shape):
+    return _rewrap(x, jsparse.bcoo_reshape(_coo(x), new_sizes=tuple(shape)))
+
+
+def coalesce(x):
     return SparseCooTensor(
-        jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
-                     shape=x._bcoo.shape))
+        jsparse.bcoo_sort_indices(_coo(x).sum_duplicates()))
+
+
+def is_same_shape(x, y):
+    xs = x.shape if _is_sparse(x) else list(ensure_tensor(x).shape)
+    ys = y.shape if _is_sparse(y) else list(ensure_tensor(y).shape)
+    return list(xs) == list(ys)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(dtype) if dtype is not None else None
+    if axis is None:
+        out = jnp.sum(_coo(x).data)
+        return Tensor(out.astype(dt) if dt is not None else out)
+    c = _coo(x)
+    if dt is not None:
+        c = jsparse.BCOO((c.data.astype(dt), c.indices), shape=c.shape)
+    nd = len(c.shape)
+    ax = axis if axis >= 0 else axis + nd
+    red = jsparse.bcoo_reduce_sum(c, axes=(ax,))
+    if keepdim:
+        red = jsparse.bcoo_reshape(
+            red, new_sizes=tuple(c.shape[:ax]) + (1,) +
+            tuple(c.shape[ax + 1:]))
+    # CSR is 2-D only; a reduced (1-D) result must stay COO
+    if len(red.shape) < 2:
+        return SparseCooTensor(red)
+    return _rewrap(x, red)
+
+
+def softmax(x, axis=-1):
+    """Row softmax over the sparse pattern (reference: paddle.sparse
+    .nn.functional.softmax — per-row over stored values only).
+
+    Supports 2-D COO/CSR with axis=-1; computed with segment ops keyed by
+    row (no densification).
+    """
+    c = jsparse.bcoo_sort_indices(_coo(x).sum_duplicates())
+    if len(c.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse softmax: 2-D, last axis only")
+    rows = c.indices[:, 0]
+    n = c.shape[0]
+    vals = c.data.astype(jnp.float32)
+    rmax = jax.ops.segment_max(vals, rows, num_segments=n)
+    e = jnp.exp(vals - rmax[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=n)
+    out = (e / denom[rows]).astype(c.data.dtype)
+    return _rewrap(x, jsparse.BCOO((out, c.indices), shape=c.shape))
+
+
+from . import nn  # noqa: E402  (public submodule, after defs it uses)

@@ -1,0 +1,279 @@
+"""paddle_tpu.sparse.nn — sparse NN layers (reference: paddle.sparse.nn
+Conv3D/SubmConv3D/BatchNorm/ReLU/MaxPool3D over gathered GEMMs — upstream
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu etc., unverified; SURVEY.md
+§2.1 "PHI sparse").
+
+TPU-native design note: the reference's gather-GEMM-scatter sparse conv
+builds a rulebook (hash join of input/output coordinates) per kernel
+offset — an inherently dynamic-shape computation that XLA cannot compile
+efficiently (every nnz change would recompile, and scalar scatter loops
+starve the MXU; see SURVEY.md §7 "Dynamic shapes"). On TPU the idiomatic
+lowering for the point-cloud workloads these layers serve is DENSIFY →
+dense XLA conv (MXU-tiled) → re-sparsify against the static structure
+mask. Sparse *semantics* are preserved exactly — SubmConv3D masks output
+sites to the input's active set (the submanifold contract), BatchNorm
+normalizes over active values only — while the compute maps onto the
+MXU. Layout is NDHWC (the reference's sparse conv layout).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, Parameter
+from ..nn.layer import Layer
+from ..nn import initializer as init
+from . import SparseCooTensor, _coo
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv3D", "SubmConv3D",
+           "BatchNorm", "MaxPool3D", "functional"]
+
+
+def _apply_values(x, fn):
+    c = _coo(x)
+    return SparseCooTensor(
+        jsparse.BCOO((fn(c.data), c.indices), shape=c.shape))
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _apply_values(x, lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _apply_values(x, lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = float(negative_slope)
+
+    def forward(self, x):
+        return _apply_values(
+            x, lambda v: jnp.where(v >= 0, v, self._slope * v))
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from . import softmax
+        return softmax(x, axis=self._axis)
+
+
+def _to_tuple3(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _site_layout(c):
+    """Normalize a 5-D NDHWC BCOO to site-major layout: indices [nnz, 4]
+    spatial coords, data [nnz, C] dense channel rows (the natural point-
+    cloud layout; unique sites after the duplicate merge)."""
+    if c.n_dense == 0 and c.indices.shape[-1] == 5:
+        c = jsparse.bcoo_update_layout(c, n_dense=1,
+                                       on_inefficient=None)
+    return jsparse.bcoo_sort_indices(c.sum_duplicates())
+
+
+class _SparseConv3DBase(Layer):
+    """Shared machinery for Conv3D / SubmConv3D (NDHWC)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        assert data_format == "NDHWC", "sparse conv layout is NDHWC"
+        self._in, self._out = int(in_channels), int(out_channels)
+        self._ks = _to_tuple3(kernel_size)
+        self._stride = _to_tuple3(stride)
+        self._padding = _to_tuple3(padding)
+        self._dilation = _to_tuple3(dilation)
+        self._groups = int(groups)
+        kd, kh, kw = self._ks
+        fan_in = self._in * kd * kh * kw
+        w = init.XavierUniform(fan_in=fan_in,
+                               fan_out=self._out * kd * kh * kw)(
+            (kd, kh, kw, self._in // self._groups, self._out), jnp.float32)
+        self.weight = Parameter(w)
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((self._out,), w.dtype))
+        else:
+            self.bias = None
+
+    def _dense_conv(self, dense):
+        # NDHWC × DHWIO → NDHWC
+        out = jax.lax.conv_general_dilated(
+            dense, self.weight._data,
+            window_strides=self._stride,
+            padding=[(p, p) for p in self._padding],
+            rhs_dilation=self._dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            feature_group_count=self._groups)
+        if self.bias is not None:
+            out = out + self.bias._data
+        return out
+
+
+class Conv3D(_SparseConv3DBase):
+    """Standard sparse conv: output sites = conv support (re-sparsified)."""
+
+    def forward(self, x):
+        c = _coo(x)
+        out = self._dense_conv(c.todense())
+        return SparseCooTensor(jsparse.bcoo_fromdense(out))
+
+
+class SubmConv3D(_SparseConv3DBase):
+    """Submanifold conv: output pattern == input pattern (active sites do
+    not dilate through the layers — the defining property the reference's
+    rulebook enforces). Requires stride 1 / 'same' geometry."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=None, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        if padding is None:  # 'same' geometry — the submanifold default
+            padding = tuple((k - 1) // 2 for k in _to_tuple3(kernel_size))
+        if _to_tuple3(stride) != (1, 1, 1):
+            raise ValueError(
+                "SubmConv3D requires stride 1: the submanifold contract "
+                "(output sites == input sites) is undefined under striding")
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        c = _site_layout(_coo(x))
+        out = self._dense_conv(c.todense())
+        # sample the dense result at the INPUT's active sites (indices are
+        # the [nnz, 4] spatial site coords in site-major layout)
+        site_idx = c.indices
+        rows = out[tuple(site_idx[:, i] for i in range(site_idx.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO(
+            (rows, site_idx), shape=tuple(c.shape[:-1]) + (self._out,)))
+
+
+class BatchNorm(Layer):
+    """BatchNorm over ACTIVE values per channel (reference sparse BN)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        self._eps = float(epsilon)
+        self._momentum = float(momentum)
+        self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+        self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        c = _coo(x)
+        vals = c.data  # [nnz, C] (dense trailing channel) or [nnz]
+        if vals.ndim == 1:
+            # channel is a sparse dim: fall back to per-element stats
+            ch = c.indices[:, -1]
+            nC = c.shape[-1]
+            cnt = jax.ops.segment_sum(jnp.ones_like(vals), ch, nC)
+            mean = jax.ops.segment_sum(vals, ch, nC) / jnp.maximum(cnt, 1)
+            var = jax.ops.segment_sum(
+                (vals - mean[ch]) ** 2, ch, nC) / jnp.maximum(cnt, 1)
+            if self.training:
+                m, v = mean, var
+                self._buffers["_mean"]._data = (
+                    self._momentum * self._mean._data +
+                    (1 - self._momentum) * m)
+                self._buffers["_variance"]._data = (
+                    self._momentum * self._variance._data +
+                    (1 - self._momentum) * v)
+            else:
+                m, v = self._mean._data, self._variance._data
+            out = ((vals - m[ch]) / jnp.sqrt(v[ch] + self._eps) *
+                   self.weight._data[ch] + self.bias._data[ch])
+        else:
+            if self.training:
+                m = jnp.mean(vals, axis=0)
+                v = jnp.var(vals, axis=0)
+                self._buffers["_mean"]._data = (
+                    self._momentum * self._mean._data +
+                    (1 - self._momentum) * m)
+                self._buffers["_variance"]._data = (
+                    self._momentum * self._variance._data +
+                    (1 - self._momentum) * v)
+            else:
+                m, v = self._mean._data, self._variance._data
+            out = ((vals - m) / jnp.sqrt(v + self._eps) *
+                   self.weight._data + self.bias._data)
+        return SparseCooTensor(
+            jsparse.BCOO((out.astype(vals.dtype), c.indices),
+                         shape=c.shape))
+
+
+class MaxPool3D(Layer):
+    """Max pool over the dense view (NDHWC), re-sparsified."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self._ks = _to_tuple3(kernel_size)
+        self._stride = _to_tuple3(stride if stride is not None
+                                  else kernel_size)
+        self._padding = _to_tuple3(padding)
+
+    def forward(self, x):
+        c = _site_layout(_coo(x))
+        dense = c.todense()
+        # pool over ACTIVE sites only (reference semantics): inactive
+        # sites must not contribute their structural 0 to the max — a
+        # window whose only active value is negative keeps it. Mark
+        # inactive sites -inf via a scatter of the active-site set.
+        active = jnp.zeros(tuple(c.shape[:-1]), jnp.bool_).at[
+            tuple(c.indices[:, i] for i in range(c.indices.shape[1]))
+        ].set(True)[..., None]
+        neg = jnp.asarray(-jnp.inf, dense.dtype)
+        out = jax.lax.reduce_window(
+            jnp.where(active, dense, neg), neg, jax.lax.max,
+            window_dimensions=(1,) + self._ks + (1,),
+            window_strides=(1,) + self._stride + (1,),
+            padding=((0, 0),) + tuple((p, p) for p in self._padding) +
+            ((0, 0),))
+        out = jnp.where(jnp.isfinite(out), out, 0)
+        return SparseCooTensor(jsparse.bcoo_fromdense(out, n_dense=1))
+
+
+class functional:
+    """paddle.sparse.nn.functional parity handles."""
+
+    @staticmethod
+    def relu(x):
+        from . import relu as _r
+        return _r(x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        from . import softmax as _s
+        return _s(x, axis=axis)
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NDHWC"):
+        c = _coo(x)
+        out = jax.lax.conv_general_dilated(
+            c.todense(), weight._data if hasattr(weight, "_data") else
+            jnp.asarray(weight),
+            window_strides=_to_tuple3(stride),
+            padding=[(p, p) for p in _to_tuple3(padding)],
+            rhs_dilation=_to_tuple3(dilation),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            feature_group_count=groups)
+        if bias is not None:
+            out = out + (bias._data if hasattr(bias, "_data")
+                         else jnp.asarray(bias))
+        return SparseCooTensor(jsparse.bcoo_fromdense(out))

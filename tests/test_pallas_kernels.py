@@ -102,3 +102,99 @@ class TestFlashAttentionBackward:
             a, b_, c, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
         for a, b_ in zip(g1, g2):
             assert np.allclose(np.asarray(a), np.asarray(b_), atol=2e-4)
+
+
+class TestFusedAdamWKernel:
+    """Pallas fused AdamW vs the XLA _update rule (interpret mode)."""
+
+    def _states(self, shape, master_dtype=None, seed=0):
+        rng = np.random.default_rng(seed)
+        f = lambda: jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+        st = {"moment1": f() * 0.1, "moment2": jnp.abs(f()) * 0.01}
+        if master_dtype is not None:
+            st["master"] = f()
+        return st
+
+    @pytest.mark.parametrize("decoupled", [False, True])
+    def test_parity_master_bf16(self, decoupled):
+        from paddle_tpu.ops.pallas._adamw_kernel import adamw_update
+        from paddle_tpu.optimizer.optimizers import Adam
+        shape = (96, 128)
+        st = self._states(shape, master_dtype=jnp.float32)
+        rng = np.random.default_rng(3)
+        g = jnp.asarray(rng.standard_normal(shape).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        p_bf16 = st["master"].astype(jnp.bfloat16)
+        hp = {"b1": 0.9, "b2": 0.999, "eps": 1e-8, "weight_decay": 0.01,
+              "decoupled": decoupled, "amsgrad": False}
+        lr = jnp.asarray(1e-3, jnp.float32)
+        step = jnp.asarray(7, jnp.int32)
+
+        got_p, got_st = adamw_update(
+            p_bf16, g, dict(st), lr, step, b1=hp["b1"], b2=hp["b2"],
+            eps=hp["eps"], wd=hp["weight_decay"],
+            decoupled=decoupled, interpret=True)
+        ref_master, ref_st = Adam._update(
+            st["master"], g.astype(jnp.float32), st, lr, step, hp)
+        assert np.allclose(np.asarray(got_st["master"]),
+                           np.asarray(ref_master), atol=1e-6)
+        assert np.allclose(np.asarray(got_p, np.float32),
+                           np.asarray(ref_master.astype(jnp.bfloat16),
+                                      np.float32), atol=0)
+        for k in ("moment1", "moment2"):
+            assert np.allclose(np.asarray(got_st[k]),
+                               np.asarray(ref_st[k]), atol=1e-6), k
+
+    def test_parity_f32_no_master_uneven_grid(self):
+        from paddle_tpu.ops.pallas._adamw_kernel import (adamw_update,
+                                                         _BLOCK_ROWS)
+        from paddle_tpu.optimizer.optimizers import Adam
+        # rows = 600 does not divide _BLOCK_ROWS=512 -> exercises the
+        # masked final block
+        shape = (600, 128)
+        assert shape[0] % _BLOCK_ROWS != 0
+        st = self._states(shape)
+        p = jnp.asarray(np.random.default_rng(5).standard_normal(
+            shape).astype(np.float32))
+        g = jnp.asarray(np.random.default_rng(6).standard_normal(
+            shape).astype(np.float32))
+        hp = {"b1": 0.9, "b2": 0.999, "eps": 1e-8, "weight_decay": 0.0,
+              "decoupled": True, "amsgrad": False}
+        lr = jnp.asarray(3e-4, jnp.float32)
+        step = jnp.asarray(1, jnp.int32)
+        got_p, got_st = adamw_update(p, g, dict(st), lr, step, b1=0.9,
+                                     b2=0.999, eps=1e-8, wd=0.0,
+                                     decoupled=True, interpret=True)
+        ref_p, ref_st = Adam._update(p, g, st, lr, step, hp)
+        assert np.allclose(np.asarray(got_p), np.asarray(ref_p), atol=1e-6)
+        for k in ("moment1", "moment2"):
+            assert np.allclose(np.asarray(got_st[k]),
+                               np.asarray(ref_st[k]), atol=1e-6), k
+
+    def test_eligibility(self):
+        from paddle_tpu.ops.pallas._adamw_kernel import adamw_eligible
+        st = {"moment1": 1, "moment2": 1}
+        assert adamw_eligible((256, 128), jnp.bfloat16, st)
+        assert adamw_eligible((2048,), jnp.float32, st)
+        assert not adamw_eligible((100,), jnp.float32, st)   # not lane-div
+        assert not adamw_eligible((256, 128), jnp.float32,
+                                  dict(st, moment2_max=1))   # amsgrad
+
+    def test_optimizer_fused_apply_pallas_route(self):
+        """AdamW._fused_apply(use_pallas=True) == the XLA route."""
+        import paddle_tpu as P
+        lin = P.nn.Linear(128, 64)
+        opt = P.optimizer.AdamW(1e-3, parameters=lin.parameters())
+        params = [p._data for p in lin.parameters()]
+        grads = [jnp.ones_like(p) * 0.01 for p in params]
+        states = [opt._get_state(p) for p in lin.parameters()]
+        lr = jnp.asarray(1e-3, jnp.float32)
+        step = jnp.asarray(1, jnp.int32)
+        got_p, got_st = opt._fused_apply(list(params), grads,
+                                         [dict(s) for s in states],
+                                         lr, step, use_pallas=True)
+        ref_p, ref_st = opt._fused_apply(list(params), grads,
+                                         [dict(s) for s in states],
+                                         lr, step, use_pallas=False)
+        for a, b in zip(got_p, ref_p):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -1,0 +1,223 @@
+"""paddle_tpu.sparse — COO/CSR surface, ops, and sparse NN layers.
+
+Oracle pattern per SURVEY.md §4: NumPy/dense references.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from paddle_tpu import sparse
+
+
+def coo2x3():
+    # [[0, 1, 0], [2, 0, 3]]
+    return sparse.sparse_coo_tensor(
+        [[0, 1, 1], [1, 0, 2]], [1.0, 2.0, 3.0], shape=[2, 3])
+
+
+def dense(x):
+    return np.asarray(x.to_dense()._data if hasattr(x, "to_dense")
+                      else x._data)
+
+
+class TestFormats:
+    def test_coo_roundtrip(self):
+        s = coo2x3()
+        assert s.nnz() == 3 and s.shape == [2, 3]
+        np.testing.assert_allclose(dense(s),
+                                   [[0, 1, 0], [2, 0, 3]])
+
+    def test_coo_to_csr_and_back(self):
+        s = coo2x3().to_sparse_csr()
+        assert s.is_sparse_csr()
+        np.testing.assert_array_equal(np.asarray(s.crows()._data),
+                                      [0, 1, 3])
+        np.testing.assert_array_equal(np.asarray(s.cols()._data),
+                                      [1, 0, 2])
+        np.testing.assert_allclose(dense(s), [[0, 1, 0], [2, 0, 3]])
+        back = s.to_sparse_coo()
+        assert back.is_sparse_coo()
+        np.testing.assert_allclose(dense(back), [[0, 1, 0], [2, 0, 3]])
+
+    def test_csr_ctor(self):
+        s = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [1.0, 2.0, 3.0],
+                                     [2, 3])
+        np.testing.assert_allclose(dense(s), [[0, 1, 0], [2, 0, 3]])
+
+    def test_coalesce(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 5.0],
+                                     shape=[2, 3])
+        c = s.coalesce()
+        assert float(np.asarray(c.values()._data)[0]) == 6.0
+
+
+class TestOps:
+    def test_matmul_coo_and_csr(self):
+        rng = np.random.default_rng(0)
+        d = rng.standard_normal((3, 4)).astype(np.float32)
+        ref = dense(coo2x3()) @ d
+        np.testing.assert_allclose(
+            np.asarray(sparse.matmul(coo2x3(), P.to_tensor(d))._data),
+            ref, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sparse.matmul(coo2x3().to_sparse_csr(),
+                                     P.to_tensor(d))._data),
+            ref, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 5)).astype(np.float32)
+        y = rng.standard_normal((5, 3)).astype(np.float32)
+        mask = coo2x3()
+        out = sparse.masked_matmul(P.to_tensor(x), P.to_tensor(y), mask)
+        ref = (x @ y) * (dense(mask) != 0)
+        np.testing.assert_allclose(dense(out), ref, atol=1e-5)
+
+    def test_mv_addmm(self):
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.mv(coo2x3(), P.to_tensor(v))._data),
+            dense(coo2x3()) @ v, atol=1e-5)
+        inp = np.ones((2, 2), np.float32)
+        y = np.ones((3, 2), np.float32)
+        out = sparse.addmm(P.to_tensor(inp), coo2x3(), P.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        ref = 0.5 * inp + 2.0 * (dense(coo2x3()) @ y)
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+
+    def test_add_subtract_multiply_divide(self):
+        a, b = coo2x3(), coo2x3()
+        np.testing.assert_allclose(dense(sparse.add(a, b)),
+                                   2 * dense(a))
+        np.testing.assert_allclose(dense(sparse.subtract(a, b)),
+                                   0 * dense(a))
+        np.testing.assert_allclose(dense(sparse.multiply(a, b)),
+                                   dense(a) ** 2)
+        np.testing.assert_allclose(dense(sparse.divide(a, b)),
+                                   (dense(a) != 0).astype(np.float32))
+
+    def test_unary_value_ops(self):
+        s = coo2x3()
+        np.testing.assert_allclose(dense(sparse.sin(s)),
+                                   np.sin(dense(s)) * (dense(s) != 0),
+                                   atol=1e-6)
+        np.testing.assert_allclose(dense(sparse.square(s)), dense(s) ** 2)
+        np.testing.assert_allclose(dense(sparse.neg(s)), -dense(s))
+        np.testing.assert_allclose(dense(sparse.pow(s, 3)), dense(s) ** 3)
+        out = sparse.cast(s, value_dtype="float16")
+        assert str(out.values()._data.dtype) == "float16"
+
+    def test_structure_ops(self):
+        s = coo2x3()
+        np.testing.assert_allclose(dense(sparse.transpose(s, [1, 0])),
+                                   dense(s).T)
+        np.testing.assert_allclose(dense(sparse.reshape(s, [3, 2])),
+                                   dense(s).reshape(3, 2))
+        assert sparse.is_same_shape(s, s)
+        assert float(np.asarray(sparse.sum(s)._data)) == 6.0
+        np.testing.assert_allclose(dense(sparse.sum(s, axis=1)),
+                                   dense(s).sum(1))
+
+    def test_softmax(self):
+        s = coo2x3()
+        out = sparse.softmax(s)
+        d = dense(s)
+        # per-row softmax over STORED values only
+        ref = np.zeros_like(d)
+        for i in range(2):
+            nz = d[i] != 0
+            e = np.exp(d[i][nz] - d[i][nz].max())
+            ref[i][nz] = e / e.sum()
+        np.testing.assert_allclose(dense(out), ref, atol=1e-6)
+
+
+class TestSparseNN:
+    def _pc(self, seed=0, n=2, d=6, h=6, w=6, c=4, nnz=20):
+        """Random point-cloud NDHWC sparse tensor (site-major)."""
+        rng = np.random.default_rng(seed)
+        sites = np.stack([rng.integers(0, n, nnz), rng.integers(0, d, nnz),
+                          rng.integers(0, h, nnz),
+                          rng.integers(0, w, nnz)], axis=1)
+        sites = np.unique(sites, axis=0)
+        vals = rng.standard_normal((len(sites), c)).astype(np.float32)
+        from jax.experimental import sparse as jsparse
+        b = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(sites)),
+                         shape=(n, d, h, w, c))
+        return sparse.SparseCooTensor(b)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        x = self._pc()
+        conv = sparse.nn.SubmConv3D(4, 8, kernel_size=3)
+        y = conv(x)
+        assert y.shape[-1] == 8
+        # submanifold contract: active sites unchanged
+        xd, yd = dense(x), dense(y)
+        x_sites = np.any(xd != 0, axis=-1)
+        y_sites = np.any(yd != 0, axis=-1)
+        assert (y_sites & ~x_sites).sum() == 0
+
+    def test_subm_conv3d_matches_masked_dense_conv(self):
+        import jax
+        x = self._pc(seed=3)
+        conv = sparse.nn.SubmConv3D(4, 5, kernel_size=3)
+        y = conv(x)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(dense(x)), conv.weight._data, (1, 1, 1),
+            [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        ref = np.asarray(ref + conv.bias._data)
+        mask = np.any(dense(x) != 0, axis=-1, keepdims=True)
+        np.testing.assert_allclose(dense(y), ref * mask, atol=1e-4)
+
+    def test_conv3d_runs(self):
+        x = self._pc(seed=4)
+        conv = sparse.nn.Conv3D(4, 8, kernel_size=2, stride=2)
+        y = conv(x)
+        assert y.shape == [2, 3, 3, 3, 8]
+
+    def test_batchnorm_active_values(self):
+        x = self._pc(seed=5)
+        bn = sparse.nn.BatchNorm(4)
+        y = bn(x)
+        vals = np.asarray(y.values()._data)
+        # active values normalized per channel
+        np.testing.assert_allclose(vals.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(vals.std(0), 1, atol=1e-2)
+
+    def test_relu_maxpool(self):
+        x = self._pc(seed=6)
+        y = sparse.nn.ReLU()(x)
+        assert (np.asarray(y.values()._data) >= 0).all()
+        p = sparse.nn.MaxPool3D(kernel_size=2, stride=2)(y)
+        ref = np.asarray(dense(y)).reshape(2, 3, 2, 3, 2, 3, 2, 4).max(
+            (2, 4, 6))
+        np.testing.assert_allclose(dense(p), np.maximum(ref, 0), atol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_csr_sum_axis_returns_coo(self):
+        s = coo2x3().to_sparse_csr()
+        out = sparse.sum(s, axis=1)
+        assert out.is_sparse_coo()
+        np.testing.assert_allclose(dense(out), dense(s).sum(1))
+
+    def test_sum_dtype_with_axis(self):
+        out = sparse.sum(coo2x3(), axis=1, dtype="float16")
+        assert str(out.values()._data.dtype) == "float16"
+
+    def test_subm_conv_positional_args(self):
+        conv = sparse.nn.SubmConv3D(4, 8, 3, 1, 1)
+        assert conv._padding == (1, 1, 1)
+        with pytest.raises(ValueError):
+            sparse.nn.SubmConv3D(4, 8, 3, stride=2)
+
+    def test_maxpool_keeps_negative_active_values(self):
+        from jax.experimental import sparse as jsparse
+        # one active site with value -5; window contains only it
+        b = jsparse.BCOO(
+            (jnp.asarray([[-5.0]]), jnp.asarray([[0, 0, 0, 0]])),
+            shape=(1, 2, 2, 2, 1))
+        x = sparse.SparseCooTensor(b)
+        y = sparse.nn.MaxPool3D(kernel_size=2, stride=2)(x)
+        np.testing.assert_allclose(dense(y).reshape(-1), [-5.0])
