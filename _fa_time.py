@@ -1,0 +1,24 @@
+import time, json
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas._fa_kernel import fa_forward, fa_backward
+
+def t(f, n=10):
+    f()  # compile
+    jax.block_until_ready(f())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f()
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n * 1000
+
+for b, s, h in [(16, 1024, 16), (4, 2048, 16), (1, 8192, 16)]:
+    d = 128
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16) for _ in range(3))
+    g = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    fwd = jax.jit(lambda q, k, v: fa_forward(q, k, v, causal=True, return_lse=True))
+    out, lse = fwd(q, k, v)
+    bwd = jax.jit(lambda: fa_backward(q, k, v, out, lse, g, causal=True))
+    print(json.dumps({"b": b, "s": s, "fwd_ms": round(t(lambda: fwd(q, k, v)[0]), 2),
+                      "bwd_ms": round(t(bwd), 2)}))

@@ -1,0 +1,45 @@
+"""Long-sequence single-chip bench: LLaMA proxy (h2048 L8) at s=8192
+with recompute + fused linear-cross-entropy.
+
+Usage: python bench_longseq.py [batch] [seq] [recompute] [fuse_ce]
+Prints one JSON line. Results log: PERF.md (round-2 table).
+"""
+import sys, time, json
+import numpy as np
+
+batch = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+recompute = (sys.argv[3] != "0") if len(sys.argv) > 3 else True
+fuse = (sys.argv[4] != "0") if len(sys.argv) > 4 else True
+
+import jax
+import paddle_tpu as P
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                               LlamaPretrainingCriterion, flops_per_token)
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                  num_hidden_layers=8, num_attention_heads=16,
+                  max_position_embeddings=seq, recompute=recompute,
+                  fuse_linear_cross_entropy=fuse, dtype="bfloat16")
+P.seed(0)
+model = LlamaForCausalLM(cfg); model.to(dtype="bfloat16")
+crit = LlamaPretrainingCriterion(cfg)
+if fuse:
+    crit.bind(model)
+opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(), multi_precision=True)
+m = P.Model(model); m.prepare(opt, crit)
+ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+x = P.to_tensor(ids)
+m.train_batch([x], [x]); m.train_batch([x], [x]); jax.effects_barrier()
+iters = 8
+t0 = time.perf_counter()
+for _ in range(iters):
+    loss = m.train_batch([x], [x])
+import jax.numpy as jnp
+jnp.zeros(()).block_until_ready()
+dt = time.perf_counter() - t0
+tok_s = batch * seq * iters / dt
+mfu = tok_s * flops_per_token(cfg, seq) / 197e12
+print(json.dumps({"batch": batch, "seq": seq, "recompute": recompute,
+                  "fuse_ce": fuse, "tok_s": round(tok_s, 1),
+                  "mfu": round(mfu, 4), "loss": float(loss)}))

@@ -131,31 +131,6 @@ def _mj_bwd(fn, args, multi, cots):
     return vjp_fn(tuple(cots) if multi else cots[0])
 
 
-def _ad_tracer_types():
-    global _AD_TRACERS
-    if _AD_TRACERS is None:
-        try:
-            from jax._src.interpreters import ad as _ad
-            _AD_TRACERS = tuple(
-                t for t in (getattr(_ad, "JVPTracer", None),
-                            getattr(_ad, "LinearizeTracer", None))
-                if t is not None)
-        except ImportError:  # jax internals moved — fail safe to tape
-            _AD_TRACERS = ()
-    return _AD_TRACERS
-
-
-_AD_TRACERS = None
-
-
-def _under_outer_ad(arrs) -> bool:
-    """True when any arg is a JVP/linearize tracer — i.e. an enclosing
-    jax AD transform (value_and_grad in a compiled stepper) is
-    differentiating this code."""
-    kinds = _ad_tracer_types()
-    return bool(kinds) and any(isinstance(a, kinds) for a in arrs)
-
-
 def _is_stable(fn) -> bool:
     if getattr(fn, "_pt_stable", False):
         return True
@@ -185,20 +160,26 @@ def apply(fn, *tensors, name: str = ""):
     from .tensor import Tensor
 
     arrs = tuple(t._data for t in tensors)
-    microjit = _MICROJIT and _is_stable(fn) and \
-        not any(isinstance(a, jax.core.Tracer) for a in arrs)
+    traced = any(isinstance(a, jax.core.Tracer) for a in arrs)
+    microjit = _MICROJIT and _is_stable(fn) and not traced
     needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
-    if needs_grad and _under_outer_ad(arrs):
-        # An OUTER jax transform (the compiled steppers' value_and_grad)
-        # owns differentiation here. Eagerly calling jax.vjp at JVP
-        # tracers would be a second-order linearization that (a) cannot
-        # see custom_vjp rules from inside the replayed jaxpr, silently
-        # knocking Pallas kernels down to their XLA fallback, and (b)
-        # bloats the traced program. Run fn plainly — the outer AD
-        # differentiates it with every custom_vjp rule intact — but keep
-        # a LAZY tape node (fn only), so an inner paddle.grad/backward
-        # inside the traced loss (gradient penalties) still works via
-        # the lazy-vjp path.
+    if needs_grad and traced:
+        # An OUTER jax transform owns differentiation here — either an
+        # enclosing AD transform (the compiled steppers' value_and_grad,
+        # detected by JVP/linearize tracers) or ANY enclosing trace
+        # (jit / to_static / jax.checkpoint body staging, detected by
+        # plain tracers: if grads are wanted for traced values, a jax
+        # transform outside the trace will derive them). Eagerly calling
+        # jax.vjp at tracers would be a second-order linearization that
+        # (a) cannot see custom_vjp rules from inside the replayed jaxpr,
+        # silently knocking Pallas kernels down to their XLA fallback —
+        # inside a jax.checkpoint body this plants a bare pallas_call in
+        # the remat jaxpr, which crashes the outer AD's jvp replay —
+        # and (b) bloats the traced program. Run fn plainly — the outer
+        # AD differentiates it with every custom_vjp rule intact — but
+        # keep a LAZY tape node (fn only), so an inner
+        # paddle.grad/backward inside the traced loss (gradient
+        # penalties) still works via the lazy-vjp path.
         out = fn(*arrs)
         node = TapeNode(tensors, None, isinstance(out, (tuple, list)),
                         name=name, fn=fn)

@@ -17,7 +17,12 @@ from ..ops._base import ensure_tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel",
-           "Laplace", "LogNormal", "Multinomial", "Poisson", "kl_divergence"]
+           "Laplace", "LogNormal", "Multinomial", "Poisson", "Cauchy",
+           "Chi2", "Geometric", "StudentT", "MultivariateNormal",
+           "Independent", "TransformedDistribution", "Transform",
+           "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
+           "StickBreakingTransform", "ChainTransform", "kl_divergence"]
 
 
 class Distribution:
@@ -294,3 +299,308 @@ def kl_divergence(p, q):
             p.logits, q.logits, name="kl_categorical")
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale, ref=self.loc)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.loc.shape)
+        return apply(lambda m, s: m + s * jrandom.cauchy(k, shp),
+                     self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+        return apply(
+            lambda v, m, s: -jnp.log(math.pi * s *
+                                     (1 + ((v - m) / s) ** 2)),
+            value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: jnp.log(4 * math.pi * s), self.scale)
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        self.df = ensure_tensor(df)
+        super().__init__(self.df * 0.5, 0.5)
+
+
+class Geometric(Distribution):
+    """P(X = k) = (1-p)^k p, k = 0, 1, ... (failures before success)."""
+
+    def __init__(self, probs):
+        self.probs_t = ensure_tensor(probs)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.probs_t.shape)
+        return apply(
+            lambda p: jnp.floor(
+                jnp.log1p(-jrandom.uniform(k, shp)) /
+                jnp.log1p(-jnp.clip(p, 1e-12, 1 - 1e-7))),
+            self.probs_t)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.probs_t)
+        return apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                     value, self.probs_t)
+
+    def entropy(self):
+        return apply(
+            lambda p: (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p,
+            self.probs_t)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = ensure_tensor(df)
+        self.loc = ensure_tensor(loc, ref=self.df)
+        self.scale = ensure_tensor(scale, ref=self.df)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape),
+            tuple(self.scale.shape)))
+        return apply(lambda df, m, s: m + s * jrandom.t(k, df, shp),
+                     self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+
+        def lp(v, df, m, s):
+            z = (v - m) / s
+            return (jax.scipy.special.gammaln((df + 1) / 2) -
+                    jax.scipy.special.gammaln(df / 2) -
+                    0.5 * jnp.log(df * math.pi) - jnp.log(s) -
+                    (df + 1) / 2 * jnp.log1p(z * z / df))
+        return apply(lp, value, self.df, self.loc, self.scale)
+
+
+class MultivariateNormal(Distribution):
+    """Full-covariance MVN (loc [d], covariance_matrix [d, d])."""
+
+    def __init__(self, loc, covariance_matrix):
+        self.loc = ensure_tensor(loc)
+        self.cov = ensure_tensor(covariance_matrix, ref=self.loc)
+
+    def sample(self, shape=()):
+        k = next_key()
+        return apply(
+            lambda m, c: jrandom.multivariate_normal(
+                k, m, c, tuple(shape) if shape else None),
+            self.loc, self.cov)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+
+        def lp(v, m, c):
+            d = m.shape[-1]
+            chol = jnp.linalg.cholesky(c)
+            z = jax.scipy.linalg.solve_triangular(chol, (v - m)[..., None],
+                                                  lower=True)[..., 0]
+            return (-0.5 * jnp.sum(z * z, -1) -
+                    jnp.sum(jnp.log(jnp.diagonal(chol, axis1=-2,
+                                                 axis2=-1)), -1) -
+                    0.5 * d * math.log(2 * math.pi))
+        return apply(lp, value, self.loc, self.cov)
+
+    def entropy(self):
+        def ent(c):
+            d = c.shape[-1]
+            chol = jnp.linalg.cholesky(c)
+            return (0.5 * d * (1 + math.log(2 * math.pi)) +
+                    jnp.sum(jnp.log(jnp.diagonal(chol, axis1=-2,
+                                                 axis2=-1)), -1))
+        return apply(ent, self.cov)
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims of `base` as event dims (reference
+    paddle.distribution.Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply(lambda x: jnp.sum(x, axis=tuple(
+            range(-self.rank, 0))), lp)
+
+    def entropy(self):
+        e = self.base.entropy()
+        return apply(lambda x: jnp.sum(x, axis=tuple(
+            range(-self.rank, 0))), e)
+
+
+# -- transforms (reference: paddle.distribution.transform) -------------------
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale, ref=self.loc)
+
+    def forward(self, x):
+        return apply(lambda v, m, s: m + s * v, ensure_tensor(x),
+                     self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply(lambda v, m, s: (v - m) / s, ensure_tensor(y),
+                     self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                   v.shape),
+                     ensure_tensor(x), self.scale)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.exp, ensure_tensor(x))
+
+    def inverse(self, y):
+        return apply(jnp.log, ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return ensure_tensor(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = ensure_tensor(power)
+
+    def forward(self, x):
+        return apply(lambda v, p: jnp.power(v, p), ensure_tensor(x),
+                     self.power)
+
+    def inverse(self, y):
+        return apply(lambda v, p: jnp.power(v, 1.0 / p), ensure_tensor(y),
+                     self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+                     ensure_tensor(x), self.power)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply(jax.nn.sigmoid, ensure_tensor(x))
+
+    def inverse(self, y):
+        return apply(lambda v: jnp.log(v) - jnp.log1p(-v), ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                     ensure_tensor(x))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.tanh, ensure_tensor(x))
+
+    def inverse(self, y):
+        return apply(jnp.arctanh, ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(
+            lambda v: 2.0 * (math.log(2.0) - v - jax.nn.softplus(-2.0 * v)),
+            ensure_tensor(x))
+
+
+class SoftmaxTransform(Transform):
+    def forward(self, x):
+        return apply(lambda v: jax.nn.softmax(v, -1), ensure_tensor(x))
+
+    def inverse(self, y):
+        return apply(lambda v: jnp.log(v), ensure_tensor(y))
+
+
+class StickBreakingTransform(Transform):
+    """R^{d} -> simplex^{d+1} via stick breaking."""
+
+    def forward(self, x):
+        def f(v):
+            off = jnp.log(jnp.arange(v.shape[-1], 0, -1, dtype=v.dtype))
+            z = jax.nn.sigmoid(v - off)
+            zpad = jnp.concatenate([z, jnp.ones(v.shape[:-1] + (1,),
+                                                v.dtype)], -1)
+            cum = jnp.cumprod(1 - z, -1)
+            cpad = jnp.concatenate([jnp.ones(v.shape[:-1] + (1,),
+                                             v.dtype), cum], -1)
+            return zpad * cpad
+        return apply(f, ensure_tensor(x))
+
+    def inverse(self, y):
+        def g(v):
+            cum = jnp.cumsum(v[..., :-1], -1)
+            rem = 1 - jnp.concatenate(
+                [jnp.zeros(v.shape[:-1] + (1,), v.dtype),
+                 cum[..., :-1]], -1)
+            z = v[..., :-1] / rem
+            off = jnp.log(jnp.arange(z.shape[-1], 0, -1, dtype=v.dtype))
+            return jnp.log(z) - jnp.log1p(-z) + off
+        return apply(g, ensure_tensor(y))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a Transform (reference parity)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms))
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(ensure_tensor(value))
+        return (self.base.log_prob(x) -
+                self.transform.forward_log_det_jacobian(x))

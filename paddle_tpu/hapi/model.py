@@ -130,6 +130,67 @@ class _JitStepper:
         return (jax.jit(pure, donate_argnums=(1, 3, 4)),
                 (train_p, frozen_p, bufs))
 
+    def _build_loop(self, n_inputs, n_labels):
+        """Compiled MULTI-STEP trainer: lax.scan of the single-step body
+        over batches stacked on a leading axis — the whole loop is one
+        XLA program, eliminating the per-step host round-trip (~14% of
+        wall time in the single-chip profile, PERF.md). LR is captured
+        once per loop (schedulers tick between loops, not inside)."""
+        step_jit, state_ref = self._build(n_inputs, n_labels)
+        pure = step_jit.__wrapped__
+
+        def pure_loop(keys, params, frozen, buffers, states, lr, step0,
+                      *batches):
+            def body(carry, xs):
+                params_, buffers_, states_, step_i = carry
+                key = xs[0]
+                batch = xs[1:]
+                loss_v, _outs, new_buf, new_params, new_states = pure(
+                    key, params_, frozen, buffers_, states_, lr, step_i,
+                    *batch)
+                return ((new_params, new_buf, new_states, step_i + 1),
+                        loss_v)
+
+            (params, buffers, states, _), losses = jax.lax.scan(
+                body, (list(params), list(buffers), list(states), step0),
+                (keys,) + tuple(batches))
+            return losses, params, buffers, states
+
+        return (jax.jit(pure_loop, donate_argnums=(1, 3, 4)), state_ref)
+
+    def step_loop(self, inputs, labels):
+        """Run N compiled steps at once. inputs/labels arrays carry a
+        leading step axis [N, batch, ...]; returns the [N] loss vector."""
+        n_steps = int(inputs[0].shape[0])
+        sig = ("loop", len(inputs), len(labels),
+               tuple(tuple(t.shape) for t in inputs + labels))
+        if self._jit is None or self._sig != sig:
+            self._jit, self._state_ref = self._build_loop(len(inputs),
+                                                          len(labels))
+            self._sig = sig
+        train_p, frozen_p, bufs = self._state_ref
+        opt = self.optimizer
+        step0 = jnp.asarray(opt._step_count + 1, jnp.int32)
+        opt._step_count += n_steps
+        states = [opt._get_state(t) for _, t in train_p]
+        keys = jnp.stack([_random.next_key() for _ in range(n_steps)])
+        losses, new_params, new_buf, new_states = self._jit(
+            keys,
+            [t._data for _, t in train_p],
+            [t._data for _, t in frozen_p],
+            [t._data for _, t in bufs],
+            states,
+            jnp.asarray(opt.get_lr(), jnp.float32),
+            step0,
+            *[t._data for t in inputs + labels])
+        for (n, t), arr in zip(train_p, new_params):
+            t._inplace_update(arr)
+        for (n, t), ns in zip(train_p, new_states):
+            opt._accum[id(t)] = ns
+        for (n, t), arr in zip(bufs, new_buf):
+            t._inplace_update(arr)
+        return Tensor(losses)
+
     def step(self, inputs, labels):
         sig = (len(inputs), len(labels),
                tuple(tuple(t.shape) for t in inputs + labels))
@@ -227,6 +288,21 @@ class Model:
                            amp_level=self._amp_level)
 
     # -- single-batch ops -----------------------------------------------------
+    def train_batch_loop(self, inputs, labels=None):
+        """Device-side training loop: N steps compiled into ONE XLA
+        program (lax.scan). inputs/labels carry a leading step axis
+        [N, batch, ...]; returns the [N] per-step losses. The TPU-native
+        counterpart of feeding N batches to train_batch — no host
+        round-trip between steps."""
+        self.network.train()
+        inputs = [to_tensor(x) if not isinstance(x, Tensor) else x
+                  for x in _to_list(inputs)]
+        labels = [to_tensor(x) if not isinstance(x, Tensor) else x
+                  for x in _to_list(labels)]
+        if self._stepper is None:
+            self._stepper = self._make_stepper()
+        return self._stepper.step_loop(inputs, labels)
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = [to_tensor(x) if not isinstance(x, Tensor) else x

@@ -1,2 +1,3 @@
 """paddle_tpu.incubate (reference: paddle.incubate)."""
+from . import asp  # noqa: F401
 from . import nn  # noqa: F401

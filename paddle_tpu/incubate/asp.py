@@ -1,0 +1,94 @@
+"""paddle_tpu.incubate.asp — automatic structured (2:4) sparsity
+(reference: paddle.incubate.asp prune_model/decorate/calculate_density —
+upstream python/paddle/incubate/asp/, unverified; SURVEY.md §2.2
+Incubate "sparsity (ASP)").
+
+TPU-native design: the 2:4 pattern is computed with a vectorized
+reshape-and-top2 over groups of 4 along the input dim (no Python loops —
+one XLA program per weight), and training-under-mask is a mask re-apply
+hook after each optimizer step (the reference's OptimizerWithSparsity
+wrapper). TPUs have no sparse tensor cores, so the mask is a
+regularization/compression artifact here — kept numerically identical to
+the reference's m4n2 pattern so checkpoints port.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["calculate_density", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+_EXCLUDED: set = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in param_names:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.mean((arr != 0).astype(jnp.float32)))
+
+
+def _m4n2_mask(w):
+    """Best 2-of-4 mask along the LAST dim (groups of 4, keep top-2 |w|)."""
+    n = w.shape[-1]
+    pad = (-n) % 4
+    wp = jnp.pad(w, [(0, 0)] * (w.ndim - 1) + [(0, pad)])
+    g = wp.reshape(wp.shape[:-1] + (-1, 4))
+    a = jnp.abs(g)
+    # rank within each group; keep the two largest magnitudes
+    order = jnp.argsort(a, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)  # 0 = smallest
+    mask = (ranks >= 2).astype(w.dtype)
+    mask = mask.reshape(wp.shape)[..., :n]
+    return mask
+
+
+def _prunable(name, param):
+    if name in _EXCLUDED:
+        return False
+    shp = tuple(param._data.shape)
+    return len(shp) >= 2 and shp[-1] >= 4
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True):
+    """Apply 2:4 masks to every prunable weight; returns {name: mask}."""
+    assert (n, m) == (2, 4), "reference ASP pattern is 2:4"
+    out = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = _m4n2_mask(p._data)
+        p._inplace_update(p._data * mask)
+        if with_mask:
+            p._asp_mask = mask  # attached to the param (survives GC id reuse)
+        out[name] = Tensor(mask)
+    return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so masks re-apply after every update (the
+    reference's OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def step(*a, **k):
+        r = inner_step(*a, **k)
+        for group in optimizer._param_groups:
+            for p in group["params"]:
+                msk = getattr(p, "_asp_mask", None)
+                if msk is not None:
+                    p._inplace_update(p._data * msk)
+        return r
+
+    optimizer.step = step
+    return optimizer

@@ -79,6 +79,8 @@ def save(layer, path, input_spec=None, **config):
                 with open(path + ".stablehlo", "wb") as f:
                     f.write(exported.serialize())
                 meta["stablehlo"] = True
+                _write_native_artifact(path, exported, named, params,
+                                       buffers, specs, meta)
             except Exception as e:  # export is best-effort; params always saved
                 meta["stablehlo"] = False
                 meta["export_error"] = str(e)[:500]
@@ -87,6 +89,53 @@ def save(layer, path, input_spec=None, **config):
     else:
         raise TypeError("jit.save expects a Layer (decorate functions with "
                         "to_static and save the owning Layer)")
+
+
+from ..native import PJRT_DTYPE_CODES as _DTYPE_CODES  # single source
+
+
+def _write_native_artifact(path, exported, named, params, buffers, specs,
+                           meta):
+    """Emit the pure-C++ deployment triple next to the jax.export blob:
+    raw StableHLO bytecode (.mlir), a flat param blob (.pdparams.bin) and
+    a line-oriented arg manifest (.pdpjrt.txt) — everything
+    native/pjrt_loader.cpp (the C++ inference runtime / CLI `pd_infer`)
+    needs to run this artifact on any PJRT plugin without Python.
+
+    Manifest line: `arg <dtype_code> <rank> <dims...> <param|input> <off>`
+    in the exported calling convention's flat arg order
+    (params, buffers, inputs)."""
+    def code_of(dt):
+        name = str(np.dtype(dt)) if str(dt) != "bfloat16" else "bfloat16"
+        if name not in _DTYPE_CODES:
+            raise ValueError(f"dtype {name} unsupported by native artifact")
+        return _DTYPE_CODES[name]
+
+    try:
+        blob = bytearray()
+        lines = []
+        for arr in list(params) + list(buffers):
+            a = np.asarray(arr)
+            off = len(blob)
+            blob += a.tobytes()
+            dims = " ".join(str(d) for d in a.shape)
+            lines.append(f"arg {code_of(arr.dtype)} {a.ndim} {dims} "
+                         f"param {off}".replace("  ", " "))
+        for i, s in enumerate(specs):
+            dims = " ".join(str(d) for d in s.shape)
+            lines.append(f"arg {code_of(s.dtype)} {len(s.shape)} {dims} "
+                         f"input {i}".replace("  ", " "))
+        code = exported.mlir_module_serialized
+        with open(path + ".mlir", "wb") as f:
+            f.write(code)
+        with open(path + ".pdparams.bin", "wb") as f:
+            f.write(bytes(blob))
+        with open(path + ".pdpjrt.txt", "w") as f:
+            f.write("\n".join(lines) + "\n")
+        meta["native_artifact"] = True
+    except Exception as e:
+        meta["native_artifact"] = False
+        meta["native_error"] = str(e)[:300]
 
 
 class TranslatedLayer(Layer):

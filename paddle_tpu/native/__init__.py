@@ -19,15 +19,20 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_LOCK = threading.Lock()
 
 
-def _build(src: str, out: str) -> str:
+def _build(src: str, out: str, *, shared=True, extra_flags=()) -> str:
     src_path = os.path.join(_DIR, src)
     out_path = os.path.join(_DIR, out)
     with _BUILD_LOCK:
         if (not os.path.exists(out_path) or
                 os.path.getmtime(out_path) < os.path.getmtime(src_path)):
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", src_path, "-o", out_path]
-            subprocess.run(cmd, check=True, capture_output=True)
+            cmd = (["g++", "-O2", "-std=c++17"] +
+                   (["-shared"] if shared else []) +
+                   ["-fPIC", "-pthread"] + list(extra_flags) +
+                   [src_path, "-o", out_path])
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RuntimeError(f"native build of {src} failed:\n"
+                                   f"{r.stderr}")
     return out_path
 
 
@@ -217,3 +222,164 @@ class TokenLoader:
             self.close()
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------
+# PJRT C++ inference runtime (native/pjrt_loader.cpp)
+
+
+def _pjrt_include_dir():
+    """The PJRT C API header ships with the tensorflow wheel in this
+    image; the loader only needs pjrt_c_api.h (self-contained C)."""
+    import glob
+    import sysconfig
+    for pat in [
+        os.path.join(sysconfig.get_paths()["purelib"],
+                     "tensorflow", "include"),
+        "/opt/venv/lib/python3.12/site-packages/tensorflow/include",
+    ]:
+        for d in glob.glob(pat):
+            if os.path.exists(os.path.join(d, "xla", "pjrt", "c",
+                                           "pjrt_c_api.h")):
+                return d
+    raise RuntimeError("pjrt_c_api.h not found (tensorflow include dir)")
+
+
+def _build_pjrt(binary=False):
+    flags = ["-I", _pjrt_include_dir(), "-ldl"]
+    if binary:
+        flags.append("-DPD_PJRT_MAIN")
+    return _build("pjrt_loader.cpp",
+                  "pd_infer" if binary else "libpd_pjrt.so",
+                  shared=not binary, extra_flags=flags)
+
+
+def pd_infer_binary():
+    """Build (if needed) and return the path of the pd_infer CLI."""
+    return _build_pjrt(binary=True)
+
+
+# dtype → code shared by the manifest writer (jit/save_load.py), the
+# ctypes runner below, and the C++ enum switch in pjrt_loader.cpp.
+PJRT_DTYPE_CODES = {"float32": 0, "bfloat16": 1, "int32": 2, "float16": 3,
+                    "float64": 4, "int64": 5, "bool": 6, "int8": 7,
+                    "uint8": 8}
+
+
+class PjrtRunner:
+    """C++ PJRT inference session (reference parity: the C++ side of
+    jit.save/load + AnalysisPredictor; SURVEY.md §2.1 "C++ JIT").
+
+    Compiles StableHLO bytecode on a PJRT plugin and executes it without
+    jax in the loop — the same native runtime the `pd_infer` CLI uses.
+    """
+
+    _lib = None
+
+    @classmethod
+    def lib(cls):
+        if cls._lib is None:
+            lib = ctypes.CDLL(_build_pjrt())
+            lib.pd_pjrt_create.restype = ctypes.c_void_p
+            lib.pd_pjrt_create.argtypes = [ctypes.c_char_p,
+                                           ctypes.c_char_p]
+            lib.pd_pjrt_destroy.argtypes = [ctypes.c_void_p]
+            lib.pd_pjrt_last_error.restype = ctypes.c_char_p
+            lib.pd_pjrt_last_error.argtypes = [ctypes.c_void_p]
+            lib.pd_pjrt_compile.restype = ctypes.c_void_p
+            lib.pd_pjrt_compile.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p,
+                                            ctypes.c_size_t]
+            lib.pd_pjrt_num_outputs.restype = ctypes.c_size_t
+            lib.pd_pjrt_num_outputs.argtypes = [ctypes.c_void_p]
+            lib.pd_pjrt_execute.restype = ctypes.c_void_p
+            lib.pd_pjrt_execute.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_void_p)]
+            lib.pd_pjrt_output_size.restype = ctypes.c_int64
+            lib.pd_pjrt_output_size.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_size_t]
+            lib.pd_pjrt_output_copy.restype = ctypes.c_int
+            lib.pd_pjrt_output_copy.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_size_t,
+                                                ctypes.c_void_p,
+                                                ctypes.c_size_t]
+            lib.pd_pjrt_result_destroy.argtypes = [ctypes.c_void_p]
+            lib.pd_pjrt_exec_destroy.argtypes = [ctypes.c_void_p]
+            cls._lib = lib
+        return cls._lib
+
+    def __init__(self, plugin_path, options=None):
+        """options: dict of plugin create options (ints or strings) —
+        e.g. the axon TPU plugin needs remote_compile/topology/
+        session_id (see default_axon_options())."""
+        spec = None
+        if options:
+            spec = ";".join(f"{k}={v}" for k, v in options.items()).encode()
+        self._ctx = self.lib().pd_pjrt_create(str(plugin_path).encode(),
+                                              spec)
+        if not self._ctx:
+            raise RuntimeError(f"PJRT plugin init failed: {plugin_path}")
+        self._exec = None
+
+    @staticmethod
+    def default_axon_options(topology="v5e:1x1x1"):
+        import uuid
+        return {"remote_compile": 1, "local_only": 0, "priority": 0,
+                "topology": topology, "n_slices": 1,
+                "session_id": str(uuid.uuid4())}
+
+    def _err(self):
+        return self.lib().pd_pjrt_last_error(self._ctx).decode()
+
+    def compile(self, stablehlo_bytes: bytes):
+        e = self.lib().pd_pjrt_compile(self._ctx, stablehlo_bytes,
+                                       len(stablehlo_bytes))
+        if not e:
+            raise RuntimeError(f"PJRT compile failed: {self._err()}")
+        self._exec = e
+        return self
+
+    def run(self, arrays):
+        """Execute with host numpy arrays; returns list of raw byte
+        buffers (one per output — caller reshapes/casts)."""
+        assert self._exec, "compile() first"
+        lib = self.lib()
+        n = len(arrays)
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        dtypes = (ctypes.c_int * n)(*[
+            PJRT_DTYPE_CODES[str(a.dtype)] for a in arrays])
+        ranks = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        dims_flat = []
+        for a in arrays:
+            dims_flat += list(a.shape)
+        dims = (ctypes.c_int64 * len(dims_flat))(*dims_flat)
+        ptrs = (ctypes.c_void_p * n)(*[
+            a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        res = lib.pd_pjrt_execute(self._exec, n, dtypes, ranks, dims, ptrs)
+        if not res:
+            raise RuntimeError(f"PJRT execute failed: {self._err()}")
+        outs = []
+        try:
+            for i in range(lib.pd_pjrt_num_outputs(self._exec)):
+                sz = lib.pd_pjrt_output_size(res, i)
+                if sz < 0:
+                    raise RuntimeError(self._err())
+                buf = ctypes.create_string_buffer(int(sz))
+                if lib.pd_pjrt_output_copy(res, i, buf, int(sz)) != 0:
+                    raise RuntimeError(self._err())
+                outs.append(bytes(buf.raw))
+        finally:
+            lib.pd_pjrt_result_destroy(res)
+        return outs
+
+    def close(self):
+        if self._exec:
+            self.lib().pd_pjrt_exec_destroy(self._exec)
+            self._exec = None
+        if self._ctx:
+            self.lib().pd_pjrt_destroy(self._ctx)
+            self._ctx = None

@@ -1,0 +1,71 @@
+"""paddle.nn.functional.flash_attention parity module (reference:
+python/paddle/nn/functional/flash_attention.py — unverified, SURVEY.md
+§2.2 Incubate/flash_attn family).
+
+`flash_attention` routes to the Pallas TPU kernel
+(ops/pallas/flash_attention.py). `flash_attn_unpadded` (varlen packed
+sequences + cu_seqlens) is computed with a block-diagonal segment mask
+over one packed attention call — static shapes, so it stays jittable;
+the O(total²) mask form is the TPU-native trade for the reference's
+varlen CUDA kernel (dynamic per-sequence lengths defeat XLA tiling).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+from ...ops._base import ensure_tensor
+from ...ops.pallas.flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention", "flash_attn_unpadded",
+           "scaled_dot_product_attention"]
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """Packed varlen attention: q/k/v [total, H, D]; cu_seqlens [B+1]."""
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    cq = ensure_tensor(cu_seqlens_q)._data
+    ck = ensure_tensor(cu_seqlens_k)._data
+    sc = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def attn(qa, ka, va):
+        tq = qa.shape[0]
+        tk = ka.shape[0]
+        # segment id per packed row: seg[i] = #boundaries <= i
+        seg_q = jnp.sum(jnp.arange(tq)[:, None] >= cq[None, 1:-1], -1)
+        seg_k = jnp.sum(jnp.arange(tk)[:, None] >= ck[None, 1:-1], -1)
+        s = jnp.einsum("qhd,khd->hqk", qa.astype(jnp.float32),
+                       ka.astype(jnp.float32)) * sc
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - cq[seg_q]
+            pos_k = jnp.arange(tk) - ck[seg_k]
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        s = jnp.where(mask[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        return jnp.einsum("hqk,khd->qhd", p, va.astype(jnp.float32)
+                          ).astype(qa.dtype)
+
+    out = apply(attn, q, k, v, name="flash_attn_unpadded")
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    ([B, S, H, D] layout) over the flash kernel when mask-free."""
+    from ...ops.pallas.flash_attention import flash_attention_bshd
+    return flash_attention_bshd(query, key, value, mask=attn_mask,
+                                causal=is_causal,
+                                dropout_p=dropout_p if training else 0.0)

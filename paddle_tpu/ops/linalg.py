@@ -275,3 +275,77 @@ def trace(x, offset=0, axis1=0, axis2=1, name=None):
 def matrix_exp(x, name=None):
     x = ensure_tensor(x)
     return apply(jax.scipy.linalg.expm, x, name="matrix_exp")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu() results into (P, L, U) with A = P @ L @ U (reference
+    paddle.linalg.lu_unpack; pivots are the 0-based successive row swaps
+    jax.scipy's lu_factor emits)."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def unpack2d(a, piv):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+        # successive swaps i <-> piv[i] build perm with A[perm] = L @ U,
+        # hence P = eye[:, perm] satisfies A = P L U
+        perm = jnp.arange(m)
+        def body(i, p):
+            j = piv[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=a.dtype)[:, perm]
+        return P, L, U
+
+    def unpack(a, piv):
+        fn = unpack2d
+        for _ in range(a.ndim - 2):  # batched LU: vmap over leading dims
+            fn = jax.vmap(fn)
+        return fn(a, piv)
+
+    P_, L, U = apply(lambda a, p: unpack(a, p), x, y.detach(),
+                     name="lu_unpack")
+    out_p = P_ if unpack_pivots else None
+    if unpack_ludata:
+        return out_p, L, U
+    return out_p, None, None
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference paddle.linalg.svd_lowrank):
+    subspace iteration on a Gaussian sketch — all matmuls, MXU-friendly."""
+    from ..core.random import next_key
+    x = ensure_tensor(x)
+    key = next_key()
+
+    def lowrank(a):
+        m, n = a.shape[-2], a.shape[-1]
+        qq = min(q, m, n)
+        g = jax.random.normal(key, a.shape[:-2] + (n, qq), a.dtype)
+        y = a @ g
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(Q, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return Q @ u_b, s, jnp.swapaxes(vh, -1, -2)
+
+    if M is not None:
+        x = x - ensure_tensor(M)
+    return apply(lowrank, x, name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA via svd_lowrank (reference paddle.linalg
+    .pca_lowrank)."""
+    x = ensure_tensor(x)
+    n = x.shape[-2]
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+    if center:
+        from .math import mean as _mean
+        x = x - _mean(x, axis=-2, keepdim=True)
+    return svd_lowrank(x, q=q, niter=niter)

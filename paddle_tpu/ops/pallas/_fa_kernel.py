@@ -131,88 +131,99 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, *, scale, causal, block_k, seq_len):
-    q = q_ref[0].astype(jnp.float32)                      # [bq, D]
-    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
-    lse = lse_ref[0]                                      # [bq, LANES] f32
-    delta = delta_ref[0]                                  # [bq, LANES] f32
-    bq, d = q.shape
+                      dq_ref, *, scale, causal, block_k, block_q):
+    """grid = (B*H, n_qb, n_kb); dq block revisited across the innermost
+    kb axis (index map drops it), accumulating in an f32 out ref — the
+    VMEM-bounded layout: every operand block is O(block · D), nothing is
+    sequence-length-resident (at s=8192 the previous full-K/V layout
+    overflowed the 16 MB scoped VMEM)."""
     qi = pl.program_id(1)
-    n_kb = seq_len // block_k
-    lse_t = _stat_cols(lse, block_k)                      # [bq, block_k]
-    delta_t = _stat_cols(delta, block_k)
+    kj = pl.program_id(2)
 
-    def body(i, dq):
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)                  # [bq, D]
+        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        bq = q.shape[0]
+        bk = k.shape[0]
+        lse_t = _stat_cols(lse_ref[0], bk)                # [bq, bk]
+        delta_t = _stat_cols(delta_ref[0], bk)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-            kpos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
+            kpos = kj * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (1, bk), 1)
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        p = jnp.exp(s - lse_t)                            # [bq, block_k]
+        p = jnp.exp(s - lse_t)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_t)
-        return dq + jax.lax.dot_general(
+        dq_ref[0] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     if causal:
-        upper = jnp.minimum(
-            jax.lax.div(qi * bq + bq + block_k - 1, block_k), n_kb)
+        # skip blocks entirely above the diagonal (no live q >= k pair)
+        live = (qi + 1) * block_q - 1 >= kj * block_k
+        pl.when(live)(compute)
     else:
-        upper = n_kb
-    dq = jax.lax.fori_loop(0, upper, body,
-                           jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        compute()
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
-    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
-    v = v_ref[0].astype(jnp.float32)                      # [bk, D]
-    bk, d = k.shape
+                       dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+    """grid = (B*H, n_kb, n_qb); dk/dv blocks revisited across the
+    innermost qb axis, accumulated in f32 out refs (same VMEM-bounded
+    design as _fa_bwd_dq_kernel)."""
     ki = pl.program_id(1)
-    n_qb = seq_len // block_q
+    qj = pl.program_id(2)
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]  # [bq, LANES]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+    @pl.when(qj == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    def compute():
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)                  # [bq, D]
+        do = do_ref[0].astype(jnp.float32)
+        bk = k.shape[0]
+        bq = q.shape[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
+            qpos = qj * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, 1), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (1, bk), 1)
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        p = jnp.exp(s - _stat_cols(lse, bk))              # [bq, bk]
+        p = jnp.exp(s - _stat_cols(lse_ref[0], bk))       # [bq, bk]
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         # dv += p^T @ do   (contract over q rows — dim 0 on both)
-        dv = dv + jax.lax.dot_general(
+        dv_ref[0] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - _stat_cols(delta, bk))
+        ds = p * (dp - _stat_cols(delta_ref[0], bk))
         # dk += ds^T @ q
-        dk = dk + jax.lax.dot_general(
+        dk_ref[0] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        return dk, dv
 
-    lower = jax.lax.div(ki * bk, block_q) if causal else 0
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, n_qb, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        live = (qj + 1) * block_q - 1 >= ki * block_k
+        pl.when(live)(compute)
+    else:
+        compute()
 
 
 def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
@@ -240,33 +251,42 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
         delta = delta - dlse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (b * h, s, LANES))
 
-    row = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
-    full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
-    stat_row = pl.BlockSpec((1, block_q, LANES), lambda i, j: (i, j, 0))
-    stat_full = pl.BlockSpec((1, s, LANES), lambda i, j: (i, 0, 0))
+    n_qb = s // block_q
+    n_kb = s // block_k
+    # dq pass: grid (bh, qb, kb) — q-side blocks keyed by qb, k-side by
+    # kb. Causal dead blocks skip compute via pl.when in-kernel; their
+    # DMAs still run (clamping the index map to dedupe them measured as
+    # a pathological Mosaic compile on-chip, so it was reverted).
+    q_row = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))
+    k_col = pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, t, 0))
+    q_stat = pl.BlockSpec((1, block_q, LANES), lambda i, j, t: (i, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
-                          block_k=block_k, seq_len=s),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        grid=(b * h, s // block_q),
-        in_specs=[row, full, full, row, stat_row, stat_row],
-        out_specs=row,
+                          block_k=block_k, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[q_row, k_col, k_col, q_row, q_stat, q_stat],
+        out_specs=q_row,
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
 
-    col = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))
+    # dkv pass: grid (bh, kb, qb) — k-side blocks keyed by kb, q-side by qb
+    k_col2 = pl.BlockSpec((1, block_k, d), lambda i, j, t: (i, j, 0))
+    q_row2 = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, t, 0))
+    q_stat2 = pl.BlockSpec((1, block_q, LANES), lambda i, j, t: (i, t, 0))
+
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
-                          block_q=block_q, seq_len=s),
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
-        grid=(b * h, s // block_k),
-        in_specs=[full, col, col, full, stat_full, stat_full],
-        out_specs=[col, col],
+                          block_q=block_q, block_k=block_k),
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, s, d), jnp.float32)],
+        grid=(b * h, n_kb, n_qb),
+        in_specs=[q_row2, k_col2, k_col2, q_row2, q_stat2, q_stat2],
+        out_specs=[k_col2, k_col2],
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
 
-    def unbh(x):
-        return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
-    return unbh(dq), unbh(dk), unbh(dv)
+    def unbh(x, dt):
+        return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2).astype(dt)
+    return unbh(dq, q.dtype), unbh(dk, k.dtype), unbh(dv, v.dtype)

@@ -112,6 +112,16 @@ class Optimizer:
         raise NotImplementedError
 
     # -- fused pytree apply --------------------------------------------------
+    def _update_one(self, p, g, s, lr, step, hp):
+        """One leaf through the XLA update rule (master-weight aware)."""
+        compute = s.get("master", p)
+        np_, ns = self._update(compute, g.astype(compute.dtype), s, lr,
+                               step, hp)
+        if "master" in s:
+            ns["master"] = np_
+            np_ = np_.astype(p.dtype)
+        return np_, ns
+
     def _fused_apply(self, params, grads, states, lr, step,
                      use_pallas=None):
         # use_pallas is consumed by optimizers with a Pallas fast path
@@ -119,12 +129,7 @@ class Optimizer:
         hp = self._hyperparams()
         new_params, new_states = [], []
         for p, g, s in zip(params, grads, states):
-            compute = s.get("master", p)
-            g = g.astype(compute.dtype)
-            np_, ns = self._update(compute, g, s, lr, step, hp)
-            if "master" in s:
-                ns["master"] = np_
-                np_ = np_.astype(p.dtype)
+            np_, ns = self._update_one(p, g, s, lr, step, hp)
             new_params.append(np_)
             new_states.append(ns)
         return new_params, new_states

@@ -191,12 +191,7 @@ class Adam(Optimizer):
                     eps=hp["eps"], wd=hp["weight_decay"],
                     decoupled=hp["decoupled"])
             else:
-                compute = s.get("master", p)
-                np_, ns = self._update(compute, g.astype(compute.dtype),
-                                       s, lr, step, hp)
-                if "master" in s:
-                    ns["master"] = np_
-                    np_ = np_.astype(p.dtype)
+                np_, ns = self._update_one(p, g, s, lr, step, hp)
             new_params.append(np_)
             new_states.append(ns)
         return new_params, new_states

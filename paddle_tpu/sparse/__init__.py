@@ -185,7 +185,9 @@ def masked_matmul(x, y, mask):
     bcoo_dot_general_sampled keeps the product unmaterialized)."""
     xd = ensure_tensor(x)._data
     yd = ensure_tensor(y)._data
-    m = _coo(mask)
+    # coalesce: duplicate mask indices would each emit the sampled
+    # product and double-count on densify
+    m = jsparse.bcoo_sort_indices(_coo(mask).sum_duplicates())
     out = jsparse.bcoo_dot_general_sampled(
         xd, yd, m.indices,
         dimension_numbers=(((xd.ndim - 1,), (0,)), ((), ())))
@@ -231,6 +233,14 @@ def divide(a, b):
     ca, cb = _coo(a).sum_duplicates(), _coo(b).sum_duplicates()
     ca = jsparse.bcoo_sort_indices(ca)
     cb = jsparse.bcoo_sort_indices(cb)
+    if ca.nse != cb.nse:
+        raise ValueError("sparse.divide requires matching sparsity "
+                         f"patterns (nnz {ca.nse} vs {cb.nse})")
+    if not isinstance(ca.indices, jax.core.Tracer) and \
+            not isinstance(cb.indices, jax.core.Tracer) and \
+            not bool(jnp.array_equal(ca.indices, cb.indices)):
+        raise ValueError("sparse.divide requires matching sparsity "
+                         "patterns (indices differ)")
     return _rewrap(a, jsparse.BCOO((ca.data / cb.data, ca.indices),
                                    shape=ca.shape))
 
@@ -273,7 +283,19 @@ def pow(x, factor):
 def cast(x, index_dtype=None, value_dtype=None):
     from ..core.dtype import convert_dtype
     vd = convert_dtype(value_dtype) if value_dtype is not None else None
-    return _unary(lambda v: v.astype(vd) if vd is not None else v)(x)
+    out = _unary(lambda v: v.astype(vd) if vd is not None else v)(x)
+    if index_dtype is not None:
+        idt = convert_dtype(index_dtype)
+        if isinstance(out, SparseCsrTensor):
+            b = out._bcsr
+            out = SparseCsrTensor(jsparse.BCSR(
+                (b.data, b.indices.astype(idt), b.indptr.astype(idt)),
+                shape=b.shape))
+        else:
+            b = out._bcoo
+            out = SparseCooTensor(jsparse.BCOO(
+                (b.data, b.indices.astype(idt)), shape=b.shape))
+    return out
 
 
 # -- structure ops -----------------------------------------------------------

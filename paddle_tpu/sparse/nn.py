@@ -72,6 +72,20 @@ def _to_tuple3(v):
     return (int(v),) * 3
 
 
+def _dense_conv3d(dense, weight, bias, stride, padding, dilation, groups):
+    """Shared NDHWC × DHWIO → NDHWC lowering (layer + functional paths)."""
+    out = jax.lax.conv_general_dilated(
+        dense, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in padding],
+        rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
 def _site_layout(c):
     """Normalize a 5-D NDHWC BCOO to site-major layout: indices [nnz, 4]
     spatial coords, data [nnz, C] dense channel rows (the natural point-
@@ -108,17 +122,10 @@ class _SparseConv3DBase(Layer):
             self.bias = None
 
     def _dense_conv(self, dense):
-        # NDHWC × DHWIO → NDHWC
-        out = jax.lax.conv_general_dilated(
-            dense, self.weight._data,
-            window_strides=self._stride,
-            padding=[(p, p) for p in self._padding],
-            rhs_dilation=self._dilation,
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            feature_group_count=self._groups)
-        if self.bias is not None:
-            out = out + self.bias._data
-        return out
+        return _dense_conv3d(dense, self.weight._data,
+                             None if self.bias is None else self.bias._data,
+                             self._stride, self._padding, self._dilation,
+                             self._groups)
 
 
 class Conv3D(_SparseConv3DBase):
@@ -265,15 +272,12 @@ class functional:
     def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                groups=1, data_format="NDHWC"):
         c = _coo(x)
-        out = jax.lax.conv_general_dilated(
-            c.todense(), weight._data if hasattr(weight, "_data") else
+        out = _dense_conv3d(
+            c.todense(),
+            weight._data if hasattr(weight, "_data") else
             jnp.asarray(weight),
-            window_strides=_to_tuple3(stride),
-            padding=[(p, p) for p in _to_tuple3(padding)],
-            rhs_dilation=_to_tuple3(dilation),
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            feature_group_count=groups)
-        if bias is not None:
-            out = out + (bias._data if hasattr(bias, "_data")
-                         else jnp.asarray(bias))
+            bias._data if bias is not None and hasattr(bias, "_data")
+            else (jnp.asarray(bias) if bias is not None else None),
+            _to_tuple3(stride), _to_tuple3(padding), _to_tuple3(dilation),
+            groups)
         return SparseCooTensor(jsparse.bcoo_fromdense(out))

@@ -1,4 +1,5 @@
 """paddle_tpu.vision (paddle.vision parity)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401

@@ -1,0 +1,389 @@
+"""paddle_tpu.vision.ops — detection ops (reference: paddle.vision.ops
+nms/roi_align/roi_pool/deform_conv2d/box_coder/yolo_box — upstream
+python/paddle/vision/ops.py + CUDA kernels in paddle/phi/kernels/gpu/,
+unverified; see SURVEY.md §2.2 "Vision").
+
+TPU-native design: every op is expressed with static shapes and
+vectorized gathers so it compiles under jit —
+- `nms` is the O(n²) mask formulation (pairwise IoU matrix + a lax scan
+  over score rank) instead of the reference's dynamic worklist: no
+  data-dependent shapes, MXU/VPU-friendly, exact same result;
+- `roi_align`/`roi_pool` sample with batched bilinear gathers (one
+  gather per pooling bin sample, vmapped over ROIs);
+- `deform_conv2d` is im2col-with-deformed-offsets: bilinear-sample the
+  input at offset positions → one big matmul (the MXU path);
+- `box_coder`/`yolo_box` are pure elementwise decodes.
+Outputs are fixed-size with validity masks where the reference returns
+ragged results (the XLA static-shape contract; callers slice by the
+returned count).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._base import ensure_tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "deform_conv2d", "RoIAlign", "RoIPool", "DeformConv2D"]
+
+
+def _box_iou(boxes):
+    """Pairwise IoU of [N, 4] (x1, y1, x2, y2) boxes."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns kept indices sorted by descending score
+    (fixed length N with -1 padding when compiled; eager returns the
+    trimmed result like the reference).
+
+    Multi-class (category_idxs given) offsets boxes per class so
+    suppression never crosses classes (the reference's batched_nms
+    trick).
+    """
+    b = ensure_tensor(boxes)._data.astype(jnp.float32)
+    n = b.shape[0]
+    sc = (ensure_tensor(scores)._data.astype(jnp.float32)
+          if scores is not None else jnp.arange(n, 0, -1, jnp.float32))
+    if category_idxs is not None:
+        cat = ensure_tensor(category_idxs)._data
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (cat.astype(jnp.float32) * span)[:, None]
+
+    order = jnp.argsort(-sc)
+    iou = _box_iou(b)[order][:, order]
+
+    def step(keep, i):
+        # keep[i] stays True only if no higher-ranked kept box overlaps
+        sup = jnp.any(keep & (jnp.arange(n) < i) & (iou[i] > iou_threshold))
+        keep = keep.at[i].set(~sup)
+        return keep, None
+
+    keep0 = jnp.ones((n,), bool)
+    keep, _ = jax.lax.scan(step, keep0, jnp.arange(n))
+    kept_sorted = jnp.where(keep, order, -1)  # rank order, -1 = suppressed
+    # compact: kept indices first (stable), -1 padding after
+    key = jnp.where(keep, jnp.arange(n), n)
+    perm = jnp.argsort(key)
+    out = kept_sorted[perm]
+    if isinstance(out, jax.core.Tracer):
+        return Tensor(out)
+    out = out[out >= 0]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(out)
+
+
+def _bilinear(feat, y, x):
+    """Sample feat [C, H, W] at fractional (y, x) — zero outside."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def tap(yi, xi, w):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        v = feat[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+        return v * (w * valid)
+
+    return (tap(y0, x0, wy0 * wx0) + tap(y0, x1, wy0 * wx1) +
+            tap(y1, x0, wy1 * wx0) + tap(y1, x1, wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference semantics incl. `aligned` half-pixel shift).
+
+    x: [N, C, H, W]; boxes: [R, 4] in input coords; boxes_num: [N] ROIs
+    per image (prefix-assigns ROIs to images). Returns [R, C, ph, pw].
+    """
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    bx = ensure_tensor(boxes)._data.astype(jnp.float32)
+    bn = ensure_tensor(boxes_num)._data
+    ph, pw = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    ratio = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+    off = 0.5 if aligned else 0.0
+    img_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                            total_repeat_length=bx.shape[0])
+
+    # sample positions inside the ROI: `ratio` uniform sub-samples per
+    # output cell (uniform over the whole ROI == per-bin sampling)
+    cell = jnp.arange(ph * ratio, dtype=jnp.float32)
+    frac_y = (cell + 0.5) / (ph * ratio)  # uniform — equals per-bin sampling
+    cellx = jnp.arange(pw * ratio, dtype=jnp.float32)
+    frac_x = (cellx + 0.5) / (pw * ratio)
+
+    def one_roi(box, img):
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        h = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+        w = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+        ys = y1 + frac_y * h                      # [ph*ratio]
+        xs = x1 + frac_x * w                      # [pw*ratio]
+        yy = jnp.repeat(ys, pw * ratio)
+        xx = jnp.tile(xs, ph * ratio)
+        vals = _bilinear(xd[img], yy, xx)         # [C, ph*r*pw*r]
+        C = vals.shape[0]
+        vals = vals.reshape(C, ph, ratio, pw, ratio)
+        return vals.mean(axis=(2, 4))             # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(bx, img_of_roi)
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool via dense max over adaptive bins (gather formulation)."""
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    bx = ensure_tensor(boxes)._data.astype(jnp.float32)
+    bn = ensure_tensor(boxes_num)._data
+    ph, pw = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    H, W = xd.shape[2], xd.shape[3]
+    img_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                            total_repeat_length=bx.shape[0])
+    iy = jnp.arange(H)
+    ix = jnp.arange(W)
+
+    def one_roi(box, img):
+        x1 = jnp.floor(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.floor(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.ceil(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.ceil(box[3] * spatial_scale).astype(jnp.int32)
+        hh = jnp.maximum(y2 - y1, 1).astype(jnp.float32)
+        ww = jnp.maximum(x2 - x1, 1).astype(jnp.float32)
+        # bin index of every pixel (pixels outside the ROI get -1)
+        by = jnp.floor((iy - y1).astype(jnp.float32) * ph / hh).astype(
+            jnp.int32)
+        bxx = jnp.floor((ix - x1).astype(jnp.float32) * pw / ww).astype(
+            jnp.int32)
+        by = jnp.where((iy >= y1) & (iy < jnp.maximum(y2, y1 + 1)),
+                       jnp.clip(by, 0, ph - 1), -1)
+        bxx = jnp.where((ix >= x1) & (ix < jnp.maximum(x2, x1 + 1)),
+                        jnp.clip(bxx, 0, pw - 1), -1)
+        onehot_y = (by[:, None] == jnp.arange(ph)[None, :])   # [H, ph]
+        onehot_x = (bxx[:, None] == jnp.arange(pw)[None, :])  # [W, pw]
+        feat = xd[img]                                        # [C, H, W]
+        neg = jnp.finfo(jnp.float32).min
+        masked = jnp.where(onehot_y[None, :, None, :, None] &
+                           onehot_x[None, None, :, None, :],
+                           feat[:, :, :, None, None], neg)
+        pooled = masked.max(axis=(1, 2))                      # [C, ph, pw]
+        return jnp.where(pooled == neg, 0.0, pooled)
+
+    return Tensor(jax.vmap(one_roi)(bx, img_of_roi))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (reference box_coder)."""
+    pb = ensure_tensor(prior_box)._data.astype(jnp.float32)
+    pbv = (ensure_tensor(prior_box_var)._data.astype(jnp.float32)
+           if prior_box_var is not None else None)
+    tb = ensure_tensor(target_box)._data.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    phh = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + phh * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / phh[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / phh[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        return Tensor(out)
+    # decode: target [N, M, 4] deltas against priors on `axis`
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    d = tb * (pbv[None, :, :] if pbv is not None else 1.0)
+    shp = (1, -1) if axis == 0 else (-1, 1)
+    pw_, ph_ = pw.reshape(shp), phh.reshape(shp)
+    pcx_, pcy_ = pcx.reshape(shp), pcy.reshape(shp)
+    cx = d[..., 0] * pw_ + pcx_
+    cy = d[..., 1] * ph_ + pcy_
+    w = jnp.exp(d[..., 2]) * pw_
+    h = jnp.exp(d[..., 3]) * ph_
+    return Tensor(jnp.stack([cx - w / 2, cy - h / 2,
+                             cx + w / 2 - norm, cy + h / 2 - norm],
+                            axis=-1))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, A*(5+C), H, W] → boxes + scores."""
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    imgs = ensure_tensor(img_size)._data.astype(jnp.float32)
+    N, _, H, W = xd.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    feat = xd.reshape(N, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)
+    gy = jnp.arange(H, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y -
+          (scale_x_y - 1) / 2 + gx[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y -
+          (scale_x_y - 1) / 2 + gy[None, None, :, None]) / H
+    bw = jnp.exp(feat[:, :, 2]) * an[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(feat[:, :, 3]) * an[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    obj = jax.nn.sigmoid(feat[:, :, 4])
+    cls = jax.nn.sigmoid(feat[:, :, 5:])
+    score = obj[:, :, None] * cls                      # [N, A, C, H, W]
+    imw = imgs[:, 1].reshape(N, 1, 1, 1)
+    imh = imgs[:, 0].reshape(N, 1, 1, 1)
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    mask = (obj > conf_thresh)[:, :, None]
+    scores = jnp.where(mask, score, 0.0)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 as bilinear im2col + MXU matmul.
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo];
+    weight: [Cout, Cin/g, kh, kw]; mask (v2): [N, dg*kh*kw, Ho, Wo].
+    """
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    od = ensure_tensor(offset)._data.astype(jnp.float32)
+    wd = ensure_tensor(weight)._data.astype(jnp.float32)
+    md = ensure_tensor(mask)._data.astype(jnp.float32) \
+        if mask is not None else None
+    sh, sw = (stride if isinstance(stride, (tuple, list))
+              else (stride, stride))
+    ph, pw = (padding if isinstance(padding, (tuple, list))
+              else (padding, padding))
+    dh, dw = (dilation if isinstance(dilation, (tuple, list))
+              else (dilation, dilation))
+    N, Cin, H, W = xd.shape
+    Cout, _, kh, kw = wd.shape
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = deformable_groups
+    off = od.reshape(N, dg, kh * kw, 2, Ho, Wo)
+
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    # per kernel tap (kh*kw), per out position
+    tap_y = (oy[None, :, None] +
+             jnp.repeat(ky, kw)[:, None, None]).astype(jnp.float32)
+    tap_x = (ox[None, None, :] +
+             jnp.tile(kx, kh)[:, None, None]).astype(jnp.float32)
+
+    cg = Cin // dg  # channels per deformable group
+    # v2 mask defaults to all-ones (v1 semantics)
+    msk_r = (md.reshape(N, dg, kh * kw, Ho * Wo) if md is not None
+             else jnp.ones((N, dg, kh * kw, Ho * Wo), jnp.float32))
+
+    def one_image(img, offs, msk):
+        def one_group(g):
+            feat = jax.lax.dynamic_slice_in_dim(img, g * cg, cg, axis=0)
+            yy = tap_y + offs[g, :, 0]            # [kk, Ho, Wo]
+            xx = tap_x + offs[g, :, 1]
+            vals = jax.vmap(
+                lambda y_, x_: _bilinear(feat, y_.reshape(-1),
+                                         x_.reshape(-1)))(yy, xx)
+            # vals: [kk, cg, Ho*Wo]
+            return vals * msk[g][:, None, :]
+        return jnp.concatenate([one_group(g) for g in range(dg)], axis=1)
+
+    cols = jax.vmap(one_image)(
+        xd, off.reshape(N, dg, kh * kw, 2, Ho, Wo), msk_r)
+    # cols: [N, kk, Cin, Ho*Wo] → output = weight · cols
+    wcol = wd.reshape(Cout, Cin // groups * kh * kw)
+    out_groups = []
+    cpg_in = Cin // groups
+    cpg_out = Cout // groups
+    cols_t = cols.transpose(0, 2, 1, 3)  # [N, Cin, kk, Ho*Wo]
+    for g in range(groups):
+        seg = cols_t[:, g * cpg_in:(g + 1) * cpg_in]  # [N,cpg,kk,HoWo]
+        seg = seg.reshape(N, cpg_in * kh * kw, Ho * Wo)
+        wseg = wcol[g * cpg_out:(g + 1) * cpg_out]
+        out_groups.append(jnp.einsum("ok,nkp->nop", wseg, seg))
+    out = jnp.concatenate(out_groups, axis=1).reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + ensure_tensor(bias)._data.reshape(1, -1, 1, 1)
+    return Tensor(out)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._size, self._scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._size, self._scale)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..core.tensor import Parameter
+        from ..nn import initializer as init
+        kh, kw = (kernel_size if isinstance(kernel_size, (tuple, list))
+                  else (kernel_size, kernel_size))
+        self._args = (stride, padding, dilation, deformable_groups, groups)
+        fan_in = in_channels * kh * kw
+        w = init.XavierUniform(fan_in=fan_in,
+                               fan_out=out_channels * kh * kw)(
+            (out_channels, in_channels // groups, kh, kw), jnp.float32)
+        self.weight = Parameter(w)
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d,
+                             dg, g, mask)

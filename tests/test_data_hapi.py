@@ -278,3 +278,39 @@ class TestFleetAmpCompiled:
             assert l2 < l1, (l1, l2)
         finally:
             _reset_fleet()
+
+
+class TestTrainBatchLoop:
+    """Device-side multi-step loop == N sequential train_batch calls."""
+
+    def test_loop_matches_sequential(self):
+        import numpy as np
+        import paddle_tpu as P
+
+        def build():
+            P.seed(0)
+            net = P.nn.Sequential(P.nn.Linear(8, 16), P.nn.ReLU(),
+                                  P.nn.Linear(16, 4))
+            m = P.Model(net)
+            m.prepare(P.optimizer.AdamW(1e-2, parameters=net.parameters()),
+                      P.nn.CrossEntropyLoss())
+            return net, m
+
+        rng = np.random.default_rng(0)
+        xs = rng.standard_normal((3, 4, 8)).astype(np.float32)
+        ys = rng.integers(0, 4, (3, 4)).astype(np.int64)
+
+        net_a, ma = build()
+        seq_losses = [float(np.asarray(ma.train_batch([P.to_tensor(xs[i])],
+                                                      [P.to_tensor(ys[i])])))
+                      for i in range(3)]
+
+        net_b, mb = build()
+        loop_losses = np.asarray(
+            mb.train_batch_loop([P.to_tensor(xs)], [P.to_tensor(ys)])._data)
+        np.testing.assert_allclose(loop_losses, seq_losses, atol=1e-5)
+        # final weights agree
+        for (n1, p1), (n2, p2) in zip(net_a.named_parameters(),
+                                      net_b.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data), atol=1e-5)

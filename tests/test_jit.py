@@ -117,3 +117,39 @@ class TestJitSaveLoad:
         loaded = P.jit.load(path)
         out = loaded(x)
         assert np.allclose(out.numpy(), ref, atol=1e-5)
+
+
+class TestNativeArtifact:
+    """jit.save emits the C++-loadable triple (.mlir/.pdpjrt.txt/.pdparams.bin)
+    consumed by native/pjrt_loader.cpp (execution itself is covered on-chip
+    in test_tpu_chip.py)."""
+
+    def test_native_artifact_files(self, tmp_path):
+        import json
+        import os
+        import numpy as np
+        import paddle_tpu as P
+        from paddle_tpu.jit import save as jit_save
+        from paddle_tpu.jit.save_load import InputSpec
+
+        net = P.nn.Sequential(P.nn.Linear(8, 16), P.nn.ReLU(),
+                              P.nn.Linear(16, 4))
+        prefix = str(tmp_path / "m")
+        jit_save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+        meta = json.load(open(prefix + ".pdmodel.json"))
+        assert meta.get("native_artifact"), meta
+        assert os.path.getsize(prefix + ".mlir") > 0
+        lines = open(prefix + ".pdpjrt.txt").read().strip().splitlines()
+        # 4 params (2 weights + 2 biases) + 1 input
+        assert len(lines) == 5
+        assert lines[-1].split()[-2] == "input"
+        nbytes = sum(np.prod([int(x) for x in l.split()[3:3 + int(l.split()[2])]],
+                             dtype=np.int64) * 4
+                     for l in lines if l.split()[-2] == "param")
+        assert os.path.getsize(prefix + ".pdparams.bin") == nbytes
+
+    def test_pjrt_loader_builds(self):
+        from paddle_tpu.native import _build_pjrt, pd_infer_binary
+        import os
+        assert os.path.exists(_build_pjrt())
+        assert os.path.exists(pd_infer_binary())

@@ -106,3 +106,152 @@ class TestSignal:
         spec = P.signal.stft(P.to_tensor(x), n_fft=64, hop_length=16)
         rec = P.signal.istft(spec, n_fft=64, hop_length=16, length=512)
         assert np.allclose(rec.numpy(), x, atol=1e-3)
+
+
+class TestDistributionExtended:
+    """New distribution families + the transform machinery, against
+    closed-form oracles."""
+
+    def test_cauchy(self):
+        import math
+        from paddle_tpu import distribution as D
+        c = D.Cauchy(0.0, 2.0)
+        # pdf(0) = 1/(pi*2)
+        np.testing.assert_allclose(float(np.asarray(c.log_prob(0.0)._data)),
+                                   -math.log(math.pi * 2), atol=1e-5)
+        assert c.sample((64,)).shape == [64]
+
+    def test_chi2_is_gamma(self):
+        from paddle_tpu import distribution as D
+        x = D.Chi2(4.0)
+        assert float(np.asarray(x.concentration._data)) == 2.0
+        assert x.sample((8,)).shape == [8]
+
+    def test_geometric_pmf(self):
+        from paddle_tpu import distribution as D
+        g = D.Geometric(0.25)
+        lp = float(np.asarray(g.log_prob(3.0)._data))
+        np.testing.assert_allclose(lp, np.log((0.75 ** 3) * 0.25),
+                                   atol=1e-5)
+
+    def test_studentt_closed_form(self):
+        import math
+        from paddle_tpu import distribution as D
+        df, v = 5.0, 0.7
+        t = D.StudentT(df)
+        lp = float(np.asarray(t.log_prob(v)._data))
+        ref = (math.lgamma((df + 1) / 2) - math.lgamma(df / 2) -
+               0.5 * math.log(df * math.pi) -
+               (df + 1) / 2 * math.log1p(v * v / df))
+        np.testing.assert_allclose(lp, ref, atol=1e-5)
+
+    def test_mvn_logprob_matches_scipy_formula(self):
+        from paddle_tpu import distribution as D
+        cov = np.asarray([[2.0, 0.3], [0.3, 1.0]], np.float32)
+        loc = np.asarray([1.0, -1.0], np.float32)
+        v = np.asarray([0.5, 0.5], np.float32)
+        m = D.MultivariateNormal(loc, cov)
+        got = float(np.asarray(m.log_prob(v)._data))
+        d = v - loc
+        ref = (-0.5 * d @ np.linalg.inv(cov) @ d -
+               0.5 * np.log(np.linalg.det(cov)) - np.log(2 * np.pi))
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_transformed_exp_equals_lognormal(self):
+        from paddle_tpu import distribution as D
+        td = D.TransformedDistribution(D.Normal(0.3, 0.8),
+                                       D.ExpTransform())
+        v = 1.7
+        # lognormal pdf
+        ref = (-np.log(v) - np.log(0.8) - 0.5 * np.log(2 * np.pi) -
+               (np.log(v) - 0.3) ** 2 / (2 * 0.8 ** 2))
+        np.testing.assert_allclose(
+            float(np.asarray(td.log_prob(v)._data)), ref, atol=1e-5)
+
+    def test_independent_sums_event_dims(self):
+        from paddle_tpu import distribution as D
+        base = D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32))
+        ind = D.Independent(base, 1)
+        got = float(np.asarray(ind.log_prob(np.zeros(4, np.float32))._data))
+        np.testing.assert_allclose(got, 4 * -0.5 * np.log(2 * np.pi),
+                                   atol=1e-5)
+
+    def test_transform_roundtrips(self):
+        from paddle_tpu import distribution as D
+        x = np.asarray([0.3, -1.2, 2.0], np.float32)
+        for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform()]:
+            y = t.forward(P.to_tensor(x))
+            back = np.asarray(t.inverse(y)._data)
+            np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_stick_breaking_simplex(self):
+        from paddle_tpu import distribution as D
+        sb = D.StickBreakingTransform()
+        x = np.asarray([0.5, -0.3, 1.0], np.float32)
+        y = np.asarray(sb.forward(P.to_tensor(x))._data)
+        assert y.shape == (4,) and y.min() > 0
+        np.testing.assert_allclose(y.sum(), 1.0, atol=1e-5)
+        back = np.asarray(sb.inverse(P.to_tensor(y))._data)
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+class TestLinalgLowrank:
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((5, 5)).astype(np.float32)
+        lu_, piv = P.linalg.lu(P.to_tensor(a))
+        Pm, L, U = P.linalg.lu_unpack(lu_, piv)
+        rec = (np.asarray(Pm._data) @ np.asarray(L._data) @
+               np.asarray(U._data))
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    def test_svd_lowrank_exact_rank(self):
+        rng = np.random.default_rng(1)
+        m = (rng.standard_normal((30, 8)).astype(np.float32) @
+             rng.standard_normal((8, 20)).astype(np.float32))
+        u, s, v = P.linalg.svd_lowrank(P.to_tensor(m), q=8)
+        rec = (np.asarray(u._data) * np.asarray(s._data)) @ \
+            np.asarray(v._data).T
+        np.testing.assert_allclose(rec, m, atol=5e-3)
+
+    def test_pca_lowrank_centers(self):
+        rng = np.random.default_rng(2)
+        m = rng.standard_normal((40, 10)).astype(np.float32) + 5.0
+        u, s, v = P.linalg.pca_lowrank(P.to_tensor(m), q=3)
+        assert u.shape == [40, 3] and s.shape == [3] and v.shape == [10, 3]
+
+
+class TestASP:
+    """incubate.asp 2:4 structured sparsity."""
+
+    def test_prune_density_and_pattern(self):
+        from paddle_tpu.incubate import asp
+        P.seed(0)
+        net = P.nn.Sequential(P.nn.Linear(16, 8), P.nn.ReLU(),
+                              P.nn.Linear(8, 4))
+        masks = asp.prune_model(net)
+        assert masks  # at least the two weight matrices
+        for name, p in net.named_parameters():
+            if name in masks:
+                w = np.asarray(p._data)
+                # exactly 2 of every 4 along last dim are nonzero
+                g = np.abs(w).reshape(w.shape[0], -1, 4)
+                nz = (g != 0).sum(-1)
+                assert (nz == 2).all(), name
+                np.testing.assert_allclose(asp.calculate_density(p), 0.5,
+                                           atol=1e-6)
+
+    def test_decorated_step_keeps_mask(self):
+        from paddle_tpu.incubate import asp
+        P.seed(0)
+        net = P.nn.Linear(8, 8)
+        asp.prune_model(net)
+        opt = asp.decorate(P.optimizer.SGD(0.1, parameters=net.parameters()))
+        x = P.randn([4, 8])
+        loss = net(x).mean()
+        loss.backward()
+        opt.step()
+        w = np.asarray(net.weight._data)
+        g = np.abs(w).reshape(w.shape[0], -1, 4)
+        assert ((g != 0).sum(-1) <= 2).all()

@@ -1,0 +1,172 @@
+"""paddle_tpu.vision.ops — NumPy-oracle tests (SURVEY.md §4 pattern)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from paddle_tpu.vision import ops as vops
+
+
+def np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            # iou
+            x1 = max(boxes[i, 0], boxes[j, 0])
+            y1 = max(boxes[i, 1], boxes[j, 1])
+            x2 = min(boxes[i, 2], boxes[j, 2])
+            y2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a + b - inter) > thresh and scores[j] <= scores[i]:
+                sup[j] = True
+    return keep
+
+
+class TestNMS:
+    def test_matches_greedy_oracle(self):
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0, 50, (40, 2))
+        wh = rng.uniform(5, 25, (40, 2))
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.uniform(size=40).astype(np.float32)
+        got = np.asarray(vops.nms(P.to_tensor(boxes), 0.4,
+                                  P.to_tensor(scores))._data)
+        ref = np_nms(boxes, scores, 0.4)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_multiclass_does_not_cross_suppress(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        cats = np.asarray([0, 1])
+        got = np.asarray(vops.nms(P.to_tensor(boxes), 0.1,
+                                  P.to_tensor(scores),
+                                  category_idxs=P.to_tensor(cats),
+                                  categories=[0, 1])._data)
+        assert set(got.tolist()) == {0, 1}  # different classes: both kept
+
+    def test_top_k(self):
+        boxes = np.asarray([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 11, 11]],
+                           np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+        got = np.asarray(vops.nms(P.to_tensor(boxes), 0.5,
+                                  P.to_tensor(scores), top_k=2)._data)
+        assert len(got) == 2
+
+
+class TestRoiOps:
+    def test_roi_align_constant_field(self):
+        # constant feature map -> every aligned value equals the constant
+        x = np.full((1, 3, 16, 16), 7.0, np.float32)
+        boxes = np.asarray([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+        out = vops.roi_align(P.to_tensor(x), P.to_tensor(boxes),
+                             P.to_tensor(np.asarray([2])), 4)
+        assert out.shape == [2, 3, 4, 4]
+        np.testing.assert_allclose(np.asarray(out._data), 7.0, atol=1e-5)
+
+    def test_roi_align_linear_field_center(self):
+        # f(y, x) = x: aligned samples average to the bin-center x coord
+        H = W = 16
+        x = np.tile(np.arange(W, dtype=np.float32), (H, 1))[None, None]
+        boxes = np.asarray([[4.0, 4.0, 12.0, 12.0]], np.float32)
+        out = np.asarray(vops.roi_align(
+            P.to_tensor(x), P.to_tensor(boxes),
+            P.to_tensor(np.asarray([1])), 2, aligned=False)._data)
+        # bin centers at x = 4 + {0.25, 0.75} * 8 -> 6, 10 (f = x)
+        np.testing.assert_allclose(out[0, 0, 0], [6.0, 10.0], atol=1e-4)
+        out_a = np.asarray(vops.roi_align(
+            P.to_tensor(x), P.to_tensor(boxes),
+            P.to_tensor(np.asarray([1])), 2, aligned=True)._data)
+        # aligned=True applies the half-pixel shift -> 5.5, 9.5
+        np.testing.assert_allclose(out_a[0, 0, 0], [5.5, 9.5], atol=1e-4)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        x[0, 0, 5, 6] = 9.0
+        boxes = np.asarray([[0, 0, 8, 8]], np.float32)
+        out = np.asarray(vops.roi_pool(P.to_tensor(x), P.to_tensor(boxes),
+                                       P.to_tensor(np.asarray([1])),
+                                       2)._data)
+        assert out[0, 0, 0, 0] == 5.0   # top-left quadrant
+        assert out[0, 0, 1, 1] == 9.0   # bottom-right quadrant
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(1)
+        priors = np.asarray([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        var = np.ones((2, 4), np.float32)
+        t = np.asarray([[1, 1, 9, 12], [4, 6, 22, 24]], np.float32)
+        enc = vops.box_coder(P.to_tensor(priors), P.to_tensor(var),
+                             P.to_tensor(t), "encode_center_size")
+        # decode the diagonal (each target against its own prior)
+        enc_d = np.asarray(enc._data)
+        diag = np.stack([enc_d[i, i] for i in range(2)])[:, None, :]
+        dec = vops.box_coder(P.to_tensor(priors), P.to_tensor(var),
+                             P.to_tensor(diag.squeeze(1)),
+                             "decode_center_size", axis=1)
+        got = np.asarray(dec._data)
+        np.testing.assert_allclose(np.stack([got[i, i] for i in range(2)]),
+                                   t, atol=1e-3)
+
+
+class TestYoloBox:
+    def test_shapes_and_score_threshold(self):
+        rng = np.random.default_rng(2)
+        N, A, C, H, W = 1, 3, 4, 5, 5
+        x = rng.standard_normal((N, A * (5 + C), H, W)).astype(np.float32)
+        boxes, scores = vops.yolo_box(
+            P.to_tensor(x), P.to_tensor(np.asarray([[320, 320]])),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+            conf_thresh=0.5)
+        assert boxes.shape == [N, A * H * W, 4]
+        assert scores.shape == [N, A * H * W, C]
+        b = np.asarray(boxes._data)
+        assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+        assert b.min() >= 0 and b.max() <= 320
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_plain_conv(self):
+        import jax
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 4, 9, 9)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 2 * 1 * 9, 7, 7), np.float32)
+        out = np.asarray(vops.deform_conv2d(
+            P.to_tensor(x), P.to_tensor(off), P.to_tensor(w))._data)
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        np.testing.assert_allclose(out, ref, atol=1e-3)
+
+    def test_mask_scales_v2(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+        half = np.full((1, 9, 4, 4), 0.5, np.float32)
+        full_out = np.asarray(vops.deform_conv2d(
+            P.to_tensor(x), P.to_tensor(off), P.to_tensor(w))._data)
+        half_out = np.asarray(vops.deform_conv2d(
+            P.to_tensor(x), P.to_tensor(off), P.to_tensor(w),
+            mask=P.to_tensor(half))._data)
+        np.testing.assert_allclose(half_out, full_out * 0.5, atol=1e-4)
+
+    def test_layer_wrapper(self):
+        layer = vops.DeformConv2D(4, 8, 3, padding=1)
+        x = P.to_tensor(np.random.default_rng(5).standard_normal(
+            (1, 4, 8, 8)).astype(np.float32))
+        off = P.to_tensor(np.zeros((1, 18, 8, 8), np.float32))
+        out = layer(x, off)
+        assert out.shape == [1, 8, 8, 8]
