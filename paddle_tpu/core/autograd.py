@@ -150,6 +150,20 @@ def mark_stable(fn):
 # ---------------------------------------------------------------------------
 # The op applicator — every differentiable op goes through here.
 
+# Static-graph recorder (paddle_tpu.static): when a Program is active,
+# every apply() additionally appends (fn, inputs, outputs) to it so
+# Executor.run can replay the op DAG as a pure jitted function of the
+# feeds. None in the common case — a single attribute load per op.
+_STATIC_RECORDER = None
+
+
+def _set_static_recorder(rec):
+    global _STATIC_RECORDER
+    prev = _STATIC_RECORDER
+    _STATIC_RECORDER = rec
+    return prev
+
+
 def apply(fn, *tensors, name: str = ""):
     """Run `fn(*arrays)` eagerly; record a TapeNode if grad is required.
 
@@ -188,9 +202,13 @@ def apply(fn, *tensors, name: str = ""):
                         for o in out)
             for t in res:
                 node.add_output(t)
+            if _STATIC_RECORDER is not None:
+                _STATIC_RECORDER.record(fn, tensors, res, name)
             return res
         t = Tensor(out, stop_gradient=False, _node=node)
         node.add_output(t)
+        if _STATIC_RECORDER is not None:
+            _STATIC_RECORDER.record(fn, tensors, (t,), name)
         return t
     if needs_grad:
         if microjit:
@@ -209,14 +227,24 @@ def apply(fn, *tensors, name: str = ""):
             res = tuple(Tensor(o, stop_gradient=False, _node=node) for o in out)
             for t in res:
                 node.add_output(t)
+            if _STATIC_RECORDER is not None:
+                _STATIC_RECORDER.record(fn, tensors, res, name)
             return res
         t = Tensor(out, stop_gradient=False, _node=node)
         node.add_output(t)
+        if _STATIC_RECORDER is not None:
+            _STATIC_RECORDER.record(fn, tensors, (t,), name)
         return t
     out = _mj_fwd(fn, arrs) if microjit else fn(*arrs)
     if isinstance(out, (tuple, list)):
-        return tuple(Tensor(o) for o in out)
-    return Tensor(out)
+        res = tuple(Tensor(o) for o in out)
+        if _STATIC_RECORDER is not None:
+            _STATIC_RECORDER.record(fn, tensors, res, name)
+        return res
+    t = Tensor(out)
+    if _STATIC_RECORDER is not None:
+        _STATIC_RECORDER.record(fn, tensors, (t,), name)
+    return t
 
 
 # ---------------------------------------------------------------------------

@@ -36,22 +36,31 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     def rope_one(x, sin_, cos_, pos):
         # x: [B, S, H, D]
         d = x.shape[-1]
-        if sin_ is None:
+        if sin_ is None and pos is not None:
+            # compute angles DIRECTLY from the position ids — no table,
+            # no gather, valid for ANY position (the table+take form
+            # NaN-filled positions >= seq_len, e.g. cached decode steps)
+            inv = 1.0 / (rotary_emb_base **
+                         (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            freqs = pos.astype(jnp.float32)[..., None] * inv  # [B, S, D/2]
+            sin_ = jnp.sin(freqs)[:, :, None, :]
+            cos_ = jnp.cos(freqs)[:, :, None, :]
+        elif sin_ is None:
             sin_, cos_ = make_sincos(x.shape[1], d, jnp.float32)
+            sin_ = sin_[None, :, None, :]
+            cos_ = cos_[None, :, None, :]
         else:
             sin_ = sin_.reshape(sin_.shape[-2], sin_.shape[-1])
             cos_ = cos_.reshape(cos_.shape[-2], cos_.shape[-1])
             if sin_.shape[-1] == d:  # full-dim tables → take half
                 sin_ = sin_[..., : d // 2]
                 cos_ = cos_[..., : d // 2]
-        if pos is not None:
-            sin_ = jnp.take(sin_, pos, axis=0)  # [B, S, D/2]
-            cos_ = jnp.take(cos_, pos, axis=0)
-            sin_ = sin_[:, :, None, :]
-            cos_ = cos_[:, :, None, :]
-        else:
-            sin_ = sin_[None, :, None, :]
-            cos_ = cos_[None, :, None, :]
+            if pos is not None:
+                sin_ = jnp.take(sin_, pos, axis=0)[:, :, None, :]
+                cos_ = jnp.take(cos_, pos, axis=0)[:, :, None, :]
+            else:
+                sin_ = sin_[None, :, None, :]
+                cos_ = cos_[None, :, None, :]
         xf = x.astype(jnp.float32)
         if use_neox_rotary_style:
             x1 = xf[..., : d // 2]

@@ -153,3 +153,7 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+from . import passes  # noqa: E402,F401  (IR-pass parity layer)
+from .passes import optimize  # noqa: E402,F401

@@ -1,0 +1,135 @@
+"""Inference optimization passes (reference: the AnalysisPredictor IR
+pass pipeline — conv_bn_fuse_pass, delete_dropout_op_pass etc. in
+paddle/fluid/inference/ and paddle/fluid/pir/transforms/ — unverified;
+SURVEY.md §2.1 "Inference engine").
+
+TPU-native design: XLA already performs the algebraic/fusion passes the
+reference runs on its IR (constant folding, elementwise fusion, layout
+assignment), so this layer keeps only the passes that need FRAMEWORK
+knowledge — structural rewrites over `nn.Layer` trees applied BEFORE
+export, where parameters can be algebraically merged:
+
+- conv_bn_fuse / linear_bn_fuse: fold BatchNorm's affine transform into
+  the preceding conv/linear weights (inference-classic; removes the BN
+  op and its memory traffic entirely).
+- delete_dropout: Dropout at inference is identity; removing the layer
+  saves the op and documents intent.
+
+`optimize(layer, passes=None)` applies the registry in order and returns
+the same layer (mutated in place, reference pass-pipeline style).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+__all__ = ["optimize", "register_pass", "available_passes"]
+
+_REGISTRY: dict = {}
+
+
+def register_pass(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_passes():
+    return list(_REGISTRY)
+
+
+def _sublayer_items(layer):
+    return list(layer._sub_layers.items())
+
+
+def _fold_bn_into(w, b, bn, channel_axis):
+    """Return (w', b') such that conv/linear(x; w', b') == bn(op(x; w, b)).
+
+    bn transform per channel c: y = gamma_c * (x - mu_c)/sqrt(var_c+eps)
+    + beta_c == scale_c * x + shift_c.
+    """
+    mu0 = bn._mean._data
+    gamma = (bn.weight._data.astype(jnp.float32) if bn.weight is not None
+             else jnp.ones_like(mu0))
+    beta = (bn.bias._data.astype(jnp.float32) if bn.bias is not None
+            else jnp.zeros_like(mu0))
+    mu = mu0.astype(jnp.float32)
+    var = bn._variance._data.astype(jnp.float32)
+    eps = getattr(bn, "epsilon", 1e-5)
+    scale = gamma / jnp.sqrt(var + eps)
+    shift = beta - mu * scale
+    shp = [1] * w.ndim
+    shp[channel_axis] = scale.shape[0]
+    w2 = (w.astype(jnp.float32) * scale.reshape(shp)).astype(w.dtype)
+    b0 = b.astype(jnp.float32) if b is not None else 0.0
+    b2 = (b0 * scale + shift).astype(w.dtype)
+    return w2, b2
+
+
+@register_pass("conv_bn_fuse")
+def conv_bn_fuse(layer: Layer):
+    """Fold BatchNorm into the immediately preceding Conv/Linear inside
+    every `nn.Sequential` container ONLY — Sequential is the one
+    container whose declaration order IS its dataflow order; fusing by
+    attribute adjacency in arbitrary Layers could rewrite branches that
+    are not actually consecutive in forward()."""
+    from ..nn.conv import Conv1D, Conv2D, Conv3D
+    from ..nn.norm import _BatchNormBase
+    from ..nn.common import Linear, Identity
+    from ..nn.layer import Sequential
+    from ..core.tensor import Parameter
+
+    n_fused = 0
+    containers = [s for s in [layer] + [s for _, s in
+                                        layer.named_sublayers()]
+                  if isinstance(s, Sequential)]
+    for sub in containers:
+        items = _sublayer_items(sub)
+        for (n1, l1), (n2, l2) in zip(items, items[1:]):
+            if not isinstance(l2, _BatchNormBase):
+                continue
+            if isinstance(l1, (Conv1D, Conv2D, Conv3D)):
+                ch_axis = 0  # O...: out-channel leads
+            elif isinstance(l1, Linear):
+                ch_axis = 1  # [in, out]
+            else:
+                continue
+            w2, b2 = _fold_bn_into(
+                l1.weight._data,
+                None if l1.bias is None else l1.bias._data, l2, ch_axis)
+            l1.weight._inplace_update(w2)
+            if l1.bias is None:
+                l1.bias = Parameter(b2)
+            else:
+                l1.bias._inplace_update(b2)
+            sub._sub_layers[n2] = Identity()
+            n_fused += 1
+    return n_fused
+
+
+@register_pass("delete_dropout")
+def delete_dropout(layer: Layer):
+    from ..nn.common import Dropout, Dropout2D, Dropout3D, Identity
+    n = 0
+    for sub in [layer] + [s for _, s in layer.named_sublayers()]:
+        for name, l in _sublayer_items(sub):
+            if isinstance(l, (Dropout, Dropout2D, Dropout3D)):
+                sub._sub_layers[name] = Identity()
+                n += 1
+    return n
+
+
+def optimize(layer: Layer, passes=None):
+    """Run the pass pipeline over `layer` (in place); returns a
+    {pass_name: rewrite_count} report."""
+    report = {}
+    for name in (passes if passes is not None else list(_REGISTRY)):
+        fn = _REGISTRY.get(name)
+        if fn is None:
+            raise KeyError(f"unknown inference pass {name!r}; "
+                           f"available: {available_passes()}")
+        report[name] = fn(layer)
+    layer.eval()
+    return report

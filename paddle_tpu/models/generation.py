@@ -1,0 +1,156 @@
+"""Autoregressive generation with a static KV cache (reference:
+paddlenlp GenerationMixin / paddle.incubate generation ops — the decode
+workflow a reference LLM user expects; upstream locations unverified,
+SURVEY.md §2.2 Incubate).
+
+TPU-native design (SURVEY.md §7 "Dynamic shapes"): the whole
+prefill + decode loop is ONE jitted XLA program —
+- the KV cache is a STATIC [B, total_len, n_kv, hd] buffer per layer,
+  written with `lax.dynamic_update_slice` at a traced offset (the
+  reference's growing-concat cache recompiles every step under XLA);
+- the decode loop is `lax.scan` over `max_new_tokens` steps (static trip
+  count), carrying (caches, last_token, rng, finished);
+- causality and cache validity collapse into ONE mask comparison
+  `k_pos <= q_pos` against absolute positions, so unwritten cache slots
+  are masked without bookkeeping;
+- sampling (greedy / temperature / top-k / top-p) is vectorized inside
+  the program; early-stopped rows keep emitting eos via a `finished`
+  lane mask (static shapes — no dynamic exit).
+
+Weights enter the program as jit-captured constants; the compiled
+program is cached on the model per (batch, prompt_len, max_new_tokens,
+sampling-config) signature.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..core import random as _random
+
+__all__ = ["GenerationMixin", "cached_attention"]
+
+
+def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
+    """Write k/v at `offset` into the static cache and attend q over the
+    whole buffer with the absolute-position causal mask.
+
+    q: [B, S, H, D]; k_new/v_new: [B, S, KV, D];
+    k_buf/v_buf: [B, T, KV, D]; offset: scalar int (traced ok).
+    Returns (out [B, S, H, D], k_buf, v_buf).
+    """
+    b, s, nh, d = q.shape
+    nkv = k_new.shape[2]
+    T = k_buf.shape[1]
+    zero = jnp.zeros((), jnp.int32)
+    off = jnp.asarray(offset, jnp.int32)
+    k_buf = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype),
+                                         (zero, off, zero, zero))
+    v_buf = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype),
+                                         (zero, off, zero, zero))
+    # GQA: group query heads over kv heads via reshape (no materialized
+    # head repeat)
+    g = nh // nkv
+    qg = q.reshape(b, s, nkv, g, d).astype(jnp.float32)
+    kf = k_buf.astype(jnp.float32)
+    vf = v_buf.astype(jnp.float32)
+    sc = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * scale
+    qpos = off + jnp.arange(s)
+    kpos = jnp.arange(T)
+    mask = kpos[None, :] <= qpos[:, None]            # [S, T]
+    sc = jnp.where(mask[None, None, None], sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", p, vf)
+    return (out.reshape(b, s, nh, d).astype(q.dtype), k_buf, v_buf)
+
+
+class GenerationMixin:
+    """Adds .generate() to a causal-LM Layer exposing
+    `_forward_cached(input_ids, caches, offset)` →
+    (logits [B, S, V], caches)."""
+
+    def _gen_program(self, sig):
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        return cache.get(sig)
+
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 seed=None):
+        """Returns generated token ids [B, max_new_tokens]."""
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        sig = (b, s, int(max_new_tokens), bool(do_sample),
+               float(temperature), int(top_k), float(top_p), eos)
+        fn = self._gen_program(sig)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _generate_pure, self, s, int(max_new_tokens),
+                bool(do_sample), float(temperature), int(top_k),
+                float(top_p), eos))
+            self._gen_cache[sig] = fn
+        key = _random.next_key() if seed is None else \
+            jax.random.PRNGKey(seed)
+        return Tensor(fn(ids, key))
+
+
+def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
+    """logits [B, V] → token [B] (vectorized sampling stack)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    v = lg.shape[-1]
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(lg, min(top_k, v))[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p < 1.0:
+        srt = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p (always keep top-1)
+        cut = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        thresh = jnp.take_along_axis(srt, cut, axis=-1)
+        lg = jnp.where(lg < thresh, -jnp.inf, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
+                   top_k, top_p, eos, ids, key):
+    b = ids.shape[0]
+    total = prompt_len + max_new
+    caches = model._init_caches(b, total)
+
+    # prefill: whole prompt in one pass
+    logits, caches = model._forward_cached(ids, caches, 0)
+    key, sub = jax.random.split(key)
+    tok = _sample_token(logits[:, -1], sub, do_sample, temperature,
+                        top_k, top_p)
+    finished = (tok == eos)
+
+    def step(carry, i):
+        caches, tok, key, finished = carry
+        logits, caches = model._forward_cached(
+            tok[:, None], caches, prompt_len + i)
+        key, sub = jax.random.split(key)
+        nxt = _sample_token(logits[:, -1], sub, do_sample, temperature,
+                            top_k, top_p)
+        nxt = jnp.where(finished, jnp.asarray(eos, jnp.int32), nxt)
+        finished = finished | (nxt == eos)
+        return (caches, nxt, key, finished), tok
+
+    (caches, tok, key, finished), toks = jax.lax.scan(
+        step, (caches, tok, key, finished),
+        jnp.arange(max_new - 1, dtype=jnp.int32))
+    # toks holds tokens emitted BEFORE each step; append the final one
+    all_toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],
+                               axis=1)
+    return all_toks

@@ -32,6 +32,7 @@ from ..incubate.nn.functional import (fused_rotary_position_embedding,
                                       swiglu)
 from ..nn import Embedding, Layer, LayerList, Linear, RMSNorm
 from ..nn import functional as F
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -154,6 +155,30 @@ class LlamaAttention(Layer):
             return out, cache
         return out
 
+    def forward_cached(self, x, k_buf, v_buf, offset):
+        """Static-cache decode path (models/generation.py): x [B,S,H];
+        k_buf/v_buf raw [B,T,KV,D]; offset traced int. Returns
+        (out Tensor, k_buf, v_buf)."""
+        import jax.numpy as _jnp
+        from .generation import cached_attention
+        from ..core.autograd import apply as _apply
+        b, s = x.shape[0], x.shape[1]
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        q = self.q_proj(x).reshape([b, s, nh, hd])
+        k = self.k_proj(x).reshape([b, s, nkv, hd])
+        v = self.v_proj(x).reshape([b, s, nkv, hd])
+        pos = Tensor(_jnp.broadcast_to(
+            _jnp.asarray(offset, _jnp.int32) + _jnp.arange(s, dtype=_jnp.int32),
+            (b, s)))
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=pos,
+            rotary_emb_base=self.cfg.rope_theta)
+        out, k_buf, v_buf = cached_attention(
+            q._data, k._data, v._data, k_buf, v_buf, offset,
+            1.0 / (hd ** 0.5))
+        out = Tensor(out).reshape([b, s, nh * hd])
+        return self.o_proj(out), k_buf, v_buf
+
 
 class LlamaMLP(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -204,6 +229,12 @@ class LlamaDecoderLayer(Layer):
             return recompute(_Body(), x)
         return self._block(x, position_ids, attn_mask)
 
+    def forward_cached(self, x, k_buf, v_buf, offset):
+        a, k_buf, v_buf = self.self_attn.forward_cached(
+            self.input_layernorm(x), k_buf, v_buf, offset)
+        h = x + a
+        return h + self.mlp(self.post_attention_layernorm(h)), k_buf, v_buf
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -236,8 +267,17 @@ class LlamaModel(Layer):
             x = all_gather(x, axis=1)
         return self.norm(x)
 
+    def forward_cached(self, input_ids, caches, offset):
+        """caches: list of (k_buf, v_buf) raw arrays per layer."""
+        x = self.embed_tokens(input_ids)
+        new = []
+        for layer, (kb, vb) in zip(self.layers, caches):
+            x, kb, vb = layer.forward_cached(x, kb, vb, offset)
+            new.append((kb, vb))
+        return self.norm(x), new
 
-class LlamaForCausalLM(Layer):
+
+class LlamaForCausalLM(Layer, GenerationMixin):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
@@ -273,6 +313,21 @@ class LlamaForCausalLM(Layer):
             h._fused_hidden = True
             return h
         return self.lm_head(h)
+
+    # -- static-cache generation hooks (GenerationMixin) ---------------------
+    def _init_caches(self, batch, total_len):
+        cfg = self.cfg
+        nkv = cfg.num_key_value_heads or cfg.num_attention_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        z = jnp.zeros((batch, total_len, nkv, hd), dt)
+        return [(z, z) for _ in range(cfg.num_hidden_layers)]
+
+    def _forward_cached(self, input_ids, caches, offset):
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(input_ids)
+        h, caches = self.llama.forward_cached(ids, caches, offset)
+        return self.lm_head(h)._data, caches
 
 
 class _TiedLMHead(Layer):

@@ -1,14 +1,20 @@
-"""paddle_tpu.static — static-graph compatibility shims.
+"""paddle_tpu.static — static-graph mode.
 
 Reference parity: paddle.static.* (upstream python/paddle/static/ —
-unverified, see SURVEY.md §2.2). This framework is eager-first with
-jax.jit compilation (SURVEY.md §7 design stance: PIR/program machinery
-collapses into tracing); the static API surface maps onto the jit/export
-path so reference scripts keep working:
+unverified, see SURVEY.md §2.2). Two tiers:
 
-- InputSpec → shape/dtype specs for to_static/jit.save
-- save/load_inference_model → jit.save/load (StableHLO artifact)
-- program_guard/default_main_program → no-op context shims
+- **Real Program/Executor** (static/program.py): `program_guard` records
+  the op DAG through the autograd chokepoint while ops run eagerly on
+  placeholder zeros; `Executor.run(prog, feed, fetch_list)` replays it as
+  ONE jitted XLA computation per feed signature. Inference-style programs
+  (data → layers/ops → fetch) work end-to-end; parameters created inside
+  the guard stay live Tensors, so their trained values flow into later
+  runs.
+- Deployment save/load maps onto jit.save/load (StableHLO artifacts).
+
+Static TRAINING (append_backward, static optimizer rewriting) is
+intentionally not re-built: the dynamic path (`to_static`, fleet Engine)
+is this framework's compiled-training story (PARITY.md "Static API").
 """
 from __future__ import annotations
 
@@ -17,40 +23,15 @@ import contextlib
 from ..jit.save_load import InputSpec, TranslatedLayer  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
+from . import nn  # noqa: F401
+from .program import (Executor, Program, data, default_main_program,  # noqa: F401
+                      default_startup_program, global_scope,
+                      program_guard, scope_guard)
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
-           "Program", "program_guard", "default_main_program",
-           "default_startup_program", "name_scope", "device_guard"]
-
-
-class Program:
-    """Placeholder Program: compiled programs are jaxprs managed by jit."""
-
-    def __init__(self):
-        self._is_shim = True
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-
-_main = Program()
-_startup = Program()
-
-
-def default_main_program():
-    return _main
-
-
-def default_startup_program():
-    return _startup
-
-
-@contextlib.contextmanager
-def program_guard(main_program=None, startup_program=None):
-    yield
+           "Program", "program_guard", "data", "Executor",
+           "default_main_program", "default_startup_program",
+           "global_scope", "scope_guard", "name_scope", "device_guard"]
 
 
 @contextlib.contextmanager

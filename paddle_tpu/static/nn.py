@@ -1,0 +1,80 @@
+"""paddle.static.nn parity — layer-functions usable inside program_guard
+(reference: python/paddle/static/nn/ fc/conv2d/batch_norm — unverified;
+SURVEY.md §2.2 "Static API").
+
+Each call creates the parameters eagerly (they become live leaf inputs
+of the active Program) and runs the op through the recorded functional
+path — so `Executor.run` replays with current weights.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..core.tensor import Parameter
+from ..ops._base import ensure_tensor
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    x = ensure_tensor(x)
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= d
+    if len(x.shape) > num_flatten_dims + 1:
+        x = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    w = Parameter(I.XavierNormal()((in_dim, size), jnp.float32))
+    b = Parameter(jnp.zeros((size,), jnp.float32)) \
+        if bias_attr is not False else None
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    input = ensure_tensor(input)
+    cin = input.shape[1]
+    ks = (filter_size if isinstance(filter_size, (tuple, list))
+          else (filter_size, filter_size))
+    w = Parameter(I.XavierNormal()(
+        (num_filters, cin // groups) + tuple(ks), jnp.float32))
+    b = Parameter(jnp.zeros((num_filters,), jnp.float32)) \
+        if bias_attr is not False else None
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=True, name=None):
+    """Inference-mode BN (static programs are inference programs here)."""
+    from ..core.tensor import Tensor
+    input = ensure_tensor(input)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    gamma = Parameter(jnp.ones((c,), jnp.float32))
+    beta = Parameter(jnp.zeros((c,), jnp.float32))
+    mean = Tensor(jnp.zeros((c,), jnp.float32))
+    var = Tensor(jnp.ones((c,), jnp.float32))
+    out = F.batch_norm(input, mean, var, gamma, beta, training=False,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    from ..core.dtype import convert_dtype
+    input = ensure_tensor(input)
+    w = Parameter(I.XavierNormal()(tuple(size), convert_dtype(dtype)))
+    return F.embedding(input, w, padding_idx=padding_idx)

@@ -1,0 +1,254 @@
+"""Real static-graph mode: Program recording + Executor replay.
+
+Reference parity: paddle.static Program/program_guard/data/Executor.run
+(upstream python/paddle/static/, paddle/fluid/framework ProgramDesc +
+executor — unverified; SURVEY.md §2.1 "Legacy framework", §2.2 "Static
+API").
+
+TPU-native design: the reference's ProgramDesc is an op-list IR executed
+op-by-op; here the IR is the framework's own op stream. Every
+differentiable op already flows through `core.autograd.apply` — under
+`program_guard` that chokepoint appends (fn, input-keys, output-keys) to
+the active Program while ops still execute eagerly on placeholder zeros
+(shape inference for free, any Python control flow already resolved,
+exactly like tracing). `Executor.run(program, feed, fetch_list)` replays
+the recorded op list as a PURE function of the feeds — parameters and
+recorded constants enter as leaf inputs, read at run time so a trained
+weight updates the program's behavior — and compiles the whole replay
+with `jax.jit` (cached per feed signature). That makes Executor.run one
+XLA computation per signature: the reference's
+ProgramDesc→executor→kernel-loop pipeline collapsed into trace + XLA.
+
+Training via Program (append_backward / static optimizers) stays out of
+scope — the dynamic path with `to_static` / fleet Engine covers it
+(PARITY.md "Static API").
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "data", "Executor", "global_scope",
+           "scope_guard"]
+
+
+class _Record:
+    __slots__ = ("fn", "in_keys", "out_keys", "name")
+
+    def __init__(self, fn, in_keys, out_keys, name):
+        self.fn = fn
+        self.in_keys = in_keys
+        self.out_keys = out_keys
+        self.name = name
+
+
+class Program:
+    """A recorded op DAG (the TPU-native ProgramDesc)."""
+
+    def __init__(self):
+        self._records: list[_Record] = []
+        self._feeds: dict[str, int] = {}       # data name -> key
+        self._leaves: dict[int, object] = {}   # key -> Tensor
+        self._produced: set[int] = set()
+        self._jit_cache: dict = {}
+        # Strong refs to EVERY tensor whose id() appears in the record —
+        # id() keys are only unique while the object lives; without the
+        # pin, a freed intermediate's id could be reused by a later
+        # tensor and silently corrupt the DAG.
+        self._pins: list = []
+
+    # -- recording (called from autograd.apply) -----------------------------
+    def record(self, fn, in_tensors, out_tensors, name=""):
+        in_keys = []
+        for t in in_tensors:
+            k = id(t)
+            if k not in self._produced and k not in self._leaves:
+                # leaf: a parameter (replayed from its live value) or a
+                # constant created outside/inside the guard
+                self._leaves[k] = t
+            in_keys.append(k)
+        out_keys = [id(t) for t in out_tensors]
+        self._produced.update(out_keys)
+        self._pins.extend(in_tensors)
+        self._pins.extend(out_tensors)
+        self._records.append(_Record(fn, tuple(in_keys), tuple(out_keys),
+                                     name))
+
+    def _register_feed(self, name, tensor):
+        self._feeds[name] = id(tensor)
+        self._produced.add(id(tensor))  # fed, not a leaf constant
+        self._pins.append(tensor)
+
+    # -- reference API surface ----------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def all_parameters(self):
+        from ..core.tensor import Parameter
+        return [t for t in self._leaves.values()
+                if isinstance(t, Parameter)]
+
+    @property
+    def num_ops(self):
+        return len(self._records)
+
+    # -- replay --------------------------------------------------------------
+    def run(self, feed, fetch_list):
+        feed = feed or {}
+        fetch_keys = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_keys.append(id(f))
+            elif isinstance(f, str) and f in self._feeds:
+                fetch_keys.append(self._feeds[f])
+            else:
+                raise TypeError(f"fetch_list entries must be Tensors "
+                                f"(got {f!r})")
+        names = sorted(self._feeds)
+        missing = [n for n in names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        feed_arrays = [jnp.asarray(feed[n]._data if isinstance(feed[n],
+                                                               Tensor)
+                                   else feed[n]) for n in names]
+        # key order must match _replay's zip over self._feeds.values()
+        ordered_keys = [self._feeds[n] for n in names]
+        leaf_arrays = [t._data for t in self._leaves.values()]
+
+        sig = (tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+               tuple(fetch_keys))
+        fn = self._jit_cache.get(sig)
+        if fn is None:
+            def pure(feed_arrays, leaf_arrays):
+                env = dict(zip(ordered_keys, feed_arrays))
+                env.update(zip(self._leaves.keys(), leaf_arrays))
+                for rec in self._records:
+                    try:
+                        args = [env[k] for k in rec.in_keys]
+                    except KeyError as e:
+                        raise RuntimeError(
+                            f"static Program replay: op "
+                            f"{rec.name or rec.fn} consumes a value not "
+                            f"reachable from feeds/leaves ({e}); was it "
+                            f"created under a different Program?")
+                    out = rec.fn(*args)
+                    outs = out if isinstance(out, (tuple, list)) else (out,)
+                    env.update(zip(rec.out_keys, outs))
+                return [env[k] for k in fetch_keys]
+
+            fn = jax.jit(pure)
+            self._jit_cache[sig] = fn
+        outs = fn(feed_arrays, leaf_arrays)
+        return [np.asarray(o) for o in outs]
+
+
+_default_main = Program()
+_default_startup = Program()
+_active: Program | None = None
+
+
+def default_main_program():
+    return _active if _active is not None else _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """Activate a Program: ops executed in the block are recorded."""
+    global _active
+    prog = main_program if isinstance(main_program, Program) else Program()
+    prev_active = _active
+    _active = prog
+    prev = _ag._set_static_recorder(prog)
+    try:
+        yield prog
+    finally:
+        _ag._set_static_recorder(prev)
+        _active = prev_active
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder: a zero Tensor with the declared shape.
+
+    Dynamic dims (-1/None) become 1 for the RECORDING pass; Executor.run
+    then re-traces the replay per concrete feed signature. This covers
+    shape-polymorphic programs (elementwise/matmul/reduce chains — jax
+    tracing re-specializes them at run). A program whose PYTHON code
+    reads `x.shape` at build time (e.g. reshape computed from the
+    placeholder dim) bakes the stand-in 1 into the recorded op — declare
+    concrete shapes for such programs, as with any trace-specialized
+    system."""
+    prog = _active
+    shp = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+    t = Tensor(jnp.zeros(shp, convert_dtype(dtype)))
+    t.name = name
+    if prog is not None:
+        prog._register_feed(name, t)
+    return t
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _scope
+    prev = _scope
+    _scope = scope
+    try:
+        yield
+    finally:
+        _scope = prev
+
+
+class Executor:
+    """paddle.static.Executor over the replay engine (place-agnostic:
+    XLA owns placement)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, **kwargs):
+        if program is None:
+            program = default_main_program()
+        elif not isinstance(program, Program):
+            raise TypeError(
+                f"Executor.run expects a paddle_tpu.static.Program, got "
+                f"{type(program).__name__}")
+        if not program._records and not fetch_list:
+            return []  # startup program: parameters are already live
+        if fetch_list is None:
+            return []
+        return program.run(feed, fetch_list)
+
+    def close(self):
+        pass

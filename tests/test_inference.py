@@ -100,3 +100,52 @@ class TestRoleMaker:
         finally:
             _state.initialized = False
             set_hybrid_communicate_group(None)
+
+
+class TestInferencePasses:
+    """inference.passes — conv/linear+BN folding, dropout elimination."""
+
+    def _train_a_bit(self, net, x):
+        # make BN stats non-trivial
+        net.train()
+        for _ in range(3):
+            net(P.to_tensor(x))
+        net.eval()
+
+    def test_conv_bn_fold_preserves_output(self):
+        import numpy as np
+        P.seed(0)
+        net = P.nn.Sequential(P.nn.Conv2D(3, 8, 3, padding=1),
+                              P.nn.BatchNorm2D(8), P.nn.ReLU(),
+                              P.nn.Dropout(0.5))
+        x = np.random.default_rng(0).standard_normal(
+            (2, 3, 8, 8)).astype(np.float32)
+        self._train_a_bit(net, x)
+        ref = np.asarray(net(P.to_tensor(x))._data)
+        from paddle_tpu.inference import optimize
+        report = optimize(net)
+        assert report["conv_bn_fuse"] == 1
+        assert report["delete_dropout"] == 1
+        got = np.asarray(net(P.to_tensor(x))._data)
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_linear_bn_fold(self):
+        import numpy as np
+        P.seed(0)
+        net = P.nn.Sequential(P.nn.Linear(6, 10), P.nn.BatchNorm1D(10))
+        x = np.random.default_rng(1).standard_normal((4, 6)).astype(
+            np.float32)
+        self._train_a_bit(net, x)
+        ref = np.asarray(net(P.to_tensor(x))._data)
+        from paddle_tpu.inference import optimize
+        report = optimize(net, passes=["conv_bn_fuse"])
+        assert report["conv_bn_fuse"] == 1
+        got = np.asarray(net(P.to_tensor(x))._data)
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_unknown_pass_raises(self):
+        import pytest as _pt
+        from paddle_tpu.inference import optimize
+        net = P.nn.Linear(2, 2)
+        with _pt.raises(KeyError):
+            optimize(net, passes=["nope"])
