@@ -1,0 +1,75 @@
+"""Decode-throughput bench: LLaMA proxy autoregressive generation with
+the static-KV-cache jitted decode loop (models/generation.py).
+
+Usage: python bench_generate.py [batch] [prompt_len] [new_tokens]
+Prints one JSON line {metric, value (decode tokens/sec), ...}.
+Results log: PERF.md.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+
+def main():
+    from bench import _tpu_usable  # bounded subprocess probe (wedge-safe)
+    tpu_ok = _tpu_usable(attempts=2, probe_timeout=90, backoff=20)
+    import jax
+    if not tpu_ok:
+        import jax._src.xla_bridge as xb
+        try:
+            xb._clear_backends()
+            xb.get_backend.cache_clear()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=prompt + new,
+                          dtype="bfloat16")
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=prompt + new)
+    P.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    x = P.to_tensor(ids)
+
+    out = model.generate(x, max_new_tokens=new)   # compile + run
+    out._data.block_until_ready()
+    t0 = time.perf_counter()
+    out = model.generate(x, max_new_tokens=new)
+    out._data.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * new / dt
+    print(json.dumps({
+        "metric": "llama_decode_tok_per_s" + ("" if on_tpu else "_cpu"),
+        "value": round(tok_s, 1),
+        "unit": "decode tokens/sec (batch total, static-cache jitted loop)",
+        "batch": batch, "prompt": prompt, "new_tokens": new,
+        "wall_s": round(dt, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

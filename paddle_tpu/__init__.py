@@ -47,7 +47,14 @@ from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401
+from . import utils  # noqa: F401
+from . import version  # noqa: F401
 from . import vision  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
+from . import sysconfig  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 from .distributed.parallel import DataParallel  # noqa: E402
 from .framework.io_save import load, save  # noqa: E402
@@ -64,8 +71,11 @@ def disable_static(place=None):
 
 def enable_static():
     raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use "
-        "paddle_tpu.jit.to_static for compiled execution.")
+        "paddle_tpu's static mode is scoped, not global: build programs "
+        "with `with paddle_tpu.static.program_guard(prog): ...` and run "
+        "them via static.Executor (record-and-replay over XLA); "
+        "compiled training uses paddle_tpu.jit.to_static / fleet "
+        "Engine.")
 
 
 def in_dynamic_mode():
@@ -78,3 +88,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     from .core.autograd import grad as _grad
     return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
                  only_inputs, allow_unused)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch: wrap a sample reader into a mini-batch reader
+    (reference: python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

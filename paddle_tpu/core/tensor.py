@@ -190,6 +190,23 @@ class Tensor:
     def pin_memory(self):
         return self  # no host pinned memory concept under PJRT
 
+    def cuda(self, device_id=None, blocking=True):
+        """Reference compat: moves to the accelerator — here the default
+        PJRT device (TPU when present)."""
+        return Tensor(jax.device_put(self._data, jax.devices()[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def ndimension(self):
+        return len(self._data.shape)
+
+    def new_zeros(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) if dtype else self._data.dtype
+        return Tensor(jnp.zeros(tuple(shape), d))
+
+    def new_ones(self, shape, dtype=None):
+        d = dtypes.convert_dtype(dtype) if dtype else self._data.dtype
+        return Tensor(jnp.ones(tuple(shape), d))
+
     def contiguous(self):
         return self  # XLA owns layout
 
@@ -223,6 +240,19 @@ class Tensor:
 
     def scale_(self, scale=1.0, bias=0.0):
         return self._inplace_update(self._data * scale + bias)
+
+    def normal_(self, mean=0.0, std=1.0):
+        from .random import next_key
+        import jax.random as jrandom
+        return self._inplace_update(
+            (mean + std * jrandom.normal(next_key(), self._data.shape)
+             ).astype(self._data.dtype))
+
+    def uniform_(self, min=-1.0, max=1.0):
+        from .random import next_key
+        import jax.random as jrandom
+        return self._inplace_update(jrandom.uniform(
+            next_key(), self._data.shape, self._data.dtype, min, max))
 
     # -- misc --------------------------------------------------------------
     def block_until_ready(self):

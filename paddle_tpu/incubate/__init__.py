@@ -1,3 +1,23 @@
 """paddle_tpu.incubate (reference: paddle.incubate)."""
 from . import asp  # noqa: F401
+from . import moe  # noqa: F401
 from . import nn  # noqa: F401
+
+
+class DistributedFusedLamb:
+    '''Reference paddle.incubate.DistributedFusedLamb: the fused
+    multi-tensor LAMB with sharded states. TPU-natively the fused-update
+    and distribution concerns collapse into optimizer.Lamb (single fused
+    XLA update) running inside the fleet SPMD stepper (states sharded by
+    the ZeRO annotations) — construct Lamb and pass it through
+    fleet.distributed_optimizer.'''
+
+    def __new__(cls, learning_rate=0.001, parameters=None, **kwargs):
+        from ..optimizer import Lamb
+        kwargs.pop("clip_after_allreduce", None)
+        kwargs.pop("is_grad_scaled_by_nranks", None)
+        kwargs.pop("use_master_param_norm", None)
+        kwargs.pop("gradient_accumulation_steps", None)
+        kwargs.pop("use_master_acc_grad", None)
+        return Lamb(learning_rate=learning_rate, parameters=parameters,
+                    **kwargs)

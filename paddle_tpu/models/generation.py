@@ -89,6 +89,17 @@ class GenerationMixin:
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
+        # weights are jit-captured constants — key the program cache on
+        # the parameter versions (and array identities) so a trained /
+        # reloaded model recompiles instead of generating from stale
+        # weights
+        wsig = tuple((id(t._data), t._version) for t in self.parameters())
+        if getattr(self, "_gen_wsig", None) != wsig:
+            # weights changed since the programs were compiled: all
+            # cached programs hold stale constants — drop them
+            if getattr(self, "_gen_cache", None):
+                self._gen_cache.clear()
+            self._gen_wsig = wsig
         sig = (b, s, int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p), eos)
         fn = self._gen_program(sig)
@@ -100,7 +111,16 @@ class GenerationMixin:
             self._gen_cache[sig] = fn
         key = _random.next_key() if seed is None else \
             jax.random.PRNGKey(seed)
-        return Tensor(fn(ids, key))
+        # generation is inference: dropout etc. must be off regardless of
+        # the module's training flag (the cached path has no dropout)
+        was_training = getattr(self, "training", False)
+        if was_training:
+            self.eval()
+        try:
+            return Tensor(fn(ids, key))
+        finally:
+            if was_training:
+                self.train()
 
 
 def _sample_token(logits, key, do_sample, temperature, top_k, top_p):

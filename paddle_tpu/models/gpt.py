@@ -12,6 +12,7 @@ import paddle_tpu as P
 from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
+from .generation import GenerationMixin
 from ..nn import Dropout, Embedding, Layer, LayerList, LayerNorm, Linear
 from ..nn import functional as F
 
@@ -71,6 +72,19 @@ class GPTAttention(Layer):
             training=self.training)
         return self.out_proj(out.reshape([b, s, self.nh * self.hd]))
 
+    def forward_cached(self, x, k_buf, v_buf, offset):
+        """Static-cache decode path (models/generation.py)."""
+        from .generation import cached_attention
+        from ..core.tensor import Tensor
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.nh, self.hd])
+        q, k, v = qkv.unbind(axis=2)
+        out, k_buf, v_buf = cached_attention(
+            q._data, k._data, v._data, k_buf, v_buf, offset,
+            1.0 / (self.hd ** 0.5))
+        out = Tensor(out).reshape([b, s, self.nh * self.hd])
+        return self.out_proj(out), k_buf, v_buf
+
 
 class GPTBlock(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -112,6 +126,14 @@ class GPTBlock(Layer):
             return recompute(_Body(), x)
         return self._block(x)
 
+    def forward_cached(self, x, k_buf, v_buf, offset):
+        a, k_buf, v_buf = self.attn.forward_cached(self.ln_1(x), k_buf,
+                                                   v_buf, offset)
+        x = x + a
+        return (x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
+                                       approximate=True)),
+                k_buf, v_buf)
+
 
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -128,6 +150,20 @@ class GPTModel(Layer):
                             for _ in range(cfg.num_hidden_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
 
+    def forward_cached(self, input_ids, caches, offset):
+        import jax.numpy as _jnp
+        from ..core.tensor import Tensor
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        pos = Tensor(_jnp.broadcast_to(
+            _jnp.asarray(offset, _jnp.int32) +
+            _jnp.arange(s, dtype=_jnp.int32), (b, s)))
+        x = self.wte(input_ids) + self.wpe(pos)
+        new = []
+        for blk, (kb, vb) in zip(self.h, caches):
+            x, kb, vb = blk.forward_cached(x, kb, vb, offset)
+            new.append((kb, vb))
+        return self.ln_f(x), new
+
     def forward(self, input_ids, position_ids=None):
         s = input_ids.shape[1]
         if position_ids is None:
@@ -139,7 +175,7 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
@@ -154,3 +190,19 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, position_ids=None):
         return self.lm_head(self.gpt(input_ids, position_ids))
+
+    # -- static-cache generation hooks (GenerationMixin) ---------------------
+    def _init_caches(self, batch, total_len):
+        import jax.numpy as _jnp
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        z = _jnp.zeros((batch, total_len, cfg.num_attention_heads, hd),
+                       _jnp.float32)
+        return [(z, z) for _ in range(cfg.num_hidden_layers)]
+
+    def _forward_cached(self, input_ids, caches, offset):
+        from ..core.tensor import Tensor
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(input_ids)
+        h, caches = self.gpt.forward_cached(ids, caches, offset)
+        return self.lm_head(h)._data, caches

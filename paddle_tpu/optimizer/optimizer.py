@@ -29,13 +29,27 @@ class _L2DecayStub:
         self.coeff = float(coeff)
 
 
+def _is_l1(weight_decay) -> bool:
+    from ..regularizer import L1Decay
+    return isinstance(weight_decay, L1Decay)
+
+
 def _decay_coeff(weight_decay):
     if weight_decay is None:
         return 0.0
     if isinstance(weight_decay, (int, float)):
         return float(weight_decay)
+    if _is_l1(weight_decay):
+        return 0.0  # L1 is applied as a gradient augmentation, not decay
     return float(getattr(weight_decay, "coeff",
                          getattr(weight_decay, "_coeff", 0.0)))
+
+
+def _l1_coeff(weight_decay):
+    if weight_decay is not None and not isinstance(
+            weight_decay, (int, float)) and _is_l1(weight_decay):
+        return float(weight_decay.coeff)
+    return 0.0
 
 
 class Optimizer:
@@ -50,6 +64,7 @@ class Optimizer:
         self._lr = learning_rate
         self._grad_clip = grad_clip
         self._weight_decay = _decay_coeff(weight_decay)
+        self._l1 = _l1_coeff(weight_decay)
         self._multi_precision = multi_precision
         self._use_master_weights = multi_precision
         self._step_count = 0
@@ -115,6 +130,9 @@ class Optimizer:
     def _update_one(self, p, g, s, lr, step, hp):
         """One leaf through the XLA update rule (master-weight aware)."""
         compute = s.get("master", p)
+        if getattr(self, "_l1", 0.0):
+            # L1Decay regularizer: subgradient coeff·sign(w) on the grad
+            g = g.astype(compute.dtype) + self._l1 * jnp.sign(compute)
         np_, ns = self._update(compute, g.astype(compute.dtype), s, lr,
                                step, hp)
         if "master" in s:

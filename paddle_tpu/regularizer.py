@@ -1,0 +1,31 @@
+"""paddle.regularizer parity: L1Decay / L2Decay (reference:
+python/paddle/regularizer.py — unverified, SURVEY.md §2.2 Optimizers
+"regularizer").
+
+The optimizer consumes `weight_decay=L2Decay(c)` via its `coeff`
+attribute (L2 == the fused update's decay term). L1Decay applies the
+subgradient sign(w)·c by augmenting the gradient — exposed as a
+callable the optimizer recognizes.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay:
+    """L1 weight decay. Optimizers detect this type and add
+    coeff * sign(param) to the gradient before the update rule."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

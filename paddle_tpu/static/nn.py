@@ -25,7 +25,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for d in x.shape[num_flatten_dims:]:
         in_dim *= d
     if len(x.shape) > num_flatten_dims + 1:
-        x = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+        # -1 for the leading dims: the recorded reshape must not bake the
+        # data() placeholder's stand-in batch size (dynamic feeds replay
+        # with real sizes)
+        x = x.reshape([-1, in_dim] if num_flatten_dims == 1 else
+                      list(x.shape[:num_flatten_dims]) + [in_dim])
     w = Parameter(I.XavierNormal()((in_dim, size), jnp.float32))
     b = Parameter(jnp.zeros((size,), jnp.float32)) \
         if bias_attr is not False else None

@@ -1,0 +1,14 @@
+"""paddle.sysconfig parity: get_include/get_lib (reference:
+python/paddle/sysconfig.py). Points at this package's native artifacts
+(C ABI shared objects built by paddle_tpu.native)."""
+import os
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_PKG, "native")
+
+
+def get_lib():
+    return os.path.join(_PKG, "native")

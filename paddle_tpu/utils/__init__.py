@@ -1,0 +1,109 @@
+"""paddle.utils parity (reference: python/paddle/utils/ — unverified,
+SURVEY.md §2.2 "Misc domains"): unique_name, deprecated, try_import,
+run_check, plus the cpp_extension note.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import importlib
+import warnings
+
+__all__ = ["unique_name", "deprecated", "try_import", "run_check"]
+
+
+class _UniqueNames:
+    """paddle.utils.unique_name: generate/guard/switch."""
+
+    def __init__(self):
+        self._counters: dict[str, int] = {}
+
+    def generate(self, key="tmp"):
+        i = self._counters.get(key, 0)
+        self._counters[key] = i + 1
+        return f"{key}_{i}"
+
+    def switch(self, new_generator=None):
+        old = dict(self._counters)
+        self._counters = {} if new_generator is None else new_generator
+        return old
+
+    @contextlib.contextmanager
+    def guard(self, new_generator=None):
+        old = self.switch(new_generator)
+        try:
+            yield
+        finally:
+            self._counters = old
+
+
+unique_name = _UniqueNames()
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference signature)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; "
+                       f"this image forbids pip install — gate the "
+                       f"feature instead.")
+
+
+def run_check():
+    """paddle.utils.run_check(): verify the framework can compute on the
+    available device (the reference's install sanity check)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as P
+    dev = jax.devices()[0]
+    x = P.to_tensor(np.eye(4, dtype=np.float32))
+    y = (x @ x).sum()
+    ok = abs(float(np.asarray(y._data)) - 4.0) < 1e-5
+    # a grad pass, too — the install check the reference runs
+    w = P.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (w * w).sum().backward()
+    ok = ok and np.allclose(np.asarray(w.grad._data), 2.0)
+    plat = getattr(dev, "platform", "cpu")
+    kind = getattr(dev, "device_kind", plat)
+    if ok:
+        print(f"PaddleTPU works well on 1 {kind} ({plat}).")
+        print("PaddleTPU is installed successfully!")
+    else:
+        raise RuntimeError("run_check failed: compute/grad mismatch")
+    return ok
+
+
+class _CppExtensionStub:
+    """Reference paddle.utils.cpp_extension builds pybind11 custom ops;
+    this image has no pybind11 — native extensions here use the ctypes
+    C-ABI pattern (see paddle_tpu/native/). Any attribute access
+    (cpp_extension.load / .setup / .CppExtension) fails loudly with
+    that guidance."""
+
+    def __getattr__(self, name):
+        raise NotImplementedError(
+            f"cpp_extension.{name} is not available (no pybind11 in "
+            "this environment); write a C ABI + ctypes binding instead "
+            "— see paddle_tpu/native/ for the pattern.")
+
+
+cpp_extension = _CppExtensionStub()

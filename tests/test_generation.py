@@ -90,3 +90,54 @@ class TestGenerate:
                                       do_sample=True, top_k=1,
                                       seed=0)._data)
         np.testing.assert_array_equal(greedy, topk1)
+
+
+class TestGPTGenerate:
+    def test_gpt_greedy_matches_full_context(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        P.seed(0)
+        cfg = GPTConfig(vocab_size=83, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=32,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = np.random.default_rng(0).integers(0, 83, (2, 4)).astype(
+            np.int32)
+        got = np.asarray(m.generate(P.to_tensor(ids),
+                                    max_new_tokens=5)._data)
+        cur = ids.copy()
+        for i in range(5):
+            logits = np.asarray(m(P.to_tensor(cur))._data)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            np.testing.assert_array_equal(got[:, i], nxt)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+
+class TestGenerateCacheInvalidation:
+    def test_weight_update_invalidates_program(self):
+        m = tiny_model(seed=5)
+        ids = np.zeros((1, 3), np.int32)
+        a = np.asarray(m.generate(P.to_tensor(ids), max_new_tokens=3)._data)
+        # mutate a weight: cached program must NOT serve stale constants
+        w = m.lm_head.weight
+        w._inplace_update(w._data + 1.0)
+        b = np.asarray(m.generate(P.to_tensor(ids), max_new_tokens=3)._data)
+        # recompute oracle with the new weights
+        cur = ids.copy()
+        for i in range(3):
+            logits = np.asarray(m(P.to_tensor(cur))._data)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            assert b[0, i] == nxt[0], (i, a, b)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+    def test_generate_in_train_mode_uses_eval_semantics(self):
+        m = tiny_model(seed=6)
+        ids = np.zeros((1, 3), np.int32)
+        ref = np.asarray(m.generate(P.to_tensor(ids), max_new_tokens=3)._data)
+        m.train()
+        got = np.asarray(m.generate(P.to_tensor(ids), max_new_tokens=3)._data)
+        np.testing.assert_array_equal(got, ref)
+        assert m.training  # restored

@@ -105,3 +105,17 @@ class TestStaticNN:
         feed = np.ones((2, 3, 8, 8), np.float32)
         (o,) = exe.run(main, feed={"x": feed}, fetch_list=[b])
         assert o.shape == (2, 4, 8, 8)
+
+
+class TestStaticNNDynamicBatch:
+    def test_fc_flattens_with_dynamic_batch(self):
+        from paddle_tpu import static
+        P.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2, 3], "float32")
+            out = static.nn.fc(x, 5)
+        exe = static.Executor()
+        feed = np.ones((4, 2, 3), np.float32)
+        (o,) = exe.run(main, feed={"x": feed}, fetch_list=[out])
+        assert o.shape == (4, 5)

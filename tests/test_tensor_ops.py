@@ -318,3 +318,25 @@ class TestScalarClosureTyping:
         assert np.array_equal(i.numpy(), [2, 4])
         b = P.to_tensor(np.array([True, False])) * True
         assert np.array_equal(np.asarray(b.numpy(), bool), [True, False])
+
+
+class TestTensorMethodParity:
+    """Reference Tensor-method surface additions."""
+
+    def test_new_zeros_ones_cuda_ndim(self):
+        t = P.to_tensor(np.ones((2, 3), np.float32))
+        assert t.cuda().shape == [2, 3]
+        assert t.ndimension() == 2
+        assert t.new_zeros([4]).shape == [4]
+        z = t.new_ones([2], "int32")
+        assert z._data.dtype == np.int32 and np.asarray(z._data).sum() == 2
+
+    def test_inplace_random_fills(self):
+        P.seed(0)
+        t = P.to_tensor(np.zeros((256,), np.float32))
+        t.normal_(2.0, 0.05)
+        m = float(np.asarray(t._data).mean())
+        assert 1.9 < m < 2.1
+        t.uniform_(3.0, 4.0)
+        a = np.asarray(t._data)
+        assert a.min() >= 3.0 and a.max() <= 4.0

@@ -1,0 +1,119 @@
+"""Top-level namespace parity: utils / version / regularizer / batch /
+hub / sysconfig / incubate.DistributedFusedLamb."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+class TestUtils:
+    def test_run_check(self, capsys):
+        assert P.utils.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+
+    def test_unique_name_guard(self):
+        un = P.utils.unique_name
+        with un.guard():
+            a = un.generate("x")
+            b = un.generate("x")
+        assert a != b
+        with un.guard():
+            assert un.generate("x") == a  # counter reset inside guard
+
+    def test_deprecated_warns(self):
+        @P.utils.deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 42
+
+    def test_version(self):
+        assert P.version.full_version
+        P.version.show()
+
+
+class TestRegularizer:
+    def test_l2_decay_changes_update(self):
+        P.seed(0)
+
+        def run(wd):
+            P.seed(0)
+            lin = P.nn.Linear(4, 4)
+            opt = P.optimizer.SGD(0.1, parameters=lin.parameters(),
+                                  weight_decay=wd)
+            lin(P.to_tensor(np.ones((2, 4), np.float32))).sum().backward()
+            opt.step()
+            return np.asarray(lin.weight._data)
+
+        w_plain = run(None)
+        w_l2 = run(P.L2Decay(0.5))
+        assert not np.allclose(w_plain, w_l2)
+
+    def test_l1_decay_sign_subgradient(self):
+        P.seed(0)
+        lin = P.nn.Linear(3, 3)
+        w0 = np.asarray(lin.weight._data).copy()
+        opt = P.optimizer.SGD(0.1, parameters=lin.parameters(),
+                              weight_decay=P.L1Decay(0.2))
+        # zero loss: grads are 0, so the whole step is -lr*c*sign(w)
+        (lin(P.to_tensor(np.zeros((1, 3), np.float32))).sum() * 0
+         ).backward()
+        opt.step()
+        w1 = np.asarray(lin.weight._data)
+        np.testing.assert_allclose(w1, w0 - 0.1 * 0.2 * np.sign(w0),
+                                   atol=1e-6)
+
+
+class TestBatchHubSysconfig:
+    def test_batch_reader(self):
+        r = P.batch(lambda: iter(range(10)), 4)
+        sizes = [len(b) for b in r()]
+        assert sizes == [4, 4, 2]
+        r2 = P.batch(lambda: iter(range(10)), 4, drop_last=True)
+        assert [len(b) for b in r2()] == [4, 4]
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=2):\n"
+            "    'a tiny model'\n"
+            "    import paddle_tpu as P\n"
+            "    return P.nn.Linear(n, n)\n")
+        assert "tiny" in P.hub.list(str(tmp_path))
+        assert "tiny model" in P.hub.help(str(tmp_path), "tiny")
+        m = P.hub.load(str(tmp_path), "tiny", n=3)
+        assert m.weight.shape == [3, 3]
+        with pytest.raises(RuntimeError):
+            P.hub.load("user/repo", "tiny", source="github")
+
+    def test_sysconfig_paths(self):
+        assert os.path.isdir(P.sysconfig.get_include())
+        assert os.path.isdir(P.sysconfig.get_lib())
+
+    def test_callbacks_namespace(self):
+        assert hasattr(P.callbacks, "ModelCheckpoint")
+
+    def test_distributed_fused_lamb_maps_to_lamb(self):
+        from paddle_tpu.incubate import DistributedFusedLamb
+        o = DistributedFusedLamb(
+            0.001, parameters=P.nn.Linear(2, 2).parameters(),
+            clip_after_allreduce=True)
+        assert type(o).__name__ == "Lamb"
+
+
+class TestReviewRegressions:
+    def test_cpp_extension_guidance(self):
+        with pytest.raises(NotImplementedError, match="ctypes"):
+            P.utils.cpp_extension.load
+        with pytest.raises(NotImplementedError, match="ctypes"):
+            P.utils.cpp_extension.CppExtension
+
+    def test_l1_subclass_detected(self):
+        class MyL1(P.L1Decay):
+            pass
+        from paddle_tpu.optimizer.optimizer import _decay_coeff, _l1_coeff
+        wd = MyL1(0.3)
+        assert _decay_coeff(wd) == 0.0
+        assert _l1_coeff(wd) == 0.3
