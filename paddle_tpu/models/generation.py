@@ -79,6 +79,10 @@ class GenerationMixin:
             cache = self._gen_cache = {}
         return cache.get(sig)
 
+    def _max_positions(self):
+        cfg = getattr(self, "cfg", None)
+        return getattr(cfg, "max_position_embeddings", None)
+
     @no_grad()
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
@@ -89,17 +93,24 @@ class GenerationMixin:
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
-        # weights are jit-captured constants — key the program cache on
-        # the parameter versions (and array identities) so a trained /
-        # reloaded model recompiles instead of generating from stale
-        # weights
-        wsig = tuple((id(t._data), t._version) for t in self.parameters())
-        if getattr(self, "_gen_wsig", None) != wsig:
-            # weights changed since the programs were compiled: all
-            # cached programs hold stale constants — drop them
+        # weights are jit-captured constants — drop cached programs when
+        # any parameter's array changed. Comparison is by IDENTITY
+        # against PINNED references (the pin keeps the arrays alive, so
+        # CPython id reuse cannot falsely validate a stale program).
+        warrs = [t._data for t in self.parameters()]
+        pinned = getattr(self, "_gen_pinned", None)
+        if pinned is None or len(pinned) != len(warrs) or \
+                any(a is not b for a, b in zip(pinned, warrs)):
             if getattr(self, "_gen_cache", None):
                 self._gen_cache.clear()
-            self._gen_wsig = wsig
+            self._gen_pinned = warrs
+        # context-length guard (the wpe/RoPE tables would silently clamp)
+        maxpos = self._max_positions()
+        if maxpos is not None and s + int(max_new_tokens) > maxpos:
+            raise ValueError(
+                f"generate: prompt_len({s}) + max_new_tokens"
+                f"({int(max_new_tokens)}) exceeds "
+                f"max_position_embeddings({maxpos})")
         sig = (b, s, int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p), eos)
         fn = self._gen_program(sig)

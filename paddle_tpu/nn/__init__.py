@@ -30,3 +30,5 @@ from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,
                           TransformerEncoderLayer)
+
+from .extended_layers import *  # noqa: E402,F401,F403

@@ -1,0 +1,158 @@
+"""Layer wrappers over the extended functional surface (reference:
+paddle.nn.{MaxPool3D, Bilinear, CTCLoss, ...} — thin state-holding
+shells over nn.functional, as upstream)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .layer import Layer
+from . import functional as F
+from . import initializer as I
+from ..core.tensor import Parameter
+
+# NOTE: Bilinear and ZeroPad2D intentionally absent — paddle_tpu.nn
+# already ships them (nn/common.py); re-exporting here would shadow the
+# canonical classes.
+__all__ = ["MaxPool3D", "AvgPool3D", "AdaptiveAvgPool3D",
+           "AdaptiveMaxPool1D", "CTCLoss", "LogSigmoid",
+           "RReLU", "MaxUnPool2D", "PixelUnshuffle",
+           "TripletMarginLoss", "PairwiseDistance", "GaussianNLLLoss"]
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        k, s, p = self._a
+        return F.max_pool3d(x, k, s, p)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        k, s, p = self._a
+        return F.avg_pool3d(x, k, s, p)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._os)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._os)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank = blank
+        self._red = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, blank=self._blank,
+                          reduction=self._red,
+                          norm_by_times=norm_by_times)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._l, self._u = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._l, self._u, training=self.training)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, os = self._a
+        return F.max_unpool2d(x, indices, k, s, p, output_size=os)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (margin, p, epsilon, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        m, p, e, sw, r = self._a
+        return F.triplet_margin_loss(input, positive, negative, m, p, e,
+                                     sw, r)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._a = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        p, e, k = self._a
+        return F.pairwise_distance(x, y, p, e, k)
+
+
+class GaussianNLLLoss(Layer):
+    """Reference paddle.nn.GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._full, self._eps, self._red = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        import math
+        from ..core.autograd import apply
+        from ..ops._base import ensure_tensor
+
+        def f(mu, y, var):
+            v = jnp.maximum(var, self._eps)
+            loss = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+            if self._full:
+                loss = loss + 0.5 * math.log(2 * math.pi)
+            if self._red == "mean":
+                return jnp.mean(loss)
+            if self._red == "sum":
+                return jnp.sum(loss)
+            return loss
+        return apply(f, ensure_tensor(input), ensure_tensor(label),
+                     ensure_tensor(variance), name="gaussian_nll")

@@ -937,3 +937,5 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 def pad(x, pad_, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ...ops.manipulation import pad as _pad
     return _pad(x, pad_, mode=mode, value=value, data_format=data_format)
+
+from .extended import *  # noqa: E402,F401,F403

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, indexing, linalg, logic, manipulation, math, random
+from . import creation, extras, indexing, linalg, logic, manipulation, math, random
 from .creation import *  # noqa: F401,F403
 from .linalg import (cholesky, cholesky_solve, corrcoef, cov, cross, cdist,
                      det, dist, eig, eigh, eigvals, eigvalsh,
@@ -17,6 +17,7 @@ from .linalg import (cholesky, cholesky_solve, corrcoef, cov, cross, cdist,
                      matrix_norm, matrix_power, matrix_rank, multi_dot, norm,
                      pinv, qr, slogdet, solve, svd, svdvals, trace,
                      triangular_solve, vector_norm)
+from .extras import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

@@ -1,0 +1,144 @@
+"""Long-tail op surface (reference: scattered across
+python/paddle/tensor/{math,manipulation,logic,creation}.py — unverified,
+SURVEY.md §2.2 "Tensor ops"). Everything lowers to one jax expression.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.dtype import convert_dtype, is_complex as _dtype_is_complex, \
+    is_floating_point as _dtype_is_float
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+__all__ = ["cast", "cat", "increment", "index_fill", "inverse",
+           "is_complex", "is_floating_point", "logcumsumexp", "nanmedian",
+           "nanquantile", "permute", "renorm", "sgn", "shape", "unflatten",
+           "vander"]
+
+
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)
+
+
+def cat(x, axis=0, name=None):
+    from .manipulation import concat
+    return concat(x, axis=axis)
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    x._inplace_update(x._data + jnp.asarray(value, x._data.dtype))
+    return x
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data.astype(jnp.int32)
+
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+    return apply(f, x, Tensor(idx).detach(), name="index_fill")
+
+
+def inverse(x, name=None):
+    from .linalg import inv
+    return inv(x)
+
+
+def is_complex(x):
+    return _dtype_is_complex(ensure_tensor(x)._data.dtype)
+
+
+def is_floating_point(x):
+    return _dtype_is_float(ensure_tensor(x)._data.dtype)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        m = jnp.max(a, axis=ax, keepdims=True)  # global max: stable shift
+        out = jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+    return apply(f, x, name="logcumsumexp")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.nanquantile(a, q, axis=axis,
+                                           keepdims=keepdim),
+                 x, name="nanquantile")
+
+
+def permute(x, perm, name=None):
+    from .manipulation import transpose
+    return transpose(x, perm)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference paddle.renorm)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply(f, x, name="renorm")
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, sign(x) for real."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(
+                mag, 1e-30))
+        return jnp.sign(a)
+    return apply(f, x, name="sgn")
+
+
+def shape(x, name=None):
+    """paddle.shape: the shape AS A TENSOR (static under jit)."""
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x._data.shape, jnp.int32))
+
+
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    shp = [int(s) for s in (shape._data.tolist()
+                            if isinstance(shape, Tensor) else shape)]
+
+    def f(a):
+        ax = axis if axis >= 0 else axis + a.ndim
+        return a.reshape(a.shape[:ax] + tuple(shp) + a.shape[ax + 1:])
+    return apply(f, x, name="unflatten")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+                 name="vander")

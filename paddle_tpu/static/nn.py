@@ -25,11 +25,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for d in x.shape[num_flatten_dims:]:
         in_dim *= d
     if len(x.shape) > num_flatten_dims + 1:
-        # -1 for the leading dims: the recorded reshape must not bake the
-        # data() placeholder's stand-in batch size (dynamic feeds replay
-        # with real sizes)
-        x = x.reshape([-1, in_dim] if num_flatten_dims == 1 else
-                      list(x.shape[:num_flatten_dims]) + [in_dim])
+        # keep dim 0 symbolic (-1): the recorded reshape must not bake
+        # the data() placeholder's stand-in batch size; dims
+        # 1..num_flatten_dims-1 stay concrete (only the batch dim is
+        # dynamic in the data() contract)
+        x = x.reshape([-1] + list(x.shape[1:num_flatten_dims]) + [in_dim])
     w = Parameter(I.XavierNormal()((in_dim, size), jnp.float32))
     b = Parameter(jnp.zeros((size,), jnp.float32)) \
         if bias_attr is not False else None

@@ -106,7 +106,13 @@ class TestShmHygiene:
         next(it)
         it.close()  # early termination — finally must drain & unlink
         gc.collect()
-        time.sleep(0.3)
-        after = set(glob.glob("/dev/shm/psm_*") +
-                    glob.glob("/dev/shm/pdtpu*"))
+        # worker teardown is async; poll instead of a fixed sleep (the
+        # fixed 0.3s flaked under full-suite CPU load)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            after = set(glob.glob("/dev/shm/psm_*") +
+                        glob.glob("/dev/shm/pdtpu*"))
+            if after <= before:
+                break
+            time.sleep(0.2)
         assert after <= before, f"leaked shm segments: {after - before}"

@@ -1,0 +1,187 @@
+"""Extended nn.functional surface — oracles are torch (cpu, baked in)
+where it has the op, else closed forms. SURVEY.md §4 op-test pattern."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as P
+
+F = P.nn.functional
+rng = np.random.default_rng(0)
+
+
+def t(x):
+    return P.to_tensor(x)
+
+
+def arr(x):
+    return np.asarray(x._data)
+
+
+class TestPools3D:
+    def test_max_avg_pool3d(self):
+        x = rng.standard_normal((2, 3, 8, 8, 8)).astype(np.float32)
+        got = arr(F.max_pool3d(t(x), 2, 2))
+        ref = tF.max_pool3d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        got = arr(F.avg_pool3d(t(x), 2, 2))
+        ref = tF.avg_pool3d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_adaptive_avg_pool3d(self):
+        x = rng.standard_normal((1, 2, 8, 8, 8)).astype(np.float32)
+        got = arr(F.adaptive_avg_pool3d(t(x), 4))
+        ref = tF.adaptive_avg_pool3d(torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_adaptive_max_pool1d(self):
+        x = rng.standard_normal((2, 3, 12)).astype(np.float32)
+        got = arr(F.adaptive_max_pool1d(t(x), 4))
+        ref = tF.adaptive_max_pool1d(torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        # non-divisible bins
+        got = arr(F.adaptive_max_pool1d(t(x), 5))
+        ref = tF.adaptive_max_pool1d(torch.tensor(x), 5).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+class TestLosses:
+    def test_ctc_loss_matches_torch(self):
+        T_, B, C, L = 12, 3, 6, 4
+        logits = rng.standard_normal((T_, B, C)).astype(np.float32)
+        labels = rng.integers(1, C, (B, L)).astype(np.int32)
+        il = np.asarray([12, 10, 8], np.int32)
+        ll = np.asarray([4, 3, 2], np.int32)
+        got = float(arr(F.ctc_loss(t(logits), t(labels), t(il), t(ll))))
+        ref = tF.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(il.astype(np.int64)),
+            torch.tensor(ll.astype(np.int64)), blank=0,
+            reduction="mean")
+        np.testing.assert_allclose(got, float(ref), atol=1e-4)
+
+    def test_triplet_and_focal_and_misc(self):
+        a = rng.standard_normal((4, 8)).astype(np.float32)
+        p = rng.standard_normal((4, 8)).astype(np.float32)
+        n = rng.standard_normal((4, 8)).astype(np.float32)
+        got = float(arr(F.triplet_margin_loss(t(a), t(p), t(n),
+                                              epsilon=0.0)))
+        ref = tF.triplet_margin_loss(torch.tensor(a), torch.tensor(p),
+                                     torch.tensor(n))
+        np.testing.assert_allclose(got, float(ref), atol=1e-5)
+
+        z = rng.standard_normal((6,)).astype(np.float32)
+        y = (rng.uniform(size=6) > 0.5).astype(np.float32)
+        got = float(arr(F.sigmoid_focal_loss(t(z), t(y))))
+        pt = torch.sigmoid(torch.tensor(z))
+        ce = tF.binary_cross_entropy_with_logits(
+            torch.tensor(z), torch.tensor(y), reduction="none")
+        p_t = pt * torch.tensor(y) + (1 - pt) * (1 - torch.tensor(y))
+        a_t = 0.25 * torch.tensor(y) + 0.75 * (1 - torch.tensor(y))
+        ref = (a_t * (1 - p_t) ** 2 * ce).sum()
+        np.testing.assert_allclose(got, float(ref), atol=1e-5)
+
+        x = np.asarray([0.3, 0.8], np.float32)
+        lab = np.asarray([0.0, 1.0], np.float32)
+        got = arr(F.log_loss(t(x), t(lab)))
+        ref = -(lab * np.log(x + 1e-4) + (1 - lab) * np.log(1 - x + 1e-4))
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        np.testing.assert_allclose(
+            arr(F.square_error_cost(t(x), t(lab))), (x - lab) ** 2,
+            atol=1e-6)
+
+    def test_dice_loss_perfect_prediction_near_zero(self):
+        lab = rng.integers(0, 3, (2, 10, 1)).astype(np.int64)
+        onehot = np.eye(3, dtype=np.float32)[lab[..., 0]]
+        loss = float(arr(F.dice_loss(t(onehot), t(lab))))
+        assert loss < 1e-3
+
+    def test_hsigmoid_loss_runs_and_grads(self):
+        x = P.to_tensor(rng.standard_normal((4, 8)).astype(np.float32),
+                        stop_gradient=False)
+        w = P.to_tensor(rng.standard_normal((9, 8)).astype(np.float32),
+                        stop_gradient=False)
+        lab = t(rng.integers(0, 10, (4,)).astype(np.int64))
+        loss = F.hsigmoid_loss(x, lab, 10, w)
+        assert float(arr(loss)) > 0
+        loss.backward()
+        assert x.grad is not None and w.grad is not None
+
+
+class TestVisionOpsF:
+    def test_grid_sample_bilinear_and_nearest(self):
+        x = rng.standard_normal((2, 3, 7, 7)).astype(np.float32)
+        g = rng.uniform(-1, 1, (2, 5, 5, 2)).astype(np.float32)
+        for mode in ("bilinear", "nearest"):
+            got = arr(F.grid_sample(t(x), t(g), mode=mode))
+            ref = tF.grid_sample(torch.tensor(x), torch.tensor(g),
+                                 mode=mode, align_corners=True).numpy()
+            np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-4)
+
+    def test_pixel_unshuffle_roundtrip(self):
+        x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+        down = F.pixel_unshuffle(t(x), 3)
+        assert down.shape == [1, 36, 2, 2]
+        back = F.pixel_shuffle(down, 3)
+        np.testing.assert_allclose(arr(back), x, atol=1e-6)
+
+    def test_max_unpool2d_inverts_pool(self):
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        pooled, idx = tF.max_pool2d(torch.tensor(x), 2, 2,
+                                    return_indices=True)
+        got = arr(F.max_unpool2d(t(pooled.numpy()), t(idx.numpy()), 2, 2))
+        ref = tF.max_unpool2d(pooled, idx, 2, 2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_temporal_shift_shapes_and_content(self):
+        x = rng.standard_normal((4, 8, 3, 3)).astype(np.float32)  # N2 T2
+        out = arr(F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25))
+        assert out.shape == x.shape
+        v = x.reshape(2, 2, 8, 3, 3)
+        o = out.reshape(2, 2, 8, 3, 3)
+        np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])  # fwd shift
+        assert (o[:, 1, :2] == 0).all()
+
+
+class TestMiscF:
+    def test_bilinear_matches_torch(self):
+        x1 = rng.standard_normal((4, 5)).astype(np.float32)
+        x2 = rng.standard_normal((4, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 5, 6)).astype(np.float32)
+        b = rng.standard_normal((3,)).astype(np.float32)
+        got = arr(F.bilinear(t(x1), t(x2), t(w), t(b)))
+        ref = tF.bilinear(torch.tensor(x1), torch.tensor(x2),
+                          torch.tensor(w), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_conv_transposes(self):
+        x1 = rng.standard_normal((1, 3, 10)).astype(np.float32)
+        w1 = rng.standard_normal((3, 4, 3)).astype(np.float32)
+        got = arr(F.conv1d_transpose(t(x1), t(w1), stride=2))
+        ref = tF.conv_transpose1d(torch.tensor(x1), torch.tensor(w1),
+                                  stride=2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+        x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        w3 = rng.standard_normal((2, 3, 2, 2, 2)).astype(np.float32)
+        got = arr(F.conv3d_transpose(t(x3), t(w3), stride=2))
+        ref = tF.conv_transpose3d(torch.tensor(x3), torch.tensor(w3),
+                                  stride=2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_small_activations(self):
+        x = rng.standard_normal((8,)).astype(np.float32)
+        np.testing.assert_allclose(
+            arr(F.log_sigmoid(t(x))),
+            tF.logsigmoid(torch.tensor(x)).numpy(), atol=1e-6)
+        mid = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(
+            arr(F.rrelu(t(x), training=False)),
+            np.where(x >= 0, x, x * mid), atol=1e-6)
+        np.testing.assert_allclose(
+            arr(F.pairwise_distance(t(x[None]), t(np.zeros_like(x)[None]),
+                                    epsilon=0.0)),
+            np.linalg.norm(x, keepdims=False)[None], rtol=1e-5)
+        got = arr(F.zeropad2d(t(x.reshape(1, 1, 2, 4)), [1, 2, 3, 4]))
+        assert got.shape == (1, 1, 9, 7)

@@ -141,3 +141,30 @@ class TestGenerateCacheInvalidation:
         got = np.asarray(m.generate(P.to_tensor(ids), max_new_tokens=3)._data)
         np.testing.assert_array_equal(got, ref)
         assert m.training  # restored
+
+
+class TestGenerateGuards:
+    def test_context_overflow_raises(self):
+        m = tiny_model()  # max_position_embeddings=64
+        ids = np.zeros((1, 60), np.int32)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            m.generate(P.to_tensor(ids), max_new_tokens=10)
+
+    def test_param_replacement_invalidates(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+        m = tiny_model(seed=9)
+        ids = np.zeros((1, 3), np.int32)
+        m.generate(P.to_tensor(ids), max_new_tokens=2)
+        # wholesale Parameter swap (LoRA/quant style), not inplace_update
+        m.lm_head.weight = Parameter(
+            jnp.asarray(np.random.default_rng(1).standard_normal(
+                m.lm_head.weight.shape).astype(np.float32)))
+        got = np.asarray(m.generate(P.to_tensor(ids),
+                                    max_new_tokens=2)._data)
+        cur = ids.copy()
+        for i in range(2):
+            logits = np.asarray(m(P.to_tensor(cur))._data)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            assert got[0, i] == nxt[0], i
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)

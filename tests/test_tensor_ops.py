@@ -340,3 +340,48 @@ class TestTensorMethodParity:
         t.uniform_(3.0, 4.0)
         a = np.asarray(t._data)
         assert a.min() >= 3.0 and a.max() <= 4.0
+
+
+class TestExtrasOps:
+    """Long-tail op surface (ops/extras.py) against numpy oracles."""
+
+    def test_logcumsumexp(self):
+        a = np.random.default_rng(0).standard_normal((3, 5)).astype(
+            np.float32)
+        got = np.asarray(P.logcumsumexp(P.to_tensor(a), axis=1)._data)
+        ref = np.log(np.cumsum(np.exp(a.astype(np.float64)), axis=1))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_renorm_clamps_only_large(self):
+        t = P.to_tensor(np.asarray([[0.3, 0.4], [3.0, 4.0]], np.float32))
+        out = np.asarray(P.renorm(t, 2, 0, 1.0)._data)
+        np.testing.assert_allclose(out[0], [0.3, 0.4], atol=1e-6)
+        np.testing.assert_allclose(np.linalg.norm(out[1]), 1.0, atol=1e-5)
+
+    def test_shape_unflatten_permute_cat(self):
+        t = P.to_tensor(np.zeros((2, 6), np.float32))
+        assert P.shape(t).numpy().tolist() == [2, 6]
+        assert P.unflatten(t, 1, [3, 2]).shape == [2, 3, 2]
+        assert P.permute(t, [1, 0]).shape == [6, 2]
+        assert P.cat([t, t], axis=0).shape == [4, 6]
+
+    def test_index_fill_increment_sgn(self):
+        t = P.to_tensor(np.ones((3, 2), np.float32))
+        out = np.asarray(P.index_fill(
+            t, P.to_tensor(np.asarray([1])), 0, 7.0)._data)
+        assert out[1].tolist() == [7, 7] and out[0].tolist() == [1, 1]
+        x = P.to_tensor(np.asarray([2.0], np.float32))
+        P.increment(x, 3.0)
+        assert float(np.asarray(x._data)) == 5.0
+        s = np.asarray(P.sgn(P.to_tensor(
+            np.asarray([-2.0, 0.0, 3.0], np.float32)))._data)
+        assert s.tolist() == [-1, 0, 1]
+
+    def test_nan_quantile_median_vander(self):
+        a = np.asarray([1.0, np.nan, 3.0, 2.0], np.float32)
+        assert float(np.asarray(P.nanmedian(P.to_tensor(a))._data)) == 2.0
+        q = float(np.asarray(P.nanquantile(P.to_tensor(a), 0.5)._data))
+        assert abs(q - 2.0) < 1e-6
+        v = np.asarray(P.vander(P.to_tensor(
+            np.asarray([1.0, 2.0], np.float32)))._data)
+        np.testing.assert_allclose(v, np.vander([1.0, 2.0]))
