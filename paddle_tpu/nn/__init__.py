@@ -32,3 +32,4 @@ from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerEncoderLayer)
 
 from .extended_layers import *  # noqa: E402,F401,F403
+from .extended_layers2 import *  # noqa: E402,F401,F403

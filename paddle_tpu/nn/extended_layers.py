@@ -24,11 +24,13 @@ class MaxPool3D(Layer):
                  ceil_mode=False, return_mask=False,
                  data_format="NCDHW", name=None):
         super().__init__()
-        self._a = (kernel_size, stride, padding)
+        self._a = (kernel_size, stride, padding, ceil_mode, return_mask,
+                   data_format)
 
     def forward(self, x):
-        k, s, p = self._a
-        return F.max_pool3d(x, k, s, p)
+        k, s, p, cm, rm, df = self._a
+        return F.max_pool3d(x, k, s, p, ceil_mode=cm, return_mask=rm,
+                            data_format=df)
 
 
 class AvgPool3D(Layer):
@@ -36,11 +38,13 @@ class AvgPool3D(Layer):
                  ceil_mode=False, exclusive=True, divisor_override=None,
                  data_format="NCDHW", name=None):
         super().__init__()
-        self._a = (kernel_size, stride, padding)
+        self._a = (kernel_size, stride, padding, ceil_mode, exclusive,
+                   divisor_override, data_format)
 
     def forward(self, x):
-        k, s, p = self._a
-        return F.avg_pool3d(x, k, s, p)
+        k, s, p, cm, ex, dv, df = self._a
+        return F.avg_pool3d(x, k, s, p, ceil_mode=cm, exclusive=ex,
+                            divisor_override=dv, data_format=df)
 
 
 class AdaptiveAvgPool3D(Layer):
@@ -56,9 +60,10 @@ class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self._os = output_size
+        self._rm = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self._os)
+        return F.adaptive_max_pool1d(x, self._os, return_mask=self._rm)
 
 
 class CTCLoss(Layer):

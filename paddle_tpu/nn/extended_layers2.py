@@ -1,0 +1,370 @@
+"""Second layer-sweep batch (reference: paddle.nn long tail —
+python/paddle/nn/layer/{loss,common,conv,container,rnn}.py, unverified;
+SURVEY.md §2.2 paddle.nn)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Parameter, Tensor
+from ..ops._base import ensure_tensor
+from .layer import Layer
+from . import functional as F
+
+__all__ = ["Conv1DTranspose", "Conv3DTranspose", "CosineEmbeddingLoss",
+           "Fold", "HuberLoss", "LayerDict", "MultiLabelSoftMarginLoss",
+           "MultiMarginLoss", "PoissonNLLLoss", "RNNCellBase",
+           "Softmax2D", "SoftMarginLoss", "TripletMarginWithDistanceLoss",
+           "Unflatten", "Unfold"]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+class _ConvTransposeNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, output_padding=0, dilation=1,
+                 groups=1, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        from . import initializer as I
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._args = (stride, padding, output_padding, dilation, groups)
+        fan_in = in_channels
+        for k in ks:
+            fan_in *= k
+        w = I.XavierUniform(fan_in=fan_in, fan_out=out_channels)(
+            (in_channels, out_channels // groups) + ks, jnp.float32)
+        self.weight = Parameter(w)
+        self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32)) \
+            if bias_attr is not False else None
+        self._nd = nd
+
+    def forward(self, x, output_size=None):
+        s, p, op, d, g = self._args
+        fn = F.conv1d_transpose if self._nd == 1 else F.conv3d_transpose
+        return fn(x, self.weight, self.bias, stride=s, padding=p,
+                  output_padding=op, groups=g, dilation=d,
+                  output_size=output_size)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1,
+                         stride, padding, output_padding, dilation,
+                         groups, weight_attr, bias_attr)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, output_padding, dilation,
+                         groups, weight_attr, bias_attr)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._m, self._red = margin, reduction
+
+    def forward(self, input1, input2, label):
+        def f(a, b, y):
+            cos = jnp.sum(a * b, -1) / jnp.maximum(
+                jnp.linalg.norm(a, axis=-1) *
+                jnp.linalg.norm(b, axis=-1), 1e-12)
+            loss = jnp.where(y > 0, 1 - cos,
+                             jnp.maximum(cos - self._m, 0.0))
+            return loss
+        out = apply(f, ensure_tensor(input1), ensure_tensor(input2),
+                    ensure_tensor(label).detach(), name="cos_emb_loss")
+        return _reduce(out, self._red)
+
+
+class Fold(Layer):
+    """col2im: inverse of Unfold (reference paddle.nn.Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        t2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+        self._os = t2(output_sizes)
+        self._ks = t2(kernel_sizes)
+        self._st = t2(strides)
+        self._pd = t2(paddings)
+        self._dl = t2(dilations)
+
+    def forward(self, x):
+        oh, ow = self._os
+        kh, kw = self._ks
+        sh, sw = self._st
+        ph, pw = self._pd
+        dh, dw = self._dl
+
+        def f(a):
+            N, CKK, L = a.shape
+            C = CKK // (kh * kw)
+            lh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+            lw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+            a6 = a.reshape(N, C, kh, kw, lh, lw)
+            out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), a.dtype)
+            for i in range(kh):
+                for j in range(kw):
+                    patch = a6[:, :, i, j]                   # [N,C,lh,lw]
+                    big = jnp.zeros_like(out)
+                    big = jax.lax.dynamic_update_slice(
+                        big,
+                        jnp.zeros((N, C, (lh - 1) * sh + 1,
+                                   (lw - 1) * sw + 1),
+                                  a.dtype).at[:, :, ::sh, ::sw].set(patch),
+                        (0, 0, i * dh, j * dw))
+                    out = out + big
+            return out[:, :, ph:ph + oh, pw:pw + ow]
+        return apply(f, ensure_tensor(x), name="fold")
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self._red, self._d = reduction, delta
+
+    def forward(self, input, label):
+        d = self._d
+
+        def f(a, y):
+            e = jnp.abs(a - y)
+            return jnp.where(e <= d, 0.5 * e * e, d * (e - 0.5 * d))
+        out = apply(f, ensure_tensor(input),
+                    ensure_tensor(label).detach(), name="huber")
+        return _reduce(out, self._red)
+
+
+class LayerDict(Layer):
+    """Reference paddle.nn.LayerDict (ordered, attribute-registered)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        setattr(self, key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers[key]
+        del self._sub_layers[key]
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) \
+            else sublayers
+        for k, v in items:
+            self[k] = v
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w = weight
+        self._red = reduction
+
+    def forward(self, input, label):
+        args = [ensure_tensor(input), ensure_tensor(label).detach()]
+        if self._w is not None:
+            args.append(ensure_tensor(self._w))
+
+        def f(z, y, *w):
+            loss = y * jax.nn.log_sigmoid(z) + \
+                (1 - y) * jax.nn.log_sigmoid(-z)
+            if w:
+                loss = loss * w[0]
+            return -jnp.mean(loss, axis=-1)
+        out = apply(f, *args, name="multilabel_soft_margin")
+        return _reduce(out, self._red)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._p, self._m, self._red = p, margin, reduction
+        self._w = weight
+
+    def forward(self, input, label):
+        p, m = self._p, self._m
+        args = [ensure_tensor(input), ensure_tensor(label).detach()]
+        if self._w is not None:
+            args.append(ensure_tensor(self._w))
+
+        def f(z, y, *w):
+            n, c = z.shape
+            yi = y.astype(jnp.int32)
+            zy = jnp.take_along_axis(z, yi[:, None], axis=1)
+            viol = jnp.maximum(m - zy + z, 0.0) ** p
+            if w:  # per-class weight of the TRUE class (torch semantics)
+                viol = viol * w[0][yi][:, None]
+            mask = jax.nn.one_hot(yi, c) == 0
+            return jnp.sum(viol * mask, axis=1) / c
+        out = apply(f, *args, name="multi_margin")
+        return _reduce(out, self._red)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._li, self._full, self._eps = log_input, full, epsilon
+        self._red = reduction
+
+    def forward(self, input, label):
+        li, full, eps = self._li, self._full, self._eps
+
+        def f(z, y):
+            if li:
+                loss = jnp.exp(z) - y * z
+            else:
+                loss = z - y * jnp.log(z + eps)
+            if full:
+                # Stirling approximation for log(y!)
+                stirling = y * jnp.log(y + eps) - y + \
+                    0.5 * jnp.log(2 * jnp.pi * (y + eps))
+                loss = loss + jnp.where(y > 1, stirling, 0.0)
+            return loss
+        out = apply(f, ensure_tensor(input),
+                    ensure_tensor(label).detach(), name="poisson_nll")
+        return _reduce(out, self._red)
+
+
+class RNNCellBase(Layer):
+    """Base for custom RNN cells (reference: paddle.nn.RNNCellBase).
+    Subclasses implement forward(inputs, states) -> (outputs, states)
+    and get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        hs = shape if shape is not None else (self.hidden_size,)
+        hs = (hs,) if isinstance(hs, int) else tuple(hs)
+        return Tensor(jnp.full((b,) + hs, init_value, jnp.float32))
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return apply(lambda a: jax.nn.softmax(a, axis=-3),
+                     ensure_tensor(x), name="softmax2d")
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._red = reduction
+
+    def forward(self, input, label):
+        def f(z, y):
+            return jnp.log1p(jnp.exp(-y * z))
+        out = apply(f, ensure_tensor(input),
+                    ensure_tensor(label).detach(), name="soft_margin")
+        return _reduce(out, self._red)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._dist = distance_function
+        self._m, self._swap, self._red = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        dist = self._dist
+        if dist is None:
+            dist = lambda a, b: (a - b).norm(p=2, axis=-1)
+        dp = dist(input, positive)
+        dn = dist(input, negative)
+        if self._swap:
+            dpn = dist(positive, negative)
+            dn = apply(lambda a, b: jnp.minimum(a, b), dn, dpn)
+        out = apply(lambda a, b: jnp.maximum(a - b + self._m, 0.0),
+                    dp, dn, name="triplet_dist")
+        return _reduce(out, self._red)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from ..ops.extras import unflatten
+        return unflatten(x, self._axis, self._shape)
+
+
+class Unfold(Layer):
+    """im2col (reference paddle.nn.Unfold): NCHW -> [N, C*kh*kw, L]."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        t2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+        self._ks, self._st = t2(kernel_sizes), t2(strides)
+        self._pd, self._dl = t2(paddings), t2(dilations)
+
+    def forward(self, x):
+        kh, kw = self._ks
+        sh, sw = self._st
+        ph, pw = self._pd
+        dh, dw = self._dl
+
+        def f(a):
+            N, C, H, W = a.shape
+            ap = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+            lh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+            lw = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+            cols = []
+            for i in range(kh):
+                for j in range(kw):
+                    sl = ap[:, :, i * dh:i * dh + (lh - 1) * sh + 1:sh,
+                            j * dw:j * dw + (lw - 1) * sw + 1:sw]
+                    cols.append(sl.reshape(N, C, lh * lw))
+            # [N, C, kh*kw, L] -> [N, C*kh*kw, L]
+            out = jnp.stack(cols, axis=2)
+            return out.reshape(N, C * kh * kw, lh * lw)
+        return apply(f, ensure_tensor(x), name="unfold")

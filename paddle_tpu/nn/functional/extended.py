@@ -53,8 +53,16 @@ def _pool3d(x, ks, stride, padding, op, init, avg, name):
     return apply(f, x, name=name)
 
 
+def _require_cf(data_format, allowed):
+    if data_format != allowed:
+        raise NotImplementedError(
+            f"data_format={data_format!r} is not supported here (only "
+            f"{allowed!r}); transpose the input instead")
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
+    _require_cf(data_format, "NCDHW")
     if return_mask:
         raise NotImplementedError(
             "max_pool3d(return_mask=True) is not supported (no 3-D "
@@ -69,14 +77,19 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None,
                data_format="NCDHW", name=None):
+    _require_cf(data_format, "NCDHW")
     if ceil_mode:
         raise NotImplementedError("avg_pool3d(ceil_mode=True) is not "
                                   "supported; pad the input instead")
-    if divisor_override is not None:
+    if divisor_override is not None or not exclusive:
+        # fixed divisor: the override, or (exclusive=False) the full
+        # kernel volume including padded elements
+        ks = _t3(kernel_size)
+        div = float(divisor_override) if divisor_override is not None \
+            else float(ks[0] * ks[1] * ks[2])
         summed = _pool3d(x, kernel_size, stride, padding, jax.lax.add,
                          0.0, False, "avg_pool3d")
-        return apply(lambda a: a / float(divisor_override),
-                     summed, name="avg_pool3d_div")
+        return apply(lambda a: a / div, summed, name="avg_pool3d_div")
     return _pool3d(x, kernel_size, stride, padding, jax.lax.add, 0.0,
                    True, "avg_pool3d")
 
@@ -93,6 +106,7 @@ def _adaptive_bins(L, os, dtype):
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    _require_cf(data_format, "NCDHW")
     x = ensure_tensor(x)
     os = _t3(output_size)
 
@@ -121,6 +135,9 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True) is not supported")
     x = ensure_tensor(x)
     os = int(output_size)
 
@@ -128,13 +145,7 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
         L = a.shape[-1]
         if L % os == 0:
             return jnp.max(a.reshape(a.shape[:-1] + (os, L // os)), -1)
-        # reference bins OVERLAP: bin i covers [floor(iL/os), ceil((i+1)L/os))
-        i = jnp.arange(os)
-        starts = (i * L) // os
-        ends = -((-(i + 1) * L) // os)  # ceil
-        pos = jnp.arange(L)
-        member = (pos[:, None] >= starts[None, :]) & \
-            (pos[:, None] < ends[None, :])            # [L, os]
+        member = _adaptive_bins(L, os, bool)          # [L, os]
         neg = jnp.asarray(-jnp.inf, a.dtype)
         masked = jnp.where(member[None, None], a[..., :, None], neg)
         return jnp.max(masked, axis=-2)
@@ -295,6 +306,13 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
     """NCHW input, grid [N, Ho, Wo, 2] in [-1, 1] (x, y order)."""
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode={mode!r} (only "
+                                  "bilinear/nearest)")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r} (only "
+            "zeros/border)")
     x = ensure_tensor(x)
     grid = ensure_tensor(grid)
 
@@ -334,6 +352,34 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     return apply(f, x, grid, name="grid_sample")
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=16)
+def _hsigmoid_tables(n):
+    """Complete-binary-tree path tables for n classes (built once per n:
+    hsigmoid exists for LARGE n — an O(n·depth) Python loop plus device
+    upload per forward would dominate step time)."""
+    import numpy as _np
+    depth = max(1, (n - 1).bit_length())
+    # leaf l sits at node n-1+l in the heap; internal nodes 0..n-2;
+    # walk to the root recording (node, bit)
+    tbl = _np.zeros((n, depth), _np.int64)
+    code = _np.zeros((n, depth), _np.float32)
+    valid = _np.zeros((n, depth), _np.float32)
+    for l in range(n):
+        node = n - 1 + l
+        d = 0
+        while node > 0 and d < depth:
+            parent = (node - 1) // 2
+            tbl[l, d] = parent
+            code[l, d] = float(node == 2 * parent + 2)  # right child
+            valid[l, d] = 1.0
+            node = parent
+            d += 1
+    return jnp.asarray(tbl), jnp.asarray(code), jnp.asarray(valid)
+
+
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
@@ -343,28 +389,9 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     w = ensure_tensor(weight)
     lab = ensure_tensor(label)._data.astype(jnp.int32).reshape(-1)
     n = int(num_classes)
-    depth = max(1, (n - 1).bit_length())
 
-    import numpy as _np
     if path_table is None:
-        # complete binary tree: leaf l sits at node n-1+l in the heap;
-        # internal nodes 0..n-2; walk to the root recording (node, bit)
-        tbl = _np.zeros((n, depth), _np.int64)
-        code = _np.zeros((n, depth), _np.float32)
-        valid = _np.zeros((n, depth), _np.float32)
-        for l in range(n):
-            node = n - 1 + l
-            d = 0
-            while node > 0 and d < depth:
-                parent = (node - 1) // 2
-                tbl[l, d] = parent
-                code[l, d] = float(node == 2 * parent + 2)  # right child
-                valid[l, d] = 1.0
-                node = parent
-                d += 1
-        tbl_j = jnp.asarray(tbl)
-        code_j = jnp.asarray(code)
-        valid_j = jnp.asarray(valid)
+        tbl_j, code_j, valid_j = _hsigmoid_tables(n)
     else:
         tbl_j = ensure_tensor(path_table)._data.astype(jnp.int32)
         code_j = ensure_tensor(path_code)._data.astype(jnp.float32)

@@ -67,8 +67,9 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
             ax = 0
         else:
             ax = axis
-        m = jnp.max(a, axis=ax, keepdims=True)  # global max: stable shift
-        out = jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
+        # exact + stable: associative scan with logaddexp (a global-max
+        # shift would -inf-underflow prefixes far below the max)
+        out = jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
         if dtype is not None:
             out = out.astype(convert_dtype(dtype))
         return out

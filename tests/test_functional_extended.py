@@ -185,3 +185,81 @@ class TestMiscF:
             np.linalg.norm(x, keepdims=False)[None], rtol=1e-5)
         got = arr(F.zeropad2d(t(x.reshape(1, 1, 2, 4)), [1, 2, 3, 4]))
         assert got.shape == (1, 1, 9, 7)
+
+
+class TestLayerSweep2:
+    """Second nn-layer sweep batch vs torch oracles."""
+
+    def test_unfold_fold_roundtrip_torch(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        u = P.nn.Unfold(3, strides=2, paddings=1)
+        got = arr(u(t(x)))
+        ref = tF.unfold(torch.tensor(x), 3, padding=1, stride=2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        f = P.nn.Fold((8, 8), 3, strides=2, paddings=1)
+        gotf = arr(f(t(ref)))
+        reff = tF.fold(torch.tensor(ref), (8, 8), 3, padding=1,
+                       stride=2).numpy()
+        np.testing.assert_allclose(gotf, reff, atol=1e-6)
+
+    def test_losses_match_torch(self):
+        a = rng.standard_normal((4, 6)).astype(np.float32)
+        y = rng.standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            float(arr(P.nn.HuberLoss(delta=1.3)(t(a), t(y)))),
+            float(tF.huber_loss(torch.tensor(a), torch.tensor(y),
+                                delta=1.3)), atol=1e-6)
+        lab = rng.integers(0, 6, (4,))
+        np.testing.assert_allclose(
+            float(arr(P.nn.MultiMarginLoss()(t(a), t(lab)))),
+            float(torch.nn.MultiMarginLoss()(torch.tensor(a),
+                                             torch.tensor(lab))),
+            atol=1e-6)
+        sign = np.where(rng.uniform(size=(4, 6)) > 0.5, 1.0,
+                        -1.0).astype(np.float32)
+        np.testing.assert_allclose(
+            float(arr(P.nn.SoftMarginLoss()(t(a), t(sign)))),
+            float(tF.soft_margin_loss(torch.tensor(a),
+                                      torch.tensor(sign))), atol=1e-6)
+        ml = (rng.uniform(size=(4, 6)) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(arr(P.nn.MultiLabelSoftMarginLoss()(t(a), t(ml)))),
+            float(torch.nn.MultiLabelSoftMarginLoss()(
+                torch.tensor(a), torch.tensor(ml))), atol=1e-6)
+        np.testing.assert_allclose(
+            float(arr(P.nn.PoissonNLLLoss()(t(a), t(np.abs(y))))),
+            float(torch.nn.PoissonNLLLoss()(torch.tensor(a),
+                                            torch.tensor(np.abs(y)))),
+            atol=1e-5)
+        y1 = rng.standard_normal((4, 8)).astype(np.float32)
+        y2 = rng.standard_normal((4, 8)).astype(np.float32)
+        lb = np.where(rng.uniform(size=4) > 0.5, 1, -1).astype(np.int64)
+        np.testing.assert_allclose(
+            float(arr(P.nn.CosineEmbeddingLoss(margin=0.2)(
+                t(y1), t(y2), t(lb)))),
+            float(torch.nn.CosineEmbeddingLoss(margin=0.2)(
+                torch.tensor(y1), torch.tensor(y2), torch.tensor(lb))),
+            atol=1e-5)
+
+    def test_conv_transpose_layers(self):
+        c1 = P.nn.Conv1DTranspose(3, 5, 3, stride=2)
+        out = c1(t(rng.standard_normal((2, 3, 7)).astype(np.float32)))
+        assert out.shape[0:2] == [2, 5]
+        c3 = P.nn.Conv3DTranspose(2, 4, 2, stride=2)
+        out = c3(t(rng.standard_normal((1, 2, 3, 3, 3)).astype(
+            np.float32)))
+        assert out.shape == [1, 4, 6, 6, 6]
+
+    def test_containers_and_misc(self):
+        ld = P.nn.LayerDict({"a": P.nn.Linear(2, 2)})
+        ld["b"] = P.nn.ReLU()
+        assert "a" in ld and len(ld) == 2
+        assert len(list(ld.parameters())) == 2  # registered as sublayers
+        ld.pop("b")
+        assert len(ld) == 1
+        s2 = P.nn.Softmax2D()
+        out = arr(s2(t(rng.standard_normal((1, 3, 2, 2)).astype(
+            np.float32))))
+        np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-5)
+        uf = P.nn.Unflatten(1, [2, 3])
+        assert uf(t(np.zeros((4, 6), np.float32))).shape == [4, 2, 3]
