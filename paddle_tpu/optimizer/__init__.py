@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (paddle.optimizer parity)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
-from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
-                         Momentum, RMSProp)
+from .optimizers import (SGD, ASGD, LBFGS, Adadelta, Adagrad, Adam,  # noqa: F401
+                         Adamax, AdamW, Lamb, Momentum, NAdam, RAdam,
+                         RMSProp, Rprop)

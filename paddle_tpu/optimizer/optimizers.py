@@ -13,6 +13,7 @@ import os
 
 import jax.numpy as jnp
 
+from ..core.autograd import enable_grad as _enable_grad_ctx, no_grad
 from .optimizer import Optimizer
 
 
@@ -330,3 +331,265 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(r * r))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return param - lr * trust * r, {"moment1": m1, "moment2": m2}
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference: paddle.optimizer.NAdam / torch NAdam
+    with momentum_decay)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "b1": self._beta1,
+                "b2": self._beta2, "eps": self._epsilon,
+                "psi": self._psi}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        b1, b2, eps, psi = hp["b1"], hp["b2"], hp["eps"], hp["psi"]
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        t = step.astype(jnp.float32)
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_product"] * mu_t
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = (mu_t1 * m1 / (1 - mu_prod * mu_t1) +
+                 (1 - mu_t) * grad / (1 - mu_prod))
+        v_hat = m2 / (1 - b2 ** t)
+        new = param - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new, {"moment1": m1, "moment2": m2, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: paddle.optimizer.RAdam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay, "b1": self._beta1,
+                "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        t = step.astype(jnp.float32)
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * grad * grad
+        m_hat = m1 / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        # variance rectification (SMA length > 4), else unadapted step
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30),
+                                    0.0))
+        # reference (and torch) convention: eps on sqrt(m2) BEFORE the
+        # bias-correction scale; rho threshold 5
+        adaptive = rect * jnp.sqrt(1 - b2 ** t) / (jnp.sqrt(m2) + eps)
+        adapted = param - lr * m_hat * adaptive
+        plain = param - lr * m_hat
+        new = jnp.where(rho_t > 5.0, adapted, plain)
+        return new, {"moment1": m1, "moment2": m2}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: paddle.optimizer.Rprop) — per-
+    element step sizes grown/shrunk by gradient-sign agreement; batch
+    training only in spirit but the rule is faithful."""
+
+    def __init__(self, learning_rate=0.01, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None):
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        self._lr0 = learning_rate
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         False, name)
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "step_size": jnp.full(p._data.shape, self._lr0,
+                                      jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": 0.0, "em": self._eta_minus,
+                "ep": self._eta_plus, "lo": self._lr_min,
+                "hi": self._lr_max}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        em, ep, lo, hi = hp["em"], hp["ep"], hp["lo"], hp["hi"]
+        sign = jnp.sign(grad * state["prev_grad"])
+        size = jnp.where(sign > 0, state["step_size"] * ep,
+                         jnp.where(sign < 0, state["step_size"] * em,
+                                   state["step_size"]))
+        size = jnp.clip(size, lo, hi)
+        # on sign change: no move, zero the stored grad (classic Rprop-)
+        eff_grad = jnp.where(sign < 0, 0.0, grad)
+        new = param - jnp.sign(eff_grad) * size
+        return new, {"prev_grad": eff_grad, "step_size": size}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: paddle.optimizer.ASGD): SGD steps plus a
+    running polyak average of the iterates held in state['averaged']
+    (fetch via state_dict or the `averaged_parameters` helper)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _init_state(self, p):
+        return {"averaged": p._data.astype(jnp.float32)}
+
+    def _hyperparams(self):
+        return {"weight_decay": self._weight_decay}
+
+    @staticmethod
+    def _update(param, grad, state, lr, step, hp):
+        wd = hp["weight_decay"]
+        if wd:
+            grad = grad + wd * param
+        new = param - lr * grad
+        t = step.astype(jnp.float32)
+        avg = state["averaged"] + (new - state["averaged"]) / t
+        return new, {"averaged": avg}
+
+    def averaged_parameters(self):
+        return [self._accum[id(p)]["averaged"]
+                for p in self._all_params() if id(p) in self._accum]
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with closure API (reference: paddle.optimizer.LBFGS).
+
+    TPU-native scope: two-loop recursion over a `history_size` window
+    with a backtracking (Armijo) line search — the closure is
+    re-evaluated on device per probe. Deterministic full-batch use, as
+    upstream documents."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+        self._max_iter = max_iter
+        self._tol_g = tolerance_grad
+        self._tol_x = tolerance_change
+        self._hist = history_size
+        self._s, self._y = [], []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def _unflat(self, flat):
+        out, off = [], 0
+        for p in self._all_params():
+            n = p._data.size
+            out.append(flat[off:off + n].reshape(p._data.shape
+                                                 ).astype(p._data.dtype))
+            off += n
+        return out
+
+    def _set_params(self, flat):
+        for p, arr in zip(self._all_params(), self._unflat(flat)):
+            p._inplace_update(arr)
+
+    @no_grad()
+    def step(self, closure):
+        import jax as _jax
+
+        def eval_closure():
+            for p in self._all_params():
+                p.clear_grad()
+            with _enable_grad_ctx():
+                loss = closure()
+            g = self._flat([(p.grad._data if p.grad is not None else
+                             jnp.zeros_like(p._data))
+                            for p in self._all_params()])
+            return float(loss), g
+
+        x = self._flat([p._data for p in self._all_params()])
+        loss, g = eval_closure()
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) < self._tol_g:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(self._s, self._y))):
+                rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+                a = rho * jnp.dot(s, q)
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                    jnp.dot(y_last, y_last), 1e-10)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, q)
+                q = q + s * (a - b)
+            d = -q
+            # backtracking line search (Armijo)
+            t = float(self.get_lr())
+            gtd = float(jnp.dot(g, d))
+            ok = False
+            for _bt in range(20):
+                self._set_params(x + t * d)
+                new_loss, new_g = eval_closure()
+                if new_loss <= loss + 1e-4 * t * gtd:
+                    ok = True
+                    break
+                t *= 0.5
+            if not ok:
+                self._set_params(x)
+                break
+            x_new = x + t * d
+            s_vec = x_new - x
+            y_vec = new_g - g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) < self._tol_x:
+                x, loss, g = x_new, new_loss, new_g
+                break
+            x, loss, g = x_new, new_loss, new_g
+        self._set_params(x)
+        return loss
+

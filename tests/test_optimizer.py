@@ -189,3 +189,70 @@ class TestAmpIntegration:
         scaler.step(opt)
         scaler.update()
         assert scaler.get_loss_scaling() >= 1.0
+
+
+class TestNewOptimizers:
+    """NAdam/RAdam/Rprop vs torch; ASGD averaging; LBFGS convergence."""
+
+    def _pair(self, make_ours, torch_cls, tkw, steps=8):
+        import torch
+        rng = np.random.default_rng(0)
+        w0 = rng.standard_normal((4, 3)).astype(np.float32)
+        gs = [rng.standard_normal((4, 3)).astype(np.float32)
+              for _ in range(steps)]
+        p = P.to_tensor(w0.copy(), stop_gradient=False)
+        opt = make_ours([p])
+        tp = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch_cls([tp], **tkw)
+        for g in gs:
+            p.clear_grad()
+            (p * P.to_tensor(g)).sum().backward()
+            opt.step()
+            topt.zero_grad()
+            (tp * torch.tensor(g)).sum().backward()
+            topt.step()
+        return np.abs(np.asarray(p._data) - tp.detach().numpy()).max()
+
+    def test_nadam_matches_torch(self):
+        import torch
+        assert self._pair(
+            lambda ps: P.optimizer.NAdam(0.01, parameters=ps),
+            torch.optim.NAdam, dict(lr=0.01)) < 1e-5
+
+    def test_radam_matches_torch(self):
+        import torch
+        assert self._pair(
+            lambda ps: P.optimizer.RAdam(0.01, parameters=ps),
+            torch.optim.RAdam, dict(lr=0.01), steps=12) < 1e-4
+
+    def test_rprop_matches_torch(self):
+        import torch
+        assert self._pair(
+            lambda ps: P.optimizer.Rprop(0.01, parameters=ps),
+            torch.optim.Rprop, dict(lr=0.01)) < 1e-6
+
+    def test_asgd_average_tracks(self):
+        p = P.to_tensor(np.zeros((2,), np.float32), stop_gradient=False)
+        opt = P.optimizer.ASGD(0.5, parameters=[p])
+        for _ in range(4):
+            p.clear_grad()
+            (p * P.to_tensor(np.ones(2, np.float32))).sum().backward()
+            opt.step()
+        avg = np.asarray(opt.averaged_parameters()[0])
+        # iterates: -0.5, -1.0, -1.5, -2.0 -> mean = -1.25
+        np.testing.assert_allclose(avg, [-1.25, -1.25], atol=1e-6)
+
+    def test_lbfgs_minimizes_quadratic(self):
+        w = P.to_tensor(np.asarray([3.0, -2.0], np.float32),
+                        stop_gradient=False)
+        lb = P.optimizer.LBFGS(parameters=[w], max_iter=30)
+        target = P.to_tensor(np.asarray([1.0, 1.0], np.float32))
+
+        def closure():
+            loss = ((w - target) ** 2).sum()
+            loss.backward()
+            return float(np.asarray(loss._data))
+
+        lb.step(closure)
+        np.testing.assert_allclose(np.asarray(w._data), [1.0, 1.0],
+                                   atol=1e-4)
