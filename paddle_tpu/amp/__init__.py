@@ -165,3 +165,37 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native TPU compute dtype; CPU XLA also executes it."""
+    return True
+
+
+def is_float16_supported(device=None):
+    import jax
+    return jax.default_backend() in ("tpu", "axon", "gpu")
+
+
+class debugging:
+    """paddle.amp.debugging surface: tensor-stat checks map onto the
+    framework's nan/inf flag (FLAGS check_nan_inf -> jax_debug_nans)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        raise NotImplementedError(
+            "operator-level AMP stats are not collected; use "
+            "paddle_tpu.profiler for op timing or set_flags("
+            "{'FLAGS_check_nan_inf': True}) for numeric checks")
+
+    @staticmethod
+    def check_numerics(x, op_type="", var_name=""):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        a = x._data if isinstance(x, Tensor) else x
+        bad = bool(jnp.any(~jnp.isfinite(a)))
+        if bad:
+            raise RuntimeError(
+                f"check_numerics: non-finite values in {op_type} "
+                f"{var_name}")
+        return x

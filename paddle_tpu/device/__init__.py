@@ -40,3 +40,38 @@ class _DeviceNamespace:
 
 tpu = _DeviceNamespace()
 cuda = _DeviceNamespace()  # API-compat alias so ported scripts run
+
+
+def is_compiled_with_cuda():
+    return False  # TPU-native build
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_all_device_type():
+    import jax
+    seen = []
+    try:
+        for d in jax.devices():
+            if d.platform not in seen:
+                seen.append(d.platform)
+    except Exception:
+        pass
+    if "cpu" not in seen:
+        seen.append("cpu")
+    return seen
+
+
+def get_available_device():
+    import jax
+    try:
+        d = jax.devices()[0]
+        return f"{d.platform}:{d.id}"
+    except Exception:
+        return "cpu:0"

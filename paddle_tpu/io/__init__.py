@@ -608,3 +608,19 @@ class DataLoader:
             # threaded prefetch fallback
             return self._iter_prefetch()
         return self._iter_sync()
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference parity)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import random as _random_mod
+        order = list(self.indices)
+        _random_mod.shuffle(order)
+        return iter(order)
+
+    def __len__(self):
+        return len(self.indices)

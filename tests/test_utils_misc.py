@@ -117,3 +117,22 @@ class TestReviewRegressions:
         wd = MyL1(0.3)
         assert _decay_coeff(wd) == 0.0
         assert _l1_coeff(wd) == 0.3
+
+
+class TestNamespaceProbes:
+    def test_io_subset_random_sampler(self):
+        s = P.io.SubsetRandomSampler([5, 2, 9])
+        assert sorted(s) == [2, 5, 9] and len(s) == 3
+
+    def test_amp_capability_probes(self):
+        assert P.amp.is_bfloat16_supported() is True
+        assert isinstance(P.amp.is_float16_supported(), bool)
+        P.amp.debugging.check_numerics(P.to_tensor([1.0, 2.0]))
+        with pytest.raises(RuntimeError):
+            P.amp.debugging.check_numerics(
+                P.to_tensor(np.asarray([np.inf], np.float32)))
+
+    def test_device_probes(self):
+        assert P.device.is_compiled_with_cuda() is False
+        assert "cpu" in P.device.get_all_device_type()
+        assert ":" in P.device.get_available_device()
