@@ -7,13 +7,18 @@ topology, fleet facade, sharding API, auto-parallel surface.
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import rpc  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
 from .collective import (ProcessGroup, ReduceOp, all_gather,  # noqa: F401
                          all_gather_object, all_reduce, alltoall,
                          alltoall_single, barrier, broadcast,
                          broadcast_object_list, destroy_process_group,
-                         get_backend, get_group, is_initialized, new_group,
-                         recv, reduce, reduce_scatter, scatter, send, wait)
+                         gather, get_backend, get_group, irecv,
+                         is_initialized, isend, new_group, recv, reduce,
+                         reduce_scatter, scatter, scatter_object_list,
+                         send, wait)
 from .env import get_rank, get_world_size  # noqa: F401
+from .env import ParallelEnv  # noqa: F401
 from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from .sharding_api import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 
@@ -21,6 +26,24 @@ from .sharding_api import group_sharded_parallel, save_group_sharded_model  # no
 from .auto_parallel.api import (ProcessMesh, Replicate, Shard, Partial,  # noqa: F401
                                 shard_tensor, reshard, dtensor_from_fn,
                                 shard_layer)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference paddle.distributed.shard_optimizer: optimizer-state
+    sharding. TPU-natively the fleet SPMD stepper already shards states
+    per the ZeRO strategy annotations; this returns the optimizer ready
+    for fleet.distributed_optimizer (the sharding attaches there)."""
+    return optimizer
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference paddle.distributed.split (TP layer helper) — superseded
+    by fleet.meta_parallel Column/RowParallelLinear here."""
+    raise NotImplementedError(
+        "paddle.distributed.split is the legacy TP helper; use "
+        "paddle_tpu.distributed.fleet mp layers (ColumnParallelLinear/"
+        "RowParallelLinear/VocabParallelEmbedding) instead")
 
 
 def get_data_parallel_group():

@@ -432,3 +432,40 @@ def destroy_process_group(group=None):
 
 def get_backend(group=None):
     return _group(group).backend
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send (reference paddle.distributed.isend). XLA collectives
+    are scheduler-async already; returns the sync Task."""
+    return send(tensor, dst=dst, group=group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src=src, group=group, sync_op=False)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference paddle.distributed.gather): built on
+    all_gather (every rank computes the list; non-dst ranks discard —
+    the XLA-native lowering, since ICI all-gather and gather cost the
+    same on a ring)."""
+    tmp = []
+    task = all_gather(tmp, tensor, group=group, sync_op=sync_op)
+    from .env import get_rank
+    if gather_list is not None and get_rank() == dst:
+        gather_list.extend(tmp)
+    return task
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Object scatter via the broadcast_object_list transport."""
+    objs = list(in_object_list) if in_object_list is not None else []
+    broadcast_object_list(objs if objs else [None], src=src, group=group)
+    from .env import get_rank, get_world_size
+    n = max(get_world_size(), 1)
+    rank = get_rank()
+    if objs:
+        per = max(len(objs) // n, 1)
+        out_object_list.append(objs[min(rank * per, len(objs) - 1)])
+    return None

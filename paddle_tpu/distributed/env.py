@@ -43,3 +43,40 @@ def get_endpoints():
 
 def get_current_endpoint():
     return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+class ParallelEnv:
+    """Reference paddle.distributed.ParallelEnv: rank/world-size/device
+    view of the PADDLE_* env protocol."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        import os
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", self.rank))
+
+    @property
+    def device_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        import os
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return self.world_size

@@ -154,3 +154,194 @@ class Pad:
         else:
             cfg = [(0, 0), (p[1], p[3]), (p[0], p[2])]
         return np.pad(np.asarray(img), cfg, mode="constant")
+
+
+from . import functional  # noqa: E402
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: E402,F401
+                         adjust_hue, affine, crop, erase, hflip,
+                         normalize, pad, perspective, resize, rotate,
+                         to_grayscale, to_tensor, vflip)
+from .functional import center_crop  # noqa: E402,F401
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize (reference semantics)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        import random as _r
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1:] if chw else arr.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = area * _r.uniform(*self.scale)
+            ar = _r.uniform(*self.ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = _r.randint(0, h - ch)
+                left = _r.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size)
+        return resize(center_crop(img, min(h, w)), self.size)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.b, self.c, self.s, self.h = brightness, contrast, \
+            saturation, hue
+
+    def __call__(self, img):
+        import random as _r
+        if self.b:
+            img = adjust_brightness(img, _r.uniform(max(0, 1 - self.b),
+                                                    1 + self.b))
+        if self.c:
+            img = adjust_contrast(img, _r.uniform(max(0, 1 - self.c),
+                                                  1 + self.c))
+        if self.s:
+            img = SaturationTransform(self.s)(img)
+        if self.h:
+            img = adjust_hue(img, _r.uniform(-self.h, self.h))
+        return img
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.fill = fill
+
+    def __call__(self, img):
+        import random as _r
+        return rotate(img, _r.uniform(*self.degrees), fill=self.fill)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        import random as _r
+        return adjust_contrast(img, _r.uniform(max(0, 1 - self.value),
+                                               1 + self.value))
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        import random as _r
+        f = _r.uniform(max(0, 1 - self.value), 1 + self.value)
+        arr = np.asarray(img, np.float32)
+        gray = np.asarray(to_grayscale(arr, 3), np.float32) \
+            if arr.ndim == 3 else arr
+        return np.clip(gray + f * (arr - gray), 0,
+                       255.0 if arr.max() > 2 else 1.0)
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        import random as _r
+        return adjust_hue(img, _r.uniform(-self.value, self.value))
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value = value
+
+    def __call__(self, img):
+        import random as _r
+        if _r.random() > self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1:] if chw else arr.shape[:2])
+        for _ in range(10):
+            target = h * w * _r.uniform(*self.scale)
+            ar = _r.uniform(*self.ratio)
+            eh = int(round((target / ar) ** 0.5))
+            ew = int(round((target * ar) ** 0.5))
+            if eh < h and ew < w:
+                top = _r.randint(0, h - eh)
+                left = _r.randint(0, w - ew)
+                return erase(img, top, left, eh, ew, self.value)
+        return img
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate, self.scale_rng, self.shear = translate, scale, \
+            shear
+        self.fill = fill
+
+    def __call__(self, img):
+        import random as _r
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1:] if chw else arr.shape[:2])
+        angle = _r.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = _r.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _r.uniform(-self.translate[1], self.translate[1]) * h
+        sc = _r.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = _r.uniform(-self.shear, self.shear) \
+            if isinstance(self.shear, (int, float)) and self.shear else 0.0
+        return affine(img, angle=angle, translate=(tx, ty), scale=sc,
+                      shear=(sh, 0.0), fill=self.fill)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0):
+        self.prob = prob
+        self.d = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        import random as _r
+        if _r.random() > self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1:] if chw else arr.shape[:2])
+        dx = self.d * w / 2
+        dy = self.d * h / 2
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(int(_r.uniform(0, dx)), int(_r.uniform(0, dy))),
+               (int(w - 1 - _r.uniform(0, dx)), int(_r.uniform(0, dy))),
+               (int(w - 1 - _r.uniform(0, dx)),
+                int(h - 1 - _r.uniform(0, dy))),
+               (int(_r.uniform(0, dx)), int(h - 1 - _r.uniform(0, dy)))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+__all__ += ["RandomResizedCrop", "ColorJitter", "RandomRotation",
+            "Grayscale", "ContrastTransform", "SaturationTransform",
+            "HueTransform", "RandomErasing", "RandomAffine",
+            "RandomPerspective", "functional"]

@@ -1,0 +1,246 @@
+"""paddle.vision.transforms.functional parity (reference:
+python/paddle/vision/transforms/functional*.py — unverified, SURVEY.md
+§2.2 Vision). Host-side numpy ops on CHW (or HW/HWC) float arrays, as
+the transform pipeline runs pre-device-transfer. Geometry ops
+(rotate/affine/perspective) use inverse-mapped bilinear sampling —
+vectorized numpy, no scipy dependency.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "center_crop",
+           "hflip", "vflip", "pad", "erase", "rotate", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_hue",
+           "affine", "perspective"]
+
+
+def _chw(img):
+    img = np.asarray(img, dtype=np.float32)
+    if img.ndim == 2:
+        return img[None], "HW"
+    if img.ndim == 3 and img.shape[0] in (1, 3, 4):
+        return img, "CHW"
+    return np.transpose(img, (2, 0, 1)), "HWC"
+
+
+def _restore(img, fmt):
+    if fmt == "HW":
+        return img[0]
+    if fmt == "HWC":
+        return np.transpose(img, (1, 2, 0))
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    from . import ToTensor
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from . import Normalize
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import Resize
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    c, fmt = _chw(img)
+    return _restore(c[:, top:top + height, left:left + width], fmt)
+
+
+def center_crop(img, output_size):
+    size = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    c, fmt = _chw(img)
+    h, w = c.shape[1:]
+    top = max((h - size[0]) // 2, 0)
+    left = max((w - size[1]) // 2, 0)
+    return _restore(c[:, top:top + size[0], left:left + size[1]], fmt)
+
+
+def hflip(img):
+    c, fmt = _chw(img)
+    return _restore(c[:, :, ::-1].copy(), fmt)
+
+
+def vflip(img):
+    c, fmt = _chw(img)
+    return _restore(c[:, ::-1, :].copy(), fmt)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    c, fmt = _chw(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl = pr = padding[0]
+        pt = pb = padding[1]
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(c, ((0, 0), (pt, pb), (pl, pr)), mode=mode, **kw)
+    return _restore(out, fmt)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    c, fmt = _chw(img)
+    if not inplace:
+        c = c.copy()
+    c[:, i:i + h, j:j + w] = v
+    return _restore(c, fmt)
+
+
+def to_grayscale(img, num_output_channels=1):
+    c, fmt = _chw(img)
+    if c.shape[0] >= 3:
+        g = (0.299 * c[0] + 0.587 * c[1] + 0.114 * c[2])[None]
+    else:
+        g = c[:1]
+    out = np.repeat(g, num_output_channels, axis=0)
+    return _restore(out, fmt)
+
+
+def adjust_brightness(img, brightness_factor):
+    c, fmt = _chw(img)
+    return _restore(np.clip(c * brightness_factor, 0,
+                            255.0 if c.max() > 2 else 1.0), fmt)
+
+
+def adjust_contrast(img, contrast_factor):
+    c, fmt = _chw(img)
+    mean = (0.299 * c[0] + 0.587 * c[1] + 0.114 * c[2]).mean() \
+        if c.shape[0] >= 3 else c.mean()
+    out = mean + contrast_factor * (c - mean)
+    return _restore(np.clip(out, 0, 255.0 if c.max() > 2 else 1.0), fmt)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via RGB→HSV→RGB."""
+    c, fmt = _chw(img)
+    scale = 255.0 if c.max() > 2 else 1.0
+    rgb = np.clip(c[:3] / scale, 0, 1)
+    r, g, b = rgb
+    mx = rgb.max(0)
+    mn = rgb.min(0)
+    d = mx - mn
+    # hue in [0, 1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        h = np.where(
+            d == 0, 0.0,
+            np.where(mx == r, ((g - b) / d) % 6,
+                     np.where(mx == g, (b - r) / d + 2,
+                              (r - g) / d + 4)) / 6.0)
+    s = np.where(mx == 0, 0.0, d / np.maximum(mx, 1e-12))
+    v = mx
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6).astype(np.int32) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2]) * scale
+    if c.shape[0] > 3:
+        out = np.concatenate([out, c[3:]], axis=0)
+    return _restore(out.astype(np.float32), fmt)
+
+
+def _sample_bilinear(c, ys, xs, fill=0.0):
+    """Sample CHW image at fractional (ys, xs) grids [H, W]."""
+    C, H, W = c.shape
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    wy = ys - y0
+    wx = xs - x0
+    out = np.zeros((C,) + ys.shape, np.float32)
+    total_w = np.zeros(ys.shape, np.float32)
+    for dy, wgt_y in ((0, 1 - wy), (1, wy)):
+        for dx, wgt_x in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = np.clip(yy, 0, H - 1)
+            xc = np.clip(xx, 0, W - 1)
+            w = (wgt_y * wgt_x) * valid
+            out += c[:, yc, xc] * w
+            total_w += w
+    return out + fill * (1 - total_w)
+
+
+def _inverse_affine_sample(img, matrix, fill=0.0):
+    """matrix: 2x3 inverse map (output coords -> input coords), centered
+    at the image center."""
+    c, fmt = _chw(img)
+    H, W = c.shape[1:]
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(H) - cy, np.arange(W) - cx,
+                         indexing="ij")
+    a, b, tx, d, e, ty = matrix
+    xs = a * xx + b * yy + tx + cx
+    ys = d * xx + e * yy + ty + cy
+    out = _sample_bilinear(c, ys, xs, fill)
+    return _restore(out, fmt)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False,
+           center=None, fill=0):
+    th = math.radians(angle)
+    # inverse rotation (output -> input)
+    m = [math.cos(th), math.sin(th), 0.0,
+         -math.sin(th), math.cos(th), 0.0]
+    return _inverse_affine_sample(img, m, fill)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    th = math.radians(angle)
+    sx = math.radians(shear[0] if isinstance(shear, (list, tuple))
+                      else shear)
+    sy = math.radians(shear[1] if isinstance(shear, (list, tuple)) and
+                      len(shear) > 1 else 0.0)
+    # forward map M = R(angle) @ Shear @ diag(scale); invert analytically
+    a = math.cos(th + sy) / math.cos(sy)
+    b = -(math.cos(th + sy) * math.tan(sx) / math.cos(sy) + math.sin(th))
+    d = math.sin(th + sy) / math.cos(sy)
+    e = -(math.sin(th + sy) * math.tan(sx) / math.cos(sy) - math.cos(th))
+    fwd = np.array([[a * scale, b * scale], [d * scale, e * scale]])
+    inv = np.linalg.inv(fwd)
+    tx, ty = translate
+    m = [inv[0, 0], inv[0, 1], -(inv[0, 0] * tx + inv[0, 1] * ty),
+         inv[1, 0], inv[1, 1], -(inv[1, 0] * tx + inv[1, 1] * ty)]
+    return _inverse_affine_sample(img, m, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Projective warp mapping endpoints back to startpoints."""
+    c, fmt = _chw(img)
+    H, W = c.shape[1:]
+    # solve the 8-dof homography endpoints -> startpoints
+    A, bvec = [], []
+    for (sx_, sy_), (ex_, ey_) in zip(startpoints, endpoints):
+        A.append([ex_, ey_, 1, 0, 0, 0, -sx_ * ex_, -sx_ * ey_])
+        bvec.append(sx_)
+        A.append([0, 0, 0, ex_, ey_, 1, -sy_ * ex_, -sy_ * ey_])
+        bvec.append(sy_)
+    h = np.linalg.solve(np.asarray(A, np.float64),
+                        np.asarray(bvec, np.float64))
+    yy, xx = np.meshgrid(np.arange(H, dtype=np.float64),
+                         np.arange(W, dtype=np.float64), indexing="ij")
+    den = h[6] * xx + h[7] * yy + 1.0
+    xs = (h[0] * xx + h[1] * yy + h[2]) / den
+    ys = (h[3] * xx + h[4] * yy + h[5]) / den
+    out = _sample_bilinear(c, ys.astype(np.float32),
+                           xs.astype(np.float32), fill)
+    return _restore(out, fmt)

@@ -170,3 +170,55 @@ class TestDeformConv:
         off = P.to_tensor(np.zeros((1, 18, 8, 8), np.float32))
         out = layer(x, off)
         assert out.shape == [1, 8, 8, 8]
+
+
+class TestTransformsExtended:
+    """New transforms + functional tier (host-side numpy)."""
+
+    def _img(self):
+        return np.random.default_rng(0).uniform(
+            0, 1, (3, 24, 24)).astype(np.float32)
+
+    def test_functional_geometry(self):
+        from paddle_tpu.vision.transforms import functional as TF
+        img = self._img()
+        np.testing.assert_allclose(TF.rotate(img, 0.0), img, atol=1e-5)
+        r180 = TF.rotate(img, 180.0)
+        np.testing.assert_allclose(r180, img[:, ::-1, ::-1], atol=1e-3)
+        np.testing.assert_allclose(TF.hflip(img), img[:, :, ::-1])
+        np.testing.assert_allclose(TF.vflip(img), img[:, ::-1, :])
+        c = TF.crop(img, 2, 3, 10, 12)
+        assert c.shape == (3, 10, 12)
+        p = TF.pad(img, 2)
+        assert p.shape == (3, 28, 28)
+        # identity perspective
+        pts = [(0, 0), (23, 0), (23, 23), (0, 23)]
+        np.testing.assert_allclose(TF.perspective(img, pts, pts), img,
+                                   atol=1e-4)
+
+    def test_color_ops(self):
+        from paddle_tpu.vision.transforms import functional as TF
+        img = self._img()
+        np.testing.assert_allclose(TF.adjust_brightness(img, 1.0), img,
+                                   atol=1e-6)
+        np.testing.assert_allclose(TF.adjust_contrast(img, 1.0), img,
+                                   atol=1e-6)
+        np.testing.assert_allclose(TF.adjust_hue(img, 0.0), img,
+                                   atol=1e-4)
+        g = TF.to_grayscale(img, 3)
+        assert np.allclose(g[0], g[1]) and np.allclose(g[1], g[2])
+
+    def test_random_transforms_shapes(self):
+        import random
+        random.seed(0)
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        assert T.RandomResizedCrop(12)(img).shape == (3, 12, 12)
+        assert np.asarray(T.ColorJitter(0.3, 0.3, 0.3, 0.2)(img)
+                          ).shape == (3, 24, 24)
+        out = T.RandomErasing(prob=1.0, value=0.5)(img)
+        assert (np.asarray(out) == 0.5).any()
+        assert np.asarray(T.RandomAffine(10, translate=(0.1, 0.1))(img)
+                          ).shape == (3, 24, 24)
+        assert np.asarray(T.RandomPerspective(prob=1.0)(img)
+                          ).shape == (3, 24, 24)
