@@ -21,3 +21,105 @@ class DistributedFusedLamb:
         kwargs.pop("use_master_acc_grad", None)
         return Lamb(learning_rate=learning_rate, parameters=parameters,
                     **kwargs)
+
+
+# -- segment ops (reference: paddle.incubate.segment_* / graph ops) ----------
+
+def _segment(op, x, segment_ids, num_segments=None):
+    import jax
+    import jax.numpy as jnp
+    from ..core.autograd import apply
+    from ..ops._base import ensure_tensor
+    x = ensure_tensor(x)
+    ids = ensure_tensor(segment_ids)._data.astype(jnp.int32)
+    n = int(num_segments) if num_segments is not None else \
+        int(ids.max()) + 1
+
+    def f(a):
+        return op(a, ids, num_segments=n)
+    return apply(f, x, name="segment_op")
+
+
+def segment_sum(data, segment_ids, name=None):
+    import jax
+    return _segment(jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+    from ..core.autograd import apply
+    from ..ops._base import ensure_tensor
+    x = ensure_tensor(data)
+    ids = ensure_tensor(segment_ids)._data.astype(jnp.int32)
+    n = int(ids.max()) + 1
+
+    def f(a):
+        s = jax.ops.segment_sum(a, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape + (1,) *
+                                           (a.ndim - 1), a.dtype),
+                                  ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1)
+    return apply(f, x, name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    import jax
+    return _segment(jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    import jax
+    return _segment(jax.ops.segment_min, data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Message passing (reference paddle.incubate.graph_send_recv /
+    paddle.geometric.send_u_recv): gather x at src, segment-reduce at
+    dst."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.autograd import apply
+    from ..ops._base import ensure_tensor
+    x = ensure_tensor(x)
+    src = ensure_tensor(src_index)._data.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._data.astype(jnp.int32)
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+    if pool_type not in red:
+        raise ValueError(f"pool_type {pool_type!r}")
+    n = int(out_size) if out_size is not None else x.shape[0]
+
+    def f(a):
+        msgs = a[src]
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones(dst.shape + (1,) * (a.ndim - 1), a.dtype), dst,
+                num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        return red[pool_type](msgs, dst, num_segments=n)
+    return apply(f, x, name="graph_send_recv")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference fused op; XLA fuses the composed
+    form into one kernel)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.autograd import apply
+    from ..ops._base import ensure_tensor
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                 ensure_tensor(x), ensure_tensor(mask),
+                 name="softmax_mask_fuse")
+
+
+def identity_loss(x, reduction="none"):
+    from ..ops._base import ensure_tensor
+    x = ensure_tensor(x)
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("mean", 1):
+        return x.mean()
+    return x.sum()

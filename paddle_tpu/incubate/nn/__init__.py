@@ -1,2 +1,6 @@
 """incubate.nn"""
 from . import functional  # noqa: F401
+
+from .layers import (FusedFeedForward, FusedLinear,  # noqa: F401
+                     FusedMultiHeadAttention,
+                     FusedTransformerEncoderLayer)

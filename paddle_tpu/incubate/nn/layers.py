@@ -1,0 +1,123 @@
+"""Fused transformer layers (reference: paddle.incubate.nn
+Fused{MultiHeadAttention,FeedForward,Linear,TransformerEncoderLayer} —
+state-holding shells over incubate.nn.functional; XLA performs the
+actual fusion at compile time, Pallas supplies flash attention).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from . import functional as F
+
+__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._tw = transpose_weight
+        shape = (out_features, in_features) if transpose_weight \
+            else (in_features, out_features)
+        self.weight = Parameter(I.XavierNormal()(shape, jnp.float32))
+        self.bias = Parameter(jnp.zeros((out_features,), jnp.float32)) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self._tw)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self._pre_ln = normalize_before
+        self._eps = epsilon
+        self._drop = dropout_rate
+        h = embed_dim
+        hd = h // num_heads
+        # reference layout: [3, num_heads, head_dim, embed_dim]
+        self.qkv_weight = Parameter(I.XavierNormal()(
+            (3, num_heads, hd, h), jnp.float32))
+        self.qkv_bias = Parameter(jnp.zeros((3, num_heads, hd),
+                                            jnp.float32))
+        self.linear_weight = Parameter(I.XavierNormal()(
+            (h, h), jnp.float32))
+        self.linear_bias = Parameter(jnp.zeros((h,), jnp.float32))
+        self.ln_scale = Parameter(jnp.ones((h,), jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros((h,), jnp.float32))
+
+    def forward(self, x, attn_mask=None, cache=None):
+        return F.fused_multi_head_attention(
+            x, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self._pre_ln, num_heads=self.num_heads,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            attn_mask=attn_mask, dropout_rate=self._drop,
+            ln_epsilon=self._eps, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._pre_ln = normalize_before
+        self._act = activation
+        self._drop = dropout_rate
+        self._eps = epsilon
+        self.linear1_weight = Parameter(I.XavierNormal()(
+            (d_model, dim_feedforward), jnp.float32))
+        self.linear1_bias = Parameter(jnp.zeros((dim_feedforward,),
+                                                jnp.float32))
+        self.linear2_weight = Parameter(I.XavierNormal()(
+            (dim_feedforward, d_model), jnp.float32))
+        self.linear2_bias = Parameter(jnp.zeros((d_model,), jnp.float32))
+        self.ln_scale = Parameter(jnp.ones((d_model,), jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros((d_model,), jnp.float32))
+
+    def forward(self, x):
+        return F.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln_scale, ln1_bias=self.ln_bias,
+            ln2_scale=self.ln_scale, ln2_bias=self.ln_bias,
+            dropout1_rate=self._drop, dropout2_rate=self._drop,
+            ln1_epsilon=self._eps, ln2_epsilon=self._eps,
+            activation=self._act, pre_layer_norm=self._pre_ln,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -24,3 +24,13 @@ from __future__ import annotations
 
 from .to_static import ignore_module, not_to_static, to_static  # noqa: F401
 from .save_load import load, save  # noqa: F401
+
+
+from .save_load import TranslatedLayer  # noqa: E402,F401
+
+
+def enable_to_static(flag=True):
+    """Reference paddle.jit.enable_to_static: globally toggles whether
+    @to_static decorators compile or run eagerly."""
+    from . import to_static as _ts
+    _ts._TO_STATIC_ENABLED = bool(flag)

@@ -121,6 +121,9 @@ def _discover_layers(fn, args, kwargs, extra):
     return layers
 
 
+_TO_STATIC_ENABLED = True  # paddle.jit.enable_to_static toggle
+
+
 class StaticFunction:
     """The compiled callable returned by to_static."""
 
@@ -182,6 +185,8 @@ class StaticFunction:
         return list(self._jit_cache.keys())
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)  # global eager toggle
         fn = self._fn
         layers = self._layers or _discover_layers(fn, args, kwargs, ())
         named_params = []

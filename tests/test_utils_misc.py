@@ -136,3 +136,47 @@ class TestNamespaceProbes:
         assert P.device.is_compiled_with_cuda() is False
         assert "cpu" in P.device.get_all_device_type()
         assert ":" in P.device.get_available_device()
+
+
+class TestIncubateOps:
+    def test_segment_ops(self):
+        x = P.to_tensor(np.arange(10, dtype=np.float32).reshape(5, 2))
+        ids = P.to_tensor(np.asarray([0, 0, 1, 2, 2]))
+        from paddle_tpu import incubate as inc
+        s = np.asarray(inc.segment_sum(x, ids)._data)
+        np.testing.assert_allclose(s[0], [2, 4])
+        m = np.asarray(inc.segment_mean(x, ids)._data)
+        np.testing.assert_allclose(m[2], [7, 8])
+        mx = np.asarray(inc.segment_max(x, ids)._data)
+        np.testing.assert_allclose(mx[2], [8, 9])
+
+    def test_graph_send_recv(self):
+        from paddle_tpu import incubate as inc
+        x = P.to_tensor(np.eye(3, dtype=np.float32))
+        src = P.to_tensor(np.asarray([0, 1, 2]))
+        dst = P.to_tensor(np.asarray([1, 2, 0]))
+        out = np.asarray(inc.graph_send_recv(x, src, dst, "sum")._data)
+        np.testing.assert_allclose(out, np.roll(np.eye(3), 1, axis=0))
+
+    def test_fused_layers(self):
+        from paddle_tpu.incubate.nn import (FusedLinear,
+                                            FusedTransformerEncoderLayer)
+        P.seed(0)
+        l = FusedTransformerEncoderLayer(16, 4, 32)
+        out = l(P.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 6, 16)).astype(np.float32)))
+        assert out.shape == [2, 6, 16]
+        fl = FusedLinear(8, 4)
+        assert fl(P.to_tensor(np.ones((2, 8), np.float32))).shape == [2, 4]
+
+    def test_jit_enable_to_static_toggle(self):
+        @P.jit.to_static
+        def f(x):
+            return x + 1
+        x = P.to_tensor(np.zeros(2, np.float32))
+        P.jit.enable_to_static(False)
+        try:
+            out = f(x)
+        finally:
+            P.jit.enable_to_static(True)
+        np.testing.assert_allclose(np.asarray(out._data), 1.0)
