@@ -51,6 +51,7 @@ from . import utils  # noqa: F401
 from . import version  # noqa: F401
 from . import vision  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import geometric  # noqa: F401
 from . import hub  # noqa: F401
 from . import sysconfig  # noqa: F401
 from .hapi import callbacks  # noqa: F401

@@ -1,0 +1,64 @@
+"""paddle.geometric parity (reference: python/paddle/geometric/ —
+message passing + segment ops; unverified, SURVEY.md §2.2 "Misc
+domains"). All ops lower to gather + jax.ops.segment_* (the TPU-native
+form of the reference's fused send/recv CUDA kernels — XLA fuses the
+gather into the segment reduction)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.autograd import apply
+from .ops._base import ensure_tensor
+from .incubate import (graph_send_recv, segment_max, segment_mean,  # noqa: F401
+                       segment_min, segment_sum)
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x at src, reduce at dst (reference send_u_recv)."""
+    return graph_send_recv(x, src_index, dst_index,
+                           pool_type=reduce_op, out_size=out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features (gathered at src) with edge
+    features y before the dst reduction."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)._data.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._data.astype(jnp.int32)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+    if reduce_op not in red and reduce_op != "mean":
+        raise ValueError(f"reduce_op {reduce_op!r}")
+
+    def f(a, e):
+        msgs = combine(a[src], e)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones(dst.shape + (1,) * (msgs.ndim - 1), a.dtype),
+                dst, num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        return red[reduce_op](msgs, dst, num_segments=n)
+    return apply(f, x, y, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge messages from both endpoints: combine(x[src], y[dst])."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)._data.astype(jnp.int32)
+    dst = ensure_tensor(dst_index)._data.astype(jnp.int32)
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+    return apply(lambda a, b: combine(a[src], b[dst]), x, y,
+                 name="send_uv")

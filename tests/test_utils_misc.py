@@ -180,3 +180,18 @@ class TestIncubateOps:
         finally:
             P.jit.enable_to_static(True)
         np.testing.assert_allclose(np.asarray(out._data), 1.0)
+
+
+class TestGeometric:
+    def test_send_u_recv_and_ue(self):
+        x = P.to_tensor(np.eye(3, dtype=np.float32))
+        e = P.to_tensor(np.ones((3, 3), np.float32))
+        src = P.to_tensor(np.asarray([0, 1, 2]))
+        dst = P.to_tensor(np.asarray([1, 2, 0]))
+        out = np.asarray(P.geometric.send_u_recv(x, src, dst)._data)
+        np.testing.assert_allclose(out, np.roll(np.eye(3), 1, 0))
+        out2 = np.asarray(P.geometric.send_ue_recv(
+            x, e, src, dst, "add", "mean")._data)
+        np.testing.assert_allclose(out2, np.roll(np.eye(3), 1, 0) + 1)
+        uv = np.asarray(P.geometric.send_uv(x, x, src, dst, "add")._data)
+        assert uv.shape == (3, 3)
