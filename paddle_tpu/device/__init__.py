@@ -54,24 +54,36 @@ def is_compiled_with_rocm():
     return False
 
 
-def get_all_device_type():
-    import jax
-    seen = []
+def _probe_devices(timeout=60):
+    """Bounded SUBPROCESS device probe: a wedged TPU makes in-process
+    jax.devices() hang forever with no exception (CLAUDE.md chip
+    hygiene), so never touch it directly here."""
+    import subprocess
+    import sys
+    code = ("import jax; "
+            "print(','.join(f'{d.platform}:{d.id}' for d in jax.devices()))")
     try:
-        for d in jax.devices():
-            if d.platform not in seen:
-                seen.append(d.platform)
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        if p.returncode == 0 and p.stdout.strip():
+            return p.stdout.strip().split(",")
     except Exception:
         pass
+    return []
+
+
+def get_all_device_type():
+    seen = []
+    for spec in _probe_devices():
+        plat = spec.split(":")[0]
+        if plat not in seen:
+            seen.append(plat)
     if "cpu" not in seen:
         seen.append("cpu")
     return seen
 
 
 def get_available_device():
-    import jax
-    try:
-        d = jax.devices()[0]
-        return f"{d.platform}:{d.id}"
-    except Exception:
-        return "cpu:0"
+    devs = _probe_devices()
+    return devs[0] if devs else "cpu:0"

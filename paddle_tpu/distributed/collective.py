@@ -459,13 +459,22 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    """Object scatter via the broadcast_object_list transport."""
-    objs = list(in_object_list) if in_object_list is not None else []
-    broadcast_object_list(objs if objs else [None], src=src, group=group)
+    """Object scatter. Under the single-controller SPMD regime every
+    rank holds in_object_list (broadcast_object_list is a pass-through),
+    so each rank picks its slice; a multi-controller non-src caller must
+    still pass the list (the object transport rides the same channel as
+    broadcast_object_list — see its docstring)."""
+    if in_object_list is None:
+        raise ValueError(
+            "scatter_object_list: in_object_list is required on every "
+            "rank in this runtime (single-controller SPMD shares the "
+            "list; multi-controller transport rides "
+            "broadcast_object_list, which needs the source list)")
+    objs = list(in_object_list)
+    broadcast_object_list(objs, src=src, group=group)
     from .env import get_rank, get_world_size
     n = max(get_world_size(), 1)
     rank = get_rank()
-    if objs:
-        per = max(len(objs) // n, 1)
-        out_object_list.append(objs[min(rank * per, len(objs) - 1)])
+    per = max(len(objs) // n, 1)
+    out_object_list.append(objs[min(rank * per, len(objs) - 1)])
     return None

@@ -47,6 +47,7 @@ class FusedMultiHeadAttention(Layer):
         self._pre_ln = normalize_before
         self._eps = epsilon
         self._drop = dropout_rate
+        self._attn_drop = attn_dropout_rate
         h = embed_dim
         hd = h // num_heads
         # reference layout: [3, num_heads, head_dim, embed_dim]
@@ -61,12 +62,18 @@ class FusedMultiHeadAttention(Layer):
         self.ln_bias = Parameter(jnp.zeros((h,), jnp.float32))
 
     def forward(self, x, attn_mask=None, cache=None):
+        # the ln params serve as pre-LN affine in pre-LN mode and
+        # post-LN affine otherwise (only one branch runs per config)
         return F.fused_multi_head_attention(
             x, self.qkv_weight, self.linear_weight,
             pre_layer_norm=self._pre_ln, num_heads=self.num_heads,
+            pre_ln_scale=self.ln_scale if self._pre_ln else None,
+            pre_ln_bias=self.ln_bias if self._pre_ln else None,
+            pre_ln_epsilon=self._eps,
             qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
             ln_scale=self.ln_scale, ln_bias=self.ln_bias,
             attn_mask=attn_mask, dropout_rate=self._drop,
+            attn_dropout_rate=self._attn_drop,
             ln_epsilon=self._eps, training=self.training)
 
 

@@ -252,8 +252,8 @@ class SaturationTransform:
         arr = np.asarray(img, np.float32)
         gray = np.asarray(to_grayscale(arr, 3), np.float32) \
             if arr.ndim == 3 else arr
-        return np.clip(gray + f * (arr - gray), 0,
-                       255.0 if arr.max() > 2 else 1.0)
+        from .functional import _max_value
+        return np.clip(gray + f * (arr - gray), 0, _max_value(img))
 
 
 class HueTransform:

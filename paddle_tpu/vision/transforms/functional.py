@@ -34,6 +34,19 @@ def _restore(img, fmt):
     return img
 
 
+
+
+def _max_value(img):
+    """Value-range ceiling: trust the ORIGINAL dtype (uint8 => 255)
+    before any float conversion; for float inputs fall back to the
+    magnitude heuristic (a dark uint8-range image passed as float is
+    ambiguous — prefer 255 when any value exceeds 2)."""
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        return 255.0
+    return 255.0 if arr.size and arr.max() > 2 else 1.0
+
+
 def to_tensor(img, data_format="CHW"):
     from . import ToTensor
     return ToTensor(data_format)(img)
@@ -109,9 +122,9 @@ def to_grayscale(img, num_output_channels=1):
 
 
 def adjust_brightness(img, brightness_factor):
+    mx = _max_value(img)
     c, fmt = _chw(img)
-    return _restore(np.clip(c * brightness_factor, 0,
-                            255.0 if c.max() > 2 else 1.0), fmt)
+    return _restore(np.clip(c * brightness_factor, 0, mx), fmt)
 
 
 def adjust_contrast(img, contrast_factor):
@@ -119,13 +132,13 @@ def adjust_contrast(img, contrast_factor):
     mean = (0.299 * c[0] + 0.587 * c[1] + 0.114 * c[2]).mean() \
         if c.shape[0] >= 3 else c.mean()
     out = mean + contrast_factor * (c - mean)
-    return _restore(np.clip(out, 0, 255.0 if c.max() > 2 else 1.0), fmt)
+    return _restore(np.clip(out, 0, _max_value(img)), fmt)
 
 
 def adjust_hue(img, hue_factor):
     """Shift hue by hue_factor (in [-0.5, 0.5]) via RGB→HSV→RGB."""
+    scale = _max_value(img)
     c, fmt = _chw(img)
-    scale = 255.0 if c.max() > 2 else 1.0
     rgb = np.clip(c[:3] / scale, 0, 1)
     r, g, b = rgb
     mx = rgb.max(0)
