@@ -200,3 +200,99 @@ class LRScheduler(Callback):
         s = self._sched()
         if s and self.by_epoch:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus
+    (reference: paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = self.model._optimizer
+            old = opt.get_lr()
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                try:
+                    opt.set_lr(new)
+                except RuntimeError:
+                    return  # LRScheduler-driven: scheduler owns the LR
+                if self.verbose:
+                    print(f"Epoch {epoch}: ReduceLROnPlateau reducing "
+                          f"lr to {new}")
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger with the reference's VisualDL callback API. The
+    visualdl package is not in this image; scalars land in a JSONL file
+    under log_dir (one record per step/epoch) that any dashboard can
+    tail."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+
+    def _emit(self, kind, step, logs):
+        import json
+        import os
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir,
+                                        "scalars.jsonl"), "a")
+        rec = {"kind": kind, "step": int(step)}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple))
+                               else v)
+            except (TypeError, ValueError):
+                continue
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit("epoch", epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+            self._f = None

@@ -314,3 +314,36 @@ class TestTrainBatchLoop:
                                       net_b.named_parameters()):
             np.testing.assert_allclose(np.asarray(p1._data),
                                        np.asarray(p2._data), atol=1e-5)
+
+
+class TestNewCallbacks:
+    def test_reduce_lr_on_plateau(self):
+        import paddle_tpu as P
+        net = P.nn.Linear(4, 2)
+        m = P.Model(net)
+        opt = P.optimizer.SGD(0.1, parameters=net.parameters())
+        m.prepare(opt, P.nn.CrossEntropyLoss())
+        cb = P.callbacks.ReduceLROnPlateau(monitor="loss", patience=1,
+                                           factor=0.5, verbose=0)
+        cb.model = m
+        for e in range(3):
+            cb.on_epoch_end(e, {"loss": 1.0})
+        assert opt.get_lr() < 0.1
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        import json
+        import paddle_tpu as P
+        v = P.callbacks.VisualDL(log_dir=str(tmp_path))
+        v.on_epoch_end(0, {"loss": 0.25, "acc": [0.9]})
+        v.on_train_end()
+        rec = json.loads((tmp_path / "scalars.jsonl").read_text().strip())
+        assert rec["loss"] == 0.25 and rec["acc"] == 0.9
+
+    def test_multiplicative_decay(self):
+        from paddle_tpu.optimizer.lr import MultiplicativeDecay
+        s = MultiplicativeDecay(1.0, lambda e: 0.5)
+        seq = []
+        for _ in range(3):
+            seq.append(float(s()))
+            s.step()
+        assert seq == [1.0, 0.5, 0.25]
