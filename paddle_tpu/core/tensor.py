@@ -199,6 +199,14 @@ class Tensor:
     def ndimension(self):
         return len(self._data.shape)
 
+    @property
+    def itemsize(self):
+        return self._data.dtype.itemsize
+
+    @property
+    def nbytes(self):
+        return self._data.dtype.itemsize * self._data.size
+
     def new_zeros(self, shape, dtype=None):
         d = dtypes.convert_dtype(dtype) if dtype else self._data.dtype
         return Tensor(jnp.zeros(tuple(shape), d))
