@@ -563,20 +563,51 @@ class LBFGS(Optimizer):
                 b = rho * jnp.dot(y, q)
                 q = q + s * (a - b)
             d = -q
-            # backtracking line search (Armijo)
+            # line search: Armijo backtracking, then a Wolfe-style
+            # curvature EXPANSION (double t while |g_newᵀd| > 0.9|gᵀd|
+            # and Armijo still holds). Armijo alone accepts too-short
+            # steps whose (s, y) pairs carry poor curvature information
+            # and L-BFGS crawls (Rosenbrock stalls); with the expansion
+            # it converges in ~35 iterations.
             t = float(self.get_lr())
             gtd = float(jnp.dot(g, d))
             ok = False
-            for _bt in range(20):
+            best = None  # (t, loss, g) of the best simple-decrease probe
+            for _bt in range(25):
                 self._set_params(x + t * d)
                 new_loss, new_g = eval_closure()
                 if new_loss <= loss + 1e-4 * t * gtd:
                     ok = True
                     break
+                if new_loss < loss and (best is None or
+                                        new_loss < best[1]):
+                    best = (t, new_loss, new_g)
                 t *= 0.5
             if not ok:
-                self._set_params(x)
-                break
+                if best is None:
+                    self._set_params(x)
+                    if self._s:
+                        # the quasi-Newton model produced a non-descent
+                        # direction (ill-conditioned curvature pair) —
+                        # drop the history and retry as steepest descent
+                        self._s.clear()
+                        self._y.clear()
+                        continue
+                    break
+                t, new_loss, new_g = best
+                self._set_params(x + t * d)
+            else:
+                for _ex in range(10):
+                    if abs(float(jnp.dot(new_g, d))) <= 0.9 * abs(gtd):
+                        break
+                    t2 = t * 2.0
+                    self._set_params(x + t2 * d)
+                    l2, g2 = eval_closure()
+                    if l2 <= loss + 1e-4 * t2 * gtd:
+                        t, new_loss, new_g = t2, l2, g2
+                    else:
+                        self._set_params(x + t * d)
+                        break
             x_new = x + t * d
             s_vec = x_new - x
             y_vec = new_g - g

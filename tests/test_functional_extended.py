@@ -263,3 +263,63 @@ class TestLayerSweep2:
         np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-5)
         uf = P.nn.Unflatten(1, [2, 3])
         assert uf(t(np.zeros((4, 6), np.float32))).shape == [4, 2, 3]
+
+
+class TestUntestedBranches:
+    """Branches added in review hardening, vs torch oracles."""
+
+    def test_adaptive_avg_pool3d_non_divisible(self):
+        x = rng.standard_normal((1, 2, 5, 7, 9)).astype(np.float32)
+        got = arr(F.adaptive_avg_pool3d(t(x), (2, 3, 4)))
+        ref = tF.adaptive_avg_pool3d(torch.tensor(x), (2, 3, 4)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_grid_sample_unaligned_and_border(self):
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        g = rng.uniform(-1.2, 1.2, (1, 4, 4, 2)).astype(np.float32)
+        got = arr(F.grid_sample(t(x), t(g), padding_mode="border"))
+        ref = tF.grid_sample(torch.tensor(x), torch.tensor(g),
+                             padding_mode="border",
+                             align_corners=True).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+        with pytest.raises(NotImplementedError):
+            F.grid_sample(t(x), t(g), mode="bicubic")
+
+    def test_avg_pool3d_divisor_override(self):
+        x = rng.standard_normal((1, 1, 4, 4, 4)).astype(np.float32)
+        got = arr(F.avg_pool3d(t(x), 2, 2, divisor_override=16))
+        ref = tF.avg_pool3d(torch.tensor(x), 2, 2,
+                            divisor_override=16).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_conv_transpose_guards(self):
+        x1 = rng.standard_normal((1, 4, 8)).astype(np.float32)
+        w1 = rng.standard_normal((4, 2, 3)).astype(np.float32)
+        with pytest.raises(NotImplementedError):
+            F.conv1d_transpose(t(x1), t(w1), groups=2)
+        with pytest.raises(NotImplementedError):
+            F.conv1d_transpose(t(x1), t(w1), output_size=[18])
+
+    def test_lbfgs_rosenbrock(self):
+        w = P.to_tensor(np.asarray([-1.2, 1.0], np.float32),
+                        stop_gradient=False)
+        opt = P.optimizer.LBFGS(parameters=[w], max_iter=60,
+                                history_size=10)
+
+        def closure():
+            a = w[0]
+            b = w[1]
+            loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+            loss.backward()
+            return float(np.asarray(loss._data))
+
+        loss = opt.step(closure)
+        got = np.asarray(w._data)
+        assert loss < 1e-3, (loss, got)
+
+    def test_logcumsumexp_flat_extreme(self):
+        x = np.asarray([[-50000.0, -3.0], [0.0, 1.0]], np.float32)
+        out = arr(P.logcumsumexp(P.to_tensor(x)))  # axis=None: flattened
+        assert np.isfinite(out).all()
+        ref = np.logaddexp.accumulate(x.reshape(-1).astype(np.float64))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
