@@ -29,8 +29,34 @@ from .save_load import load, save  # noqa: F401
 from .save_load import TranslatedLayer  # noqa: E402,F401
 
 
+def _ts_module():
+    # NOTE: `from . import to_static` would resolve to the FUNCTION (the
+    # package attribute was rebound by the `from .to_static import
+    # to_static` above), silently no-oping any module-global writes.
+    import importlib
+    return importlib.import_module(__name__ + ".to_static")
+
+
 def enable_to_static(flag=True):
     """Reference paddle.jit.enable_to_static: globally toggles whether
     @to_static decorators compile or run eagerly."""
-    from . import to_static as _ts
-    _ts._TO_STATIC_ENABLED = bool(flag)
+    _ts_module()._TO_STATIC_ENABLED = bool(flag)
+
+
+def graph_break_report():
+    """Public SOT-style diagnostics: every live to_static function that
+    graph-broke (fell back to eager) with its recorded reasons.
+
+    Returns a list of {"function": qualname, "reasons": [str, ...]}
+    dicts, most recent reasons last. Empty list = everything compiled.
+    """
+    report = []
+    for sf in list(_ts_module()._LIVE_STATIC_FNS):
+        reasons = list(sf.graph_break_reasons)
+        if reasons:
+            report.append({
+                "function": getattr(sf, "__qualname__",
+                                    getattr(sf, "__name__", "?")),
+                "reasons": reasons,
+            })
+    return report

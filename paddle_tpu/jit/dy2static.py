@@ -15,14 +15,32 @@ helpers that lower onto XLA's structured control flow —
 - ``for i in range(...)`` with traced bounds → ``lax.while_loop`` with
   the index in the carry (static bounds keep the unrolled Python loop).
 
+Two SOT-tier pre-passes run before the lowering (round-3):
+
+- **return join**: early/mixed returns (`if t: return a` followed by more
+  code) are restructured into all-paths-return ``if/else`` trees — the
+  continuation is grafted into the non-returning paths — which the
+  clean-form `lax.cond` lowering below then compiles. No runtime flags:
+  the join is pure AST surgery, so there is no untyped "return value
+  carry" to break lax.cond/while_loop structure matching.
+- **loop-escape lowering**: `break`/`continue` in while/for-range loops
+  desugar to a `_jstf_brk` flag in the loop carry (cond becomes
+  ``not brk and test``) with dead-tail elimination (code after a
+  definite break/continue is dropped; code after a conditional escape is
+  grafted into the non-escaping branch). for-range desugars to a while
+  with an explicit induction variable whose increment replays at each
+  `continue` join (Python's iterator-steps-at-loop-top semantics).
+
 The transform is best-effort and safe: constructs it can't lower
-(break/continue, mixed returns, zero-arg super(), global/nonlocal) are
-left untouched — tracing then raises and `to_static` falls back to eager,
-recording the graph-break reason (the SOT-fallback contract).
+(returns inside traced loops, loop-else with break, zero-arg super(),
+global/nonlocal) are left untouched — tracing then raises and
+`to_static` falls back to eager, recording the graph-break reason (the
+SOT-fallback contract; see `paddle_tpu.jit.graph_break_report`).
 """
 from __future__ import annotations
 
 import ast
+import copy
 import inspect
 import textwrap
 
@@ -33,7 +51,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import GraphBreakError, Tensor
 
-__all__ = ["transform", "if_", "while_", "for_range", "UNDEF", "peek"]
+__all__ = ["transform", "if_", "while_", "for_range", "UNDEF", "peek",
+           "loop_not", "loop_and", "range3", "range_cond"]
 
 
 class _Undef:
@@ -132,6 +151,62 @@ def _flatten(obj, promote=False):
 
 # ---------------------------------------------------------------------------
 # runtime helpers the generated code calls
+
+def loop_not(x):
+    """Traced-aware `not x` for generated loop conditions/guards."""
+    v = _unwrap(x)
+    if isinstance(v, jax.core.Tracer):
+        return jnp.logical_not(jnp.asarray(v).astype(bool))
+    return not _to_bool(v)
+
+
+def loop_and(a, b):
+    """Traced-aware `a and b`. `b` may be a zero-arg thunk: it is then
+    only evaluated when `a` doesn't already decide the result — Python's
+    `while` never re-evaluates its test after a break, so a desugared
+    loop condition must short-circuit the same way when the flag is
+    concrete (the test may legitimately raise on post-break state)."""
+    va = _unwrap(a)
+    if callable(b):
+        if not isinstance(va, jax.core.Tracer) and not _to_bool(va):
+            return False
+        vb = _unwrap(b())
+    else:
+        vb = _unwrap(b)
+    if isinstance(va, jax.core.Tracer) or isinstance(vb, jax.core.Tracer):
+        return jnp.logical_and(jnp.asarray(va).astype(bool),
+                               jnp.asarray(vb).astype(bool))
+    return _to_bool(va) and _to_bool(vb)
+
+
+def range3(rargs):
+    """Normalize range(...) args to (start, stop, step), evaluated once."""
+    rargs = tuple(_unwrap(r) for r in rargs)
+    if len(rargs) == 1:
+        return 0, rargs[0], 1
+    if len(rargs) == 2:
+        return rargs[0], rargs[1], 1
+    return rargs
+
+
+def loop_init(prior, fallback):
+    """Pre-loop binding for a desugared for-range target: the prior
+    binding when one exists, else the (type-compatible) start value so
+    the while carry has a typable slot. Deviation: a loop that never
+    runs leaves the target bound to start instead of raising NameError
+    on later use."""
+    return fallback if isinstance(prior, _Undef) else prior
+
+
+def range_cond(i, stop, step):
+    """Continue-iterating predicate of a desugared for-range loop."""
+    vi, vstop, vstep = _unwrap(i), _unwrap(stop), _unwrap(step)
+    if any(isinstance(v, jax.core.Tracer) for v in (vi, vstop, vstep)):
+        return jnp.where(jnp.asarray(vstep) > 0,
+                         jnp.asarray(vi) < jnp.asarray(vstop),
+                         jnp.asarray(vi) > jnp.asarray(vstop))
+    return vi < vstop if vstep > 0 else vi > vstop
+
 
 def if_(pred, true_fn, false_fn, args):
     p = _unwrap(pred)
@@ -402,6 +477,248 @@ def _blockers(nodes):
 
 
 # ---------------------------------------------------------------------------
+# SOT-lite pre-passes: return join + loop-escape lowering (pure AST
+# surgery; runs before the control-flow lowering so the existing
+# clean-form machinery compiles the result)
+
+def _all_paths_return(blk):
+    """Every execution path through `blk` ends in a Return."""
+    if not blk:
+        return False
+    if _has_return(blk[:-1]):
+        return False
+    last = blk[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_all_paths_return(last.body) and
+                _all_paths_return(last.orelse or []))
+    return False
+
+
+def _is_range_call(node):
+    return (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Name) and node.func.id == "range" and
+            not node.keywords and
+            1 <= len(node.args) <= 3 and
+            not any(isinstance(a, ast.Starred) for a in node.args))
+
+
+def _escapes_only_under_ifs(stmts):
+    """Every break/continue at this loop's level is reachable through
+    plain If nesting only — the one shape _lower_escapes can rewrite."""
+    for st in stmts:
+        if isinstance(st, (ast.Break, ast.Continue)):
+            continue
+        if isinstance(st, ast.If):
+            if not _escapes_only_under_ifs(st.body):
+                return False
+            if not _escapes_only_under_ifs(st.orelse or []):
+                return False
+            continue
+        if isinstance(st, _SCOPE_NODES + (ast.For, ast.While,
+                                          ast.AsyncFor)):
+            continue  # escapes inside belong to the nested loop/scope
+        if _has_loop_escape([st]):  # Try/With/… containing an escape
+            return False
+    return True
+
+
+class _PreLower:
+    """Bottom-up statement rewriter: joins mixed returns into
+    all-paths-return if/else trees and desugars loops containing
+    break/continue into flag-carrying whiles. Conservative: anything it
+    can't prove equivalent is left untouched (the lowering below then
+    either handles it or graph-breaks to eager)."""
+
+    # grafting a continuation into a conditionally-escaping branch copies
+    # it; the budget bounds pathological nesting blowup
+    _BUDGET = 4000
+
+    def __init__(self):
+        self.changed = False
+        self.n = 0
+        self.budget = self._BUDGET
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    def _copy(self, stmts):
+        cost = sum(1 for s in stmts for _ in ast.walk(s))
+        self.budget -= cost
+        return copy.deepcopy(stmts)
+
+    # -- entry --------------------------------------------------------------
+    def block(self, stmts):
+        out = []
+        for st in stmts:
+            r = self.stmt(st)
+            out.extend(r if isinstance(r, list) else [r])
+        return self._join_returns(out)
+
+    def stmt(self, st):
+        if isinstance(st, _SCOPE_NODES):
+            return st
+        if isinstance(st, ast.If):
+            st.body = self.block(st.body)
+            st.orelse = self.block(st.orelse)
+            return st
+        if isinstance(st, (ast.While, ast.For)):
+            st.body = self.block(st.body)  # inner loops first (bottom-up)
+            return self._maybe_desugar_loop(st)
+        if isinstance(st, ast.With):
+            st.body = self.block(st.body)
+            return st
+        return st
+
+    # -- return join --------------------------------------------------------
+    def _join_returns(self, stmts):
+        """`if t: return a` followed by a tail → graft the tail into the
+        non-returning paths, producing an all-paths-return tree the
+        clean-form lax.cond lowering compiles. Dead tails (after a
+        definite return) are dropped."""
+        for idx, st in enumerate(stmts):
+            if not (isinstance(st, ast.If) and
+                    (_has_return(st.body) or _has_return(st.orelse or []))):
+                continue
+            tail = stmts[idx + 1:]
+            if not tail or self.budget <= 0:
+                return stmts
+            if _all_paths_return([st]):
+                # tail is dead code; keep Python semantics (drop it)
+                self.changed = True
+                return stmts[:idx + 1]
+            body = self._graft(st.body, tail)
+            orelse = self._graft(st.orelse or [], tail)
+            self.changed = True
+            return stmts[:idx] + [ast.If(test=st.test, body=body,
+                                         orelse=orelse)]
+        return stmts
+
+    def _graft(self, branch, tail):
+        if _all_paths_return(branch):
+            return branch            # tail unreachable on this path
+        new = list(branch) + self._copy(tail)
+        return self._join_returns(new)
+
+    # -- loop-escape lowering ------------------------------------------------
+    def _maybe_desugar_loop(self, st):
+        if not _has_loop_escape(st.body):
+            return st
+        if st.orelse:
+            return st        # loop-else + break semantics: keep Python
+        if _has_return(st.body):
+            # a return inside a traced loop has no typable carry slot;
+            # leave untouched (concrete loops still run eagerly)
+            return st
+        if not _escapes_only_under_ifs(st.body):
+            # an escape under Try/With/etc cannot be rewritten by
+            # _lower_escapes — desugaring would skip it (e.g. a continue
+            # in an except handler would bypass the for-loop increment
+            # and spin forever); keep the Python loop
+            return st
+        if self.budget <= 0:
+            return st
+        if isinstance(st, ast.While):
+            return self._desugar_while(st)
+        if (isinstance(st, ast.For) and isinstance(st.target, ast.Name)
+                and _is_range_call(st.iter)
+                and not _assigned_names([st.iter])):
+            return self._desugar_for(st)
+        return st
+
+    def _assign(self, name, value):
+        return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+    def _guard_test(self, brk, test):
+        # not brk and test — the test rides a thunk so it is NOT
+        # evaluated once the break flag is concretely set (Python never
+        # re-evaluates a while test after break)
+        thunk = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=test)
+        return _call_helper("loop_and", [
+            _call_helper("loop_not", [_name(brk)]), thunk])
+
+    def _lower_escapes(self, stmts, brk, cont_tail):
+        """Remove Break/Continue belonging to THIS loop from `stmts`.
+        Break → set the brk flag, drop the dead tail. Continue → replay
+        `cont_tail` (the for-loop increment), drop the dead tail. A
+        conditional escape grafts the tail into both branches (only the
+        non-escaping path reaches it)."""
+        out = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(self._assign(brk, ast.Constant(True)))
+                return out
+            if isinstance(st, ast.Continue):
+                out.extend(self._copy(cont_tail))
+                return out
+            if isinstance(st, ast.If) and _has_loop_escape([st]):
+                tail = stmts[idx + 1:]
+                body = self._lower_escapes(
+                    list(st.body) + self._copy(tail), brk, cont_tail)
+                orelse = self._lower_escapes(
+                    list(st.orelse or []) + self._copy(tail), brk,
+                    cont_tail)
+                out.append(ast.If(test=st.test, body=body or [ast.Pass()],
+                                  orelse=orelse))
+                return out
+            out.append(st)
+        out.extend(self._copy(cont_tail))
+        return out
+
+    def _desugar_while(self, st):
+        i = self._uid()
+        brk = f"_jstf_brk{i}"
+        body = self._lower_escapes(st.body, brk, cont_tail=[])
+        self.changed = True
+        return [self._assign(brk, ast.Constant(False)),
+                ast.While(test=self._guard_test(brk, st.test),
+                          body=body or [ast.Pass()], orelse=[])]
+
+    def _desugar_for(self, st):
+        u = self._uid()
+        iv, brk = f"_jstf_i{u}", f"_jstf_brk{u}"
+        start, stop, step = (f"_jstf_start{u}", f"_jstf_stop{u}",
+                             f"_jstf_step{u}")
+        incr = self._assign(iv, ast.BinOp(left=_name(iv), op=ast.Add(),
+                                          right=_name(step)))
+        # Python's iterator advances at loop TOP: a `continue` replays
+        # the increment; a `break` does not (the target keeps the value
+        # of the breaking iteration).
+        body = self._lower_escapes(st.body, brk, cont_tail=[incr])
+        loop_body = [self._assign(st.target.id, _name(iv))] + body
+        prologue = [
+            ast.Assign(
+                targets=[ast.Tuple(elts=[_name(start, ast.Store()),
+                                         _name(stop, ast.Store()),
+                                         _name(step, ast.Store())],
+                                   ctx=ast.Store())],
+                value=_call_helper("range3", [
+                    ast.Tuple(elts=list(st.iter.args), ctx=ast.Load())])),
+            self._assign(iv, _name(start)),
+            self._assign(brk, ast.Constant(False)),
+            # the target is (re)assigned inside the body, so it rides the
+            # while carry — give it a typed pre-loop binding
+            self._assign(st.target.id, _call_helper("loop_init", [
+                _call_helper("peek", [
+                    ast.Call(func=_name("locals"), args=[], keywords=[]),
+                    ast.Constant(st.target.id)]),
+                _name(iv)])),
+        ]
+        test = _call_helper("loop_and", [
+            _call_helper("loop_not", [_name(brk)]),
+            _call_helper("range_cond", [_name(iv), _name(stop),
+                                        _name(step)])])
+        self.changed = True
+        return prologue + [ast.While(test=test, body=loop_body, orelse=[])]
+
+
+# ---------------------------------------------------------------------------
 # the transformer
 
 def _name(id_, ctx=None):
@@ -579,12 +896,15 @@ def transform(fn):
         raise GraphBreakError("source is not a function definition")
     fdef.decorator_list = []
 
+    pre = _PreLower()
+    fdef.body = pre.block(fdef.body)
+
     tr = _CFTransformer()
     new_body = []
     for stmt in fdef.body:
         r = tr.visit(stmt)
         new_body.extend(r if isinstance(r, list) else [r])
-    if not tr.changed:
+    if not (tr.changed or pre.changed):
         return fn
     fdef.body = new_body
 

@@ -60,12 +60,19 @@ def save(layer, path, input_spec=None, **config):
                     saved.append((t, t._data))
                 for (n, t), arr in zip(named, params + buffers):
                     t._data = arr
+                # snapshot per-sublayer training flags: layer.train()
+                # would recursively force training=True and clobber
+                # sublayers the user deliberately froze in eval mode
+                modes = [(m, m.training) for m in layer.sublayers(
+                    include_self=True)]
                 try:
                     layer.eval()
                     out = layer(*[Tensor(a) for a in inputs])
                     outs = out if isinstance(out, (list, tuple)) else [out]
                     return tuple(o._data for o in outs)
                 finally:
+                    for m, flag in modes:
+                        m.training = flag
                     for t, arr in saved:
                         t._data = arr
 

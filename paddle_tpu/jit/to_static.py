@@ -16,6 +16,12 @@ from ..nn.layer import Layer
 
 _NOT_TO_STATIC = set()
 
+# live StaticFunction instances for jit.graph_break_report(); weak so a
+# dropped function's diagnostics die with it
+import weakref as _weakref
+
+_LIVE_STATIC_FNS: "_weakref.WeakSet" = _weakref.WeakSet()
+
 
 def not_to_static(fn):
     """Mark a function to always run eagerly (reference parity shim)."""
@@ -139,6 +145,7 @@ class StaticFunction:
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__",
                                            "__qualname__"), updated=())
+        _LIVE_STATIC_FNS.add(self)
 
     def _get_traced(self):
         """The fn actually traced under jit: tensor-dependent control flow

@@ -8,6 +8,7 @@ g++ (no pybind11 in this image; bindings are ctypes over a C ABI).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -20,19 +21,45 @@ _BUILD_LOCK = threading.Lock()
 
 
 def _build(src: str, out: str, *, shared=True, extra_flags=()) -> str:
+    """Compile `src` to `out` on demand, keyed by source CONTENT hash.
+
+    Binaries are machine/ABI-specific and never checked in (.gitignore);
+    an mtime check would trust a stale artifact after a fresh checkout
+    (git resets mtimes), so the rebuild key is a sha256 of the source +
+    flags, stored in a sidecar `.stamp` file next to the binary.
+    """
+    import fcntl
     src_path = os.path.join(_DIR, src)
     out_path = os.path.join(_DIR, out)
-    with _BUILD_LOCK:
-        if (not os.path.exists(out_path) or
-                os.path.getmtime(out_path) < os.path.getmtime(src_path)):
+    stamp_path = out_path + ".stamp"
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(
+            f.read() + repr(sorted(extra_flags)).encode()).hexdigest()
+    # _BUILD_LOCK serializes threads; the fcntl lock serializes PROCESSES
+    # (multi-controller workers all import native on startup and would
+    # otherwise race g++ writing the same .so in place).
+    with _BUILD_LOCK, open(out_path + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        stale = not os.path.exists(out_path)
+        if not stale:
+            try:
+                with open(stamp_path) as f:
+                    stale = f.read().strip() != digest
+            except OSError:
+                stale = True
+        if stale:
+            tmp_path = f"{out_path}.tmp.{os.getpid()}"
             cmd = (["g++", "-O2", "-std=c++17"] +
                    (["-shared"] if shared else []) +
                    ["-fPIC", "-pthread"] + list(extra_flags) +
-                   [src_path, "-o", out_path])
+                   [src_path, "-o", tmp_path])
             r = subprocess.run(cmd, capture_output=True, text=True)
             if r.returncode != 0:
                 raise RuntimeError(f"native build of {src} failed:\n"
                                    f"{r.stderr}")
+            os.replace(tmp_path, out_path)  # atomic: no half-written dlopen
+            with open(stamp_path, "w") as f:
+                f.write(digest)
     return out_path
 
 

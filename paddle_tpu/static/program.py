@@ -125,8 +125,11 @@ class Program:
         ordered_keys = [self._feeds[n] for n in names]
         leaf_arrays = [t._data for t in self._leaves.values()]
 
+        # num_ops is in the key: the jitted replay closes over the record
+        # list at trace time, so a Program extended after compilation must
+        # not replay the stale op list for already-seen feed signatures.
         sig = (tuple((a.shape, str(a.dtype)) for a in feed_arrays),
-               tuple(fetch_keys))
+               tuple(fetch_keys), len(self._records))
         fn = self._jit_cache.get(sig)
         if fn is None:
             def pure(feed_arrays, leaf_arrays):

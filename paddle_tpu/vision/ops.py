@@ -78,6 +78,10 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     perm = jnp.argsort(key)
     out = kept_sorted[perm]
     if isinstance(out, jax.core.Tracer):
+        # top_k is a Python int, so the slice is shape-static and legal
+        # under trace; -1 padding semantics are preserved.
+        if top_k is not None:
+            out = out[:top_k]
         return Tensor(out)
     out = out[out >= 0]
     if top_k is not None:
@@ -110,6 +114,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     x: [N, C, H, W]; boxes: [R, 4] in input coords; boxes_num: [N] ROIs
     per image (prefix-assigns ROIs to images). Returns [R, C, ph, pw].
+
+    Numerics note: with sampling_ratio<=0 the reference adapts the
+    sub-sample count per ROI (ceil(roi_size/pooled_size)); that is a
+    data-dependent shape, illegal under XLA's static-shape contract, so
+    this implementation uses a fixed ratio of 2 (the common detector
+    setting). Outputs deviate slightly from reference numerics for ROIs
+    much larger than the output grid; pass an explicit sampling_ratio to
+    pin the reference behavior you need.
     """
     xd = ensure_tensor(x)._data.astype(jnp.float32)
     bx = ensure_tensor(boxes)._data.astype(jnp.float32)
