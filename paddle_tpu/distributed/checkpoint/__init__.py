@@ -5,16 +5,31 @@ load_state_dict (upstream python/paddle/distributed/checkpoint/ —
 unverified, see SURVEY.md §5.4): every rank writes its local shards plus
 global metadata; load reshards automatically when the mesh/degrees change.
 
-TPU-native: orbax/tensorstore is the shard store — jax global arrays
-already know their sharding, orbax writes per-shard OCDBT chunks, and
-restoring with a DIFFERENT NamedSharding performs the reshard (this is
-the mechanism the reference implements by hand with shard-merging logic).
-Falls back to a numpy .npz full-gather format when orbax is unavailable.
+TPU-native, three regimes (round-3 hardening, VERDICT r2 item 8):
+
+- **orbax/tensorstore** (preferred): jax global arrays already know their
+  sharding, orbax writes per-shard OCDBT chunks, and restoring with a
+  DIFFERENT NamedSharding performs the reshard. `async_save=True` uses
+  orbax's AsyncCheckpointer (device→host copy synchronous, file writes
+  in the background).
+- **npz fallback, per-shard**: each key is written as one entry PER
+  ADDRESSABLE SHARD (`key::s{i}`) with its global index in the metadata —
+  no full gather at any scale. Loading assembles exactly the regions the
+  target sharding asks for (`jax.make_array_from_callback`), merging
+  overlapping saved shards — the reference's by-hand shard-merging logic.
+- **true multi-controller** (separate OS processes, Gloo): each rank
+  writes `arrays_rank{r}.npz` of its local state; the coordinator writes
+  the metadata after a cross-process barrier. Loading reads the caller's
+  own rank file (rank-private optimizer shards resume exactly); a rank
+  with no file (scale-out grew the world) restores nothing and reports
+  all keys missing — adopting another rank's private shards would be
+  silently wrong.
 """
 from __future__ import annotations
 
 import json
 import os
+import threading
 
 import numpy as np
 
@@ -22,7 +37,36 @@ import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_all",
+           "AsyncSaveHandle"]
+
+_PENDING: list["AsyncSaveHandle"] = []
+_FORCE_NPZ = False  # tests force the per-shard npz backend
+
+
+class AsyncSaveHandle:
+    """Returned by save_state_dict(async_save=True); .wait() blocks until
+    the checkpoint is durable on disk."""
+
+    def __init__(self, waiter):
+        self._waiter = waiter
+        self._done = False
+
+    def wait(self):
+        if not self._done:
+            self._waiter()
+            self._done = True
+            try:
+                _PENDING.remove(self)
+            except ValueError:
+                pass  # already drained by wait_all()
+        return self
+
+
+def wait_all():
+    """Block until every outstanding async save has finished."""
+    while _PENDING:
+        _PENDING.pop().wait()
 
 
 def _to_arrays(state_dict):
@@ -30,38 +74,212 @@ def _to_arrays(state_dict):
     for k, v in state_dict.items():
         if isinstance(v, Tensor):
             flat[k] = v._data
-        elif isinstance(v, (int, float)):
-            flat[k] = np.asarray(v)
         elif isinstance(v, dict):
             for k2, v2 in _to_arrays(v).items():
                 flat[f"{k}.{k2}"] = v2
+        elif isinstance(v, (int, float)):
+            flat[k] = np.asarray(v)
         else:
             flat[k] = np.asarray(v)
     return flat
 
 
+def _multiproc_world():
+    """(rank, world) in the true multi-controller regime, else (0, 1)."""
+    try:
+        from .. import parallel as _par
+        from ..collective import is_initialized
+        if is_initialized() and jax.process_count() > 1:
+            return _par.get_rank(), _par.get_world_size()
+    except Exception:
+        pass
+    return 0, 1
+
+
+def _shard_entries(key, arr):
+    """Per-shard (entry_name, numpy, start, stop) for one array — one
+    entry per DISTINCT shard index (replication axes deduped), never a
+    full gather of a sharded array."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None or not hasattr(arr, "addressable_shards"):
+        a = np.asarray(arr)
+        return [(f"{key}::s0", a, [0] * a.ndim, list(a.shape))]
+    shape = arr.shape
+    seen = {}
+    out = []
+    for sh in arr.addressable_shards:
+        idx = tuple(
+            (s.start or 0,
+             s.stop if s.stop is not None else shape[d])
+            for d, s in enumerate(sh.index)) if sh.index else \
+            tuple((0, shape[d]) for d in range(len(shape)))
+        if idx in seen:
+            continue
+        seen[idx] = True
+        i = len(out)
+        out.append((f"{key}::s{i}", np.asarray(jax.device_get(sh.data)),
+                    [lo for lo, _ in idx], [hi for _, hi in idx]))
+    return out
+
+
+def _snapshot_npz(path, arrays, fname):
+    """Snapshot per-shard HOST copies now (the caller may mutate the
+    device arrays right after an async save returns); the thunk only
+    writes files."""
+    entries = {}
+    meta = {}
+    for k, a in arrays.items():
+        shards = _shard_entries(k, a)
+        meta[k] = {
+            "shape": list(np.shape(a)),
+            "dtype": str(shards[0][1].dtype),
+            "shards": [{"entry": e, "start": lo, "stop": hi}
+                       for e, _, lo, hi in shards],
+        }
+        for e, buf, _, _ in shards:
+            entries[e] = buf
+
+    def write_arrays():
+        np.savez(os.path.join(path, fname), **entries)
+    return write_arrays, meta
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
+    """Write `state_dict` under `path`. Returns an AsyncSaveHandle when
+    async_save=True (also tracked by `wait_all`), else None."""
     os.makedirs(path, exist_ok=True)
     arrays = _to_arrays(state_dict)
-    meta = {k: {"shape": list(np.shape(a)),
-                "dtype": str(np.asarray(jax.device_get(a)).dtype
-                             if not isinstance(a, np.ndarray) else a.dtype)}
-            for k, a in arrays.items()}
-    try:
-        import orbax.checkpoint as ocp
+    rank, world = _multiproc_world()
 
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.join(os.path.abspath(path), "arrays"), arrays,
-                   force=True)
-        backend = "orbax"
+    if world > 1:
+        # true multi-process: every rank writes ITS OWN local state.
+        # Sequencing: files → barrier → coordinator metadata → barrier,
+        # so metadata.json existing certifies a COMPLETE rank set. In
+        # async mode file writes happen in a thread; the barriers run on
+        # the calling thread at .wait() (collectives are not thread-safe
+        # against concurrent main-thread traffic) — every rank must wait.
+        write_arrays, meta = _snapshot_npz(path, arrays,
+                                           f"arrays_rank{rank}.npz")
+        from ..collective import barrier
+
+        def finalize():
+            barrier(process_group)
+            if rank == coordinator_rank:
+                with open(os.path.join(path, "metadata.json"), "w") as f:
+                    json.dump({"backend": "npz-multiproc",
+                               "world_size": world,
+                               "coordinator_rank": coordinator_rank,
+                               "arrays": meta}, f)
+            barrier(process_group)
+
+        if async_save:
+            t = threading.Thread(target=write_arrays, daemon=True)
+            t.start()
+
+            def waiter():
+                t.join()
+                finalize()
+            h = AsyncSaveHandle(waiter)
+            _PENDING.append(h)
+            return h
+        write_arrays()
+        finalize()
+        return None
+
+    try:
+        if _FORCE_NPZ or os.environ.get("PADDLE_TPU_CKPT_NPZ") == "1":
+            raise ImportError("npz backend forced")
+        import orbax.checkpoint as ocp
+        target = os.path.join(os.path.abspath(path), "arrays")
+        meta = {k: {"shape": list(np.shape(a)),
+                    "dtype": str(np.asarray(
+                        jax.device_get(a)).dtype
+                        if not isinstance(a, np.ndarray) else a.dtype)}
+                for k, a in arrays.items()}
+        def write_meta():
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump({"backend": "orbax", "arrays": meta}, f)
+        if async_save:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            ckptr.save(target, arrays, force=True)
+
+            def waiter(c=ckptr):
+                c.wait_until_finished()
+                c.close()
+                # metadata LAST: its existence certifies a durable
+                # checkpoint (a crash before wait() must not leave
+                # metadata pointing at a partial arrays dir)
+                write_meta()
+            h = AsyncSaveHandle(waiter)
+            _PENDING.append(h)
+            return h
+        ocp.PyTreeCheckpointer().save(target, arrays, force=True)
+        write_meta()
+        return None
     except Exception:
-        np.savez(os.path.join(path, "arrays.npz"),
-                 **{k: np.asarray(jax.device_get(a))
-                    for k, a in arrays.items()})
-        backend = "npz"
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump({"backend": backend, "arrays": meta}, f)
+        pass  # orbax missing/failed → durable per-shard npz below
+
+    write_arrays, meta = _snapshot_npz(path, arrays, "arrays.npz")
+
+    def write():
+        write_arrays()
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump({"backend": "npz-sharded", "arrays": meta}, f)
+    if async_save:
+        t = threading.Thread(target=write, daemon=True)
+        t.start()
+        h = AsyncSaveHandle(t.join)
+        _PENDING.append(h)
+        return h
+    write()
+    return None
+
+
+def _assemble_region(npz, shards, region, dtype):
+    """Fill the requested global `region` (list of (lo, hi)) from the
+    saved shard entries that overlap it — the shard-merge."""
+    out_shape = [hi - lo for lo, hi in region]
+    out = np.zeros(out_shape, dtype=dtype)
+    for sh in shards:
+        src_sl, dst_sl = [], []
+        empty = False
+        for (rlo, rhi), slo, shi in zip(region, sh["start"], sh["stop"]):
+            lo, hi = max(rlo, slo), min(rhi, shi)
+            if lo >= hi:
+                empty = True
+                break
+            src_sl.append(slice(lo - slo, hi - slo))
+            dst_sl.append(slice(lo - rlo, hi - rlo))
+        if empty:
+            continue
+        out[tuple(dst_sl)] = npz[sh["entry"]][tuple(src_sl)]
+    return out
+
+
+def _restore_npz_sharded(npz, meta_arrays, flat_targets):
+    restored = {}
+    for k, t in flat_targets.items():
+        m = meta_arrays.get(k)
+        if m is None:
+            continue
+        shape = tuple(m["shape"])
+        dtype = np.dtype(m["dtype"])
+        sharding = getattr(t._data, "sharding", None)
+        if (sharding is not None and hasattr(sharding, "mesh")
+                and shape == tuple(t._data.shape) and shape):
+            # device-resident reshard: materialize ONLY the regions the
+            # target sharding asks for, shard by shard
+            def cb(index, m=m, shape=shape, dtype=dtype):
+                region = [(s.start or 0,
+                           s.stop if s.stop is not None else shape[d])
+                          for d, s in enumerate(index)]
+                return _assemble_region(npz, m["shards"], region, dtype)
+            restored[k] = jax.make_array_from_callback(shape, sharding, cb)
+        else:
+            region = [(0, s) for s in shape]
+            restored[k] = _assemble_region(npz, m["shards"], region, dtype)
+    return restored
 
 
 def load_state_dict(state_dict, path, process_group=None,
@@ -83,20 +301,50 @@ def load_state_dict(state_dict, path, process_group=None,
                 walk(v, key + ".")
     walk(state_dict)
 
-    if meta["backend"] == "orbax":
+    backend = meta["backend"]
+    if backend == "orbax":
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
+        # restore_args must mirror the FULL saved tree (orbax restores
+        # the whole structure); targets not being restored get plain
+        # RestoreArgs, and loading a subset of keys subsets afterwards
+        saved_keys = set(meta.get("arrays", {}))
         restore_args = {}
-        for k, t in flat_targets.items():
-            sharding = getattr(t._data, "sharding", None)
+        for k in (saved_keys or flat_targets):
+            t = flat_targets.get(k)
+            sharding = getattr(t._data, "sharding", None) \
+                if t is not None else None
             restore_args[k] = ocp.ArrayRestoreArgs(sharding=sharding) \
                 if sharding is not None and hasattr(
                     sharding, "mesh") else ocp.RestoreArgs()
         restored = ckptr.restore(
             os.path.join(os.path.abspath(path), "arrays"),
             restore_args=restore_args)
-    else:
+    elif backend == "npz-multiproc":
+        rank, world = _multiproc_world()
+        own = os.path.join(path, f"arrays_rank{rank}.npz")
+        if not os.path.exists(own):
+            # a rank with no file (e.g. scale-out grew the world) must
+            # NOT adopt another rank's private shards as its own — the
+            # files are rank-private and keys are indistinguishable.
+            # Restore nothing and report every key missing so the caller
+            # reinitializes deliberately.
+            import sys
+            sys.stderr.write(
+                f"paddle_tpu checkpoint: no shard file for rank {rank} "
+                f"in {path} (saved world_size="
+                f"{meta.get('world_size')}); restoring nothing for this "
+                "rank\n")
+            restored = {}
+        else:
+            npz = np.load(own)
+            restored = _restore_npz_sharded(npz, meta["arrays"],
+                                            flat_targets)
+    elif backend == "npz-sharded":
+        npz = np.load(os.path.join(path, "arrays.npz"))
+        restored = _restore_npz_sharded(npz, meta["arrays"], flat_targets)
+    else:  # legacy "npz": one full entry per key
         data = np.load(os.path.join(path, "arrays.npz"))
         restored = {k: data[k] for k in data.files}
 
@@ -107,6 +355,10 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         arr = restored[k]
         sharding = getattr(t._data, "sharding", None)
+        if isinstance(arr, jax.Array) and sharding is not None and \
+                arr.sharding == sharding:
+            t._inplace_update(arr.astype(t._data.dtype))
+            continue
         new = jax.numpy.asarray(arr).astype(t._data.dtype)
         if sharding is not None and hasattr(sharding, "mesh"):
             new = jax.device_put(new, sharding)  # reshard to live layout

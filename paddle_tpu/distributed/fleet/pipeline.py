@@ -19,10 +19,19 @@ TPU-native design: the schedule is a DIFFERENTIABLE COLLECTIVE SCAN inside
   activation memory (the reason the reference needs 1F1B rather than
   GPipe); compute-bubble fraction matches 1F1B at (S-1)/(M+S-1);
 - stage bodies must be structurally identical blocks (the transformer
-  case); embedding runs ONLY on stage 0 and head+loss ONLY on the last
-  stage, via `lax.cond` on the stage index — other stages skip those
-  FLOPs at runtime (dedicated stage placement, reference: pp_layers
-  SharedLayerDesc head/embedding stages);
+  case); embedding and head+loss run BATCHED and replicated outside the
+  tick scan with the loss masked to the last stage and psum'd — in
+  lockstep SPMD per-stage specialization saves no wall-clock, and the
+  mask keeps gradients single-counted (see `spmd_loss`);
+- **weight tying** (reference: pp_layers SharedLayerDesc): a
+  SharedLayerDesc key names one built layer; later descs with the same
+  key become thin refs calling `forward_func(layer, x)` against the SAME
+  parameter tensors. Because pre+post params are substituted for the
+  whole traced body, both uses see one traced array and the shard_map
+  transpose psums the tied cotangents from the embedding path (stage-0
+  injection) and the head path (last-stage loss) into one accumulated
+  gradient — the reference's cross-stage tied-weight allreduce, done by
+  the partitioner;
 - **interleaved virtual pipeline** (`num_virtual_pipeline_stages` = V,
   reference: PipelineParallelWithInterleave): blocks are split into S·V
   chunks; physical stage s owns chunks {v·S+s} (Megatron placement).
@@ -69,12 +78,38 @@ class LayerDesc:
 
 
 class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer (reference: fleet pp SharedLayerDesc). The first
+    desc with a given `key` builds the layer; every later desc with the
+    same key resolves to a `_SharedLayerRef` that runs
+    ``forward_func(layer, x)`` (default: ``layer(x)``) against the SAME
+    parameters — tied input/output embeddings in one pipeline program."""
+
     def __init__(self, key, layer_cls, *args, forward_func=None,
                  shared_weight_attr="weight", **kwargs):
         super().__init__(layer_cls, *args, **kwargs)
         self.layer_name = key
         self.forward_func = forward_func
         self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedLayerRef(Layer):
+    """Second occurrence of a SharedLayerDesc key: forwards through the
+    original layer's params WITHOUT re-registering them (the tied weight
+    must appear exactly once in the program's parameter list; the ref
+    reads the owner's live — traced, during compilation — tensors)."""
+
+    def __init__(self, owner, forward_func, shared_weight_attr):
+        super().__init__()
+        # bypass Layer.__setattr__ so the owner is NOT registered as a
+        # sublayer (its params would be collected twice)
+        object.__setattr__(self, "_shared_owner", owner)
+        object.__setattr__(self, "_shared_forward", forward_func)
+        self.shared_weight_attr = shared_weight_attr
+
+    def forward(self, x, *args):
+        if self._shared_forward is not None:
+            return self._shared_forward(self._shared_owner, x, *args)
+        return self._shared_owner(x, *args)
 
 
 class PipelineLayer(Layer):
@@ -98,19 +133,51 @@ class PipelineLayer(Layer):
         self.num_virtual_pipeline_stages = max(
             int(num_virtual_pipeline_stages or 1), 1)
         descs = list(layers)
-        built = [d.build_layer() if isinstance(d, LayerDesc) else d
-                 for d in descs]
-        # find the longest run of same-class layers => the block section
+        shared: dict[str, Layer] = {}
+        built = []
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in shared:
+                    built.append(_SharedLayerRef(shared[d.layer_name],
+                                                 d.forward_func,
+                                                 d.shared_weight_attr))
+                else:
+                    layer = d.build_layer()
+                    shared[d.layer_name] = layer
+                    built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            else:
+                built.append(d)
+        self.shared_layers = shared
         classes = [type(b).__name__ for b in built]
-        best_start, best_len = 0, 0
-        i = 0
-        while i < len(classes):
-            j = i
-            while j < len(classes) and classes[j] == classes[i]:
-                j += 1
-            if j - i > best_len:
-                best_start, best_len = i, j - i
-            i = j
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            # reference seg_method "layer:ClassName": the repeated block
+            # section is exactly the (contiguous) run of that class
+            cls_name = seg_method.split(":", 1)[1]
+            idxs = [i for i, c in enumerate(classes) if c == cls_name]
+            if not idxs:
+                raise ValueError(
+                    f"seg_method {seg_method!r}: no layer of class "
+                    f"{cls_name!r} in the desc list (have {set(classes)})")
+            if idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+                raise ValueError(
+                    f"seg_method {seg_method!r}: occurrences of "
+                    f"{cls_name!r} are not contiguous — the collective-"
+                    "scan runtime needs one repeated middle section")
+            best_start, best_len = idxs[0], len(idxs)
+        else:
+            # 'uniform': the longest run of same-class layers is the
+            # block section
+            best_start, best_len = 0, 0
+            i = 0
+            while i < len(classes):
+                j = i
+                while j < len(classes) and classes[j] == classes[i]:
+                    j += 1
+                if j - i > best_len:
+                    best_start, best_len = i, j - i
+                i = j
         self._pre = LayerList(built[:best_start])
         self._blocks = LayerList(built[best_start:best_start + best_len])
         self._post = LayerList(built[best_start + best_len:])
@@ -407,17 +474,10 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
         h, _ = jax.lax.scan(body, x, tuple(chunk))
         return h
 
-    def apply_section(named, section, params, x):
-        saved = [(p, p._data) for _, p in named]
-        for (n, p), arr in zip(named, params):
-            p._data = arr
-        try:
-            out = x
-            for l in section:
-                out = l(out)
-        finally:
-            for p, arr in saved:
-                p._data = arr
+    def run_section(section, x):
+        out = x
+        for l in section:
+            out = l(out)
         return out._data if isinstance(out, Tensor) else out
 
     def spmd_loss(key, pre, post, blk, micro, mlab):
@@ -436,8 +496,19 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
         across pp). Gradient single-counting: the loss is masked to the
         last stage and psum'd, so only one pp rank's head/embedding path
         carries cotangents; the shard_map transpose of the replicated
-        param inputs then psums to the correct total."""
+        param inputs then psums to the correct total.
+
+        Pre+post params are substituted for the WHOLE body (not per
+        section): a `_SharedLayerRef` in the head reads the embedding
+        owner's tensors, which must still hold the traced arrays when
+        the post section runs — that is what ties the weights inside
+        one differentiated program."""
         _random.push_trace_key(key)
+        sub = ([(p, arr) for (_, p), arr in zip(pre_named, pre)] +
+               [(p, arr) for (_, p), arr in zip(post_named, post)])
+        saved = [(p, p._data) for p, _ in sub]
+        for p, arr in sub:
+            p._data = arr
         try:
             sid = jax.lax.axis_index("pp")
             T = M * V + S - 1
@@ -445,7 +516,7 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
 
             # batched embedding for ALL microbatches
             flat = micro.reshape((M * mb,) + micro.shape[2:])
-            emb = apply_section(pre_named, layers._pre, pre, Tensor(flat))
+            emb = run_section(layers._pre, Tensor(flat))
             emb_all = emb.reshape((M, mb) + emb.shape[1:])
 
             def sched(u):
@@ -494,8 +565,7 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
             # transient logits at [mb, ...] instead of [M·mb, ...]
             lval = jnp.zeros((), jnp.float32)
             for m in range(M):
-                lg = apply_section(post_named, layers._post, post,
-                                   Tensor(h_all[m]))
+                lg = run_section(layers._post, Tensor(h_all[m]))
                 if loss_fn is not None:
                     l_t = loss_fn(Tensor(lg), Tensor(mlab[m]))
                     l_m = (l_t._data if isinstance(l_t, Tensor)
@@ -508,6 +578,8 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
             # backward doesn't S-multiply the head/embedding grads
             return jax.lax.psum(jnp.where(sid == S - 1, lval, 0.0), "pp")
         finally:
+            for p, arr in saved:
+                p._data = arr
             _random.pop_trace_key()
 
     smapped = shard_map(

@@ -37,38 +37,79 @@ class Container:
                 self.proc.kill()
 
 
-def worker_env(rank, nnodes, master, base_port=8100):
+def worker_env(rank, nnodes, master, base_port=8100, incarnation=0):
     """The PADDLE_* env protocol for one worker — the single source of
-    truth shared by the launch CLI and distributed.spawn."""
+    truth shared by the launch CLI and distributed.spawn. `incarnation`
+    counts elastic re-forms: ports shift with it (old sockets may sit in
+    TIME_WAIT) and workers read it to know they must resume from the
+    latest checkpoint."""
+    bp = base_port + incarnation * 200
     return {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nnodes),
         "PADDLE_MASTER": master or "",
-        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{bp + rank}",
         "PADDLE_TRAINER_ENDPOINTS": ",".join(
-            f"127.0.0.1:{base_port + r}" for r in range(nnodes)),
+            f"127.0.0.1:{bp + r}" for r in range(nnodes)),
         "PADDLE_RANK_IN_NODE": "0",
+        "PADDLE_ELASTIC_RESTART": str(incarnation),
     }
 
 
-def build_env(rank, nnodes, master, base_env=None):
+def build_env(rank, nnodes, master, base_env=None, incarnation=0):
     env = dict(base_env or os.environ)
-    env.update(worker_env(rank, nnodes, master))
+    env.update(worker_env(rank, nnodes, master, incarnation=incarnation))
     return env
 
 
+def _shift_master(master, incarnation):
+    """Re-formed jobs rendezvous on a fresh port (the dead coordinator's
+    port may linger in TIME_WAIT)."""
+    if not master or incarnation == 0:
+        return master
+    host, port = master.rsplit(":", 1)
+    return f"{host}:{int(port) + incarnation}"
+
+
 def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
-           max_restarts=0, elastic_level=0, run_mode="collective"):
+           max_restarts=0, elastic_level=0, run_mode="collective",
+           min_nodes=None, max_reforms=5):
     """Spawn nnodes containers of `script` with the env protocol; watch &
-    restart per elastic_level (0: fail job; >=1: restart failed rank)."""
-    containers = []
-    for rank in range(nnodes):
-        cmd = [sys.executable, script, *script_args]
-        env = build_env(rank, nnodes, master)
-        c = Container(cmd, env, os.path.join(log_dir,
-                                             f"workerlog.{rank}"))
-        c.start()
-        containers.append(c)
+    restart per elastic_level:
+
+    - 0: any failure fails the job;
+    - 1: same-rank restart of a failed container (up to max_restarts);
+    - 2: ELASTIC MEMBERSHIP — when a rank fails beyond its restart
+      budget (or a scale signal arrives), the job RE-FORMS at the new
+      world size: every survivor is terminated and the whole world is
+      relaunched with recomputed ranks, a fresh rendezvous port, and
+      PADDLE_ELASTIC_RESTART bumped so workers resume from checkpoint
+      (reference: fleet elastic rank reassignment; SURVEY.md §5.3).
+
+    Scale-in/out signal: write the target world size to
+    `{log_dir}/scale_to`; the watcher re-forms to any size within
+    [min_nodes, nnodes_at_launch·… max observed] bounds.
+    """
+    min_np = min_nodes if min_nodes is not None else \
+        (1 if elastic_level >= 2 else nnodes)
+    max_np = max(nnodes, min_np)
+    incarnation = 0
+    cur_n = nnodes
+
+    def start_world(n, inc):
+        cs = []
+        m = _shift_master(master, inc)
+        for rank in range(n):
+            cmd = [sys.executable, script, *script_args]
+            env = build_env(rank, n, m, incarnation=inc)
+            c = Container(cmd, env, os.path.join(
+                log_dir, f"workerlog.{rank}" if inc == 0 else
+                f"workerlog.{rank}.r{inc}"))
+            c.start()
+            cs.append(c)
+        return cs
+
+    containers = start_world(cur_n, incarnation)
 
     def shutdown(*_):
         for c in containers:
@@ -78,25 +119,62 @@ def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
     signal.signal(signal.SIGINT, shutdown)
     signal.signal(signal.SIGTERM, shutdown)
 
+    def reform(new_n):
+        nonlocal containers, incarnation, cur_n
+        for c in containers:
+            c.terminate()
+        incarnation += 1
+        cur_n = new_n
+        print(f"[launch] elastic re-form #{incarnation}: world size "
+              f"{new_n}", flush=True)
+        containers = start_world(new_n, incarnation)
+
+    scale_file = os.path.join(log_dir, "scale_to")
     while True:
-        alive = 0
+        # scale-in/out signal (reference: elastic membership watch)
+        if elastic_level >= 2 and os.path.exists(scale_file):
+            try:
+                target = int(open(scale_file).read().strip())
+            except ValueError:
+                target = None
+            os.unlink(scale_file)
+            if target and min_np <= target <= max_np and \
+                    target != cur_n and incarnation < max_reforms:
+                reform(target)
+                continue
+
+        alive, done, failed = 0, 0, []
         for rank, c in enumerate(containers):
             rc = c.poll()
             if rc is None:
                 alive += 1
-            elif rc != 0:
-                if elastic_level >= 1 and c.restarts < max_restarts:
-                    c.restarts += 1
-                    print(f"[launch] rank {rank} exited {rc}; restart "
-                          f"{c.restarts}/{max_restarts}", flush=True)
-                    c.start()
-                    alive += 1
-                else:
-                    print(f"[launch] rank {rank} failed with {rc}; "
-                          f"terminating job", flush=True)
-                    for other in containers:
-                        other.terminate()
-                    return rc
+            elif rc == 0:
+                done += 1
+            else:
+                failed.append((rank, c, rc))
+
+        if failed:
+            rank, c, rc = failed[0]
+            if elastic_level >= 1 and c.restarts < max_restarts:
+                c.restarts += 1
+                print(f"[launch] rank {rank} exited {rc}; restart "
+                      f"{c.restarts}/{max_restarts}", flush=True)
+                c.start()
+            elif elastic_level >= 2 and alive >= min_np and \
+                    incarnation < max_reforms:
+                # survivors re-form at the smaller world size with
+                # recomputed ranks (scale-in on permanent failure)
+                print(f"[launch] rank {rank} failed with {rc}; "
+                      f"re-forming at world size {alive}", flush=True)
+                reform(alive)
+            else:
+                print(f"[launch] rank {rank} failed with {rc}; "
+                      f"terminating job", flush=True)
+                for other in containers:
+                    other.terminate()
+                return rc
+            continue
+
         if alive == 0:
             return 0
         time.sleep(1)
@@ -105,7 +183,9 @@ def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch")
-    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="world size N, or MIN:MAX for an elastic job "
+                        "(starts at MAX, may re-form down to MIN)")
     p.add_argument("--master", type=str, default=None)
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=0)
@@ -117,11 +197,18 @@ def main(argv=None):
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
-    return launch(args.script, args.script_args, nnodes=args.nnodes,
+    if ":" in args.nnodes:
+        lo, hi = args.nnodes.split(":", 1)
+        min_nodes, nnodes = int(lo), int(hi)
+        elastic_level = max(args.elastic_level, 2)
+    else:
+        nnodes, min_nodes = int(args.nnodes), None
+        elastic_level = args.elastic_level
+    return launch(args.script, args.script_args, nnodes=nnodes,
                   master=args.master, log_dir=args.log_dir,
                   max_restarts=args.max_restarts,
-                  elastic_level=args.elastic_level,
-                  run_mode=args.run_mode)
+                  elastic_level=elastic_level,
+                  run_mode=args.run_mode, min_nodes=min_nodes)
 
 
 if __name__ == "__main__":

@@ -504,6 +504,10 @@ def _is_range_call(node):
             not any(isinstance(a, ast.Starred) for a in node.args))
 
 
+class _BudgetExceeded(Exception):
+    """Graft blowup guard tripped mid-desugar; caller keeps the original."""
+
+
 def _escapes_only_under_ifs(stmts):
     """Every break/continue at this loop's level is reachable through
     plain If nesting only — the one shape _lower_escapes can rewrite."""
@@ -620,12 +624,18 @@ class _PreLower:
             return st
         if self.budget <= 0:
             return st
-        if isinstance(st, ast.While):
-            return self._desugar_while(st)
-        if (isinstance(st, ast.For) and isinstance(st.target, ast.Name)
-                and _is_range_call(st.iter)
-                and not _assigned_names([st.iter])):
-            return self._desugar_for(st)
+        try:
+            if isinstance(st, ast.While) and \
+                    not _assigned_names([st.test]):
+                # (walrus in the test would bind inside the generated
+                # thunk lambda's scope — same guard as visit_While)
+                return self._desugar_while(st)
+            if (isinstance(st, ast.For) and isinstance(st.target, ast.Name)
+                    and _is_range_call(st.iter)
+                    and not _assigned_names([st.iter])):
+                return self._desugar_for(st)
+        except _BudgetExceeded:
+            pass  # graft blowup: keep the Python loop (eager fallback)
         return st
 
     def _assign(self, name, value):
@@ -649,6 +659,8 @@ class _PreLower:
         `cont_tail` (the for-loop increment), drop the dead tail. A
         conditional escape grafts the tail into both branches (only the
         non-escaping path reaches it)."""
+        if self.budget <= 0:
+            raise _BudgetExceeded()
         out = []
         for idx, st in enumerate(stmts):
             if isinstance(st, ast.Break):

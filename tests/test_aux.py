@@ -197,3 +197,128 @@ class TestNanInfCheck:
             (P.to_tensor([0.0]) / P.to_tensor([0.0])).numpy()
         P.set_flags({"FLAGS_check_nan_inf": False})
         assert not jax.config.jax_debug_nans
+
+
+class TestDistributedCheckpointHardened:
+    """Round-3 hardening (VERDICT r2 item 8): async save, per-shard npz
+    (no full gather), sharded→differently-sharded reshard, optimizer
+    state round-trip."""
+
+    def test_async_save_handle(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        net = nn.Linear(4, 8)
+        path = str(tmp_path / "async_ckpt")
+        h = ckpt.save_state_dict(net.state_dict(), path, async_save=True)
+        assert h is not None
+        h.wait()
+        net2 = nn.Linear(4, 8)
+        missing = ckpt.load_state_dict(net2.state_dict(), path)
+        assert not missing
+        assert np.allclose(net.weight.numpy(), net2.weight.numpy())
+        ckpt.wait_all()  # idempotent
+
+    def test_npz_per_shard_no_full_gather(self, tmp_path):
+        """Forced npz backend writes one entry PER SHARD with its global
+        index; loading into a different sharding merges shards."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pp
+        from paddle_tpu.distributed import checkpoint as ckpt
+        import paddle_tpu as P
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("a", "b"))
+        w = P.to_tensor(
+            np.arange(32 * 16, dtype=np.float32).reshape(32, 16))
+        w._data = jax.device_put(w._data, NamedSharding(mesh, Pp("a", "b")))
+        path = str(tmp_path / "npz_ckpt")
+        ckpt._FORCE_NPZ = True
+        try:
+            ckpt.save_state_dict({"w": w}, path)
+        finally:
+            ckpt._FORCE_NPZ = False
+
+        meta = json.load(open(os.path.join(path, "metadata.json")))
+        assert meta["backend"] == "npz-sharded"
+        shards = meta["arrays"]["w"]["shards"]
+        assert len(shards) == 8, shards  # 4x2 distinct shard indices
+        npz = np.load(os.path.join(path, "arrays.npz"))
+        assert all(npz[s["entry"]].shape == (8, 8) for s in shards)
+
+        # load into a DIFFERENT sharding (transposed axes)
+        w2 = P.to_tensor(np.zeros((32, 16), np.float32))
+        w2._data = jax.device_put(w2._data,
+                                  NamedSharding(mesh, Pp("b", "a")))
+        missing = ckpt.load_state_dict({"w": w2}, path)
+        assert not missing
+        assert np.allclose(w2.numpy(),
+                           np.arange(32 * 16).reshape(32, 16))
+        assert w2._data.sharding.spec == Pp("b", "a")
+
+    def test_sharded_to_differently_sharded_orbax(self, tmp_path):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pp
+        from paddle_tpu.distributed import checkpoint as ckpt
+        import paddle_tpu as P
+
+        mesh = Mesh(np.array(jax.devices()), ("x",))
+        ref = np.random.default_rng(3).standard_normal(
+            (16, 8)).astype(np.float32)
+        w = P.to_tensor(ref)
+        w._data = jax.device_put(w._data, NamedSharding(mesh, Pp("x")))
+        path = str(tmp_path / "orbax_reshard")
+        ckpt.save_state_dict({"w": w}, path)
+
+        w2 = P.to_tensor(np.zeros((16, 8), np.float32))
+        w2._data = jax.device_put(w2._data,
+                                  NamedSharding(mesh, Pp(None, "x")))
+        missing = ckpt.load_state_dict({"w": w2}, path)
+        assert not missing
+        assert np.allclose(w2.numpy(), ref)
+        assert w2._data.sharding.spec == Pp(None, "x")
+
+    def test_sharded_optimizer_state_roundtrip(self, tmp_path):
+        """ZeRO-style sharded AdamW moments survive save → perturb →
+        load with shardings intact."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pp
+        from paddle_tpu.distributed import checkpoint as ckpt
+        import paddle_tpu as P
+
+        mesh = Mesh(np.array(jax.devices()), ("sharding",))
+        net = nn.Linear(16, 8, bias_attr=False)
+        opt = P.optimizer.AdamW(1e-3, parameters=net.parameters())
+        x = P.to_tensor(np.random.default_rng(0).standard_normal(
+            (4, 16)).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        # shard the moments over the mesh (ZeRO-1 style placement)
+        sh = NamedSharding(mesh, Pp("sharding"))
+        state = opt._accum[id(net.weight)]
+        state = {k: jax.device_put(v, sh) if np.ndim(v) >= 1 else v
+                 for k, v in state.items()}
+        opt._accum[id(net.weight)] = state
+        mom_ref = {k: np.asarray(jax.device_get(v))
+                   for k, v in state.items()}
+
+        sd = {"w": net.weight}
+        sd.update({f"opt.{k}": P.Tensor(v) if not isinstance(v, P.Tensor)
+                   else v for k, v in state.items()})
+        path = str(tmp_path / "opt_ckpt")
+        ckpt.save_state_dict(sd, path)
+
+        # perturb then restore into same-sharded targets
+        targets = {"w": net.weight}
+        for k, v in state.items():
+            z = P.Tensor(jax.device_put(
+                jax.numpy.zeros_like(v), sh)
+                if np.ndim(v) >= 1 else jax.numpy.zeros_like(v))
+            targets[f"opt.{k}"] = z
+        missing = ckpt.load_state_dict(targets, path)
+        assert not missing
+        for k in state:
+            got = np.asarray(jax.device_get(targets[f"opt.{k}"]._data))
+            assert np.allclose(got, mom_ref[k]), k
+            if np.ndim(mom_ref[k]) >= 1:
+                assert targets[f"opt.{k}"]._data.sharding == sh

@@ -150,3 +150,52 @@ class TestSpawn:
         vals = [float(open(tmp_path / f"spawn.{rk}").read())
                 for rk in range(2)]
         assert vals == [3.0, 3.0], vals
+
+
+class TestMultiProcessCheckpoint:
+    def test_per_rank_ckpt_roundtrip(self, tmp_path):
+        """Round-3 (VERDICT r2 item 8): per-rank shard files + async_save
+        + coordinator metadata across 2 real processes."""
+        port = _free_port()
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+               "--log_dir", str(tmp_path / "logs"),
+               os.path.join(WORKERS, "ckpt_worker.py"), str(tmp_path)]
+        r = subprocess.run(cmd, env=_clean_env(), cwd=REPO, timeout=300,
+                           capture_output=True, text=True)
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
+        assert r.returncode == 0, (r.stdout, r.stderr, logs)
+        res = [json.load(open(tmp_path / f"ckpt_result.{rk}.json"))
+               for rk in range(2)]
+        # each rank restored ITS OWN private shard
+        assert np.allclose(res[0]["private"], 1.0)
+        assert np.allclose(res[1]["private"], 2.0)
+
+
+class TestElasticScaleIn:
+    def test_reform_at_smaller_world(self, tmp_path):
+        """Round-3 (VERDICT r2 item 9): permanent rank failure →
+        launcher re-forms the job at world size 1 (recomputed ranks,
+        bumped incarnation); the survivor resumes from checkpoint."""
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", "1:2", "--log_dir", str(tmp_path / "logs"),
+               os.path.join(WORKERS, "elastic_scalein_worker.py"),
+               str(tmp_path)]
+        r = subprocess.run(cmd, env=_clean_env(), cwd=REPO, timeout=300,
+                           capture_output=True, text=True)
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+        assert r.returncode == 0, (r.stdout, r.stderr, logs)
+        assert "re-form" in r.stdout, r.stdout
+        res = json.load(open(tmp_path / "scalein_result.json"))
+        assert res["world"] == 1, res           # scaled in
+        assert res["incarnation"] == 1, res     # one re-form
+        assert 0 < res["resumed_from"] < 20, res  # resumed mid-run
+        assert res["final_step"] == 20, res

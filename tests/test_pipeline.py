@@ -316,3 +316,121 @@ class TestLlamaPipe4D:
         assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1, (l1, l2)
         for p in pipe.parameters():
             p._data.block_until_ready()
+
+
+class TiedEmbed(nn.Layer):
+    """'Embedding' whose weight is tied into the head (GPT/LLaMA idiom)."""
+
+    def __init__(self, din, d):
+        super().__init__()
+        self.fc = nn.Linear(din, d, bias_attr=False)
+
+    def forward(self, x):
+        return P.tanh(self.fc(x))
+
+
+def tied_head(owner, x):
+    # logits over the input features via the SAME weight, transposed
+    return P.matmul(x, owner.fc.weight, transpose_y=True)
+
+
+class TestSharedLayerDesc:
+    """Round-3 (VERDICT r2 item 6): tied embedding/head across the
+    first/last pipeline stages with accumulated gradients."""
+
+    def _build(self, din=4, d=12, nblocks=4, num_stages=4, loss_fn=None):
+        from paddle_tpu.distributed.fleet import SharedLayerDesc
+        return PipelineLayer(
+            layers=[SharedLayerDesc("embed", TiedEmbed, din, d)] +
+                   [LayerDesc(Block, d) for _ in range(nblocks)] +
+                   [SharedLayerDesc("embed", TiedEmbed, din, d,
+                                    forward_func=tied_head)],
+            num_stages=num_stages, loss_fn=loss_fn)
+
+    def test_tie_structure(self):
+        pipe = self._build()
+        assert len(pipe.shared_layers) == 1
+        owner = pipe.shared_layers["embed"]
+        ref = pipe._post[0]
+        assert ref._shared_owner is owner
+        # the tied weight is registered exactly once: under _pre, with
+        # no duplicate registration under the _post ref
+        names = [n for n, _ in pipe.named_parameters()]
+        assert "_pre.0.fc.weight" in names, names
+        assert not any(n.startswith("_post") for n in names), names
+        # dense forward works through the ref (eager tie)
+        out = pipe(P.randn([3, 4]))
+        assert out.shape == [3, 4]
+
+    def test_tied_pp_parity_and_grad_accumulation(self):
+        """Pipeline loss AND the updated tied weight match a dense
+        microbatched-accumulation oracle — the tie's gradient is the sum
+        of the embedding-path and head-path contributions."""
+        _reset_fleet()
+        P.seed(23)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        pipe = self._build(loss_fn=mse_loss)
+        snap = {n: p.numpy().copy() for n, p in pipe.named_parameters()}
+
+        opt = P.optimizer.SGD(0.1, parameters=pipe.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        model = fleet.distributed_model(pipe)
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+
+        pp_losses = []
+        for _ in range(2):
+            loss = model.train_batch((P.to_tensor(x), P.to_tensor(y)), opt)
+            pp_losses.append(float(loss.numpy()))
+        tied_pp = pipe.shared_layers["embed"].fc.weight.numpy().copy()
+
+        # dense oracle with identical init
+        _reset_fleet()
+        P.seed(23)
+        dense = self._build(loss_fn=mse_loss)
+        dense.set_state_dict({n: P.to_tensor(a) for n, a in snap.items()})
+        opt2 = P.optimizer.SGD(0.1, parameters=dense.parameters())
+        ref_losses = []
+        M = 4
+        for _ in range(2):
+            total = 0.0
+            for m in range(M):
+                xm = P.to_tensor(x[m * 2:(m + 1) * 2])
+                ym = P.to_tensor(y[m * 2:(m + 1) * 2])
+                loss = mse_loss(dense(xm), ym) / M
+                loss.backward()
+                total += float(loss.numpy())
+            opt2.step()
+            opt2.clear_grad()
+            ref_losses.append(total)
+        tied_ref = dense.shared_layers["embed"].fc.weight.numpy()
+
+        assert np.allclose(pp_losses, ref_losses, rtol=2e-4, atol=2e-5), \
+            (pp_losses, ref_losses)
+        assert np.allclose(tied_pp, tied_ref, rtol=2e-4, atol=2e-5), \
+            np.abs(tied_pp - tied_ref).max()
+
+
+class TestSegMethodLayer:
+    def test_layer_seg_pins_block_class(self):
+        """'layer:Block' beats the longest-run heuristic when a decoy
+        run is longer than the block run."""
+        pipe = PipelineLayer(
+            layers=[Stem(6, 12), Stem(12, 12), Stem(12, 12),
+                    LayerDesc(Block, 12), LayerDesc(Block, 12),
+                    Head(12, 4)],
+            num_stages=2, seg_method="layer:Block")
+        assert len(pipe._pre) == 3
+        assert len(pipe._blocks) == 2
+        assert len(pipe._post) == 1
+
+    def test_layer_seg_missing_class_raises(self):
+        with pytest.raises(ValueError, match="no layer of class"):
+            PipelineLayer(layers=[Stem(6, 12), LayerDesc(Block, 12)],
+                          num_stages=1, seg_method="layer:Bogus")

@@ -1,0 +1,43 @@
+"""Elastic scale-in worker (round 3, VERDICT r2 item 9): a 2-rank job
+where rank 1 fails permanently; the launcher (elastic_level>=2) re-forms
+the job at world size 1 with recomputed ranks and a bumped
+PADDLE_ELASTIC_RESTART; the survivor resumes from the checkpoint and
+finishes. No collectives here on purpose — the launcher's membership
+behavior is the unit under test (real-collective restart is covered by
+the other multiprocess tests)."""
+import json
+import os
+import sys
+import time
+
+OUT = sys.argv[1]
+TOTAL = 20
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+inc = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+assert 0 <= rank < world, (rank, world)
+
+ckpt = os.path.join(OUT, "state.json")
+state = {"step": 0}
+resumed = 0
+if inc > 0 and os.path.exists(ckpt):
+    state = json.load(open(ckpt))
+    resumed = state["step"]
+
+while state["step"] < TOTAL:
+    state["step"] += 1
+    if rank == 0:
+        tmp = ckpt + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, ckpt)  # atomic: SIGTERM must not corrupt it
+    if world == 2 and rank == 1 and state["step"] == 4:
+        os._exit(3)  # permanent failure -> launcher scales the job in
+    time.sleep(0.3)
+
+if rank == 0:
+    with open(os.path.join(OUT, "scalein_result.json"), "w") as f:
+        json.dump({"world": world, "incarnation": inc,
+                   "resumed_from": resumed,
+                   "final_step": state["step"]}, f)
