@@ -236,9 +236,11 @@ def save_state_dict(state_dict, path, process_group=None,
     return None
 
 
-def _assemble_region(npz, shards, region, dtype):
+def _assemble_region(npz, shards, region, dtype, coverage=None):
     """Fill the requested global `region` (list of (lo, hi)) from the
-    saved shard entries that overlap it — the shard-merge."""
+    saved shard entries that overlap it — the shard-merge. `coverage`
+    (optional [region]-shaped bool) records which cells were filled so
+    callers can detect holes instead of restoring silent zeros."""
     out_shape = [hi - lo for lo, hi in region]
     out = np.zeros(out_shape, dtype=dtype)
     for sh in shards:
@@ -254,11 +256,19 @@ def _assemble_region(npz, shards, region, dtype):
         if empty:
             continue
         out[tuple(dst_sl)] = npz[sh["entry"]][tuple(src_sl)]
+        if coverage is not None:
+            coverage[tuple(dst_sl)] = True
     return out
 
 
-def _restore_npz_sharded(npz, meta_arrays, flat_targets):
+def _restore_npz_sharded(npz, meta_arrays, flat_targets,
+                         require_full=False):
+    """Restore targets from per-shard entries. With require_full (the
+    rank-private multiproc regime, where this rank's file may not cover
+    a RESHAPED world's regions), keys with coverage holes are returned
+    in `incomplete` instead of silently zero-filled."""
     restored = {}
+    incomplete = []
     for k, t in flat_targets.items():
         m = meta_arrays.get(k)
         if m is None:
@@ -266,20 +276,37 @@ def _restore_npz_sharded(npz, meta_arrays, flat_targets):
         shape = tuple(m["shape"])
         dtype = np.dtype(m["dtype"])
         sharding = getattr(t._data, "sharding", None)
+        holes = []
         if (sharding is not None and hasattr(sharding, "mesh")
                 and shape == tuple(t._data.shape) and shape):
             # device-resident reshard: materialize ONLY the regions the
             # target sharding asks for, shard by shard
-            def cb(index, m=m, shape=shape, dtype=dtype):
+            def cb(index, m=m, shape=shape, dtype=dtype, holes=holes):
                 region = [(s.start or 0,
                            s.stop if s.stop is not None else shape[d])
                           for d, s in enumerate(index)]
-                return _assemble_region(npz, m["shards"], region, dtype)
-            restored[k] = jax.make_array_from_callback(shape, sharding, cb)
+                cov = np.zeros([hi - lo for lo, hi in region], bool) \
+                    if require_full else None
+                out = _assemble_region(npz, m["shards"], region, dtype,
+                                       coverage=cov)
+                if cov is not None and not cov.all():
+                    holes.append(region)
+                return out
+            arr = jax.make_array_from_callback(shape, sharding, cb)
+            if holes:
+                incomplete.append(k)
+            else:
+                restored[k] = arr
         else:
             region = [(0, s) for s in shape]
-            restored[k] = _assemble_region(npz, m["shards"], region, dtype)
-    return restored
+            cov = np.zeros(shape, bool) if require_full else None
+            out = _assemble_region(npz, m["shards"], region, dtype,
+                                   coverage=cov)
+            if cov is not None and not cov.all():
+                incomplete.append(k)
+            else:
+                restored[k] = out
+    return restored, incomplete
 
 
 def load_state_dict(state_dict, path, process_group=None,
@@ -339,11 +366,22 @@ def load_state_dict(state_dict, path, process_group=None,
             restored = {}
         else:
             npz = np.load(own)
-            restored = _restore_npz_sharded(npz, meta["arrays"],
-                                            flat_targets)
+            # require_full: this rank's file only holds ITS OWN former
+            # shards — a re-formed world asking for different regions
+            # must see the key as missing, not silent zero-fill
+            restored, incomplete = _restore_npz_sharded(
+                npz, meta["arrays"], flat_targets, require_full=True)
+            if incomplete:
+                import sys
+                sys.stderr.write(
+                    "paddle_tpu checkpoint: rank-private file does not "
+                    f"cover the requested regions for {incomplete} "
+                    "(world/mesh changed since save); reporting them "
+                    "missing\n")
     elif backend == "npz-sharded":
         npz = np.load(os.path.join(path, "arrays.npz"))
-        restored = _restore_npz_sharded(npz, meta["arrays"], flat_targets)
+        restored, _ = _restore_npz_sharded(npz, meta["arrays"],
+                                           flat_targets)
     else:  # legacy "npz": one full entry per key
         data = np.load(os.path.join(path, "arrays.npz"))
         restored = {k: data[k] for k in data.files}

@@ -7,8 +7,10 @@ context parallelism (reference ecosystem: PaddleNLP atop the sep axis).
 TPU-native design:
 - `ulysses_attention`: inside shard_map with the sep axis live, tokens are
   sequence-sharded [B, S/n, H, D]; `all_to_all` re-partitions to
-  head-sharded [B, S, H/n, D], the full-sequence attention core runs
-  per-head (Pallas/XLA), and a second all_to_all restores sequence
+  head-sharded [B, S, H/n, D], the full-sequence attention runs through
+  the PALLAS flash core per head group (round-3 — the sep axis exists
+  precisely for long sequences, where the O(s²) XLA reference collapses
+  30× at s=8192; PERF.md), and a second all_to_all restores sequence
   sharding. Two alltoalls ride ICI — exactly the reference mechanism.
 - `ring_flash_attention`: K/V blocks rotate around the sep ring via
   `ppermute` while each step merges partial attention with the numerically
@@ -41,7 +43,7 @@ def _sep_group():
 def ulysses_attention(q, k, v, group=None, causal=False, scale=None):
     """q,k,v: [B, S_local, H, D] sequence-sharded over the sep axis."""
     group = group if group is not None else _sep_group()
-    from ...ops.pallas.flash_attention import _attention_ref
+    from ...ops.pallas.flash_attention import _attention_ref, _flash_core
 
     if group is None or group.axis_name not in current_axis_env():
         return apply(lambda qa, ka, va: _attention_ref(qa, ka, va,
@@ -77,7 +79,10 @@ def ulysses_attention(q, k, v, group=None, causal=False, scale=None):
             return x.reshape(b, sl, n * hn, d)
 
         qh, kh, vh = seq2head(qa), seq2head(ka), seq2head(va)
-        out = _attention_ref(qh, kh, vh, causal=causal, scale=scale)
+        # flash core: Pallas kernel on TPU (streaming, O(S) memory),
+        # XLA reference off-TPU — sequence order after seq2head is the
+        # true global order, so causal semantics carry over unchanged
+        out = _flash_core(qh, kh, vh, causal, scale)
         return head2seq(out)
     return apply(f, q, k, v, name="ulysses_attention")
 

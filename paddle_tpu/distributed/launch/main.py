@@ -132,12 +132,17 @@ def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
     scale_file = os.path.join(log_dir, "scale_to")
     while True:
         # scale-in/out signal (reference: elastic membership watch)
-        if elastic_level >= 2 and os.path.exists(scale_file):
-            try:
-                target = int(open(scale_file).read().strip())
-            except ValueError:
+        if elastic_level >= 2:
+            target = None
+            try:  # read+consume tolerant of concurrent writers (TOCTOU)
+                with open(scale_file) as f:
+                    target = int(f.read().strip())
+            except (OSError, ValueError):
                 target = None
-            os.unlink(scale_file)
+            try:
+                os.unlink(scale_file)
+            except OSError:
+                pass
             if target and min_np <= target <= max_np and \
                     target != cur_n and incarnation < max_reforms:
                 reform(target)

@@ -128,18 +128,21 @@ class LlamaAttention(Layer):
             k = P.concat([cache[0], k], axis=1)
             v = P.concat([cache[1], v], axis=1)
             cache = (k, v)
-        if nkv != nh:  # GQA: repeat kv heads
-            rep = nh // nkv
-            k = k.unsqueeze(3).expand([b, k.shape[1], nkv, rep, hd]) \
-                 .reshape([b, k.shape[1], nh, hd])
-            v = v.unsqueeze(3).expand([b, v.shape[1], nkv, rep, hd]) \
-                 .reshape([b, v.shape[1], nh, hd])
         causal = cache is None
         if self.cfg.use_flash_attention:
+            # GQA: K/V go in at their NATIVE head count — the Pallas
+            # kernel indexes KV heads in its BlockSpec maps (round-3;
+            # the old `repeat` paid G× K/V HBM traffic for nothing)
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=causal,
                 training=self.training)
         else:
+            if nkv != nh:  # XLA debug path: repeat kv heads
+                rep = nh // nkv
+                k = k.unsqueeze(3).expand([b, k.shape[1], nkv, rep, hd]) \
+                     .reshape([b, k.shape[1], nh, hd])
+                v = v.unsqueeze(3).expand([b, v.shape[1], nkv, rep, hd]) \
+                     .reshape([b, v.shape[1], nh, hd])
             # honor the config switch: plain XLA attention (debug /
             # numerics-comparison path, reference flag parity)
             from ..core.autograd import apply as _apply

@@ -8,8 +8,17 @@ SURVEY.md §2.1) exposed via paddle.nn.functional.flash_attention with
 TPU-native design: a Pallas kernel (paddle_tpu/ops/pallas/_fa_kernel.py)
 tiled for the MXU (block sizes multiple of 128 on the lane dim) with the
 standard online-softmax streaming algorithm; `jax.custom_vjp` wires the
-Pallas backward. Off-TPU (CPU tests) or for shapes the kernel doesn't
-support, falls back to a pure-XLA implementation that XLA fuses well.
+Pallas backward. The kernel natively handles **GQA** (KV heads indexed
+in the BlockSpec maps — never repeated through HBM), **packed/varlen
+segments** (block-diagonal masking with dead-block skip), and
+**additive masks** (per-block mask slabs) — round-3, VERDICT r2 item 2.
+
+Fallback discipline (round-3, VERDICT r2 item 3): every Pallas→XLA
+fallback is COUNTED (`dispatch_stats()`), warned once per site, and
+raises under `PADDLE_TPU_REQUIRE_PALLAS=1`. A silent fallback cost
+round 2 ~24 MFU points before it was root-caused (PERF.md); it cannot
+happen quietly again. Off-TPU (CPU tests) the reference path is the
+EXPECTED backend and is not counted as a fallback.
 
 The public entry is `flash_attention_bshd(q, k, v, ...)` on framework
 Tensors; `_attention_ref` is the jax-level oracle shared by tests.
@@ -17,6 +26,10 @@ Tensors; `_attention_ref` is the jax-level oracle shared by tests.
 from __future__ import annotations
 
 import functools
+import os
+import warnings
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -24,10 +37,53 @@ import jax.numpy as jnp
 from ...core.autograd import apply
 from ...core.random import next_key
 
+# ---------------------------------------------------------------------------
+# dispatch accounting: Pallas engagement is observable, fallbacks are loud
+
+_DISPATCH = {"pallas": 0, "fallback": 0}
+_WARNED: set = set()
+
+
+def dispatch_stats():
+    """{'pallas': n, 'fallback': m} — counted at TRACE time (how many
+    attention calls engaged the kernel vs fell back while on TPU)."""
+    return dict(_DISPATCH)
+
+
+def reset_dispatch_stats():
+    _DISPATCH["pallas"] = 0
+    _DISPATCH["fallback"] = 0
+    _WARNED.clear()
+
+
+def _note_pallas():
+    _DISPATCH["pallas"] += 1
+
+
+def _fallback(site, err=None):
+    """Record a Pallas→XLA fallback ON TPU: warn once per site; raise
+    under PADDLE_TPU_REQUIRE_PALLAS=1 (strict mode)."""
+    _DISPATCH["fallback"] += 1
+    msg = (f"paddle_tpu flash attention: Pallas kernel fell back to the "
+           f"XLA reference [{site}]")
+    if err is not None:
+        msg += f": {type(err).__name__}: {err}"
+    if os.environ.get("PADDLE_TPU_REQUIRE_PALLAS") == "1":
+        raise RuntimeError(msg) from err
+    if site not in _WARNED:
+        _WARNED.add(site)
+        warnings.warn(msg + " (warning once per site; set "
+                      "PADDLE_TPU_REQUIRE_PALLAS=1 to make this an error)")
+
 
 def _attention_ref(q, k, v, mask=None, causal=False, scale=None):
-    """XLA reference attention. q,k,v: [B, S, H, D] (bshd)."""
+    """XLA reference attention. q: [B, S, H, D]; k/v may carry fewer
+    (GQA) heads — repeated here (the kernel never repeats)."""
     d = q.shape[-1]
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     # [B,H,Sq,Sk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -41,8 +97,22 @@ def _attention_ref(q, k, v, mask=None, causal=False, scale=None):
             logits = jnp.where(mask, logits, -jnp.inf)
         else:
             logits = logits + mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _seg_additive_mask(q_seg, kv_seg):
+    """[B, 1, Sq, Sk] additive: 0 where segments match, -inf elsewhere."""
+    eq = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+    return jnp.where(eq, 0.0, -jnp.inf).astype(jnp.float32)
+
+
+def _ref_ext(q, k, v, mask, q_seg, kv_seg, causal, scale):
+    if q_seg is not None:
+        seg_m = _seg_additive_mask(q_seg, kv_seg)
+        mask = seg_m if mask is None else mask + seg_m
+    return _attention_ref(q, k, v, mask=mask, causal=causal, scale=scale)
 
 
 # Tests set this True to run the Pallas kernels in interpret mode off-TPU
@@ -50,45 +120,119 @@ def _attention_ref(q, k, v, mask=None, causal=False, scale=None):
 _FORCE_INTERPRET = False
 
 
-def _use_pallas(q_shape, head_dim) -> bool:
-    if not _FORCE_INTERPRET:
-        try:
-            if jax.default_backend() not in ("tpu", "axon"):
-                return False
-        except Exception:
-            return False
-    # MXU-friendly shapes only; fallback handles the rest
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _shape_reason(q_shape, k_shape) -> str | None:
+    """None if the kernel supports this shape, else the reason it can't."""
     b, s, h, d = q_shape
-    return (d in (64, 128, 256)) and s % 128 == 0 and s >= 128
+    sk, kv_heads = k_shape[1], k_shape[2]
+    if d not in (64, 128, 256):
+        return f"head_dim {d} not in (64, 128, 256)"
+    if s % 128 != 0 or s < 128:
+        return f"seq_len {s} not a multiple of 128"
+    if sk != s:
+        return f"kv seq_len {sk} != q seq_len {s} (cross-length)"
+    if kv_heads == 0 or h % kv_heads != 0:
+        return f"num_heads {h} not divisible by kv_heads {kv_heads}"
+    return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
+def _want_pallas() -> bool:
+    return _FORCE_INTERPRET or _on_tpu()
+
+
+def _mask_kernel_ok(mask, b, h, s) -> bool:
+    """Kernel takes additive [B|1, H|1, Sq, Sk] f32 with Sq == Sk == s."""
+    if mask is None:
+        return True
+    return (mask.ndim == 4 and mask.shape[0] in (1, b) and
+            mask.shape[1] in (1, h) and mask.shape[2] == s and
+            mask.shape[3] == s)
+
+
+# ---------------------------------------------------------------------------
+# the differentiable core: q, k, v diff; mask (additive f32) carried with
+# zero cotangent; segment ids are ints (float0 cotangent)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _flash_core_ext(q, k, v, mask, q_seg, kv_seg, causal, scale):
     # Primal (no-grad) body: do NOT request the lse output — pallas_call
     # is opaque to XLA DCE, so asking for lse here would write a dead
     # [B*H, S, 128] f32 buffer on every inference forward.
-    if _use_pallas(q.shape, q.shape[-1]):
-        try:
-            from ._fa_kernel import fa_forward
-            return fa_forward(q, k, v, causal=causal, scale=scale,
-                              interpret=_FORCE_INTERPRET)
-        except Exception:
-            pass
-    return _attention_ref(q, k, v, causal=causal, scale=scale)
+    if _want_pallas():
+        reason = _shape_reason(q.shape, k.shape)
+        if reason is None and _mask_kernel_ok(mask, q.shape[0], q.shape[2],
+                                              q.shape[1]):
+            try:
+                from ._fa_kernel import fa_forward
+                out = fa_forward(q, k, v, causal=causal, scale=scale,
+                                 interpret=_FORCE_INTERPRET, mask=mask,
+                                 q_seg=q_seg, kv_seg=kv_seg)
+                _note_pallas()
+                return out
+            except Exception as e:
+                _fallback("fa_forward", e)
+        else:
+            _fallback(f"fa_forward: {reason or 'unsupported mask shape'}")
+    return _ref_ext(q, k, v, mask, q_seg, kv_seg, causal, scale)
 
 
-def _flash_fwd_vjp(q, k, v, causal, scale):
-    # Training forward: one dispatch point shared with flash_core_lse
-    # (the lse residual feeds the Pallas backward).
-    (out, _lse), res = _flash_lse_fwd(q, k, v, causal, scale)
-    return out, res
+def _ext_fwd(q, k, v, mask, q_seg, kv_seg, causal, scale):
+    if _want_pallas():
+        reason = _shape_reason(q.shape, k.shape)
+        if reason is None and _mask_kernel_ok(mask, q.shape[0], q.shape[2],
+                                              q.shape[1]):
+            try:
+                from ._fa_kernel import fa_forward
+                out, lse_l = fa_forward(q, k, v, causal=causal,
+                                        scale=scale, return_lse=True,
+                                        interpret=_FORCE_INTERPRET,
+                                        mask=mask, q_seg=q_seg,
+                                        kv_seg=kv_seg)
+                _note_pallas()
+                return out, (q, k, v, out, lse_l, mask, q_seg, kv_seg)
+            except Exception as e:
+                _fallback("fa_forward(train)", e)
+        else:
+            _fallback("fa_forward(train): "
+                      f"{reason or 'unsupported mask shape'}")
+    out = _ref_ext(q, k, v, mask, q_seg, kv_seg, causal, scale)
+    return out, (q, k, v, None, None, mask, q_seg, kv_seg)
 
 
-def _flash_bwd_vjp(causal, scale, res, g):
-    return _flash_lse_bwd(causal, scale, res, (g, None))
+def _int_zero(x):
+    return np.zeros(x.shape, jax.dtypes.float0) if x is not None else None
 
 
-_flash_core.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+def _ext_bwd(causal, scale, res, g):
+    q, k, v, out, lse_l, mask, q_seg, kv_seg = res
+    if lse_l is not None:
+        from ._fa_kernel import fa_backward
+        dq, dk, dv = fa_backward(q, k, v, out, lse_l, g, causal=causal,
+                                 scale=scale, interpret=_FORCE_INTERPRET,
+                                 mask=mask, q_seg=q_seg, kv_seg=kv_seg)
+    else:
+        _, vjp_fn = jax.vjp(
+            lambda q_, k_, v_: _ref_ext(q_, k_, v_, mask, q_seg, kv_seg,
+                                        causal, scale), q, k, v)
+        dq, dk, dv = vjp_fn(g)
+    dmask = jnp.zeros_like(mask) if mask is not None else None
+    return (dq, dk, dv, dmask, _int_zero(q_seg), _int_zero(kv_seg))
+
+
+_flash_core_ext.defvjp(_ext_fwd, _ext_bwd)
+
+
+def _flash_core(q, k, v, causal, scale):
+    """Mask/segment-free core (kept as the name the rest of the framework
+    dispatches through)."""
+    return _flash_core_ext(q, k, v, None, None, None, causal, scale)
 
 
 # ---------------------------------------------------------------------------
@@ -120,16 +264,21 @@ def flash_core_lse(q, k, v, causal, scale):
 
 def _flash_lse_fwd(q, k, v, causal, scale):
     b, s, h, d = q.shape
-    if _use_pallas(q.shape, d):
-        try:
-            from ._fa_kernel import fa_forward
-            out, lse_l = fa_forward(q, k, v, causal=causal, scale=scale,
-                                    return_lse=True,
-                                    interpret=_FORCE_INTERPRET)
-            lse = lse_l[:, :, 0].reshape(b, h, s)
-            return (out, lse), (q, k, v, out, lse_l)
-        except Exception:
-            pass
+    if _want_pallas():
+        reason = _shape_reason(q.shape, k.shape)
+        if reason is None:
+            try:
+                from ._fa_kernel import fa_forward
+                out, lse_l = fa_forward(q, k, v, causal=causal,
+                                        scale=scale, return_lse=True,
+                                        interpret=_FORCE_INTERPRET)
+                lse = lse_l[:, :, 0].reshape(b, h, s)
+                _note_pallas()
+                return (out, lse), (q, k, v, out, lse_l)
+            except Exception as e:
+                _fallback("flash_core_lse", e)
+        else:
+            _fallback(f"flash_core_lse: {reason}")
     out, lse = _attention_ref_lse(q, k, v, causal=causal, scale=scale)
     return (out, lse), (q, k, v, None, None)
 
@@ -155,28 +304,77 @@ def _flash_lse_bwd(causal, scale, res, gs):
 flash_core_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention_bshd(q, k, v, mask=None, causal=False, dropout_p=0.0,
-                         scale=None):
-    """Framework-level entry on Tensors; [B, S, H, D] layout."""
-    if mask is not None:
-        # masked path: XLA fallback (mask folding into the Pallas kernel is
-        # a follow-up; XLA still fuses this into few kernels)
-        marr = mask._data
+def _normalize_mask(marr, b, h, sq, sk):
+    """Full masks → additive f32 [B|1, H|1, Sq, Sk] for the kernel's
+    block streaming. Broadcast Sq/Sk dims are NOT materialized (a
+    [B,1,1,Sk] padding mask densified to O(S²) f32 would cost the HBM
+    the flash kernel exists to save) — those return None and ride the
+    segment encoding or the lazily-broadcasting reference instead."""
+    m = marr
+    if m.ndim == 2:
+        m = m[None, None]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.ndim != 4:
+        return None
+    if m.shape[2] != sq or m.shape[3] != sk or             m.shape[0] not in (1, b) or m.shape[1] not in (1, h):
+        return None
+    if m.dtype == jnp.bool_:
+        return jnp.where(m, 0.0, -jnp.inf).astype(jnp.float32)
+    return m.astype(jnp.float32)
 
-        def f(qa, ka, va):
-            return _attention_ref(qa, ka, va, mask=marr, causal=causal,
-                                  scale=scale)
-        out = apply(f, q, k, v, name="attention")
-    else:
-        out = apply(lambda qa, ka, va: _flash_core(qa, ka, va, causal,
-                                                   scale),
-                    q, k, v, name="attention")
+
+def flash_attention_bshd(q, k, v, mask=None, causal=False, dropout_p=0.0,
+                         scale=None, q_seg=None, kv_seg=None):
+    """Framework-level entry on Tensors; [B, S, H, D] layout (k/v may
+    carry fewer heads — GQA runs natively in the kernel). `mask` is
+    bool (True = keep) or additive; q_seg/kv_seg are int32 [B, S] packed
+    segment ids (varlen)."""
+    b, sq, h, _ = q.shape
+    sk = k.shape[1]
+    marr = None
+    qsa = q_seg._data if q_seg is not None and hasattr(q_seg, "_data") \
+        else q_seg
+    ksa = kv_seg._data if kv_seg is not None and hasattr(kv_seg, "_data") \
+        else kv_seg
+    if mask is not None:
+        raw = mask._data
+        if (raw.ndim == 4 and raw.shape[1] == 1 and raw.shape[2] == 1 and
+                raw.dtype == jnp.bool_ and qsa is None and sq == sk):
+            # bool key-padding mask → segment encoding: O(S) memory and
+            # dead-block skipping instead of an O(S²) dense mask
+            keep = jnp.broadcast_to(raw[:, 0, 0, :], (b, sk))
+            ksa = jnp.where(keep, 0, -2).astype(jnp.int32)
+            qsa = jnp.zeros((b, sq), jnp.int32)
+        else:
+            marr = _normalize_mask(raw, b, h, sq, sk)
+        if mask is not None and marr is None and qsa is None:
+            # unsupported rank — XLA reference handles the broadcast
+            marr_raw = mask._data
+
+            def f_raw(qa, ka, va):
+                return _attention_ref(qa, ka, va, mask=marr_raw,
+                                      causal=causal, scale=scale)
+            if _want_pallas():
+                _fallback(f"mask shape {tuple(mask._data.shape)} not "
+                          "kernel-streamable")
+            out = apply(f_raw, q, k, v, name="attention")
+            return _maybe_dropout(out, dropout_p)
+
+    def f(qa, ka, va):
+        return _flash_core_ext(qa, ka, va, marr, qsa, ksa, causal, scale)
+    out = apply(f, q, k, v, name="attention")
+    return _maybe_dropout(out, dropout_p)
+
+
+def _maybe_dropout(out, dropout_p):
     if dropout_p > 0.0:
         key = next_key()
 
         def drop(a):
             keep = jax.random.bernoulli(key, 1.0 - dropout_p, a.shape)
-            return jnp.where(keep, a / (1.0 - dropout_p), 0.0).astype(a.dtype)
+            return jnp.where(keep, a / (1.0 - dropout_p),
+                             0.0).astype(a.dtype)
         out = apply(drop, out, name="attn_dropout")
     return out
 

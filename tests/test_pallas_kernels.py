@@ -198,3 +198,165 @@ class TestFusedAdamWKernel:
                                          lr, step, use_pallas=False)
         for a, b in zip(got_p, ref_p):
             assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def _seg_ids(b, s, n_seg, seed=3):
+    """Monotone packed segment ids [B, S] (varlen packing layout)."""
+    rng = np.random.default_rng(seed)
+    out = np.zeros((b, s), np.int32)
+    for bi in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, s), n_seg - 1,
+                                  replace=False))
+        out[bi] = np.searchsorted(cuts, np.arange(s), side="right")
+    return jnp.asarray(out)
+
+
+class TestKernelGQA:
+    """Round-3 (VERDICT r2 item 2a): KV heads indexed in-kernel."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity(self, causal):
+        q, _, _ = qkv(b=2, s=256, h=8, d=64)
+        _, k, v = qkv(b=2, s=256, h=2, d=64, seed=5)
+        out = fa_forward(q, k, v, causal=causal, interpret=True)
+        ref = _attention_ref(q, k, v, causal=causal)  # ref repeats kv
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_parity(self, causal):
+        import jax
+        from paddle_tpu.ops.pallas._fa_kernel import fa_backward
+        q, _, _ = qkv(b=2, s=256, h=4, d=64)
+        _, k, v = qkv(b=2, s=256, h=2, d=64, seed=5)
+        g = jnp.asarray(np.random.default_rng(7).standard_normal(
+            q.shape).astype(np.float32))
+        out, lse = fa_forward(q, k, v, causal=causal, interpret=True,
+                              return_lse=True)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=causal,
+                                 interpret=True)
+        _, vjp = jax.vjp(lambda a, b_, c: _attention_ref(
+            a, b_, c, causal=causal), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        for got, ref, name in [(dq, rdq, "dq"), (dk, rdk, "dk"),
+                               (dv, rdv, "dv")]:
+            assert np.allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-3), \
+                (name, np.abs(np.asarray(got) - np.asarray(ref)).max())
+        assert dk.shape == k.shape and dv.shape == v.shape
+
+
+class TestKernelSegments:
+    """Round-3 (VERDICT r2 item 2b): packed varlen via segment ids."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity(self, causal):
+        from paddle_tpu.ops.pallas.flash_attention import _ref_ext
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        seg = _seg_ids(2, 256, 3)
+        out = fa_forward(q, k, v, causal=causal, interpret=True,
+                         q_seg=seg, kv_seg=seg)
+        ref = _ref_ext(q, k, v, None, seg, seg, causal, None)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_padding_rows_zero(self):
+        """Rows whose segment id never matches any key produce 0 (the
+        padded-varlen contract)."""
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        qseg = jnp.asarray(np.full((1, 256), -1, np.int32))
+        kseg = jnp.asarray(np.full((1, 256), -2, np.int32))
+        out = fa_forward(q, k, v, causal=False, interpret=True,
+                         q_seg=qseg, kv_seg=kseg)
+        assert np.allclose(np.asarray(out), 0.0)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_parity(self, causal):
+        import jax
+        from paddle_tpu.ops.pallas._fa_kernel import fa_backward
+        from paddle_tpu.ops.pallas.flash_attention import _ref_ext
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        seg = _seg_ids(2, 256, 3)
+        g = jnp.asarray(np.random.default_rng(7).standard_normal(
+            q.shape).astype(np.float32))
+        out, lse = fa_forward(q, k, v, causal=causal, interpret=True,
+                              return_lse=True, q_seg=seg, kv_seg=seg)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=causal,
+                                 interpret=True, q_seg=seg, kv_seg=seg)
+        _, vjp = jax.vjp(lambda a, b_, c: _ref_ext(
+            a, b_, c, None, seg, seg, causal, None), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        for got, ref, name in [(dq, rdq, "dq"), (dk, rdk, "dk"),
+                               (dv, rdv, "dv")]:
+            assert np.allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-3), \
+                (name, np.abs(np.asarray(got) - np.asarray(ref)).max())
+
+
+class TestKernelMask:
+    """Round-3 (VERDICT r2 item 2c): additive masks stream per block."""
+
+    @pytest.mark.parametrize("mshape", [(1, 1, 256, 256), (2, 1, 256, 256),
+                                        (2, 2, 256, 256)])
+    def test_forward_parity(self, mshape):
+        rng = np.random.default_rng(11)
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        # additive mask with some -inf (hard-masked) entries
+        m = rng.standard_normal(mshape).astype(np.float32)
+        m[..., ::7] = -np.inf
+        m = jnp.asarray(m)
+        out = fa_forward(q, k, v, interpret=True, mask=m)
+        ref = _attention_ref(q, k, v, mask=m)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_backward_parity(self):
+        import jax
+        from paddle_tpu.ops.pallas._fa_kernel import fa_backward
+        rng = np.random.default_rng(11)
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        m = jnp.asarray(np.where(
+            rng.random((2, 1, 256, 256)) < 0.2, -np.inf,
+            0.0).astype(np.float32))
+        g = jnp.asarray(rng.standard_normal(q.shape).astype(np.float32))
+        out, lse = fa_forward(q, k, v, interpret=True, return_lse=True,
+                              mask=m)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, interpret=True,
+                                 mask=m)
+        _, vjp = jax.vjp(lambda a, b_, c: _attention_ref(a, b_, c,
+                                                         mask=m), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        for got, ref, name in [(dq, rdq, "dq"), (dk, rdk, "dk"),
+                               (dv, rdv, "dv")]:
+            assert np.allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-3), \
+                (name, np.abs(np.asarray(got) - np.asarray(ref)).max())
+
+    def test_mask_with_gqa_and_causal(self):
+        q, _, _ = qkv(b=1, s=256, h=4, d=64)
+        _, k, v = qkv(b=1, s=256, h=2, d=64, seed=5)
+        m = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (1, 1, 256, 256)).astype(np.float32))
+        out = fa_forward(q, k, v, causal=True, interpret=True, mask=m)
+        ref = _attention_ref(q, k, v, causal=True, mask=m)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+class TestDispatchDiscipline:
+    """Round-3 (VERDICT r2 item 3): fallbacks are counted and loud."""
+
+    def test_counter_and_strict_mode(self, monkeypatch):
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        out = fa._flash_core(q, k, v, False, None)
+        stats = fa.dispatch_stats()
+        assert stats["pallas"] == 1 and stats["fallback"] == 0, stats
+        # unsupported shape (seq not /128) → counted fallback + warning
+        q2, k2, v2 = qkv(b=1, s=100, h=2, d=64)
+        with pytest.warns(UserWarning, match="fell back"):
+            fa._flash_core(q2, k2, v2, False, None)
+        assert fa.dispatch_stats()["fallback"] == 1
+        # strict mode raises instead
+        monkeypatch.setenv("PADDLE_TPU_REQUIRE_PALLAS", "1")
+        with pytest.raises(RuntimeError, match="fell back"):
+            fa._flash_core(q2, k2, v2, False, None)
+        fa.reset_dispatch_stats()
