@@ -109,7 +109,13 @@ def main():
                           intermediate_size=5504, num_hidden_layers=8,
                           num_attention_heads=16,
                           max_position_embeddings=2048, recompute=False,
+                          fuse_linear_cross_entropy=True,
                           dtype="bfloat16")
+        # fused linear+CE: the [B·S, 32000] f32 logits are never
+        # materialized (chunked head matmul + CE under checkpoint). The
+        # plain-CE path measured 50.3% MFU in round 2 but collapsed to
+        # 4% on round 3's runtime (PERF.md round-3 log) — the fused path
+        # is both the robust and the memory-lean config.
         batch, seq, iters = 16, 1024, 20
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128,
@@ -126,6 +132,8 @@ def main():
         if on_tpu:
             model.to(dtype="bfloat16")
         crit = LlamaPretrainingCriterion(cfg)
+        if cfg.fuse_linear_cross_entropy:
+            crit.bind(model)  # chunked head+CE reads the lm_head weight
         opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(),
                                 multi_precision=on_tpu)
         m = P.Model(model)
@@ -168,10 +176,15 @@ def main():
         "unit": "MFU (model FLOPs utilization, fwd+bwd+opt)",
         "vs_baseline": round(mfu / 0.50, 4),
         "tokens_per_sec": round(tok_per_s, 1),
+        "batch": batch,
         "loss": float(loss),
     }
     if not tpu_ok:
+        # a CPU proxy number carries NO evidence against the 50%-on-TPU
+        # baseline — do not imply a ratio (round-2 verdict, weak #3)
         rec["tpu_unavailable"] = True
+        rec["vs_baseline"] = 0.0
+        rec["note"] = "no TPU evidence this run (CPU fallback smoke)"
     print(json.dumps(rec))
 
 

@@ -134,15 +134,23 @@ def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
         # scale-in/out signal (reference: elastic membership watch)
         if elastic_level >= 2:
             target = None
-            try:  # read+consume tolerant of concurrent writers (TOCTOU)
+            content = None
+            try:  # read tolerant of concurrent writers (TOCTOU)
                 with open(scale_file) as f:
-                    target = int(f.read().strip())
-            except (OSError, ValueError):
-                target = None
-            try:
-                os.unlink(scale_file)
+                    content = f.read()
             except OSError:
                 pass
+            if content is not None:
+                # only consume a file we actually read — unlinking after
+                # a failed open could delete a request written in between
+                try:
+                    os.unlink(scale_file)
+                except OSError:
+                    pass
+                try:
+                    target = int(content.strip())
+                except ValueError:
+                    target = None
             if target and min_np <= target <= max_np and \
                     target != cur_n and incarnation < max_reforms:
                 reform(target)

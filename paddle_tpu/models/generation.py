@@ -17,9 +17,11 @@ prefill + decode loop is ONE jitted XLA program —
   the program; early-stopped rows keep emitting eos via a `finished`
   lane mask (static shapes — no dynamic exit).
 
-Weights enter the program as jit-captured constants; the compiled
-program is cached on the model per (batch, prompt_len, max_new_tokens,
-sampling-config) signature.
+Weights and buffers enter the program as ARGUMENTS (round 3 — baked
+constants made the serialized program O(model size) and invalidated the
+cache on every weight update); the compiled program is cached on the
+model per (batch, prompt_len, max_new_tokens, sampling-config)
+signature and survives training steps between generations.
 """
 from __future__ import annotations
 
@@ -93,17 +95,13 @@ class GenerationMixin:
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
-        # weights are jit-captured constants — drop cached programs when
-        # any parameter's array changed. Comparison is by IDENTITY
-        # against PINNED references (the pin keeps the arrays alive, so
-        # CPython id reuse cannot falsely validate a stale program).
-        warrs = [t._data for t in self.parameters()]
-        pinned = getattr(self, "_gen_pinned", None)
-        if pinned is None or len(pinned) != len(warrs) or \
-                any(a is not b for a, b in zip(pinned, warrs)):
-            if getattr(self, "_gen_cache", None):
-                self._gen_cache.clear()
-            self._gen_pinned = warrs
+        # weights/buffers enter the compiled program as ARGUMENTS, not
+        # jit-captured constants (round 3): baked constants made the
+        # serialized program O(model size) — a 0.5B model's decode
+        # program overflowed the remote-compile transport — and forced
+        # cache invalidation on every weight update. As args, the cached
+        # program survives training steps and compiles are O(HLO).
+        warrs = [t._data for t in self._gen_state_tensors()]
         # context-length guard (the wpe/RoPE tables would silently clamp)
         maxpos = self._max_positions()
         if maxpos is not None and s + int(max_new_tokens) > maxpos:
@@ -128,10 +126,16 @@ class GenerationMixin:
         if was_training:
             self.eval()
         try:
-            return Tensor(fn(ids, key))
+            return Tensor(fn(warrs, ids, key))
         finally:
             if was_training:
                 self.train()
+
+    def _gen_state_tensors(self):
+        """Parameters + buffers, in a deterministic order, passed as the
+        compiled generate program's weight arguments."""
+        return list(self.parameters()) + [b for _, b in
+                                          self.named_buffers()]
 
 
 def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
@@ -155,6 +159,20 @@ def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
 
 
 def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
+                   top_k, top_p, eos, warrs, ids, key):
+    tensors = model._gen_state_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, warrs):
+        t._data = arr
+    try:
+        return _generate_body(model, prompt_len, max_new, do_sample,
+                              temperature, top_k, top_p, eos, ids, key)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _generate_body(model, prompt_len, max_new, do_sample, temperature,
                    top_k, top_p, eos, ids, key):
     b = ids.shape[0]
     total = prompt_len + max_new

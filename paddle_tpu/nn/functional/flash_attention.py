@@ -67,8 +67,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                           ).astype(qa.dtype)
 
     out = apply(attn, q, k, v, name="flash_attn_unpadded")
-    if return_softmax:
-        return out, None
+    from ...ops.pallas.flash_attention import _maybe_dropout
+    out = _maybe_dropout(out, dropout)  # same contract as the kernel path
     return out, None
 
 

@@ -146,13 +146,20 @@ def _want_pallas() -> bool:
     return _FORCE_INTERPRET or _on_tpu()
 
 
+# the FORWARD kernel holds the mask as a [block_q, S] f32 slab (the k
+# loop streams inside one grid instance) — cap S so the slab stays ~2 MB
+# of the 16 MB scoped VMEM; the backward streams (block_q, block_k)
+# mask blocks and has no such cap
+_MASK_FWD_MAX_S = 4096
+
+
 def _mask_kernel_ok(mask, b, h, s) -> bool:
     """Kernel takes additive [B|1, H|1, Sq, Sk] f32 with Sq == Sk == s."""
     if mask is None:
         return True
     return (mask.ndim == 4 and mask.shape[0] in (1, b) and
             mask.shape[1] in (1, h) and mask.shape[2] == s and
-            mask.shape[3] == s)
+            mask.shape[3] == s and s <= _MASK_FWD_MAX_S)
 
 
 # ---------------------------------------------------------------------------
@@ -241,8 +248,13 @@ def _flash_core(q, k, v, causal, scale):
 
 
 def _attention_ref_lse(q, k, v, causal=False, scale=None):
-    """XLA reference returning (out, lse[B,H,S] f32)."""
+    """XLA reference returning (out, lse[B,H,S] f32). Accepts the same
+    GQA head layout as the kernel (repeat here, never in-kernel)."""
     d = q.shape[-1]
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * s
@@ -348,18 +360,21 @@ def flash_attention_bshd(q, k, v, mask=None, causal=False, dropout_p=0.0,
             qsa = jnp.zeros((b, sq), jnp.int32)
         else:
             marr = _normalize_mask(raw, b, h, sq, sk)
-        if mask is not None and marr is None and qsa is None:
-            # unsupported rank — XLA reference handles the broadcast
-            marr_raw = mask._data
+            if marr is None:
+                # not kernel-streamable — XLA reference with the RAW
+                # mask (lazy broadcast) COMBINED with any segments (a
+                # seg-only kernel call would silently drop the mask)
+                marr_raw = raw if raw.dtype != jnp.bool_ else \
+                    jnp.where(raw, 0.0, -jnp.inf).astype(jnp.float32)
 
-            def f_raw(qa, ka, va):
-                return _attention_ref(qa, ka, va, mask=marr_raw,
-                                      causal=causal, scale=scale)
-            if _want_pallas():
-                _fallback(f"mask shape {tuple(mask._data.shape)} not "
-                          "kernel-streamable")
-            out = apply(f_raw, q, k, v, name="attention")
-            return _maybe_dropout(out, dropout_p)
+                def f_raw(qa, ka, va):
+                    return _ref_ext(qa, ka, va, marr_raw, qsa, ksa,
+                                    causal, scale)
+                if _want_pallas():
+                    _fallback(f"mask shape {tuple(raw.shape)} not "
+                              "kernel-streamable")
+                out = apply(f_raw, q, k, v, name="attention")
+                return _maybe_dropout(out, dropout_p)
 
     def f(qa, ka, va):
         return _flash_core_ext(qa, ka, va, marr, qsa, ksa, causal, scale)

@@ -160,3 +160,79 @@ class TestCppPjrtLoader:
         # computed in f32 on CPU — 6e-3 observed, 2e-2 bound.
         assert r["err_runtime"] < 2e-2, r
         assert r["err_cli"] < 2e-2, r
+
+
+FA_EXT_PARITY = r"""
+import json
+import numpy as np
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ("tpu", "axon"), jax.devices()
+from paddle_tpu.ops.pallas._fa_kernel import fa_forward, fa_backward
+from paddle_tpu.ops.pallas.flash_attention import _attention_ref, _ref_ext
+
+rng = np.random.default_rng(0)
+b, s, d = 2, 512, 128
+errs = {}
+
+# GQA: 8 query heads on 2 kv heads, fwd + bwd
+q = jnp.asarray(rng.standard_normal((b, s, 8, d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, s, 2, d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, s, 2, d)), jnp.bfloat16)
+g = jnp.asarray(rng.standard_normal((b, s, 8, d)), jnp.bfloat16)
+out, lse = fa_forward(q, k, v, causal=True, return_lse=True)
+ref = _attention_ref(q, k, v, causal=True)
+errs["gqa_fwd"] = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                        ref.astype(jnp.float32))))
+dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=True)
+_, vjp = jax.vjp(lambda a, b_, c: _attention_ref(a, b_, c, causal=True),
+                 q, k, v)
+rdq, rdk, rdv = vjp(g)
+errs["gqa_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
+                                            y.astype(jnp.float32))))
+                      for x, y in ((dq, rdq), (dk, rdk), (dv, rdv)))
+
+# packed segments (varlen): 3 segments, fwd + bwd
+qf = jnp.asarray(rng.standard_normal((b, s, 4, d)), jnp.bfloat16)
+kf = jnp.asarray(rng.standard_normal((b, s, 4, d)), jnp.bfloat16)
+vf = jnp.asarray(rng.standard_normal((b, s, 4, d)), jnp.bfloat16)
+gf = jnp.asarray(rng.standard_normal((b, s, 4, d)), jnp.bfloat16)
+seg = jnp.asarray(np.searchsorted([150, 350], np.arange(s),
+                                  side="right")[None].repeat(b, 0)
+                  .astype(np.int32))
+out2, lse2 = fa_forward(qf, kf, vf, causal=True, return_lse=True,
+                        q_seg=seg, kv_seg=seg)
+ref2 = _ref_ext(qf, kf, vf, None, seg, seg, True, None)
+errs["seg_fwd"] = float(jnp.max(jnp.abs(out2.astype(jnp.float32) -
+                                        ref2.astype(jnp.float32))))
+dq2, dk2, dv2 = fa_backward(qf, kf, vf, out2, lse2, gf, causal=True,
+                            q_seg=seg, kv_seg=seg)
+_, vjp2 = jax.vjp(lambda a, b_, c: _ref_ext(a, b_, c, None, seg, seg,
+                                            True, None), qf, kf, vf)
+r2 = vjp2(gf)
+errs["seg_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
+                                            y.astype(jnp.float32))))
+                      for x, y in zip((dq2, dk2, dv2), r2))
+
+# additive mask, fwd
+m = jnp.asarray(np.where(rng.random((b, 1, s, s)) < 0.15, -np.inf,
+                         0.0).astype(np.float32))
+out3 = fa_forward(qf, kf, vf, mask=m)
+ref3 = _attention_ref(qf, kf, vf, mask=m)
+errs["mask_fwd"] = float(jnp.max(jnp.abs(out3.astype(jnp.float32) -
+                                         ref3.astype(jnp.float32))))
+print(json.dumps(errs))
+"""
+
+
+class TestOnChipKernelExtensions:
+    """Round-3 on-chip smoke: GQA / varlen segments / additive masks run
+    COMPILED on the chip (interpret-mode parity is in
+    test_pallas_kernels.py; this is the hardware evidence)."""
+
+    def test_gqa_segments_masks_on_tpu(self):
+        r = _run_on_chip(FA_EXT_PARITY, timeout=600)
+        assert r["gqa_fwd"] < 5e-2, r
+        assert r["gqa_bwd"] < 1e-1, r
+        assert r["seg_fwd"] < 5e-2, r
+        assert r["seg_bwd"] < 1e-1, r
+        assert r["mask_fwd"] < 5e-2, r
