@@ -55,11 +55,14 @@ def bert_amp_o2(trace: bool = False):
                 m.train_batch([ids], [labels])
             jax.effects_barrier()
 
+    # timed region ends fetching the last step's loss: on axon only a
+    # dependent fetch proves execution (PERF.md round-3 hygiene notes);
+    # steps differ via the updated params so no request is cache-served
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = m.train_batch([ids], [labels])
-    import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    loss = float(np.asarray(loss._data if hasattr(loss, "_data")
+                            else loss))
     dt = time.perf_counter() - t0
 
     tok_s = batch * seq * iters / dt
@@ -73,8 +76,7 @@ def bert_amp_o2(trace: bool = False):
         "unit": "tokens/sec (fwd+bwd+opt, AMP-O2)",
         "mfu_6N_proxy": round(mfu, 4),
         "batch": batch, "seq": seq,
-        "loss": float(np.asarray(loss)) if not isinstance(loss, float)
-        else loss,
+        "loss": loss,
     }
 
 

@@ -50,16 +50,25 @@ def main():
     if on_tpu:
         model.to(dtype="bfloat16")
     model.eval()
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     x = P.to_tensor(ids)
 
     out = model.generate(x, max_new_tokens=new)   # compile + run
     out._data.block_until_ready()
+    # Axon measurement hygiene (PERF.md round 3): the remote service
+    # CACHES identical execution requests, so re-running the warmed-up
+    # call with the same inputs "measures" nothing. Time a call with
+    # DIFFERENT inputs and make the timed region end in a host fetch of
+    # a value derived from the output — only a dependent fetch proves
+    # the execution actually ran.
+    ids2 = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    x2 = P.to_tensor(ids2)
     t0 = time.perf_counter()
-    out = model.generate(x, max_new_tokens=new)
-    out._data.block_until_ready()
+    out = model.generate(x2, max_new_tokens=new)
+    checksum = int(np.asarray(out._data).sum())
     dt = time.perf_counter() - t0
+    del checksum
 
     tok_s = batch * new / dt
     print(json.dumps({

@@ -32,14 +32,18 @@ ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(
 x = P.to_tensor(ids)
 m.train_batch([x], [x]); m.train_batch([x], [x]); jax.effects_barrier()
 iters = 8
+# timed region ends in a dependent fetch of the LAST step's loss: on
+# axon, block_until_ready on an unrelated value does not prove the
+# queued steps executed (the service caches identical requests — see
+# PERF.md round-3 hygiene notes). Steps differ via the updated params,
+# and the loss float depends on the whole chain.
 t0 = time.perf_counter()
 for _ in range(iters):
     loss = m.train_batch([x], [x])
-import jax.numpy as jnp
-jnp.zeros(()).block_until_ready()
+loss_val = float(np.asarray(loss._data if hasattr(loss, "_data") else loss))
 dt = time.perf_counter() - t0
 tok_s = batch * seq * iters / dt
 mfu = tok_s * flops_per_token(cfg, seq) / 197e12
 print(json.dumps({"batch": batch, "seq": seq, "recompute": recompute,
                   "fuse_ce": fuse, "tok_s": round(tok_s, 1),
-                  "mfu": round(mfu, 4), "loss": float(loss)}))
+                  "mfu": round(mfu, 4), "loss": loss_val}))

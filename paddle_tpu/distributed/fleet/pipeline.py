@@ -15,9 +15,13 @@ TPU-native design: the schedule is a DIFFERENTIABLE COLLECTIVE SCAN inside
   reference, but compiled into the program so XLA overlaps transfer with
   compute);
 - `jax.grad` through the scan replays the schedule in reverse — the
-  backward pipeline — with `jax.checkpoint` on the stage body bounding
-  activation memory (the reason the reference needs 1F1B rather than
-  GPipe); compute-bubble fraction matches 1F1B at (S-1)/(M+S-1);
+  backward pipeline. The `schedule` pipeline config picks the memory
+  regime: "1F1B" (default) puts `jax.checkpoint` on the stage body so
+  the stash is capped at the carry chain (the reason the reference needs
+  1F1B rather than GPipe), "FThenB" saves residuals instead (GPipe);
+  zero-bubble collapses into 1F1B+VPP under lockstep SPMD — see
+  `PipelineParallel.SCHEDULES`. Compute-bubble fraction matches 1F1B at
+  (S-1)/(M+S-1);
 - stage bodies must be structurally identical blocks (the transformer
   case); embedding and head+loss run BATCHED and replicated outside the
   tick scan with the loss masked to the last stage and psum'd — in
@@ -215,6 +219,25 @@ class PipelineLayer(Layer):
 class PipelineParallel(Layer):
     """The compiled pipeline runtime (reference: PipelineParallel)."""
 
+    #: Schedule space (reference: dist passes FThenB / 1F1B / VPP /
+    #: zero-bubble — SURVEY.md §2.3). In this lockstep-SPMD runtime the
+    #: tick loop is ONE compiled scan executed by every pp rank with
+    #: in-window masks, so a rank outside its window still spends the
+    #: tick — there is no per-device idle for a zero-bubble pass to
+    #: reclaim by reordering B/W work. The schedules therefore select the
+    #: MEMORY regime (their other defining axis), while bubble TIME is
+    #: reduced by interleaving (num_virtual_pipeline_stages > 1 — the VPP
+    #: schedule), and XLA already orders dX before dW inside the backward
+    #: scan wherever that shortens the critical path (it schedules the
+    #: whole DAG). zero-bubble is thus collapsed into 1F1B+VPP here by
+    #: design, not omitted:
+    #:   - "FThenB"  (GPipe): scan residuals saved — no recompute,
+    #:     activation stash grows with accumulate_steps;
+    #:   - "1F1B" (default): jax.checkpoint on the chunk body — backward
+    #:     recomputes block internals from the per-tick carry, capping
+    #:     the stash at the carry chain (the reference 1F1B memory cap).
+    SCHEDULES = ("1F1B", "FThenB")
+
     def __init__(self, layers: PipelineLayer, hcg, strategy):
         super().__init__()
         self._layers = layers
@@ -223,6 +246,14 @@ class PipelineParallel(Layer):
         pc = strategy.pipeline_configs if strategy is not None else {}
         self.accumulate_steps = int(pc.get("accumulate_steps", 1))
         self.micro_batch_size = int(pc.get("micro_batch_size", 1))
+        self.schedule = str(pc.get("schedule", "1F1B"))
+        if self.schedule not in self.SCHEDULES:
+            raise ValueError(
+                f"pipeline schedule {self.schedule!r} not supported; "
+                f"choose from {self.SCHEDULES} (VPP via "
+                "num_virtual_pipeline_stages; zero-bubble collapses into "
+                "1F1B+VPP under lockstep SPMD — see PipelineParallel."
+                "SCHEDULES)")
         self._jit = None
         self._sig = None
 
@@ -469,7 +500,10 @@ def _build_pipeline_jit(pp, opt, mesh, S, M, V, pc, pre_named,
             return out._data, None
 
         body = one_block
-        if layers.recompute_interval:
+        if layers.recompute_interval or pp.schedule == "1F1B":
+            # 1F1B memory regime: recompute block internals from the
+            # per-tick carry instead of stashing scan residuals (see
+            # PipelineParallel.SCHEDULES); FThenB saves residuals.
             body = jax.checkpoint(one_block)
         h, _ = jax.lax.scan(body, x, tuple(chunk))
         return h

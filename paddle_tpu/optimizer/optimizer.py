@@ -184,9 +184,96 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core import autograd as _ag
+        if _ag._STATIC_RECORDER is not None:
+            return self._minimize_static(_ag._STATIC_RECORDER, loss,
+                                         parameters, no_grad_set)
         loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, prog, loss, parameters=None,
+                         no_grad_set=None):
+        """Static-graph minimize (reference: Optimizer.minimize appending
+        backward + optimizer ops to the Program; SURVEY.md §2.2 "Static
+        API"). Appends `append_backward`'s gradient record plus ONE
+        update record running this optimizer's fused XLA rule
+        (step-count increment, grad clip, master weights and all);
+        parameter / optimizer-state leaves are written back after every
+        Executor.run, and a pre-run hook re-reads `get_lr()` so LR
+        schedulers tick exactly as in eager mode.
+        """
+        from ..static.program import append_backward
+        params = (list(parameters) if parameters is not None
+                  else list(self._all_params()))
+        pairs = append_backward(loss, params, no_grad_set, program=prog)
+        params = [p for p, _ in pairs]
+        grads = [g for _, g in pairs]
+        n = len(params)
+        states = [self._get_state(p) for p in params]
+        state_keys = [tuple(st.keys()) for st in states]
+        flat_state_t = [Tensor(st[k]) for st, ks in zip(states, state_keys)
+                        for k in ks]
+        total = len(flat_state_t)
+        lr_t = Tensor(jnp.asarray(self.get_lr(), jnp.float32))
+        step_t = Tensor(jnp.asarray(self._step_count, jnp.int32))
+
+        def _update_fn(*args):
+            ps = list(args[:n])
+            gs = list(args[n:2 * n])
+            flat = list(args[2 * n:2 * n + total])
+            lr, step = args[-2], args[-1]
+            sdicts, i = [], 0
+            for ks in state_keys:
+                sdicts.append({k: flat[i + j] for j, k in enumerate(ks)})
+                i += len(ks)
+            step2 = step + 1
+            if self._grad_clip is not None:
+                clipped = self._grad_clip(
+                    [(Tensor(p), Tensor(g)) for p, g in zip(ps, gs)])
+                gs = [g._data for _, g in clipped]
+            new_ps, new_sts = self._fused_apply(ps, gs, sdicts, lr, step2,
+                                                use_pallas=False)
+            out = list(new_ps)
+            for ns, ks in zip(new_sts, state_keys):
+                out.extend(ns[k] for k in ks)
+            out.append(step2)
+            return tuple(out)
+
+        in_tensors = (params + grads + flat_state_t + [lr_t, step_t])
+        new_param_t = [Tensor(jnp.zeros_like(p._data)) for p in params]
+        new_state_t = [Tensor(jnp.zeros_like(t._data))
+                       for t in flat_state_t]
+        new_step_t = Tensor(jnp.zeros((), jnp.int32))
+        out_tensors = new_param_t + new_state_t + [new_step_t]
+        prog.record(_update_fn, in_tensors, out_tensors,
+                    name=f"{type(self).__name__}.minimize")
+
+        for p, np_t in zip(params, new_param_t):
+            prog._assigns.append((id(np_t), p))
+        it = iter(zip(flat_state_t, new_state_t))
+        for st, ks in zip(states, state_keys):
+            for k in ks:
+                leaf_t, out_t = next(it)
+                prog._assigns.append(
+                    (id(out_t), self._mk_state_setter(leaf_t, st, k)))
+        prog._assigns.append((id(new_step_t), self._mk_step_setter(step_t)))
+        prog._prerun_hooks.append(
+            lambda: lr_t._inplace_update(
+                jnp.asarray(self.get_lr(), jnp.float32)))
+        return None, pairs
+
+    def _mk_state_setter(self, leaf_t, state_dict, key):
+        def set_(v):
+            leaf_t._inplace_update(v)
+            state_dict[key] = v
+        return set_
+
+    def _mk_step_setter(self, step_t):
+        def set_(v):
+            step_t._inplace_update(v)
+            self._step_count = int(v)
+        return set_
 
     @no_grad()
     def clear_grad(self, set_to_zero=False):

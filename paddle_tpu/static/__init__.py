@@ -11,10 +11,11 @@ unverified, see SURVEY.md §2.2). Two tiers:
   the guard stay live Tensors, so their trained values flow into later
   runs.
 - Deployment save/load maps onto jit.save/load (StableHLO artifacts).
-
-Static TRAINING (append_backward, static optimizer rewriting) is
-intentionally not re-built: the dynamic path (`to_static`, fleet Engine)
-is this framework's compiled-training story (PARITY.md "Static API").
+- **Static TRAINING**: `append_backward(loss)` + `optimizer.minimize`
+  inside `program_guard` append gradient/update records whose outputs
+  are written back to parameter and optimizer-state leaves after every
+  `Executor.run` (see static/program.py). The dynamic path (`to_static`,
+  fleet Engine) remains the recommended compiled-training story.
 """
 from __future__ import annotations
 
@@ -24,14 +25,15 @@ from ..jit.save_load import InputSpec, TranslatedLayer  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
 from . import nn  # noqa: F401
-from .program import (Executor, Program, data, default_main_program,  # noqa: F401
-                      default_startup_program, global_scope,
-                      program_guard, scope_guard)
+from .program import (Executor, Program, append_backward, data,  # noqa: F401
+                      default_main_program, default_startup_program,
+                      global_scope, program_guard, scope_guard)
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "Program", "program_guard", "data", "Executor",
-           "default_main_program", "default_startup_program",
-           "global_scope", "scope_guard", "name_scope", "device_guard"]
+           "append_backward", "default_main_program",
+           "default_startup_program", "global_scope", "scope_guard",
+           "name_scope", "device_guard"]
 
 
 @contextlib.contextmanager

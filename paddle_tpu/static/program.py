@@ -19,9 +19,16 @@ with `jax.jit` (cached per feed signature). That makes Executor.run one
 XLA computation per signature: the reference's
 ProgramDesc→executor→kernel-loop pipeline collapsed into trace + XLA.
 
-Training via Program (append_backward / static optimizers) stays out of
-scope — the dynamic path with `to_static` / fleet Engine covers it
-(PARITY.md "Static API").
+Static TRAINING (reference: paddle.static append_backward + optimizer
+op rewriting, upstream python/paddle/base/backward.py — unverified):
+`append_backward(loss)` appends ONE gradient record that replays the
+forward sub-program under `jax.grad` w.r.t. the parameter leaves (XLA
+CSEs the recomputed forward against the fetched one inside the same
+jitted replay), and `optimizer.minimize(loss)` inside `program_guard`
+appends the optimizer's own fused update rule as a record whose outputs
+are WRITTEN BACK to the parameter / optimizer-state leaves after every
+`Executor.run` — the reference's in-scope variable mutation, expressed
+as a pure program + host-side assign list.
 """
 from __future__ import annotations
 
@@ -36,7 +43,7 @@ from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 
 __all__ = ["Program", "program_guard", "data", "Executor", "global_scope",
-           "scope_guard"]
+           "scope_guard", "append_backward"]
 
 
 class _Record:
@@ -58,6 +65,15 @@ class Program:
         self._leaves: dict[int, object] = {}   # key -> Tensor
         self._produced: set[int] = set()
         self._jit_cache: dict = {}
+        # static-training writebacks: (src value key, setter). After every
+        # run the fetched src value is handed to the setter — a Tensor
+        # (in-place update) or a callable — mutating the parameter /
+        # optimizer-state leaves exactly like the reference executor
+        # mutates scope variables.
+        self._assigns: list[tuple[int, object]] = []
+        # callables invoked before each run (e.g. refresh the lr leaf
+        # from an LRScheduler)
+        self._prerun_hooks: list = []
         # Strong refs to EVERY tensor whose id() appears in the record —
         # id() keys are only unique while the object lives; without the
         # pin, a freed intermediate's id could be reused by a later
@@ -104,6 +120,8 @@ class Program:
 
     # -- replay --------------------------------------------------------------
     def run(self, feed, fetch_list):
+        for hook in self._prerun_hooks:
+            hook()
         feed = feed or {}
         fetch_keys = []
         for f in fetch_list:
@@ -125,13 +143,16 @@ class Program:
         ordered_keys = [self._feeds[n] for n in names]
         leaf_arrays = [t._data for t in self._leaves.values()]
 
-        # num_ops is in the key: the jitted replay closes over the record
-        # list at trace time, so a Program extended after compilation must
-        # not replay the stale op list for already-seen feed signatures.
+        # num_ops/num_assigns are in the key: the jitted replay closes
+        # over the record list at trace time, so a Program extended after
+        # compilation must not replay the stale op list for already-seen
+        # feed signatures.
         sig = (tuple((a.shape, str(a.dtype)) for a in feed_arrays),
-               tuple(fetch_keys), len(self._records))
+               tuple(fetch_keys), len(self._records), len(self._assigns))
         fn = self._jit_cache.get(sig)
         if fn is None:
+            assign_keys = [k for k, _ in self._assigns]
+
             def pure(feed_arrays, leaf_arrays):
                 env = dict(zip(ordered_keys, feed_arrays))
                 env.update(zip(self._leaves.keys(), leaf_arrays))
@@ -147,12 +168,92 @@ class Program:
                     out = rec.fn(*args)
                     outs = out if isinstance(out, (tuple, list)) else (out,)
                     env.update(zip(rec.out_keys, outs))
-                return [env[k] for k in fetch_keys]
+                return ([env[k] for k in fetch_keys],
+                        [env[k] for k in assign_keys])
 
             fn = jax.jit(pure)
             self._jit_cache[sig] = fn
-        outs = fn(feed_arrays, leaf_arrays)
+        # replaying a record must never re-record (an op replayed while a
+        # guard is active would append itself to the active Program)
+        prev = _ag._set_static_recorder(None)
+        try:
+            outs, assign_vals = fn(feed_arrays, leaf_arrays)
+        finally:
+            _ag._set_static_recorder(prev)
+        for (_, target), val in zip(self._assigns, assign_vals):
+            if callable(target):
+                target(val)
+            else:
+                target._inplace_update(val)
         return [np.asarray(o) for o in outs]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    program=None):
+    """Append the gradient computation for `loss` to the Program
+    (reference: paddle.static.append_backward, upstream
+    python/paddle/base/backward.py — unverified; SURVEY.md §2.2).
+
+    TPU-native design: instead of emitting one grad op per forward op,
+    ONE record is appended whose fn replays the forward sub-program (the
+    records present when append_backward was called) under `jax.grad`
+    w.r.t. the parameter leaves. Inside the jitted replay XLA CSEs this
+    recomputed forward against the fetched one, so the cost matches an
+    op-by-op backward. Returns [(param, grad_tensor)] — grad tensors are
+    ordinary program values (fetchable, consumable by later records).
+    """
+    prog = program if program is not None else default_main_program()
+    if parameter_list is None:
+        params = prog.all_parameters()
+    else:
+        params = [p for p in parameter_list]
+    skip_ids = {id(s) for s in (no_grad_set or ())}
+    params = [p for p in params
+              if not p.stop_gradient and id(p) not in skip_ids]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters reach "
+                         "the loss (all stop_gradient or filtered)")
+    loss_key = id(loss)
+    if loss_key not in prog._produced:
+        raise ValueError(
+            "append_backward: loss was not produced by this Program "
+            "(build it under program_guard on the same Program)")
+    fwd_records = list(prog._records)
+    param_keys = [id(p) for p in params]
+    param_dtypes = [p._data.dtype for p in params]
+    for p in params:
+        if id(p) not in prog._leaves and id(p) not in prog._produced:
+            prog._leaves[id(p)] = p
+            prog._pins.append(p)
+    feed_keys = tuple(prog._feeds[n] for n in sorted(prog._feeds))
+    leaf_keys = tuple(prog._leaves.keys())
+    in_keys = feed_keys + leaf_keys
+
+    def _grads_fn(*args):
+        env = dict(zip(in_keys, args))
+
+        def loss_of(pvals):
+            e = dict(env)
+            e.update(zip(param_keys, pvals))
+            for rec in fwd_records:
+                a = [e[k] for k in rec.in_keys]
+                out = rec.fn(*a)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                e.update(zip(rec.out_keys, outs))
+            return jnp.sum(e[loss_key].astype(jnp.float32))
+
+        g = jax.grad(loss_of)([env[k] for k in param_keys])
+        return tuple(gi.astype(dt) for gi, dt in zip(g, param_dtypes))
+
+    grad_tensors = [Tensor(jnp.zeros_like(p._data)) for p in params]
+    for p, g in zip(params, grad_tensors):
+        g.name = (getattr(p, "name", None) or "param") + "@GRAD"
+    prog._produced.update(id(g) for g in grad_tensors)
+    prog._pins.extend(grad_tensors)
+    prog._records.append(_Record(
+        _grads_fn, in_keys, tuple(id(g) for g in grad_tensors),
+        "append_backward"))
+    return list(zip(params, grad_tensors))
 
 
 _default_main = Program()
@@ -250,7 +351,9 @@ class Executor:
         if not program._records and not fetch_list:
             return []  # startup program: parameters are already live
         if fetch_list is None:
-            return []
+            if not program._assigns:
+                return []
+            fetch_list = []  # training program: run for the writebacks
         return program.run(feed, fetch_list)
 
     def close(self):

@@ -434,3 +434,50 @@ class TestSegMethodLayer:
         with pytest.raises(ValueError, match="no layer of class"):
             PipelineLayer(layers=[Stem(6, 12), LayerDesc(Block, 12)],
                           num_stages=1, seg_method="layer:Bogus")
+
+
+class TestScheduleVariants:
+    """schedule config: FThenB (residual-saving GPipe) vs 1F1B (remat)
+    must produce identical losses — they differ only in the memory
+    regime (PipelineParallel.SCHEDULES; SURVEY.md §2.3 dist passes)."""
+
+    def _run(self, schedule):
+        _reset_fleet()
+        P.seed(23)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "micro_batch_size": 2,
+                                     "schedule": schedule}
+        fleet.init(is_collective=True, strategy=strategy)
+        pipe = build_pipe(loss_fn=mse_loss)
+        opt = P.optimizer.SGD(0.1, parameters=pipe.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        model = fleet.distributed_model(pipe)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((8, 6)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        losses = []
+        for _ in range(3):
+            loss = model.train_batch((P.to_tensor(x), P.to_tensor(y)), opt)
+            losses.append(float(loss.numpy()))
+        return losses
+
+    def test_fthenb_matches_1f1b(self):
+        l_remat = self._run("1F1B")
+        l_gpipe = self._run("FThenB")
+        np.testing.assert_allclose(l_remat, l_gpipe, rtol=1e-5, atol=1e-6)
+
+    def test_unknown_schedule_raises(self):
+        _reset_fleet()
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "schedule": "zero-bubble"}
+        fleet.init(is_collective=True, strategy=strategy)
+        pipe = build_pipe(loss_fn=mse_loss)
+        with pytest.raises(ValueError, match="1F1B"):
+            from paddle_tpu.distributed.fleet.pipeline import \
+                PipelineParallel
+            PipelineParallel(pipe, fleet.get_hybrid_communicate_group(),
+                             strategy)

@@ -1,0 +1,153 @@
+"""Eager-dispatch micro-benchmark on the live device (SURVEY.md §7
+hard-part 1: per-op dispatch overhead; VERDICT r2 item 10 asked for the
+TPU number — round 2 only measured CPU).
+
+Measures ms/step of an eager MLP fwd+bwd+SGD step (~20 op dispatches)
+with the micro-jit dispatch cache ON vs OFF, plus the fully-jitted step
+as the floor. Each iteration's ops see UPDATED weights (requests differ
+— the axon service caches identical execution requests) and the timed
+region ends fetching the final loss float (dependent-fetch proof of
+execution; PERF.md round-3 hygiene notes).
+
+Usage: python tools/bench_dispatch.py [iters]   # prints one JSON line
+The script re-execs itself in subprocesses (the flag is read at import).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+if len(sys.argv) > 1 and sys.argv[1] == "--child":
+    ITERS = int(sys.argv[2])
+else:
+    ITERS = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+
+
+def child(mode: str):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        # the axon sitecustomize bakes JAX_PLATFORMS at interpreter
+        # start; forcing CPU requires the post-import backend reset
+        import jax
+        import jax._src.xla_bridge as xb
+        try:
+            xb._clear_backends()
+            xb.get_backend.cache_clear()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as P
+
+    P.seed(0)
+    lin1 = P.nn.Linear(256, 256)
+    lin2 = P.nn.Linear(256, 256)
+    opt = P.optimizer.SGD(0.01, parameters=[*lin1.parameters(),
+                                            *lin2.parameters()])
+    x = P.to_tensor(np.random.default_rng(0).standard_normal(
+        (32, 256)).astype(np.float32))
+
+    def step():
+        h = P.nn.functional.relu(lin1(x))
+        loss = (lin2(h) * lin2(h)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    if mode == "jit":
+        import jax
+
+        params = [p for p in lin1.parameters()] + \
+            [p for p in lin2.parameters()]
+
+        @jax.jit
+        def jstep(arrs, xv):
+            saved = [(p, p._data) for p in params]
+            for p, a in zip(params, arrs):
+                p._data = a
+            try:
+                h = P.nn.functional.relu(lin1(P.Tensor(xv)))
+                loss = (lin2(h) * lin2(h)).mean()
+                import jax.numpy as jnp
+                return loss._data.astype(jnp.float32)
+            finally:
+                for p, a in saved:
+                    p._data = a
+
+        arrs = [p._data for p in params]
+        float(np.asarray(jstep(arrs, x._data)))  # compile
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            # vary the input so requests differ (no param update here);
+            # i+1 so the first timed call also differs from the warmup
+            v = jstep(arrs, x._data * (1.0 + 1e-6 * (i + 1)))
+        out = float(np.asarray(v))
+        dt = time.perf_counter() - t0
+    else:
+        for _ in range(3):
+            loss = step()  # warmup: compile micro-jits / build caches
+        float(loss.numpy())
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step()
+        out = float(loss.numpy())
+        dt = time.perf_counter() - t0
+    print(json.dumps({"mode": mode, "ms_per_step": dt / ITERS * 1e3,
+                      "loss": out}))
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _tpu_usable
+    tpu_ok = _tpu_usable(attempts=2, probe_timeout=90, backoff=20)
+    here = os.path.abspath(__file__)
+    results = {}
+    for mode, env in (("microjit", {"PADDLE_TPU_EAGER_MICROJIT": "1"}),
+                      ("plain", {"PADDLE_TPU_EAGER_MICROJIT": "0"}),
+                      ("jit", {})):
+        e = dict(os.environ, **env)
+        if not tpu_ok:
+            e["PADDLE_TPU_BENCH_CPU"] = "1"
+        # SIGTERM + grace on timeout, never SIGKILL: kill -9 of a
+        # process mid-compile on the chip wedges the grant (CLAUDE.md
+        # chip hygiene; same pattern as bench.py's probe)
+        import signal
+        p = subprocess.Popen([sys.executable, here, "--child",
+                              str(ITERS), mode], env=e,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+        try:
+            out, err = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            p.send_signal(signal.SIGTERM)
+            try:
+                out, err = p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"{mode}: child ignored SIGTERM; "
+                                 "leaving it to exit on its own\n")
+                continue
+        line = [l for l in out.splitlines() if l.startswith("{")]
+        if p.returncode != 0 or not line:
+            sys.stderr.write(f"{mode} failed: {err[-500:]}\n")
+            continue
+        results[mode] = json.loads(line[-1])
+    rec = {
+        "metric": "eager_dispatch_ms_per_step" + ("" if tpu_ok else "_cpu"),
+        "iters": ITERS,
+        **{f"{k}_ms": round(v["ms_per_step"], 2)
+           for k, v in results.items()},
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[3])
+    else:
+        main()
