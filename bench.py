@@ -117,6 +117,12 @@ def main():
         # 4% on round 3's runtime (PERF.md round-3 log) — the fused path
         # is both the robust and the memory-lean config.
         batch, seq, iters = 16, 1024, 20
+        # sweep overrides (tools/perf_sweep.py)
+        import os
+        batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
+        seq = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", seq))
+        if seq != 1024:
+            cfg.max_position_embeddings = max(seq, 2048)
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128,
                           intermediate_size=256, num_hidden_layers=2,

@@ -1,5 +1,6 @@
 """paddle_tpu.incubate (reference: paddle.incubate)."""
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
 

@@ -1,0 +1,84 @@
+"""Functional autodiff transforms (reference: paddle.incubate.autograd
+jvp/vjp/Jacobian/Hessian/forward_grad, upstream
+python/paddle/incubate/autograd/ — unverified; SURVEY.md §2.2 Autograd
+API / Incubate rows).
+
+TPU-native design: these are thin Tensor-boundary adapters over jax's
+own transforms — `jax.jvp` (forward mode) and `jax.vjp` (reverse mode)
+ARE the reference's primitive-based transform engine here, with every
+`custom_vjp` rule (Pallas flash attention etc.) intact because the
+wrapped function re-enters the framework's ops under tracing (the
+`core.autograd.apply` tracer contract).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "forward_grad"]
+
+
+def _as_tuple(xs):
+    return tuple(xs) if isinstance(xs, (tuple, list)) else (xs,)
+
+
+def _arrays(ts):
+    return tuple(t._data if isinstance(t, Tensor) else t for t in ts)
+
+
+def _wrap(arrs):
+    if isinstance(arrs, (tuple, list)):
+        out = tuple(Tensor(a) for a in arrs)
+        return out if len(out) != 1 else out[0]
+    return Tensor(arrs)
+
+
+def _pure(func, n_in):
+    """Lift a Tensor->Tensor(s) function to arrays->arrays."""
+    def f(*arrs):
+        outs = func(*[Tensor(a) for a in arrs[:n_in]])
+        outs_t = _as_tuple(outs)
+        res = tuple(o._data if isinstance(o, Tensor) else o
+                    for o in outs_t)
+        return res if len(res) != 1 else res[0]
+    return f
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode Jacobian-vector product (reference:
+    paddle.incubate.autograd.jvp). Returns (func_out, jvp_out); `v`
+    defaults to ones like `xs`."""
+    xs_t = _as_tuple(xs)
+    arrs = _arrays(xs_t)
+    if v is None:
+        import jax.numpy as jnp
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents = _arrays(_as_tuple(v))
+    primal_out, tangent_out = jax.jvp(_pure(func, len(arrs)), arrs,
+                                      tangents)
+    return _wrap(primal_out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode vector-Jacobian product (reference:
+    paddle.incubate.autograd.vjp). Returns (func_out, vjp_out); `v`
+    defaults to ones like the output."""
+    xs_t = _as_tuple(xs)
+    arrs = _arrays(xs_t)
+    primal_out, vjp_fn = jax.vjp(_pure(func, len(arrs)), *arrs)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jax.tree.map(jnp.ones_like, primal_out)
+    else:
+        v_t = _arrays(_as_tuple(v))
+        cot = v_t if isinstance(primal_out, tuple) else v_t[0]
+    grads = vjp_fn(cot)
+    out = tuple(Tensor(g) for g in grads)
+    return _wrap(primal_out), (out if len(out) != 1 else out[0])
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (the jvp tangent output alone)."""
+    return jvp(func, xs, v)[1]

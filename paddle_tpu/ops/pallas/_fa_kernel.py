@@ -46,11 +46,18 @@ interpret=True path runs these exact kernels on CPU for tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+def _env_block(name, default):
+    """Block-size override for perf sweeps (tools/perf_sweep.py). Values
+    must stay multiples of 128 (MXU lane dim) — asserted at call sites."""
+    return int(os.environ.get(name, default))
 
 
 LANES = 128
@@ -179,9 +186,9 @@ def _seg_layouts(q_seg, kv_seg):
     return qs, ks
 
 
-def fa_forward(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
-               interpret=False, return_lse=False, mask=None, q_seg=None,
-               kv_seg=None):
+def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
+               block_k=None, interpret=False, return_lse=False, mask=None,
+               q_seg=None, kv_seg=None):
     """q: [B, S, H, D]; k/v: [B, S, Hkv, D] (Hkv | H → GQA in-kernel)
     → out [B, S, H, D] (+ lse [B*H, S, LANES]).
 
@@ -192,6 +199,10 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
     assert h % hkv == 0, (h, hkv)
     g = h // hkv
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    if block_q is None:
+        block_q = _env_block("PADDLE_TPU_FA_BLOCK_Q", 128)
+    if block_k is None:
+        block_k = _env_block("PADDLE_TPU_FA_BLOCK_K", 128)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0
@@ -381,9 +392,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         compute()
 
 
-def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
-                block_k=128, interpret=False, dlse=None, mask=None,
-                q_seg=None, kv_seg=None):
+def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
+                block_q=None, block_k=None, interpret=False, dlse=None,
+                mask=None, q_seg=None, kv_seg=None):
     """FlashAttention-2 backward. q,o,do: [B,S,H,D]; k,v: [B,S,Hkv,D];
     lse: [B*H,S,LANES].
 
@@ -399,6 +410,10 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None, block_q=128,
     hkv = k.shape[2]
     g = h // hkv
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    if block_q is None:
+        block_q = _env_block("PADDLE_TPU_FA_BWD_BLOCK_Q", 128)
+    if block_k is None:
+        block_k = _env_block("PADDLE_TPU_FA_BWD_BLOCK_K", 128)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0

@@ -280,3 +280,70 @@ class TestHigherOrder:
         (g,) = P.grad([y], [x], create_graph=True)   # 2x = 6
         g2 = P.grad([g.sum()], [x])[0]               # 2
         assert np.allclose(g2.numpy(), [2.0])
+
+
+class TestIncubateFunctionalAutograd:
+    """paddle.incubate.autograd jvp/vjp/forward_grad parity vs jax
+    oracles (SURVEY.md §2.2 Autograd API / Incubate)."""
+
+    def test_jvp_matches_jax(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.incubate import autograd as iag
+        x = P.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        v = P.to_tensor(np.full((2, 2), 0.5, np.float32))
+
+        def f(t):
+            return (t * t).sum(axis=1)
+
+        out, tangent = iag.jvp(f, x, v)
+        ref_out, ref_tan = jax.jvp(lambda a: jnp.sum(a * a, axis=1),
+                                   (x._data,), (v._data,))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref_out),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(tangent.numpy(), np.asarray(ref_tan),
+                                   rtol=1e-6)
+
+    def test_vjp_matches_backward(self):
+        from paddle_tpu.incubate import autograd as iag
+        x = P.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+
+        def f(t):
+            return (t * t * t).sum()
+
+        out, grad = iag.vjp(f, x)
+        np.testing.assert_allclose(out.numpy(), 36.0, rtol=1e-6)
+        np.testing.assert_allclose(grad.numpy(), 3 * np.asarray(
+            [1.0, 4.0, 9.0]), rtol=1e-6)
+
+    def test_vjp_multi_input_with_cotangent(self):
+        from paddle_tpu.incubate import autograd as iag
+        a = P.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        b = P.to_tensor(np.asarray([3.0, 4.0], np.float32))
+        v = P.to_tensor(np.asarray([1.0, -1.0], np.float32))
+
+        def f(x, y):
+            return x * y
+
+        out, grads = iag.vjp(f, [a, b], v)
+        ga, gb = grads
+        np.testing.assert_allclose(ga.numpy(), [3.0, -4.0], rtol=1e-6)
+        np.testing.assert_allclose(gb.numpy(), [1.0, -2.0], rtol=1e-6)
+
+    def test_forward_grad_through_framework_ops(self):
+        from paddle_tpu.incubate import autograd as iag
+        x = P.to_tensor(np.asarray([[0.5, -0.5]], np.float32))
+        lin = P.nn.Linear(2, 3)
+
+        def f(t):
+            return P.nn.functional.relu(lin(t)).sum()
+
+        tangent = iag.forward_grad(f, x)
+        # oracle: reverse-mode grad dotted with ones tangent
+        xe = P.to_tensor(np.asarray([[0.5, -0.5]], np.float32),
+                         stop_gradient=False)
+        loss = P.nn.functional.relu(lin(xe)).sum()
+        loss.backward()
+        np.testing.assert_allclose(float(tangent.numpy()),
+                                   float(xe.grad.numpy().sum()),
+                                   rtol=1e-5)
