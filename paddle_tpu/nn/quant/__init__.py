@@ -1,0 +1,154 @@
+"""Weight-only quantization (reference: paddle.nn.quant
+weight_quantize / weight_dequantize / weight_only_linear, upstream
+python/paddle/nn/quant/quantized_linear.py — unverified; SURVEY.md §2.2
+quantization row).
+
+TPU-native design: decode-time linear layers are HBM-bandwidth-bound, so
+storing weights int8 (or int4, two nibbles packed per int8 byte) halves
+(quarters) the bytes streamed per step. The dequant (int → compute dtype
+× per-channel/group scale) happens INSIDE the compiled matmul program —
+XLA fuses the convert+scale into the dot-general's operand read, so
+there is no dequantized weight copy in HBM. Scales are per-output-
+channel (absmax / 127 or 7) or per-`group_size` rows of the reduction
+dim, matching the reference's layouts.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...core.tensor import Tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
+
+
+def _data(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _check_algo(algo):
+    if algo not in ("weight_only_int8", "weight_only_int4"):
+        raise ValueError(
+            f"unsupported algo {algo!r}: expected 'weight_only_int8' or "
+            "'weight_only_int4' (llm.int8 is a CUDA-kernel path the "
+            "reference gates on sm75+; the TPU analogue is the fused "
+            "dequant matmul used here)")
+
+
+def weight_quantize(x, algo="weight_only_int8", group_size=-1):
+    """Quantize a [k, n] weight for weight-only inference.
+
+    Returns (quantized weight, scale):
+      - int8: qw [k, n] int8, scale [n] (or [k/group, n] grouped) f32;
+      - int4: two nibbles packed per byte → qw [k/2, n] int8 ("signed
+        nibble" −8..7), scale as above.
+    """
+    _check_algo(algo)
+    w = _data(x).astype(jnp.float32)
+    k, n = w.shape
+    bits_max = 127.0 if algo.endswith("int8") else 7.0
+    if group_size and group_size > 0:
+        if k % group_size:
+            raise ValueError(f"group_size {group_size} must divide k={k}")
+        wg = w.reshape(k // group_size, group_size, n)
+        scale = jnp.max(jnp.abs(wg), axis=1) / bits_max      # [k/g, n]
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(wg / scale[:, None, :]), -bits_max,
+                     bits_max).reshape(k, n)
+    else:
+        scale = jnp.max(jnp.abs(w), axis=0) / bits_max        # [n]
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(w / scale[None, :]), -bits_max, bits_max)
+    q = q.astype(jnp.int8)
+    if algo.endswith("int4"):
+        if k % 2:
+            raise ValueError(f"int4 packing requires even k (got {k})")
+        lo = q[0::2]                      # [k/2, n] in −8..7
+        hi = q[1::2]
+        q = ((hi.astype(jnp.int32) << 4) |
+             (lo.astype(jnp.int32) & 0xF)).astype(jnp.int8)
+    return Tensor(q), Tensor(scale.astype(jnp.float32))
+
+
+def _unpack_int4(q):
+    """[k/2, n] packed int8 → [k, n] signed-nibble values (−8..7)."""
+    qi = q.astype(jnp.int32)
+    lo = qi & 0xF
+    lo = jnp.where(lo >= 8, lo - 16, lo)          # sign-extend nibble
+    hi = qi >> 4                                  # arithmetic shift
+    k2, n = q.shape
+    out = jnp.stack([lo, hi], axis=1).reshape(2 * k2, n)
+    return out
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1,
+                      out_dtype=jnp.float32):
+    """Inverse of weight_quantize (mainly for tests/debug — inference
+    should use weight_only_linear, which never materializes this)."""
+    _check_algo(algo)
+    q = _data(x)
+    s = _data(scale).astype(jnp.float32)
+    vals = _unpack_int4(q) if algo.endswith("int4") else q
+    vals = vals.astype(jnp.float32)
+    k = vals.shape[0]
+    _check_group(group_size, s, k)
+    if s.ndim == 2:                                # grouped [k/g, n]
+        g = k // s.shape[0]
+        w = (vals.reshape(s.shape[0], g, -1) * s[:, None, :]).reshape(
+            k, -1)
+    else:
+        w = vals * s[None, :]
+    return Tensor(w.astype(out_dtype))
+
+
+def _check_group(group_size, scale, k):
+    """group_size is redundant with the scale's own shape — validate the
+    two agree rather than silently ignoring one."""
+    if group_size and group_size > 0:
+        if scale.ndim != 2 or k // scale.shape[0] != group_size:
+            raise ValueError(
+                f"group_size {group_size} inconsistent with scale shape "
+                f"{tuple(scale.shape)} for k={k}")
+    elif scale.ndim == 2:
+        raise ValueError(
+            f"grouped scale {tuple(scale.shape)} requires passing the "
+            f"matching group_size (={k // scale.shape[0]})")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1):
+    """y = x @ dequant(weight) + bias with the dequant fused into the
+    compiled matmul (no f16/f32 weight copy in HBM)."""
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8/int4, got "
+                         f"{weight_dtype!r}")
+    if weight_scale is None:
+        raise ValueError("weight_scale is required (from weight_quantize)")
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    args = [xt, weight if isinstance(weight, Tensor) else Tensor(weight),
+            weight_scale if isinstance(weight_scale, Tensor)
+            else Tensor(weight_scale)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(bias if isinstance(bias, Tensor) else Tensor(bias))
+    is4 = weight_dtype == "int4"
+    k_full = args[1]._data.shape[0] * (2 if is4 else 1)
+    _check_group(group_size, args[2]._data, k_full)
+
+    def fn(xa, qa, sa, *rest):
+        vals = _unpack_int4(qa) if is4 else qa
+        vals = vals.astype(xa.dtype)
+        s = sa.astype(xa.dtype)
+        k = vals.shape[0]
+        if s.ndim == 2:
+            g = k // s.shape[0]
+            w = (vals.reshape(s.shape[0], g, -1) * s[:, None, :]).reshape(
+                k, -1)
+        else:
+            w = vals * s[None, :]
+        y = xa @ w
+        if rest:
+            y = y + rest[0].astype(y.dtype)
+        return y
+
+    return apply(fn, *args, name="weight_only_linear")

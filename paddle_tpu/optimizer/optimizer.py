@@ -247,7 +247,7 @@ class Optimizer:
         new_step_t = Tensor(jnp.zeros((), jnp.int32))
         out_tensors = new_param_t + new_state_t + [new_step_t]
         prog.record(_update_fn, in_tensors, out_tensors,
-                    name=f"{type(self).__name__}.minimize")
+                    name=f"{type(self).__name__}.minimize", kind="opt")
 
         for p, np_t in zip(params, new_param_t):
             prog._assigns.append((id(np_t), p))

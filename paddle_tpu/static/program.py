@@ -47,13 +47,14 @@ __all__ = ["Program", "program_guard", "data", "Executor", "global_scope",
 
 
 class _Record:
-    __slots__ = ("fn", "in_keys", "out_keys", "name")
+    __slots__ = ("fn", "in_keys", "out_keys", "name", "kind")
 
-    def __init__(self, fn, in_keys, out_keys, name):
+    def __init__(self, fn, in_keys, out_keys, name, kind="op"):
         self.fn = fn
         self.in_keys = in_keys
         self.out_keys = out_keys
         self.name = name
+        self.kind = kind  # "op" | "backward" | "opt"
 
 
 class Program:
@@ -81,7 +82,7 @@ class Program:
         self._pins: list = []
 
     # -- recording (called from autograd.apply) -----------------------------
-    def record(self, fn, in_tensors, out_tensors, name=""):
+    def record(self, fn, in_tensors, out_tensors, name="", kind="op"):
         in_keys = []
         for t in in_tensors:
             k = id(t)
@@ -95,7 +96,7 @@ class Program:
         self._pins.extend(in_tensors)
         self._pins.extend(out_tensors)
         self._records.append(_Record(fn, tuple(in_keys), tuple(out_keys),
-                                     name))
+                                     name, kind))
 
     def _register_feed(self, name, tensor):
         self._feeds[name] = id(tensor)
@@ -107,7 +108,24 @@ class Program:
         return self
 
     def clone(self, for_test=False):
-        return self
+        """for_test=True: the reference strips backward + optimizer ops
+        so Executor.run on the clone evaluates without training. Here
+        that is a view Program sharing this one's forward records and
+        leaves (live parameters included — a trained weight evaluates
+        with its current value) but carrying no training records,
+        writebacks, or pre-run hooks."""
+        if not for_test:
+            return self
+        p = Program()
+        p._records = [r for r in self._records if r.kind == "op"]
+        # shallow copies: the clone sees the same LIVE Tensor objects
+        # (a trained weight evaluates with its current value) but
+        # recording into the clone must not mutate this Program's maps
+        p._feeds = dict(self._feeds)
+        p._leaves = dict(self._leaves)
+        p._produced = set(self._produced)
+        p._pins = list(self._pins)
+        return p
 
     def all_parameters(self):
         from ..core.tensor import Parameter
@@ -132,14 +150,27 @@ class Program:
             else:
                 raise TypeError(f"fetch_list entries must be Tensors "
                                 f"(got {f!r})")
-        names = sorted(self._feeds)
+        # dead-record elimination: replay only ops whose outputs reach a
+        # fetch or writeback (the reference prunes the same way for
+        # test-clone programs — an eval fetch must not demand the label
+        # feed that only the loss op consumes)
+        need = set(fetch_keys)
+        need.update(k for k, _ in self._assigns)
+        active = []
+        for rec in reversed(self._records):
+            if any(k in need for k in rec.out_keys):
+                active.append(rec)
+                need.update(rec.in_keys)
+        active.reverse()
+        names = sorted(n for n in self._feeds
+                       if self._feeds[n] in need)
         missing = [n for n in names if n not in feed]
         if missing:
             raise ValueError(f"missing feeds: {missing}")
         feed_arrays = [jnp.asarray(feed[n]._data if isinstance(feed[n],
                                                                Tensor)
                                    else feed[n]) for n in names]
-        # key order must match _replay's zip over self._feeds.values()
+        # key order must match _replay's zip over the ordered feed names
         ordered_keys = [self._feeds[n] for n in names]
         leaf_arrays = [t._data for t in self._leaves.values()]
 
@@ -156,7 +187,7 @@ class Program:
             def pure(feed_arrays, leaf_arrays):
                 env = dict(zip(ordered_keys, feed_arrays))
                 env.update(zip(self._leaves.keys(), leaf_arrays))
-                for rec in self._records:
+                for rec in active:
                     try:
                         args = [env[k] for k in rec.in_keys]
                     except KeyError as e:
@@ -252,7 +283,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     prog._pins.extend(grad_tensors)
     prog._records.append(_Record(
         _grads_fn, in_keys, tuple(id(g) for g in grad_tensors),
-        "append_backward"))
+        "append_backward", kind="backward"))
     return list(zip(params, grad_tensors))
 
 

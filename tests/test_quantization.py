@@ -296,3 +296,84 @@ class TestInt8InferencePath:
         pred = create_predictor(cfg)
         (got,) = pred.run([x])
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestWeightOnlyQuant:
+    """paddle.nn.quant weight-only int8/int4 (SURVEY.md §2.2
+    quantization): quantize→dequantize error bounds and fused
+    weight_only_linear parity with the f32 matmul."""
+
+    def _w(self, k=64, n=32, seed=0):
+        return np.random.default_rng(seed).standard_normal(
+            (k, n)).astype(np.float32)
+
+    def test_int8_roundtrip_error(self):
+        from paddle_tpu.nn import quant
+        w = self._w()
+        qw, scale = quant.weight_quantize(P.to_tensor(w),
+                                          algo="weight_only_int8")
+        assert qw.numpy().dtype == np.int8 and qw.numpy().shape == w.shape
+        wd = quant.weight_dequantize(qw, scale, algo="weight_only_int8")
+        # absmax int8: max error <= scale/2 per channel
+        err = np.abs(wd.numpy() - w)
+        bound = np.abs(w).max(axis=0) / 127.0 * 0.5 + 1e-6
+        assert (err <= bound[None, :]).all()
+
+    def test_int4_pack_roundtrip(self):
+        from paddle_tpu.nn import quant
+        w = self._w()
+        qw, scale = quant.weight_quantize(P.to_tensor(w),
+                                          algo="weight_only_int4")
+        assert qw.numpy().shape == (w.shape[0] // 2, w.shape[1])
+        wd = quant.weight_dequantize(qw, scale, algo="weight_only_int4")
+        err = np.abs(wd.numpy() - w)
+        bound = np.abs(w).max(axis=0) / 7.0 * 0.5 + 1e-6
+        assert (err <= bound[None, :]).all()
+
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        from paddle_tpu.nn import quant
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 64)).astype(np.float32)
+        w = self._w(seed=2)
+        b = rng.standard_normal((32,)).astype(np.float32)
+        for algo, dt in [("weight_only_int8", "int8"),
+                         ("weight_only_int4", "int4")]:
+            qw, scale = quant.weight_quantize(P.to_tensor(w), algo=algo)
+            y = quant.weight_only_linear(P.to_tensor(x), qw,
+                                         bias=P.to_tensor(b),
+                                         weight_scale=scale,
+                                         weight_dtype=dt)
+            wd = quant.weight_dequantize(qw, scale, algo=algo).numpy()
+            ref = x @ wd + b
+            np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_grouped_scales(self):
+        from paddle_tpu.nn import quant
+        w = self._w(k=64, n=16, seed=3)
+        qw, scale = quant.weight_quantize(P.to_tensor(w),
+                                          algo="weight_only_int8",
+                                          group_size=16)
+        assert scale.numpy().shape == (4, 16)
+        wd = quant.weight_dequantize(qw, scale, algo="weight_only_int8",
+                                     group_size=16)
+        # grouped absmax tightens the bound per 16-row group
+        err = np.abs(wd.numpy() - w)
+        for gi in range(4):
+            blk = w[gi * 16:(gi + 1) * 16]
+            bound = np.abs(blk).max(axis=0) / 127.0 * 0.5 + 1e-6
+            assert (err[gi * 16:(gi + 1) * 16] <= bound[None, :]).all()
+
+    def test_backward_through_weight_only_linear(self):
+        from paddle_tpu.nn import quant
+        x = P.to_tensor(self._w(k=4, n=64, seed=4), stop_gradient=False)
+        w = self._w(seed=5)
+        qw, scale = quant.weight_quantize(P.to_tensor(w),
+                                          algo="weight_only_int8")
+        y = quant.weight_only_linear(x, qw, weight_scale=scale)
+        y.sum().backward()
+        wd = quant.weight_dequantize(qw, scale).numpy()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.broadcast_to(wd.sum(axis=1),
+                                                   (4, 64)),
+                                   rtol=1e-4)

@@ -271,3 +271,42 @@ class TestStaticTraining:
         exe.run(main, feed={"x": np.ones((4, 8), np.float32),
                             "y": np.zeros((4, 1), np.float32)})
         assert not np.allclose(w0, np.asarray(lin.weight._data))
+
+    def test_clone_for_test_does_not_train(self):
+        import paddle_tpu.optimizer as opt_mod
+        main, lin, loss, _ = self._build(opt_mod.SGD, lr=0.5)
+        test_prog = main.clone(for_test=True)
+        exe = static.Executor()
+        w0 = np.asarray(lin.weight._data).copy()
+        # eval on the clone: fetches the loss WITHOUT feeding... the
+        # loss needs y; fetch it with both feeds — weights must not move
+        (lv,) = exe.run(test_prog,
+                        feed={"x": np.ones((4, 8), np.float32),
+                              "y": np.zeros((4, 1), np.float32)},
+                        fetch_list=[loss])
+        np.testing.assert_allclose(w0, np.asarray(lin.weight._data))
+        # pred-only fetch must not demand the label feed (dead-record
+        # elimination prunes the loss op)
+        pred = None
+        P.seed(7)
+        main2 = static.Program()
+        with static.program_guard(main2):
+            x2 = static.data("x", [4, 8], "float32")
+            y2 = static.data("y", [4, 1], "float32")
+            lin2 = P.nn.Linear(8, 1)
+            pred = lin2(x2)
+            l2 = ((pred - y2) * (pred - y2)).mean()
+            opt = opt_mod.SGD(learning_rate=0.1,
+                              parameters=lin2.parameters())
+            opt.minimize(l2)
+        tp = main2.clone(for_test=True)
+        (pv,) = exe.run(tp, feed={"x": np.ones((4, 8), np.float32)},
+                        fetch_list=[pred])
+        assert pv.shape == (4, 1)
+        # training on the ORIGINAL still works after cloning
+        w_before = np.asarray(lin2.weight._data).copy()
+        (lv2,) = exe.run(main2,
+                         feed={"x": np.ones((4, 8), np.float32),
+                               "y": np.zeros((4, 1), np.float32)},
+                         fetch_list=[l2])
+        assert not np.allclose(w_before, np.asarray(lin2.weight._data))
