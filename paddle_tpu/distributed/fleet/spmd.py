@@ -39,38 +39,50 @@ from ...core.tensor import Tensor
 from ...nn.layer import Layer
 
 
+def _add_sharding(spec, shape, sharding_degree):
+    """Compose ZeRO 'sharding' onto a (possibly TP-sharded) spec: take
+    the largest FREE dim divisible by the sharding degree. Returns None
+    if no free dim qualifies (spec unchanged). ZeRO composes WITH tensor
+    parallelism — each TP shard is further sharded across the sharding
+    group (the reference's sharding×mp hybrid; same rule as the
+    pipeline's `_pp_param_spec`)."""
+    tail = list(spec) + [None] * (len(shape) - len(spec))
+    if "sharding" in tail:
+        return None
+    for d in np.argsort([-s for s in shape]):
+        if tail[d] is None and shape[d] % sharding_degree == 0 \
+                and shape[d] >= sharding_degree:
+            tail[d] = "sharding"
+            return P(*tail)
+    return None
+
+
 def param_spec(param, shape, stage, sharding_degree, mp_degree) -> P:
     """Decide the PartitionSpec for a parameter.
 
-    Priority: explicit mpu `dist_spec` > ZeRO-3 dim-0 sharding > replicate.
+    Explicit mpu `dist_spec` (TP) dims are kept; ZeRO-3 then shards the
+    largest free divisible dim on top (TP×ZeRO-3 composition — without
+    it every TP-sharded transformer weight would be replicated across
+    the whole sharding group, forfeiting ZeRO's memory win at scale).
     """
     explicit = getattr(param, "dist_spec", None)
-    if explicit is not None:
-        return P(*explicit)
+    spec = P(*explicit) if explicit is not None else P()
     if stage >= 3 and sharding_degree > 1 and len(shape) >= 1:
-        # shard the largest divisible dim (dim0-preferred, reference
-        # shards flattened params; dim sharding is the GSPMD analogue)
-        for d in np.argsort([-s for s in shape]):
-            if shape[d] % sharding_degree == 0 and shape[d] >= \
-                    sharding_degree:
-                spec = [None] * len(shape)
-                spec[d] = "sharding"
-                return P(*spec)
-    return P()
+        composed = _add_sharding(spec, shape, sharding_degree)
+        if composed is not None:
+            return composed
+    return spec
 
 
 def state_spec(pspec: P, shape, stage, sharding_degree) -> P:
-    """Optimizer-state sharding: stage>=1 shards states like ZeRO-1."""
-    if any(s is not None for s in pspec):
-        return pspec  # follows its (possibly mp/zero3-sharded) param
-    if stage >= 1 and sharding_degree > 1 and len(shape) >= 1:
-        for d in np.argsort([-s for s in shape]):
-            if shape[d] % sharding_degree == 0 and shape[d] >= \
-                    sharding_degree:
-                spec = [None] * len(shape)
-                spec[d] = "sharding"
-                return P(*spec)
-    return P()
+    """Optimizer-state sharding: stage>=1 shards states like ZeRO-1,
+    composing with (not deferring to) the param's TP dims."""
+    if stage >= 1 and sharding_degree > 1 and len(shape) >= 1 and \
+            len(pspec) <= len(shape):
+        composed = _add_sharding(pspec, shape, sharding_degree)
+        if composed is not None:
+            return composed
+    return pspec
 
 
 def batch_spec(ndim: int, dp_axes=("dp", "sharding")) -> P:
