@@ -3,7 +3,8 @@
 AOT-lowers (NO execution) the real fleet SPMD train step for the actual
 7B config under ZeRO-3 (+TP) on a virtual CPU mesh, proving the program
 compiles, and derives the per-device memory table from the lowered
-shardings. Writes FEASIBILITY.md.
+shardings. Prints one JSON record; FEASIBILITY.md is authored from the
+records of the two standard layouts below.
 
 Usage:
     python tools/feasibility_7b.py [--devices 8] [--mp 1] [--seq 4096]
