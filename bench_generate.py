@@ -1,7 +1,10 @@
 """Decode-throughput bench: LLaMA proxy autoregressive generation with
 the static-KV-cache jitted decode loop (models/generation.py).
 
-Usage: python bench_generate.py [batch] [prompt_len] [new_tokens]
+Usage: python bench_generate.py [batch] [prompt_len] [new_tokens] [--wq int8|int4]
+`--wq` swaps every linear (except lm_head) to weight-only quantized
+storage before compiling the decode program — decode is HBM-bound, so
+int8/int4 weights target ~2x/4x the streamed bytes.
 Prints one JSON line {metric, value (decode tokens/sec), ...}.
 Results log: PERF.md.
 """
@@ -13,6 +16,11 @@ import time
 
 import numpy as np
 
+wq = None
+if "--wq" in sys.argv:
+    i = sys.argv.index("--wq")
+    wq = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
 batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
 new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
@@ -50,6 +58,10 @@ def main():
     if on_tpu:
         model.to(dtype="bfloat16")
     model.eval()
+    if wq:
+        from paddle_tpu.nn.quant import convert_to_weight_only
+        convert_to_weight_only(model, algo=f"weight_only_{wq}",
+                               exclude=("lm_head",))
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     x = P.to_tensor(ids)
@@ -76,6 +88,7 @@ def main():
         "value": round(tok_s, 1),
         "unit": "decode tokens/sec (batch total, static-cache jitted loop)",
         "batch": batch, "prompt": prompt, "new_tokens": new,
+        "weight_quant": wq or "none",
         "wall_s": round(dt, 3),
     }))
 

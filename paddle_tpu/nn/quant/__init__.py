@@ -18,8 +18,10 @@ import jax.numpy as jnp
 
 from ...core.autograd import apply
 from ...core.tensor import Tensor
+from ..layer import Layer
 
-__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "WeightOnlyLinear", "convert_to_weight_only"]
 
 
 def _data(t):
@@ -152,3 +154,75 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         return y
 
     return apply(fn, *args, name="weight_only_linear")
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in replacement for nn.Linear holding int8/int4 weights
+    (reference workflow: PaddleNLP's weight-only module swap over
+    paddle.nn.quant.weight_only_linear). qweight/scale are BUFFERS —
+    never trained, but serialized and passed as arguments of any
+    compiled program that closes over the module (generation's
+    weights-as-args plumbing picks them up automatically)."""
+
+    def __init__(self, in_features, out_features, qweight, scale, bias,
+                 weight_dtype, group_size=-1):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight_dtype = weight_dtype
+        self.group_size = group_size
+        self.register_buffer("qweight", qweight)
+        self.register_buffer("weight_scale", scale)
+        self.bias = bias  # Parameter or None (still trainable)
+
+    def forward(self, x):
+        return weight_only_linear(
+            x, self.qweight, bias=self.bias,
+            weight_scale=self.weight_scale,
+            weight_dtype=self.weight_dtype,
+            group_size=self.group_size)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, "
+                f"weight_dtype={self.weight_dtype}")
+
+    @staticmethod
+    def from_linear(linear, algo="weight_only_int8", group_size=-1):
+        qw, scale = weight_quantize(linear.weight, algo=algo,
+                                    group_size=group_size)
+        return WeightOnlyLinear(
+            linear.in_features, linear.out_features, qw, scale,
+            linear.bias, "int4" if algo.endswith("int4") else "int8",
+            group_size)
+
+
+def convert_to_weight_only(layer, algo="weight_only_int8", group_size=-1,
+                           exclude=()):
+    """Recursively swap every nn.Linear sublayer for a WeightOnlyLinear
+    quantized from its current weight. `exclude`: substring match on the
+    qualified sublayer name (e.g. ("lm_head",) keeps the output head in
+    full precision — the usual LLM recipe). Returns `layer` (mutated);
+    count of converted layers at `layer._weight_only_converted`."""
+    from ..common import Linear
+
+    converted = 0
+
+    def walk(mod, prefix):
+        nonlocal converted
+        for name, sub in list(mod._sub_layers.items()):
+            qual = f"{prefix}.{name}" if prefix else name
+            # exact type only: Linear SUBCLASSES (TP/SP parallel linears
+            # etc.) carry sharding semantics the swap would destroy
+            if type(sub) is Linear and not any(e in qual
+                                               for e in exclude):
+                setattr(mod, name,
+                        WeightOnlyLinear.from_linear(sub, algo=algo,
+                                                     group_size=group_size))
+                converted += 1
+            else:
+                walk(sub, qual)
+
+    walk(layer, "")
+    layer._weight_only_converted = converted
+    return layer
