@@ -477,6 +477,28 @@ class _LlamaPipeEmbed(Layer):
         return self.embed_tokens(input_ids)
 
 
+class _LlamaPipeNorm(Layer):
+    """Pipeline post-section piece: final RMSNorm alone (used when the
+    LM head is a tied ref to the embedding — reference:
+    LlamaRMSNormPipe)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def forward(self, x):
+        return self.norm(x)
+
+
+def _tied_pipe_head(owner, x):
+    """forward_func for the tied-head SharedLayerDesc ref: contract
+    against the shared embedding weight's transpose (the owner's LIVE —
+    traced — tensors, so the shard_map transpose psums embedding- and
+    head-path cotangents into one tied gradient)."""
+    from ..ops.math import matmul
+    return matmul(x, owner.embed_tokens.weight, transpose_y=True)
+
+
 class _LlamaPipeHead(Layer):
     """Pipeline post-section: final norm + LM head (reference:
     LlamaForCausalLMPipe's LlamaRMSNormPipe + LlamaLMHead)."""
@@ -503,20 +525,33 @@ def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages=None,
     LlamaForCausalLMPipe): embedding pre-section, N decoder blocks, norm+
     head post-section. Composes with TP (tensor_parallel=True) and ZeRO
     via the pipeline runtime's GSPMD auto axes."""
-    from ..distributed.fleet.pipeline import LayerDesc, PipelineLayer
-    if cfg.tie_word_embeddings:
-        raise NotImplementedError(
-            "tie_word_embeddings is not supported in the pipeline form")
+    from ..distributed.fleet.pipeline import (LayerDesc, PipelineLayer,
+                                              SharedLayerDesc)
     if cfg.fuse_linear_cross_entropy:
         raise NotImplementedError(
             "fuse_linear_cross_entropy is not supported in the pipeline "
             "form yet — the pipe head materializes logits, which would "
             "silently defeat the flag's purpose")
+    if cfg.tie_word_embeddings:
+        if cfg.tensor_parallel:
+            raise NotImplementedError(
+                "tie_word_embeddings with tensor_parallel is not "
+                "supported yet; untie or disable tensor_parallel")
+        # tied input/output embeddings across first/last stage via
+        # SharedLayerDesc (the GPT/LLaMA idiom): the head is a thin ref
+        # contracting against the embedding owner's weight
+        pre = [SharedLayerDesc("embed_tokens", _LlamaPipeEmbed, cfg)]
+        post = [_LlamaPipeNorm(cfg),
+                SharedLayerDesc("embed_tokens", _LlamaPipeEmbed, cfg,
+                                forward_func=_tied_pipe_head)]
+    else:
+        pre = [_LlamaPipeEmbed(cfg)]
+        post = [_LlamaPipeHead(cfg)]
     return PipelineLayer(
-        layers=[_LlamaPipeEmbed(cfg)] +
+        layers=pre +
                [LayerDesc(LlamaDecoderLayer, cfg)
                 for _ in range(cfg.num_hidden_layers)] +
-               [_LlamaPipeHead(cfg)],
+               post,
         num_stages=num_stages,
         num_virtual_pipeline_stages=num_virtual_pipeline_stages,
         loss_fn=loss_fn if loss_fn is not None
