@@ -122,6 +122,9 @@ class SPMDTrainer:
         self.sharding_degree = ax.get("sharding", 1)
         self.mp_degree = ax.get("mp", 1)
         self.dp_degree = ax.get("dp", 1)
+        # context parallelism (sep axis): the step runs sequence-sharded
+        # inside shard_map over 'sep'; see _build's sep branch
+        self.sep_degree = ax.get("sep", 1)
         # gradient merge (reference: fleet gradient_merge dist pass):
         # accumulate k micro-steps' grads in f32 accumulators, apply the
         # optimizer on the k-th — two cached program flavors
@@ -214,6 +217,10 @@ class SPMDTrainer:
                     new_buf = [t._data for _, t in buf_named]
                     return total._data.astype(jnp.float32), new_buf
 
+                if self.sep_degree > 1:
+                    loss_of = self._build_sep_loss(
+                        key, frozen, buffers, batch, n_inputs)
+
                 (loss_v, new_buf), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(list(params))
 
@@ -263,7 +270,13 @@ class SPMDTrainer:
             jax.tree.map(
                 lambda a, sp=sp: self._state_sharding(sp, a.shape), st)
             for st, sp in zip(states_tree_shapes[0], self._pspecs)]
-        batch_sh = [ns(batch_spec(nd)) for nd in states_tree_shapes[1]]
+        if self.sep_degree > 1:
+            # [B, S] args: batch dim over data axes, seq dim over 'sep'
+            batch_sh = [ns(P(("dp", "sharding"), "sep")) if nd == 2
+                        else ns(batch_spec(nd))
+                        for nd in states_tree_shapes[1]]
+        else:
+            batch_sh = [ns(batch_spec(nd)) for nd in states_tree_shapes[1]]
 
         gacc_sh = [self._state_sharding(sp, tuple(p._data.shape))
                    for (_, p), sp in zip(self._train_named, self._pspecs)] \
@@ -278,6 +291,84 @@ class SPMDTrainer:
         return jax.jit(pure, in_shardings=in_shardings,
                        out_shardings=out_shardings)
 
+    def _build_sep_loss(self, key, frozen, buffers, batch, n_inputs):
+        """Context-parallel loss (sep axis; SURVEY §5.7): the forward
+        runs sequence-sharded inside shard_map MANUAL over 'sep' only —
+        dp/sharding/mp stay GSPMD auto axes, same partial-manual design
+        as the pipeline runtime. The model's attention layers route
+        through ring/ulysses flash attention (cfg.context_parallel) and
+        rope positions carry the global block offset. Labels are the
+        GLOBALLY pre-shifted next-token ids (train_batch shifts before
+        sharding), so the psum'd per-token CE sum/count equals the dense
+        shifted CE EXACTLY — shard-boundary pairs included (a per-shard
+        shifted loss would silently drop sep-1 of them)."""
+        import jax
+        from jax import shard_map
+
+        from .._axis import axis_env
+
+        if self.amp_level:
+            raise NotImplementedError(
+                "sep (context-parallel) training does not compose with "
+                "amp auto_cast yet; run bf16-native via model.to()")
+        cfg = getattr(self.layer, "cfg", None)
+        if cfg is not None and getattr(cfg, "fuse_linear_cross_entropy",
+                                       False):
+            raise NotImplementedError(
+                "sep training computes its own token CE; disable "
+                "fuse_linear_cross_entropy")
+        if n_inputs != 1 or len(batch) != 2:
+            raise NotImplementedError(
+                "sep (context-parallel) training expects exactly "
+                "(input_ids, labels) — a causal-LM step")
+        mesh = self.mesh
+        layer = self.layer
+        train_named = self._train_named
+        frozen_named = self._frozen_named
+        buf_named = self._buf_named
+
+        def local_body(key_, params_, frozen_, buffers_, ids_l, lab_l):
+            for (n, t), arr in zip(train_named, params_):
+                t._data = arr
+            for (n, t), arr in zip(frozen_named, frozen_):
+                t._data = arr
+            for (n, t), arr in zip(buf_named, buffers_):
+                t._data = arr
+            _random.push_trace_key(jax.random.fold_in(
+                key_, jax.lax.axis_index("sep")))
+            try:
+                outs = layer(Tensor(ids_l))
+                logits = (outs[0] if isinstance(outs, (list, tuple))
+                          else outs)._data
+                lp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                        axis=-1)
+                valid = lab_l >= 0
+                lab_c = jnp.where(valid, lab_l, 0).astype(jnp.int32)
+                tok = jnp.take_along_axis(lp, lab_c[..., None],
+                                          axis=-1)[..., 0]
+                s = jax.lax.psum(-jnp.sum(jnp.where(valid, tok, 0.0)),
+                                 "sep")
+                c = jax.lax.psum(jnp.sum(valid.astype(jnp.float32)),
+                                 "sep")
+                new_buf = [t._data for _, t in buf_named]
+                return s / jnp.maximum(c, 1.0), new_buf
+            finally:
+                _random.pop_trace_key()
+
+        smapped = shard_map(
+            local_body, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(None, "sep"),
+                      P(None, "sep")),
+            out_specs=(P(), P()),
+            axis_names=frozenset({"sep"}), check_vma=False)
+
+        def loss_of(params_):
+            with axis_env("sep"):
+                return smapped(key, list(params_), list(frozen),
+                               list(buffers), batch[0], batch[1])
+
+        return loss_of
+
     def train_batch(self, inputs, labels):
         if not self._placed:
             self.shard_parameters()
@@ -286,6 +377,40 @@ class SPMDTrainer:
                   for t in inputs]
         labels = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
                   for t in labels]
+        if self.sep_degree > 1:
+            # causal-LM labels are shifted GLOBALLY before sequence
+            # sharding (see _build_sep_loss); ignore-pad the final slot.
+            # The sep branch computes the standard shifted token CE
+            # itself, so it REFUSES inputs it would silently reinterpret
+            # (prompt-masked labels, custom criteria) instead of
+            # training on a different objective than sep_degree=1 would.
+            ids = inputs[0]._data
+            if ids.ndim != 2 or ids.shape[1] % self.sep_degree:
+                raise ValueError(
+                    f"sep training needs [B, S] ids with S divisible by "
+                    f"sep degree {self.sep_degree} (got {ids.shape})")
+            if len(labels) != 1 or (labels[0]._data is not ids and not (
+                    labels[0]._data.shape == ids.shape
+                    and bool(jnp.all(labels[0]._data == ids)))):
+                raise NotImplementedError(
+                    "sep (context-parallel) training computes the "
+                    "standard shifted causal-LM CE from input_ids; "
+                    "pass labels == input_ids (prompt-masked or custom "
+                    "labels are not supported yet)")
+            from ...models.llama import LlamaPretrainingCriterion
+            if self.loss_fn is not None and not isinstance(
+                    self.loss_fn, LlamaPretrainingCriterion) and not \
+                    getattr(self.loss_fn, "is_causal_lm_criterion",
+                            False):
+                raise NotImplementedError(
+                    f"sep training replaces the criterion with the "
+                    f"shifted token CE; {type(self.loss_fn).__name__} "
+                    "would be silently ignored (mark it with "
+                    "is_causal_lm_criterion=True if that is the same "
+                    "objective)")
+            labels = [Tensor(jnp.concatenate(
+                [ids[:, 1:],
+                 jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1))]
         states = [opt._get_state(p) for _, p in self._train_named]
         batch_ndims = [t._data.ndim for t in inputs + labels]
         self._micro += 1
@@ -311,9 +436,14 @@ class SPMDTrainer:
         if do_update:
             opt._step_count += 1
         key = _random.next_key()
+        def _batch_sharding(nd):
+            if self.sep_degree > 1 and nd == 2:
+                return NamedSharding(self.mesh,
+                                     P(("dp", "sharding"), "sep"))
+            return NamedSharding(self.mesh, batch_spec(nd))
+
         batch_arrays = [
-            jax.device_put(t._data, NamedSharding(
-                self.mesh, batch_spec(t._data.ndim)))
+            jax.device_put(t._data, _batch_sharding(t._data.ndim))
             for t in inputs + labels]
         out = fn(
             key,

@@ -57,6 +57,13 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # context parallelism over the 'sep' mesh axis (reference: sep axis
+    # + PaddleNLP context parallel): "ring" = ring flash attention
+    # (K/V ppermute, O(S/n) memory), "ulysses" = alltoall head/sequence
+    # re-partition. Training runs sequence-sharded inside shard_map over
+    # 'sep' (SPMDTrainer wires this when sep_degree > 1); both degrade
+    # to dense attention when no sep axis is live.
+    context_parallel: str | None = None
     recompute: bool = False
     recompute_granularity: str = "full"
     dtype: str = "float32"
@@ -85,6 +92,17 @@ def _linear_cls(cfg, kind):
     if cfg.tensor_parallel and _mp_degree() > 1:
         return kind
     return None
+
+
+def _repeat_kv(k, v, rep):
+    """[B, S, Hkv, D] → [B, S, Hkv·rep, D] (GQA head repeat for paths
+    without in-kernel KV indexing)."""
+    b, sk, nkv, hd = k.shape
+    k = k.unsqueeze(3).expand([b, sk, nkv, rep, hd]) \
+         .reshape([b, sk, nkv * rep, hd])
+    v = v.unsqueeze(3).expand([b, sk, nkv, rep, hd]) \
+         .reshape([b, sk, nkv * rep, hd])
+    return k, v
 
 
 class LlamaAttention(Layer):
@@ -129,6 +147,31 @@ class LlamaAttention(Layer):
             v = P.concat([cache[1], v], axis=1)
             cache = (k, v)
         causal = cache is None
+        if self.cfg.context_parallel and cache is None:
+            if self.cfg.context_parallel not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"context_parallel={self.cfg.context_parallel!r}: "
+                    "expected 'ring' or 'ulysses'")
+            from ..distributed._axis import current_axis_env
+            if "sep" in current_axis_env():
+                if attn_mask is not None:
+                    raise NotImplementedError(
+                        "context-parallel attention does not support "
+                        "attn_mask yet (pad masks would be silently "
+                        "dropped); pack sequences or pad with causal "
+                        "semantics instead")
+                from ..distributed.fleet.long_context import (
+                    ring_flash_attention, ulysses_attention)
+                if nkv != nh:
+                    # GQA through the sep composition repeats KV to full
+                    # heads (the in-kernel GQA path does not yet compose
+                    # with the sep collectives' head/sequence layouts)
+                    k, v = _repeat_kv(k, v, nh // nkv)
+                cp = ring_flash_attention \
+                    if self.cfg.context_parallel == "ring" \
+                    else ulysses_attention
+                out = cp(q, k, v, causal=True)
+                return self.o_proj(out.reshape([b, s, nh * hd]))
         if self.cfg.use_flash_attention:
             # GQA: K/V go in at their NATIVE head count — the Pallas
             # kernel indexes KV heads in its BlockSpec maps (round-3;
@@ -138,11 +181,7 @@ class LlamaAttention(Layer):
                 training=self.training)
         else:
             if nkv != nh:  # XLA debug path: repeat kv heads
-                rep = nh // nkv
-                k = k.unsqueeze(3).expand([b, k.shape[1], nkv, rep, hd]) \
-                     .reshape([b, k.shape[1], nh, hd])
-                v = v.unsqueeze(3).expand([b, v.shape[1], nkv, rep, hd]) \
-                     .reshape([b, v.shape[1], nh, hd])
+                k, v = _repeat_kv(k, v, nh // nkv)
             # honor the config switch: plain XLA attention (debug /
             # numerics-comparison path, reference flag parity)
             from ..core.autograd import apply as _apply
@@ -260,6 +299,18 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
         x = self.embed_tokens(input_ids)
+        if self.cfg.context_parallel and position_ids is None:
+            from ..distributed._axis import current_axis_env
+            if "sep" in current_axis_env():
+                # sequence-sharded under shard_map: each sep rank holds
+                # the GLOBAL block [r·S_local, (r+1)·S_local) — rope
+                # positions must carry the global offset
+                import jax
+                sl = x.shape[1]
+                off = jax.lax.axis_index("sep").astype(jnp.int32) * sl
+                pos = off + jnp.arange(sl, dtype=jnp.int32)
+                position_ids = Tensor(jnp.broadcast_to(
+                    pos[None, :], (x.shape[0], sl)))
         if self.cfg.sequence_parallel:
             from ..distributed.fleet.sequence_parallel import scatter
             x = scatter(x, axis=1)
