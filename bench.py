@@ -194,7 +194,10 @@ def main():
         # baseline — do not imply a ratio (round-2 verdict, weak #3)
         rec["tpu_unavailable"] = True
         rec["vs_baseline"] = 0.0
-        rec["note"] = "no TPU evidence this run (CPU fallback smoke)"
+        rec["note"] = ("no TPU evidence this run (CPU fallback smoke); "
+                       "last committed on-chip capture: "
+                       "BENCH_tpu_capture_r3.json (56.7% MFU, PERF.md "
+                       "round-3 capture log)")
     print(json.dumps(rec))
 
 

@@ -15,7 +15,8 @@ from ..nn import initializer as I
 from ..core.tensor import Parameter
 from ..ops._base import ensure_tensor
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+__all__ = ["fc", "conv2d", "batch_norm", "embedding",
+           "cond", "while_loop", "switch_case"]
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -82,3 +83,191 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     input = ensure_tensor(input)
     w = Parameter(I.XavierNormal()(tuple(size), convert_dtype(dtype)))
     return F.embedding(input, w, padding_idx=padding_idx)
+
+
+# ---------------------------------------------------------------------------
+# Control flow (reference: paddle.static.nn.cond/while_loop/switch_case).
+# TPU-native design: each construct is ONE recorded op whose fn runs the
+# matching lax primitive (cond/while_loop/switch). The user's branch/body
+# callables are Tensor-level closures over earlier program values; their
+# closed-over Tensors are collected as record INPUTS and substituted at
+# replay, so the branches re-execute against the replay's live values —
+# dynamic control flow survives into the jitted replay instead of being
+# frozen at record time.
+
+
+def _closure_tensors(fns):
+    from ..core.tensor import Tensor
+    seen = {}
+
+    def visit(v):
+        if isinstance(v, Tensor):
+            seen.setdefault(id(v), v)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                visit(x)
+
+    for f in fns:
+        if f is None:
+            continue
+        for cell in (getattr(f, "__closure__", None) or ()):
+            try:
+                visit(cell.cell_contents)
+            except ValueError:
+                pass  # empty cell
+    return list(seen.values())
+
+
+def _flatten_out(out):
+    from ..core.tensor import Tensor
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                 for o in outs)
+
+
+def _record_or_apply(fn, in_tensors, name):
+    """Recording path for control-flow constructs: capture shapes
+    ABSTRACTLY (eval_shape — traces, executes nothing) with the recorder
+    shielded, and append one record with placeholder outputs. Two
+    reasons apply() cannot be used here: (a) a while_loop executed
+    eagerly on the data() placeholders (zeros) can diverge; (b) lax
+    control flow traces its branches even eagerly, so the branches'
+    interior framework ops would be recorded as spurious program
+    entries. Outside recording, apply() runs the construct for real
+    (dygraph semantics, differentiable)."""
+    from ..core import autograd as _ag
+    from ..core.autograd import apply
+    from ..core.tensor import Tensor as _T
+    rec = _ag._STATIC_RECORDER
+    if rec is None:
+        return apply(fn, *in_tensors, name=name)
+    import jax
+    prev = _ag._set_static_recorder(None)
+    try:
+        outs_shape = jax.eval_shape(fn, *[t._data for t in in_tensors])
+    finally:
+        _ag._set_static_recorder(prev)
+    single = not isinstance(outs_shape, tuple)
+    outs = (outs_shape,) if single else outs_shape
+    out_tensors = [_T(jnp.zeros(s.shape, s.dtype)) for s in outs]
+    rec.record(fn, list(in_tensors), out_tensors, name=name)
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond: run `true_fn()` or `false_fn()` depending
+    on a (possibly feed-dependent) boolean Tensor. Branches must be
+    side-effect-free and return matching structures."""
+    import jax
+
+    pred = ensure_tensor(pred)
+    closed = _closure_tensors([true_fn, false_fn])
+
+    def fn(pred_a, *cls):
+        saved = [(t, t._data) for t in closed]
+        for t, a in zip(closed, cls):
+            t._data = a
+        try:
+            def run(f):
+                return lambda: _flatten_out(f() if f is not None else ())
+            p = jnp.squeeze(pred_a).astype(bool)
+            out = jax.lax.cond(p, run(true_fn), run(false_fn))
+            return out if len(out) != 1 else out[0]
+        finally:
+            for t, a in saved:
+                t._data = a
+
+    return _record_or_apply(fn, [pred] + closed, "static.nn.cond")
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop: iterate `body_fn(*vars)` while
+    `cond_fn(*vars)` holds — lowered to lax.while_loop, so the trip
+    count is runtime-dynamic in the replayed program. Carried values
+    must keep shapes/dtypes across iterations."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    loop_vars = [ensure_tensor(v) for v in loop_vars]
+    n = len(loop_vars)
+    closed = _closure_tensors([cond_fn, body_fn])
+
+    def fn(*args):
+        carry0 = tuple(args[:n])
+        cls = args[n:]
+        saved = [(t, t._data) for t in closed]
+        for t, a in zip(closed, cls):
+            t._data = a
+        try:
+            def c(carry):
+                r = cond_fn(*[Tensor(a) for a in carry])
+                r = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+                return jnp.squeeze(r).astype(bool)
+
+            def b(carry):
+                out = body_fn(*[Tensor(a) for a in carry])
+                flat = _flatten_out(out)
+                if len(flat) != n:
+                    raise ValueError(
+                        f"while_loop body returned {len(flat)} values "
+                        f"for {n} loop_vars")
+                return flat
+
+            out = jax.lax.while_loop(c, b, carry0)
+            return out if len(out) != 1 else out[0]
+        finally:
+            for t, a in saved:
+                t._data = a
+
+    out = _record_or_apply(fn, list(loop_vars) + closed,
+                           "static.nn.while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case over lax.switch."""
+    import jax
+
+    branch_index = ensure_tensor(branch_index)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+        keys = [k for k, _ in items]
+        fns = [f for _, f in items]
+    else:
+        fns = [f if not isinstance(f, (tuple, list)) else f[1]
+               for f in branch_fns]
+        keys = [i if not isinstance(f, (tuple, list)) else f[0]
+                for i, f in enumerate(branch_fns)]
+    if keys != list(range(len(keys))):
+        raise NotImplementedError(
+            f"switch_case requires dense 0..n-1 branch keys (got {keys})")
+    if default is not None:
+        fns = fns + [default]
+    closed = _closure_tensors(fns)
+
+    def fn(idx_a, *cls):
+        saved = [(t, t._data) for t in closed]
+        for t, a in zip(closed, cls):
+            t._data = a
+        try:
+            runs = [(lambda f=f: _flatten_out(f())) for f in fns]
+            raw = jnp.squeeze(idx_a).astype(jnp.int32)
+            if default is not None:
+                # out-of-range indices route to the default branch
+                # (appended last)
+                n_cases = len(fns) - 1
+                i = jnp.where((raw >= 0) & (raw < n_cases), raw, n_cases)
+            else:
+                i = jnp.clip(raw, 0, len(runs) - 1)
+            out = jax.lax.switch(i, runs)
+            return out if len(out) != 1 else out[0]
+        finally:
+            for t, a in saved:
+                t._data = a
+
+    return _record_or_apply(fn, [branch_index] + closed,
+                            "static.nn.switch_case")

@@ -310,3 +310,101 @@ class TestStaticTraining:
                                "y": np.zeros((4, 1), np.float32)},
                          fetch_list=[l2])
         assert not np.allclose(w_before, np.asarray(lin2.weight._data))
+
+    def test_static_amp_auto_cast_records_mixed_program(self):
+        """amp.auto_cast composes with program recording for free (ops
+        flow through the same apply chokepoint) — the reference's
+        paddle.static.amp tier."""
+        from paddle_tpu import amp
+        import paddle_tpu.optimizer as opt_mod
+        P.seed(7)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            lin = P.nn.Linear(8, 4)
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                y = lin(x)
+            assert "bfloat16" in str(y.dtype)
+            loss = (y.astype("float32") * y.astype("float32")).mean()
+            opt = opt_mod.SGD(0.1, parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        feed = {"x": np.ones((4, 8), np.float32)}
+        (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+        (l2,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert float(l2) < float(l1)
+
+
+class TestStaticControlFlow:
+    """static.nn.cond / while_loop / switch_case: ONE record wrapping the
+    lax primitive — control flow stays runtime-dynamic in the replayed
+    program (different feeds take different branches / trip counts)."""
+
+    def test_cond_dispatches_at_runtime(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            pred = x.sum() > 0
+            y = static.nn.cond(pred, lambda: x + 100.0, lambda: x - 100.0)
+        exe = static.Executor()
+        (a,) = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                       fetch_list=[y])
+        (b,) = exe.run(main, feed={"x": -np.ones(3, np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(a, [101, 101, 101])
+        np.testing.assert_allclose(b, [-101, -101, -101])
+
+    def test_while_loop_dynamic_trip_count(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [], "float32")
+            i = P.to_tensor(np.float32(0.0))
+            iv, xv = static.nn.while_loop(
+                lambda i_, x_: x_ < 100.0,
+                lambda i_, x_: (i_ + 1.0, x_ * 2.0),
+                [i, x])
+        exe = static.Executor()
+        (n1, v1) = exe.run(main, feed={"x": np.float32(1.0)},
+                           fetch_list=[iv, xv])
+        (n2, v2) = exe.run(main, feed={"x": np.float32(30.0)},
+                           fetch_list=[iv, xv])
+        assert float(n1) == 7.0 and float(v1) == 128.0
+        assert float(n2) == 2.0 and float(v2) == 120.0
+
+    def test_switch_case_with_default(self):
+        main = static.Program()
+        with static.program_guard(main):
+            idx = static.data("i", [], "int32")
+            x = static.data("x", [2], "float32")
+            y = static.nn.switch_case(
+                idx,
+                {0: lambda: x * 1.0, 1: lambda: x * 10.0},
+                default=lambda: x * 0.0)
+        exe = static.Executor()
+        feed = np.asarray([1.0, 2.0], np.float32)
+        (a,) = exe.run(main, feed={"i": np.int32(1), "x": feed},
+                       fetch_list=[y])
+        (b,) = exe.run(main, feed={"i": np.int32(7), "x": feed},
+                       fetch_list=[y])
+        np.testing.assert_allclose(a, [10, 20])
+        np.testing.assert_allclose(b, [0, 0])
+
+    def test_cond_differentiable_through_minimize(self):
+        import paddle_tpu.optimizer as opt_mod
+        P.seed(7)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            lin = P.nn.Linear(8, 1)
+            pred_v = lin(x)
+            gate = pred_v.mean() > -1000.0  # always true branch at run
+            out = static.nn.cond(gate, lambda: pred_v * 2.0,
+                                 lambda: pred_v)
+            loss = (out * out).mean()
+            opt = opt_mod.SGD(0.1, parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        w0 = np.asarray(lin.weight._data).copy()
+        exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                fetch_list=[loss])
+        assert not np.allclose(w0, np.asarray(lin.weight._data))
