@@ -1,7 +1,8 @@
 """paddle_tpu.models — LLM model families (reference ecosystem: PaddleNLP)."""
 from .bert import (BertConfig, BertForMaskedLM,  # noqa: F401
                    BertForSequenceClassification, BertModel)
-from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .gpt import (GPTConfig, GPTForCausalLM, GPTForCausalLMPipe,  # noqa: F401
+                  GPTModel)
 from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
                     LlamaForCausalLMPipe, LlamaModel,
                     LlamaPretrainingCriterion, count_params,

@@ -30,6 +30,7 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     tensor_parallel: bool = False
     recompute: bool = False
+    tie_word_embeddings: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -206,3 +207,90 @@ class GPTForCausalLM(Layer, GenerationMixin):
             else Tensor(input_ids)
         h, caches = self.gpt.forward_cached(ids, caches, offset)
         return self.lm_head(h)._data, caches
+
+
+# ---------------------------------------------------------------------------
+# Pipeline form (reference: PaddleNLP GPTForCausalLMPipe) — mirrors the
+# LLaMA pipe wiring in models/llama.py
+
+
+class _GPTPipeEmbed(Layer):
+    """Pipeline pre-section: token + learned position embedding."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        if cfg.tensor_parallel:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size,
+                                              cfg.hidden_size)
+        else:
+            self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = P.arange(s).unsqueeze(0)
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class _GPTPipeNorm(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_f = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+
+    def forward(self, x):
+        return self.ln_f(x)
+
+
+def _gpt_tied_head(owner, x):
+    """Tied LM head: contract against the shared wte weight (see the
+    LLaMA pipe's _tied_pipe_head for the gradient-accumulation story)."""
+    from ..ops.math import matmul
+    return matmul(x, owner.wte.weight, transpose_y=True)
+
+
+class _GPTPipeHead(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_f = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        if cfg.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=False)
+        else:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, x):
+        return self.lm_head(self.ln_f(x))
+
+
+def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None,
+                       num_virtual_pipeline_stages=1, loss_fn=None,
+                       **kwargs):
+    """GPT as a PipelineLayer; tie_word_embeddings shares wte with the
+    LM head across first/last stage via SharedLayerDesc (the GPT-2
+    idiom)."""
+    from ..distributed.fleet.pipeline import (LayerDesc, PipelineLayer,
+                                              SharedLayerDesc)
+    if cfg.tie_word_embeddings:
+        if cfg.tensor_parallel:
+            raise NotImplementedError(
+                "tie_word_embeddings with tensor_parallel is not "
+                "supported yet; untie or disable tensor_parallel")
+        pre = [SharedLayerDesc("wte", _GPTPipeEmbed, cfg)]
+        post = [_GPTPipeNorm(cfg),
+                SharedLayerDesc("wte", _GPTPipeEmbed, cfg,
+                                forward_func=_gpt_tied_head)]
+    else:
+        pre = [_GPTPipeEmbed(cfg)]
+        post = [_GPTPipeHead(cfg)]
+    if loss_fn is None:
+        from .llama import LlamaPretrainingCriterion
+        loss_fn = LlamaPretrainingCriterion(cfg)
+    return PipelineLayer(
+        layers=pre + [LayerDesc(GPTBlock, cfg)
+                      for _ in range(cfg.num_hidden_layers)] + post,
+        num_stages=num_stages,
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+        loss_fn=loss_fn, **kwargs)

@@ -402,10 +402,15 @@ class LlamaPretrainingCriterion(Layer):
                  lm_head_weight=None):
         super().__init__()
         self.ignore_index = ignore_index
-        self.parallel = cfg is not None and cfg.tensor_parallel
+        # getattr: the criterion is shared across model families whose
+        # configs may lack the llama-only fields (e.g. GPTConfig)
+        self.parallel = cfg is not None and getattr(
+            cfg, "tensor_parallel", False)
         self.vocab_size = cfg.vocab_size if cfg is not None else None
-        self.fuse = cfg is not None and cfg.fuse_linear_cross_entropy
-        self.chunk = cfg.loss_chunk_size if cfg is not None else 1024
+        self.fuse = cfg is not None and getattr(
+            cfg, "fuse_linear_cross_entropy", False)
+        self.chunk = getattr(cfg, "loss_chunk_size", 1024) \
+            if cfg is not None else 1024
         # plain object attr: Layer.__setattr__ would register the head
         # weight as this criterion's own parameter (double-counting it)
         object.__setattr__(self, "_head_w", lm_head_weight)

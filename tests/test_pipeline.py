@@ -556,3 +556,35 @@ class TestTiedLlamaPipe:
             ref.append(total)
         assert np.allclose(pp_losses, ref, rtol=5e-3, atol=5e-4), \
             (pp_losses, ref)
+
+
+class TestGPTPipe:
+    """GPT pipeline form with tied wte/head (the GPT-2 idiom) — second
+    model family through SharedLayerDesc."""
+
+    def test_tied_gpt_pipe_trains(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLMPipe
+        _reset_fleet()
+        P.seed(13)
+        cfg = GPTConfig.tiny(tie_word_embeddings=True,
+                             num_hidden_layers=4)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        pipe = GPTForCausalLMPipe(cfg, num_stages=4)
+        names = [n for n, _ in pipe.named_parameters()]
+        assert sum(n.endswith("wte.weight") for n in names) == 1, names
+        assert not any("lm_head" in n for n in names), names
+        opt = P.optimizer.SGD(0.05, parameters=pipe.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        model = fleet.distributed_model(pipe)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        losses = []
+        for _ in range(3):
+            loss = model.train_batch(
+                (P.to_tensor(ids), P.to_tensor(ids)), opt)
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
