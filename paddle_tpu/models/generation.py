@@ -88,13 +88,27 @@ class GenerationMixin:
     @no_grad()
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=None):
-        """Returns generated token ids [B, max_new_tokens]."""
+                 seed=None, num_beams=1, length_penalty=0.0):
+        """Returns generated token ids [B, max_new_tokens].
+
+        num_beams > 1 runs beam search (do_sample must be False): beams
+        ride the batch dim of the SAME static-cache decode loop, with
+        per-step cache/beam reordering via a batched gather — one jitted
+        program like the sampling path. length_penalty applies the GNMT
+        ((5+len)/6)**p normalization at final beam selection."""
         ids = input_ids._data if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
+        if int(num_beams) > 1:
+            if do_sample:
+                raise NotImplementedError(
+                    "beam sampling is not supported: use num_beams>1 "
+                    "with do_sample=False, or sampling with num_beams=1")
+            return self._beam_generate(ids, int(max_new_tokens),
+                                       int(num_beams), eos,
+                                       float(length_penalty))
         # weights/buffers enter the compiled program as ARGUMENTS, not
         # jit-captured constants (round 3): baked constants made the
         # serialized program O(model size) — a 0.5B model's decode
@@ -131,6 +145,29 @@ class GenerationMixin:
             if was_training:
                 self.train()
 
+    def _beam_generate(self, ids, max_new, K, eos, lenpen):
+        b, s = ids.shape
+        warrs = [t._data for t in self._gen_state_tensors()]
+        maxpos = self._max_positions()
+        if maxpos is not None and s + max_new > maxpos:
+            raise ValueError(
+                f"generate: prompt_len({s}) + max_new_tokens({max_new}) "
+                f"exceeds max_position_embeddings({maxpos})")
+        sig = (b, s, max_new, "beam", K, eos, lenpen)
+        fn = self._gen_program(sig)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _beam_pure, self, s, max_new, K, eos, lenpen))
+            self._gen_cache[sig] = fn
+        was_training = getattr(self, "training", False)
+        if was_training:
+            self.eval()
+        try:
+            return Tensor(fn(warrs, ids))
+        finally:
+            if was_training:
+                self.train()
+
     def _gen_state_tensors(self):
         """Parameters + buffers, in a deterministic order, passed as the
         compiled generate program's weight arguments."""
@@ -156,6 +193,72 @@ def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
         thresh = jnp.take_along_axis(srt, cut, axis=-1)
         lg = jnp.where(lg < thresh, -jnp.inf, lg)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def _beam_pure(model, prompt_len, max_new, K, eos, lenpen, warrs, ids):
+    tensors = model._gen_state_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, warrs):
+        t._data = arr
+    try:
+        return _beam_body(model, prompt_len, max_new, K, eos, lenpen,
+                          ids)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _beam_body(model, prompt_len, max_new, K, eos, lenpen, ids):
+    b = ids.shape[0]
+    total = prompt_len + max_new
+    # prefill at batch B, then expand caches to B·K beams (row order
+    # [b0 beams..., b1 beams...] — matches the gather below)
+    caches = model._init_caches(b, total)
+    logits, caches = model._forward_cached(ids, caches, 0)
+    lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    scores, tok0 = jax.lax.top_k(lp, K)              # [B, K]
+    caches = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0), caches)
+    tok0 = tok0.astype(jnp.int32)
+    toks_buf = jnp.zeros((b, K, max_new), jnp.int32)
+    toks_buf = toks_buf.at[:, :, 0].set(tok0)
+    finished = tok0 == eos                           # [B, K]
+    lengths = jnp.ones((b, K), jnp.float32)
+    eos_idx = max(eos, 0)
+    V = lp.shape[-1]
+    eos_row = jnp.full((V,), -jnp.inf).at[eos_idx].set(0.0)
+
+    def step(carry, i):
+        caches, tok, scores, toks_buf, finished, lengths = carry
+        logits, caches = model._forward_cached(
+            tok.reshape(b * K)[:, None], caches, prompt_len + i)
+        lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                                axis=-1).reshape(b, K, V)
+        # finished beams only continue with eos at zero cost, so their
+        # cumulative score is frozen and they stay comparable
+        lp = jnp.where(finished[:, :, None], eos_row[None, None, :], lp)
+        flat = (scores[:, :, None] + lp).reshape(b, K * V)
+        scores2, idx = jax.lax.top_k(flat, K)        # [B, K]
+        beam = idx // V
+        tokn = (idx % V).astype(jnp.int32)
+        rows = (jnp.arange(b)[:, None] * K + beam).reshape(-1)
+        caches = jax.tree.map(lambda a: a[rows], caches)
+        toks_buf = jnp.take_along_axis(toks_buf, beam[:, :, None],
+                                       axis=1)
+        toks_buf = toks_buf.at[:, :, i + 1].set(tokn)
+        fin = jnp.take_along_axis(finished, beam, axis=1)
+        lengths2 = jnp.take_along_axis(lengths, beam, axis=1) + \
+            jnp.where(fin, 0.0, 1.0)
+        fin = fin | (tokn == eos)
+        return (caches, tokn, scores2, toks_buf, fin, lengths2), None
+
+    carry = (caches, tok0, scores, toks_buf, finished, lengths)
+    (caches, tok, scores, toks_buf, finished, lengths), _ = jax.lax.scan(
+        step, carry, jnp.arange(max_new - 1, dtype=jnp.int32))
+    if lenpen:
+        scores = scores / (((5.0 + lengths) / 6.0) ** lenpen)
+    best = jnp.argmax(scores, axis=1)
+    return jnp.take_along_axis(
+        toks_buf, best[:, None, None], axis=1)[:, 0]
 
 
 def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
