@@ -1,3 +1,42 @@
 """fleet.utils namespace (recompute + sequence-parallel re-exports)."""
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel as sequence_parallel_utils  # noqa: F401
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Reference: fleet.utils.hybrid_parallel_util
+    fused_allreduce_gradients — sum-allreduce every parameter's .grad
+    over the data-parallel group (the manual grad-sync step of custom
+    hybrid training loops, e.g. under no_sync accumulation).
+
+    TPU-native: one eager allreduce per grad through the collective API
+    (lowers to a single fused XLA computation per call; inside compiled
+    steppers grad sync is structural and this helper is a no-op there —
+    call it only from eager custom loops)."""
+    from ..collective import _group, _multiproc, _traced_axis, all_reduce
+    from .topology import get_hybrid_communicate_group
+
+    if hcg is None:
+        hcg = get_hybrid_communicate_group()
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    if group is not None and getattr(group, "nranks", 1) <= 1:
+        return
+    gobj = _group(group)
+    # mean semantics (the DDP contract) apply only in regimes where the
+    # allreduce actually aggregates distinct per-rank grads; in the
+    # single-controller eager-SPMD view the value is already the global
+    # mean and all_reduce is identity — dividing there would corrupt
+    aggregated = _traced_axis(gobj) is not None or _multiproc(gobj)
+    n = gobj.nranks if gobj is not None else 1
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        all_reduce(g, group=group)
+        if aggregated and n > 1:
+            g._inplace_update(g._data / n)
+
+
+# reference import path parity
+class hybrid_parallel_util:  # noqa: N801 — module-as-class shim
+    fused_allreduce_gradients = staticmethod(fused_allreduce_gradients)

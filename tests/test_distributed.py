@@ -468,3 +468,33 @@ class TestTPZeroComposition:
             losses.append(float(loss.numpy()))
         assert np.allclose(losses, ref, rtol=2e-3, atol=2e-4), \
             (losses, ref)
+
+
+class TestFusedAllreduceGradients:
+    def test_identity_in_single_controller_regime(self):
+        """fleet.utils.fused_allreduce_gradients: in the eager-SPMD view
+        grads are already global — the helper must not rescale them."""
+        from paddle_tpu.distributed.fleet.utils import \
+            fused_allreduce_gradients
+        _reset_fleet()
+        P.seed(5)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        lin = P.nn.Linear(4, 2)
+        x = P.to_tensor(np.ones((2, 4), np.float32))
+        loss = (lin(x) * lin(x)).mean()
+        loss.backward()
+        g0 = lin.weight.grad.numpy().copy()
+        fused_allreduce_gradients(list(lin.parameters()))
+        np.testing.assert_allclose(g0, lin.weight.grad.numpy())
+
+    def test_skips_params_without_grad(self):
+        from paddle_tpu.distributed.fleet.utils import \
+            fused_allreduce_gradients
+        _reset_fleet()
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        lin = P.nn.Linear(4, 2)
+        fused_allreduce_gradients(list(lin.parameters()))  # no grads: noop
