@@ -45,15 +45,86 @@ from ..core.device import device_guard  # noqa: E402,F401
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
+                         program=None, **kwargs):
+    """Export an inference artifact loadable by `load_inference_model` /
+    `jit.load` / the C++ `pd_infer` runtime.
+
+    Two paths (reference: paddle.static.save_inference_model):
+    - `layer=<nn.Layer>`: delegates to jit.save (trace-based export);
+    - a recorded PROGRAM (default main or `program=`): the op records
+      reaching `fetch_vars` are pruned (training records excluded) and
+      exported as StableHLO with the leaf constants/parameters saved by
+      name — the reference's Program→inference-model path."""
     layer = kwargs.get("layer")
-    if layer is None:
+    if layer is not None:
+        specs = feed_vars if feed_vars else None
+        _jit_save(layer, path_prefix, input_spec=specs)
+        return
+    import json
+    import os
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from .program import Program, default_main_program
+    prog = program if isinstance(program, Program) \
+        else default_main_program()
+    if not prog._records:
         raise ValueError(
-            "TPU-native save_inference_model exports a Layer: pass "
-            "layer=<nn.Layer> (the reference Program path does not exist "
-            "here); or use paddle_tpu.jit.save directly.")
-    specs = feed_vars if feed_vars else None
-    _jit_save(layer, path_prefix, input_spec=specs)
+            "save_inference_model: the Program has no recorded ops; "
+            "build it under program_guard (or pass layer=<nn.Layer>)")
+    feed_vars = list(feed_vars or [])
+    fetch_vars = list(fetch_vars or [])
+    if not feed_vars or not fetch_vars:
+        raise ValueError("save_inference_model needs feed_vars and "
+                         "fetch_vars from the recorded Program")
+    fetch_keys = [id(t) for t in fetch_vars]
+    feed_keys = [id(t) for t in feed_vars]
+    # prune to forward records reaching the fetches (no training records,
+    # no writebacks — an inference snapshot)
+    need = set(fetch_keys)
+    active = []
+    for rec in reversed([r for r in prog._records if r.kind == "op"]):
+        if any(k in need for k in rec.out_keys):
+            active.append(rec)
+            need.update(rec.in_keys)
+    active.reverse()
+    leaf_keys = [k for k in prog._leaves if k in need]
+    leaf_arrays = [prog._leaves[k]._data for k in leaf_keys]
+    names = [f"leaf_{i}" for i in range(len(leaf_keys))]
+
+    def pure(params, buffers, *feeds):
+        env = dict(zip(leaf_keys, params))
+        env.update(zip(feed_keys, feeds))
+        for rec in active:
+            args = [env[k] for k in rec.in_keys]
+            out = rec.fn(*args)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            env.update(zip(rec.out_keys, outs))
+        return tuple(env[k] for k in fetch_keys)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    np.savez(path_prefix + ".pdiparams.npz",
+             **{n: np.asarray(a) for n, a in zip(names, leaf_arrays)})
+    meta = {"type": "program", "params": names, "buffers": [],
+            "fetches": len(fetch_keys)}
+    specs = [jax.ShapeDtypeStruct(tuple(t._data.shape),
+                                  jnp.dtype(t._data.dtype))
+             for t in feed_vars]
+    try:
+        exported = jax.export.export(jax.jit(pure))(
+            [jax.ShapeDtypeStruct(a.shape, a.dtype)
+             for a in leaf_arrays], [], *specs)
+        with open(path_prefix + ".stablehlo", "wb") as f:
+            f.write(exported.serialize())
+        meta["stablehlo"] = True
+    except Exception as e:
+        meta["stablehlo"] = False
+        meta["export_error"] = str(e)[:500]
+    with open(path_prefix + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

@@ -408,3 +408,44 @@ class TestStaticControlFlow:
         exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
                 fetch_list=[loss])
         assert not np.allclose(w0, np.asarray(lin.weight._data))
+
+
+class TestSaveInferenceProgram:
+    """save_inference_model on a RECORDED Program (no layer=): pruned
+    forward export → StableHLO, loadable by load_inference_model."""
+
+    def test_program_roundtrip(self, tmp_path):
+        import paddle_tpu.optimizer as opt_mod
+        P.seed(7)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 1], "float32")
+            lin = P.nn.Linear(8, 1)
+            pred = lin(x)
+            loss = ((pred - y) * (pred - y)).mean()
+            opt = opt_mod.SGD(0.1, parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        # train a step so the exported weights are the TRAINED ones
+        exe.run(main, feed={"x": np.ones((4, 8), np.float32),
+                            "y": np.zeros((4, 1), np.float32)},
+                fetch_list=[loss])
+        path = str(tmp_path / "prog")
+        static.save_inference_model(path, [x], [pred], exe, program=main)
+        loaded = static.load_inference_model(path)
+        feed = np.random.default_rng(0).standard_normal(
+            (4, 8)).astype(np.float32)
+        got = loaded(P.to_tensor(feed)).numpy()
+        ref = feed @ np.asarray(lin.weight._data) + np.asarray(
+            lin.bias._data)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_program_without_ops_raises(self, tmp_path):
+        main = static.Program()
+        try:
+            static.save_inference_model(str(tmp_path / "e"), [], [],
+                                        program=main)
+            assert False
+        except ValueError as e:
+            assert "no recorded ops" in str(e)
