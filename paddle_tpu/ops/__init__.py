@@ -9,7 +9,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, extras, indexing, linalg, logic, manipulation, math, random
+from . import (creation, extras, extras2, indexing, linalg, logic,
+               manipulation, math, random)
 from .creation import *  # noqa: F401,F403
 from .linalg import (cholesky, cholesky_solve, corrcoef, cov, cross, cdist,
                      det, dist, eig, eigh, eigvals, eigvalsh,
@@ -18,6 +19,7 @@ from .linalg import (cholesky, cholesky_solve, corrcoef, cov, cross, cdist,
                      pinv, qr, slogdet, solve, svd, svdvals, trace,
                      triangular_solve, vector_norm)
 from .extras import *  # noqa: F401,F403
+from .extras2 import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

@@ -1,0 +1,239 @@
+"""Long-tail op surface, sweep 3 (reference: python/paddle/tensor/
+{math,manipulation,creation}.py — unverified, SURVEY.md §2.2 "Tensor
+ops"). Everything lowers to one jax expression through `apply`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import apply
+from ..core.tensor import Tensor
+from ._base import ensure_tensor
+
+__all__ = ["cumulative_trapezoid", "as_strided", "pdist", "histogramdd",
+           "select_scatter", "slice_scatter", "diagonal_scatter",
+           "block_diag", "hsplit", "vsplit", "dsplit", "tensor_split",
+           "column_stack", "row_stack", "positive"]
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        x = ensure_tensor(x)
+
+        def f(ya, xa):
+            d = jnp.diff(xa, axis=axis)
+            avg = (_slice_axis(ya, axis, 1, None) +
+                   _slice_axis(ya, axis, 0, -1)) * 0.5
+            return jnp.cumsum(d * avg, axis=axis)
+        return apply(f, y, x, name="cumulative_trapezoid")
+    step = 1.0 if dx is None else float(dx)
+
+    def f(ya):
+        avg = (_slice_axis(ya, axis, 1, None) +
+               _slice_axis(ya, axis, 0, -1)) * 0.5
+        return jnp.cumsum(step * avg, axis=axis)
+    return apply(f, y, name="cumulative_trapezoid")
+
+
+def _slice_axis(a, axis, start, stop):
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(start, stop)
+    return a[tuple(idx)]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View with explicit strides (reference semantics over a flat
+    buffer). XLA has no aliasing views — this materializes the gather,
+    which is the correct dataflow translation."""
+    x = ensure_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(int(offset))
+        for dim, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(dim) * st
+        return flat[idx.reshape(shape)]
+    return apply(f, x, name="as_strided")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] rows (upper triangle)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        n = a.shape[0]
+        d = jnp.linalg.norm(a[:, None, :] - a[None, :, :], ord=p, axis=-1)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return d[iu, ju]
+    return apply(f, x, name="pdist")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """D-dimensional histogram of [N, D] samples (reference:
+    paddle.histogramdd). Returns (hist, edges_list)."""
+    x = ensure_tensor(x)
+    xa = x._data
+    n, d = xa.shape
+    if isinstance(bins, int):
+        bins = [bins] * d
+    w = ensure_tensor(weights)._data if weights is not None else None
+    edges = []
+    for i in range(d):
+        if ranges is not None:
+            lo, hi = float(ranges[2 * i]), float(ranges[2 * i + 1])
+        else:
+            lo = float(jnp.min(xa[:, i]))
+            hi = float(jnp.max(xa[:, i]))
+        edges.append(jnp.linspace(lo, hi, int(bins[i]) + 1))
+    idx = []
+    for i in range(d):
+        e = edges[i]
+        j = jnp.clip(jnp.searchsorted(e, xa[:, i], side="right") - 1,
+                     0, int(bins[i]) - 1)
+        inside = (xa[:, i] >= e[0]) & (xa[:, i] <= e[-1])
+        idx.append((j, inside))
+    flat = jnp.zeros((), jnp.int32)
+    ok = jnp.ones((n,), bool)
+    for (j, inside), b in zip(idx, bins):
+        flat = flat * int(b) + j
+        ok = ok & inside
+    size = 1
+    for b in bins:
+        size *= int(b)
+    vals = w if w is not None else jnp.ones((n,), jnp.float32)
+    hist = jnp.zeros((size,), jnp.float32).at[flat].add(
+        jnp.where(ok, vals.astype(jnp.float32), 0.0))
+    hist = hist.reshape(tuple(int(b) for b in bins))
+    if density:
+        widths = [e[1:] - e[:-1] for e in edges]
+        vol = widths[0]
+        for wd in widths[1:]:
+            vol = vol[..., None] * wd
+        total = jnp.sum(hist)
+        hist = hist / jnp.maximum(total, 1.0) / vol
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, values = ensure_tensor(x), ensure_tensor(values)
+
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return apply(f, x, values, name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, value = ensure_tensor(x), ensure_tensor(value)
+
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return apply(f, x, value, name="slice_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, v):
+        # move the two axes last, scatter into the diagonal, move back
+        a2 = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        n, m = a2.shape[-2], a2.shape[-1]
+        if offset >= 0:
+            r = jnp.arange(min(n, m - offset))
+            c = r + offset
+        else:
+            c = jnp.arange(min(m, n + offset))
+            r = c - offset
+        v2 = jnp.moveaxis(v, -1, -1)  # diag values on the last dim
+        a2 = a2.at[..., r, c].set(v2.astype(a.dtype))
+        return jnp.moveaxis(a2, (-2, -1), (axis1, axis2))
+    return apply(f, x, y, name="diagonal_scatter")
+
+
+def block_diag(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def f(*arrs):
+        arrs = [a[None, :] if a.ndim == 1 else a for a in arrs]
+        rows = sum(a.shape[0] for a in arrs)
+        cols = sum(a.shape[1] for a in arrs)
+        out = jnp.zeros((rows, cols), arrs[0].dtype)
+        r = c = 0
+        for a in arrs:
+            out = out.at[r:r + a.shape[0], c:c + a.shape[1]].set(
+                a.astype(out.dtype))
+            r += a.shape[0]
+            c += a.shape[1]
+        return out
+    return apply(f, *ts, name="block_diag")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Like split but allows uneven sections (reference
+    paddle.tensor_split / numpy.array_split semantics)."""
+    x = ensure_tensor(x)
+    a = x._data
+    n = a.shape[axis]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, rem = divmod(n, k)
+        sizes = [base + (1 if i < rem else 0) for i in range(k)]
+        bounds = []
+        acc = 0
+        for s in sizes[:-1]:
+            acc += s
+            bounds.append(acc)
+    else:
+        bounds = [int(i) for i in num_or_indices]
+    outs = []
+    prev = 0
+    for b in bounds + [n]:
+        outs.append(apply(
+            lambda arr, s=prev, e=b: _slice_axis(arr, axis, s, e), x,
+            name="tensor_split"))
+        prev = b
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def column_stack(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+
+    def f(*arrs):
+        arrs = [a[:, None] if a.ndim == 1 else a for a in arrs]
+        return jnp.concatenate(arrs, axis=1)
+    return apply(f, *ts, name="column_stack")
+
+
+def row_stack(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+
+    def f(*arrs):
+        arrs = [a[None, :] if a.ndim == 1 else a for a in arrs]
+        return jnp.concatenate(arrs, axis=0)
+    return apply(f, *ts, name="row_stack")
+
+
+def positive(x, name=None):
+    return apply(lambda a: +a, ensure_tensor(x), name="positive")

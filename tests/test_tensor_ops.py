@@ -385,3 +385,96 @@ class TestExtrasOps:
         v = np.asarray(P.vander(P.to_tensor(
             np.asarray([1.0, 2.0], np.float32)))._data)
         np.testing.assert_allclose(v, np.vander([1.0, 2.0]))
+
+
+class TestExtras2Sweep:
+    """Sweep-3 ops vs numpy/torch oracles (SURVEY.md §4 methodology)."""
+
+    def test_cumulative_trapezoid(self):
+        y = np.asarray([1.0, 2.0, 4.0, 8.0], np.float32)
+        got = P.cumulative_trapezoid(P.to_tensor(y), dx=0.5).numpy()
+        ref = np.asarray([0.75, 2.25, 5.25], np.float32)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_as_strided_matches_numpy(self):
+        x = np.arange(12, dtype=np.float32)
+        got = P.as_strided(P.to_tensor(x), [3, 4], [4, 1]).numpy()
+        np.testing.assert_array_equal(got, x.reshape(3, 4))
+        # overlapping windows
+        got2 = P.as_strided(P.to_tensor(x), [5, 3], [2, 1]).numpy()
+        ref2 = np.lib.stride_tricks.as_strided(
+            x, (5, 3), (2 * 4, 4)).copy()
+        np.testing.assert_array_equal(got2, ref2)
+
+    def test_pdist(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, 3)).astype(np.float32)
+        got = P.pdist(P.to_tensor(x)).numpy()
+        ref = []
+        for i in range(5):
+            for j in range(i + 1, 5):
+                ref.append(np.linalg.norm(x[i] - x[j]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_histogramdd(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0, 1, (100, 2)).astype(np.float32)
+        hist, edges = P.histogramdd(P.to_tensor(x), bins=[4, 5],
+                                    ranges=[0.0, 1.0, 0.0, 1.0])
+        ref, re1, re2 = np.histogram2d(x[:, 0], x[:, 1], bins=[4, 5],
+                                       range=[[0, 1], [0, 1]])
+        np.testing.assert_allclose(hist.numpy(), ref)
+        np.testing.assert_allclose(edges[0].numpy(), re1, rtol=1e-6)
+
+    def test_scatter_family(self):
+        x = np.zeros((3, 4), np.float32)
+        v = np.ones((4,), np.float32)
+        got = P.select_scatter(P.to_tensor(x), P.to_tensor(v), 0,
+                               1).numpy()
+        assert got[1].sum() == 4 and got[0].sum() == 0
+        g2 = P.slice_scatter(P.to_tensor(x),
+                             P.to_tensor(np.ones((3, 2), np.float32)),
+                             axes=[1], starts=[1], ends=[3],
+                             strides=[1]).numpy()
+        np.testing.assert_array_equal(g2[:, 1:3], np.ones((3, 2)))
+        assert g2[:, 0].sum() == 0
+        m = np.zeros((3, 3), np.float32)
+        g3 = P.diagonal_scatter(P.to_tensor(m),
+                                P.to_tensor(np.asarray([1., 2., 3.],
+                                                       np.float32))).numpy()
+        np.testing.assert_array_equal(np.diag(g3), [1, 2, 3])
+
+    def test_block_diag_and_stacks(self):
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((1, 3), np.float32)
+        got = P.block_diag([P.to_tensor(a), P.to_tensor(b)]).numpy()
+        assert got.shape == (3, 5)
+        assert got[:2, :2].sum() == 4 and got[2, 2:].sum() == 6
+        c1 = P.column_stack([P.to_tensor(np.asarray([1., 2.], np.float32)),
+                             P.to_tensor(np.asarray([3., 4.], np.float32))])
+        np.testing.assert_array_equal(c1.numpy(), [[1, 3], [2, 4]])
+        r1 = P.row_stack([P.to_tensor(np.asarray([1., 2.], np.float32)),
+                          P.to_tensor(np.asarray([3., 4.], np.float32))])
+        np.testing.assert_array_equal(r1.numpy(), [[1, 2], [3, 4]])
+
+    def test_split_family(self):
+        x = np.arange(10, dtype=np.float32)
+        parts = P.tensor_split(P.to_tensor(x), 3)
+        assert [p.numpy().shape[0] for p in parts] == [4, 3, 3]
+        np.testing.assert_array_equal(
+            np.concatenate([p.numpy() for p in parts]), x)
+        m = np.arange(12, dtype=np.float32).reshape(2, 6)
+        hs = P.hsplit(P.to_tensor(m), 3)
+        assert all(h.numpy().shape == (2, 2) for h in hs)
+        vs = P.vsplit(P.to_tensor(m), 2)
+        assert all(v.numpy().shape == (1, 6) for v in vs)
+        d = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        ds = P.dsplit(P.to_tensor(d), 2)
+        assert all(t.numpy().shape == (2, 3, 2) for t in ds)
+
+    def test_positive_and_grad_through_sweep(self):
+        x = P.to_tensor(np.asarray([1.0, -2.0], np.float32),
+                        stop_gradient=False)
+        y = P.positive(x * 2.0)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
