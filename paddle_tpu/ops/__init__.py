@@ -38,7 +38,8 @@ def _method(fn):
 
 
 _METHOD_TABLE = {}
-for _mod in (math, manipulation, logic, linalg, creation):
+for _mod in (math, manipulation, logic, linalg, creation, extras,
+             extras2):
     for _name in dir(_mod):
         if _name.startswith("_"):
             continue
@@ -54,7 +55,10 @@ for _bad in ("zeros", "ones", "full", "empty", "arange", "linspace",
              "scatter_nd", "broadcast_shape", "ensure_tensor", "to_tensor",
              "apply", "unary_op", "binary_op", "amp_autocast", "Tensor",
              "Parameter", "is_tensor", "getitem", "setitem",
-             "inplace_rebind"):
+             "inplace_rebind",
+             # list-taking ops cannot be methods
+             "cat", "block_diag", "column_stack", "row_stack",
+             "histogramdd"):
     _METHOD_TABLE.pop(_bad, None)
 _METHOD_TABLE = {k: v for k, v in _METHOD_TABLE.items()
                  if not isinstance(v, type)}
