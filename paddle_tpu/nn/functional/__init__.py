@@ -364,8 +364,53 @@ def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode, mean_div,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool2d_with_mask(x, kernel_size, stride, padding,
+                                     ceil_mode)
     return _pool2d(x, kernel_size, stride, padding, jax.lax.max,
                    -jnp.inf, ceil_mode, False, "max_pool2d")
+
+
+def _max_pool2d_with_mask(x, kernel_size, stride, padding, ceil_mode):
+    """(out, indices) — indices are flat per-channel H·W argmax positions
+    (the reference's max_unpool convention). Patch extraction is a pair
+    of static gathers; use the maskless path when indices aren't needed
+    (it lowers to reduce_window)."""
+    if ceil_mode:
+        raise NotImplementedError("max_pool2d(return_mask=True) with "
+                                  "ceil_mode is not supported")
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            f"max_pool2d(return_mask=True) with padding={padding!r}; "
+            "use integer padding on the mask path")
+    x = ensure_tensor(x)
+    t2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = t2(kernel_size)
+    sh, sw = t2(stride if stride is not None else kernel_size)
+    ph, pw = t2(padding)
+
+    def f(a):
+        N, C, H, W = a.shape
+        ap = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                     constant_values=-jnp.inf)
+        oh = (H + 2 * ph - kh) // sh + 1
+        ow = (W + 2 * pw - kw) // sw + 1
+        hidx = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
+        widx = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]
+        p1 = ap[:, :, hidx, :]                 # [N, C, OH, kh, Wp]
+        p2 = p1[:, :, :, :, widx]              # [N, C, OH, kh, OW, kw]
+        patches = p2.transpose(0, 1, 2, 4, 3, 5).reshape(
+            N, C, oh, ow, kh * kw)
+        out = jnp.max(patches, axis=-1)
+        am = jnp.argmax(patches, axis=-1)
+        r, c = am // kw, am % kw
+        habs = jnp.arange(oh)[None, None, :, None] * sh + r - ph
+        wabs = jnp.arange(ow)[None, None, None, :] * sw + c - pw
+        flat = (habs * W + wabs).astype(jnp.int32)
+        return out, flat
+
+    out, mask = apply(f, x, name="max_pool2d_mask")
+    return out, mask.detach()
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -378,6 +423,13 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     x = ensure_tensor(x)
+    if return_mask:
+        # W=1 window: the 2d flat H·W index IS the sequence position
+        out, mask = max_pool2d(
+            x.unsqueeze(-1), (kernel_size, 1), (stride or kernel_size, 1),
+            (padding, 0) if isinstance(padding, int) else padding,
+            ceil_mode=ceil_mode, return_mask=True)
+        return out.squeeze(-1), mask.squeeze(-1)
     out = max_pool2d(x.unsqueeze(-1), (kernel_size, 1),
                      (stride or kernel_size, 1),
                      (padding, 0) if isinstance(padding, int) else padding)
@@ -411,6 +463,10 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d(return_mask=True) is not supported; "
+            "use max_pool2d(return_mask=True) for unpooling indices")
     x = ensure_tensor(x)
     os = (output_size, output_size) if isinstance(output_size, int) \
         else tuple(output_size)
@@ -939,3 +995,4 @@ def pad(x, pad_, mode="constant", value=0.0, data_format="NCHW", name=None):
     return _pad(x, pad_, mode=mode, value=value, data_format=data_format)
 
 from .extended import *  # noqa: E402,F401,F403
+from .extended2 import *  # noqa: E402,F401,F403

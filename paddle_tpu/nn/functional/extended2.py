@@ -1,0 +1,279 @@
+"""Functional surface, sweep 3 (reference: python/paddle/nn/functional/
+{common,pooling,vision,loss}.py — unverified; SURVEY.md §2.2 paddle.nn).
+
+Loss functionals delegate to the existing Layer implementations (one
+source of truth for the math); structural ops lower to one jax
+expression each.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.autograd import apply
+from ...ops._base import ensure_tensor
+
+__all__ = ["fold", "channel_shuffle", "affine_grid", "max_unpool1d",
+           "max_unpool3d", "adaptive_max_pool3d", "lp_pool1d",
+           "lp_pool2d", "npair_loss", "soft_margin_loss",
+           "triplet_margin_with_distance_loss",
+           "multi_label_soft_margin_loss", "gaussian_nll_loss",
+           "poisson_nll_loss", "cosine_embedding_loss"]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    from ..extended_layers2 import Fold
+    return Fold(output_sizes, kernel_sizes, strides, paddings,
+                dilations)(ensure_tensor(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                    .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+                .swapaxes(3, 4).reshape(n, h, w, c)
+    return apply(f, x, name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid for spatial transformers (reference:
+    paddle.nn.functional.affine_grid). theta [N,2,3] → grid [N,H,W,2]
+    (x,y in [-1,1], x ↔ width); theta [N,3,4] → [N,D,H,W,3]."""
+    theta = ensure_tensor(theta)
+    dims = [int(d) for d in out_shape]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return -1.0 + step / 2 + step * jnp.arange(n)
+
+    if len(dims) == 4:
+        _, _, H, W = dims
+
+        def f(th):
+            xs = axis_coords(W)
+            ys = axis_coords(H)
+            gx, gy = jnp.meshgrid(xs, ys)            # [H, W]
+            base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H,W,3]
+            return jnp.einsum("nij,hwj->nhwi", th, base)
+        return apply(f, theta, name="affine_grid")
+    _, _, D, H, W = dims
+
+    def f3(th):
+        xs = axis_coords(W)
+        ys = axis_coords(H)
+        zs = axis_coords(D)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], -1)
+        return jnp.einsum("nij,dhwj->ndhwi", th, base)
+    return apply(f3, theta, name="affine_grid")
+
+
+def _unpool_nd(x, indices, spatial, out_spatial, name):
+    """Shared scatter for max_unpoolNd: flat per-channel indices."""
+    x = ensure_tensor(x)
+    idx = ensure_tensor(indices)
+
+    def f(a, i):
+        lead = a.shape[:2]
+        size = 1
+        for s in out_spatial:
+            size *= s
+        flat = jnp.zeros(lead + (size,), a.dtype)
+        ii = i.reshape(lead + (-1,)).astype(jnp.int32)
+        vv = a.reshape(lead + (-1,))
+        flat = jax.vmap(jax.vmap(
+            lambda fz, jj, vz: fz.at[jj].set(vz)))(flat, ii, vv)
+        return flat.reshape(lead + tuple(out_spatial))
+    return apply(f, x, idx.detach(), name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    x = ensure_tensor(x)
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = ks if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    L = x.shape[-1]
+    ol = output_size[-1] if output_size is not None else \
+        (L - 1) * st + ks - 2 * padding
+    return _unpool_nd(x, indices, (L,), (ol,), "max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    x = ensure_tensor(x)
+    t3 = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+    ks = t3(kernel_size)
+    st = ks if stride is None else t3(stride)
+    pd = t3(padding) if not isinstance(padding, int) else (padding,) * 3
+    D, H, W = x.shape[-3:]
+    if output_size is not None:
+        out = tuple(output_size[-3:])
+    else:
+        out = tuple((n - 1) * s + k - 2 * p for n, s, k, p in
+                    zip((D, H, W), st, ks, pd))
+    return _unpool_nd(x, indices, (D, H, W), out, "max_unpool3d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    x = ensure_tensor(x)
+    t3 = lambda v: (v, v, v) if isinstance(v, int) else tuple(v)
+    od, oh, ow = t3(output_size)
+
+    def f(a):
+        d, h, w = a.shape[-3:]
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            a2 = a.reshape(a.shape[:-3] + (od, d // od, oh, h // oh,
+                                           ow, w // ow))
+            return jnp.max(a2, axis=(-5, -3, -1))
+        # exact bins, static unrolled loop over output cells
+        import numpy as _np
+        ds = _np.floor(_np.arange(od) * d / od).astype(int)
+        de = _np.ceil((_np.arange(od) + 1) * d / od).astype(int)
+        hs = _np.floor(_np.arange(oh) * h / oh).astype(int)
+        he = _np.ceil((_np.arange(oh) + 1) * h / oh).astype(int)
+        ws = _np.floor(_np.arange(ow) * w / ow).astype(int)
+        we = _np.ceil((_np.arange(ow) + 1) * w / ow).astype(int)
+        rows = []
+        for i in range(od):
+            cols = []
+            for j in range(oh):
+                cells = []
+                for k in range(ow):
+                    cells.append(jnp.max(
+                        a[..., ds[i]:de[i], hs[j]:he[j], ws[k]:we[k]],
+                        axis=(-3, -2, -1)))
+                cols.append(jnp.stack(cells, axis=-1))
+            rows.append(jnp.stack(cols, axis=-2))
+        return jnp.stack(rows, axis=-3)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not supported")
+    return apply(f, x, name="adaptive_max_pool3d")
+
+
+def _lp_pool(x, p, ks, st, name):
+    """(sum x^p)^(1/p) over windows — NO abs(), matching the reference:
+    odd norm_type with negative window sums yields NaN exactly as
+    torch/paddle's pow-based formula does."""
+    x = ensure_tensor(x)
+    pf = float(p)
+    if pf <= 0:
+        raise ValueError("lp_pool requires norm_type > 0")
+
+    def f(a):
+        win = (1, 1) + ks
+        strides = (1, 1) + st
+        powd = a.astype(jnp.float32) ** pf
+        summed = jax.lax.reduce_window(
+            powd, 0.0, jax.lax.add, win, strides, "VALID")
+        return (summed ** (1.0 / pf)).astype(a.dtype)
+    return apply(f, x, name=name)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    if padding not in (0, (0,), [0]):
+        raise NotImplementedError("lp_pool1d padding != 0")
+    if ceil_mode:
+        raise NotImplementedError("lp_pool1d ceil_mode is not supported")
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = ks if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    return _lp_pool(x, norm_type, (ks,), (st,), "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    if padding not in (0, (0, 0), [0, 0]):
+        raise NotImplementedError("lp_pool2d padding != 0")
+    if ceil_mode:
+        raise NotImplementedError("lp_pool2d ceil_mode is not supported")
+    t2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    ks = t2(kernel_size)
+    st = ks if stride is None else t2(stride)
+    return _lp_pool(x, norm_type, ks, st, "lp_pool2d")
+
+
+# -- loss functionals delegating to the Layer implementations ---------------
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    from ..extended_layers2 import SoftMarginLoss
+    return SoftMarginLoss(reduction=reduction)(ensure_tensor(input),
+                                               ensure_tensor(label))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from ..extended_layers2 import TripletMarginWithDistanceLoss
+    return TripletMarginWithDistanceLoss(
+        distance_function=distance_function, margin=margin, swap=swap,
+        reduction=reduction)(ensure_tensor(input),
+                             ensure_tensor(positive),
+                             ensure_tensor(negative))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    from ..extended_layers2 import MultiLabelSoftMarginLoss
+    return MultiLabelSoftMarginLoss(
+        weight=weight, reduction=reduction)(ensure_tensor(input),
+                                            ensure_tensor(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    from ..extended_layers import GaussianNLLLoss
+    return GaussianNLLLoss(full=full, epsilon=epsilon,
+                           reduction=reduction)(
+        ensure_tensor(input), ensure_tensor(label),
+        ensure_tensor(variance))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    from ..extended_layers2 import PoissonNLLLoss
+    return PoissonNLLLoss(log_input=log_input, full=full,
+                          epsilon=epsilon, reduction=reduction)(
+        ensure_tensor(input), ensure_tensor(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    from ..extended_layers2 import CosineEmbeddingLoss
+    return CosineEmbeddingLoss(margin=margin, reduction=reduction)(
+        ensure_tensor(input1), ensure_tensor(input2),
+        ensure_tensor(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference paddle.nn.functional.npair_loss: softmax CE over the
+    anchor·positiveᵀ similarity with same-label soft targets, plus L2
+    regularization on both embeddings."""
+    anchor = ensure_tensor(anchor)
+    positive = ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    def f(a, p, lb):
+        lb = lb.reshape(-1, 1)
+        tgt = (lb == lb.T).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        sim = a.astype(jnp.float32) @ p.astype(jnp.float32).T
+        ce = -jnp.mean(jnp.sum(
+            tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        l2 = jnp.mean(jnp.sum(a.astype(jnp.float32) ** 2, -1) +
+                      jnp.sum(p.astype(jnp.float32) ** 2, -1)) * \
+            float(l2_reg) * 0.25
+        return ce + l2
+    return apply(f, anchor, positive, labels.detach(), name="npair_loss")

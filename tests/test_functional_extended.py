@@ -323,3 +323,109 @@ class TestUntestedBranches:
         assert np.isfinite(out).all()
         ref = np.logaddexp.accumulate(x.reshape(-1).astype(np.float64))
         np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestExtended2Sweep:
+    """Functional sweep 3: structural ops + loss functionals."""
+
+    def test_fold_inverts_unfold(self):
+        x = np.random.default_rng(0).standard_normal(
+            (2, 3, 6, 6)).astype(np.float32)
+        cols = F.unfold(P.to_tensor(x), 2, strides=2)
+        back = F.fold(cols, output_sizes=(6, 6), kernel_sizes=2,
+                      strides=2)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)
+
+    def test_channel_shuffle(self):
+        x = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+        got = F.channel_shuffle(P.to_tensor(x), 3).numpy()
+        ref = x.reshape(2, 3, 2, 2, 2).swapaxes(1, 2).reshape(2, 6, 2, 2)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_affine_grid_identity(self):
+        theta = np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], np.float32),
+                        (2, 1, 1))
+        grid = F.affine_grid(P.to_tensor(theta), [2, 3, 4, 5]).numpy()
+        assert grid.shape == (2, 4, 5, 2)
+        np.testing.assert_allclose(grid[0, 0, :, 0],
+                                   np.linspace(-1, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(grid[0, :, 0, 1],
+                                   np.linspace(-1, 1, 4), rtol=1e-6)
+
+    def test_max_unpool1d_roundtrip(self):
+        x = np.asarray([[[1., 3., 2., 4.]]], np.float32)
+        pooled, idx = F.max_pool1d(P.to_tensor(x), 2, stride=2,
+                                   return_mask=True)
+        up = F.max_unpool1d(pooled, idx, 2, stride=2).numpy()
+        ref = np.asarray([[[0., 3., 0., 4.]]], np.float32)
+        np.testing.assert_array_equal(up, ref)
+
+    def test_adaptive_max_pool3d(self):
+        x = np.random.default_rng(1).standard_normal(
+            (1, 2, 4, 6, 8)).astype(np.float32)
+        got = F.adaptive_max_pool3d(P.to_tensor(x), (2, 3, 4)).numpy()
+        ref = x.reshape(1, 2, 2, 2, 3, 2, 4, 2).max(axis=(3, 5, 7))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        # non-divisible: exact bin semantics vs torch
+        import torch
+        got2 = F.adaptive_max_pool3d(P.to_tensor(x), (3, 4, 5)).numpy()
+        ref2 = torch.nn.functional.adaptive_max_pool3d(
+            torch.from_numpy(x), (3, 4, 5)).numpy()
+        np.testing.assert_allclose(got2, ref2, rtol=1e-6)
+
+    def test_lp_pool_vs_torch(self):
+        import torch
+        x = np.abs(np.random.default_rng(2).standard_normal(
+            (2, 3, 8, 8))).astype(np.float32)
+        got = F.lp_pool2d(P.to_tensor(x), 2.0, 2, stride=2).numpy()
+        ref = torch.nn.functional.lp_pool2d(
+            torch.from_numpy(x), 2.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        x1 = np.abs(np.random.default_rng(3).standard_normal(
+            (2, 3, 10))).astype(np.float32)
+        got1 = F.lp_pool1d(P.to_tensor(x1), 3.0, 2, stride=2).numpy()
+        ref1 = torch.nn.functional.lp_pool1d(
+            torch.from_numpy(x1), 3.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got1, ref1, rtol=1e-5)
+
+    def test_loss_functionals_match_layers(self):
+        rng = np.random.default_rng(4)
+        a = P.to_tensor(rng.standard_normal((4, 5)).astype(np.float32))
+        b = P.to_tensor(rng.standard_normal((4, 5)).astype(np.float32))
+        y = P.to_tensor(np.sign(rng.standard_normal(4)).astype(
+            np.float32))
+        from paddle_tpu.nn import (CosineEmbeddingLoss, SoftMarginLoss)
+        np.testing.assert_allclose(
+            F.cosine_embedding_loss(a, b, y).numpy(),
+            CosineEmbeddingLoss()(a, b, y).numpy(), rtol=1e-6)
+        lb = P.to_tensor(np.sign(rng.standard_normal(
+            (4, 5))).astype(np.float32))
+        np.testing.assert_allclose(
+            F.soft_margin_loss(a, lb).numpy(),
+            SoftMarginLoss()(a, lb).numpy(), rtol=1e-6)
+        # npair: scalar, positive, differentiable
+        lbl = P.to_tensor(np.asarray([0, 1, 0, 1], np.int64))
+        anchor = P.to_tensor(rng.standard_normal(
+            (4, 8)).astype(np.float32), stop_gradient=False)
+        pos = P.to_tensor(rng.standard_normal(
+            (4, 8)).astype(np.float32))
+        loss = F.npair_loss(anchor, pos, lbl)
+        assert loss.numpy().shape == ()
+        loss.backward()
+        assert anchor.grad is not None
+
+    def test_max_pool2d_mask_roundtrip_vs_torch(self):
+        import torch
+        x = np.random.default_rng(5).standard_normal(
+            (2, 3, 8, 8)).astype(np.float32)
+        out, mask = F.max_pool2d(P.to_tensor(x), 2, stride=2,
+                                 return_mask=True)
+        t_out, t_idx = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 2, stride=2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), t_out.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), t_idx.numpy())
+        # unpool closes the loop
+        up = F.max_unpool2d(out, mask, 2, stride=2).numpy()
+        t_up = torch.nn.functional.max_unpool2d(
+            t_out, t_idx, 2, stride=2).numpy()
+        np.testing.assert_allclose(up, t_up, rtol=1e-6)
