@@ -12,7 +12,9 @@ from ..ops._base import ensure_tensor
 from .layer import Layer
 from . import functional as F
 
-__all__ = ["Conv1DTranspose", "Conv3DTranspose", "CosineEmbeddingLoss",
+__all__ = ["AdaptiveMaxPool3D", "ChannelShuffle",
+           "Conv1DTranspose", "Conv3DTranspose", "CosineEmbeddingLoss",
+           "LPPool1D", "LPPool2D", "MaxUnPool1D", "MaxUnPool3D",
            "Fold", "HuberLoss", "LayerDict", "MultiLabelSoftMarginLoss",
            "MultiMarginLoss", "PoissonNLLLoss", "RNNCellBase",
            "Softmax2D", "SoftMarginLoss", "TripletMarginWithDistanceLoss",
@@ -368,3 +370,82 @@ class Unfold(Layer):
             out = jnp.stack(cols, axis=2)
             return out.reshape(N, C * kh * kw, lh * lw)
         return apply(f, ensure_tensor(x), name="unfold")
+
+
+class MaxUnPool1D(Layer):
+    """Reference paddle.nn.MaxUnPool1D over F.max_unpool1d."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from . import functional as F
+        ks, st, pd, df, os_ = self._a
+        return F.max_unpool1d(x, indices, ks, stride=st, padding=pd,
+                              data_format=df, output_size=os_)
+
+
+class MaxUnPool3D(Layer):
+    """Reference paddle.nn.MaxUnPool3D over F.max_unpool3d."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from . import functional as F
+        ks, st, pd, df, os_ = self._a
+        return F.max_unpool3d(x, indices, ks, stride=st, padding=pd,
+                              data_format=df, output_size=os_)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        from . import functional as F
+        nt, ks, st, pd, cm, df = self._a
+        return F.lp_pool1d(x, nt, ks, stride=st, padding=pd,
+                           ceil_mode=cm, data_format=df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        from . import functional as F
+        nt, ks, st, pd, cm, df = self._a
+        return F.lp_pool2d(x, nt, ks, stride=st, padding=pd,
+                           ceil_mode=cm, data_format=df)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g, self._df = groups, data_format
+
+    def forward(self, x):
+        from . import functional as F
+        return F.channel_shuffle(x, self._g, data_format=self._df)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os, self._rm = output_size, return_mask
+
+    def forward(self, x):
+        from . import functional as F
+        return F.adaptive_max_pool3d(x, self._os,
+                                     return_mask=self._rm)
