@@ -1,5 +1,7 @@
 """paddle.audio — audio feature extraction (SURVEY.md §2.2 misc domains)."""
+from . import backends  # noqa: F401
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
                        Spectrogram)
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,95 @@
+"""Audio file IO (reference: paddle.audio.backends load/save/info —
+upstream python/paddle/audio/backends/, unverified; SURVEY.md §2.2 Misc
+domains). Pure-stdlib WAV backend (PCM 8/16/32-bit + float32): no
+soundfile dependency, which the survey's environment rules exclude.
+"""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "AudioInfo"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def _pcm_to_float(data: np.ndarray, sampwidth: int) -> np.ndarray:
+    if sampwidth == 1:  # unsigned 8-bit
+        return (data.astype(np.float32) - 128.0) / 128.0
+    if sampwidth == 2:
+        return data.astype(np.float32) / 32768.0
+    if sampwidth == 4:
+        return data.astype(np.float32) / 2147483648.0
+    raise ValueError(f"unsupported PCM sample width {sampwidth}")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor, sample_rate). waveform is float32 in
+    [-1, 1] (normalize=True) with shape [C, L] (channels_first) or
+    [L, C]."""
+    with wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        sw = w.getsampwidth()
+        total = w.getnframes()
+        w.setpos(min(frame_offset, total))
+        n = total - frame_offset if num_frames < 0 else \
+            min(num_frames, total - frame_offset)
+        raw = w.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[sw]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        data = _pcm_to_float(data, sw)
+    else:
+        data = data.astype(np.float32) if sw == 1 else data
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    """Write a PCM WAV. src: Tensor/array [C, L] (channels_first) or
+    [L, C], float in [-1, 1] or integer PCM."""
+    a = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if a.ndim == 1:
+        a = a[None, :] if channels_first else a[:, None]
+    if channels_first:
+        a = a.T                                     # [L, C]
+    if np.issubdtype(a.dtype, np.floating):
+        a = np.clip(a, -1.0, 1.0)
+        if bits_per_sample == 16:
+            a = (a * 32767.0).astype(np.int16)
+        elif bits_per_sample == 32:
+            a = (a * 2147483647.0).astype(np.int32)
+        elif bits_per_sample == 8:
+            a = ((a * 127.0) + 128.0).astype(np.uint8)
+        else:
+            raise ValueError(
+                f"unsupported bits_per_sample {bits_per_sample}")
+    with wave.open(str(filepath), "wb") as w:
+        w.setnchannels(a.shape[1])
+        w.setsampwidth(a.dtype.itemsize)
+        w.setframerate(int(sample_rate))
+        w.writeframes(np.ascontiguousarray(a).tobytes())
+
+
+def info(filepath):
+    with wave.open(str(filepath), "rb") as w:
+        sw = w.getsampwidth()
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * sw,
+                         encoding=f"PCM_{'U' if sw == 1 else 'S'}")

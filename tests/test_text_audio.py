@@ -97,3 +97,35 @@ class TestAudio:
         d = audio.functional.create_dct(13, 40).numpy()
         gram = d.T @ d
         np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+class TestAudioBackends:
+    """WAV load/save/info roundtrip (reference: paddle.audio.backends)."""
+
+    def test_wav_roundtrip_16bit(self, tmp_path):
+        import paddle_tpu.audio as audio
+        sr = 8000
+        t = np.arange(800, dtype=np.float32) / sr
+        wav = np.stack([np.sin(2 * np.pi * 440 * t),
+                        np.cos(2 * np.pi * 220 * t)])  # [2, L]
+        p = str(tmp_path / "t.wav")
+        audio.save(p, P.to_tensor(wav), sr)
+        meta = audio.info(p)
+        assert (meta.sample_rate, meta.num_channels,
+                meta.bits_per_sample) == (sr, 2, 16)
+        back, sr2 = audio.load(p)
+        assert sr2 == sr and back.numpy().shape == (2, 800)
+        np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
+
+    def test_frame_offset_and_channels_last(self, tmp_path):
+        import paddle_tpu.audio as audio
+        sr = 4000
+        wav = np.random.default_rng(0).uniform(
+            -0.5, 0.5, (1, 400)).astype(np.float32)
+        p = str(tmp_path / "o.wav")
+        audio.save(p, P.to_tensor(wav), sr)
+        seg, _ = audio.load(p, frame_offset=100, num_frames=50,
+                            channels_first=False)
+        assert seg.numpy().shape == (50, 1)
+        np.testing.assert_allclose(seg.numpy()[:, 0], wav[0, 100:150],
+                                   atol=1e-3)
