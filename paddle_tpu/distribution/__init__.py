@@ -22,7 +22,8 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Independent", "TransformedDistribution", "Transform",
            "AffineTransform", "ExpTransform", "PowerTransform",
            "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
-           "StickBreakingTransform", "ChainTransform", "kl_divergence"]
+           "StickBreakingTransform", "ChainTransform", "kl_divergence",
+           "Binomial", "ContinuousBernoulli", "ExponentialFamily"]
 
 
 class Distribution:
@@ -604,3 +605,122 @@ class TransformedDistribution(Distribution):
         x = self.transform.inverse(ensure_tensor(value))
         return (self.base.log_prob(x) -
                 self.transform.forward_log_det_jacobian(x))
+
+
+class Binomial(Distribution):
+    """Reference paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = ensure_tensor(total_count)
+        self.probs_t = ensure_tensor(probs, ref=self.total_count)
+
+    @property
+    def mean(self):
+        return apply(lambda n, p: n * p, self.total_count, self.probs_t)
+
+    @property
+    def variance(self):
+        return apply(lambda n, p: n * p * (1 - p), self.total_count,
+                     self.probs_t)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(
+            tuple(self.total_count.shape), tuple(self.probs_t.shape)))
+        n = jnp.broadcast_to(self.total_count._data, shp)
+        p = jnp.broadcast_to(self.probs_t._data, shp)
+        nmax = int(jnp.max(self.total_count._data))
+        # sum of Bernoulli draws, masked to each element's own n —
+        # static shapes (nmax trials), correct per-element counts
+        draws = jrandom.uniform(k, (nmax,) + shp) < p[None]
+        mask = jnp.arange(nmax)[(...,) + (None,) * len(shp)] < n[None]
+        return Tensor(jnp.sum(draws & mask, axis=0).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.probs_t)
+
+        def f(v, n, p):
+            logc = (jax.scipy.special.gammaln(n + 1) -
+                    jax.scipy.special.gammaln(v + 1) -
+                    jax.scipy.special.gammaln(n - v + 1))
+            return logc + v * jnp.log(jnp.clip(p, 1e-12, 1)) + \
+                (n - v) * jnp.log(jnp.clip(1 - p, 1e-12, 1))
+        return apply(f, value, self.total_count, self.probs_t)
+
+    def entropy(self):
+        """Exact by summing p(k)·(−log p(k)) over the static support."""
+        nmax = int(jnp.max(self.total_count._data))
+        ks = jnp.arange(nmax + 1, dtype=jnp.float32)
+
+        def f(n, p):
+            logc = (jax.scipy.special.gammaln(n + 1) -
+                    jax.scipy.special.gammaln(ks + 1) -
+                    jax.scipy.special.gammaln(n - ks + 1))
+            lp = logc + ks * jnp.log(jnp.clip(p, 1e-12, 1)) + \
+                (n - ks) * jnp.log(jnp.clip(1 - p, 1e-12, 1))
+            valid = ks <= n
+            pr = jnp.where(valid, jnp.exp(lp), 0.0)
+            return -jnp.sum(pr * jnp.where(valid, lp, 0.0), axis=-1)
+        return apply(f, self.total_count, self.probs_t)
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference paddle.distribution.ContinuousBernoulli(probs)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs_t = ensure_tensor(probs)
+        self._lims = lims
+
+    def _log_norm(self, p):
+        # C(p) = 2 atanh(1-2p) / (1-2p), with the p→1/2 limit of 2;
+        # clamp near 1/2 for numerical stability (reference lims)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        c = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        taylor = jnp.log(2.0) + (4.0 / 3) * (p - 0.5) ** 2
+        return jnp.where(near, taylor, c)
+
+    def sample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.probs_t.shape)
+        u = jrandom.uniform(k, shp, minval=1e-6, maxval=1 - 1e-6)
+        p = jnp.broadcast_to(self.probs_t._data, shp)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        # inverse CDF: F(x) = (e^{λx}-1)/(e^λ-1), λ = log(p/(1-p))
+        # → x = log1p(u·(2p-1)/(1-p)) / λ; the p→1/2 limit is x = u
+        x = jnp.log1p(u * (2 * safe - 1) / (1 - safe)) / \
+            (jnp.log(safe) - jnp.log1p(-safe))
+        return Tensor(jnp.where(near, u, jnp.clip(x, 0.0, 1.0)))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.probs_t)
+
+        def f(v, p):
+            return (v * jnp.log(jnp.clip(p, 1e-12, 1)) +
+                    (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12, 1)) +
+                    self._log_norm(p))
+        return apply(f, value, self.probs_t)
+
+    @property
+    def mean(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.25, p)
+            m = safe / (2 * safe - 1) + \
+                1 / (2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(near, 0.5, m)
+        return apply(f, self.probs_t)
+
+
+class ExponentialFamily(Distribution):
+    """Abstract base (reference parity): subclasses expose natural
+    parameters / log-normalizer; entropy via the Bregman identity is
+    provided by the concrete families here directly."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError

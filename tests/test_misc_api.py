@@ -255,3 +255,56 @@ class TestASP:
         w = np.asarray(net.weight._data)
         g = np.abs(w).reshape(w.shape[0], -1, 4)
         assert ((g != 0).sum(-1) <= 2).all()
+
+
+class TestNewDistributions:
+    """Binomial / ContinuousBernoulli vs scipy-free oracles."""
+
+    def test_binomial_log_prob_and_moments(self):
+        import math
+        from paddle_tpu.distribution import Binomial
+        d = Binomial(P.to_tensor(np.asarray(10.0, np.float32)),
+                     P.to_tensor(np.asarray(0.3, np.float32)))
+        # log C(10,3) 0.3^3 0.7^7
+        ref = math.log(math.comb(10, 3) * 0.3 ** 3 * 0.7 ** 7)
+        got = float(d.log_prob(P.to_tensor(
+            np.asarray(3.0, np.float32))).numpy())
+        assert abs(got - ref) < 1e-5
+        assert abs(float(d.mean.numpy()) - 3.0) < 1e-6
+        assert abs(float(d.variance.numpy()) - 2.1) < 1e-6
+        P.seed(0)
+        s = d.sample((2000,)).numpy()
+        assert 2.7 < s.mean() < 3.3
+        assert s.min() >= 0 and s.max() <= 10
+
+    def test_binomial_entropy_matches_torch(self):
+        import torch
+        from paddle_tpu.distribution import Binomial
+        d = Binomial(P.to_tensor(np.asarray(7.0, np.float32)),
+                     P.to_tensor(np.asarray(0.4, np.float32)))
+        t = torch.distributions.Binomial(7, torch.tensor(0.4))
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   float(t.entropy()), rtol=1e-5)
+
+    def test_continuous_bernoulli_vs_torch(self):
+        import torch
+        from paddle_tpu.distribution import ContinuousBernoulli
+        for p in (0.2, 0.5, 0.9):
+            d = ContinuousBernoulli(P.to_tensor(
+                np.asarray(p, np.float32)))
+            t = torch.distributions.ContinuousBernoulli(
+                torch.tensor(p))
+            for v in (0.1, 0.5, 0.83):
+                np.testing.assert_allclose(
+                    float(d.log_prob(P.to_tensor(
+                        np.asarray(v, np.float32))).numpy()),
+                    float(t.log_prob(torch.tensor(v))), rtol=2e-4,
+                    atol=2e-4)
+            np.testing.assert_allclose(float(d.mean.numpy()),
+                                       float(t.mean), rtol=2e-4)
+        P.seed(0)
+        s = ContinuousBernoulli(P.to_tensor(
+            np.asarray(0.7, np.float32))).sample((4000,)).numpy()
+        ref_mean = float(torch.distributions.ContinuousBernoulli(
+            torch.tensor(0.7)).mean)
+        assert abs(s.mean() - ref_mean) < 0.02
