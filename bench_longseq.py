@@ -12,17 +12,44 @@ seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
 recompute = (sys.argv[3] != "0") if len(sys.argv) > 3 else True
 fuse = (sys.argv[4] != "0") if len(sys.argv) > 4 else True
 
-import jax
-import paddle_tpu as P
-from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+# wedge guard: on a dead tunnel the FIRST device touch hangs forever —
+# probe in a bounded subprocess and force CPU (downscaled smoke config)
+# if the chip does not answer (same discipline as bench.py/generate)
+from bench import _tpu_usable  # noqa: E402
+
+tpu_ok = _tpu_usable(attempts=2, probe_timeout=90, backoff=20)
+import jax  # noqa: E402
+
+if not tpu_ok:
+    import jax._src.xla_bridge as xb
+    try:
+        xb._clear_backends()
+        xb.get_backend.cache_clear()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as P  # noqa: E402
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,  # noqa: E402
                                LlamaPretrainingCriterion, flops_per_token)
 
-cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-                  num_hidden_layers=8, num_attention_heads=16,
-                  max_position_embeddings=seq, recompute=recompute,
-                  fuse_linear_cross_entropy=fuse, dtype="bfloat16")
+on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+if on_tpu:
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16,
+                      max_position_embeddings=seq, recompute=recompute,
+                      fuse_linear_cross_entropy=fuse, dtype="bfloat16")
+else:
+    seq = min(seq, 256)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=seq, recompute=recompute,
+                      fuse_linear_cross_entropy=fuse)
 P.seed(0)
-model = LlamaForCausalLM(cfg); model.to(dtype="bfloat16")
+model = LlamaForCausalLM(cfg)
+if on_tpu:
+    model.to(dtype="bfloat16")
 crit = LlamaPretrainingCriterion(cfg)
 if fuse:
     crit.bind(model)
@@ -43,7 +70,8 @@ for _ in range(iters):
 loss_val = float(np.asarray(loss._data if hasattr(loss, "_data") else loss))
 dt = time.perf_counter() - t0
 tok_s = batch * seq * iters / dt
-mfu = tok_s * flops_per_token(cfg, seq) / 197e12
+mfu = tok_s * flops_per_token(cfg, seq) / (197e12 if on_tpu else 1e12)
 print(json.dumps({"batch": batch, "seq": seq, "recompute": recompute,
+                  "tpu": on_tpu,
                   "fuse_ce": fuse, "tok_s": round(tok_s, 1),
                   "mfu": round(mfu, 4), "loss": loss_val}))
