@@ -75,19 +75,33 @@ def _tpu_usable(attempts=4, probe_timeout=120, backoff=45):
     return False
 
 
+def force_cpu():
+    """Reroute jax to CPU without touching the (possibly wedged) TPU.
+
+    The axon sitecustomize bakes JAX_PLATFORMS=axon at interpreter
+    start, so env vars are ignored; clearing the backend caches before
+    any device query is the only safe in-process switch. Shared by every
+    driver/bench script — keep the recipe in exactly one place.
+    """
+    import jax
+    import jax._src.xla_bridge as xb
+    ok = True
+    try:
+        xb._clear_backends()
+        xb.get_backend.cache_clear()
+    except Exception:
+        ok = False
+    jax.config.update("jax_platforms", "cpu")
+    return ok
+
+
 def main():
     tpu_ok = _tpu_usable()
     import jax
     if not tpu_ok:
         # Do NOT touch the wedged TPU backend in-process: force CPU
         # before any device query so the bench still emits a number.
-        import jax._src.xla_bridge as xb
-        try:
-            xb._clear_backends()
-            xb.get_backend.cache_clear()
-        except Exception:
-            pass
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu()
     import jax.numpy as jnp
 
     import paddle_tpu as P

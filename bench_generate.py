@@ -27,17 +27,11 @@ new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
 
 
 def main():
-    from bench import _tpu_usable  # bounded subprocess probe (wedge-safe)
+    from bench import _tpu_usable, force_cpu  # wedge-safe probe + reroute
     tpu_ok = _tpu_usable(attempts=2, probe_timeout=90, backoff=20)
     import jax
     if not tpu_ok:
-        import jax._src.xla_bridge as xb
-        try:
-            xb._clear_backends()
-            xb.get_backend.cache_clear()
-        except Exception:
-            pass
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu()
     import paddle_tpu as P
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
@@ -66,30 +60,59 @@ def main():
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     x = P.to_tensor(ids)
 
-    out = model.generate(x, max_new_tokens=new)   # compile + run
-    out._data.block_until_ready()
-    # Axon measurement hygiene (PERF.md round 3): the remote service
-    # CACHES identical execution requests, so re-running the warmed-up
-    # call with the same inputs "measures" nothing. Time a call with
-    # DIFFERENT inputs and make the timed region end in a host fetch of
-    # a value derived from the output — only a dependent fetch proves
-    # the execution actually ran.
-    ids2 = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
-    x2 = P.to_tensor(ids2)
-    t0 = time.perf_counter()
-    out = model.generate(x2, max_new_tokens=new)
-    checksum = int(np.asarray(out._data).sum())
-    dt = time.perf_counter() - t0
-    del checksum
+    # Two-point measurement (PERF.md round 3): on axon every generate()
+    # call pays a multi-second dispatch+fetch relay overhead that varies
+    # run to run, so end-to-end wall understates device decode rate by
+    # 10-30x. Timing the SAME cache layout at two trip counts and taking
+    # the marginal rate (extra tokens / extra wall) cancels the fixed
+    # overhead — the scaling probe measured 384 extra steps in 0.5 s
+    # (1.3 ms/step, the HBM floor for the 0.5B proxy). Axon hygiene
+    # still applies: fresh inputs per timed call (the service caches
+    # identical requests) and each timed region ends in a host fetch of
+    # a value derived from the output.
+    new_q = max(1, new // 4)
+    for warm_n in (new, new_q):   # compile both trip counts
+        out = model.generate(x, max_new_tokens=warm_n)
+        out._data.block_until_ready()
 
+    def timed(n):
+        # min over 2 samples: the relay's fixed overhead fluctuates
+        # 1-8 s between windows; min picks the quietest window seen.
+        best = float("inf")
+        for _ in range(2):
+            ids2 = rng.integers(0, cfg.vocab_size,
+                                (batch, prompt)).astype(np.int32)
+            x2 = P.to_tensor(ids2)
+            t0 = time.perf_counter()
+            out = model.generate(x2, max_new_tokens=n)
+            int(np.asarray(out._data).sum())   # dependent fetch
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    dt_q = timed(new_q)
+    dt = timed(new)
+    marginal = None
+    if dt > dt_q and new > new_q:
+        marginal = batch * (new - new_q) / (dt - dt_q)
+        # fixed overhead = quarter-run wall minus its device share,
+        # scaled from the marginal per-step time
+        step_s = (dt - dt_q) / (new - new_q)
+        overhead = max(0.0, min(dt_q, dt_q - step_s * new_q))
     tok_s = batch * new / dt
+    rate_kind = "marginal device rate" if marginal else \
+        "end-to-end (marginal unavailable: relay noise inverted the " \
+        "two-point; understates device rate)"
     print(json.dumps({
         "metric": "llama_decode_tok_per_s" + ("" if on_tpu else "_cpu"),
-        "value": round(tok_s, 1),
-        "unit": "decode tokens/sec (batch total, static-cache jitted loop)",
+        "value": round(marginal, 1) if marginal else round(tok_s, 1),
+        "unit": f"decode tokens/sec (batch total, {rate_kind}; "
+                "static-cache jitted loop)",
         "batch": batch, "prompt": prompt, "new_tokens": new,
         "weight_quant": wq or "none",
-        "wall_s": round(dt, 3),
+        "e2e_tok_per_s": round(tok_s, 1),
+        "wall_s": round(dt, 3), "wall_quarter_s": round(dt_q, 3),
+        "fixed_overhead_s_est":
+            round(overhead, 3) if marginal else None,
     }))
 
 

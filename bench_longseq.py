@@ -15,19 +15,13 @@ fuse = (sys.argv[4] != "0") if len(sys.argv) > 4 else True
 # wedge guard: on a dead tunnel the FIRST device touch hangs forever —
 # probe in a bounded subprocess and force CPU (downscaled smoke config)
 # if the chip does not answer (same discipline as bench.py/generate)
-from bench import _tpu_usable  # noqa: E402
+from bench import _tpu_usable, force_cpu, detect_peak  # noqa: E402
 
 tpu_ok = _tpu_usable(attempts=2, probe_timeout=90, backoff=20)
 import jax  # noqa: E402
 
 if not tpu_ok:
-    import jax._src.xla_bridge as xb
-    try:
-        xb._clear_backends()
-        xb.get_backend.cache_clear()
-    except Exception:
-        pass
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu()
 import paddle_tpu as P  # noqa: E402
 from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,  # noqa: E402
                                LlamaPretrainingCriterion, flops_per_token)
@@ -70,7 +64,7 @@ for _ in range(iters):
 loss_val = float(np.asarray(loss._data if hasattr(loss, "_data") else loss))
 dt = time.perf_counter() - t0
 tok_s = batch * seq * iters / dt
-mfu = tok_s * flops_per_token(cfg, seq) / (197e12 if on_tpu else 1e12)
+mfu = tok_s * flops_per_token(cfg, seq) / detect_peak()[0]
 print(json.dumps({"batch": batch, "seq": seq, "recompute": recompute,
                   "tpu": on_tpu,
                   "fuse_ce": fuse, "tok_s": round(tok_s, 1),

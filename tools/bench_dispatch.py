@@ -30,16 +30,8 @@ def child(mode: str):
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     if os.environ.get("PADDLE_TPU_BENCH_CPU"):
-        # the axon sitecustomize bakes JAX_PLATFORMS at interpreter
-        # start; forcing CPU requires the post-import backend reset
-        import jax
-        import jax._src.xla_bridge as xb
-        try:
-            xb._clear_backends()
-            xb.get_backend.cache_clear()
-        except Exception:
-            pass
-        jax.config.update("jax_platforms", "cpu")
+        from bench import force_cpu
+        force_cpu()
     import numpy as np
     import paddle_tpu as P
 
