@@ -12,14 +12,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 if "--cpu" in sys.argv:  # force the CPU backend (e.g. no chip attached)
     sys.argv.remove("--cpu")
-    import jax
-    import jax._src.xla_bridge as xb
-    try:
-        xb._clear_backends()
-        xb.get_backend.cache_clear()
-    except Exception:
-        pass
-    jax.config.update("jax_platforms", "cpu")
+    import os
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import force_cpu
+    force_cpu()
 
 
 import numpy as np

@@ -39,20 +39,21 @@ from ...core.tensor import Tensor
 from ...nn.layer import Layer
 
 
-def _add_sharding(spec, shape, sharding_degree):
-    """Compose ZeRO 'sharding' onto a (possibly TP-sharded) spec: take
-    the largest FREE dim divisible by the sharding degree. Returns None
-    if no free dim qualifies (spec unchanged). ZeRO composes WITH tensor
+def _add_sharding(spec, shape, sharding_degree, axis="sharding"):
+    """Compose a ZeRO-style `axis` onto a (possibly TP-sharded) spec:
+    take the largest FREE dim divisible by the degree. Returns None if
+    no free dim qualifies (spec unchanged). ZeRO composes WITH tensor
     parallelism — each TP shard is further sharded across the sharding
     group (the reference's sharding×mp hybrid; same rule as the
-    pipeline's `_pp_param_spec`)."""
+    pipeline's `_pp_param_spec`). The pipeline reuses this with
+    axis='pp' to store embedding/head params sharded over the pp group."""
     tail = list(spec) + [None] * (len(shape) - len(spec))
-    if "sharding" in tail:
+    if axis in tail:
         return None
     for d in np.argsort([-s for s in shape]):
         if tail[d] is None and shape[d] % sharding_degree == 0 \
                 and shape[d] >= sharding_degree:
-            tail[d] = "sharding"
+            tail[d] = axis
             return P(*tail)
     return None
 

@@ -34,8 +34,19 @@ def main():
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={args.devices} "
         + os.environ.get("XLA_FLAGS", ""))
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # the axon sitecustomize imports jax at interpreter start, so the
+    # XLA_FLAGS above can be too late — clear backends and use the
+    # device-count config, which works post-init
+    from bench import force_cpu
+    force_cpu()
     import jax
-    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(args.devices))
+    except Exception:
+        pass  # older configs: XLA_FLAGS already covers the fresh case
     import jax.numpy as jnp
     import numpy as np
 
