@@ -11,7 +11,8 @@ __version__ = "0.1.0"
 
 # core
 from .core import dtype as _dtype_mod
-from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+from .core.dtype import (finfo, iinfo,  # noqa: F401
+                         bfloat16, bool_, complex64, complex128, float16,
                          float32, float64, get_default_dtype, int8, int16,
                          int32, int64, set_default_dtype, uint8)
 from .core.device import (CPUPlace, Place, TPUPlace, device_count, get_device,
@@ -54,7 +55,8 @@ from . import regularizer  # noqa: F401
 from . import geometric  # noqa: F401
 from . import hub  # noqa: F401
 from . import sysconfig  # noqa: F401
-from .hapi import callbacks  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import onnx  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 from .distributed.parallel import DataParallel  # noqa: E402

@@ -1,0 +1,13 @@
+"""paddle.callbacks namespace parity.
+
+The reference exposes hapi callbacks both as paddle.callbacks.* and via
+paddle.hapi (upstream python/paddle/callbacks.py re-export — unverified,
+SURVEY.md blocker notice). Same arrangement here.
+"""
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,
+                             LRScheduler, ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, VisualDL)
+
+__all__ = ["Callback", "CallbackList", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint", "ProgBarLogger", "ReduceLROnPlateau",
+           "VisualDL"]

@@ -97,3 +97,55 @@ def set_default_dtype(d):
 
 def get_default_dtype():
     return _default_dtype
+
+
+class finfo:
+    """Floating-point type info (paddle.finfo parity; upstream
+    python/paddle/framework/dtype.py — unverified, SURVEY.md blocker).
+
+    Backed by jnp.finfo so bfloat16 (ml_dtypes) is covered — the dtype that
+    matters on TPU."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        # np.dtype(bfloat16).kind is 'V' (ml_dtypes extension); go through
+        # jnp's dtype lattice instead of the numpy kind char.
+        if not (jnp.issubdtype(d, jnp.floating)
+                or jnp.issubdtype(d, jnp.complexfloating)):
+            raise ValueError(f"finfo expects a floating dtype, got "
+                             f"{dtype_name(d)}")
+        info = jnp.finfo(d)
+        self.dtype = dtype_name(d)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+    def __repr__(self):
+        return (f"finfo(dtype={self.dtype}, bits={self.bits}, "
+                f"min={self.min}, max={self.max}, eps={self.eps})")
+
+
+class iinfo:
+    """Integer type info (paddle.iinfo parity)."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        if np.dtype(d).kind not in "iub":
+            raise ValueError(f"iinfo expects an integer dtype, got "
+                             f"{dtype_name(d)}")
+        info = jnp.iinfo(d) if np.dtype(d).kind != "b" else None
+        self.dtype = dtype_name(d)
+        if info is None:  # bool
+            self.bits, self.min, self.max = 8, 0, 1
+        else:
+            self.bits = info.bits
+            self.min = int(info.min)
+            self.max = int(info.max)
+
+    def __repr__(self):
+        return (f"iinfo(dtype={self.dtype}, bits={self.bits}, "
+                f"min={self.min}, max={self.max})")

@@ -305,3 +305,24 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
     """Reference: paddle.distributed.to_static — returns an Engine-backed
     static trainer for the annotated model."""
     return Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded tensor to a fully-replicated local tensor
+    (paddle.distributed.unshard_dtensor parity; upstream
+    auto_parallel/api.py — unverified, SURVEY.md blocker notice).
+
+    TPU-native: a device_put to a replicated NamedSharding when the source
+    mesh is known (XLA inserts the all_gather), else a host round-trip.
+    """
+    data = dist_tensor._data
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is not None:
+        rep = jax.device_put(
+            data, NamedSharding(mesh.jax_mesh,
+                                jax.sharding.PartitionSpec()))
+        out = Tensor(rep, stop_gradient=dist_tensor.stop_gradient)
+    else:
+        out = Tensor(jax.numpy.asarray(np.asarray(data)),
+                     stop_gradient=dist_tensor.stop_gradient)
+    return out

@@ -1,0 +1,11 @@
+"""paddle.distributed.sharding — the ZeRO public facade import path.
+
+Reference parity: `from paddle.distributed.sharding import
+group_sharded_parallel` (upstream python/paddle/distributed/sharding/ —
+unverified, SURVEY.md §2.3). Implementation lives in ``sharding_api``;
+this package provides the reference import path.
+"""
+from ..sharding_api import (group_sharded_parallel,  # noqa: F401
+                            save_group_sharded_model)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

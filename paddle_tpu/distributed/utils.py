@@ -1,0 +1,24 @@
+"""paddle.distributed.utils parity (upstream
+python/paddle/distributed/utils/ — unverified, SURVEY.md blocker notice).
+
+The reference keeps MoE's expert-exchange collectives and launcher helpers
+here; the TPU-native implementations live with the MoE layer
+(incubate/moe.py: alltoall over the 'ep' mesh axis inside shard_map) and
+the launch package — this module surfaces the reference names.
+"""
+from __future__ import annotations
+
+import socket
+
+from ..incubate.moe import global_gather, global_scatter  # noqa: F401
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None
+
+
+__all__ = ["global_scatter", "global_gather", "get_host_name_ip"]

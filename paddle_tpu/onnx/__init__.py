@@ -1,0 +1,33 @@
+"""paddle.onnx — documented-out export path (API-parity stub, honest).
+
+The reference's paddle.onnx.export delegates to the external paddle2onnx
+package (upstream python/paddle/onnx/ — unverified, SURVEY.md blocker
+notice). This rebuild's deployment interchange format is **StableHLO**
+(`paddle_tpu.jit.save` → .mlir bytecode + params, loadable from Python
+and from the C++ PJRT runtime `native/pd_infer`): on the TPU stack,
+StableHLO is what ONNX is on the CUDA stack — the portable compiler-input
+artifact. See PARITY.md §2.2 (onnx row) for the design stance.
+
+`export()` therefore raises with guidance unless the optional `onnx`
+package is importable (it is not baked into this image).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export requires the external 'onnx'/'paddle2onnx' "
+            "toolchain, which is not available in this environment. The "
+            "supported deployment artifact is StableHLO: use "
+            "paddle_tpu.jit.save(layer, path, input_spec) and load it with "
+            "paddle_tpu.jit.load, the inference Predictor, or the C++ "
+            "runtime (native/pd_infer)."
+        )
+    raise NotImplementedError(
+        "paddle2onnx-style conversion is not implemented; export via "
+        "paddle_tpu.jit.save (StableHLO) instead.")

@@ -362,3 +362,27 @@ def softmax(x, axis=-1):
 
 
 from . import nn  # noqa: E402  (public submodule, after defs it uses)
+
+
+# -- dense Tensor bridges (reference: dense_tensor.to_sparse_coo/csr) -----
+def _dense_to_sparse_coo(self, sparse_dim=None):
+    """Tensor.to_sparse_coo(sparse_dim) — dense → COO. Eager-path
+    conversion (nse is data-dependent; under jit the sparse module's
+    bounded-nse ops apply)."""
+    nd = self._data.ndim
+    sd = nd if sparse_dim is None else int(sparse_dim)
+    if not (0 < sd <= nd):
+        raise ValueError(f"sparse_dim must be in (0, {nd}], got {sparse_dim}")
+    bcoo = jsparse.BCOO.fromdense(self._data, n_dense=nd - sd)
+    return SparseCooTensor(bcoo)
+
+
+def _dense_to_sparse_csr(self):
+    """Tensor.to_sparse_csr() — dense 2-D → CSR."""
+    if self._data.ndim != 2:
+        raise NotImplementedError("to_sparse_csr expects a 2-D tensor")
+    return SparseCsrTensor(jsparse.BCSR.fromdense(self._data))
+
+
+Tensor.to_sparse_coo = _dense_to_sparse_coo
+Tensor.to_sparse_csr = _dense_to_sparse_csr

@@ -107,3 +107,8 @@ class _CppExtensionStub:
 
 
 cpp_extension = _CppExtensionStub()
+
+
+from . import dlpack  # noqa: E402,F401
+
+__all__.append("dlpack")

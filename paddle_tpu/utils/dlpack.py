@@ -1,0 +1,34 @@
+"""DLPack interchange (paddle.utils.dlpack parity).
+
+Reference surface: paddle.utils.dlpack.to_dlpack / from_dlpack (upstream
+python/paddle/utils/dlpack.py — unverified, SURVEY.md blocker notice).
+
+TPU-native: `jax.Array` already speaks the DLPack protocol; we surface the
+capsule form for legacy consumers (torch.utils.dlpack, cupy) and accept
+either a capsule or any object exporting ``__dlpack__`` on import.
+Zero-copy on CPU; device buffers cross through the PJRT DLPack bridge.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..ops._base import ensure_tensor
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule."""
+    t = ensure_tensor(x)
+    data = t._data
+    if hasattr(data, "__dlpack__"):
+        return data.__dlpack__()
+    import jax.dlpack
+    return jax.dlpack.to_dlpack(data)  # pragma: no cover - legacy jax
+
+
+def from_dlpack(ext):
+    """Import a DLPack capsule (or any ``__dlpack__`` exporter, e.g. a
+    torch/numpy/cupy array) as a Tensor."""
+    import jax.numpy as jnp
+    if hasattr(ext, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(ext))
+    import jax.dlpack
+    return Tensor(jax.dlpack.from_dlpack(ext))
