@@ -108,10 +108,7 @@ class finfo:
 
     def __init__(self, dtype):
         d = convert_dtype(dtype)
-        # np.dtype(bfloat16).kind is 'V' (ml_dtypes extension); go through
-        # jnp's dtype lattice instead of the numpy kind char.
-        if not (jnp.issubdtype(d, jnp.floating)
-                or jnp.issubdtype(d, jnp.complexfloating)):
+        if not (is_floating_point(d) or is_complex(d)):
             raise ValueError(f"finfo expects a floating dtype, got "
                              f"{dtype_name(d)}")
         info = jnp.finfo(d)
@@ -134,17 +131,14 @@ class iinfo:
 
     def __init__(self, dtype):
         d = convert_dtype(dtype)
-        if np.dtype(d).kind not in "iub":
+        if not is_integer(d):  # bool rejected, as in numpy/reference
             raise ValueError(f"iinfo expects an integer dtype, got "
                              f"{dtype_name(d)}")
-        info = jnp.iinfo(d) if np.dtype(d).kind != "b" else None
+        info = jnp.iinfo(d)
         self.dtype = dtype_name(d)
-        if info is None:  # bool
-            self.bits, self.min, self.max = 8, 0, 1
-        else:
-            self.bits = info.bits
-            self.min = int(info.min)
-            self.max = int(info.max)
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)
 
     def __repr__(self):
         return (f"iinfo(dtype={self.dtype}, bits={self.bits}, "

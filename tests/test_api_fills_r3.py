@@ -42,6 +42,8 @@ class TestTypeInfo:
             paddle.finfo("int32")
         with pytest.raises(ValueError):
             paddle.iinfo("float32")
+        with pytest.raises(ValueError):  # numpy/reference reject bool too
+            paddle.iinfo("bool")
 
 
 class TestDlpack:
