@@ -4,6 +4,7 @@ from __future__ import annotations
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
+from . import utils  # noqa: F401
 from .activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid,
                          Hardswish, Hardtanh, LeakyReLU, LogSoftmax, Maxout,
                          Mish, PReLU, ReLU, ReLU6, Sigmoid, Silu, Softmax,

@@ -93,6 +93,13 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
         total = jnp.sum(jnp.stack(
             [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
              for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite:
+        import math
+        t = float(total)
+        if not math.isfinite(t):
+            raise RuntimeError(
+                f"the total norm of gradients is non-finite ({t}); set "
+                "error_if_nonfinite=False to skip this check")
     scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
     for p in parameters:
         if p.grad is not None:

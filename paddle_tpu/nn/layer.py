@@ -103,6 +103,15 @@ class Layer:
             d = self.__dict__.get(store)
             if d is not None and name in d:
                 return d[name]
+        # Derived attributes (nn.utils weight_norm/spectral_norm): computed
+        # fresh on every access from the underlying Parameters, so nothing
+        # stale or trace-time-tracer-backed is ever stored on the layer.
+        # Entries are plain spec tuples (deepcopy-safe — a cloned layer
+        # derives from its OWN parameters, not the prototype's).
+        derived = self.__dict__.get("_derived_attrs")
+        if derived is not None and name in derived:
+            from .utils import compute_derived
+            return compute_derived(self, name, derived[name])
         raise AttributeError(
             f"'{type(self).__name__}' object has no attribute {name!r}")
 

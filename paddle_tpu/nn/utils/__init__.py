@@ -1,0 +1,260 @@
+"""paddle.nn.utils parity: weight_norm / spectral_norm reparametrizations,
+gradient clipping helpers, parameter<->vector flattening.
+
+Reference surface (upstream python/paddle/nn/utils/ — unverified, SURVEY.md
+blocker notice): weight_norm, remove_weight_norm, spectral_norm,
+clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+vector_to_parameters.
+
+TPU-native notes
+----------------
+* Reparametrizations are *derived attributes*: the effective weight is
+  recomputed from the underlying Parameters on every attribute access
+  (Layer.__getattr__ consults `_derived_attrs`). Nothing is stored on the
+  layer, so compiled-stepper traces can't leak tracers into eager state,
+  and the recomputation (a fused norm+mul on the weight) folds into the
+  one XLA program next to the matmul it feeds.
+* The in-place grad clips are eager utilities (the reference's use); the
+  same math lives in ClipGradByGlobalNorm/ByValue for in-program clipping
+  by the optimizers. clip_grad_norm_ here IS nn/clip_grad.py's — one
+  implementation, fp32-accumulating and overflow-safe.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from ...core import autograd as _ag
+from ..layer import Layer
+from ..clip_grad import clip_grad_norm_  # noqa: F401  (single impl, re-exported)
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _derived(layer) -> dict:
+    return layer.__dict__.setdefault("_derived_attrs", {})
+
+
+def _clone_param_like(src: Parameter, data) -> Parameter:
+    """New Parameter carrying over the source's training metadata
+    (trainable flag, need_clip, per-param lr, regularizer) so the
+    reparametrization doesn't silently unfreeze/unclip a weight."""
+    p = Parameter(data, trainable=src.trainable,
+                  name=getattr(src, "name", "") or "")
+    p.optimize_attr = dict(getattr(src, "optimize_attr", None)
+                           or {"learning_rate": 1.0})
+    p.regularizer = getattr(src, "regularizer", None)
+    p.need_clip = getattr(src, "need_clip", True)
+    p.is_distributed = getattr(src, "is_distributed", False)
+    return p
+
+
+def compute_derived(layer, name, spec):
+    """Dispatcher for Layer.__getattr__ derived attributes. `spec` is a
+    plain tuple so layers deepcopy cleanly (closures would keep deriving
+    from the prototype layer's parameters)."""
+    kind = spec[0]
+    if kind == "weight_norm":
+        return _compute_weight(layer, name, spec[1])
+    if kind == "spectral_norm":
+        return _compute_spectral(layer, name, *spec[1:])
+    raise AttributeError(f"unknown derived attribute kind {kind!r}")
+
+
+# --------------------------------------------------------------------------
+# weight_norm
+# --------------------------------------------------------------------------
+
+def _norm_except_dim(v, dim):
+    """L2 norm of `v` over all axes except `dim` (None → all axes),
+    keepdim layout so it broadcasts against v."""
+    import paddle_tpu as P
+    if dim is None:
+        return P.sqrt(P.sum(v * v))
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return P.sqrt(P.sum(v * v, axis=axes, keepdim=True))
+
+
+def _compute_weight(layer, name, dim):
+    import paddle_tpu as P
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    norm = _norm_except_dim(v, dim)
+    if dim is None:
+        return v * (g / norm)
+    gshape = [1] * len(v.shape)
+    gshape[dim] = v.shape[dim]
+    return v * (P.reshape(g, gshape) / norm)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int | None = 0):
+    """Apply weight normalization: w = g * v / ||v||.
+
+    Replaces Parameter `name` with `name`_g (per-`dim` magnitudes, 1-D) and
+    `name`_v (direction); `name` becomes a derived attribute recomputed on
+    access, so gradients flow to g and v.
+    """
+    params = layer.__dict__.get("_parameters")
+    if params and name + "_g" in params:  # check first: `name` is already
+        raise RuntimeError(               # a derived attr, not a Parameter
+            f"weight_norm already applied to {name!r}")
+    if params is None or name not in params:
+        raise ValueError(f"layer has no parameter {name!r}")
+    v0 = params[name]
+    ndim = len(v0.shape)
+    if dim is not None and not (-ndim <= dim < ndim):
+        raise ValueError(f"dim {dim} out of range for ndim {ndim}")
+    if dim is not None and dim < 0:
+        dim += ndim
+
+    with _ag.no_grad():
+        norm0 = _norm_except_dim(v0, dim)
+        g0 = norm0 if dim is None else norm0.reshape([v0.shape[dim]])
+    del params[name]
+    setattr(layer, name + "_g", _clone_param_like(v0, g0._data))
+    setattr(layer, name + "_v", _clone_param_like(v0, v0._data))
+    _derived(layer)[name] = ("weight_norm", dim)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Undo weight_norm: fold g*v/||v|| back into a single Parameter."""
+    derived = layer.__dict__.get("_derived_attrs") or {}
+    params = layer.__dict__.get("_parameters") or {}
+    if name not in derived or name + "_g" not in params:
+        raise ValueError(f"weight_norm not applied to {name!r}")
+    with _ag.no_grad():
+        w = compute_derived(layer, name, derived[name])
+    src = params[name + "_v"]
+    del derived[name]
+    del params[name + "_g"]
+    del params[name + "_v"]
+    setattr(layer, name, _clone_param_like(src, w._data))
+    return layer
+
+
+# --------------------------------------------------------------------------
+# spectral_norm
+# --------------------------------------------------------------------------
+
+def _sn_default_dim(layer):
+    # Reference picks the output-channel axis: 1 for Linear / ConvTranspose
+    # (whose weight layouts put fan-out second), else 0.
+    from ..common import Linear
+    from ..conv import Conv2DTranspose
+    from ..extended_layers2 import Conv1DTranspose, Conv3DTranspose
+    kinds = (Linear, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose)
+    return 1 if isinstance(layer, kinds) else 0
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int | None = None):
+    """Apply spectral normalization: w = w_orig / sigma_max(w_orig).
+
+    sigma is estimated by power iteration on the [d, rest] matricization of
+    the weight; u/v live as buffers and are refined IN PLACE (no-grad) on
+    every access of the derived weight — in-place `_data` update keeps the
+    compiled steppers' identity-based buffer threading intact.
+    """
+    params = layer.__dict__.get("_parameters")
+    if params and name + "_orig" in params:  # same ordering as weight_norm
+        raise RuntimeError(f"spectral_norm already applied to {name!r}")
+    if params is None or name not in params:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        dim = _sn_default_dim(layer)
+    w0 = params[name]
+    d = w0.shape[dim]
+    rest = int(np.prod(w0.shape)) // d
+
+    del params[name]
+    setattr(layer, name + "_orig", _clone_param_like(w0, w0._data))
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(d).astype(np.float32)
+    v0 = rng.standard_normal(rest).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0)),
+                                 stop_gradient=True), persistable=True)
+    layer.register_buffer(name + "_v",
+                          Tensor(jnp.asarray(v0 / np.linalg.norm(v0)),
+                                 stop_gradient=True), persistable=True)
+    _derived(layer)[name] = ("spectral_norm", dim, n_power_iterations, eps)
+    return layer
+
+
+def _compute_spectral(layer, name, dim, n_power_iterations, eps):
+    import paddle_tpu as P
+    w = getattr(layer, name + "_orig")
+    u = layer._buffers[name + "_u"]
+    v = layer._buffers[name + "_v"]
+    d = w.shape[dim]
+    rest = int(np.prod(w.shape)) // d
+    wm = P.reshape(P.moveaxis(w, dim, 0), [d, rest])
+    with _ag.no_grad():
+        un, vn = u._data, v._data
+        for _ in range(max(1, n_power_iterations)):
+            vn = jnp.matmul(wm._data.T, un)
+            vn = vn / (jnp.linalg.norm(vn) + eps)
+            un = jnp.matmul(wm._data, vn)
+            un = un / (jnp.linalg.norm(un) + eps)
+        if getattr(layer, "training", True):
+            # Persist in place on the SAME Tensor objects — the compiled
+            # steppers thread buffers by identity (the BatchNorm
+            # running-stat contract). Eval mode: transient refinement
+            # only, so inference jit stays side-effect-free.
+            u._inplace_update(un)
+            v._inplace_update(vn)
+    sigma = P.sum(Tensor(un, stop_gradient=True)
+                  * P.matmul(wm, Tensor(vn, stop_gradient=True)))
+    return w / sigma
+
+
+# --------------------------------------------------------------------------
+# grad clipping (eager, in place)
+# --------------------------------------------------------------------------
+
+def _param_list(parameters):
+    if isinstance(parameters, Tensor):
+        return [parameters]
+    return list(parameters)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every gradient element into [-clip_value, clip_value]."""
+    cv = abs(float(clip_value))
+    for p in _param_list(parameters):
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._data, -cv, cv),
+                            stop_gradient=True)
+
+
+# --------------------------------------------------------------------------
+# parameter <-> vector
+# --------------------------------------------------------------------------
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten-and-concatenate parameters into one 1-D tensor."""
+    params = _param_list(parameters)
+    if not params:
+        raise ValueError("parameters_to_vector got an empty parameter list")
+    flat = jnp.concatenate([jnp.ravel(p._data) for p in params])
+    return Tensor(flat, stop_gradient=True)
+
+
+def vector_to_parameters(vec, parameters):
+    """Scatter a flat vector back into the parameters (in place)."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    params = _param_list(parameters)
+    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in params)
+    if int(v.shape[0]) != total:
+        raise ValueError(f"vector has {int(v.shape[0])} elements, "
+                         f"parameters need {total}")
+    off = 0
+    for p in params:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(jnp.reshape(v[off:off + n], p._data.shape))
+        off += n
