@@ -58,10 +58,12 @@ from . import sysconfig  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import onnx  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
+from .nn.layer import LazyGuard  # noqa: E402,F401
 
 from .distributed.parallel import DataParallel  # noqa: E402
 from .framework.io_save import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
+from .hapi.summary import flops, summary  # noqa: E402,F401
 from .nn.clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: E402
                            ClipGradByValue)
 

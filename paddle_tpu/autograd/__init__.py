@@ -11,6 +11,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from ..core.autograd import saved_tensors_hooks  # noqa: F401
 from ..core.autograd import (PyLayer, PyLayerContext, backward,  # noqa: F401
                              enable_grad, grad, is_grad_enabled, no_grad,
                              set_grad_enabled)

@@ -62,13 +62,57 @@ class enable_grad(contextlib.ContextDecorator):
 
 
 # ---------------------------------------------------------------------------
+# saved_tensors_hooks (reference: paddle.autograd.saved_tensors_hooks,
+# upstream python/paddle/autograd/saved_tensors_hooks.py — unverified,
+# SURVEY.md blocker notice).
+#
+# TPU-native realization: the eager tape's backward is remat-based — what
+# it saves per op is the op's INPUT tensors, so those are the "saved
+# tensors" the hooks see. While a context is active, every recorded node
+# stores pack(input) instead of relying on the live arrays, and backward
+# re-derives the pullback from unpack(packed). A pack that offloads to
+# host (np.asarray) or requantizes therefore genuinely changes what
+# backward reads. Under jit/compiled steppers, XLA rematerialization
+# (jax.checkpoint policies, fleet recompute) owns residual memory — the
+# hooks are an eager-mode feature there, as in the reference. PyLayer's
+# explicitly saved tensors are not intercepted (documented deviation).
+
+_SAVED_HOOKS: list = []
+
+
+def _unpack_value(x):
+    """Normalize an unpack-hook result (Tensor | array-like) to an array."""
+    from .tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+class saved_tensors_hooks:
+    """Context manager: pack_hook(tensor) runs when the tape saves a
+    tensor for backward; unpack_hook(packed) runs when backward needs it.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _SAVED_HOOKS.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_HOOKS.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
 # Tape nodes
 
 class TapeNode:
     """One recorded differentiable op: inputs + vjp pullback + output slots."""
 
     __slots__ = ("inputs", "in_versions", "vjp_fn", "multi_out", "out_refs",
-                 "out_info", "name", "fn", "tensor_vjp", "__weakref__")
+                 "out_info", "name", "fn", "tensor_vjp", "packed", "unpack",
+                 "__weakref__")
 
     def __init__(self, inputs, vjp_fn, multi_out, name="", fn=None):
         self.inputs = tuple(inputs)          # strong refs keep the graph alive
@@ -80,6 +124,8 @@ class TapeNode:
         self.name = name
         self.fn = fn          # forward fn, kept for create_graph re-trace
         self.tensor_vjp = None  # PyLayer: Tensor-level backward (create_graph)
+        self.packed = None    # saved_tensors_hooks: packed input values
+        self.unpack = None    # ... and the matching unpack hook
 
     def add_output(self, tensor):
         self.out_refs.append(weakref.ref(tensor))
@@ -90,6 +136,8 @@ class TapeNode:
         self.inputs = ()
         self.fn = None
         self.tensor_vjp = None
+        self.packed = None
+        self.unpack = None
 
 
 def _check_versions(node: TapeNode):
@@ -211,7 +259,30 @@ def apply(fn, *tensors, name: str = ""):
             _STATIC_RECORDER.record(fn, tensors, (t,), name)
         return t
     if needs_grad:
-        if microjit:
+        if _SAVED_HOOKS:
+            # saved_tensors_hooks active: the values the tape saves for
+            # backward go through pack NOW; backward re-derives the
+            # pullback (remat) from unpack's results, so a lossy pack
+            # (offload, quantize) genuinely feeds the gradients. Eager
+            # jax.vjp is skipped — its residuals live inside the closure
+            # where hooks can't reach.
+            pack, unpack = _SAVED_HOOKS[-1]
+            out = fn(*arrs)
+            node = TapeNode(tensors, None, isinstance(out, (tuple, list)),
+                            name=name, fn=fn)
+            node.packed = tuple(pack(t) for t in tensors)
+            node.unpack = unpack
+            # Device-memory relief — the point of an offload pack: once an
+            # INTERMEDIATE input (produced by the tape, not a leaf/param)
+            # is packed, swap its live device array for a host copy.
+            # numpy is a transparent stand-in (jnp ops re-upload on use);
+            # no version bump — this is not a user-visible value change.
+            import numpy as _np
+            for t in tensors:
+                if t._node is not None and \
+                        not isinstance(t._data, _np.ndarray):
+                    t._data = _np.asarray(t._data)
+        elif microjit:
             # lazy backward: the pullback is derived inside a cached jit
             # at backward time (see _mj_bwd) — vjp_fn stays None
             out = _mj_fwd(fn, arrs)
@@ -388,7 +459,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             cot_ts = [c if isinstance(c, Tensor) else Tensor(c)
                       for c in cotangents]
             if node.fn is not None:
-                in_grads = apply(_make_pullback(node), *node.inputs, *cot_ts,
+                ins = node.inputs
+                if node.packed is not None:
+                    # hooks + create_graph: re-trace from the unpacked
+                    # values as fresh leaves (grad-of-grad w.r.t. the
+                    # originals is cut by packing — documented)
+                    ins = tuple(Tensor(_unpack_value(node.unpack(p)))
+                                for p in node.packed)
+                in_grads = apply(_make_pullback(node), *ins, *cot_ts,
                                  name=f"vjp[{node.name}]")
                 if not isinstance(in_grads, tuple):
                     in_grads = (in_grads,)
@@ -403,10 +481,26 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                                    else cotangents[0])
         else:
             # micro-jit lazy backward: cached jit re-derives the pullback
-            # from the saved inputs (remat — no residuals were kept)
-            in_grads = _mj_bwd(node.fn,
-                               tuple(t._data for t in node.inputs),
-                               node.multi_out, tuple(cotangents))
+            # from the saved inputs (remat — no residuals were kept).
+            # saved_tensors_hooks: the saved values are the UNPACKED
+            # packs, so offloaded/requantized data is what backward sees.
+            if node.packed is not None:
+                arrs = tuple(_unpack_value(node.unpack(p))
+                             for p in node.packed)
+                if _is_stable(node.fn):
+                    in_grads = _mj_bwd(node.fn, arrs,
+                                       node.multi_out, tuple(cotangents))
+                else:
+                    # per-call lambdas would never hit the fn-keyed jit
+                    # cache (one fresh XLA program per op per step — the
+                    # micro-jit comment's exact hazard); eager vjp instead
+                    _, vjp_fn = jax.vjp(node.fn, *arrs)
+                    in_grads = vjp_fn(tuple(cotangents) if node.multi_out
+                                      else cotangents[0])
+            else:
+                arrs = tuple(t._data for t in node.inputs)
+                in_grads = _mj_bwd(node.fn, arrs,
+                                   node.multi_out, tuple(cotangents))
         for t, g in zip(node.inputs, in_grads):
             if g is not None:
                 deposit(t, g)

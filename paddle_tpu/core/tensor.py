@@ -225,6 +225,11 @@ class Tensor:
     def _inplace_update(self, new_data):
         self._data = new_data
         self._version += 1
+        # A directly-assigned value supersedes a LazyGuard deferred init
+        # (set_state_dict on a lazily-built net must not be clobbered by
+        # materialization at first forward).
+        if "_lazy_init" in self.__dict__:
+            del self.__dict__["_lazy_init"]
         return self
 
     def set_value(self, value):

@@ -1,3 +1,4 @@
 """paddle_tpu.hapi — high-level API (paddle.hapi parity)."""
 from . import callbacks  # noqa: F401
 from .model import Model  # noqa: F401
+from .summary import flops, summary  # noqa: F401

@@ -518,6 +518,9 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
+        if input_size is not None:
+            from .summary import summary as _summary
+            return _summary(self.network, input_size, dtypes=dtype)
         total = 0
         trainable = 0
         lines = ["-" * 60,

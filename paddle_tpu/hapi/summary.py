@@ -1,0 +1,214 @@
+"""paddle.summary / paddle.flops — model introspection.
+
+Reference surface (upstream python/paddle/hapi/model_summary.py and
+python/paddle/hapi/dynamic_flops.py — unverified, SURVEY.md blocker
+notice): `summary(net, input_size)` prints a per-layer table (output
+shapes, parameter counts) and returns totals; `flops(net, input_size)`
+estimates per-layer FLOPs with the reference's counting rules (one MAC
+counted as one FLOP — documented; multiply by 2 for mul+add accounting).
+
+TPU-native: both run ONE eager forward on zeros with forward-post-hooks
+collecting shapes — shape inference is tracing, no per-op infermeta
+needed. The forward runs under no_grad; training flags are untouched.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def _make_inputs(input_size, dtypes):
+    import paddle_tpu as P
+    if input_size is None:
+        raise ValueError("summary/flops need input_size or input")
+    if isinstance(input_size, tuple) and all(
+            isinstance(d, (numbers.Integral, type(None))) for d in input_size):
+        sizes = [input_size]
+    elif isinstance(input_size, (list, tuple)):
+        sizes = list(input_size)
+    else:
+        raise TypeError(f"bad input_size {input_size!r}")
+    if dtypes is None:
+        dtypes = ["float32"] * len(sizes)
+    elif isinstance(dtypes, str):
+        dtypes = [dtypes] * len(sizes)
+    elif len(dtypes) != len(sizes):
+        raise ValueError(f"dtypes has {len(dtypes)} entries for "
+                         f"{len(sizes)} inputs")
+    outs = []
+    for shape, dt in zip(sizes, dtypes):
+        shape = tuple(1 if (d is None or (isinstance(d, numbers.Integral)
+                                          and d < 0)) else int(d)
+                      for d in shape)
+        outs.append(P.zeros(list(shape), dtype=dt))
+    return outs
+
+
+def _out_shape(out):
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)) and out:
+        first = out[0]
+        return list(first.shape) if isinstance(first, Tensor) else []
+    return []
+
+
+def _collect(net: Layer, inputs):
+    """Run one forward with post-hooks on every sublayer; returns rows of
+    (qualified_name, layer, output_shape) in execution order."""
+    rows, handles = [], []
+
+    def _mk(qname, lyr):
+        def _hook(l, ins, outs):
+            rows.append((qname, l, _out_shape(outs)))
+            return None
+        return _hook
+
+    for qname, sub in net.named_sublayers(include_self=False):
+        handles.append(sub.register_forward_post_hook(_mk(qname, sub)))
+    try:
+        with _ag.no_grad():
+            net(*inputs)
+    finally:
+        for h in handles:
+            h.remove()
+    return rows
+
+
+def _own_param_count(layer: Layer):
+    total = trainable = 0
+    for _, p in layer.named_parameters(include_sublayers=False):
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+    return total, trainable
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a Keras-style per-layer table; returns
+    {'total_params': N, 'trainable_params': M}."""
+    if input is None:
+        inputs = _make_inputs(input_size, dtypes)
+    elif isinstance(input, Tensor):
+        inputs = [input]  # list(Tensor) would getitem-iterate the batch dim
+    else:
+        inputs = list(input)
+    inputs = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+              for x in inputs]
+    rows = _collect(net, inputs)
+
+    header = f"{'Layer (type)':<38}{'Output Shape':<24}{'Param #':>12}"
+    line = "-" * len(header)
+    print(line); print(header); print(line)
+    for qname, lyr, oshape in rows:
+        label = f"{qname} ({type(lyr).__name__})"
+        own, _ = _own_param_count(lyr)
+        print(f"{label:<38}{str(oshape):<24}{own:>12,}")
+    print(line)
+
+    total = trainable = 0
+    for _, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+# -- FLOPs counting rules (reference convention: 1 MAC = 1 FLOP) ----------
+
+def _conv_flops(layer, oshape):
+    # output elements * (Cin/groups * prod(kernel) [+1 bias]) — MAC=1
+    w = layer.weight
+    kernel_ops = int(np.prod(w.shape[1:]))  # Cin/groups * prod(k)
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return int(np.prod(oshape)) * (kernel_ops + bias_ops)
+
+
+def _linear_flops(layer, oshape):
+    w = layer.weight
+    in_f, out_f = int(w.shape[0]), int(w.shape[1])
+    nbatch = int(np.prod(oshape[:-1])) if len(oshape) > 1 else 1
+    bias_ops = out_f if getattr(layer, "bias", None) is not None else 0
+    return nbatch * (in_f * out_f + bias_ops)
+
+
+def _norm_flops(layer, oshape):
+    return 2 * int(np.prod(oshape))
+
+
+def _act_flops(layer, oshape):
+    return int(np.prod(oshape))
+
+
+def _pool_flops(layer, oshape):
+    return int(np.prod(oshape))
+
+
+def _default_rules():
+    from .. import nn
+    rules = {}
+    for cls in (nn.Conv1D, nn.Conv2D, nn.Conv3D):
+        rules[cls] = _conv_flops
+    rules[nn.Linear] = _linear_flops
+    for name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+                 "LayerNorm", "GroupNorm", "InstanceNorm1D",
+                 "InstanceNorm2D", "InstanceNorm3D", "RMSNorm"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            rules[cls] = _norm_flops
+    for name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+                 "LeakyReLU", "SiLU", "Hardswish", "PReLU"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            rules[cls] = _act_flops
+    for name in ("AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D",
+                 "MaxPool2D", "MaxPool3D", "AdaptiveAvgPool1D",
+                 "AdaptiveAvgPool2D", "AdaptiveAvgPool3D"):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            rules[cls] = _pool_flops
+    return rules
+
+
+def flops(net: Layer, input_size=None, custom_ops=None, print_detail=False):
+    """Estimate total FLOPs of one forward (reference counting: MAC=1).
+    `custom_ops`: {LayerClass: fn(layer, output_shape) -> int} overrides."""
+    inputs = _make_inputs(input_size, None)
+    rows = _collect(net, inputs)
+    rules = _default_rules()
+    if custom_ops:
+        rules.update(custom_ops)
+
+    total = 0
+    details = []
+    for qname, lyr, oshape in rows:
+        fn = None
+        for cls in type(lyr).__mro__:
+            if cls in rules:
+                fn = rules[cls]
+                break
+        n = int(fn(lyr, oshape)) if fn and oshape else 0
+        total += n
+        details.append((qname, type(lyr).__name__, oshape, n))
+    if print_detail:
+        hdr = f"{'Layer':<38}{'Output Shape':<24}{'FLOPs':>14}"
+        print("-" * len(hdr)); print(hdr); print("-" * len(hdr))
+        for qname, tname, oshape, n in details:
+            print(f"{qname + ' (' + tname + ')':<38}"
+                  f"{str(oshape):<24}{n:>14,}")
+        print("-" * len(hdr))
+    print(f"Total Flops: {total:,}")
+    return total

@@ -22,6 +22,37 @@ from ..core.tensor import Parameter, Tensor, to_tensor
 from . import initializer as I
 
 
+class _LazyGuardState:
+    __slots__ = ("depth",)
+
+    def __init__(self):
+        self.depth = 0
+
+    @property
+    def active(self):
+        return self.depth > 0
+
+
+_LAZY_GUARD = _LazyGuardState()
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity (upstream python/paddle/base/framework.py —
+    unverified, SURVEY.md blocker notice): layers constructed inside the
+    guard defer parameter initialization. Placeholders carry shape/dtype;
+    the initializers run at the layer's first forward (or explicit
+    `layer.materialize_lazy_params()`), so giant models can be described
+    cheaply and initialized directly under a sharding context."""
+
+    def __enter__(self):
+        _LAZY_GUARD.depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY_GUARD.depth -= 1
+        return False
+
+
 class ParamAttr:
     """Reference parity: paddle.ParamAttr — init/regularizer/lr per-param."""
 
@@ -135,8 +166,21 @@ class Layer:
         init = attr.initializer or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        data = init(tuple(shape), d)
-        p = Parameter(data, trainable=attr.trainable, name=attr.name or "")
+        if _LAZY_GUARD.active:
+            # paddle.LazyGuard: defer the initializer — the Parameter
+            # carries a ShapeDtypeStruct placeholder (shape/dtype/ndim
+            # work) and materializes at first forward of its layer.
+            import jax
+            data = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                        np.dtype(d))
+            p = Parameter(data, trainable=attr.trainable,
+                          name=attr.name or "")
+            p._lazy_init = (init, tuple(int(s) for s in shape), d)
+            self.__dict__["_has_lazy_params"] = True
+        else:
+            data = init(tuple(shape), d)
+            p = Parameter(data, trainable=attr.trainable,
+                          name=attr.name or "")
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = attr.need_clip
@@ -250,7 +294,23 @@ class Layer:
         return handle
 
     # -- call ----------------------------------------------------------------
+    def materialize_lazy_params(self):
+        """Run deferred initializers (LazyGuard) on this layer and all
+        sublayers; no-op when nothing is lazy."""
+        for lyr in self.sublayers(include_self=True):
+            if not lyr.__dict__.get("_has_lazy_params"):
+                continue
+            for p in lyr._parameters.values():
+                lazy = getattr(p, "_lazy_init", None)
+                if lazy is not None:
+                    init, shape, d = lazy
+                    p._data = init(shape, d)
+                    del p._lazy_init
+            lyr.__dict__["_has_lazy_params"] = False
+
     def __call__(self, *inputs, **kwargs):
+        if self.__dict__.get("_has_lazy_params"):
+            self.materialize_lazy_params()
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
@@ -315,8 +375,18 @@ class Layer:
     # -- dtype/device movement ------------------------------------------------
     def to(self, device=None, dtype=None, blocking=None):
         if dtype is not None:
+            import jax
             d = dtypes.convert_dtype(dtype)
             for t in list(self.parameters()) + list(self.buffers()):
+                lazy = getattr(t, "_lazy_init", None)
+                if lazy is not None:
+                    # LazyGuard placeholder: retarget the deferred init's
+                    # dtype so materialization lands in the cast dtype
+                    init, shape, old_d = lazy
+                    if dtypes.is_floating_point(old_d):
+                        t._lazy_init = (init, shape, d)
+                        t._data = jax.ShapeDtypeStruct(shape, np.dtype(d))
+                    continue
                 if dtypes.is_floating_point(t._data.dtype):
                     t._inplace_update(t._data.astype(d))
             self._dtype = d
