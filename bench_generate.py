@@ -1,7 +1,7 @@
 """Decode-throughput bench: LLaMA proxy autoregressive generation with
 the static-KV-cache jitted decode loop (models/generation.py).
 
-Usage: python bench_generate.py [batch] [prompt_len] [new_tokens] [--wq int8|int4]
+Usage: python bench_generate.py [batch] [prompt_len] [new_tokens] [--wq int8|int4] [--kv int8]
 `--wq` swaps every linear (except lm_head) to weight-only quantized
 storage before compiling the decode program — decode is HBM-bound, so
 int8/int4 weights target ~2x/4x the streamed bytes.
@@ -20,6 +20,11 @@ wq = None
 if "--wq" in sys.argv:
     i = sys.argv.index("--wq")
     wq = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
+kv = None
+if "--kv" in sys.argv:
+    i = sys.argv.index("--kv")
+    kv = sys.argv[i + 1]
     del sys.argv[i:i + 2]
 batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
@@ -72,7 +77,7 @@ def main():
     # a value derived from the output.
     new_q = max(1, new // 4)
     for warm_n in (new, new_q):   # compile both trip counts
-        out = model.generate(x, max_new_tokens=warm_n)
+        out = model.generate(x, max_new_tokens=warm_n, cache_dtype=kv)
         out._data.block_until_ready()
 
     def timed(n):
@@ -84,7 +89,7 @@ def main():
                                 (batch, prompt)).astype(np.int32)
             x2 = P.to_tensor(ids2)
             t0 = time.perf_counter()
-            out = model.generate(x2, max_new_tokens=n)
+            out = model.generate(x2, max_new_tokens=n, cache_dtype=kv)
             int(np.asarray(out._data).sum())   # dependent fetch
             best = min(best, time.perf_counter() - t0)
         return best
@@ -109,6 +114,7 @@ def main():
                 "static-cache jitted loop)",
         "batch": batch, "prompt": prompt, "new_tokens": new,
         "weight_quant": wq or "none",
+        "kv_cache": kv or "bf16",
         "e2e_tok_per_s": round(tok_s, 1),
         "wall_s": round(dt, 3), "wall_quarter_s": round(dt_q, 3),
         "fixed_overhead_s_est":

@@ -71,7 +71,10 @@ def _collect(net: Layer, inputs):
             return None
         return _hook
 
-    for qname, sub in net.named_sublayers(include_self=False):
+    subs = list(net.named_sublayers(include_self=False))
+    if not subs:  # a leaf net (e.g. bare nn.Linear): report the net itself
+        subs = [(type(net).__name__.lower(), net)]
+    for qname, sub in subs:
         handles.append(sub.register_forward_post_hook(_mk(qname, sub)))
     try:
         with _ag.no_grad():

@@ -37,6 +37,35 @@ from ..core import random as _random
 __all__ = ["GenerationMixin", "cached_attention"]
 
 
+def _normalize_cache_dtype(cache_dtype):
+    """Accept None, "int8", or a float dtype-like; reject the rest.
+    np.int8/jnp.int8 normalize to the quantized path — without this an
+    int8 dtype-like would fall into the raw-buffer branch and astype-
+    truncate K/V to garbage."""
+    if cache_dtype is None:
+        return None
+    try:
+        name = str(jnp.dtype(cache_dtype))
+    except TypeError:
+        name = str(cache_dtype)
+    if name == "int8":
+        return "int8"
+    if name in ("bfloat16", "float16", "float32"):
+        return name
+    raise ValueError(f"unsupported cache_dtype {cache_dtype!r}: use None, "
+                     "'int8' (quantized codes+scales), or a float dtype")
+
+
+def _quantize_q8(x):
+    """Per-(token, head) absmax int8 quantization: [B,S,KV,D] →
+    (codes int8, scales f32 [B,S,KV,1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    codes = jnp.clip(jnp.round(x.astype(jnp.float32) / s),
+                     -127, 127).astype(jnp.int8)
+    return codes, s
+
+
 def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
     """Write k/v at `offset` into the static cache and attend q over the
     whole buffer with the absolute-position causal mask.
@@ -47,19 +76,40 @@ def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
     """
     b, s, nh, d = q.shape
     nkv = k_new.shape[2]
-    T = k_buf.shape[1]
     zero = jnp.zeros((), jnp.int32)
     off = jnp.asarray(offset, jnp.int32)
-    k_buf = jax.lax.dynamic_update_slice(k_buf, k_new.astype(k_buf.dtype),
-                                         (zero, off, zero, zero))
-    v_buf = jax.lax.dynamic_update_slice(v_buf, v_new.astype(v_buf.dtype),
-                                         (zero, off, zero, zero))
+    idx = (zero, off, zero, zero)
+    if isinstance(k_buf, tuple):
+        # int8 KV cache (cache_dtype="int8"): each buffer is
+        # (codes int8 [B,T,KV,D], scales f32 [B,T,KV,1]) with per-token
+        # per-head absmax scales. Decode at batch is KV-cache
+        # HBM-bandwidth-bound (PERF.md round-3 decode analysis) — int8
+        # codes halve the bytes the decode step streams; XLA fuses the
+        # dequant multiply into the attention einsum's loads.
+        kq, ks = k_buf
+        vq, vs = v_buf
+        knq, kns = _quantize_q8(k_new)
+        vnq, vns = _quantize_q8(v_new)
+        kq = jax.lax.dynamic_update_slice(kq, knq, idx)
+        ks = jax.lax.dynamic_update_slice(ks, kns.astype(ks.dtype), idx)
+        vq = jax.lax.dynamic_update_slice(vq, vnq, idx)
+        vs = jax.lax.dynamic_update_slice(vs, vns.astype(vs.dtype), idx)
+        kf = kq.astype(jnp.float32) * ks
+        vf = vq.astype(jnp.float32) * vs
+        k_buf, v_buf = (kq, ks), (vq, vs)
+        T = kq.shape[1]
+    else:
+        T = k_buf.shape[1]
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, k_new.astype(k_buf.dtype), idx)
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, v_new.astype(v_buf.dtype), idx)
+        kf = k_buf.astype(jnp.float32)
+        vf = v_buf.astype(jnp.float32)
     # GQA: group query heads over kv heads via reshape (no materialized
     # head repeat)
     g = nh // nkv
     qg = q.reshape(b, s, nkv, g, d).astype(jnp.float32)
-    kf = k_buf.astype(jnp.float32)
-    vf = v_buf.astype(jnp.float32)
     sc = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * scale
     qpos = off + jnp.arange(s)
     kpos = jnp.arange(T)
@@ -88,7 +138,8 @@ class GenerationMixin:
     @no_grad()
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=None, num_beams=1, length_penalty=0.0):
+                 seed=None, num_beams=1, length_penalty=0.0,
+                 cache_dtype=None):
         """Returns generated token ids [B, max_new_tokens].
 
         num_beams > 1 runs beam search (do_sample must be False): beams
@@ -101,6 +152,7 @@ class GenerationMixin:
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
+        cache_dtype = _normalize_cache_dtype(cache_dtype)
         if int(num_beams) > 1:
             if do_sample:
                 raise NotImplementedError(
@@ -108,7 +160,7 @@ class GenerationMixin:
                     "with do_sample=False, or sampling with num_beams=1")
             return self._beam_generate(ids, int(max_new_tokens),
                                        int(num_beams), eos,
-                                       float(length_penalty))
+                                       float(length_penalty), cache_dtype)
         # weights/buffers enter the compiled program as ARGUMENTS, not
         # jit-captured constants (round 3): baked constants made the
         # serialized program O(model size) — a 0.5B model's decode
@@ -124,13 +176,14 @@ class GenerationMixin:
                 f"({int(max_new_tokens)}) exceeds "
                 f"max_position_embeddings({maxpos})")
         sig = (b, s, int(max_new_tokens), bool(do_sample),
-               float(temperature), int(top_k), float(top_p), eos)
+               float(temperature), int(top_k), float(top_p), eos,
+               cache_dtype)
         fn = self._gen_program(sig)
         if fn is None:
             fn = jax.jit(functools.partial(
                 _generate_pure, self, s, int(max_new_tokens),
                 bool(do_sample), float(temperature), int(top_k),
-                float(top_p), eos))
+                float(top_p), eos, cache_dtype))
             self._gen_cache[sig] = fn
         key = _random.next_key() if seed is None else \
             jax.random.PRNGKey(seed)
@@ -145,7 +198,8 @@ class GenerationMixin:
             if was_training:
                 self.train()
 
-    def _beam_generate(self, ids, max_new, K, eos, lenpen):
+    def _beam_generate(self, ids, max_new, K, eos, lenpen,
+                       cache_dtype=None):
         b, s = ids.shape
         warrs = [t._data for t in self._gen_state_tensors()]
         maxpos = self._max_positions()
@@ -153,11 +207,12 @@ class GenerationMixin:
             raise ValueError(
                 f"generate: prompt_len({s}) + max_new_tokens({max_new}) "
                 f"exceeds max_position_embeddings({maxpos})")
-        sig = (b, s, max_new, "beam", K, eos, lenpen)
+        sig = (b, s, max_new, "beam", K, eos, lenpen, cache_dtype)
         fn = self._gen_program(sig)
         if fn is None:
             fn = jax.jit(functools.partial(
-                _beam_pure, self, s, max_new, K, eos, lenpen))
+                _beam_pure, self, s, max_new, K, eos, lenpen,
+                cache_dtype))
             self._gen_cache[sig] = fn
         was_training = getattr(self, "training", False)
         if was_training:
@@ -195,25 +250,27 @@ def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
-def _beam_pure(model, prompt_len, max_new, K, eos, lenpen, warrs, ids):
+def _beam_pure(model, prompt_len, max_new, K, eos, lenpen,
+               cache_dtype, warrs, ids):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
         return _beam_body(model, prompt_len, max_new, K, eos, lenpen,
-                          ids)
+                          cache_dtype, ids)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
-def _beam_body(model, prompt_len, max_new, K, eos, lenpen, ids):
+def _beam_body(model, prompt_len, max_new, K, eos, lenpen,
+               cache_dtype, ids):
     b = ids.shape[0]
     total = prompt_len + max_new
     # prefill at batch B, then expand caches to B·K beams (row order
     # [b0 beams..., b1 beams...] — matches the gather below)
-    caches = model._init_caches(b, total)
+    caches = model._init_caches(b, total, cache_dtype)
     logits, caches = model._forward_cached(ids, caches, 0)
     lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
     scores, tok0 = jax.lax.top_k(lp, K)              # [B, K]
@@ -262,24 +319,25 @@ def _beam_body(model, prompt_len, max_new, K, eos, lenpen, ids):
 
 
 def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
-                   top_k, top_p, eos, warrs, ids, key):
+                   top_k, top_p, eos, cache_dtype, warrs, ids, key):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
         return _generate_body(model, prompt_len, max_new, do_sample,
-                              temperature, top_k, top_p, eos, ids, key)
+                              temperature, top_k, top_p, eos, cache_dtype,
+                              ids, key)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
 def _generate_body(model, prompt_len, max_new, do_sample, temperature,
-                   top_k, top_p, eos, ids, key):
+                   top_k, top_p, eos, cache_dtype, ids, key):
     b = ids.shape[0]
     total = prompt_len + max_new
-    caches = model._init_caches(b, total)
+    caches = model._init_caches(b, total, cache_dtype)
 
     # prefill: whole prompt in one pass
     logits, caches = model._forward_cached(ids, caches, 0)

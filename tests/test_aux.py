@@ -14,10 +14,19 @@ import paddle_tpu as P
 import paddle_tpu.nn as nn
 
 
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
 class TestNativeTCPStore:
     def test_set_get_add_wait_keys(self):
         from paddle_tpu.native import TCPStore
-        port = 23511
+        port = _free_port()
         master = TCPStore(port=port, is_master=True)
         client = TCPStore(port=port)
         master.set("alpha", b"1")
@@ -35,7 +44,7 @@ class TestNativeTCPStore:
 
     def test_rendezvous_pattern(self):
         from paddle_tpu.native import TCPStore
-        port = 23512
+        port = _free_port()
         master = TCPStore(port=port, is_master=True)
         # two "ranks" register and barrier via counter
         r0 = TCPStore(port=port)
@@ -170,7 +179,7 @@ class TestElastic:
     def test_membership_and_ranks(self):
         from paddle_tpu.distributed.elastic import ElasticManager
         from paddle_tpu.native import TCPStore
-        port = 23513
+        port = _free_port()
         master = TCPStore(port=port, is_master=True)
         m1 = ElasticManager(TCPStore(port=port), node_id="a",
                             heartbeat_interval=0.05, ttl=1.0)

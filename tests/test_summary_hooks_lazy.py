@@ -205,3 +205,11 @@ class TestReviewRegressions:
         net = nn.Sequential(nn.Linear(4, 3))
         with pytest.raises(ValueError):
             paddle.summary(net, [(1, 4), (1, 4)], dtypes=["float32"])
+
+    def test_summary_leaf_net(self, capsys):
+        lin = nn.Linear(4, 3)
+        info = paddle.summary(lin, (1, 4))
+        out = capsys.readouterr().out
+        assert "Linear" in out.split("Layer (type)")[1]
+        assert info["total_params"] == 15
+        assert paddle.flops(lin, (1, 4)) == 1 * (4 * 3 + 3)
