@@ -194,18 +194,11 @@ class GPTForCausalLM(Layer, GenerationMixin):
 
     # -- static-cache generation hooks (GenerationMixin) ---------------------
     def _init_caches(self, batch, total_len, cache_dtype=None):
-        import jax.numpy as _jnp
+        from .generation import init_static_caches
         cfg = self.cfg
         nh = cfg.num_attention_heads
-        hd = cfg.hidden_size // nh
-        if cache_dtype == "int8":
-            zq = _jnp.zeros((batch, total_len, nh, hd), _jnp.int8)
-            zs = _jnp.zeros((batch, total_len, nh, 1), _jnp.float32)
-            return [((zq, zs), (zq, zs))
-                    for _ in range(cfg.num_hidden_layers)]
-        dt = _jnp.float32 if cache_dtype is None else _jnp.dtype(cache_dtype)
-        z = _jnp.zeros((batch, total_len, nh, hd), dt)
-        return [(z, z) for _ in range(cfg.num_hidden_layers)]
+        return init_static_caches(cfg.num_hidden_layers, batch, total_len,
+                                  nh, cfg.hidden_size // nh, cache_dtype)
 
     def _forward_cached(self, input_ids, caches, offset):
         from ..core.tensor import Tensor

@@ -370,21 +370,13 @@ class LlamaForCausalLM(Layer, GenerationMixin):
 
     # -- static-cache generation hooks (GenerationMixin) ---------------------
     def _init_caches(self, batch, total_len, cache_dtype=None):
+        from .generation import init_static_caches
         cfg = self.cfg
         nkv = cfg.num_key_value_heads or cfg.num_attention_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
-        if cache_dtype == "int8":
-            # quantized KV cache: (codes, scales) per buffer — halves the
-            # decode step's dominant HBM stream (generation.py design note)
-            zq = jnp.zeros((batch, total_len, nkv, hd), jnp.int8)
-            zs = jnp.zeros((batch, total_len, nkv, 1), jnp.float32)
-            return [((zq, zs), (zq, zs))
-                    for _ in range(cfg.num_hidden_layers)]
-        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        if cache_dtype is not None:
-            dt = jnp.dtype(cache_dtype)
-        z = jnp.zeros((batch, total_len, nkv, hd), dt)
-        return [(z, z) for _ in range(cfg.num_hidden_layers)]
+        fdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        return init_static_caches(cfg.num_hidden_layers, batch, total_len,
+                                  nkv, hd, cache_dtype, fdt)
 
     def _forward_cached(self, input_ids, caches, offset):
         ids = input_ids if isinstance(input_ids, Tensor) \
