@@ -87,6 +87,27 @@ def in_dynamic_mode():
     return True
 
 
+def in_pir_mode():
+    # static programs here are recorded eagerly (static/program.py), not
+    # interpreted from a separate IR — the dygraph surface stays live
+    return False
+
+
+def in_dynamic_or_pir_mode():
+    return in_dynamic_mode() or in_pir_mode()
+
+
+from .device import (is_compiled_with_cuda, is_compiled_with_rocm,  # noqa: E402,F401
+                     is_compiled_with_xpu)
+
+
+def is_compiled_with_custom_device(device_name):
+    return device_name in ("tpu", "axon")
+
+
+from .ops.logic import histogram_bin_edges  # noqa: E402,F401
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):

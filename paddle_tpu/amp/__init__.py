@@ -49,11 +49,17 @@ amp_guard = auto_cast
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
-             master_weight=None, save_dtype=None):
+             master_weight=None, save_dtype=None, master_grad=False):
     """O2 decoration: cast model params to the low dtype, keeping fp32
     master weights inside the optimizer (reference: paddle.amp.decorate).
+
+    master_grad=True keeps GRADIENTS in fp32 too (reference O2 knob):
+    realized as a per-parameter grad hook casting the cotangent on
+    deposit, so eager multi-step accumulation happens at fp32 precision
+    before the (already fp32, master-weight) optimizer update.
     """
     from ..nn.layer import Layer
+    from ..core.tensor import Tensor as _T
 
     d = dtypes.convert_dtype(dtype)
     single = isinstance(models, Layer)
@@ -65,6 +71,15 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
                     with no_grad():
                         p._master_weight = p._data  # fp32 master copy
                         p._inplace_update(p._data.astype(d))
+        if master_grad:
+            def _to_f32(g):
+                if jnp.dtype(g._data.dtype) == jnp.dtype(jnp.float32):
+                    return None
+                return _T(g._data.astype(jnp.float32),
+                          stop_gradient=True)
+            for m in model_list:
+                for p in m.parameters():
+                    p._hooks.append(_to_f32)
     if optimizers is None:
         return models if single else model_list
     opts = optimizers if not isinstance(optimizers, (list, tuple)) \

@@ -267,6 +267,19 @@ class Tensor:
         return self._inplace_update(jrandom.uniform(
             next_key(), self._data.shape, self._data.dtype, min, max))
 
+    def bernoulli_(self, p=0.5):
+        from .random import next_key
+        import jax.random as jrandom
+        return self._inplace_update(jrandom.bernoulli(
+            next_key(), p, self._data.shape).astype(self._data.dtype))
+
+    def exponential_(self, lam=1.0):
+        from .random import next_key
+        import jax.random as jrandom
+        return self._inplace_update(
+            (jrandom.exponential(next_key(), self._data.shape)
+             / lam).astype(self._data.dtype))
+
     # -- misc --------------------------------------------------------------
     def block_until_ready(self):
         if not _is_tracer(self._data):

@@ -40,6 +40,7 @@ class _DeviceNamespace:
 
 tpu = _DeviceNamespace()
 cuda = _DeviceNamespace()  # API-compat alias so ported scripts run
+xpu = _DeviceNamespace()   # same, for XPU-targeting scripts
 
 
 def is_compiled_with_cuda():

@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed — MoE model home (reference: upstream
+python/paddle/incubate/distributed/models/moe/ — unverified, SURVEY.md
+§2.3 Expert parallel row). The TPU-native MoE (GShard gate, alltoall
+dispatch over the 'ep' mesh axis) lives in incubate/moe.py; this package
+provides the reference import path.
+"""
+from . import models  # noqa: F401

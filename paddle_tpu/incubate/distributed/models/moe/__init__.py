@@ -1,0 +1,10 @@
+"""Reference import path for the MoE layer family
+(paddle.incubate.distributed.models.moe.MoELayer et al.)."""
+from ....moe import (MoELayer, TopKGate,  # noqa: F401
+                     global_gather, global_scatter)
+
+GShardGate = TopKGate  # reference gate names map onto the top-k gate
+SwitchGate = TopKGate  # (k=1) — same GShard dispatch math
+
+__all__ = ["MoELayer", "TopKGate", "GShardGate", "SwitchGate",
+           "global_scatter", "global_gather"]

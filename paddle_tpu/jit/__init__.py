@@ -26,7 +26,7 @@ from .to_static import ignore_module, not_to_static, to_static  # noqa: F401
 from .save_load import load, save  # noqa: F401
 
 
-from .save_load import TranslatedLayer  # noqa: E402,F401
+from .save_load import TracedLayer, TranslatedLayer  # noqa: E402,F401
 
 
 def _ts_module():

@@ -180,3 +180,68 @@ class TranslatedLayer(Layer):
 
 def load(path, **config):
     return TranslatedLayer(path)
+
+
+class TracedLayer:
+    """Legacy dygraph tracing API (reference: paddle.jit.TracedLayer,
+    upstream python/paddle/fluid/dygraph/jit.py — unverified, SURVEY.md
+    blocker notice). `trace(layer, inputs)` returns (eager_out, traced);
+    the traced object replays one jitted XLA program per input signature
+    and saves via the StableHLO deployment path."""
+
+    def __init__(self, layer, example_inputs, multi_out=None):
+        self._layer = layer
+        self._specs = [InputSpec(shape=list(x.shape),
+                                 dtype=x._data.dtype)
+                       for x in example_inputs]
+        self._programs = {}
+        self._multi = multi_out  # None → determined at first replay
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        inputs = list(inputs)
+        out = layer(*inputs)
+        return out, cls(layer, inputs,
+                        multi_out=isinstance(out, (tuple, list)))
+
+    def _state(self):
+        """Params AND buffers thread as program arguments (the CLAUDE.md
+        invariant: jit-captured weights are constants — a cache must see
+        every mutable array as an argument, not bake it)."""
+        layer = self._layer
+        return (list(layer.named_parameters())
+                + list(layer.named_buffers()))
+
+    def __call__(self, inputs):
+        import jax
+        from ..core.tensor import Tensor
+        inputs = list(inputs)
+        if self._multi is None:
+            self._multi = isinstance(self._layer(*inputs), (tuple, list))
+        sig = tuple((tuple(x.shape), str(x._data.dtype)) for x in inputs)
+        fn = self._programs.get(sig)
+        if fn is None:
+            layer = self._layer
+            state = self._state()
+
+            @jax.jit
+            def fn(svals, arrs):
+                saved = [(t, t._data) for _, t in state]
+                for (_, t), a in zip(state, svals):
+                    t._data = a
+                try:
+                    outs = layer(*[Tensor(a) for a in arrs])
+                finally:
+                    for t, a in saved:
+                        t._data = a
+                multi = isinstance(outs, (tuple, list))
+                return [o._data for o in (outs if multi else [outs])]
+
+            self._programs[sig] = fn
+        svals = [t._data for _, t in self._state()]
+        outs = fn(svals, [x._data for x in inputs])
+        res = [Tensor(o) for o in outs]
+        return tuple(res) if self._multi else res[0]
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path, input_spec=self._specs)

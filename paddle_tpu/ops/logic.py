@@ -187,18 +187,33 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     return res[0] if len(res) == 1 else tuple(res)
 
 
+def _hist_range(a, min, max):
+    """Shared paddle histogram range rule: min==max==0 → data range."""
+    if min == 0 and max == 0:
+        return jnp.min(a), jnp.max(a)
+    return min, max
+
+
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
               name=None):
     x = ensure_tensor(input)
     a = x._data
-    if min == 0 and max == 0:
-        lo, hi = jnp.min(a), jnp.max(a)
-    else:
-        lo, hi = min, max
+    lo, hi = _hist_range(a, min, max)
     w = weight._data if weight is not None else None
     hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w,
                             density=density)
     return Tensor(hist)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """paddle.histogram_bin_edges parity: the edges histogram() uses
+    (same min==max==0 auto-range rule, shared above)."""
+    x = ensure_tensor(input)
+    lo, hi = _hist_range(x._data, min, max)
+    eq = jnp.asarray(lo) == jnp.asarray(hi)   # degenerate range → widen
+    lo = jnp.where(eq, jnp.asarray(lo, jnp.float32) - 0.5, lo)
+    hi = jnp.where(eq, jnp.asarray(hi, jnp.float32) + 0.5, hi)
+    return Tensor(jnp.linspace(lo, hi, int(bins) + 1))
 
 
 def bincount(x, weights=None, minlength=0, name=None):

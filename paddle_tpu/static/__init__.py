@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 
 from ..jit.save_load import InputSpec, TranslatedLayer  # noqa: F401
+from . import amp  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
 from . import nn  # noqa: F401

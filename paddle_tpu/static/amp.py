@@ -1,0 +1,15 @@
+"""paddle.static.amp — static-graph AMP namespace (reference: upstream
+python/paddle/static/amp/ — unverified, SURVEY.md blocker notice).
+
+The dynamic amp module's auto_cast/decorate/GradScaler compose with the
+static recorder (tested in tests/test_static_training.py's AMP case), so
+the static namespace is the same machinery re-exported — the reference's
+separate static rewrite pass collapses under trace-and-compile.
+"""
+from ..amp import (GradScaler, auto_cast, decorate)  # noqa: F401
+
+amp_guard = auto_cast          # legacy alias (fluid.dygraph.amp_guard)
+amp_decorate = decorate        # legacy alias
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard",
+           "amp_decorate"]

@@ -1,0 +1,162 @@
+"""Second round-3 API tranche: in-place random family, amp master_grad,
+static.amp, incubate.distributed.models.moe path, is_compiled_with_*,
+histogram_bin_edges, jit.TracedLayer, device.xpu.
+
+Reference surfaces per SURVEY.md §2.2 (upstream paths unverified, empty
+mount).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestInplaceRandom:
+    def test_bernoulli_(self):
+        t = paddle.to_tensor(np.zeros((2000,), np.float32))
+        t.bernoulli_(p=0.3)
+        vals = t.numpy()
+        assert set(np.unique(vals)).issubset({0.0, 1.0})
+        assert 0.2 < vals.mean() < 0.4
+
+    def test_exponential_(self):
+        t = paddle.to_tensor(np.zeros((4000,), np.float32))
+        t.exponential_(lam=2.0)
+        vals = t.numpy()
+        assert (vals >= 0).all()
+        assert abs(vals.mean() - 0.5) < 0.1  # E = 1/lam
+
+    def test_version_bumped(self):
+        t = paddle.to_tensor(np.zeros((4,), np.float32))
+        v0 = t._version
+        t.bernoulli_()
+        assert t._version == v0 + 1
+
+
+class TestMasterGrad:
+    def test_grads_cast_to_fp32(self):
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        model, _ = paddle.amp.decorate(lin, opt, level="O2",
+                                       dtype="bfloat16", master_grad=True)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = paddle.sum(model(x))
+        loss.backward()
+        assert str(np.dtype(model.weight.grad._data.dtype)) == "float32"
+        assert str(np.dtype(model.weight._data.dtype)) == "bfloat16"
+
+    def test_off_by_default(self):
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        model, _ = paddle.amp.decorate(lin, opt, level="O2",
+                                       dtype="bfloat16")
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = paddle.sum(model(x))
+        loss.backward()
+        assert str(np.dtype(model.weight.grad._data.dtype)) == "bfloat16"
+
+
+class TestNamespaceAliases:
+    def test_static_amp(self):
+        assert paddle.static.amp.decorate is paddle.amp.decorate
+        assert paddle.static.amp.amp_guard is paddle.amp.auto_cast
+
+    def test_incubate_distributed_moe_path(self):
+        from paddle_tpu.incubate.distributed.models.moe import (
+            GShardGate, MoELayer, SwitchGate, global_scatter)
+        from paddle_tpu.incubate.moe import MoELayer as impl
+        assert MoELayer is impl
+        assert callable(global_scatter)
+
+    def test_is_compiled_with(self):
+        assert paddle.is_compiled_with_cuda() is False
+        assert paddle.is_compiled_with_xpu() is False
+        assert paddle.is_compiled_with_rocm() is False
+        assert paddle.is_compiled_with_custom_device("tpu") is True
+        assert paddle.is_compiled_with_custom_device("npu") is False
+
+    def test_mode_predicates(self):
+        assert paddle.in_dynamic_or_pir_mode() is True
+        assert paddle.in_pir_mode() is False
+
+    def test_device_xpu_namespace(self):
+        assert hasattr(paddle.device, "xpu")
+
+
+class TestHistogramBinEdges:
+    def test_matches_numpy(self):
+        x = np.random.default_rng(0).standard_normal(100).astype(np.float32)
+        got = paddle.histogram_bin_edges(paddle.to_tensor(x), bins=8).numpy()
+        ref = np.histogram_bin_edges(x, bins=8)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_explicit_range(self):
+        x = paddle.to_tensor(np.zeros(4, np.float32))
+        got = paddle.histogram_bin_edges(x, bins=4, min=1.0, max=3.0).numpy()
+        np.testing.assert_allclose(got, np.linspace(1.0, 3.0, 5))
+
+    def test_degenerate_range_widens(self):
+        x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        got = paddle.histogram_bin_edges(x, bins=2).numpy()
+        np.testing.assert_allclose(got, [1.5, 2.0, 2.5])
+
+
+class TestTracedLayer:
+    def test_trace_and_replay(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out, traced = paddle.jit.TracedLayer.trace(lin, [x])
+        rep = traced([x])
+        np.testing.assert_allclose(rep.numpy(), out.numpy(), rtol=1e-6)
+
+    def test_weight_update_visible(self):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        _, traced = paddle.jit.TracedLayer.trace(lin, [x])
+        before = traced([x]).numpy()
+        lin.weight.set_value(lin.weight.numpy() * 2)
+        after = traced([x]).numpy()
+        np.testing.assert_allclose(after, before * 2, rtol=1e-5)
+
+    def test_buffer_update_visible(self):
+        class WithBuf(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.register_buffer("shift", paddle.to_tensor(
+                    np.ones(4, np.float32)))
+
+            def forward(self, x):
+                return x + self.shift
+
+        net = WithBuf()
+        x = paddle.to_tensor(np.zeros((1, 4), np.float32))
+        _, traced = paddle.jit.TracedLayer.trace(net, [x])
+        np.testing.assert_allclose(traced([x]).numpy(), np.ones((1, 4)))
+        net._buffers["shift"].set_value(np.full(4, 6.0, np.float32))
+        np.testing.assert_allclose(traced([x]).numpy(),
+                                   np.full((1, 4), 6.0))
+
+    def test_multi_output_structure(self):
+        class Two(nn.Layer):
+            def forward(self, x):
+                return x + 1, x * 2
+
+        net = Two()
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        out, traced = paddle.jit.TracedLayer.trace(net, [x])
+        rep = traced([x])
+        assert isinstance(rep, tuple) and len(rep) == 2
+        np.testing.assert_allclose(rep[0].numpy(), out[0].numpy())
+
+    def test_save_inference_model(self, tmp_path):
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        _, traced = paddle.jit.TracedLayer.trace(lin, [x])
+        path = str(tmp_path / "traced_model")
+        traced.save_inference_model(path)
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), lin(x).numpy(),
+                                   rtol=1e-5)
