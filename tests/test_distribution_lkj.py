@@ -1,0 +1,64 @@
+"""LKJCholesky — torch oracle parity (SURVEY.md §4 OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.distribution as D
+
+
+class TestLKJCholesky:
+    def test_samples_are_cholesky_of_correlation(self):
+        d = D.LKJCholesky(5, 1.0)
+        L = d.sample((32,)).numpy()
+        R = L @ L.transpose(0, 2, 1)
+        np.testing.assert_allclose(np.diagonal(R, axis1=1, axis2=2), 1.0,
+                                   atol=1e-5)
+        assert np.linalg.eigvalsh(R).min() > -1e-5
+        assert np.allclose(np.triu(L, 1), 0)  # lower-triangular
+
+    @pytest.mark.parametrize("dim,eta", [(2, 1.0), (3, 2.0), (4, 1.5),
+                                         (6, 0.5)])
+    def test_log_prob_matches_torch(self, dim, eta):
+        torch = pytest.importorskip("torch")
+        d = D.LKJCholesky(dim, eta)
+        L = d.sample((8,))
+        lp = d.log_prob(L).numpy()
+        ref = torch.distributions.LKJCholesky(dim, eta).log_prob(
+            torch.from_numpy(L.numpy().copy())).numpy()
+        np.testing.assert_allclose(lp, ref, rtol=1e-4, atol=1e-4)
+
+    def test_sampler_moments_match_theory(self):
+        # LKJ marginal: r_ij ~ 2·Beta(a, a) − 1 with a = eta − 1 + d/2,
+        # so std = 1/sqrt(2a+1), identical for EVERY off-diagonal entry.
+        # (The torch SAMPLER is not used as oracle here: its onion
+        # implementation gives std≈0.43 for rows ≥3 where the exact
+        # marginal — confirmed by an independent rejection sampler from
+        # det(R)^(eta−1) — is 0.378 at d=4, eta=2. torch's log_prob IS
+        # exact and is oracled in test_log_prob_matches_torch.)
+        d, eta = 4, 2.0
+        a = eta - 1 + d / 2
+        theory_std = (1.0 / (2 * a + 1)) ** 0.5
+        ours = D.LKJCholesky(d, eta).sample((6000,)).numpy()
+        Ro = ours @ ours.transpose(0, 2, 1)
+        for (i, j) in [(0, 1), (1, 2), (0, 3), (2, 3)]:
+            assert abs(Ro[:, i, j].mean()) < 0.03
+            assert abs(Ro[:, i, j].std() - theory_std) < 0.02
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            D.LKJCholesky(1)
+        with pytest.raises(ValueError):
+            D.LKJCholesky(3, sample_method="bogus")
+
+    def test_log_prob_grad_flows(self):
+        import paddle_tpu as paddle
+        d = D.LKJCholesky(3, paddle.to_tensor(2.0, stop_gradient=False))
+        L = d.sample()
+        L.stop_gradient = False
+        lp = d.log_prob(L)
+        lp.backward()
+        assert L.grad is not None
+        assert d.concentration.grad is not None
+
+    def test_negative_concentration_rejected(self):
+        with pytest.raises(ValueError):
+            D.LKJCholesky(3, -1.0)
