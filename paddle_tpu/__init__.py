@@ -108,6 +108,31 @@ def is_compiled_with_custom_device(device_name):
 from .ops.logic import histogram_bin_edges  # noqa: E402,F401
 
 
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions: Tensor repr goes through numpy, so this
+    maps onto numpy's global print options."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Reference parity no-op: the C++ runtime's SIGSEGV/SIGBUS hooks
+    don't exist here (Python-native + XLA runtime)."""
+    return None
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):

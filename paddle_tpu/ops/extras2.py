@@ -237,3 +237,137 @@ def row_stack(x, name=None):
 
 def positive(x, name=None):
     return apply(lambda a: +a, ensure_tensor(x), name="positive")
+
+
+# -- round-3 top-level sweep closure (reference names, SURVEY.md §2.2) ----
+
+def add_n(inputs, name=None):
+    """paddle.add_n: elementwise sum of a list of tensors."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = [ensure_tensor(t) for t in inputs]
+    if not ts:
+        raise ValueError("add_n expects a non-empty tensor list")
+    out = ts[0]
+    for t in ts[1:]:
+        out = out + t
+    return out
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place diagonal fill (see Tensor.fill_diagonal_ for the
+    reference's in-place form). 2-D: main/offset diagonal, with
+    wrap=True restarting the diagonal every (m+1) rows on tall
+    matrices; ndim>2 (all dims equal): the hyper-diagonal a[i,i,...,i].
+    """
+    x = ensure_tensor(x)
+    nd = x._data.ndim
+    if nd < 2:
+        raise ValueError("fill_diagonal expects ndim >= 2")
+    if nd > 2:
+        dims = set(x._data.shape)
+        if len(dims) != 1:
+            raise ValueError("fill_diagonal with ndim > 2 requires all "
+                             "dimensions equal (reference semantics)")
+        if offset or wrap:
+            raise ValueError("offset/wrap apply to 2-D inputs only")
+
+        def f_nd(a):
+            i = jnp.arange(a.shape[0])
+            return a.at[tuple([i] * a.ndim)].set(value)
+
+        return apply(f_nd, x, name="fill_diagonal")
+
+    if wrap and offset:
+        raise ValueError("wrap=True composes with offset=0 only")
+
+    def f(a):
+        n, m = a.shape
+        if wrap and offset == 0:
+            flat = a.reshape(-1)
+            idx = jnp.arange(0, n * m, m + 1)
+            return flat.at[idx].set(value).reshape(n, m)
+        i = jnp.arange(max(0, min(n - max(-offset, 0),
+                                  m - max(offset, 0))))
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        return a.at[rows, cols].set(value)
+
+    return apply(f, x, name="fill_diagonal")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    out = fill_diagonal(x, value, offset=offset, wrap=wrap)
+    return x._inplace_update(out._data)
+
+
+def i0e(x, name=None):
+    import jax.scipy.special as jss
+    return apply(lambda a: jss.i0e(a), ensure_tensor(x), name="i0e")
+
+
+def i1e(x, name=None):
+    import jax.scipy.special as jss
+    return apply(lambda a: jss.i1e(a), ensure_tensor(x), name="i1e")
+
+
+def is_integer(x):
+    from ..core import dtype as _dt
+    return _dt.is_integer(ensure_tensor(x)._data.dtype)
+
+
+def multigammaln(x, p, name=None):
+    import jax.scipy.special as jss
+    return apply(lambda a: jss.multigammaln(a, int(p)), ensure_tensor(x),
+                 name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    import jax.scipy.special as jss
+    return apply(lambda a: jss.polygamma(int(n), a), ensure_tensor(x),
+                 name="polygamma")
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x)._data.ndim, jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recompute global embedding indices into a shard's local range
+    (reference: the TP vocab-sharding helper): indices owned by
+    `shard_id` map to [0, shard_size); the rest become ignore_value."""
+    if not (0 <= int(shard_id) < int(nshards)):
+        raise ValueError(f"shard_id {shard_id} out of range [0, {nshards})")
+    x = ensure_tensor(input)
+    shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = int(shard_id) * shard_size
+
+    def f(a):
+        local = a - lo
+        mine = (a >= lo) & (a < lo + shard_size)
+        return jnp.where(mine, local, ignore_value).astype(a.dtype)
+
+    return apply(f, x, name="shard_index")
+
+
+def signbit(x, name=None):
+    return Tensor(jnp.signbit(ensure_tensor(x)._data))
+
+
+def sinc(x, name=None):
+    return apply(lambda a: jnp.sinc(a), ensure_tensor(x), name="sinc")
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def view_as(x, other, name=None):
+    other = ensure_tensor(other)
+    return ensure_tensor(x).reshape(list(other.shape))
+
+
+__all__ += ["add_n", "fill_diagonal", "fill_diagonal_", "i0e", "i1e",
+            "is_integer", "multigammaln", "polygamma", "rank",
+            "shard_index", "signbit", "sinc", "tolist", "view_as"]

@@ -1,0 +1,118 @@
+"""Round-3 top-level sweep closure ops — torch/scipy oracles per
+SURVEY.md §4."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSweepOps:
+    def test_add_n(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        b = paddle.to_tensor(np.full((2, 2), 2.0, np.float32))
+        np.testing.assert_allclose(paddle.add_n([a, b]).numpy(), 3.0)
+
+    def test_add_n_grad(self):
+        a = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        paddle.sum(paddle.add_n([a, a])).backward()
+        np.testing.assert_allclose(a.grad.numpy(), 2.0 * np.ones(3))
+
+    def test_fill_diagonal_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.default_rng(0).standard_normal((4, 5)).astype(
+            np.float32)
+        got = paddle.fill_diagonal(paddle.to_tensor(x), 7.0).numpy()
+        ref = torch.from_numpy(x.copy())
+        ref.fill_diagonal_(7.0)
+        np.testing.assert_allclose(got, ref.numpy())
+
+    def test_fill_diagonal_inplace(self):
+        t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        t.fill_diagonal_(1.0)
+        np.testing.assert_allclose(t.numpy(), np.eye(3))
+
+    def test_bessel_scaled_match_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(0.1, 5, 20).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.i0e(paddle.to_tensor(x)).numpy(),
+            torch.special.i0e(torch.from_numpy(x)).numpy(), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.i1e(paddle.to_tensor(x)).numpy(),
+            torch.special.i1e(torch.from_numpy(x)).numpy(), rtol=1e-4)
+
+    def test_polygamma_multigammaln_match_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(1.5, 4, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.polygamma(paddle.to_tensor(x), 1).numpy(),
+            torch.special.polygamma(1, torch.from_numpy(x)).numpy(),
+            rtol=1e-3)
+        np.testing.assert_allclose(
+            paddle.multigammaln(paddle.to_tensor(x), 2).numpy(),
+            torch.special.multigammaln(torch.from_numpy(x), 2).numpy(),
+            rtol=1e-4)
+
+    def test_sinc_signbit(self):
+        torch = pytest.importorskip("torch")
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.sinc(paddle.to_tensor(x)).numpy(),
+            torch.sinc(torch.from_numpy(x)).numpy(), rtol=1e-5,
+            atol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.signbit(paddle.to_tensor(x)).numpy(),
+            np.signbit(x))
+
+    def test_shard_index(self):
+        idx = paddle.to_tensor(np.array([0, 4, 5, 9, 3], np.int32))
+        out = paddle.shard_index(idx, index_num=10, nshards=2, shard_id=1)
+        np.testing.assert_array_equal(out.numpy(), [-1, -1, 0, 4, -1])
+        with pytest.raises(ValueError):
+            paddle.shard_index(idx, 10, 2, 5)
+
+    def test_rank_is_integer_view_as(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        assert int(paddle.rank(x).numpy()) == 2
+        assert paddle.is_integer(paddle.to_tensor([1])) is True
+        assert paddle.is_integer(x) is False
+        y = paddle.view_as(x, paddle.to_tensor(np.zeros(6)))
+        assert list(y.shape) == [6]
+
+    def test_set_printoptions(self):
+        paddle.set_printoptions(precision=2)
+        s = repr(paddle.to_tensor(np.array([1.23456], np.float32)))
+        assert "1.23" in s and "1.2345" not in s
+        paddle.set_printoptions(precision=8)
+
+    def test_disable_signal_handler_noop(self):
+        assert paddle.disable_signal_handler() is None
+
+
+class TestSweepOpsReviewRegressions:
+    def test_add_n_not_a_method(self):
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        assert not hasattr(t, "add_n")
+
+    def test_add_n_empty_raises(self):
+        with pytest.raises(ValueError):
+            paddle.add_n([])
+
+    def test_fill_diagonal_wrap_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.zeros((7, 3), np.float32)
+        got = paddle.fill_diagonal(paddle.to_tensor(x), 5.0,
+                                   wrap=True).numpy()
+        ref = torch.from_numpy(x.copy())
+        ref.fill_diagonal_(5.0, wrap=True)
+        np.testing.assert_allclose(got, ref.numpy())
+
+    def test_fill_diagonal_3d_hyperdiagonal(self):
+        torch = pytest.importorskip("torch")
+        x = np.zeros((3, 3, 3), np.float32)
+        got = paddle.fill_diagonal(paddle.to_tensor(x), 2.0).numpy()
+        ref = torch.from_numpy(x.copy())
+        ref.fill_diagonal_(2.0)
+        np.testing.assert_allclose(got, ref.numpy())
+        with pytest.raises(ValueError):
+            paddle.fill_diagonal(paddle.to_tensor(np.zeros((2, 3, 3))), 1.0)
