@@ -30,10 +30,17 @@ Two SOT-tier pre-passes run before the lowering (round-3):
   grafted into the non-escaping branch). for-range desugars to a while
   with an explicit induction variable whose increment replays at each
   `continue` join (Python's iterator-steps-at-loop-top semantics).
+- **return-in-loop extraction** (round-3b): `return expr` directly under
+  a loop becomes flag-set + break, with ``if flag: return expr`` emitted
+  AFTER the loop — `expr` evaluates on the state carried out at the
+  break, which is what the in-loop return saw (tracing is side-effect-
+  free, so deferring is sound). Nested loops compose bottom-up: an inner
+  loop's extracted return surfaces as a conditional return for the outer
+  pass to extract again.
 
 The transform is best-effort and safe: constructs it can't lower
-(returns inside traced loops, loop-else with break, zero-arg super(),
-global/nonlocal) are left untouched — tracing then raises and
+(loop-else with break, returns under try within a loop, zero-arg
+super(), global/nonlocal) are left untouched — tracing then raises and
 `to_static` falls back to eager, recording the graph-break reason (the
 SOT-fallback contract; see `paddle_tpu.jit.graph_break_report`).
 """
@@ -606,37 +613,117 @@ class _PreLower:
         new = list(branch) + self._copy(tail)
         return self._join_returns(new)
 
+    # -- return-in-loop extraction -------------------------------------------
+    def _extract_loop_returns(self, st):
+        """Rewrite `return expr` directly under this loop into
+        flag-set + break, moving `expr` to a post-loop
+        ``if flag: return expr`` — evaluated on the state carried out at
+        the break, which is exactly the state the in-loop return saw.
+        Nested loops were processed bottom-up already, so their returns
+        surface here as plain conditional returns.
+
+        Tracing contract (same as every lax.cond branch this module
+        emits): Python-level side effects inside the return expression
+        fire once at trace time even if the return path is never taken
+        at runtime — previously a return-in-loop guaranteed eager
+        fallback and exact side-effect counts. Pure-trace code (the
+        to_static contract) is unaffected.
+
+        Returns (new_loop, prologue, post) or (None, ..) to bail."""
+        st = copy.deepcopy(st)
+        self.budget -= sum(1 for _ in ast.walk(st))
+        if self.budget <= 0:
+            return None, [], []
+        rets = []
+        outer = self
+
+        class R(ast.NodeTransformer):
+            # returns under nested scopes belong to those scopes
+            def visit_FunctionDef(self, node):
+                return node
+
+            def visit_AsyncFunctionDef(self, node):
+                return node
+
+            def visit_Lambda(self, node):
+                return node
+
+            def visit_While(self, node):
+                return node
+
+            def visit_For(self, node):
+                return node
+
+            def visit_Try(self, node):
+                R._bail = True
+                return node
+
+            def visit_Return(self, node):
+                flag = f"_jstf_ret{outer._uid()}"
+                rets.append((flag, node.value))
+                return [outer._assign(flag, ast.Constant(True)),
+                        ast.Break()]
+
+        R._bail = False
+        st.body = [R().visit(s) for s in st.body]
+        # NodeTransformer list-returns only splice inside visited bodies;
+        # flatten any top-level lists it produced
+        flat = []
+        for s in st.body:
+            flat.extend(s if isinstance(s, list) else [s])
+        st.body = flat
+        if R._bail or not rets:
+            return None, [], []
+        prologue = [self._assign(f, ast.Constant(False)) for f, _ in rets]
+        post = []
+        for f, expr in rets:
+            post.append(ast.If(test=_name(f),
+                               body=[ast.Return(value=expr)], orelse=[]))
+        return st, prologue, post
+
     # -- loop-escape lowering ------------------------------------------------
     def _maybe_desugar_loop(self, st):
-        if not _has_loop_escape(st.body):
+        if not _has_loop_escape(st.body) and not _has_return(st.body):
             return st
         if st.orelse:
             return st        # loop-else + break semantics: keep Python
+        orig = st  # any bail below must return the UNMODIFIED loop
+        prologue_ret, post_ret = [], []
         if _has_return(st.body):
-            # a return inside a traced loop has no typable carry slot;
-            # leave untouched (concrete loops still run eagerly)
-            return st
+            new_st, prologue_ret, post_ret = self._extract_loop_returns(st)
+            if new_st is None:
+                # untypable form (return under try/…): keep Python loop
+                return orig
+            st = new_st
         if not _escapes_only_under_ifs(st.body):
             # an escape under Try/With/etc cannot be rewritten by
             # _lower_escapes — desugaring would skip it (e.g. a continue
             # in an except handler would bypass the for-loop increment
             # and spin forever); keep the Python loop
-            return st
+            return orig
         if self.budget <= 0:
-            return st
+            return orig
+        lowered = None
         try:
             if isinstance(st, ast.While) and \
                     not _assigned_names([st.test]):
                 # (walrus in the test would bind inside the generated
                 # thunk lambda's scope — same guard as visit_While)
-                return self._desugar_while(st)
-            if (isinstance(st, ast.For) and isinstance(st.target, ast.Name)
+                lowered = self._desugar_while(st)
+            elif (isinstance(st, ast.For)
+                    and isinstance(st.target, ast.Name)
                     and _is_range_call(st.iter)
                     and not _assigned_names([st.iter])):
-                return self._desugar_for(st)
+                lowered = self._desugar_for(st)
         except _BudgetExceeded:
-            pass  # graft blowup: keep the Python loop (eager fallback)
-        return st
+            lowered = None   # graft blowup: keep the Python loop (eager)
+        if lowered is None:
+            return orig
+        if prologue_ret or post_ret:
+            self.changed = True
+            low = lowered if isinstance(lowered, list) else [lowered]
+            return prologue_ret + low + post_ret
+        return lowered
 
     def _assign(self, name, value):
         return ast.Assign(targets=[_name(name, ast.Store())], value=value)

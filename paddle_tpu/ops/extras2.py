@@ -248,8 +248,10 @@ def add_n(inputs, name=None):
     ts = [ensure_tensor(t) for t in inputs]
     if not ts:
         raise ValueError("add_n expects a non-empty tensor list")
-    out = ts[0]
-    for t in ts[1:]:
+    if len(ts) == 1:  # fresh tensor, never an alias of the input
+        return apply(lambda a: a + 0, ts[0], name="add_n")
+    out = ts[0] + ts[1]
+    for t in ts[2:]:
         out = out + t
     return out
 

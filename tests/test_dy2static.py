@@ -503,9 +503,10 @@ class TestSOTLite:
         assert np.allclose(x.grad.numpy(), [2048.0])
         _compiled_ok(f)
 
-    def test_return_in_traced_loop_still_falls_back(self):
-        """A return inside a traced loop has no typable carry — the
-        documented graph-break."""
+    def test_return_in_traced_loop_compiles(self):
+        """Round-3b: `return` inside a traced loop desugars to
+        flag+break with the return expression moved post-loop
+        (evaluated on the carried break-state) — no graph break."""
         @to_static
         def f(x):
             while x.sum() < 100.0:
@@ -516,7 +517,113 @@ class TestSOTLite:
 
         out = f(t([1.0]))
         assert np.allclose(out.numpy(), [16.0])
-        assert f.graph_break_reasons
+        assert not f.graph_break_reasons
+
+    def test_return_in_loop_value_uses_break_state(self):
+        @to_static
+        def f(x):
+            i = 0
+            acc = x * 0.0
+            while i < 10:
+                acc = acc + x * (i + 1)
+                if acc.sum() > 5.0:
+                    return acc + i        # state AT the break
+                i = i + 1
+            return acc - 1.0
+
+        # eager oracle
+        def ref(xv):
+            i, acc = 0, xv * 0.0
+            while i < 10:
+                acc = acc + xv * (i + 1)
+                if acc.sum() > 5.0:
+                    return acc + i
+                i = i + 1
+            return acc - 1.0
+
+        for v in ([0.4], [3.0], [0.01]):
+            out = f(t(v))
+            np.testing.assert_allclose(out.numpy(),
+                                       ref(np.asarray(v, np.float32)),
+                                       rtol=1e-6)
+        assert not f.graph_break_reasons
+
+    def test_multiple_returns_in_loop(self):
+        @to_static
+        def f(x):
+            n = 0
+            while n < 8:
+                x = x + 1.0
+                if x.sum() > 6.0:
+                    return x * 10.0
+                if x.sum() < -6.0:
+                    return x * -10.0
+                n = n + 1
+            return x
+
+        def ref(xv):
+            n = 0
+            while n < 8:
+                xv = xv + 1.0
+                if xv.sum() > 6.0:
+                    return xv * 10.0
+                if xv.sum() < -6.0:
+                    return xv * -10.0
+                n = n + 1
+            return xv
+
+        for v in ([0.5], [-20.0], [-3.5]):
+            np.testing.assert_allclose(
+                f(t(v)).numpy(), ref(np.asarray(v, np.float32)),
+                rtol=1e-6)
+        assert not f.graph_break_reasons
+
+    def test_return_in_nested_loop(self):
+        @to_static
+        def f(x):
+            i = 0
+            while i < 4:
+                j = 0
+                while j < 4:
+                    x = x + 1.0
+                    if x.sum() > 5.0:
+                        return x * 2.0    # exits BOTH loops
+                    j = j + 1
+                i = i + 1
+            return x
+
+        def ref(xv):
+            i = 0
+            while i < 4:
+                j = 0
+                while j < 4:
+                    xv = xv + 1.0
+                    if xv.sum() > 5.0:
+                        return xv * 2.0
+                    j = j + 1
+                i = i + 1
+            return xv
+
+        for v in ([0.0], [-30.0]):
+            np.testing.assert_allclose(
+                f(t(v)).numpy(), ref(np.asarray(v, np.float32)),
+                rtol=1e-6)
+        assert not f.graph_break_reasons
+
+    def test_valued_return_in_for_range(self):
+        @to_static
+        def f(x):
+            out = x * 0.0
+            for i in range(6):
+                out = out + x
+                if out.sum() > 3.0:
+                    return out
+            return out * 0.5
+
+        np.testing.assert_allclose(f(t([1.0])).numpy(), [4.0])
+        np.testing.assert_allclose(f(t([0.1])).numpy(), [0.3],
+                                   rtol=1e-5)
+        assert not f.graph_break_reasons
 
     def test_dead_code_after_full_return_dropped(self):
         @to_static
@@ -579,3 +686,29 @@ class TestSOTLite:
             return x
 
         assert np.allclose(f(t([0.0])).numpy(), [3.0])
+
+
+class TestReturnInLoopContract:
+    def test_valueless_return_in_loop_falls_back(self):
+        """A bare `return` in a traced loop joins against a valued path
+        → pytree mismatch → documented graph-break to eager."""
+        @to_static
+        def f(x):
+            i = 0
+            while x.sum() < 100.0:
+                x = x * 2.0
+                if x.sum() > 20.0:
+                    return
+                i = i + 1
+            return x
+
+        out = f(t([1.0]))
+        assert out is None or np.allclose(out.numpy(), [32.0])
+        assert f.graph_break_reasons  # fell back, recorded
+
+    def test_add_n_single_no_alias(self):
+        import paddle_tpu as paddle
+        a = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        s = paddle.add_n([a])
+        s.fill_diagonal_(9.0)
+        assert a.numpy()[0, 0] == 0.0  # input untouched
