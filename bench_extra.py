@@ -82,8 +82,17 @@ def bert_amp_o2(trace: bool = False):
 
 def main():
     trace = "--trace" in sys.argv
+    # wedge-proofing (CLAUDE.md chip hygiene): probe in a bounded
+    # subprocess — a dead chip/tunnel hangs the first in-process device
+    # touch forever; fall back to the CPU smoke config instead.
+    from bench import _tpu_usable, force_cpu
+    if not _tpu_usable(attempts=2, probe_timeout=90, backoff=20):
+        force_cpu()
     rec = bert_amp_o2(trace=trace)
     print(json.dumps(rec))
+    if "cpu_smoke" in rec["metric"]:
+        # never clobber the committed on-chip capture with a fallback
+        return
     with open("BENCH_extra.json", "w") as f:
         json.dump(rec, f, indent=1)
 

@@ -249,7 +249,7 @@ def add_n(inputs, name=None):
     if not ts:
         raise ValueError("add_n expects a non-empty tensor list")
     if len(ts) == 1:  # fresh tensor, never an alias of the input
-        return apply(lambda a: a + 0, ts[0], name="add_n")
+        return apply(jnp.copy, ts[0], name="add_n")  # clone/assign idiom
     out = ts[0] + ts[1]
     for t in ts[2:]:
         out = out + t

@@ -1,0 +1,137 @@
+"""paddle.text datasets (Imdb, Movielens) — parsing validated against
+synthetic archives in the reference layouts (no network in this env;
+SURVEY.md §2.2 text row)."""
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import Imdb, Movielens
+
+
+def _make_imdb(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"good great good movie",
+        "aclImdb/train/pos/1.txt": b"great fun good",
+        "aclImdb/train/neg/0.txt": b"bad awful good",
+        "aclImdb/test/pos/0.txt": b"great movie",
+        "aclImdb/test/neg/0.txt": b"awful bad bad",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+class TestImdb:
+    def test_requires_local_file(self):
+        with pytest.raises(ValueError):
+            Imdb()
+
+    def test_parse_and_vocab(self, tmp_path):
+        path = _make_imdb(tmp_path)
+        ds = Imdb(data_file=path, mode="train", cutoff=1)
+        assert len(ds) == 3
+        # vocab from TRAIN with freq > 1: good(4), great(2); others unk
+        assert set(ds.word_idx) == {"good", "great", "<unk>"}
+        assert ds.word_idx["good"] == 0  # most frequent first
+        ids, label = ds[0]
+        assert ids.dtype == np.int64 and label in (0, 1)
+        labels = sorted(int(l) for _, l in ds)
+        assert labels == [0, 0, 1]  # two pos, one neg
+
+    def test_test_split_uses_train_vocab(self, tmp_path):
+        path = _make_imdb(tmp_path)
+        tr = Imdb(data_file=path, mode="train", cutoff=1)
+        te = Imdb(data_file=path, mode="test", cutoff=1)
+        assert te.word_idx == tr.word_idx
+        assert len(te) == 2
+        unk = te.word_idx["<unk>"]
+        # "awful bad bad" — none in vocab → all unk
+        for ids, label in te:
+            if label == 1:
+                assert (ids == unk).all()
+
+
+def _make_ml1m(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    users = "1::M::25::4::12345\n2::F::35::7::54321\n"
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action|Crime\n")
+    ratings = ("1::1::5::964982703\n1::2::3::964982703\n"
+               "2::1::4::964982703\n2::2::2::964982703\n")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("ml-1m/users.dat", users)
+        zf.writestr("ml-1m/movies.dat", movies)
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    return str(path)
+
+
+class TestMovielens:
+    def test_requires_local_file(self):
+        with pytest.raises(ValueError):
+            Movielens()
+
+    def test_parse_fields(self, tmp_path):
+        path = _make_ml1m(tmp_path)
+        tr = Movielens(data_file=path, mode="train", test_ratio=0.25,
+                       rand_seed=0)
+        te = Movielens(data_file=path, mode="test", test_ratio=0.25,
+                       rand_seed=0)
+        assert len(tr) + len(te) == 4
+        uid, g, age, job, mid, t_ids, c_ids, rating = tr[0]
+        assert uid in (1, 2) and g in (0, 1)
+        assert 0 <= age < len(Movielens.AGES)
+        assert t_ids.dtype == np.int64 and c_ids.dtype == np.int64
+        assert 1.0 <= float(rating) <= 5.0
+        assert tr.vocab_size >= 4  # toy story heat + years
+        assert tr.category_size == 4  # Animation Comedy Action Crime
+
+
+class TestReviewRegressionsText:
+    def test_imdb_mode_validated(self, tmp_path):
+        path = _make_imdb(tmp_path)
+        with pytest.raises(ValueError):
+            Imdb(data_file=path, mode="valid")
+
+    def test_imdb_cutoff_strict(self, tmp_path):
+        path = _make_imdb(tmp_path)
+        ds = Imdb(data_file=path, mode="train", cutoff=2)
+        # great occurs exactly 2x -> excluded under strict >
+        assert "great" not in ds.word_idx and "good" in ds.word_idx
+
+    def test_imdb_punctuation_split(self, tmp_path):
+        import io, tarfile
+        path = tmp_path / "p.tar.gz"
+        data = b"don't stop don't stop don't"
+        with tarfile.open(path, "w:gz") as tf:
+            info = tarfile.TarInfo("aclImdb/train/pos/0.txt")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        ds = Imdb(data_file=str(path), mode="train", cutoff=1)
+        assert "don" in ds.word_idx and "t" in ds.word_idx
+
+    def test_movielens_macosx_junk_ignored(self, tmp_path):
+        import zipfile
+        path = tmp_path / "mac.zip"
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("__MACOSX/ml-1m/._users.dat", "garbage")
+            zf.writestr("ml-1m/users.dat", "1::M::25::4::12345\n")
+            zf.writestr("ml-1m/movies.dat", "1::Heat (1995)::Action\n")
+            zf.writestr("ml-1m/ratings.dat", "1::1::4::1\n")
+        ds = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+        assert len(ds) == 1
+
+    def test_movielens_missing_member_message(self, tmp_path):
+        import zipfile
+        path = tmp_path / "bad.zip"
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("ml-1m/users.dat", "1::M::25::4::1\n")
+        with pytest.raises(ValueError, match="movies.dat"):
+            Movielens(data_file=str(path))
