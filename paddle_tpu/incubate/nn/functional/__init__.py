@@ -207,3 +207,71 @@ def swiglu(x, y=None, name=None):
         return apply(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
     return apply(lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2]) *
                  a[..., a.shape[-1] // 2:], x, name="swiglu")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """Reference: paddle.incubate.nn.functional.fused_matmul_bias
+    (cublasLt epilogue fusion upstream — XLA fuses the bias add into the
+    dot on TPU; one compiled op either way)."""
+    from ....ops.math import matmul
+    out = matmul(ensure_tensor(x), ensure_tensor(y),
+                 transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + ensure_tensor(bias)
+    return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: paddle.incubate.nn.functional.fused_dropout_add —
+    dropout(x) + y in one fused op (XLA fuses the mask-mul-add chain)."""
+    out = F.dropout(ensure_tensor(x), p=p, training=training, mode=mode)
+    return out + ensure_tensor(y)
+
+
+from ....nn.functional.flash_attention import (  # noqa: E402,F401
+    flash_attn_unpadded)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """Reference: paddle.incubate.nn.functional.
+    variable_length_memory_efficient_attention (cutlass varlen attention
+    upstream). TPU-native: per-batch valid lengths become a keep-mask on
+    the Pallas flash path (`flash_attention_bshd`) — O(block) memory,
+    never a dense [B,H,S,Sk] score tensor. Layout [B, H, S, D] in/out
+    (transposed around the [B, S, H, D] kernel)."""
+    if pre_cache_length:
+        raise NotImplementedError(
+            "pre_cache_length != 0 (cache-offset causal masking) is not "
+            "supported; use the generation KV-cache path instead")
+    from ....ops.manipulation import transpose as _tp
+    q, k, v = (ensure_tensor(t) for t in (query, key, value))
+    s, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    ql = ensure_tensor(seq_lens)._data.reshape(-1)
+    kl = ensure_tensor(kv_seq_lens)._data.reshape(-1)
+    sc = (1.0 / (d ** 0.5)) if scale is None else float(scale)
+
+    qvalid = jnp.arange(s)[None, :] < ql[:, None]            # [B, S]
+    kvalid = jnp.arange(sk)[None, :] < kl[:, None]           # [B, Sk]
+    keep = qvalid[:, None, :, None] & kvalid[:, None, None, :]
+    if mask is not None:
+        madd = jnp.where(keep, 0.0, -jnp.inf) \
+            + ensure_tensor(mask)._data.astype(jnp.float32)
+        mask_t = Tensor(madd)
+    else:
+        mask_t = Tensor(keep)
+
+    out = flash_attention_bshd(_tp(q, [0, 2, 1, 3]),
+                               _tp(k, [0, 2, 1, 3]),
+                               _tp(v, [0, 2, 1, 3]),
+                               mask=mask_t, causal=causal, scale=sc)
+    out = _tp(out, [0, 2, 1, 3])
+    # rows with no valid query slot (or zero valid keys) are defined 0
+    rowzero = qvalid & (kl[:, None] > 0)
+    return apply(lambda o, m: jnp.where(m, o, 0.0).astype(o.dtype),
+                 out, Tensor(rowzero[:, None, :, None]),
+                 name="varlen_mea_pad")

@@ -1,0 +1,116 @@
+"""Round-3b incubate fused-op closure: fused_matmul_bias,
+fused_dropout_add, variable_length_memory_efficient_attention,
+flash_attn_unpadded re-export (SURVEY.md §2.2 Incubate)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+
+class TestFusedMatmulBias:
+    def test_forward(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 5)).astype(np.float32)
+        b = rng.standard_normal((5,)).astype(np.float32)
+        out = F.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_transpose_and_grad(self):
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(rng.standard_normal((5, 4)).astype(np.float32),
+                             stop_gradient=False)
+        out = F.fused_matmul_bias(x, w, transpose_y=True)
+        paddle.sum(out).backward()
+        assert x.grad is not None and w.grad is not None
+
+
+class TestFusedDropoutAdd:
+    def test_p0_is_plain_add(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        np.testing.assert_allclose(
+            F.fused_dropout_add(x, y, p=0.0).numpy(), 3.0)
+
+    def test_eval_mode_no_drop(self):
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        y = paddle.to_tensor(np.zeros(64, np.float32))
+        out = F.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), 1.0)
+
+    def test_train_mode_upscales(self):
+        x = paddle.to_tensor(np.ones((4000,), np.float32))
+        y = paddle.to_tensor(np.zeros(4000, np.float32))
+        out = F.fused_dropout_add(x, y, p=0.5, training=True).numpy()
+        kept = out[out > 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # 1/(1-p)
+        assert 0.4 < (out > 0).mean() < 0.6
+
+
+class TestVarlenMEA:
+    def test_matches_dense_oracle(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((2, 2, 4, 8)).astype(np.float32)
+        k = rng.standard_normal((2, 2, 6, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 2, 6, 8)).astype(np.float32)
+        ql = np.array([3, 4], np.int32)
+        kl = np.array([5, 2], np.int32)
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(ql), paddle.to_tensor(kl)).numpy()
+        for bi in range(2):
+            for h in range(2):
+                lq, lk = ql[bi], kl[bi]
+                s = (q[bi, h, :lq] @ k[bi, h, :lk].T) / np.sqrt(8)
+                p = torch.softmax(torch.from_numpy(s), -1).numpy()
+                np.testing.assert_allclose(got[bi, h, :lq],
+                                           p @ v[bi, h, :lk],
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_causal_and_padding_rows_zero(self):
+        rng = np.random.default_rng(3)
+        q = rng.standard_normal((1, 1, 4, 8)).astype(np.float32)
+        k = rng.standard_normal((1, 1, 4, 8)).astype(np.float32)
+        v = rng.standard_normal((1, 1, 4, 8)).astype(np.float32)
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(np.array([2], np.int32)),
+            paddle.to_tensor(np.array([2], np.int32)), causal=True).numpy()
+        np.testing.assert_allclose(got[0, 0, 2:], 0.0)  # padded q rows
+        # first valid row attends only to k0 (causal)
+        np.testing.assert_allclose(got[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_unpadded_reexport(self):
+        from paddle_tpu.nn.functional.flash_attention import (
+            flash_attn_unpadded)
+        assert F.flash_attn_unpadded is flash_attn_unpadded
+
+    def test_pre_cache_length_rejected(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 8), np.float32))
+        l = paddle.to_tensor(np.array([2], np.int32))
+        with pytest.raises(NotImplementedError):
+            F.variable_length_memory_efficient_attention(
+                x, x, x, l, l, pre_cache_length=2)
+
+    def test_additive_mask_composes(self):
+        rng = np.random.default_rng(4)
+        q = rng.standard_normal((1, 1, 3, 8)).astype(np.float32)
+        k = rng.standard_normal((1, 1, 3, 8)).astype(np.float32)
+        v = rng.standard_normal((1, 1, 3, 8)).astype(np.float32)
+        l = paddle.to_tensor(np.array([3], np.int32))
+        # additive mask blocking key 1 entirely
+        m = np.zeros((1, 1, 3, 3), np.float32)
+        m[..., 1] = -1e9
+        got = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            l, l, mask=paddle.to_tensor(m)).numpy()
+        s = (q[0, 0] @ k[0, 0, [0, 2]].T) / np.sqrt(8)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got[0, 0], p @ v[0, 0, [0, 2]],
+                                   rtol=1e-4, atol=1e-5)
