@@ -251,24 +251,44 @@ def variable_length_memory_efficient_attention(
     q, k, v = (ensure_tensor(t) for t in (query, key, value))
     s, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
+    if causal and s != sk:
+        # the kernel's causal is bottom-right-aligned over padded
+        # shapes; with s != sk that leaks future keys into early rows —
+        # varlen causal is only well-defined here for equal paddings
+        raise NotImplementedError(
+            "causal=True requires matching q/kv padded lengths "
+            f"(got {s} vs {sk}); decode-style offsets are the "
+            "generation KV-cache path's job")
     ql = ensure_tensor(seq_lens)._data.reshape(-1)
     kl = ensure_tensor(kv_seq_lens)._data.reshape(-1)
     sc = (1.0 / (d ** 0.5)) if scale is None else float(scale)
 
     qvalid = jnp.arange(s)[None, :] < ql[:, None]            # [B, S]
     kvalid = jnp.arange(sk)[None, :] < kl[:, None]           # [B, Sk]
-    keep = qvalid[:, None, :, None] & kvalid[:, None, None, :]
+    # Invalid QUERY rows are NOT masked in the attention itself: a fully
+    # -inf row NaNs the softmax backward and the NaN leaks into dk even
+    # for valid keys. They attend normally instead; the post-fixup
+    # zeroes their outputs, so their cotangents are exactly zero and
+    # they contribute nothing to any gradient. Only invalid KEYS mask.
+    # kl==0 rows keep key 0 visible for the same finiteness reason.
+    kvalid_safe = kvalid | ((kl[:, None] == 0)
+                            & (jnp.arange(sk)[None, :] == 0))
     if mask is not None:
-        madd = jnp.where(keep, 0.0, -jnp.inf) \
+        # explicit additive mask: dense combine is inherent to the input
+        madd = jnp.where(kvalid_safe[:, None, None, :], 0.0, -jnp.inf) \
             + ensure_tensor(mask)._data.astype(jnp.float32)
-        mask_t = Tensor(madd)
+        seg_kw = {"mask": Tensor(madd)}
     else:
-        mask_t = Tensor(keep)
+        # O(S) segment encoding — the kernel's varlen dead-block path
+        seg_kw = {"q_seg": Tensor(jnp.zeros((ql.shape[0], s),
+                                            jnp.int32)),
+                  "kv_seg": Tensor(jnp.where(kvalid_safe, 0, -2)
+                                   .astype(jnp.int32))}
 
     out = flash_attention_bshd(_tp(q, [0, 2, 1, 3]),
                                _tp(k, [0, 2, 1, 3]),
                                _tp(v, [0, 2, 1, 3]),
-                               mask=mask_t, causal=causal, scale=sc)
+                               causal=causal, scale=sc, **seg_kw)
     out = _tp(out, [0, 2, 1, 3])
     # rows with no valid query slot (or zero valid keys) are defined 0
     rowzero = qvalid & (kl[:, None] > 0)

@@ -133,3 +133,134 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     pass
+
+
+class Flowers(Dataset):
+    """Reference parity: paddle.vision.datasets.Flowers (upstream
+    python/paddle/vision/datasets/flowers.py — unverified, SURVEY.md
+    blocker notice). Oxford-102 layout from LOCAL files (no network):
+    `data_file` = 102flowers.tgz (jpg/image_XXXXX.jpg), `label_file` =
+    imagelabels.mat, `setid_file` = setid.mat. Splits per setid keys
+    trnid/valid/tstid; labels 1-based in the .mat → kept 1-based like
+    the reference. Images decode lazily per __getitem__ (PIL), HWC
+    uint8 numpy (backend='cv2'-style array output).
+    """
+
+    _SET_KEYS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend="cv2"):
+        if mode not in self._SET_KEYS:
+            raise ValueError(f"mode must be one of "
+                             f"{sorted(self._SET_KEYS)}, got {mode!r}")
+        if not all(p and os.path.exists(p)
+                   for p in (data_file, label_file, setid_file)):
+            raise FileNotFoundError(
+                "Flowers needs local copies (no network access): "
+                "data_file=102flowers.tgz, label_file=imagelabels.mat, "
+                "setid_file=setid.mat")
+        import scipy.io as sio
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        ids = sio.loadmat(setid_file)[self._SET_KEYS[mode]].ravel()
+        self.indexes = ids.astype(np.int64)          # 1-based image ids
+        self.labels = {int(i): np.int64(labels[int(i) - 1])
+                       for i in self.indexes}
+        self._tar_path = data_file
+        self._tf = None
+
+    def _image(self, image_id):
+        from PIL import Image
+        # gzip tars have no random access: a shuffled sampler reading
+        # members directly would re-decompress from the archive start on
+        # every backward seek. Extract once per process (lazy — after
+        # DataLoader workers fork), then reads are O(image).
+        if self._tf is None:
+            import tempfile
+            d = tempfile.mkdtemp(prefix="pd_flowers_")
+            with tarfile.open(self._tar_path) as tf:
+                tf.extractall(d, filter="data")
+            self._tf = d
+        name = os.path.join(self._tf, "jpg", f"image_{image_id:05d}.jpg")
+        return np.asarray(Image.open(name).convert("RGB"))
+
+    def __getitem__(self, idx):
+        image_id = int(self.indexes[idx])
+        img = self._image(image_id)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[image_id]
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Reference parity: paddle.vision.datasets.VOC2012 (segmentation
+    split; upstream python/paddle/vision/datasets/voc2012.py —
+    unverified). Parses a LOCAL VOCtrainval tar: JPEGImages/*.jpg +
+    SegmentationClass/*.png, split lists under
+    ImageSets/Segmentation/{train,val,trainval}.txt. Yields
+    (image HWC uint8, label HW uint8) numpy arrays.
+    """
+
+    _SPLITS = {"train": "train.txt", "valid": "val.txt",
+               "test": "val.txt", "trainval": "trainval.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        if mode not in self._SPLITS:
+            raise ValueError(f"mode must be one of "
+                             f"{sorted(self._SPLITS)}, got {mode!r}")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "VOC2012 needs a local VOCtrainval tar (no network "
+                "access): pass data_file=")
+        self.transform = transform
+        self._tar_path = data_file
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+
+            def _find(suffix):
+                hits = [n for n in names if n.endswith(suffix)]
+                if not hits:
+                    raise ValueError(
+                        f"{suffix} not found in {data_file!r} — "
+                        "expected the VOC2012 layout")
+                return hits[0]
+
+            split = tf.extractfile(
+                _find("ImageSets/Segmentation/" + self._SPLITS[mode]))
+            self.keys = [l.strip() for l in
+                         split.read().decode().splitlines() if l.strip()]
+            self._jpeg_dir = os.path.dirname(_find("JPEGImages/" +
+                                                   self.keys[0] + ".jpg"))
+            self._seg_dir = os.path.dirname(_find("SegmentationClass/" +
+                                                  self.keys[0] + ".png"))
+        # handle opened lazily PER PROCESS: DataLoader workers fork
+        # after __init__, and a shared fd's seek/read would interleave
+        self._tf = None
+
+    def _read(self, name):
+        import io as _io
+        from PIL import Image
+        if self._tf is None:
+            self._tf = tarfile.open(self._tar_path)
+        data = self._tf.extractfile(name).read()
+        return Image.open(_io.BytesIO(data))
+
+    def __getitem__(self, idx):
+        key = self.keys[idx]
+        img = np.asarray(self._read(
+            f"{self._jpeg_dir}/{key}.jpg").convert("RGB"))
+        lbl = np.asarray(self._read(f"{self._seg_dir}/{key}.png"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.keys)
+
+
+__all__ += ["Flowers", "VOC2012"]

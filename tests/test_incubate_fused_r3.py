@@ -114,3 +114,27 @@ class TestVarlenMEA:
         p = e / e.sum(-1, keepdims=True)
         np.testing.assert_allclose(got[0, 0], p @ v[0, 0, [0, 2]],
                                    rtol=1e-4, atol=1e-5)
+
+    def test_padded_rows_grads_finite(self):
+        # review regression: padded q rows must not poison grads
+        q = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+            (1, 1, 4, 8)).astype(np.float32), stop_gradient=False)
+        k = paddle.to_tensor(np.random.default_rng(6).standard_normal(
+            (1, 1, 4, 8)).astype(np.float32), stop_gradient=False)
+        l2 = paddle.to_tensor(np.array([2], np.int32))
+        out = F.variable_length_memory_efficient_attention(
+            q, k, k, l2, l2)
+        paddle.sum(out).backward()
+        assert np.isfinite(q.grad.numpy()).all()
+        assert np.isfinite(k.grad.numpy()).all()
+        # padded q rows contribute nothing
+        np.testing.assert_allclose(q.grad.numpy()[0, 0, 2:], 0.0)
+
+    def test_causal_mismatched_lengths_rejected(self):
+        q = paddle.to_tensor(np.zeros((1, 1, 3, 8), np.float32))
+        k = paddle.to_tensor(np.zeros((1, 1, 6, 8), np.float32))
+        l = paddle.to_tensor(np.array([3], np.int32))
+        lk = paddle.to_tensor(np.array([4], np.int32))
+        with pytest.raises(NotImplementedError):
+            F.variable_length_memory_efficient_attention(
+                q, k, k, l, lk, causal=True)

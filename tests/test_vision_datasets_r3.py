@@ -1,0 +1,125 @@
+"""Flowers / VOC2012 datasets — parsing validated against synthetic
+archives in the reference layouts (SURVEY.md §2.2 Vision row)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import VOC2012, Flowers
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _add(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def flowers_files(tmp_path):
+    import scipy.io as sio
+    rng = np.random.default_rng(0)
+    tar = tmp_path / "102flowers.tgz"
+    with tarfile.open(tar, "w:gz") as tf:
+        for i in range(1, 7):
+            img = rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+            _add(tf, f"jpg/image_{i:05d}.jpg", _jpg_bytes(img))
+    labels = tmp_path / "imagelabels.mat"
+    sio.savemat(labels, {"labels": np.array([[1, 2, 1, 2, 1, 2]])})
+    setid = tmp_path / "setid.mat"
+    sio.savemat(setid, {"trnid": np.array([[1, 2, 3]]),
+                        "valid": np.array([[4]]),
+                        "tstid": np.array([[5, 6]])})
+    return str(tar), str(labels), str(setid)
+
+
+class TestFlowers:
+    def test_requires_local_files(self):
+        with pytest.raises(FileNotFoundError):
+            Flowers()
+
+    def test_splits_and_labels(self, flowers_files):
+        tar, labels, setid = flowers_files
+        tr = Flowers(data_file=tar, label_file=labels, setid_file=setid,
+                     mode="train")
+        te = Flowers(data_file=tar, label_file=labels, setid_file=setid,
+                     mode="test")
+        assert len(tr) == 3 and len(te) == 2
+        img, lab = tr[0]
+        assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+        assert int(lab) == 1  # image 1 → label 1 (1-based kept)
+        img2, lab2 = tr[1]
+        assert int(lab2) == 2
+
+    def test_transform_applied(self, flowers_files):
+        tar, labels, setid = flowers_files
+        ds = Flowers(data_file=tar, label_file=labels, setid_file=setid,
+                     mode="valid", transform=lambda a: a.astype(np.float32)
+                     / 255.0)
+        img, _ = ds[0]
+        assert img.dtype == np.float32 and img.max() <= 1.0
+
+    def test_bad_mode(self, flowers_files):
+        tar, labels, setid = flowers_files
+        with pytest.raises(ValueError):
+            Flowers(data_file=tar, label_file=labels, setid_file=setid,
+                    mode="bogus")
+
+
+@pytest.fixture
+def voc_file(tmp_path):
+    rng = np.random.default_rng(1)
+    tar = tmp_path / "VOCtrainval.tar"
+    keys = ["2007_000001", "2007_000002", "2007_000003"]
+    with tarfile.open(tar, "w") as tf:
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+             ("\n".join(keys[:2]) + "\n").encode())
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+             (keys[2] + "\n").encode())
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+             ("\n".join(keys) + "\n").encode())
+        for k in keys:
+            img = rng.integers(0, 255, (6, 6, 3), dtype=np.uint8)
+            seg = rng.integers(0, 21, (6, 6), dtype=np.uint8)
+            _add(tf, f"VOCdevkit/VOC2012/JPEGImages/{k}.jpg",
+                 _jpg_bytes(img))
+            _add(tf, f"VOCdevkit/VOC2012/SegmentationClass/{k}.png",
+                 _png_bytes(seg))
+    return str(tar)
+
+
+class TestVOC2012:
+    def test_requires_local_file(self):
+        with pytest.raises(FileNotFoundError):
+            VOC2012()
+
+    def test_splits(self, voc_file):
+        tr = VOC2012(data_file=voc_file, mode="train")
+        va = VOC2012(data_file=voc_file, mode="valid")
+        tv = VOC2012(data_file=voc_file, mode="trainval")
+        assert (len(tr), len(va), len(tv)) == (2, 1, 3)
+        img, lbl = tr[0]
+        assert img.shape == (6, 6, 3) and lbl.shape == (6, 6)
+        assert lbl.max() < 21
+
+    def test_missing_layout_message(self, tmp_path):
+        bad = tmp_path / "bad.tar"
+        with tarfile.open(bad, "w") as tf:
+            _add(tf, "whatever.txt", b"x")
+        with pytest.raises(ValueError, match="Segmentation"):
+            VOC2012(data_file=str(bad))
