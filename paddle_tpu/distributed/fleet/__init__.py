@@ -21,4 +21,5 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        set_hybrid_communicate_group)
 
 # fleet.meta_parallel namespace parity
-from . import mp_layers as meta_parallel  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import layers  # noqa: F401

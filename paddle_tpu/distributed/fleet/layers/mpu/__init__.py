@@ -1,0 +1,9 @@
+"""paddle.distributed.fleet.layers.mpu — reference import path for the
+Megatron-style parallel layers (upstream fleet/layers/mpu/mp_layers.py —
+unverified, SURVEY.md §2.3 TP row)."""
+from ...mp_layers import (ColumnParallelLinear,  # noqa: F401
+                          ParallelCrossEntropy, RowParallelLinear,
+                          VocabParallelEmbedding)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy"]

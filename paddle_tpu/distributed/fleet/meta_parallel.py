@@ -1,0 +1,21 @@
+"""paddle.distributed.fleet.meta_parallel — reference import path
+(upstream python/paddle/distributed/fleet/meta_parallel/ — unverified,
+SURVEY.md §2.3 PP/TP rows). The TPU-native implementations live in
+pipeline.py (collective-scan pipeline runtime), mp_layers.py
+(shard_map/GSPMD tensor parallel), and sequence_parallel.py; this module
+surfaces the upstream names."""
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
+                       SharedLayerDesc)
+from .random_ctl import (RNGStatesTracker,  # noqa: F401
+                         get_rng_state_tracker, model_parallel_random_seed)
+from .sequence_parallel import (ColumnSequenceParallelLinear,  # noqa: F401
+                                RowSequenceParallelLinear)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]

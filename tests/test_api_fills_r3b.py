@@ -160,3 +160,23 @@ class TestTracedLayer:
         loaded = paddle.jit.load(path)
         np.testing.assert_allclose(loaded(x).numpy(), lin(x).numpy(),
                                    rtol=1e-5)
+
+
+class TestFleetImportPaths:
+    def test_meta_parallel_module(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear, LayerDesc, PipelineLayer,
+            RNGStatesTracker, SharedLayerDesc)
+        assert fleet.meta_parallel.PipelineLayer is PipelineLayer
+        from paddle_tpu.distributed.fleet.pipeline import (
+            PipelineLayer as impl)
+        assert PipelineLayer is impl
+
+    def test_layers_mpu_module(self):
+        from paddle_tpu.distributed.fleet.layers.mpu import (
+            ColumnParallelLinear, ParallelCrossEntropy,
+            RowParallelLinear, VocabParallelEmbedding)
+        from paddle_tpu.distributed.fleet.mp_layers import (
+            ColumnParallelLinear as impl)
+        assert ColumnParallelLinear is impl
