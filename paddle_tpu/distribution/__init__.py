@@ -299,6 +299,63 @@ def kl_divergence(p, q):
                 jax.nn.softmax(a, -1) * (jax.nn.log_softmax(a, -1) -
                                          jax.nn.log_softmax(b, -1)), -1),
             p.logits, q.logits, name="kl_categorical")
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        # finite iff support(p) ⊆ support(q)
+        return apply(
+            lambda a1, b1, a2, b2: jnp.where(
+                (a2 <= a1) & (b1 <= b2),
+                jnp.log((b2 - a2) / (b1 - a1)), jnp.inf),
+            p.low, p.high, q.low, q.high, name="kl_uniform")
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def _kl_bern(a, b):
+            # boundary-exact (torch parity): q at 0/1 with p-mass on the
+            # impossible outcome → inf; 0·log0 terms → 0
+            t1 = jnp.where(a > 0, a * (jnp.log(a) - jnp.log(b)), 0.0)
+            t2 = jnp.where(a < 1, (1 - a) * (jnp.log1p(-a)
+                                             - jnp.log1p(-b)), 0.0)
+            return t1 + t2
+        return apply(_kl_bern, p.probs_t, q.probs_t, name="kl_bernoulli")
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        def _kl_beta(a1, b1, a2, b2):
+            lbeta = (jax.scipy.special.gammaln(a2)
+                     + jax.scipy.special.gammaln(b2)
+                     - jax.scipy.special.gammaln(a2 + b2)
+                     - (jax.scipy.special.gammaln(a1)
+                        + jax.scipy.special.gammaln(b1)
+                        - jax.scipy.special.gammaln(a1 + b1)))
+            dg = jax.scipy.special.digamma
+            return (lbeta + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                    + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+        return apply(_kl_beta, p.alpha, p.beta, q.alpha, q.beta,
+                     name="kl_beta")
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        return apply(lambda r1, r2: jnp.log(r1 / r2) + r2 / r1 - 1.0,
+                     p.rate, q.rate, name="kl_exponential")
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        def _kl_gamma(a1, r1, a2, r2):
+            dg = jax.scipy.special.digamma
+            gl = jax.scipy.special.gammaln
+            return ((a1 - a2) * dg(a1) - gl(a1) + gl(a2)
+                    + a2 * (jnp.log(r1) - jnp.log(r2))
+                    + a1 * (r2 - r1) / r1)
+        return apply(_kl_gamma, p.concentration, p.rate,
+                     q.concentration, q.rate, name="kl_gamma")
+    if isinstance(p, Laplace) and isinstance(q, Laplace):
+        def _kl_laplace(m1, s1, m2, s2):
+            ad = jnp.abs(m1 - m2)
+            return (jnp.log(s2 / s1) + ad / s2
+                    + (s1 / s2) * jnp.exp(-ad / s1) - 1.0)
+        return apply(_kl_laplace, p.loc, p.scale, q.loc, q.scale,
+                     name="kl_laplace")
+    if isinstance(p, Geometric) and isinstance(q, Geometric):
+        def _kl_geom(a, b):
+            # support k>=0: E[k]·(log(1-a) − log(1-b)) + log(a/b),
+            # boundary-exact: a==1 has E[k]=0 (guard kills the 0·inf)
+            tail = jnp.where(a < 1, ((1 - a) / a) * (jnp.log1p(-a)
+                                                     - jnp.log1p(-b)),
+                             0.0)
+            return tail + jnp.log(a) - jnp.log(b)
+        return apply(_kl_geom, p.probs_t, q.probs_t, name="kl_geometric")
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
 

@@ -327,3 +327,29 @@ class MultiplicativeDecay(LRScheduler):
         if self.last_epoch > 0:
             self._cur = self._cur * self.lr_lambda(self.last_epoch)
         return self._cur
+
+
+class LinearLR(LRScheduler):
+    """Reference parity: paddle.optimizer.lr.LinearLR — the lr factor
+    interpolates linearly from start_factor to end_factor over
+    total_steps, then stays at end_factor."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not (0 < start_factor <= 1):
+            raise ValueError("start_factor must be in (0, 1]")
+        if not (0 <= end_factor <= 1):
+            raise ValueError("end_factor must be in [0, 1]")
+        self.total_steps = int(total_steps)
+        self.start_factor = float(start_factor)
+        self.end_factor = float(end_factor)
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + (self.end_factor
+                                      - self.start_factor) * frac
+        return self.base_lr * factor

@@ -1,0 +1,134 @@
+"""Round-3b: KL closed forms for 7 more distribution pairs (torch
+oracle) + LinearLR scheduler (hand oracle)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.distribution as D
+from paddle_tpu.distribution import kl_divergence
+
+
+def _t(x):
+    import paddle_tpu as paddle
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestKLPairs:
+    def _check(self, ours, tp, tq, rtol=1e-4):
+        torch = pytest.importorskip("torch")
+        ref = torch.distributions.kl_divergence(tp, tq).numpy()
+        np.testing.assert_allclose(np.asarray(ours._data), ref,
+                                   rtol=rtol, atol=1e-6)
+
+    def test_uniform(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Uniform(0.0, 1.0), D.Uniform(-1.0, 2.0))
+        self._check(got, torch.distributions.Uniform(0.0, 1.0),
+                    torch.distributions.Uniform(-1.0, 2.0))
+        inf = kl_divergence(D.Uniform(-2.0, 1.0), D.Uniform(0.0, 1.0))
+        assert np.isinf(float(np.asarray(inf._data)))
+
+    def test_bernoulli(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Bernoulli(_t(0.3)), D.Bernoulli(_t(0.6)))
+        self._check(got, torch.distributions.Bernoulli(0.3),
+                    torch.distributions.Bernoulli(0.6))
+
+    def test_beta(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Beta(_t(2.0), _t(3.0)),
+                            D.Beta(_t(4.0), _t(1.5)))
+        self._check(got, torch.distributions.Beta(2.0, 3.0),
+                    torch.distributions.Beta(4.0, 1.5))
+
+    def test_exponential(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Exponential(_t(1.5)), D.Exponential(_t(0.5)))
+        self._check(got, torch.distributions.Exponential(1.5),
+                    torch.distributions.Exponential(0.5))
+
+    def test_gamma(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Gamma(_t(2.0), _t(1.0)),
+                            D.Gamma(_t(3.0), _t(2.0)))
+        self._check(got, torch.distributions.Gamma(2.0, 1.0),
+                    torch.distributions.Gamma(3.0, 2.0))
+
+    def test_laplace(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Laplace(_t(0.0), _t(1.0)),
+                            D.Laplace(_t(1.0), _t(2.0)))
+        self._check(got, torch.distributions.Laplace(0.0, 1.0),
+                    torch.distributions.Laplace(1.0, 2.0))
+
+    def test_geometric(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Geometric(_t(0.3)), D.Geometric(_t(0.5)))
+        self._check(got, torch.distributions.Geometric(0.3),
+                    torch.distributions.Geometric(0.5))
+
+    def test_batched(self):
+        torch = pytest.importorskip("torch")
+        p = np.array([0.2, 0.8], np.float32)
+        q = np.array([0.5, 0.5], np.float32)
+        got = kl_divergence(D.Bernoulli(_t(p)), D.Bernoulli(_t(q)))
+        import torch as th
+        ref = th.distributions.kl_divergence(
+            th.distributions.Bernoulli(th.tensor(p)),
+            th.distributions.Bernoulli(th.tensor(q))).numpy()
+        np.testing.assert_allclose(np.asarray(got._data), ref, rtol=1e-4)
+
+
+class TestLinearLR:
+    def test_interpolation(self):
+        import paddle_tpu.optimizer.lr as lr
+        s = lr.LinearLR(learning_rate=1.0, total_steps=4,
+                        start_factor=0.5, end_factor=1.0)
+        seen = [s()]
+        for _ in range(5):
+            s.step()
+            seen.append(s())
+        np.testing.assert_allclose(
+            seen[:5], [0.5, 0.625, 0.75, 0.875, 1.0], rtol=1e-6)
+        assert seen[5] == 1.0  # clamps at end_factor
+
+    def test_validation(self):
+        import paddle_tpu.optimizer.lr as lr
+        with pytest.raises(ValueError):
+            lr.LinearLR(1.0, total_steps=0)
+        with pytest.raises(ValueError):
+            lr.LinearLR(1.0, total_steps=5, start_factor=0.0)
+
+    def test_drives_optimizer(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        lin = nn.Linear(2, 2)
+        sched = paddle.optimizer.lr.LinearLR(0.1, total_steps=2,
+                                             start_factor=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=lin.parameters())
+        loss = paddle.sum(lin(paddle.to_tensor(
+            np.ones((1, 2), np.float32))))
+        loss.backward()
+        opt.step()
+        sched.step()
+        assert sched() == pytest.approx(0.075)
+
+
+class TestKLBoundaries:
+    def test_bernoulli_boundary_inf(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(D.Bernoulli(_t(0.5)), D.Bernoulli(_t(1.0)))
+        assert np.isinf(float(np.asarray(got._data)))
+        ref = torch.distributions.kl_divergence(
+            torch.distributions.Bernoulli(0.5),
+            torch.distributions.Bernoulli(1.0))
+        assert np.isinf(ref.numpy())
+
+    def test_bernoulli_degenerate_zero(self):
+        # p deterministic, q covers it → finite
+        got = kl_divergence(D.Bernoulli(_t(1.0)), D.Bernoulli(_t(0.5)))
+        np.testing.assert_allclose(float(np.asarray(got._data)),
+                                   np.log(2.0), rtol=1e-5)
+
+    def test_geometric_boundary_inf(self):
+        got = kl_divergence(D.Geometric(_t(0.5)), D.Geometric(_t(1.0)))
+        assert np.isinf(float(np.asarray(got._data)))
