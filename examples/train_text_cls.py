@@ -1,0 +1,113 @@
+"""End-to-end text classification: Imdb archive -> vocab -> embedding
+bag classifier -> Model.fit with LinearLR warmup.
+
+Walkthrough of the reference text workflow (paddle.text.datasets.Imdb +
+hapi Model) on the TPU-native stack. Needs a local aclImdb_v1.tar.gz
+(no network in this environment); with --synthetic it builds a tiny
+in-memory corpus so the script runs anywhere:
+
+    python examples/train_text_cls.py --synthetic
+    python examples/train_text_cls.py /data/aclImdb_v1.tar.gz
+"""
+import io
+import os
+import sys
+import tarfile
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.text import Imdb
+
+MAXLEN = 64
+
+
+def synthetic_archive():
+    rng = np.random.default_rng(0)
+    pos_words = ["great", "good", "wonderful", "fun", "love"]
+    neg_words = ["bad", "awful", "boring", "hate", "poor"]
+    path = os.path.join(tempfile.mkdtemp(), "aclImdb_v1.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        for split in ("train", "test"):
+            n = 200 if split == "train" else 50
+            for i in range(n):
+                for label, words in (("pos", pos_words),
+                                     ("neg", neg_words)):
+                    doc = " ".join(rng.choice(words + ["movie", "film",
+                                                       "the", "a"], 12))
+                    data = doc.encode()
+                    info = tarfile.TarInfo(
+                        f"aclImdb/{split}/{label}/{i}.txt")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+    return path
+
+
+class BowClassifier(nn.Layer):
+    """Embedding-mean (bag of words) -> MLP head."""
+
+    def __init__(self, vocab_size, hidden=64):
+        super().__init__()
+        self.emb = nn.Embedding(vocab_size, hidden)
+        self.fc1 = nn.Linear(hidden, hidden)
+        self.fc2 = nn.Linear(hidden, 2)
+
+    def forward(self, ids):
+        h = self.emb(ids)                       # [B, L, H]
+        mask = (ids != 0).astype("float32")     # 0 = pad
+        h = paddle.sum(h * mask.unsqueeze(-1), axis=1) / (
+            paddle.sum(mask, axis=1, keepdim=True) + 1e-6)
+        return self.fc2(paddle.nn.functional.relu(self.fc1(h)))
+
+
+class Padded:
+    """Pad/trim each sample to MAXLEN (static shapes for XLA)."""
+
+    def __init__(self, ds):
+        self.ds = ds
+
+    def __len__(self):
+        return len(self.ds)
+
+    def __getitem__(self, i):
+        ids, label = self.ds[i]
+        out = np.zeros(MAXLEN, np.int64)
+        n = min(len(ids), MAXLEN)
+        out[:n] = ids[:n] + 1          # shift: 0 is the pad id
+        return out, np.int64(label)
+
+
+def main():
+    if "--synthetic" in sys.argv:
+        archive = synthetic_archive()
+    elif len(sys.argv) > 1:
+        archive = sys.argv[1]
+    else:
+        print(__doc__)
+        return
+    train = Imdb(data_file=archive, mode="train", cutoff=0)
+    test = Imdb(data_file=archive, mode="test", cutoff=0)
+    vocab = len(train.word_idx) + 1
+    print(f"train={len(train)} test={len(test)} vocab={vocab}")
+
+    model = paddle.Model(BowClassifier(vocab))
+    sched = paddle.optimizer.lr.LinearLR(2e-3, total_steps=50,
+                                         start_factor=0.1)
+    model.prepare(paddle.optimizer.Adam(sched,
+                                        parameters=model.network
+                                        .parameters()),
+                  nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    model.fit(Padded(train), Padded(test), batch_size=32, epochs=3,
+              verbose=1)
+    res = model.evaluate(Padded(test), batch_size=32, verbose=0)
+    print("eval:", res)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,6 +100,19 @@ class Tensor:
         a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __dlpack__(self, stream=None):
+        # DLPack protocol: one implementation lives in utils/dlpack.py
+        # (zero-copy on CPU; host-copy fallback on TPU — documented
+        # deviation there)
+        from ..utils.dlpack import to_dlpack
+        return to_dlpack(self)
+
+    def __dlpack_device__(self):
+        try:
+            return self._data.__dlpack_device__()
+        except Exception:
+            return (1, 0)  # kDLCPU after the host-copy fallback
+
     def __float__(self):
         return float(self.item())
 

@@ -139,3 +139,16 @@ class TestDenseSparseBridges:
     def test_bad_sparse_dim(self):
         with pytest.raises(ValueError):
             paddle.to_tensor(np.ones((2, 2), np.float32)).to_sparse_coo(3)
+
+
+class TestDlpackProtocol:
+    def test_tensor_is_dlpack_exporter(self):
+        # np.from_dlpack / torch.from_dlpack consume the Tensor directly
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        if hasattr(np, "from_dlpack"):
+            back = np.from_dlpack(t)
+            np.testing.assert_allclose(back, t.numpy())
+        torch = pytest.importorskip("torch")
+        tt = torch.from_dlpack(t)
+        np.testing.assert_allclose(tt.numpy(), t.numpy())
+        assert isinstance(t.__dlpack_device__(), tuple)
