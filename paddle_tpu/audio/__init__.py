@@ -2,6 +2,7 @@
 from . import backends  # noqa: F401
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
                        Spectrogram)
 from .backends import info, load, save  # noqa: F401

@@ -39,7 +39,8 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     """Returns (waveform Tensor, sample_rate). waveform is float32 in
     [-1, 1] (normalize=True) with shape [C, L] (channels_first) or
     [L, C]."""
-    with wave.open(str(filepath), "rb") as w:
+    fp = filepath if hasattr(filepath, "read") else str(filepath)
+    with wave.open(fp, "rb") as w:
         sr = w.getframerate()
         nch = w.getnchannels()
         sw = w.getsampwidth()

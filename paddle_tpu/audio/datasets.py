@@ -1,0 +1,161 @@
+"""paddle.audio.datasets — TESS / ESC50 from LOCAL archives (reference:
+python/paddle/audio/datasets/ — unverified, SURVEY.md blocker notice; no
+network in this environment, so `data_file`/`archive_dir` is required).
+
+Both yield (waveform float32 [n], label int64) or, with
+feat_type="mfcc"/"spectrogram"/"melspectrogram"/"logmelspectrogram",
+the corresponding paddle.audio.features transform of the waveform.
+"""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+_FEATS = ("raw", "mfcc", "spectrogram", "melspectrogram",
+          "logmelspectrogram")
+
+
+def _feature_cls(feat_type):
+    from . import features as AF
+    return {"spectrogram": AF.Spectrogram,
+            "melspectrogram": AF.MelSpectrogram,
+            "logmelspectrogram": AF.LogMelSpectrogram,
+            "mfcc": AF.MFCC}[feat_type]
+
+
+def _validate_feat(feat_type, feat_kwargs):
+    """Early validation: reference callers pass arbitrary feature kwargs
+    (hop_length, n_mfcc, window, ...) — a bad name must fail at
+    construction, not at the first __getitem__."""
+    if feat_type not in _FEATS:
+        raise ValueError(f"feat_type must be one of {_FEATS}")
+    if feat_type != "raw":
+        kw = dict(feat_kwargs)
+        if feat_type != "spectrogram":
+            kw.setdefault("sr", 16000)
+        _feature_cls(feat_type)(**kw)  # TypeError on unknown kwargs
+
+
+def _apply_feat(wav, feat_type, sr, **feat_kwargs):
+    if feat_type == "raw":
+        return wav
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    kw = dict(feat_kwargs)
+    if feat_type != "spectrogram":
+        kw.setdefault("sr", sr)
+    out = _feature_cls(feat_type)(**kw)(Tensor(jnp.asarray(wav)[None, :]))
+    return np.asarray(out._data)[0]
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set: WAV files named
+    ``*_<emotion>.wav`` under per-actor folders inside a local zip (the
+    reference's layout). Labels = sorted emotion vocabulary indices."""
+
+    def __init__(self, data_file=None, mode="train", n_folds=5,
+                 split=1, feat_type="raw", archive=None, **feat_kwargs):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "TESS needs a local zip copy (no network access); pass "
+                "data_file=")
+        _validate_feat(feat_type, feat_kwargs)
+        if not (1 <= int(split) <= int(n_folds)):
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._zip_path = data_file
+        with zipfile.ZipFile(data_file) as zf:
+            wavs = sorted(n for n in zf.namelist()
+                          if n.lower().endswith(".wav")
+                          and not os.path.basename(n).startswith("._"))
+        if not wavs:
+            raise ValueError(f"no .wav members in {data_file!r}")
+        emotions = sorted({os.path.splitext(os.path.basename(n))[0]
+                           .rsplit("_", 1)[-1].lower() for n in wavs})
+        self.label_list = emotions
+        labeled = [(n, emotions.index(
+            os.path.splitext(os.path.basename(n))[0]
+            .rsplit("_", 1)[-1].lower())) for n in wavs]
+        # deterministic fold assignment (reference: n_folds cross-val)
+        folds = {n: i % int(n_folds) for i, (n, _) in enumerate(labeled)}
+        tgt = int(split) - 1
+        if mode == "train":
+            self.rows = [(n, l) for n, l in labeled if folds[n] != tgt]
+        else:
+            self.rows = [(n, l) for n, l in labeled if folds[n] == tgt]
+        self._zf = None
+        self._zf_pid = None
+
+    def _zip(self):
+        # lazy AND pid-guarded: DataLoader forks workers after the
+        # parent may have opened the handle; a shared fd's seek/read
+        # would interleave across processes
+        if self._zf is None or self._zf_pid != os.getpid():
+            self._zf = zipfile.ZipFile(self._zip_path)
+            self._zf_pid = os.getpid()
+        return self._zf
+
+    def _wav(self, name):
+        import io as _io
+        from .backends import load as _load
+        t, sr = _load(_io.BytesIO(self._zip().read(name)),
+                      channels_first=True)
+        arr = np.asarray(t._data)
+        return (arr[0] if arr.ndim == 2 else arr).astype(np.float32), sr
+
+    def __getitem__(self, i):
+        name, label = self.rows[i]
+        wav, sr = self._wav(name)
+        return _apply_feat(wav, self.feat_type, sr,
+                           **self.feat_kwargs), np.int64(label)
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class ESC50(TESS):
+    """ESC-50 environmental sounds: WAVs named
+    ``<fold>-<src>-<take>-<target>.wav`` (reference layout); the fold
+    digit drives the train/dev split and <target> is the label."""
+
+    def __init__(self, data_file=None, mode="train", split=1,
+                 feat_type="raw", **feat_kwargs):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "ESC50 needs a local zip copy (no network access); pass "
+                "data_file=")
+        _validate_feat(feat_type, feat_kwargs)
+        if not (1 <= int(split) <= 5):
+            raise ValueError("split must be in [1, 5] (ESC-50 folds)")
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._zip_path = data_file
+        with zipfile.ZipFile(data_file) as zf:
+            wavs = sorted(n for n in zf.namelist()
+                          if n.lower().endswith(".wav")
+                          and not os.path.basename(n).startswith("._"))
+        if not wavs:
+            raise ValueError(f"no .wav members in {data_file!r}")
+        rows = []
+        for n in wavs:
+            stem = os.path.splitext(os.path.basename(n))[0]
+            parts = stem.split("-")
+            if len(parts) != 4:
+                continue
+            fold, _src, _take, target = parts
+            rows.append((n, int(fold), int(target)))
+        self.label_list = sorted({t for _, _, t in rows})
+        if mode == "train":
+            self.rows = [(n, t) for n, f, t in rows if f != int(split)]
+        else:
+            self.rows = [(n, t) for n, f, t in rows if f == int(split)]
+        self._zf = None
+        self._zf_pid = None

@@ -1,3 +1,7 @@
-"""paddle_tpu.framework — save/load + misc framework surface."""
+"""paddle_tpu.framework — save/load + misc framework surface
+(reference: python/paddle/framework/ — unverified, SURVEY.md §2.2)."""
 from .io_save import load, save  # noqa: F401
 from ..core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from ..core.dtype import (get_default_dtype,  # noqa: F401
+                          set_default_dtype)
+from ..core import random  # noqa: F401  (paddle.framework.random)

@@ -1,6 +1,7 @@
 """incubate.nn"""
 from . import functional  # noqa: F401
 
-from .layers import (FusedFeedForward, FusedLinear,  # noqa: F401
+from .layers import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                     FusedFeedForward, FusedLinear, FusedMoELayer,
                      FusedMultiHeadAttention,
                      FusedTransformerEncoderLayer)

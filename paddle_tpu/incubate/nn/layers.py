@@ -12,7 +12,8 @@ from ...nn.layer import Layer
 from ...nn import initializer as I
 from . import functional as F
 
-__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+__all__ = ["FusedBiasDropoutResidualLayerNorm", "FusedMoELayer",
+           "FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer"]
 
 
@@ -128,3 +129,50 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference: paddle.incubate.nn.FusedBiasDropoutResidualLayerNorm —
+    layer form of the fused epilogue (bias + dropout + residual + LN);
+    XLA fuses the chain into the producing matmul on TPU."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.ln_weight = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+        self.bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.bias, ln_scale=self.ln_weight,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate
+            if self.training else 0.0, ln_epsilon=self.epsilon)
+
+
+class FusedMoELayer(Layer):
+    """Reference: paddle.incubate.nn.FusedMoELayer — signature-adapting
+    shim over the TPU-native MoELayer (incubate/moe.py: GShard gate +
+    alltoall dispatch over the 'ep'/'sharding' mesh axis)."""
+
+    def __init__(self, d_model, dim_feedforward, num_expert, top_k=2,
+                 approximate=True, moe_group=None, mp_group=None,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..moe import MoELayer
+        self.moe = MoELayer(d_model=d_model, d_hidden=dim_feedforward,
+                            num_experts=num_expert, top_k=top_k)
+
+    @property
+    def l_aux(self):
+        return self.moe.l_aux
+
+    def forward(self, x):
+        return self.moe(x)

@@ -1,0 +1,129 @@
+"""paddle.audio.datasets (TESS, ESC50) — synthetic-archive parsing tests
+(SURVEY.md §2.2 audio row; local-file loaders, no network)."""
+import io
+import os
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.audio.datasets import ESC50, TESS
+
+
+def _wav_bytes(n=1600, sr=16000, freq=440.0):
+    t = np.arange(n) / sr
+    sig = (np.sin(2 * np.pi * freq * t) * 2000).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(sig.tobytes())
+    return buf.getvalue()
+
+
+@pytest.fixture
+def tess_zip(tmp_path):
+    path = tmp_path / "TESS.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        for actor in ("OAF", "YAF"):
+            for word in ("back", "bar"):
+                for emo in ("angry", "happy", "sad"):
+                    zf.writestr(f"tess/{actor}/{actor}_{word}_{emo}.wav",
+                                _wav_bytes())
+    return str(path)
+
+
+class TestTESS:
+    def test_requires_local(self):
+        with pytest.raises(FileNotFoundError):
+            TESS()
+
+    def test_labels_and_folds(self, tess_zip):
+        tr = TESS(data_file=tess_zip, mode="train", n_folds=4, split=1)
+        de = TESS(data_file=tess_zip, mode="dev", n_folds=4, split=1)
+        assert sorted(tr.label_list) == ["angry", "happy", "sad"]
+        assert len(tr) + len(de) == 12
+        wav, label = tr[0]
+        assert wav.dtype == np.float32 and wav.shape == (1600,)
+        assert np.abs(wav).max() <= 1.0
+        assert 0 <= int(label) < 3
+
+    def test_feature_mode(self, tess_zip):
+        ds = TESS(data_file=tess_zip, feat_type="melspectrogram")
+        feat, _ = ds[0]
+        assert feat.ndim == 2 and feat.shape[0] == 64  # n_mels
+
+    def test_bad_feat(self, tess_zip):
+        with pytest.raises(ValueError):
+            TESS(data_file=tess_zip, feat_type="bogus")
+
+
+@pytest.fixture
+def esc_zip(tmp_path):
+    path = tmp_path / "ESC50.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        for fold in (1, 2):
+            for target in (0, 7):
+                zf.writestr(f"audio/{fold}-1001-A-{target}.wav",
+                            _wav_bytes())
+    return str(path)
+
+
+class TestESC50:
+    def test_split_by_fold(self, esc_zip):
+        tr = ESC50(data_file=esc_zip, mode="train", split=1)
+        de = ESC50(data_file=esc_zip, mode="dev", split=1)
+        assert len(tr) == 2 and len(de) == 2  # fold 1 held out
+        wav, label = tr[0]
+        assert wav.shape == (1600,)
+        assert int(label) in (0, 7)
+        assert tr.label_list == [0, 7]
+
+    def test_requires_local(self):
+        with pytest.raises(FileNotFoundError):
+            ESC50()
+
+
+class TestReviewRegressionsAudio:
+    def test_feature_kwargs_pass_through(self, tess_zip):
+        ds = TESS(data_file=tess_zip, feat_type="mfcc", n_mfcc=13,
+                  hop_length=160)
+        feat, _ = ds[0]
+        assert feat.shape[0] == 13
+
+    def test_bad_feature_kwarg_fails_early(self, tess_zip):
+        with pytest.raises(TypeError):
+            TESS(data_file=tess_zip, feat_type="mfcc", bogus_kw=1)
+
+    def test_esc50_split_validated(self, esc_zip):
+        with pytest.raises(ValueError):
+            ESC50(data_file=esc_zip, split=6)
+
+    def test_8bit_wav_decoded(self, tmp_path):
+        # width-aware decode via backends.load (was int16-hardcoded)
+        buf = io.BytesIO()
+        with wave.open(buf, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(1)
+            w.setframerate(8000)
+            w.writeframes((np.arange(800) % 256).astype(np.uint8)
+                          .tobytes())
+        path = tmp_path / "t8.zip"
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("a/x_happy.wav", buf.getvalue())
+        ds = TESS(data_file=str(path), n_folds=1, split=1, mode="dev")
+        wav, _ = ds[0]
+        assert wav.shape == (800,)  # NOT halved by int16 mispairing
+        assert np.abs(wav).max() <= 1.0
+
+
+class TestFusedMoELayerShim:
+    def test_reference_signature(self):
+        import paddle_tpu as paddle
+        m = paddle.incubate.nn.FusedMoELayer(d_model=8,
+                                             dim_feedforward=16,
+                                             num_expert=2)
+        x = paddle.to_tensor(np.ones((1, 4, 8), np.float32))
+        assert list(m(x).shape) == [1, 4, 8]
