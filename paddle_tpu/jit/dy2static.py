@@ -39,8 +39,8 @@ Two SOT-tier pre-passes run before the lowering (round-3):
   pass to extract again.
 
 The transform is best-effort and safe: constructs it can't lower
-(loop-else with break, returns under try within a loop, zero-arg
-super(), global/nonlocal) are left untouched — tracing then raises and
+(loop-else with break, returns under try within a loop, global/nonlocal
+rebinding) are left untouched — tracing then raises and
 `to_static` falls back to eager, recording the graph-break reason (the
 SOT-fallback contract; see `paddle_tpu.jit.graph_break_report`).
 """
@@ -985,8 +985,6 @@ def transform(fn):
     raw = fn.__func__ if bound_self is not None else fn
 
     code = raw.__code__
-    if "__class__" in code.co_freevars and "super" in code.co_names:
-        raise GraphBreakError("zero-arg super() is not re-compilable")
 
     src = textwrap.dedent(inspect.getsource(raw))
     mod = ast.parse(src)
@@ -994,6 +992,49 @@ def transform(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise GraphBreakError("source is not a function definition")
     fdef.decorator_list = []
+
+    if "__class__" in code.co_freevars and "super" in code.co_names:
+        # Zero-arg super() (round-3b): outside a class body the compiler
+        # would not wire the implicit __class__ cell, so the recompiled
+        # code would raise at call time. Rewrite `super()` →
+        # `super(__class__, <first param>)`: the explicit __class__ name
+        # becomes an ordinary freevar, and the factory/cell-rebinding
+        # below maps it onto the ORIGINAL method's live __class__ cell.
+        pos = list(fdef.args.posonlyargs) + list(fdef.args.args)
+        if not pos:
+            raise GraphBreakError(
+                "zero-arg super() in a method without positional "
+                "parameters is not re-compilable")
+        first = pos[0].arg
+
+        class _SuperFix(ast.NodeTransformer):
+            # nested scopes have their own frame/first-arg semantics for
+            # zero-arg super(); rewriting them with the OUTER receiver
+            # would silently change behavior — leave them be (they keep
+            # working through the factory's __class__ cell)
+            def visit_FunctionDef(self, node):
+                return node
+
+            def visit_AsyncFunctionDef(self, node):
+                return node
+
+            def visit_Lambda(self, node):
+                return node
+
+            def visit_ClassDef(self, node):
+                return node
+
+            def visit_Call(self, node):
+                self.generic_visit(node)
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "super"
+                        and not node.args and not node.keywords):
+                    node.args = [_name("__class__"), _name(first)]
+                return node
+
+        # visit the BODY statements (visiting fdef itself would hit the
+        # root-FunctionDef skip guard above)
+        fdef.body = [_SuperFix().visit(s) for s in fdef.body]
 
     pre = _PreLower()
     fdef.body = pre.block(fdef.body)
