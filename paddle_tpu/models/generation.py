@@ -167,7 +167,7 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, num_beams=1, length_penalty=0.0,
-                 cache_dtype=None):
+                 cache_dtype=None, draft_model=None, speculative_k=4):
         """Returns generated token ids [B, max_new_tokens].
 
         num_beams > 1 runs beam search (do_sample must be False): beams
@@ -181,6 +181,14 @@ class GenerationMixin:
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
         cache_dtype = _normalize_cache_dtype(cache_dtype)
+        if draft_model is not None:
+            if do_sample or int(num_beams) > 1:
+                raise NotImplementedError(
+                    "speculative decoding supports greedy single-beam "
+                    "generation (do_sample=False, num_beams=1)")
+            return self._speculative_generate(
+                ids, int(max_new_tokens), draft_model,
+                int(speculative_k), eos, cache_dtype)
         if int(num_beams) > 1:
             if do_sample:
                 raise NotImplementedError(
@@ -250,6 +258,43 @@ class GenerationMixin:
         finally:
             if was_training:
                 self.train()
+
+    def _speculative_generate(self, ids, max_new, draft, k, eos,
+                              cache_dtype):
+        if getattr(draft.cfg, "vocab_size", None) != \
+                getattr(self.cfg, "vocab_size", None):
+            raise ValueError("draft and target models must share a "
+                             "vocabulary")
+        if not (1 <= k <= 16):
+            raise ValueError(f"speculative_k must be in [1, 16], got {k}")
+        b, s = ids.shape
+        for m_ in (self, draft):
+            maxpos = m_._max_positions()
+            if maxpos is not None and s + max_new + k + 1 > maxpos:
+                raise ValueError(
+                    f"prompt_len({s}) + max_new({max_new}) + k+1 exceeds "
+                    f"max_position_embeddings({maxpos})")
+        import weakref
+        sig = (b, s, max_new, "spec", k, eos, cache_dtype, id(draft))
+        fn = self._gen_program(sig)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _speculative_pure, self, weakref.ref(draft), s, max_new,
+                k, eos, cache_dtype))
+            self._gen_cache[sig] = fn
+        twarrs = [t._data for t in self._gen_state_tensors()]
+        dwarrs = [t._data for t in draft._gen_state_tensors()]
+        was = [(m_, getattr(m_, "training", False))
+               for m_ in (self, draft)]
+        for m_, w in was:
+            if w:
+                m_.eval()
+        try:
+            return Tensor(fn(twarrs, dwarrs, ids))
+        finally:
+            for m_, w in was:
+                if w:
+                    m_.train()
 
     def _gen_state_tensors(self):
         """Parameters + buffers, in a deterministic order, passed as the
@@ -392,3 +437,106 @@ def _generate_body(model, prompt_len, max_new, do_sample, temperature,
     all_toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],
                                axis=1)
     return all_toks
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (reference analogue: PaddleNLP speculative /
+# draft-model decoding — upstream unverified, SURVEY.md blocker notice).
+#
+# TPU-native design: ONE jitted lax.while_loop runs draft-propose /
+# target-verify rounds. The static absolute-position cache makes
+# REJECTION ROLLBACK FREE: entries written beyond the accepted offset are
+# never attended (the `k_pos <= q_pos` mask) and are simply overwritten
+# when the offset catches up — no bookkeeping, no copies. Greedy output
+# is EXACT in exact arithmetic: per verify round the accepted prefix +
+# bonus token equal the vanilla greedy continuation (tests assert
+# token-for-token equality on the f32 CPU mesh). On TPU the [B,1] decode
+# and [B,k+1] verify matmuls may reduce in different orders at reduced
+# precision, so an argmax TIE can break differently — the output is then
+# a different but equally-greedy continuation (quality-neutral; the
+# standard speculative-decoding caveat). Batched rows accept the
+# BATCH-MIN prefix — every row's emitted tokens are still its own target
+# argmaxes — trading some speedup at batch>1 for the uniform cache
+# offset the single dynamic_update_slice needs.
+
+def _speculative_body(model, draft, prompt_len, max_new, k, eos,
+                      cache_dtype, ids):
+    b = ids.shape[0]
+    total = prompt_len + max_new + k + 1
+    tc = model._init_caches(b, total, cache_dtype)
+    dc = draft._init_caches(b, total, cache_dtype)
+
+    tlogits, tc = model._forward_cached(ids, tc, 0)
+    _, dc = draft._forward_cached(ids, dc, 0)
+    cur = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+
+    buf = jnp.full((b, max_new + k + 1), eos if eos >= 0 else 0,
+                   jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, cur[:, None],
+                                       (jnp.zeros((), jnp.int32),
+                                        jnp.zeros((), jnp.int32)))
+
+    def cond(carry):
+        n = carry[3]
+        return n < max_new
+
+    def body(carry):
+        tc, dc, cur, n, buf = carry
+        pos = prompt_len + n - 1          # sequence position of `cur`
+
+        def draft_step(c, i):
+            dcs, tok = c
+            lg, dcs = draft._forward_cached(tok[:, None], dcs, pos + i)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (dcs, nxt), nxt
+
+        (dc2, _), d = jax.lax.scan(draft_step, (dc, cur),
+                                   jnp.arange(k, dtype=jnp.int32))
+        d = jnp.swapaxes(d, 0, 1)                       # [B, k] proposals
+        x = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
+        tlg, tc2 = model._forward_cached(x, tc, pos)
+        g = jnp.argmax(tlg, axis=-1).astype(jnp.int32)  # [B, k+1]
+        # acceptance: d[:, j] accepted iff g[:, j] == d[:, j] and all
+        # previous accepted; batch-min keeps the cache offset uniform
+        ok = jnp.cumprod((g[:, :k] == d).astype(jnp.int32), axis=1)
+        m = jnp.min(jnp.sum(ok, axis=1))                # scalar 0..k
+        # emit g[:, 0..m] (m+1 tokens); write all k+1, next round
+        # overwrites the tail — same free-rollback trick as the caches
+        buf = jax.lax.dynamic_update_slice(
+            buf, g, (jnp.zeros((), jnp.int32), n.astype(jnp.int32)))
+        cur = jnp.take_along_axis(g, jnp.full((b, 1), m), axis=1)[:, 0]
+        return (tc2, dc2, cur, n + m + 1, buf)
+
+    _, _, _, _, buf = jax.lax.while_loop(
+        cond, body, (tc, dc, cur, jnp.ones((), jnp.int32), buf))
+    out = buf[:, :max_new]
+    if eos >= 0:
+        seen = jnp.cumsum((out == eos).astype(jnp.int32), axis=1)
+        after = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.int32), seen[:, :-1]], axis=1) > 0
+        out = jnp.where(after, eos, out)
+    return out
+
+
+def _speculative_pure(model, draft_ref, prompt_len, max_new, k, eos,
+                      cache_dtype, twarrs, dwarrs, ids):
+    # draft_ref is a WEAKREF: the cached program must not pin the draft
+    # model's weights to the target's lifetime (weights themselves enter
+    # as dwarrs arguments). Only trace time needs the live object.
+    draft = draft_ref()
+    if draft is None:
+        raise RuntimeError("speculative draft model was garbage-collected "
+                           "before the program finished tracing")
+    tts = model._gen_state_tensors()
+    dts = draft._gen_state_tensors()
+    saved = [(t, t._data) for t in tts + dts]
+    for t, arr in zip(tts, twarrs):
+        t._data = arr
+    for t, arr in zip(dts, dwarrs):
+        t._data = arr
+    try:
+        return _speculative_body(model, draft, prompt_len, max_new, k,
+                                 eos, cache_dtype, ids)
+    finally:
+        for t, arr in saved:
+            t._data = arr

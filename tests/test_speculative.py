@@ -1,0 +1,114 @@
+"""Speculative decoding — EXACTNESS vs vanilla greedy is the oracle
+(the algorithm guarantees token-for-token equality for greedy), plus
+rollback/batch/eos edge cases. Reference analogue: PaddleNLP draft-model
+decoding (upstream unverified, SURVEY.md blocker notice)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _model(layers, hidden, seed):
+    cfg = LlamaConfig(vocab_size=96, hidden_size=hidden,
+                      intermediate_size=hidden * 2,
+                      num_hidden_layers=layers, num_attention_heads=4,
+                      num_key_value_heads=2,
+                      max_position_embeddings=256, dtype="float32")
+    paddle.seed(seed)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _model(3, 64, 0), _model(1, 32, 1)  # target, draft
+
+
+class TestSpeculativeExactness:
+    def test_matches_vanilla_greedy(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(2).integers(0, 96, (1, 10)))
+        ref = target.generate(ids, max_new_tokens=24).numpy()
+        spec = target.generate(ids, max_new_tokens=24, draft_model=draft,
+                               speculative_k=4).numpy()
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_batched_exact(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(3).integers(0, 96, (3, 8)))
+        ref = target.generate(ids, max_new_tokens=16).numpy()
+        spec = target.generate(ids, max_new_tokens=16, draft_model=draft,
+                               speculative_k=3).numpy()
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_various_k(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(4).integers(0, 96, (1, 6)))
+        ref = target.generate(ids, max_new_tokens=12).numpy()
+        for k in (1, 2, 8):
+            spec = target.generate(ids, max_new_tokens=12,
+                                   draft_model=draft,
+                                   speculative_k=k).numpy()
+            np.testing.assert_array_equal(spec, ref)
+
+    def test_self_draft_accepts_everything(self, models):
+        # draft == target → every proposal accepted; still exact
+        target, _ = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(5).integers(0, 96, (1, 5)))
+        ref = target.generate(ids, max_new_tokens=10).numpy()
+        spec = target.generate(ids, max_new_tokens=10,
+                               draft_model=target,
+                               speculative_k=4).numpy()
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_eos_semantics(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(6).integers(0, 96, (2, 6)))
+        ref = target.generate(ids, max_new_tokens=14,
+                              eos_token_id=7).numpy()
+        spec = target.generate(ids, max_new_tokens=14, draft_model=draft,
+                               speculative_k=4, eos_token_id=7).numpy()
+        np.testing.assert_array_equal(spec, ref)
+
+    def test_int8_cache_composes(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(7).integers(0, 96, (1, 6)))
+        out = target.generate(ids, max_new_tokens=8, draft_model=draft,
+                              speculative_k=3, cache_dtype="int8")
+        assert list(out.shape) == [1, 8]
+
+
+class TestSpeculativeValidation:
+    def test_sampling_rejected(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(NotImplementedError):
+            target.generate(ids, max_new_tokens=4, draft_model=draft,
+                            do_sample=True)
+        with pytest.raises(NotImplementedError):
+            target.generate(ids, max_new_tokens=4, draft_model=draft,
+                            num_beams=2)
+
+    def test_vocab_mismatch_rejected(self, models):
+        target, _ = models
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=2,
+                          max_position_embeddings=128, dtype="float32")
+        bad = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError):
+            target.generate(ids, max_new_tokens=4, draft_model=bad)
+
+    def test_bad_k_rejected(self, models):
+        target, draft = models
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError):
+            target.generate(ids, max_new_tokens=4, draft_model=draft,
+                            speculative_k=0)
