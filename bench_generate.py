@@ -1,7 +1,7 @@
 """Decode-throughput bench: LLaMA proxy autoregressive generation with
 the static-KV-cache jitted decode loop (models/generation.py).
 
-Usage: python bench_generate.py [batch] [prompt_len] [new_tokens] [--wq int8|int4] [--kv int8]
+Usage: python bench_generate.py [batch] [prompt_len] [new_tokens] [--wq int8|int4] [--kv int8] [--spec K]
 `--wq` swaps every linear (except lm_head) to weight-only quantized
 storage before compiling the decode program — decode is HBM-bound, so
 int8/int4 weights target ~2x/4x the streamed bytes.
@@ -26,6 +26,11 @@ if "--kv" in sys.argv:
     i = sys.argv.index("--kv")
     kv = sys.argv[i + 1]
     del sys.argv[i:i + 2]
+spec_k = 0
+if "--spec" in sys.argv:
+    i = sys.argv.index("--spec")
+    spec_k = int(sys.argv[i + 1])
+    del sys.argv[i:i + 2]
 batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 prompt = int(sys.argv[2]) if len(sys.argv) > 2 else 128
 new = int(sys.argv[3]) if len(sys.argv) > 3 else 128
@@ -41,17 +46,21 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # speculative mode needs k+1 extra cache/position slots — size the
+    # config up front (a post-hoc mutation would defeat the maxpos
+    # guard for families with build-time position tables)
+    maxpos = prompt + new + (spec_k + 1 if spec_k else 0)
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
                           num_attention_heads=16,
-                          max_position_embeddings=prompt + new,
+                          max_position_embeddings=maxpos,
                           dtype="bfloat16")
     else:
         cfg = LlamaConfig(vocab_size=512, hidden_size=128,
                           intermediate_size=256, num_hidden_layers=2,
                           num_attention_heads=4,
-                          max_position_embeddings=prompt + new)
+                          max_position_embeddings=maxpos)
     P.seed(0)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
@@ -61,6 +70,29 @@ def main():
         from paddle_tpu.nn.quant import convert_to_weight_only
         convert_to_weight_only(model, algo=f"weight_only_{wq}",
                                exclude=("lm_head",))
+    draft = None
+    if spec_k:
+        # layer-skip self-speculation: the draft is the target truncated
+        # to its first quarter of layers (shared embedding/head weights
+        # copied) — a realistic acceptance-rate proxy, unlike an
+        # uncorrelated random draft
+        dcfg_kw = dict(vocab_size=cfg.vocab_size,
+                       hidden_size=cfg.hidden_size,
+                       intermediate_size=cfg.intermediate_size,
+                       num_hidden_layers=max(1, cfg.num_hidden_layers // 4),
+                       num_attention_heads=cfg.num_attention_heads,
+                       max_position_embeddings=maxpos,
+                       dtype=cfg.dtype)
+        draft = LlamaForCausalLM(LlamaConfig(**dcfg_kw))
+        sd = model.state_dict()
+        dsd = draft.state_dict()
+        for name in dsd:
+            if name in sd and tuple(sd[name].shape) == \
+                    tuple(dsd[name].shape):
+                dsd[name].set_value(sd[name])
+        if on_tpu:
+            draft.to(dtype="bfloat16")
+        draft.eval()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     x = P.to_tensor(ids)
@@ -76,8 +108,11 @@ def main():
     # identical requests) and each timed region ends in a host fetch of
     # a value derived from the output.
     new_q = max(1, new // 4)
+    gen_kw = dict(cache_dtype=kv)
+    if draft is not None:
+        gen_kw.update(draft_model=draft, speculative_k=spec_k)
     for warm_n in (new, new_q):   # compile both trip counts
-        out = model.generate(x, max_new_tokens=warm_n, cache_dtype=kv)
+        out = model.generate(x, max_new_tokens=warm_n, **gen_kw)
         out._data.block_until_ready()
 
     def timed(n):
@@ -89,7 +124,7 @@ def main():
                                 (batch, prompt)).astype(np.int32)
             x2 = P.to_tensor(ids2)
             t0 = time.perf_counter()
-            out = model.generate(x2, max_new_tokens=n, cache_dtype=kv)
+            out = model.generate(x2, max_new_tokens=n, **gen_kw)
             int(np.asarray(out._data).sum())   # dependent fetch
             best = min(best, time.perf_counter() - t0)
         return best
@@ -115,6 +150,7 @@ def main():
         "batch": batch, "prompt": prompt, "new_tokens": new,
         "weight_quant": wq or "none",
         "kv_cache": kv or "bf16",
+        "speculative_k": spec_k,
         "e2e_tok_per_s": round(tok_s, 1),
         "wall_s": round(dt, 3), "wall_quarter_s": round(dt_q, 3),
         "fixed_overhead_s_est":

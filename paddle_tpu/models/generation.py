@@ -275,13 +275,22 @@ class GenerationMixin:
                     f"prompt_len({s}) + max_new({max_new}) + k+1 exceeds "
                     f"max_position_embeddings({maxpos})")
         import weakref
-        sig = (b, s, max_new, "spec", k, eos, cache_dtype, id(draft))
-        fn = self._gen_program(sig)
+        # cache entry carries the draft WEAKREF and is validated by
+        # identity on every hit — id()-keying would let a recycled
+        # address alias a different draft (CLAUDE.md: pin by identity)
+        sig = (b, s, max_new, "spec", k, eos, cache_dtype)
+        ent = self._gen_program(sig)
+        fn = None
+        if ent is not None:
+            ref, cached_fn = ent
+            if ref() is draft:
+                fn = cached_fn
         if fn is None:
+            ref = weakref.ref(draft)
             fn = jax.jit(functools.partial(
-                _speculative_pure, self, weakref.ref(draft), s, max_new,
+                _speculative_pure, self, ref, s, max_new,
                 k, eos, cache_dtype))
-            self._gen_cache[sig] = fn
+            self._gen_cache[sig] = (ref, fn)
         twarrs = [t._data for t in self._gen_state_tensors()]
         dwarrs = [t._data for t in draft._gen_state_tensors()]
         was = [(m_, getattr(m_, "training", False))
@@ -290,7 +299,12 @@ class GenerationMixin:
             if w:
                 m_.eval()
         try:
-            return Tensor(fn(twarrs, dwarrs, ids))
+            out, rounds = fn(twarrs, dwarrs, ids)
+            # verify-round count → acceptance diagnostics (rounds ==
+            # ceil((max_new-1)/(k+1)) at full acceptance)
+            import numpy as _np
+            self._last_spec_rounds = int(_np.asarray(rounds))
+            return Tensor(out)
         finally:
             for m_, w in was:
                 if w:
@@ -481,7 +495,7 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
         return n < max_new
 
     def body(carry):
-        tc, dc, cur, n, buf = carry
+        tc, dc, cur, n, buf, r = carry
         pos = prompt_len + n - 1          # sequence position of `cur`
 
         def draft_step(c, i):
@@ -490,9 +504,15 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
             nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
             return (dcs, nxt), nxt
 
+        # k+1 steps: the extra step feeds d_{k-1} through the draft so
+        # its K/V lands at pos+k — without it, a full-accept round
+        # (m=k) leaves a PERMANENT unmasked hole there and acceptance
+        # collapses on subsequent rounds (measured: [4,1,0,2,...]
+        # instead of [4,4,4,...] with a self-draft). When m<k the extra
+        # slot is overwritten like any rolled-back entry.
         (dc2, _), d = jax.lax.scan(draft_step, (dc, cur),
-                                   jnp.arange(k, dtype=jnp.int32))
-        d = jnp.swapaxes(d, 0, 1)                       # [B, k] proposals
+                                   jnp.arange(k + 1, dtype=jnp.int32))
+        d = jnp.swapaxes(d, 0, 1)[:, :k]                # [B, k] proposals
         x = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
         tlg, tc2 = model._forward_cached(x, tc, pos)
         g = jnp.argmax(tlg, axis=-1).astype(jnp.int32)  # [B, k+1]
@@ -505,17 +525,18 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
         buf = jax.lax.dynamic_update_slice(
             buf, g, (jnp.zeros((), jnp.int32), n.astype(jnp.int32)))
         cur = jnp.take_along_axis(g, jnp.full((b, 1), m), axis=1)[:, 0]
-        return (tc2, dc2, cur, n + m + 1, buf)
+        return (tc2, dc2, cur, n + m + 1, buf, r + 1)
 
-    _, _, _, _, buf = jax.lax.while_loop(
-        cond, body, (tc, dc, cur, jnp.ones((), jnp.int32), buf))
+    _, _, _, _, buf, rounds = jax.lax.while_loop(
+        cond, body, (tc, dc, cur, jnp.ones((), jnp.int32), buf,
+                     jnp.zeros((), jnp.int32)))
     out = buf[:, :max_new]
     if eos >= 0:
         seen = jnp.cumsum((out == eos).astype(jnp.int32), axis=1)
         after = jnp.concatenate(
             [jnp.zeros((b, 1), jnp.int32), seen[:, :-1]], axis=1) > 0
         out = jnp.where(after, eos, out)
-    return out
+    return out, rounds
 
 
 def _speculative_pure(model, draft_ref, prompt_len, max_new, k, eos,

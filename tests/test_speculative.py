@@ -112,3 +112,39 @@ class TestSpeculativeValidation:
         with pytest.raises(ValueError):
             target.generate(ids, max_new_tokens=4, draft_model=draft,
                             speculative_k=0)
+
+
+class TestSpeculativeReviewRegressions:
+    def test_self_draft_full_acceptance_rate(self, models):
+        """Review regression: the draft-cache hole at full-accept rounds
+        collapsed acceptance. With draft==target every round must accept
+        k proposals, so max_new tokens take ceil((max_new-1)/(k+1))
+        verify rounds — count them via the target's forward invocations.
+        """
+        target, _ = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(8).integers(0, 96, (1, 5)))
+        k, max_new = 4, 21
+        out = target.generate(ids, max_new_tokens=max_new,
+                              draft_model=target, speculative_k=k)
+        assert list(out.shape) == [1, max_new]
+        # runtime rounds counter from the program: full acceptance →
+        # exactly ceil((max_new-1)/(k+1)) = 4 verify rounds for 20
+        # post-prefill tokens (the cache-hole bug measured 7)
+        assert target._last_spec_rounds == 4, target._last_spec_rounds
+
+    def test_draft_id_reuse_not_aliased(self, models):
+        import gc
+        target, _ = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(9).integers(0, 96, (1, 4)))
+        d1 = _model(1, 32, 7)
+        target.generate(ids, max_new_tokens=4, draft_model=d1,
+                        speculative_k=2)
+        del d1
+        gc.collect()
+        d2 = _model(1, 32, 8)  # may land on the recycled address
+        out = target.generate(ids, max_new_tokens=4, draft_model=d2,
+                              speculative_k=2)
+        ref = target.generate(ids, max_new_tokens=4).numpy()
+        np.testing.assert_array_equal(out.numpy(), ref)
