@@ -182,13 +182,15 @@ class GenerationMixin:
         eos = -1 if eos_token_id is None else int(eos_token_id)
         cache_dtype = _normalize_cache_dtype(cache_dtype)
         if draft_model is not None:
-            if do_sample or int(num_beams) > 1:
+            if int(num_beams) > 1:
                 raise NotImplementedError(
-                    "speculative decoding supports greedy single-beam "
-                    "generation (do_sample=False, num_beams=1)")
+                    "speculative decoding is single-beam (num_beams=1); "
+                    "greedy and sampling are both supported")
+            sample_cfg = (float(temperature), int(top_k),
+                          float(top_p)) if do_sample else None
             return self._speculative_generate(
                 ids, int(max_new_tokens), draft_model,
-                int(speculative_k), eos, cache_dtype)
+                int(speculative_k), eos, cache_dtype, sample_cfg, seed)
         if int(num_beams) > 1:
             if do_sample:
                 raise NotImplementedError(
@@ -260,7 +262,7 @@ class GenerationMixin:
                 self.train()
 
     def _speculative_generate(self, ids, max_new, draft, k, eos,
-                              cache_dtype):
+                              cache_dtype, sample_cfg=None, seed=None):
         if getattr(draft.cfg, "vocab_size", None) != \
                 getattr(self.cfg, "vocab_size", None):
             raise ValueError("draft and target models must share a "
@@ -278,7 +280,7 @@ class GenerationMixin:
         # cache entry carries the draft WEAKREF and is validated by
         # identity on every hit — id()-keying would let a recycled
         # address alias a different draft (CLAUDE.md: pin by identity)
-        sig = (b, s, max_new, "spec", k, eos, cache_dtype)
+        sig = (b, s, max_new, "spec", k, eos, cache_dtype, sample_cfg)
         ent = self._gen_program(sig)
         fn = None
         if ent is not None:
@@ -289,7 +291,7 @@ class GenerationMixin:
             ref = weakref.ref(draft)
             fn = jax.jit(functools.partial(
                 _speculative_pure, self, ref, s, max_new,
-                k, eos, cache_dtype))
+                k, eos, cache_dtype, sample_cfg))
             self._gen_cache[sig] = (ref, fn)
         twarrs = [t._data for t in self._gen_state_tensors()]
         dwarrs = [t._data for t in draft._gen_state_tensors()]
@@ -298,8 +300,10 @@ class GenerationMixin:
         for m_, w in was:
             if w:
                 m_.eval()
+        key = _random.next_key() if seed is None else \
+            jax.random.PRNGKey(seed)
         try:
-            out, rounds = fn(twarrs, dwarrs, ids)
+            out, rounds = fn(twarrs, dwarrs, ids, key)
             # verify-round count → acceptance diagnostics (rounds ==
             # ceil((max_new-1)/(k+1)) at full acceptance)
             import numpy as _np
@@ -317,10 +321,10 @@ class GenerationMixin:
                                           self.named_buffers()]
 
 
-def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
-    """logits [B, V] → token [B] (vectorized sampling stack)."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, temperature, top_k, top_p):
+    """The sampling stack's logit transform (temperature + top-k/top-p
+    masking), shared by vanilla and speculative decoding so both draw
+    from the SAME filtered distribution."""
     lg = logits.astype(jnp.float32) / max(temperature, 1e-6)
     v = lg.shape[-1]
     if top_k and top_k > 0:
@@ -334,6 +338,14 @@ def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
         cut = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         thresh = jnp.take_along_axis(srt, cut, axis=-1)
         lg = jnp.where(lg < thresh, -jnp.inf, lg)
+    return lg
+
+
+def _sample_token(logits, key, do_sample, temperature, top_k, top_p):
+    """logits [B, V] → token [B] (vectorized sampling stack)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = _filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
@@ -474,15 +486,29 @@ def _generate_body(model, prompt_len, max_new, do_sample, temperature,
 # offset the single dynamic_update_slice needs.
 
 def _speculative_body(model, draft, prompt_len, max_new, k, eos,
-                      cache_dtype, ids):
+                      cache_dtype, sample_cfg, ids, key):
+    """sample_cfg None → greedy (token-exact vs vanilla). Otherwise
+    (temperature, top_k, top_p): standard speculative REJECTION sampling
+    — draft proposals accepted with prob min(1, p/q), rejections drawn
+    from the residual max(p−q, 0)/Z — whose marginal at every position
+    is exactly the target's filtered distribution (distribution-level
+    oracle test vs vanilla sampling)."""
     b = ids.shape[0]
+    do_sample = sample_cfg is not None
+    temperature, top_k, top_p = sample_cfg or (1.0, 0, 1.0)
+
+    def filt(lg):
+        return _filter_logits(lg, temperature, top_k, top_p)
+
     total = prompt_len + max_new + k + 1
     tc = model._init_caches(b, total, cache_dtype)
     dc = draft._init_caches(b, total, cache_dtype)
 
     tlogits, tc = model._forward_cached(ids, tc, 0)
     _, dc = draft._forward_cached(ids, dc, 0)
-    cur = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+    key, sub = jax.random.split(key)
+    cur = _sample_token(tlogits[:, -1], sub, do_sample, temperature,
+                        top_k, top_p)
 
     buf = jnp.full((b, max_new + k + 1), eos if eos >= 0 else 0,
                    jnp.int32)
@@ -495,14 +521,21 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
         return n < max_new
 
     def body(carry):
-        tc, dc, cur, n, buf, r = carry
+        tc, dc, cur, n, buf, r, key = carry
         pos = prompt_len + n - 1          # sequence position of `cur`
+        key, kd, ka, kr = jax.random.split(key, 4)
 
         def draft_step(c, i):
             dcs, tok = c
             lg, dcs = draft._forward_cached(tok[:, None], dcs, pos + i)
-            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-            return (dcs, nxt), nxt
+            f = filt(lg[:, -1])
+            if do_sample:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(kd, i), f, axis=-1
+                ).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(f, axis=-1).astype(jnp.int32)
+            return (dcs, nxt), (nxt, jax.nn.softmax(f, axis=-1))
 
         # k+1 steps: the extra step feeds d_{k-1} through the draft so
         # its K/V lands at pos+k — without it, a full-accept round
@@ -510,26 +543,70 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
         # collapses on subsequent rounds (measured: [4,1,0,2,...]
         # instead of [4,4,4,...] with a self-draft). When m<k the extra
         # slot is overwritten like any rolled-back entry.
-        (dc2, _), d = jax.lax.scan(draft_step, (dc, cur),
-                                   jnp.arange(k + 1, dtype=jnp.int32))
-        d = jnp.swapaxes(d, 0, 1)[:, :k]                # [B, k] proposals
+        (dc2, _), (d_all, q_all) = jax.lax.scan(
+            draft_step, (dc, cur), jnp.arange(k + 1, dtype=jnp.int32))
+        d = jnp.swapaxes(d_all, 0, 1)[:, :k]            # [B, k] proposals
+        qdist = jnp.swapaxes(q_all, 0, 1)[:, :k]        # [B, k, V]
         x = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
         tlg, tc2 = model._forward_cached(x, tc, pos)
-        g = jnp.argmax(tlg, axis=-1).astype(jnp.int32)  # [B, k+1]
-        # acceptance: d[:, j] accepted iff g[:, j] == d[:, j] and all
-        # previous accepted; batch-min keeps the cache offset uniform
-        ok = jnp.cumprod((g[:, :k] == d).astype(jnp.int32), axis=1)
-        m = jnp.min(jnp.sum(ok, axis=1))                # scalar 0..k
-        # emit g[:, 0..m] (m+1 tokens); write all k+1, next round
+        pf = filt(tlg)                                  # [B, k+1, V]
+        if do_sample:
+            pdist = jax.nn.softmax(pf, axis=-1)
+            psel = jnp.take_along_axis(pdist[:, :k], d[..., None],
+                                       axis=-1)[..., 0]       # [B, k]
+            qsel = jnp.take_along_axis(qdist, d[..., None],
+                                       axis=-1)[..., 0]
+            u = jax.random.uniform(ka, (b, k))
+            acc = u * jnp.maximum(qsel, 1e-20) < psel
+            ok = jnp.cumprod(acc.astype(jnp.int32), axis=1)   # [B, k]
+            m = jnp.min(jnp.sum(ok, axis=1))
+            # cutoff position m: rows that accepted proposal m keep it;
+            # rows that rejected there draw from the residual
+            # max(p−q, 0) (at m==k nobody "accepted": q is padded 0, so
+            # the residual is p itself — a fresh target sample)
+            ok_pad = jnp.concatenate(
+                [ok, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            q_pad = jnp.concatenate(
+                [qdist, jnp.zeros((b, 1) + qdist.shape[2:])], axis=1)
+            mi = jnp.full((b, 1), m)
+            p_c = jnp.take_along_axis(pdist, mi[..., None],
+                                      axis=1)[:, 0]           # [B, V]
+            q_c = jnp.take_along_axis(q_pad, mi[..., None],
+                                      axis=1)[:, 0]
+            resid = jnp.maximum(p_c - q_c, 0.0)
+            resid = jnp.log(resid + 1e-20)
+            fresh = jax.random.categorical(kr, resid,
+                                           axis=-1).astype(jnp.int32)
+            d_pad = jnp.concatenate(
+                [d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            kept = jnp.take_along_axis(d_pad, mi, axis=1)[:, 0]
+            bonus = jnp.where(
+                jnp.take_along_axis(ok_pad, mi, axis=1)[:, 0] > 0,
+                kept, fresh)
+            # emitted row: accepted proposals then the bonus — build the
+            # k+1-wide write (tail overwritten next round)
+            e = jnp.concatenate([d, fresh[:, None]], axis=1)
+            e = jnp.where(jnp.arange(k + 1)[None, :] == m, bonus[:, None],
+                          e)
+            cur2 = bonus
+        else:
+            g = jnp.argmax(pf, axis=-1).astype(jnp.int32)   # [B, k+1]
+            # acceptance: d[:, j] accepted iff g[:, j] == d[:, j] and
+            # all previous accepted; batch-min keeps offsets uniform
+            ok = jnp.cumprod((g[:, :k] == d).astype(jnp.int32), axis=1)
+            m = jnp.min(jnp.sum(ok, axis=1))                # scalar 0..k
+            e = g
+            cur2 = jnp.take_along_axis(g, jnp.full((b, 1), m),
+                                       axis=1)[:, 0]
+        # emit e[:, 0..m] (m+1 tokens); write all k+1, next round
         # overwrites the tail — same free-rollback trick as the caches
         buf = jax.lax.dynamic_update_slice(
-            buf, g, (jnp.zeros((), jnp.int32), n.astype(jnp.int32)))
-        cur = jnp.take_along_axis(g, jnp.full((b, 1), m), axis=1)[:, 0]
-        return (tc2, dc2, cur, n + m + 1, buf, r + 1)
+            buf, e, (jnp.zeros((), jnp.int32), n.astype(jnp.int32)))
+        return (tc2, dc2, cur2, n + m + 1, buf, r + 1, key)
 
-    _, _, _, _, buf, rounds = jax.lax.while_loop(
+    _, _, _, _, buf, rounds, _ = jax.lax.while_loop(
         cond, body, (tc, dc, cur, jnp.ones((), jnp.int32), buf,
-                     jnp.zeros((), jnp.int32)))
+                     jnp.zeros((), jnp.int32), key))
     out = buf[:, :max_new]
     if eos >= 0:
         seen = jnp.cumsum((out == eos).astype(jnp.int32), axis=1)
@@ -540,7 +617,7 @@ def _speculative_body(model, draft, prompt_len, max_new, k, eos,
 
 
 def _speculative_pure(model, draft_ref, prompt_len, max_new, k, eos,
-                      cache_dtype, twarrs, dwarrs, ids):
+                      cache_dtype, sample_cfg, twarrs, dwarrs, ids, key):
     # draft_ref is a WEAKREF: the cached program must not pin the draft
     # model's weights to the target's lifetime (weights themselves enter
     # as dwarrs arguments). Only trace time needs the live object.
@@ -557,7 +634,7 @@ def _speculative_pure(model, draft_ref, prompt_len, max_new, k, eos,
         t._data = arr
     try:
         return _speculative_body(model, draft, prompt_len, max_new, k,
-                                 eos, cache_dtype, ids)
+                                 eos, cache_dtype, sample_cfg, ids, key)
     finally:
         for t, arr in saved:
             t._data = arr
