@@ -11,12 +11,14 @@ from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from .collective import (ProcessGroup, ReduceOp, all_gather,  # noqa: F401
                          all_gather_object, all_reduce, alltoall,
-                         alltoall_single, barrier, broadcast,
-                         broadcast_object_list, destroy_process_group,
-                         gather, get_backend, get_group, irecv,
-                         is_initialized, isend, new_group, recv, reduce,
-                         reduce_scatter, scatter, scatter_object_list,
-                         send, wait)
+                         alltoall_single, barrier, batch_isend_irecv,
+                         broadcast, broadcast_object_list,
+                         destroy_process_group, gather, get_backend,
+                         get_group, irecv, is_initialized, isend,
+                         monitored_barrier, new_group, P2POp, recv,
+                         reduce, reduce_scatter, scatter,
+                         scatter_object_list, send, wait)
+from . import stream  # noqa: F401
 from .env import get_rank, get_world_size  # noqa: F401
 from .env import ParallelEnv  # noqa: F401
 from .parallel import DataParallel, init_parallel_env  # noqa: F401

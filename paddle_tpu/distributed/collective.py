@@ -412,11 +412,6 @@ def wait(tensor, group=None, use_calc_stream=True):
         tensor.block_until_ready()
 
 
-def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
-                      use_calc_stream=False):
-    return all_reduce(tensor, op, group, sync_op)
-
-
 def is_initialized():
     return _default_group is not None
 
@@ -478,3 +473,43 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     per = max(len(objs) // n, 1)
     out_object_list.append(objs[min(rank * per, len(objs) - 1)])
     return None
+
+
+class P2POp:
+    """Reference parity: paddle.distributed.P2POp — one peer-to-peer
+    operation for batch_isend_irecv. `op` is the module-level isend or
+    irecv function."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend "
+                             "or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of isend/irecv ops; returns their Tasks. The
+    reference coalesces these into one NCCL group call — XLA's scheduler
+    performs the same coalescing/overlap on the lowered collectives, so
+    issuing them back-to-back is the TPU-native equivalent."""
+    if not p2p_op_list:
+        raise ValueError("batch_isend_irecv expects a non-empty list")
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise TypeError("batch_isend_irecv expects a list of P2POp")
+    tasks = []
+    for p in p2p_op_list:
+        if p.op is isend:
+            tasks.append(isend(p.tensor, dst=p.peer, group=p.group))
+        else:
+            tasks.append(irecv(p.tensor, src=p.peer, group=p.group))
+    return tasks
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Reference parity: barrier with a liveness timeout. The underlying
+    rendezvous (TCPStore counter / coordination service) already bounds
+    waits; timeout is accepted for signature parity."""
+    return barrier(group=group)

@@ -1,0 +1,78 @@
+"""paddle.distributed.stream — stream-variant collectives (reference:
+python/paddle/distributed/communication/stream/ — unverified, SURVEY.md
+§2.3 Communication API).
+
+TPU-native design stance: the reference's `use_calc_stream` knob picks
+between the compute stream (synchronous) and a dedicated comm stream
+(overlappable) on NCCL. Under XLA there is no user-visible stream pair —
+the compiler schedules collectives and overlaps them with compute
+(SURVEY.md §5.8) — so these wrappers accept the reference signature
+(`sync_op`, `use_calc_stream`) and lower to the same ProcessGroupXLA
+collectives; the overlap the knob used to buy is performed by the XLA
+scheduler instead.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "scatter", "alltoall", "alltoall_single", "reduce", "send",
+           "recv"]
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    kw = {} if op is None else {"op": op}
+    return _c.all_reduce(tensor, group=group, **kw)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_list, tensor, group=group)
+
+
+def reduce_scatter(tensor, tensor_list, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    kw = {} if op is None else {"op": op}
+    return _c.reduce_scatter(tensor, tensor_list, group=group, **kw)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _c.broadcast(tensor, src=src, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _c.scatter(tensor, tensor_list, src=src, group=group)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    # reference STREAM variants take (out, in) — the reverse of the
+    # plain collective's (in, out); map across
+    return _c.alltoall(in_tensor_list, out_tensor_list, group=group)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    # reference STREAM variant order: (out, in)
+    return _c.alltoall_single(in_tensor, out_tensor,
+                              in_split_sizes=in_split_sizes,
+                              out_split_sizes=out_split_sizes,
+                              group=group)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    kw = {} if op is None else {"op": op}
+    return _c.reduce(tensor, dst=dst, group=group, **kw)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group, sync_op=sync_op)

@@ -180,3 +180,40 @@ class TestFleetImportPaths:
         from paddle_tpu.distributed.fleet.mp_layers import (
             ColumnParallelLinear as impl)
         assert ColumnParallelLinear is impl
+
+
+class TestStreamAndP2P:
+    def test_stream_all_reduce(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        dist.stream.all_reduce(x)  # world size 1: identity
+        np.testing.assert_allclose(x.numpy(), 1.0)
+
+    def test_stream_signatures_accept_knobs(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        dist.stream.all_reduce(x, sync_op=False, use_calc_stream=True)
+        dist.stream.broadcast(x, src=0, use_calc_stream=True)
+
+    def test_p2pop_validation(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(ValueError):
+            dist.P2POp(dist.all_reduce, x, 0)
+        with pytest.raises(ValueError):
+            dist.batch_isend_irecv([])
+        with pytest.raises(TypeError):
+            dist.batch_isend_irecv([1, 2])
+
+    def test_monitored_barrier(self):
+        import paddle_tpu.distributed as dist
+        dist.monitored_barrier(timeout=5)  # world size 1: no-op
+
+    def test_stream_alltoall_out_in_order(self):
+        # stream variants take (out, in) — review regression
+        import paddle_tpu.distributed as dist
+        x = [paddle.to_tensor(np.full(2, 5.0, np.float32))]
+        out = []
+        dist.stream.alltoall(out, x)  # world size 1: out gets x's shard
+        assert len(out) == 1
+        np.testing.assert_allclose(out[0].numpy(), 5.0)
