@@ -13,6 +13,7 @@ from .activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink, Hardsigmoid,
 from .clip_grad import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                         clip_grad_norm_)
 from .common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
+                     FeatureAlphaDropout,
                      Dropout2D, Dropout3D, Embedding, Flatten, Identity,
                      Linear, Pad1D, Pad2D, Pad3D, PixelShuffle, Upsample,
                      UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
@@ -27,7 +28,7 @@ from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
                       AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
-from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
+from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, SimpleRNN,
                   SimpleRNNCell)
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerDecoderLayer, TransformerEncoder,

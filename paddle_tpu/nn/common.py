@@ -224,3 +224,20 @@ class Bilinear(Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+class FeatureAlphaDropout(Layer):
+    """Reference parity: paddle.nn.FeatureAlphaDropout — alpha dropout
+    that drops ENTIRE feature channels (axis 1), preserving SELU
+    self-normalizing statistics (delegates to F.alpha_dropout's
+    channelwise mode — one copy of the math)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        if not 0 <= p < 1:
+            raise ValueError(f"p must be in [0, 1), got {p}")
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training,
+                               channelwise=True)

@@ -672,22 +672,34 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=axes, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
+def alpha_dropout(x, p=0.5, training=True, name=None,
+                  channelwise=False):
+    """SELU-preserving dropout. channelwise=True drops whole feature
+    channels (axis 1) — the FeatureAlphaDropout semantics — with the
+    same affine correction (ONE copy of the SELU constants)."""
     x = ensure_tensor(x)
     if not training or p == 0.0:
         return x
+    if not 0 <= p < 1:
+        raise ValueError(f"p must be in [0, 1), got {p}")
     k = next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     neg = -alpha * scale
 
     def f(a):
-        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        shape = a.shape if not channelwise else \
+            tuple(a.shape[:2]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
         q = 1.0 - p
         a_coef = (q + neg ** 2 * q * p) ** -0.5
         b_coef = -a_coef * p * neg
         return (a_coef * jnp.where(keep, a, neg) + b_coef).astype(a.dtype)
     return apply(f, x, name="alpha_dropout")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    return alpha_dropout(x, p=p, training=training, channelwise=True)
 
 # ---------------------------------------------------------------------------
 # losses (functional)

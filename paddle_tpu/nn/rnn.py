@@ -287,3 +287,40 @@ class RNN(Layer):
         if self.time_major:
             y = y.swapaxes(0, 1)
         return y, states
+
+
+class BiRNN(Layer):
+    """Reference parity: paddle.nn.BiRNN — run a forward cell and a
+    backward cell over the sequence and concatenate the feature dims."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        # single registration per cell (via the RNN wrappers) — the
+        # direct attributes are plain properties below
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    @property
+    def cell_fw(self):
+        return self.rnn_fw.cell
+
+    @property
+    def cell_bw(self):
+        return self.rnn_bw.cell
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            # the reverse pass would start inside the padding; honest
+            # failure beats silently-wrong backward states
+            raise NotImplementedError(
+                "BiRNN with sequence_length (padded batches) is not "
+                "supported; trim/pack sequences instead")
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        import paddle_tpu as P
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return P.concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

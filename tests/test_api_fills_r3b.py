@@ -217,3 +217,68 @@ class TestStreamAndP2P:
         dist.stream.alltoall(out, x)  # world size 1: out gets x's shard
         assert len(out) == 1
         np.testing.assert_allclose(out[0].numpy(), 5.0)
+
+
+class TestNewLayers:
+    def test_birnn_matches_torch_bidirectional(self):
+        torch = pytest.importorskip("torch")
+        cf, cb = nn.SimpleRNNCell(4, 8), nn.SimpleRNNCell(4, 8)
+        bi = nn.BiRNN(cf, cb)
+        tr = torch.nn.RNN(4, 8, nonlinearity="tanh", batch_first=True,
+                          bidirectional=True)
+        with torch.no_grad():
+            tr.weight_ih_l0.copy_(torch.from_numpy(
+                cf.weight_ih.numpy()))
+            tr.weight_hh_l0.copy_(torch.from_numpy(
+                cf.weight_hh.numpy()))
+            tr.bias_ih_l0.copy_(torch.from_numpy(cf.bias_ih.numpy()))
+            tr.bias_hh_l0.copy_(torch.from_numpy(cf.bias_hh.numpy()))
+            tr.weight_ih_l0_reverse.copy_(torch.from_numpy(
+                cb.weight_ih.numpy()))
+            tr.weight_hh_l0_reverse.copy_(torch.from_numpy(
+                cb.weight_hh.numpy()))
+            tr.bias_ih_l0_reverse.copy_(torch.from_numpy(
+                cb.bias_ih.numpy()))
+            tr.bias_hh_l0_reverse.copy_(torch.from_numpy(
+                cb.bias_hh.numpy()))
+        x = np.random.default_rng(0).standard_normal(
+            (2, 5, 4)).astype(np.float32)
+        y, _ = bi(paddle.to_tensor(x))
+        ref, _ = tr(torch.from_numpy(x))
+        np.testing.assert_allclose(y.numpy(), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_birnn_padded_batches_rejected(self):
+        bi = nn.BiRNN(nn.SimpleRNNCell(2, 2), nn.SimpleRNNCell(2, 2))
+        x = paddle.to_tensor(np.ones((1, 3, 2), np.float32))
+        with pytest.raises(NotImplementedError):
+            bi(x, sequence_length=paddle.to_tensor(np.array([2])))
+
+    def test_birnn_single_registration(self):
+        bi = nn.BiRNN(nn.SimpleRNNCell(2, 2), nn.SimpleRNNCell(2, 2))
+        assert bi.cell_fw is bi.rnn_fw.cell  # properties, not re-registered
+        subs = [s for _, s in bi.named_sublayers()]
+        assert sum(1 for s in subs if s is bi.cell_fw) == 1
+
+    def test_feature_alpha_dropout_affine_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        p = 0.4
+        fd = nn.FeatureAlphaDropout(p)
+        ours = fd(paddle.to_tensor(np.ones((64, 64, 2),
+                                           np.float32))).numpy()
+        tref = torch.nn.functional.feature_alpha_dropout(
+            torch.ones(64, 64, 2), p=p, training=True).numpy()
+        # same affine correction → the SAME two output levels
+        np.testing.assert_allclose(sorted(set(np.round(ours.ravel(), 4))),
+                                   sorted(set(np.round(tref.ravel(), 4))),
+                                   atol=2e-4)
+        per = ours.reshape(64, 64, -1)
+        assert np.allclose(per.std(axis=-1), 0, atol=1e-6)  # whole chans
+        fd.eval()
+        np.testing.assert_allclose(
+            fd(paddle.to_tensor(np.ones((2, 3), np.float32))).numpy(),
+            1.0)
+
+    def test_feature_alpha_dropout_p1_rejected(self):
+        with pytest.raises(ValueError):
+            nn.FeatureAlphaDropout(1.0)
