@@ -678,10 +678,10 @@ def alpha_dropout(x, p=0.5, training=True, name=None,
     channels (axis 1) — the FeatureAlphaDropout semantics — with the
     same affine correction (ONE copy of the SELU constants)."""
     x = ensure_tensor(x)
+    if not 0 <= p < 1:  # validate BEFORE the eval-mode early return
+        raise ValueError(f"p must be in [0, 1), got {p}")
     if not training or p == 0.0:
         return x
-    if not 0 <= p < 1:
-        raise ValueError(f"p must be in [0, 1), got {p}")
     k = next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
@@ -1008,3 +1008,4 @@ def pad(x, pad_, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 from .extended import *  # noqa: E402,F401,F403
 from .extended2 import *  # noqa: E402,F401,F403
+from .extended3 import *  # noqa: E402,F401,F403

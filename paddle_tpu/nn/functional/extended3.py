@@ -1,0 +1,285 @@
+"""Round-3b functional closure: gather_tree, margin_cross_entropy,
+class_center_sample, rnnt_loss, adaptive_log_softmax_with_loss
+(reference: python/paddle/nn/functional/ — upstream paths unverified,
+SURVEY.md §2.2 paddle.nn row).
+
+TPU-native notes: gather_tree and rnnt_loss are lax.scan dynamic
+programs (the CTC pattern); margin softmax is a masked logit transform
+XLA fuses into the softmax; class_center_sample does its union/remap
+with fixed-size sets (jnp.unique with a static size bound) so it stays
+compilable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.autograd import apply
+from ...core.random import next_key
+from ...ops._base import ensure_tensor
+from ...core.tensor import Tensor
+
+__all__ = ["gather_tree", "margin_cross_entropy", "class_center_sample",
+           "rnnt_loss", "adaptive_log_softmax_with_loss"]
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (reference: paddle.nn.functional
+    .gather_tree): ids/parents [T, B, K] step-wise predictions and their
+    parent-beam indices → the full sequences re-read along each final
+    beam's ancestor chain."""
+    ids = ensure_tensor(ids)
+    parents = ensure_tensor(parents)
+    if ids.shape != parents.shape or len(ids.shape) != 3:
+        raise ValueError("gather_tree expects ids/parents [T, B, K] of "
+                         "equal shape")
+
+    def f(i, p):
+        T = i.shape[0]
+
+        def step(beam, t):
+            # walking BACKWARD from the last step: read ids at the
+            # current beam, then hop to its parent
+            tok = jnp.take_along_axis(i[t], beam, axis=-1)
+            beam = jnp.take_along_axis(p[t], beam, axis=-1)
+            return beam, tok
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        _, toks = jax.lax.scan(step, init,
+                               jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply(f, ids, parents, name="gather_tree")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-family margin softmax (reference:
+    paddle.nn.functional.margin_cross_entropy): the TARGET class logit
+    cosθ becomes cos(m1·θ + m2) − m3, everything is scaled by `scale`,
+    then ordinary softmax cross-entropy. Logits must be cosines
+    (normalized features·centers)."""
+    if group is not None:
+        raise NotImplementedError(
+            "model-parallel margin_cross_entropy (sharded class centers) "
+            "is not implemented; honest failure beats a per-shard "
+            "softmax treated as global")
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+
+    def _out(lg, lb):
+        lg = lg.astype(jnp.float32)
+        c = lg.shape[-1]
+        onehot = jax.nn.one_hot(lb, c, dtype=jnp.float32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        # clip strictly inside (-1, 1) BEFORE arccos: at exactly ±1
+        # arccos' is infinite and the where() turns 0·inf into NaN for
+        # the whole gradient row (review repro)
+        theta = jnp.arccos(jnp.clip(cos, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(onehot > 0, target, cos) * scale
+        return out, onehot
+
+    def _loss_of(out, onehot):
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    def f_loss(lg, lb):
+        return _loss_of(*_out(lg, lb))
+
+    if not return_softmax:
+        # the [N, C] softmax is O(N·C) extra memory (face-recognition
+        # heads: millions of classes) — only materialize when asked
+        return apply(f_loss, logits, label, name="margin_cross_entropy")
+
+    def f_both(lg, lb):
+        out, onehot = _out(lg, lb)
+        return _loss_of(out, onehot), jax.nn.softmax(out, axis=-1)
+
+    return apply(f_both, logits, label, name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC negative-class sampling (reference:
+    paddle.nn.functional.class_center_sample): keep every POSITIVE class
+    in `label`, pad with sampled negatives up to `num_samples`, and
+    remap labels into the sampled-center index space.
+
+    Returns (remapped_label, sampled_class_center). Eager-path op (the
+    sampled set size is data-dependent; the returned center list has
+    EXACTLY num_samples entries, negatives padding the positives —
+    deterministic layout for the downstream sharded matmul)."""
+    if group is not None:
+        raise NotImplementedError(
+            "multi-rank class_center_sample (shared negative sampling "
+            "across a process group) is not implemented")
+    label = ensure_tensor(label)
+    lb = np.asarray(label._data).astype(np.int64).reshape(-1)
+    if np.any((lb < 0) | (lb >= num_classes)):
+        raise ValueError("labels out of [0, num_classes)")
+    pos = np.unique(lb)
+    if len(pos) > num_samples:
+        raise ValueError(f"num_samples {num_samples} < number of "
+                         f"distinct positive classes {len(pos)}")
+    k = next_key()
+    perm = np.asarray(jax.random.permutation(k, num_classes))
+    neg = perm[~np.isin(perm, pos)][:num_samples - len(pos)]
+    centers = np.concatenate([pos, neg]).astype(np.int64)
+    remap = -np.ones(num_classes, np.int64)
+    remap[centers] = np.arange(len(centers))
+    return (Tensor(jnp.asarray(remap[lb].reshape(label.shape))),
+            Tensor(jnp.asarray(centers)))
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-Transducer loss (reference: paddle.nn.functional.rnnt_loss):
+    -log P(labels | logits) summed over all monotonic alignments of the
+    [T, U+1] lattice. The forward DP is a lax.scan over T with a nested
+    scan over U (the label-advance recursion is sequential in u —
+    O(T·U) device steps; an associative logaddexp scan is the upgrade
+    path if this ever becomes hot).
+
+    logits: [B, T, U+1, V] (T acoustic steps, U label steps), labels
+    [B, U] int, per-sample lengths. blank emissions advance t; label
+    emissions advance u.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError("fastemit regularization is not "
+                                  "implemented")
+    logits = ensure_tensor(logits)
+    labels = ensure_tensor(labels)
+    tl = ensure_tensor(logit_lengths)
+    ul = ensure_tensor(label_lengths)
+
+    def f(lg, lb, tlen, ulen):
+        lg = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lg.shape
+        U = U1 - 1
+        neg_inf = -1e30
+        blank_lp = lg[..., blank]                      # [B, T, U+1]
+        lbl_lp = jnp.take_along_axis(
+            lg[:, :, :U, :], jnp.broadcast_to(
+                lb[:, None, :, None], (B, T, U, 1)).astype(jnp.int32),
+            axis=-1)[..., 0]                           # [B, T, U]
+        ar = jnp.arange(U1)
+
+        def step(alpha, t):
+            # alpha [B, U+1] at time t; first fold label emissions
+            # WITHIN time t is not allowed in RNNT — label moves use
+            # the SAME t: alpha'[u] = logsumexp(alpha_prev[u] + blank,
+            # alpha'[u-1] + label) — the label recursion is a scan in u
+            def ustep(prev_u, u):
+                from_blank = alpha[:, u] + \
+                    jnp.where(t > 0, blank_lp[:, t - 1, u], neg_inf)
+                first = jnp.where((t == 0) & (u == 0), 0.0, neg_inf)
+                lbl = jnp.where(
+                    u > 0,
+                    prev_u + lbl_lp[:, t, jnp.maximum(u - 1, 0)],
+                    neg_inf)
+                cur = jnp.logaddexp(jnp.logaddexp(from_blank, lbl),
+                                    first)
+                return cur, cur
+
+            _, cols = jax.lax.scan(ustep,
+                                   jnp.full((B,), neg_inf), ar)
+            return jnp.swapaxes(cols, 0, 1), None
+
+        # Graves 2012 recursion: alpha[t, u] = logsumexp(
+        #   alpha[t-1, u] + blank_lp[t-1, u],      (blank consumes frame)
+        #   alpha[t, u-1] + lbl_lp[t, u-1])        (label at the same t)
+        alpha0 = jnp.full((B, U1), neg_inf)
+
+        def tstep(a, t):
+            a, _ = step(a, t)
+            return a, a
+
+        _, aT = jax.lax.scan(tstep, alpha0, jnp.arange(T))
+        # total log-prob = alpha[tlen-1, ulen] + blank_lp[tlen-1, ulen]
+        bidx = jnp.arange(B)
+        at = aT[jnp.clip(tlen - 1, 0, T - 1).astype(jnp.int32), bidx]
+        fin = jnp.take_along_axis(
+            at, ulen.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        last_blank = blank_lp[bidx,
+                              jnp.clip(tlen - 1, 0, T - 1).astype(
+                                  jnp.int32),
+                              ulen.astype(jnp.int32)]
+        nll = -(fin + last_blank)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply(f, logits, labels, tl, ul, name="rnnt_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight,
+                                   tail_weights, cutoffs,
+                                   head_bias=None):
+    """Adaptive softmax (reference: paddle.nn.functional
+    .adaptive_log_softmax_with_loss, torch-compatible math): frequent
+    classes live in the head; rare classes live in down-projected tail
+    clusters reached through cluster logits appended to the head.
+
+    head_weight [H, n_head + n_clusters]; tail_weights: list of
+    (proj [H, H/r], out [H/r, cluster_size]); cutoffs ascending.
+    Returns (output nll-per-sample·(-1) i.e. log-prob, loss scalar).
+    """
+    x = ensure_tensor(input)
+    lb = ensure_tensor(label)
+    if not isinstance(lb._data, jax.core.Tracer):
+        la = np.asarray(lb._data)
+        if la.size and (la.min() < 0 or la.max() >= int(cutoffs[-1])):
+            raise ValueError(
+                f"labels must be in [0, {int(cutoffs[-1])}), got range "
+                f"[{la.min()}, {la.max()}] (torch raises here too)")
+    hw = ensure_tensor(head_weight)
+    tws = [(ensure_tensor(a), ensure_tensor(b)) for a, b in tail_weights]
+    hb = None if head_bias is None else ensure_tensor(head_bias)
+    n_clusters = len(tws)
+    shortlist = int(cutoffs[0])
+
+    args = [x, lb, hw] + [t for pair in tws for t in pair] + \
+        ([hb] if hb is not None else [])
+
+    def f(xa, lba, hwa, *rest):
+        tails = [(rest[2 * i], rest[2 * i + 1])
+                 for i in range(n_clusters)]
+        hba = rest[2 * n_clusters] if hb is not None else None
+        head = xa.astype(jnp.float32) @ hwa.astype(jnp.float32)
+        if hba is not None:
+            head = head + hba
+        head_lp = jax.nn.log_softmax(head, axis=-1)   # [N, sh + C]
+        n = xa.shape[0]
+        out = jnp.zeros((n,), jnp.float32)
+        in_short = lba < shortlist
+        short_lp = jnp.take_along_axis(
+            head_lp, jnp.clip(lba, 0, shortlist - 1).astype(
+                jnp.int32)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        lo = shortlist
+        for ci, (proj, w) in enumerate(tails):
+            hi = int(cutoffs[ci + 1])
+            inc = (lba >= lo) & (lba < hi)
+            cl_lp = head_lp[:, shortlist + ci]
+            tail_logit = (xa.astype(jnp.float32)
+                          @ proj.astype(jnp.float32)) \
+                @ w.astype(jnp.float32)
+            tail_lp = jax.nn.log_softmax(tail_logit, axis=-1)
+            rel = jnp.clip(lba - lo, 0, hi - lo - 1).astype(jnp.int32)
+            t_lp = jnp.take_along_axis(tail_lp, rel[:, None],
+                                       axis=1)[:, 0]
+            out = jnp.where(inc, cl_lp + t_lp, out)
+            lo = hi
+        return out, -jnp.mean(out)
+
+    out, loss = apply(f, *args, name="adaptive_log_softmax")
+    return out, loss

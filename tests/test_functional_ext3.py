@@ -1,0 +1,207 @@
+"""Round-3b functional closure — gather_tree / margin_cross_entropy /
+class_center_sample / rnnt_loss / adaptive_log_softmax_with_loss, each
+against a NumPy or torch oracle (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestGatherTree:
+    def test_hand_oracle(self):
+        ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], np.int64)
+        par = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(par)).numpy()
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 4])
+        np.testing.assert_array_equal(out[:, 0, 1], [5, 3, 7])
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError):
+            F.gather_tree(paddle.to_tensor(np.zeros((2, 2), np.int64)),
+                          paddle.to_tensor(np.zeros((2, 2), np.int64)))
+
+
+class TestMarginCrossEntropy:
+    def test_zero_margins_is_plain_ce(self):
+        rng = np.random.default_rng(0)
+        cos = np.clip(rng.standard_normal((4, 6)) * 0.3, -1,
+                      1).astype(np.float32)
+        lb = np.array([0, 2, 3, 5])
+        loss = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lb),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=10.0)
+        z = cos * 10.0
+        ref = -(z[np.arange(4), lb] - np.log(np.exp(z).sum(-1)))
+        np.testing.assert_allclose(float(np.asarray(loss._data)),
+                                   ref.mean(), rtol=1e-5)
+
+    def test_arcface_margin_numpy_oracle(self):
+        rng = np.random.default_rng(1)
+        cos = np.clip(rng.standard_normal((3, 5)) * 0.5, -0.99,
+                      0.99).astype(np.float32)
+        lb = np.array([1, 4, 2])
+        m1, m2, m3, s = 1.0, 0.5, 0.1, 32.0
+        loss, sm = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lb), margin1=m1,
+            margin2=m2, margin3=m3, scale=s, return_softmax=True,
+            reduction="none")
+        mod = cos.copy()
+        for i, l in enumerate(lb):
+            th = np.arccos(np.clip(cos[i, l], -1, 1))
+            mod[i, l] = np.cos(m1 * th + m2) - m3
+        z = mod * s
+        p = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(3), lb])
+        np.testing.assert_allclose(np.asarray(loss._data), ref,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(sm._data), p, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_grad_flows(self):
+        cos = paddle.to_tensor(
+            np.clip(np.random.default_rng(2).standard_normal(
+                (2, 4)) * 0.5, -0.9, 0.9).astype(np.float32),
+            stop_gradient=False)
+        loss = F.margin_cross_entropy(cos, paddle.to_tensor(
+            np.array([0, 3])))
+        loss.backward()
+        assert np.isfinite(cos.grad.numpy()).all()
+
+
+class TestClassCenterSample:
+    def test_positives_kept_and_remapped(self):
+        lab = paddle.to_tensor(np.array([3, 7, 3, 1]))
+        remap, centers = F.class_center_sample(lab, num_classes=20,
+                                               num_samples=8)
+        c, r = centers.numpy(), remap.numpy()
+        assert len(c) == 8 and len(set(c.tolist())) == 8
+        assert set(c[:3].tolist()) == {1, 3, 7}  # positives first
+        for i, l in enumerate([3, 7, 3, 1]):
+            assert c[r[i]] == l
+
+    def test_too_many_positives(self):
+        lab = paddle.to_tensor(np.arange(10))
+        with pytest.raises(ValueError):
+            F.class_center_sample(lab, num_classes=20, num_samples=4)
+
+
+class TestRnntLoss:
+    @staticmethod
+    def _np_rnnt(lg, lb, T, U, blank=0):
+        lp = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+        alpha = np.full((T, U + 1), -1e30)
+        alpha[0, 0] = 0.0
+        for t in range(T):
+            for u in range(U + 1):
+                if t == 0 and u == 0:
+                    continue
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + lp[t, u - 1, lb[u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(cands)
+        return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+    def test_matches_numpy_dp(self):
+        rng = np.random.default_rng(3)
+        B, T, U, V = 3, 5, 3, 6
+        lg = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+        lbs = rng.integers(1, V, (B, U)).astype(np.int32)
+        tl = np.array([5, 4, 3], np.int32)
+        ul = np.array([3, 2, 1], np.int32)
+        got = F.rnnt_loss(paddle.to_tensor(lg), paddle.to_tensor(lbs),
+                          paddle.to_tensor(tl), paddle.to_tensor(ul),
+                          reduction="none").numpy()
+        ref = [self._np_rnnt(lg[i], lbs[i], tl[i], ul[i])
+               for i in range(B)]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_reductions_and_grad(self):
+        rng = np.random.default_rng(4)
+        lg = paddle.to_tensor(rng.standard_normal(
+            (1, 4, 3, 5)).astype(np.float32), stop_gradient=False)
+        lbs = paddle.to_tensor(np.array([[1, 2]], np.int32))
+        tl = paddle.to_tensor(np.array([4], np.int32))
+        ul = paddle.to_tensor(np.array([2], np.int32))
+        loss = F.rnnt_loss(lg, lbs, tl, ul, reduction="mean")
+        loss.backward()
+        assert np.isfinite(lg.grad.numpy()).all()
+        assert np.abs(lg.grad.numpy()).sum() > 0
+
+    def test_fastemit_unsupported(self):
+        with pytest.raises(NotImplementedError):
+            F.rnnt_loss(paddle.to_tensor(np.zeros((1, 2, 2, 3),
+                                                  np.float32)),
+                        paddle.to_tensor(np.zeros((1, 1), np.int32)),
+                        paddle.to_tensor(np.array([2], np.int32)),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        fastemit_lambda=0.1)
+
+
+class TestAdaptiveLogSoftmax:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(5)
+        H, n_classes, cutoffs = 16, 20, [8, 14]
+        mod = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            H, n_classes, cutoffs=cutoffs, div_value=2.0)
+        x = rng.standard_normal((6, H)).astype(np.float32)
+        y = np.array([0, 5, 9, 13, 15, 19])
+        with torch.no_grad():
+            ref_out, ref_loss = mod(torch.from_numpy(x),
+                                    torch.from_numpy(y))
+        hw = mod.head.weight.detach().numpy().T.copy()
+        tails = [(paddle.to_tensor(seq[0].weight.detach().numpy()
+                                   .T.copy()),
+                  paddle.to_tensor(seq[1].weight.detach().numpy()
+                                   .T.copy()))
+                 for seq in mod.tail]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            paddle.to_tensor(hw), tails, cutoffs=[8, 14, 20])
+        np.testing.assert_allclose(out.numpy(), ref_out.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(loss._data)),
+                                   float(ref_loss), rtol=1e-5)
+
+
+class TestReviewRegressionsExt3:
+    def test_margin_ce_boundary_cos_finite_grad(self):
+        import paddle_tpu as paddle
+        cos = paddle.to_tensor(
+            np.array([[1.0, 0.2, 0.1, 0.3]], np.float32),
+            stop_gradient=False)
+        loss = F.margin_cross_entropy(cos, paddle.to_tensor(
+            np.array([2])))
+        loss.backward()
+        assert np.isfinite(cos.grad.numpy()).all()
+
+    def test_group_rejected(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        l = paddle.to_tensor(np.array([0, 1]))
+        with pytest.raises(NotImplementedError):
+            F.margin_cross_entropy(x, l, group="g")
+        with pytest.raises(NotImplementedError):
+            F.class_center_sample(l, 10, 4, group="g")
+
+    def test_adaptive_label_range_validated(self):
+        x = paddle.to_tensor(np.zeros((1, 4), np.float32))
+        hw = paddle.to_tensor(np.zeros((4, 3), np.float32))
+        tails = [(paddle.to_tensor(np.zeros((4, 2), np.float32)),
+                  paddle.to_tensor(np.zeros((2, 2), np.float32)))]
+        with pytest.raises(ValueError):
+            F.adaptive_log_softmax_with_loss(
+                x, paddle.to_tensor(np.array([7])), hw, tails,
+                cutoffs=[2, 4])
+
+    def test_rnnt_has_docstring(self):
+        assert F.rnnt_loss.__doc__ and "Transducer" in F.rnnt_loss.__doc__
+
+    def test_alpha_dropout_validates_in_eval(self):
+        with pytest.raises(ValueError):
+            F.alpha_dropout(paddle.to_tensor(np.ones(2, np.float32)),
+                            p=1.5, training=False)
