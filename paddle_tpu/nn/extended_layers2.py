@@ -9,10 +9,11 @@ import jax.numpy as jnp
 from ..core.autograd import apply
 from ..core.tensor import Parameter, Tensor
 from ..ops._base import ensure_tensor
-from .layer import Layer
+from .layer import Layer, ParameterList
 from . import functional as F
 
-__all__ = ["AdaptiveMaxPool3D", "ChannelShuffle",
+__all__ = ["AdaptiveLogSoftmaxWithLoss", "RNNTLoss",
+           "AdaptiveMaxPool3D", "ChannelShuffle",
            "Conv1DTranspose", "Conv3DTranspose", "CosineEmbeddingLoss",
            "LPPool1D", "LPPool2D", "MaxUnPool1D", "MaxUnPool3D",
            "Fold", "HuberLoss", "LayerDict", "MultiLabelSoftMarginLoss",
@@ -449,3 +450,95 @@ class AdaptiveMaxPool3D(Layer):
         from . import functional as F
         return F.adaptive_max_pool3d(x, self._os,
                                      return_mask=self._rm)
+
+
+class RNNTLoss(Layer):
+    """Reference parity: paddle.nn.RNNTLoss — layer form of
+    functional.rnnt_loss (lax.scan transducer DP)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from .functional.extended3 import rnnt_loss
+        return rnnt_loss(input, label, input_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Reference parity: paddle.nn.AdaptiveLogSoftmaxWithLoss — owns the
+    head/tail projection parameters (tail cluster i down-projects by
+    div_value**(i+1), torch-compatible math; oracle-tested against
+    torch in tests/test_functional_ext3.py)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if cutoffs != sorted(set(cutoffs)) or not cutoffs or \
+                cutoffs[-1] > n_classes or min(cutoffs) <= 0:
+            raise ValueError("cutoffs must be ascending, unique, "
+                             "positive and <= n_classes")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.cutoffs = cutoffs
+        self.n_classes = n_classes
+        n_clusters = len(cutoffs) - 1
+        shortlist = cutoffs[0]
+        self.head_weight = self.create_parameter(
+            (in_features, shortlist + n_clusters))
+        self.head_bias = self.create_parameter(
+            (shortlist + n_clusters,), is_bias=True) if head_bias \
+            else None
+        self.tail_projs = ParameterList()
+        self.tail_outs = ParameterList()
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            size = cutoffs[i + 1] - cutoffs[i]
+            self.tail_projs.append(self.create_parameter(
+                (in_features, hsz)))
+            self.tail_outs.append(self.create_parameter((hsz, size)))
+
+    def forward(self, input, label):
+        from .functional.extended3 import adaptive_log_softmax_with_loss
+        tails = list(zip(self.tail_projs, self.tail_outs))
+        return adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, tails, self.cutoffs,
+            head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities (reference API)."""
+        from ..core.autograd import apply as _apply
+        hw, hb = self.head_weight, self.head_bias
+        shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        args = [ensure_tensor(input), hw] + list(self.tail_projs) + \
+            list(self.tail_outs) + ([hb] if hb is not None else [])
+
+        def f(xa, hwa, *rest):
+            projs = rest[:n_clusters]
+            outs = rest[n_clusters:2 * n_clusters]
+            hba = rest[2 * n_clusters] if hb is not None else None
+            head = xa.astype(jnp.float32) @ hwa.astype(jnp.float32)
+            if hba is not None:
+                head = head + hba
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            parts = [head_lp[:, :shortlist]]
+            for i in range(n_clusters):
+                t = (xa.astype(jnp.float32) @ projs[i].astype(
+                    jnp.float32)) @ outs[i].astype(jnp.float32)
+                parts.append(head_lp[:, shortlist + i:shortlist + i + 1]
+                             + jax.nn.log_softmax(t, axis=-1))
+            return jnp.concatenate(parts, axis=1)
+
+        return _apply(f, *args, name="adaptive_log_prob")
+
+    def predict(self, input):
+        import paddle_tpu as P
+        return P.argmax(self.log_prob(input), axis=-1)

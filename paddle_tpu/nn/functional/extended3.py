@@ -120,6 +120,10 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         raise NotImplementedError(
             "multi-rank class_center_sample (shared negative sampling "
             "across a process group) is not implemented")
+    if num_samples > num_classes:
+        raise ValueError(
+            f"num_samples {num_samples} > num_classes {num_classes}: "
+            "the fixed num_samples-wide center layout cannot be filled")
     label = ensure_tensor(label)
     lb = np.asarray(label._data).astype(np.int64).reshape(-1)
     if np.any((lb < 0) | (lb >= num_classes)):
@@ -154,8 +158,19 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     if fastemit_lambda:
         raise NotImplementedError("fastemit regularization is not "
                                   "implemented")
-    logits = ensure_tensor(logits)
-    labels = ensure_tensor(labels)
+    _logits = ensure_tensor(logits)
+    _labels = ensure_tensor(labels)
+    V = int(_logits.shape[-1])
+    if not (0 <= blank < V):
+        raise ValueError(f"blank {blank} out of [0, {V})")
+    if not isinstance(_labels._data, jax.core.Tracer):
+        la = np.asarray(_labels._data)
+        if la.size and (la.min() < 0 or la.max() >= V):
+            raise ValueError(
+                f"labels must be in [0, {V}), got range "
+                f"[{la.min()}, {la.max()}] — out-of-range labels NaN "
+                "the gather silently")
+    logits, labels = _logits, _labels
     tl = ensure_tensor(logit_lengths)
     ul = ensure_tensor(label_lengths)
 

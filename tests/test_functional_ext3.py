@@ -205,3 +205,81 @@ class TestReviewRegressionsExt3:
         with pytest.raises(ValueError):
             F.alpha_dropout(paddle.to_tensor(np.ones(2, np.float32)),
                             p=1.5, training=False)
+
+
+class TestLayerWrappers:
+    def test_adaptive_layer_log_prob_consistent(self):
+        from paddle_tpu import nn
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [8, 14], div_value=2.0)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (5, 16)).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 9, 15, 0, 19]))
+        out, loss = m(x, y)
+        lp = m.log_prob(x)
+        assert list(lp.shape) == [5, 20]
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            np.take_along_axis(lp.numpy(),
+                               np.asarray(y._data)[:, None], 1)[:, 0],
+            out.numpy(), rtol=1e-4)
+        np.testing.assert_allclose(float(np.asarray(loss._data)),
+                                   -out.numpy().mean(), rtol=1e-5)
+        assert list(m.predict(x).shape) == [5]
+
+    def test_adaptive_layer_trains(self):
+        import paddle_tpu as P
+        from paddle_tpu import nn
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4], div_value=2.0)
+        opt = P.optimizer.Adam(0.05, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (16, 8)).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.default_rng(2).integers(0, 12, 16))
+        first = None
+        for _ in range(25):
+            _, loss = m(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(np.asarray(loss._data))
+        assert float(np.asarray(loss._data)) < first * 0.7
+
+    def test_adaptive_layer_validation(self):
+        from paddle_tpu import nn
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 4])
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(8, 12, [14])
+
+    def test_rnnt_layer_matches_functional(self):
+        from paddle_tpu import nn
+        rng = np.random.default_rng(3)
+        lg = paddle.to_tensor(rng.standard_normal(
+            (2, 4, 3, 5)).astype(np.float32))
+        lbs = paddle.to_tensor(rng.integers(1, 5, (2, 2)).astype(
+            np.int32))
+        tl = paddle.to_tensor(np.array([4, 3], np.int32))
+        ul = paddle.to_tensor(np.array([2, 1], np.int32))
+        layer_loss = nn.RNNTLoss(reduction="sum")(lg, lbs, tl, ul)
+        fn_loss = F.rnnt_loss(lg, lbs, tl, ul, reduction="sum")
+        np.testing.assert_allclose(float(np.asarray(layer_loss._data)),
+                                   float(np.asarray(fn_loss._data)))
+
+    def test_rnnt_label_range_validated(self):
+        lg = paddle.to_tensor(np.zeros((1, 2, 2, 4), np.float32))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(lg, paddle.to_tensor(np.array([[7]], np.int32)),
+                        paddle.to_tensor(np.array([2], np.int32)),
+                        paddle.to_tensor(np.array([1], np.int32)))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(lg, paddle.to_tensor(np.array([[1]], np.int32)),
+                        paddle.to_tensor(np.array([2], np.int32)),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        blank=9)
+
+    def test_class_center_sample_oversized_rejected(self):
+        with pytest.raises(ValueError):
+            F.class_center_sample(paddle.to_tensor(np.array([1])),
+                                  num_classes=5, num_samples=9)
