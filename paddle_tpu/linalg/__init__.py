@@ -1,3 +1,4 @@
 """paddle_tpu.linalg (paddle.linalg parity)."""
 from ..ops.linalg import *  # noqa: F401,F403
 from ..ops.math import matmul  # noqa: F401
+from ..ops.extras2 import cond, ormqr, vecdot  # noqa: E402,F401

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.autograd import apply
 from ..core.tensor import Tensor
@@ -373,3 +374,112 @@ def view_as(x, other, name=None):
 __all__ += ["add_n", "fill_diagonal", "fill_diagonal_", "i0e", "i1e",
             "is_integer", "multigammaln", "polygamma", "rank",
             "shard_index", "signbit", "sinc", "tolist", "view_as"]
+
+
+# -- round-3b sweep 2 -----------------------------------------------------
+
+def vecdot(x, y, axis=-1, name=None):
+    """paddle.linalg.vecdot: sum(conj(x) * y) along `axis`."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis),
+                 x, y, name="vecdot")
+
+
+def frexp(x, name=None):
+    """paddle.frexp: (mantissa, exponent) with x = m * 2**e."""
+    x = ensure_tensor(x)
+    return apply(lambda a: tuple(jnp.frexp(a)), x, name="frexp")
+
+
+from ._base import unary_op as _unary_op  # noqa: E402
+
+isneginf = _unary_op(jnp.isneginf, "isneginf")
+isposinf = _unary_op(jnp.isposinf, "isposinf")
+isreal = _unary_op(jnp.isreal, "isreal")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """paddle.combinations: r-length combinations of a 1-D tensor's
+    elements (static index set — compilable)."""
+    import itertools
+    x = ensure_tensor(x)
+    if len(x.shape) != 1:
+        raise ValueError("combinations expects a 1-D tensor")
+    n = x.shape[0]
+    gen = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = np.array(list(gen), np.int32).reshape(-1, r)
+    return apply(lambda a: a[jnp.asarray(idx)], x, name="combinations")
+
+
+def ldexp_(x, y, name=None):
+    # inplace_rebind keeps the autograd graph correct (the shadow
+    # carries the pre-mutation node; _inplace_update alone would leave
+    # a STALE node and silently wrong grads — review repro)
+    from .indexing import inplace_rebind
+    from .math import ldexp as _ldexp
+    return inplace_rebind(x, lambda s: _ldexp(s, ensure_tensor(y)))
+
+
+def lgamma_(x, name=None):
+    from .indexing import inplace_rebind
+    from .math import lgamma as _lgamma
+    return inplace_rebind(x, lambda s: _lgamma(s))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    from .indexing import inplace_rebind
+    from .extras import index_fill as _index_fill
+    return inplace_rebind(
+        x, lambda s: _index_fill(s, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    from .indexing import inplace_rebind
+    from .manipulation import index_put as _index_put
+    return inplace_rebind(
+        x, lambda s: _index_put(s, indices, value,
+                                accumulate=accumulate))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """paddle.linalg.ormqr: multiply `y` by the orthogonal Q encoded by
+    Householder reflectors (x, tau). Realized as
+    householder_product→matmul — the explicit-Q form XLA maps onto MXU
+    matmuls (an in-place reflector application would be a sequential
+    scalar loop, hostile to the TPU; documented trade)."""
+    from .linalg import householder_product
+    q = householder_product(ensure_tensor(x), ensure_tensor(tau),
+                            _full=True)
+    y = ensure_tensor(y)
+
+    def f(qa, ya):
+        # transpose means Q^H (conjugate transpose — matters for
+        # complex Householder factors, torch/paddle semantics)
+        qm = jnp.conj(jnp.swapaxes(qa, -1, -2)) if transpose else qa
+        return jnp.matmul(qm, ya) if left else jnp.matmul(ya, qm)
+
+    return apply(f, q, y, name="ormqr")
+
+
+def cond(x, p=None, name=None):
+    """paddle.linalg.cond: condition number under norm `p` (None/2,
+    -2, 'fro', 'nuc', 1, -1, inf, -inf)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        a = a.astype(jnp.float32)
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return (s[..., 0] / s[..., -1]) if (p is None or p == 2) \
+                else (s[..., -1] / s[..., 0])
+        na = jnp.linalg.norm(a, ord=p, axis=(-2, -1))
+        nb = jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1))
+        return na * nb
+
+    return apply(f, x, name="cond")
+
+
+__all__ += ["vecdot", "frexp", "isneginf", "isposinf", "isreal",
+            "combinations", "ldexp_", "lgamma_", "index_fill_",
+            "index_put_", "ormqr", "cond"]

@@ -55,11 +55,29 @@ def inplace_rebind(x: Tensor, fn, *others):
             "In-place operation on a leaf Tensor that requires grad is not "
             "allowed (wrap in no_grad() for optimizer-style updates).")
     shadow = Tensor(x._data, stop_gradient=x.stop_gradient, _node=x._node)
+    old_node = x._node
     out = fn(shadow, *others)
     x._data = out._data
     x._node = out._node
     if out._node is not None:
         x.stop_gradient = False
+        # Output-ref surgery (the lgamma_ digamma regression): the new
+        # node's out weakref points at the TEMPORARY `out` (about to be
+        # collected) → repoint at x so backward can deliver x's
+        # cotangent to this op's pullback; and the OLD node's out
+        # weakref still points at x, whose identity now means the
+        # POST-mutation value → repoint it at `shadow`, which carries
+        # the pre-mutation value and is kept alive by the new node's
+        # input refs. Without both, backward silently skips the
+        # in-place op and/or drops the upstream chain.
+        import weakref as _wr
+        for i, r in enumerate(out._node.out_refs):
+            if r() is out:
+                out._node.out_refs[i] = _wr.ref(x)
+        if old_node is not None:
+            for i, r in enumerate(old_node.out_refs):
+                if r() is x:
+                    old_node.out_refs[i] = _wr.ref(shadow)
     x._version += 1
     return x
 

@@ -232,7 +232,9 @@ def multi_dot(x, name=None):
                  name="multi_dot")
 
 
-def householder_product(x, tau, name=None):
+def householder_product(x, tau, name=None, _full=False):
+    # _full=True keeps the complete m×m Q (ormqr needs it; the public
+    # reference op returns the reduced [m, n] block)
     x, tau = ensure_tensor(x), ensure_tensor(tau)
 
     def f(a, t):
@@ -248,7 +250,7 @@ def householder_product(x, tau, name=None):
             H = (jnp.eye(m, dtype=a.dtype) -
                  tk[..., None, None] * v[..., :, None] * v[..., None, :])
             q = jnp.matmul(q, H)
-        return q[..., :, :n]
+        return q if _full else q[..., :, :n]
     return apply(f, x, tau, name="householder_product")
 
 

@@ -1,0 +1,120 @@
+"""Round-3b op sweep 2: linalg cond/ormqr/vecdot, frexp, combinations,
+is{neg,pos}inf/isreal, in-place variants — numpy/torch oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.linalg as L
+
+
+class TestLinalgSweep:
+    def test_cond_matches_numpy(self):
+        a = np.random.default_rng(0).standard_normal((5, 5)).astype(
+            np.float32)
+        for p in (None, 2, -2, "fro", 1, np.inf):
+            got = float(np.asarray(L.cond(paddle.to_tensor(a),
+                                          p=p)._data))
+            ref = float(np.linalg.cond(a, p=p))
+            assert abs(got - ref) / abs(ref) < 2e-3, (p, got, ref)
+
+    def test_ormqr_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        raw = np.linalg.qr(x, mode="raw")
+        h = raw[0].T.copy().astype(np.float32)
+        tau = raw[1].astype(np.float32)
+        y = rng.standard_normal((4, 2)).astype(np.float32)
+        for transpose in (False, True):
+            got = L.ormqr(paddle.to_tensor(h), paddle.to_tensor(tau),
+                          paddle.to_tensor(y),
+                          transpose=transpose).numpy()
+            ref = torch.ormqr(torch.from_numpy(h),
+                              torch.from_numpy(tau),
+                              torch.from_numpy(y),
+                              transpose=transpose).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_vecdot(self):
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        got = L.vecdot(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got, (a * b).sum(-1), rtol=1e-5)
+
+
+class TestMiscSweep2:
+    def test_frexp(self):
+        x = np.array([8.0, 0.5, -3.0], np.float32)
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mm, ee = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), mm)
+        np.testing.assert_array_equal(e.numpy(), ee)
+        # invariant: m * 2**e == x
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x)
+
+    def test_combinations(self):
+        torch = pytest.importorskip("torch")
+        x = np.array([1, 2, 3, 4])
+        got = paddle.combinations(paddle.to_tensor(x), 2).numpy()
+        ref = torch.combinations(torch.from_numpy(x), 2).numpy()
+        np.testing.assert_array_equal(got, ref)
+        got_wr = paddle.combinations(paddle.to_tensor(x), 2,
+                                     with_replacement=True).numpy()
+        ref_wr = torch.combinations(torch.from_numpy(x), 2,
+                                    with_replacement=True).numpy()
+        np.testing.assert_array_equal(got_wr, ref_wr)
+        with pytest.raises(ValueError):
+            paddle.combinations(paddle.to_tensor(np.zeros((2, 2))))
+
+    def test_inf_predicates(self):
+        x = np.array([-np.inf, np.inf, 1.0, np.nan], np.float32)
+        np.testing.assert_array_equal(
+            paddle.isneginf(paddle.to_tensor(x)).numpy(),
+            np.isneginf(x))
+        np.testing.assert_array_equal(
+            paddle.isposinf(paddle.to_tensor(x)).numpy(),
+            np.isposinf(x))
+        assert paddle.isreal(paddle.to_tensor(x)).numpy().all()
+
+    def test_inplace_variants(self):
+        import scipy.special as sp
+        t = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        v0 = t._version
+        t.lgamma_()
+        np.testing.assert_allclose(t.numpy(),
+                                   sp.gammaln([2.0, 3.0]).astype(
+                                       np.float32), rtol=1e-5)
+        assert t._version == v0 + 1
+        u = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        u.ldexp_(paddle.to_tensor(np.array([2, 3], np.int32)))
+        np.testing.assert_allclose(u.numpy(), [4.0, 8.0])
+        w = paddle.to_tensor(np.zeros((3,), np.float32))
+        w.index_fill_(paddle.to_tensor(np.array([0, 2])), 0, 5.0)
+        np.testing.assert_allclose(w.numpy(), [5.0, 0.0, 5.0])
+
+
+class TestReviewRegressionsSweep2:
+    def test_inplace_grad_correct(self):
+        # lgamma_ must contribute the digamma factor to backward
+        import scipy.special as sp
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2.0
+        y.lgamma_()
+        paddle.sum(y).backward()
+        ref = 2.0 * sp.digamma(6.0)  # d/dx lgamma(2x) = 2·ψ(2x)
+        np.testing.assert_allclose(x.grad.numpy(), [ref], rtol=1e-4)
+
+    def test_inplace_leaf_rejected(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            x.lgamma_()
+
+    def test_predicates_through_apply(self):
+        # unary_op routes through the chokepoint → works when traced
+        import jax
+        out = jax.jit(lambda a: paddle.isposinf(
+            paddle.Tensor(a))._data)(np.array([np.inf, 1.0], np.float32))
+        np.testing.assert_array_equal(out, [True, False])
