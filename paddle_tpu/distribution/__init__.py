@@ -356,6 +356,14 @@ def kl_divergence(p, q):
                              0.0)
             return tail + jnp.log(a) - jnp.log(b)
         return apply(_kl_geom, p.probs_t, q.probs_t, name="kl_geometric")
+    if isinstance(p, Independent) and isinstance(q, Independent):
+        if p.rank != q.rank:
+            raise ValueError("kl_divergence(Independent, Independent) "
+                             "requires equal reinterpreted ranks")
+        base = kl_divergence(p.base, q.base)
+        return apply(lambda x: jnp.sum(x, axis=tuple(
+            range(-p.rank, 0))) if p.rank else x, base,
+            name="kl_independent")
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
 

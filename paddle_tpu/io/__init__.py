@@ -624,3 +624,23 @@ class SubsetRandomSampler(Sampler):
 
     def __len__(self):
         return len(self.indices)
+
+
+def default_convert_fn(batch):
+    """Reference parity: paddle.io.dataloader.collate.default_convert_fn
+    — convert a SINGLE sample's leaves to Tensors without adding a batch
+    dim (the batch_size=None passthrough path)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor as _T
+    if isinstance(batch, _T):
+        return batch
+    if isinstance(batch, _np.ndarray):
+        return _T(_jnp.asarray(batch))
+    if isinstance(batch, (int, float)):
+        return _T(_jnp.asarray(batch))
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    return batch

@@ -132,3 +132,39 @@ class TestKLBoundaries:
     def test_geometric_boundary_inf(self):
         got = kl_divergence(D.Geometric(_t(0.5)), D.Geometric(_t(1.0)))
         assert np.isinf(float(np.asarray(got._data)))
+
+
+class TestKLIndependent:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        got = kl_divergence(
+            D.Independent(D.Normal(np.zeros(3, np.float32),
+                                   np.ones(3, np.float32)), 1),
+            D.Independent(D.Normal(np.ones(3, np.float32),
+                                   np.full(3, 2.0, np.float32)), 1))
+        ref = torch.distributions.kl_divergence(
+            torch.distributions.Independent(
+                torch.distributions.Normal(torch.zeros(3),
+                                           torch.ones(3)), 1),
+            torch.distributions.Independent(
+                torch.distributions.Normal(torch.ones(3),
+                                           torch.full((3,), 2.0)), 1))
+        np.testing.assert_allclose(float(np.asarray(got._data)),
+                                   float(ref), rtol=1e-5)
+
+    def test_rank_mismatch(self):
+        with pytest.raises(ValueError):
+            kl_divergence(D.Independent(D.Normal(0.0, 1.0), 0),
+                          D.Independent(D.Normal(0.0, 1.0), 1))
+
+
+class TestDefaultConvertFn:
+    def test_structure_preserved(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import default_convert_fn
+        out = default_convert_fn({"a": np.ones((2, 2)),
+                                  "b": [1, 2.5], "c": "keep"})
+        assert isinstance(out["a"], paddle.Tensor)
+        assert list(out["a"].shape) == [2, 2]  # NO batch dim added
+        assert float(out["b"][1].numpy()) == 2.5
+        assert out["c"] == "keep"
