@@ -124,3 +124,4 @@ def identity_loss(x, reduction="none"):
     if reduction in ("mean", 1):
         return x.mean()
     return x.sum()
+from . import optimizer  # noqa: F401

@@ -26,7 +26,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "WeightedRandomSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn",
+           "default_convert_fn"]
 
 
 class Dataset:
@@ -404,6 +405,7 @@ class DataLoader:
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
+        self._user_collate = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
@@ -447,8 +449,13 @@ class DataLoader:
                 yield self.collate_fn(batch)
             return
         if self.batch_sampler is None:
+            # batch_size=None (map-style): samples pass through UNBATCHED
+            # — default_convert_fn adds no leading dim (reference
+            # semantics); a user collate_fn receives the raw sample
             for i in range(len(self.dataset)):
-                yield self.collate_fn([self.dataset[i]])
+                sample = self.dataset[i]
+                yield self.collate_fn(sample) if self._user_collate \
+                    else default_convert_fn(sample)
             return
         for indices in self.batch_sampler:
             yield self._fetch(indices)
@@ -635,10 +642,12 @@ def default_convert_fn(batch):
     from ..core.tensor import Tensor as _T
     if isinstance(batch, _T):
         return batch
-    if isinstance(batch, _np.ndarray):
+    if isinstance(batch, (_np.ndarray, _np.generic)):
         return _T(_jnp.asarray(batch))
     if isinstance(batch, (int, float)):
         return _T(_jnp.asarray(batch))
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        return type(batch)(*(default_convert_fn(b) for b in batch))
     if isinstance(batch, (list, tuple)):
         return type(batch)(default_convert_fn(b) for b in batch)
     if isinstance(batch, dict):

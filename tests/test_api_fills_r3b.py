@@ -282,3 +282,107 @@ class TestNewLayers:
     def test_feature_alpha_dropout_p1_rejected(self):
         with pytest.raises(ValueError):
             nn.FeatureAlphaDropout(1.0)
+
+
+class TestIncubateOptimizers:
+    def _net_and_data(self):
+        net = nn.Linear(4, 1)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (8, 4)).astype(np.float32))
+        y = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (8, 1)).astype(np.float32))
+        return net, x, y
+
+    def test_lookahead_interpolates(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        net, x, y = self._net_and_data()
+        inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        la = LookAhead(inner, alpha=0.5, k=2)
+        w0 = net.weight.numpy().copy()
+        losses = []
+        for _ in range(4):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]          # still optimizes
+        assert not np.allclose(net.weight.numpy(), w0)
+        # after a sync step (k=2 divides 4), weights == slow weights
+        assert np.allclose(net.weight.numpy(),
+                           la._slow[id(net.weight)], atol=1e-6)
+
+    def test_lookahead_validation(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        net, _, _ = self._net_and_data()
+        inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        with pytest.raises(ValueError):
+            LookAhead(inner, alpha=2.0)
+        with pytest.raises(ValueError):
+            LookAhead(inner, k=0)
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        net, x, y = self._net_and_data()
+        opt = paddle.optimizer.SGD(0.5, parameters=net.parameters())
+        ma = ModelAverage(1.0, parameters=net.parameters(),
+                          min_average_window=2, max_average_window=100)
+        seen = []
+        for _ in range(3):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+            seen.append(net.weight.numpy().copy())
+        live = net.weight.numpy().copy()
+        with ma.apply():
+            avg = net.weight.numpy().copy()
+        # averaged weights differ from live and restore afterwards
+        assert not np.allclose(avg, live)
+        np.testing.assert_allclose(net.weight.numpy(), live)
+        # the window restarted at count>window: sum tracks recent steps
+        assert np.isfinite(avg).all()
+
+    def test_lookahead_syncs_master_weights(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        net = nn.Linear(4, 1)
+        net.to(dtype="bfloat16")
+        inner = paddle.optimizer.SGD(0.1, parameters=net.parameters(),
+                                     multi_precision=True)
+        la = LookAhead(inner, alpha=0.5, k=1)  # sync every step
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = paddle.sum(net(x))
+        loss.backward()
+        la.step()
+        st = inner._accum.get(id(net.weight))
+        if st is not None and "master" in st:
+            np.testing.assert_allclose(
+                np.asarray(st["master"], np.float32),
+                la._slow[id(net.weight)], rtol=1e-3)
+
+    def test_dataloader_batch_size_none_unbatched(self):
+        import paddle_tpu.io as io
+
+        class DS:
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32), np.int64(i)
+
+        loader = io.DataLoader(DS(), batch_size=None)
+        items = list(loader)
+        assert len(items) == 3
+        x, y = items[1]
+        assert list(x.shape) == [4]  # NO leading batch dim
+        assert int(y.numpy()) == 1
+
+    def test_convert_fn_namedtuple(self):
+        import collections
+        from paddle_tpu.io import default_convert_fn
+        Point = collections.namedtuple("Point", "x y")
+        out = default_convert_fn(Point(np.ones(2), 3))
+        assert isinstance(out, Point)
+        assert list(out.x.shape) == [2]
