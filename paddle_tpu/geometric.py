@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from .core.autograd import apply
 from .ops._base import ensure_tensor
+from .core.tensor import Tensor
 from .incubate import (graph_send_recv, segment_max, segment_mean,  # noqa: F401
                        segment_min, segment_sum)
 
@@ -62,3 +63,79 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
                "mul": jnp.multiply, "div": jnp.divide}[message_op]
     return apply(lambda a, b: combine(a[src], b[dst]), x, y,
                  name="send_uv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Reference parity: paddle.geometric.sample_neighbors — uniform
+    neighbor sampling over a CSC graph (row = concatenated in-neighbor
+    ids, colptr = per-node offsets).
+
+    HOST-SIDE op by design: sampling is data-dependent/variable-size —
+    the standard GNN pipeline splits here (sample on host, compute on
+    device), exactly like the reference's CPU sampling kernels feeding
+    the GPU. Returns (out_neighbors, out_count[, out_eids]) int64
+    Tensors."""
+    import numpy as _np
+    r = _np.asarray(ensure_tensor(row)._data).astype(_np.int64)
+    cp = _np.asarray(ensure_tensor(colptr)._data).astype(_np.int64)
+    nodes = _np.asarray(ensure_tensor(input_nodes)._data).astype(
+        _np.int64).reshape(-1)
+    ev = _np.asarray(ensure_tensor(eids)._data).astype(_np.int64) \
+        if eids is not None else None
+    if return_eids and ev is None:
+        raise ValueError("return_eids=True requires eids")
+    rng = _np.random.default_rng(
+        int(_np.asarray(ensure_tensor(perm_buffer)._data)[0])
+        if perm_buffer is not None else None)
+    outs, counts, oeids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            sel = _np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(r[sel])
+        counts.append(len(sel))
+        if ev is not None:
+            oeids.append(ev[sel])
+    neigh = _np.concatenate(outs) if outs else _np.zeros(0, _np.int64)
+    res = (Tensor(jnp.asarray(neigh)),
+           Tensor(jnp.asarray(_np.asarray(counts, _np.int64))))
+    if return_eids:
+        oe = _np.concatenate(oeids) if oeids else _np.zeros(0, _np.int64)
+        return res + (Tensor(jnp.asarray(oe)),)
+    return res
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Reference parity: paddle.geometric.reindex_graph — compact the
+    (x ∪ neighbors) node ids into [0, n_unique): x keeps its order and
+    gets ids 0..len(x)-1; new neighbor ids follow in first-seen order.
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    import numpy as _np
+    xs = _np.asarray(ensure_tensor(x)._data).astype(_np.int64).reshape(-1)
+    nb = _np.asarray(ensure_tensor(neighbors)._data).astype(
+        _np.int64).reshape(-1)
+    ct = _np.asarray(ensure_tensor(count)._data).astype(
+        _np.int64).reshape(-1)
+    if ct.sum() != len(nb):
+        raise ValueError("count must sum to len(neighbors)")
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb:
+        mapping.setdefault(int(v), len(mapping))
+    out_nodes = _np.empty(len(mapping), _np.int64)
+    for v, i in mapping.items():
+        out_nodes[i] = v
+    reindex_src = _np.asarray([mapping[int(v)] for v in nb], _np.int64)
+    reindex_dst = _np.repeat(_np.arange(len(xs), dtype=_np.int64), ct)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+__all__ += ["sample_neighbors", "reindex_graph"]

@@ -125,3 +125,81 @@ def identity_loss(x, reduction="none"):
         return x.mean()
     return x.sum()
 from . import optimizer  # noqa: F401
+def graph_sample_neighbors(*args, **kwargs):
+    """Alias of paddle.geometric.sample_neighbors (lazy import: geometric
+    imports from incubate at module top — a top-level import here would
+    make package-import order load-bearing)."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(*args, **kwargs)
+
+
+def graph_reindex(*args, **kwargs):
+    """Alias of paddle.geometric.reindex_graph (lazy import, see
+    graph_sample_neighbors)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(*args, **kwargs)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    if return_eids or sorted_eids is not None:
+        raise NotImplementedError(
+            "graph_khop_sampler eids tracking is not implemented "
+            "(sample_neighbors supports eids for single hops)")
+    """Reference parity: paddle.incubate.graph_khop_sampler — multi-hop
+    neighbor sampling + compaction (host-side, like the reference's CPU
+    sampling kernels). Returns (edge_src, edge_dst, sample_index,
+    reindex_x)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor as _T
+    from ..geometric import reindex_graph, sample_neighbors
+    cur = input_nodes
+    all_src, all_dst = [], []
+    frontier = cur
+    for k in sample_sizes:
+        neigh, cnt = sample_neighbors(row, colptr, frontier,
+                                      sample_size=int(k))
+        src, dst, nodes = reindex_graph(frontier, neigh, cnt)
+        # lift the per-hop local ids back to GLOBAL ids for accumulation
+        nodes_np = _np.asarray(nodes._data)
+        all_src.append(nodes_np[_np.asarray(src._data)])
+        all_dst.append(_np.asarray(frontier._data).reshape(-1)[
+            _np.asarray(dst._data)])
+        frontier = _T(_jnp.asarray(nodes_np))
+    es = _np.concatenate(all_src) if all_src else _np.zeros(0, _np.int64)
+    ed = _np.concatenate(all_dst) if all_dst else _np.zeros(0, _np.int64)
+    # final compaction over the union
+    uniq = {}
+    for v in _np.asarray(input_nodes._data).reshape(-1):
+        uniq.setdefault(int(v), len(uniq))
+    for v in _np.concatenate([es, ed]) if len(es) else []:
+        uniq.setdefault(int(v), len(uniq))
+    sample_index = _np.empty(len(uniq), _np.int64)
+    for v, i in uniq.items():
+        sample_index[i] = v
+    r_src = _np.asarray([uniq[int(v)] for v in es], _np.int64)
+    r_dst = _np.asarray([uniq[int(v)] for v in ed], _np.int64)
+    reindex_x = _np.asarray(
+        [uniq[int(v)] for v in _np.asarray(input_nodes._data).reshape(-1)],
+        _np.int64)
+    return (_T(_jnp.asarray(r_src)), _T(_jnp.asarray(r_dst)),
+            _T(_jnp.asarray(sample_index)), _T(_jnp.asarray(reindex_x)))
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference parity: paddle.incubate.softmax_mask_fuse_upper_triangle
+    — causal (upper-triangle masked) softmax over the last two dims;
+    XLA fuses the mask+softmax chain on TPU."""
+    import jax
+    import jax.numpy as _jnp
+    from ..core.autograd import apply as _apply
+    from ..ops._base import ensure_tensor as _ens
+
+    def f(a):
+        s, t = a.shape[-2], a.shape[-1]
+        keep = _jnp.arange(t)[None, :] <= _jnp.arange(s)[:, None]
+        lg = _jnp.where(keep, a.astype(_jnp.float32), -_jnp.inf)
+        return jax.nn.softmax(lg, axis=-1).astype(a.dtype)
+
+    return _apply(f, _ens(x), name="softmax_mask_fuse_upper_triangle")

@@ -439,6 +439,12 @@ class DataLoader:
 
     def _iter_sync(self):
         if self._iterable:
+            if self.batch_size is None:
+                # unbatched passthrough (same semantics as map-style)
+                for item in self.dataset:
+                    yield self.collate_fn(item) if self._user_collate \
+                        else default_convert_fn(item)
+                return
             batch = []
             for item in self.dataset:
                 batch.append(item)
@@ -606,6 +612,10 @@ class DataLoader:
             result_q.close()
 
     def __iter__(self):
+        if not self._iterable and self.batch_sampler is None:
+            # batch_size=None: unbatched passthrough is host-trivial —
+            # worker pools iterate self.batch_sampler and would crash
+            return self._iter_sync()
         if self.num_workers and self.num_workers > 0:
             import multiprocessing as mp
             if not self._iterable and self.batch_sampler is not None \

@@ -357,10 +357,11 @@ class TestIncubateOptimizers:
         loss.backward()
         la.step()
         st = inner._accum.get(id(net.weight))
-        if st is not None and "master" in st:
-            np.testing.assert_allclose(
-                np.asarray(st["master"], np.float32),
-                la._slow[id(net.weight)], rtol=1e-3)
+        assert st is not None and "master" in st, \
+            "multi_precision SGD must keep a master copy"
+        np.testing.assert_allclose(
+            np.asarray(st["master"], np.float32),
+            la._slow[id(net.weight)], rtol=1e-3)
 
     def test_dataloader_batch_size_none_unbatched(self):
         import paddle_tpu.io as io

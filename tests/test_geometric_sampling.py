@@ -1,0 +1,139 @@
+"""GNN sampling ops (paddle.geometric sample_neighbors/reindex_graph,
+incubate.graph_khop_sampler, softmax_mask_fuse_upper_triangle) — hand
+oracles on small graphs (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+
+
+def _graph():
+    # CSC: node0 <- {1,2}, node1 <- {0}, node2 <- {}
+    row = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    return row, colptr
+
+
+class TestSampleNeighbors:
+    def test_full_neighborhood(self):
+        row, colptr = _graph()
+        nb, cnt = G.sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0, 1, 2], np.int64)))
+        assert cnt.numpy().tolist() == [2, 1, 0]
+        assert sorted(nb.numpy()[:2].tolist()) == [1, 2]
+        assert nb.numpy()[2] == 0
+
+    def test_subsampling_bounds(self):
+        row, colptr = _graph()
+        nb, cnt = G.sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0], np.int64)),
+            sample_size=1)
+        assert cnt.numpy().tolist() == [1]
+        assert nb.numpy()[0] in (1, 2)
+
+    def test_eids(self):
+        row, colptr = _graph()
+        eids = paddle.to_tensor(np.array([10, 20, 30], np.int64))
+        nb, cnt, oe = G.sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([0], np.int64)),
+            eids=eids, return_eids=True)
+        assert sorted(oe.numpy().tolist()) == [10, 20]
+        with pytest.raises(ValueError):
+            G.sample_neighbors(row, colptr,
+                               paddle.to_tensor(np.array([0], np.int64)),
+                               return_eids=True)
+
+
+class TestReindexGraph:
+    def test_compaction(self):
+        x = paddle.to_tensor(np.array([5, 9], np.int64))
+        neighbors = paddle.to_tensor(np.array([9, 7, 5], np.int64))
+        count = paddle.to_tensor(np.array([2, 1], np.int64))
+        src, dst, nodes = G.reindex_graph(x, neighbors, count)
+        assert nodes.numpy().tolist() == [5, 9, 7]  # x first, then new
+        assert src.numpy().tolist() == [1, 2, 0]
+        assert dst.numpy().tolist() == [0, 0, 1]
+
+    def test_count_mismatch(self):
+        with pytest.raises(ValueError):
+            G.reindex_graph(paddle.to_tensor(np.array([0], np.int64)),
+                            paddle.to_tensor(np.array([1, 2], np.int64)),
+                            paddle.to_tensor(np.array([1], np.int64)))
+
+
+class TestKhopSampler:
+    def test_two_hops(self):
+        row, colptr = _graph()
+        es, ed, si, rx = paddle.incubate.graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([0], np.int64)),
+            [2, 2])
+        # global edges recovered via sample_index must be the real ones
+        glob = [(int(si.numpy()[s]), int(si.numpy()[d]))
+                for s, d in zip(es.numpy(), ed.numpy())]
+        assert set(glob) <= {(1, 0), (2, 0), (0, 1)}
+        assert (1, 0) in glob and (2, 0) in glob and (0, 1) in glob
+        assert rx.numpy().tolist() == [0]
+        assert set(si.numpy().tolist()) == {0, 1, 2}
+
+
+class TestTriangularSoftmax:
+    def test_causal_rows(self):
+        x = paddle.to_tensor(np.zeros((1, 2, 3, 3), np.float32))
+        out = paddle.incubate.softmax_mask_fuse_upper_triangle(x).numpy()
+        np.testing.assert_allclose(out[0, 0, 0], [1, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(out[0, 1, 1], [0.5, 0.5, 0], atol=1e-6)
+        np.testing.assert_allclose(out[0, 0, 2], [1 / 3] * 3, rtol=1e-5)
+
+    def test_grad(self):
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (1, 1, 4, 4)).astype(np.float32), stop_gradient=False)
+        out = paddle.incubate.softmax_mask_fuse_upper_triangle(x)
+        paddle.sum(out * out).backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all()
+        # masked (future) positions receive no gradient
+        assert abs(g[0, 0, 0, 1]) < 1e-7
+
+
+class TestReviewRegressionsSampling:
+    def test_iterable_batch_size_none_unbatched(self):
+        import paddle_tpu.io as io
+
+        class It(io.IterableDataset):
+            def __iter__(self):
+                for i in range(3):
+                    yield np.full((4,), i, np.float32)
+
+        items = list(io.DataLoader(It(), batch_size=None))
+        assert len(items) == 3
+        assert list(items[0].shape) == [4]
+
+    def test_mapstyle_none_with_workers(self):
+        import paddle_tpu.io as io
+
+        class DS:
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        items = list(io.DataLoader(DS(), batch_size=None, num_workers=2))
+        assert len(items) == 3 and list(items[2].shape) == [2]
+
+    def test_khop_eids_rejected(self):
+        row = paddle.to_tensor(np.array([1], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 1], np.int64))
+        with pytest.raises(NotImplementedError):
+            paddle.incubate.graph_khop_sampler(
+                row, colptr, paddle.to_tensor(np.array([0], np.int64)),
+                [1], return_eids=True)
+
+    def test_incubate_aliases_resolve(self):
+        import paddle_tpu.geometric as G2
+        nb, cnt = paddle.incubate.graph_sample_neighbors(
+            paddle.to_tensor(np.array([1], np.int64)),
+            paddle.to_tensor(np.array([0, 1, 1], np.int64)),
+            paddle.to_tensor(np.array([0], np.int64)))
+        assert cnt.numpy().tolist() == [1]
