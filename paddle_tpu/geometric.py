@@ -85,9 +85,10 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
         if eids is not None else None
     if return_eids and ev is None:
         raise ValueError("return_eids=True requires eids")
-    rng = _np.random.default_rng(
-        int(_np.asarray(ensure_tensor(perm_buffer)._data)[0])
-        if perm_buffer is not None else None)
+    # perm_buffer is the reference's scratch permutation buffer (a
+    # Fisher-Yates fast-path detail), NOT a seed — sampling stays
+    # random either way here
+    rng = _np.random.default_rng()
     outs, counts, oeids = [], [], []
     for n in nodes:
         lo, hi = int(cp[n]), int(cp[n + 1])

@@ -142,21 +142,20 @@ def graph_reindex(*args, **kwargs):
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                        sorted_eids=None, return_eids=False, name=None):
-    if return_eids or sorted_eids is not None:
-        raise NotImplementedError(
-            "graph_khop_sampler eids tracking is not implemented "
-            "(sample_neighbors supports eids for single hops)")
     """Reference parity: paddle.incubate.graph_khop_sampler — multi-hop
     neighbor sampling + compaction (host-side, like the reference's CPU
     sampling kernels). Returns (edge_src, edge_dst, sample_index,
     reindex_x)."""
+    if return_eids or sorted_eids is not None:
+        raise NotImplementedError(
+            "graph_khop_sampler eids tracking is not implemented "
+            "(sample_neighbors supports eids for single hops)")
     import numpy as _np
     import jax.numpy as _jnp
     from ..core.tensor import Tensor as _T
     from ..geometric import reindex_graph, sample_neighbors
-    cur = input_nodes
     all_src, all_dst = [], []
-    frontier = cur
+    frontier = input_nodes
     for k in sample_sizes:
         neigh, cnt = sample_neighbors(row, colptr, frontier,
                                       sample_size=int(k))

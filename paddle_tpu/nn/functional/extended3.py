@@ -298,3 +298,64 @@ def adaptive_log_softmax_with_loss(input, label, head_weight,
 
     out, loss = apply(f, *args, name="adaptive_log_softmax")
     return out, loss
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Reference parity: paddle.nn.functional.sparse_attention — attend
+    only at positions named by a per-(batch, head) CSR pattern
+    (offset [B, H, S+1], columns [B, H, nnz]).
+
+    TPU-native realization: the CSR pattern becomes a keep-mask on the
+    Pallas flash path (O(block) memory; dead blocks skipped). The
+    reference's CUDA kernel gathers only nnz entries — truly sparse
+    compute is a dynamic-shape program XLA can't tile onto the MXU, so
+    the masked-flash form is the TPU-correct translation (same outputs;
+    design note in PARITY.md sparse row)."""
+    from ...ops.pallas.flash_attention import flash_attention_bshd
+    from ...ops.manipulation import transpose as _tp
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    off = np.asarray(ensure_tensor(sparse_csr_offset)._data)
+    cols = np.asarray(ensure_tensor(sparse_csr_columns)._data)
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    if off.shape[:2] != (b, h) or off.shape[2] != s + 1:
+        raise ValueError(f"sparse_csr_offset must be [B, H, S+1], got "
+                         f"{off.shape}")
+    # vectorized CSR→mask expansion (a python B·H·S loop would cost
+    # ~500k iterations at serving shapes): row ids repeat by per-row
+    # nnz, then one fancy-index assignment
+    keep = np.zeros((b, h, s, sk), bool)
+    counts = np.diff(off, axis=-1)                      # [B, H, S]
+    bi, hi, ri = np.nonzero(counts)
+    if len(bi):
+        reps = counts[bi, hi, ri]
+        bb = np.repeat(bi, reps)
+        hh = np.repeat(hi, reps)
+        rr = np.repeat(ri, reps)
+        starts = off[bi, hi, ri]
+        flat = np.concatenate(
+            [cols[b_, h_, s_:s_ + c_] for b_, h_, s_, c_ in
+             zip(bi, hi, starts, reps)]).astype(np.int64)
+        keep[bb, hh, rr, flat] = True
+    if key_padding_mask is not None:
+        kp = np.asarray(ensure_tensor(key_padding_mask)._data)
+        # reference layout [B, Sk]; True/nonzero = KEEP
+        keep &= kp.astype(bool)[:, None, None, :]
+    mask = Tensor(jnp.asarray(keep))
+    if attn_mask is not None:
+        madd = jnp.where(jnp.asarray(keep), 0.0, -jnp.inf) \
+            + ensure_tensor(attn_mask)._data.astype(jnp.float32)
+        mask = Tensor(madd)
+    out = flash_attention_bshd(_tp(q, [0, 2, 1, 3]),
+                               _tp(k, [0, 2, 1, 3]),
+                               _tp(v, [0, 2, 1, 3]),
+                               mask=mask,
+                               scale=1.0 / (d ** 0.5))
+    return _tp(out, [0, 2, 1, 3])
+
+
+__all__ += ["sparse_attention"]

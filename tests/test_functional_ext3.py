@@ -283,3 +283,76 @@ class TestLayerWrappers:
         with pytest.raises(ValueError):
             F.class_center_sample(paddle.to_tensor(np.array([1])),
                                   num_classes=5, num_samples=9)
+
+
+class TestSparseAttention:
+    def _csr_causal(self, B, H, S):
+        off = np.zeros((B, H, S + 1), np.int32)
+        cols_list = []
+        for hi in range(H):
+            cs = []
+            for r in range(S):
+                cs.extend(range(r + 1))
+                off[:, hi, r + 1] = len(cs)
+            cols_list.append(cs)
+        return off, np.asarray(cols_list, np.int32)[None].repeat(B, 0)
+
+    def test_causal_csr_matches_dense(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        B, H, S, D = 2, 2, 4, 8
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        off, cols = self._csr_causal(B, H, S)
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(off), paddle.to_tensor(cols)).numpy()
+        for bi in range(B):
+            for hi in range(H):
+                sc = (q[bi, hi] @ k[bi, hi].T) / np.sqrt(D)
+                m = np.triu(np.full((S, S), -np.inf), 1)
+                p = torch.softmax(torch.from_numpy(sc + m), -1).numpy()
+                np.testing.assert_allclose(out[bi, hi], p @ v[bi, hi],
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_shape_validation(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 3, 4), np.float32))
+        with pytest.raises(ValueError):
+            F.sparse_attention(x, x, x,
+                               paddle.to_tensor(np.zeros((1, 1, 2),
+                                                         np.int32)),
+                               paddle.to_tensor(np.zeros((1, 1, 1),
+                                                         np.int32)))
+
+    def test_grad_flows(self):
+        q = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (1, 1, 3, 8)).astype(np.float32), stop_gradient=False)
+        off, cols = self._csr_causal(1, 1, 3)
+        out = F.sparse_attention(q, q, q, paddle.to_tensor(off),
+                                 paddle.to_tensor(cols))
+        paddle.sum(out).backward()
+        assert np.isfinite(q.grad.numpy()).all()
+
+    def test_key_padding_mask_honored(self):
+        rng = np.random.default_rng(2)
+        B, H, S, D = 1, 1, 3, 8
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        off = np.tile(np.arange(S + 1, dtype=np.int32) * S,
+                      (B, H, 1))  # full attention CSR
+        cols = np.tile(np.arange(S, dtype=np.int32), (B, H, S))
+        kp = np.array([[1, 1, 0]], np.int32)  # key 2 padded out
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(off), paddle.to_tensor(cols),
+            key_padding_mask=paddle.to_tensor(kp)).numpy()
+        # oracle without key 2
+        sc = (q[0, 0] @ q[0, 0, :2].T) / np.sqrt(D)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out[0, 0], p @ q[0, 0, :2],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_khop_docstring(self):
+        assert paddle.incubate.graph_khop_sampler.__doc__ and \
+            "Reference parity" in paddle.incubate.graph_khop_sampler.__doc__
