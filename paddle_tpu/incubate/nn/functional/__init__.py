@@ -295,3 +295,119 @@ def variable_length_memory_efficient_attention(
     return apply(lambda o, m: jnp.where(m, o, 0.0).astype(o.dtype),
                  out, Tensor(rowzero[:, None, :, None]),
                  name="varlen_mea_pad")
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+        linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+        ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+        pre_layer_norm=True, epsilon=1e-5, cache_kvs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Reference parity: paddle.incubate.nn.functional
+    .fused_multi_transformer — the whole decoder stack in one call
+    (per-layer: LN → fused QKV → attention(+static KV cache) → proj →
+    residual → LN → FFN → residual). Upstream this is one CUDA
+    mega-kernel; on TPU the per-layer chain is already what XLA fuses,
+    and the KV cache rides `models/generation.py::cached_attention`
+    (absolute-position masking, lax.dynamic_update_slice writes — the
+    free-rollback static-cache design).
+
+    Layouts: qkv_weights[i] is [3, H, D, E] (trans_qkvw=True, the
+    serving layout); cache_kvs[i] is a (k, v) pair of [B, T, H, D]
+    static buffers; `time_step` is the cache write offset (traced ok).
+    Returns `out`, or (out, new_cache_kvs) when caches are given.
+    ring_id (in-op tensor-parallel allreduce) is not supported — use
+    the fleet TP layers for distributed serving."""
+    import math as _math
+    from ....models.generation import cached_attention
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "ring_id tensor parallelism is the fleet TP layers' job")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "trans_qkvw=False layout is not supported")
+    act = {"gelu": F.gelu, "relu": F.relu}[activation]
+    if cache_kvs is not None and attn_mask is not None:
+        raise NotImplementedError(
+            "attn_mask with cache_kvs (padded batched decode) is not "
+            "supported: the cached path applies only the absolute-"
+            "position causal mask — honest failure beats silently "
+            "attending padded keys")
+    x = ensure_tensor(x)
+    n_layers = len(qkv_weights)
+    caches_out = [] if cache_kvs is not None else None
+    offset = 0 if time_step is None else (
+        time_step._data if hasattr(time_step, "_data") else time_step)
+
+    def _ln(h, scale, bias):
+        return F.layer_norm(h, h.shape[-1], ensure_tensor(scale),
+                            ensure_tensor(bias), epsilon)
+
+    for i in range(n_layers):
+        residual = x
+        h = _ln(x, ln_scales[i], ln_biases[i]) if pre_layer_norm else x
+        qkvw = ensure_tensor(qkv_weights[i])
+        b, s, e = h.shape
+        three, nh, hd, _e = qkvw.shape
+        qb = None if qkv_biases is None else ensure_tensor(qkv_biases[i])
+
+        def _qkv(ha, wa, *rest):
+            out = jnp.einsum("bse,khde->bskhd", ha.astype(jnp.float32),
+                             wa.astype(jnp.float32))
+            if rest:
+                out = out + rest[0].reshape(3, nh, hd)
+            return out.astype(ha.dtype)
+
+        qkv = apply(_qkv, h, qkvw, *([qb] if qb is not None else []),
+                    name="fused_qkv")
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / _math.sqrt(hd)
+        if cache_kvs is not None:
+            kb = cache_kvs[i][0]
+            vb = cache_kvs[i][1]
+            kb = kb._data if hasattr(kb, "_data") else kb
+            vb = vb._data if hasattr(vb, "_data") else vb
+            attn, kb2, vb2 = apply(
+                lambda qa, ka, va: cached_attention(
+                    qa, ka, va, kb, vb, offset, scale),
+                q, k, v, name="fmt_cached_attn")
+            caches_out.append((kb2, vb2))
+        else:
+            mask_kw = {}
+            if attn_mask is not None:
+                mask_kw["mask"] = ensure_tensor(attn_mask)
+            attn = flash_attention_bshd(q, k, v,
+                                        causal=attn_mask is None,
+                                        scale=scale, **mask_kw)
+        attn = attn.reshape([b, s, nh * hd])
+        proj = fused_linear(attn, ensure_tensor(linear_weights[i]),
+                            None if linear_biases is None
+                            else ensure_tensor(linear_biases[i]))
+        if dropout_rate:
+            # F.dropout owns BOTH modes (incl. downscale_in_infer's
+            # (1-p) inference scaling) — don't gate it on training
+            proj = F.dropout(proj, p=dropout_rate, training=training,
+                             mode=mode)
+        x = residual + proj
+        if not pre_layer_norm:
+            x = _ln(x, ln_scales[i], ln_biases[i])
+        residual = x
+        h = _ln(x, ffn_ln_scales[i], ffn_ln_biases[i]) \
+            if pre_layer_norm else x
+        h = act(fused_linear(h, ensure_tensor(ffn1_weights[i]),
+                             None if ffn1_biases is None
+                             else ensure_tensor(ffn1_biases[i])))
+        if dropout_rate:
+            h = F.dropout(h, p=dropout_rate, training=training,
+                          mode=mode)
+        h = fused_linear(h, ensure_tensor(ffn2_weights[i]),
+                         None if ffn2_biases is None
+                         else ensure_tensor(ffn2_biases[i]))
+        x = residual + h
+        if not pre_layer_norm:
+            x = _ln(x, ffn_ln_scales[i], ffn_ln_biases[i])
+    if caches_out is not None:
+        return x, caches_out
+    return x

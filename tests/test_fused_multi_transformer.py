@@ -1,0 +1,127 @@
+"""fused_multi_transformer — hand-oracle parity (numpy per-layer
+assembly) + cached-decode consistency (SURVEY.md §2.2 Incubate)."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+import paddle_tpu.nn.functional as NF
+
+B, S, E, H, D, M, L = 2, 5, 16, 2, 8, 32, 2
+
+
+def _t(a):
+    return paddle.to_tensor(a.astype(np.float32))
+
+
+@pytest.fixture(scope="module")
+def params():
+    rng = np.random.default_rng(0)
+    return dict(
+        ln_scales=[_t(np.ones(E)) for _ in range(L)],
+        ln_biases=[_t(np.zeros(E)) for _ in range(L)],
+        qkv_weights=[_t(rng.standard_normal((3, H, D, E)) * 0.1)
+                     for _ in range(L)],
+        qkv_biases=[_t(np.zeros(3 * H * D)) for _ in range(L)],
+        linear_weights=[_t(rng.standard_normal((E, E)) * 0.1)
+                        for _ in range(L)],
+        linear_biases=[_t(np.zeros(E)) for _ in range(L)],
+        ffn_ln_scales=[_t(np.ones(E)) for _ in range(L)],
+        ffn_ln_biases=[_t(np.zeros(E)) for _ in range(L)],
+        ffn1_weights=[_t(rng.standard_normal((E, M)) * 0.1)
+                      for _ in range(L)],
+        ffn1_biases=[_t(np.zeros(M)) for _ in range(L)],
+        ffn2_weights=[_t(rng.standard_normal((M, E)) * 0.1)
+                      for _ in range(L)],
+        ffn2_biases=[_t(np.zeros(E)) for _ in range(L)],
+    )
+
+
+def _oracle(x, params):
+    hcur = x.numpy()
+    for i in range(L):
+        res = hcur
+        h = NF.layer_norm(_t(hcur), E, params["ln_scales"][i],
+                          params["ln_biases"][i], 1e-5).numpy()
+        w = params["qkv_weights"][i].numpy()
+        qkv = np.einsum("bse,khde->bskhd", h, w)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = np.zeros_like(q)
+        for bi in range(B):
+            for hi in range(H):
+                sc = (q[bi, :, hi] @ k[bi, :, hi].T) / np.sqrt(D)
+                m = np.triu(np.full((S, S), -1e30), 1)
+                e_ = np.exp(sc + m - (sc + m).max(-1, keepdims=True))
+                p_ = e_ / e_.sum(-1, keepdims=True)
+                o[bi, :, hi] = p_ @ v[bi, :, hi]
+        proj = o.reshape(B, S, H * D) @ params["linear_weights"][i].numpy()
+        hcur = res + proj
+        res2 = hcur
+        h2 = NF.layer_norm(_t(hcur), E, params["ffn_ln_scales"][i],
+                           params["ffn_ln_biases"][i], 1e-5).numpy()
+        g = h2 @ params["ffn1_weights"][i].numpy()
+        g = 0.5 * g * (1 + sp.erf(g / np.sqrt(2)))
+        hcur = res2 + g @ params["ffn2_weights"][i].numpy()
+    return hcur
+
+
+class TestFusedMultiTransformer:
+    def test_matches_hand_oracle(self, params):
+        x = _t(np.random.default_rng(1).standard_normal((B, S, E)))
+        out = IF.fused_multi_transformer(x, **params)
+        np.testing.assert_allclose(out.numpy(), _oracle(x, params),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_cached_decode_consistent(self, params):
+        rng = np.random.default_rng(2)
+        x = _t(rng.standard_normal((B, S, E)))
+        T = S + 1
+        caches = [(_t(np.zeros((B, T, H, D))), _t(np.zeros((B, T, H, D))))
+                  for _ in range(L)]
+        out_pf, caches = IF.fused_multi_transformer(
+            x, cache_kvs=caches, time_step=0, **params)
+        full_prefix = IF.fused_multi_transformer(x, **params)
+        np.testing.assert_allclose(out_pf.numpy(), full_prefix.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        x2 = _t(rng.standard_normal((B, 1, E)))
+        step, caches = IF.fused_multi_transformer(
+            x2, cache_kvs=caches, time_step=S, **params)
+        full = IF.fused_multi_transformer(
+            _t(np.concatenate([x.numpy(), x2.numpy()], 1)), **params)
+        np.testing.assert_allclose(step.numpy()[:, 0],
+                                   full.numpy()[:, -1],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_unsupported_knobs(self, params):
+        x = _t(np.zeros((1, 2, E)))
+        with pytest.raises(NotImplementedError):
+            IF.fused_multi_transformer(x, ring_id=2, **params)
+        with pytest.raises(NotImplementedError):
+            IF.fused_multi_transformer(x, trans_qkvw=False, **params)
+
+    def test_mask_with_cache_rejected(self, params):
+        x = _t(np.zeros((1, 2, E)))
+        caches = [(_t(np.zeros((1, 4, H, D))), _t(np.zeros((1, 4, H, D))))
+                  for _ in range(L)]
+        with pytest.raises(NotImplementedError):
+            IF.fused_multi_transformer(
+                x, cache_kvs=caches, time_step=0,
+                attn_mask=_t(np.zeros((1, 1, 2, 4))), **params)
+
+    def test_downscale_in_infer_scaling(self, params):
+        x = _t(np.random.default_rng(3).standard_normal((1, 3, E)))
+        base = IF.fused_multi_transformer(x, **params).numpy()
+        scaled = IF.fused_multi_transformer(
+            x, dropout_rate=0.5, training=False,
+            mode="downscale_in_infer", **params).numpy()
+        assert not np.allclose(base, scaled)  # (1-p) factors applied
+
+    def test_tensor_time_step(self, params):
+        x = _t(np.zeros((1, 2, E)))
+        caches = [(_t(np.zeros((1, 4, H, D))), _t(np.zeros((1, 4, H, D))))
+                  for _ in range(L)]
+        out, _ = IF.fused_multi_transformer(
+            x, cache_kvs=caches,
+            time_step=paddle.to_tensor(np.asarray(0, np.int32)), **params)
+        assert list(out.shape) == [1, 2, E]
