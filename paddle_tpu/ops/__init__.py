@@ -58,7 +58,7 @@ for _bad in ("zeros", "ones", "full", "empty", "arange", "linspace",
              "inplace_rebind",
              # list-taking ops cannot be methods
              "cat", "block_diag", "column_stack", "row_stack",
-             "histogramdd", "add_n"):
+             "histogramdd", "add_n", "cartesian_prod"):
     _METHOD_TABLE.pop(_bad, None)
 _METHOD_TABLE = {k: v for k, v in _METHOD_TABLE.items()
                  if not isinstance(v, type)}

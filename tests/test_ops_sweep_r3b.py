@@ -118,3 +118,63 @@ class TestReviewRegressionsSweep2:
         out = jax.jit(lambda a: paddle.isposinf(
             paddle.Tensor(a))._data)(np.array([np.inf, 1.0], np.float32))
         np.testing.assert_array_equal(out, [True, False])
+
+
+class TestFinalStragglers:
+    def test_erfc_gammainc(self):
+        import scipy.special as sp
+        x = np.linspace(0.2, 3, 8).astype(np.float32)
+        np.testing.assert_allclose(paddle.erfc(paddle.to_tensor(x)).numpy(),
+                                   sp.erfc(x), rtol=1e-5)
+        a = np.array([1.0, 2.0], np.float32)
+        y = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(
+            paddle.gammainc(paddle.to_tensor(a),
+                            paddle.to_tensor(y)).numpy(),
+            sp.gammainc(a, y), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammaincc(paddle.to_tensor(a),
+                             paddle.to_tensor(y)).numpy(),
+            sp.gammaincc(a, y), rtol=1e-5)
+
+    def test_nan_moments(self):
+        z = np.array([[1.0, np.nan], [3.0, 4.0]], np.float32)
+        np.testing.assert_allclose(
+            paddle.nanstd(paddle.to_tensor(z)).numpy(),
+            np.nanstd(z, ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.nanvar(paddle.to_tensor(z), axis=1,
+                          unbiased=False).numpy(),
+            np.nanvar(z, axis=1), rtol=1e-5)
+
+    def test_cartesian_prod_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        got = paddle.cartesian_prod(
+            [paddle.to_tensor(np.array([1, 2])),
+             paddle.to_tensor(np.array([3, 4, 5]))]).numpy()
+        ref = torch.cartesian_prod(torch.tensor([1, 2]),
+                                   torch.tensor([3, 4, 5])).numpy()
+        np.testing.assert_array_equal(got, ref)
+        single = paddle.cartesian_prod(
+            [paddle.to_tensor(np.array([7, 8]))]).numpy()
+        ref1 = torch.cartesian_prod(torch.tensor([7, 8])).numpy()
+        np.testing.assert_array_equal(single, ref1)  # 1-D, torch oracle
+        assert not hasattr(paddle.to_tensor(np.array([1, 2])),
+                           "cartesian_prod")  # list-taking: not a method
+
+    def test_lu_solve_matches_scipy(self):
+        import scipy.linalg as sla
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((4, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 2)).astype(np.float32)
+        lu, piv = sla.lu_factor(A)
+        got = paddle.lu_solve(
+            paddle.to_tensor(b), paddle.to_tensor(lu.astype(np.float32)),
+            paddle.to_tensor((piv + 1).astype(np.int32))).numpy()
+        np.testing.assert_allclose(got, sla.lu_solve((lu, piv), b),
+                                   rtol=1e-3, atol=1e-4)
+        with pytest.raises(NotImplementedError):
+            paddle.lu_solve(paddle.to_tensor(b),
+                            paddle.to_tensor(np.zeros((2, 4, 4),
+                                                      np.float32)),
+                            paddle.to_tensor(np.ones((2, 4), np.int32)))
