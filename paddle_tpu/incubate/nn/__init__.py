@@ -2,6 +2,7 @@
 from . import functional  # noqa: F401
 
 from .layers import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                     FusedMultiTransformer,
                      FusedFeedForward, FusedLinear, FusedMoELayer,
                      FusedMultiHeadAttention,
                      FusedTransformerEncoderLayer)

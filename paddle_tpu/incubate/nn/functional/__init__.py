@@ -351,7 +351,8 @@ def fused_multi_transformer(
         qkvw = ensure_tensor(qkv_weights[i])
         b, s, e = h.shape
         three, nh, hd, _e = qkvw.shape
-        qb = None if qkv_biases is None else ensure_tensor(qkv_biases[i])
+        qb = None if qkv_biases is None or qkv_biases[i] is None \
+            else ensure_tensor(qkv_biases[i])
 
         def _qkv(ha, wa, *rest):
             out = jnp.einsum("bse,khde->bskhd", ha.astype(jnp.float32),
@@ -382,9 +383,10 @@ def fused_multi_transformer(
                                         causal=attn_mask is None,
                                         scale=scale, **mask_kw)
         attn = attn.reshape([b, s, nh * hd])
-        proj = fused_linear(attn, ensure_tensor(linear_weights[i]),
-                            None if linear_biases is None
-                            else ensure_tensor(linear_biases[i]))
+        proj = fused_linear(
+            attn, ensure_tensor(linear_weights[i]),
+            None if linear_biases is None or linear_biases[i] is None
+            else ensure_tensor(linear_biases[i]))
         if dropout_rate:
             # F.dropout owns BOTH modes (incl. downscale_in_infer's
             # (1-p) inference scaling) — don't gate it on training
@@ -396,15 +398,17 @@ def fused_multi_transformer(
         residual = x
         h = _ln(x, ffn_ln_scales[i], ffn_ln_biases[i]) \
             if pre_layer_norm else x
-        h = act(fused_linear(h, ensure_tensor(ffn1_weights[i]),
-                             None if ffn1_biases is None
-                             else ensure_tensor(ffn1_biases[i])))
+        h = act(fused_linear(
+            h, ensure_tensor(ffn1_weights[i]),
+            None if ffn1_biases is None or ffn1_biases[i] is None
+            else ensure_tensor(ffn1_biases[i])))
         if dropout_rate:
             h = F.dropout(h, p=dropout_rate, training=training,
                           mode=mode)
-        h = fused_linear(h, ensure_tensor(ffn2_weights[i]),
-                         None if ffn2_biases is None
-                         else ensure_tensor(ffn2_biases[i]))
+        h = fused_linear(
+            h, ensure_tensor(ffn2_weights[i]),
+            None if ffn2_biases is None or ffn2_biases[i] is None
+            else ensure_tensor(ffn2_biases[i]))
         x = residual + h
         if not pre_layer_norm:
             x = _ln(x, ffn_ln_scales[i], ffn_ln_biases[i])

@@ -8,7 +8,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...core.tensor import Parameter
-from ...nn.layer import Layer
+from ...nn.layer import Layer, ParameterList
 from ...nn import initializer as I
 from . import functional as F
 
@@ -176,3 +176,89 @@ class FusedMoELayer(Layer):
 
     def forward(self, x):
         return self.moe(x)
+
+
+class FusedMultiTransformer(Layer):
+    """Reference parity: paddle.incubate.nn.FusedMultiTransformer — the
+    serving decoder stack as ONE layer owning all per-layer weights,
+    forwarding to functional.fused_multi_transformer (flash/cached
+    attention cores; see that docstring for layouts and the
+    free-rollback cache design)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim "
+                             f"(got {num_heads} vs {embed_dim})")
+        if nranks != 1 or ring_id not in (-1, None):
+            raise NotImplementedError(
+                "in-layer tensor parallelism: use the fleet TP layers")
+        if not trans_qkvw:
+            raise NotImplementedError(
+                "trans_qkvw=False layout is not supported (matches the "
+                "functional's guard)")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        hd, nh, e, m = (self.head_dim, num_heads, embed_dim,
+                        dim_feedforward)
+
+        def _plist(shape, attrs, is_bias=False, default=None):
+            out = ParameterList()
+            for i in range(num_layers):
+                attr = attrs[i] if isinstance(attrs, (list, tuple)) \
+                    else attrs
+                p = self.create_parameter(
+                    shape, attr=attr, is_bias=is_bias,
+                    default_initializer=default)
+                out.append(p)
+            return out
+
+        ones = I.Constant(1.0)
+        self.ln_scales = _plist((e,), ln_scale_attrs, default=ones)
+        self.ln_biases = _plist((e,), ln_bias_attrs, is_bias=True)
+        self.qkv_weights = _plist((3, nh, hd, e), qkv_weight_attrs)
+        self.qkv_biases = _plist((3 * nh * hd,), qkv_bias_attrs,
+                                 is_bias=True)
+        self.linear_weights = _plist((e, e), linear_weight_attrs)
+        self.linear_biases = _plist((e,), linear_bias_attrs,
+                                    is_bias=True)
+        self.ffn_ln_scales = _plist((e,), ffn_ln_scale_attrs,
+                                    default=ones)
+        self.ffn_ln_biases = _plist((e,), ffn_ln_bias_attrs,
+                                    is_bias=True)
+        self.ffn1_weights = _plist((e, m), ffn1_weight_attrs)
+        self.ffn1_biases = _plist((m,), ffn1_bias_attrs, is_bias=True)
+        self.ffn2_weights = _plist((m, e), ffn2_weight_attrs)
+        self.ffn2_biases = _plist((e,), ffn2_bias_attrs, is_bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        from .functional import fused_multi_transformer
+        return fused_multi_transformer(
+            src, list(self.ln_scales), list(self.ln_biases),
+            list(self.qkv_weights), list(self.qkv_biases),
+            list(self.linear_weights), list(self.linear_biases),
+            list(self.ffn_ln_scales), list(self.ffn_ln_biases),
+            list(self.ffn1_weights), list(self.ffn1_biases),
+            list(self.ffn2_weights), list(self.ffn2_biases),
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training)

@@ -125,3 +125,65 @@ class TestFusedMultiTransformer:
             x, cache_kvs=caches,
             time_step=paddle.to_tensor(np.asarray(0, np.int32)), **params)
         assert list(out.shape) == [1, 2, E]
+
+
+class TestFusedMultiTransformerLayer:
+    def test_owns_weights_and_runs(self):
+        m = paddle.incubate.nn.FusedMultiTransformer(16, 2, 32,
+                                                     num_layers=2)
+        assert len(m.parameters()) == 12 * 2  # 12 param families/layer
+        x = _t(np.random.default_rng(0).standard_normal((2, 5, 16)))
+        out = m(x)
+        assert list(out.shape) == [2, 5, 16]
+
+    def test_cached_path_consistent(self):
+        m = paddle.incubate.nn.FusedMultiTransformer(16, 2, 32,
+                                                     num_layers=2)
+        x = _t(np.random.default_rng(1).standard_normal((1, 4, 16)))
+        base = m(x)
+        caches = [(_t(np.zeros((1, 6, 2, 8))), _t(np.zeros((1, 6, 2, 8))))
+                  for _ in range(2)]
+        out, caches = m(x, caches=caches, time_step=0)
+        np.testing.assert_allclose(out.numpy(), base.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_matches_functional_with_same_weights(self, params):
+        m = paddle.incubate.nn.FusedMultiTransformer(E, H, M,
+                                                     num_layers=L)
+        for name, plist in [("ln_scales", m.ln_scales),
+                            ("ln_biases", m.ln_biases),
+                            ("qkv_weights", m.qkv_weights),
+                            ("qkv_biases", m.qkv_biases),
+                            ("linear_weights", m.linear_weights),
+                            ("linear_biases", m.linear_biases),
+                            ("ffn_ln_scales", m.ffn_ln_scales),
+                            ("ffn_ln_biases", m.ffn_ln_biases),
+                            ("ffn1_weights", m.ffn1_weights),
+                            ("ffn1_biases", m.ffn1_biases),
+                            ("ffn2_weights", m.ffn2_weights),
+                            ("ffn2_biases", m.ffn2_biases)]:
+            for i in range(L):
+                plist[i].set_value(params[name][i])
+        x = _t(np.random.default_rng(2).standard_normal((1, 3, E)))
+        got = m(x).numpy()
+        ref = IF.fused_multi_transformer(x, **params).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_tp_rejected(self):
+        with pytest.raises(NotImplementedError):
+            paddle.incubate.nn.FusedMultiTransformer(16, 2, 32,
+                                                     num_layers=1,
+                                                     nranks=2)
+
+    def test_bias_attrs_false(self):
+        m = paddle.incubate.nn.FusedMultiTransformer(
+            16, 2, 32, num_layers=1, qkv_bias_attrs=False,
+            linear_bias_attrs=False, ffn1_bias_attrs=False,
+            ffn2_bias_attrs=False)
+        x = _t(np.random.default_rng(3).standard_normal((1, 3, 16)))
+        assert list(m(x).shape) == [1, 3, 16]
+
+    def test_trans_qkvw_false_rejected(self):
+        with pytest.raises(NotImplementedError):
+            paddle.incubate.nn.FusedMultiTransformer(
+                16, 2, 32, num_layers=1, trans_qkvw=False)
