@@ -190,3 +190,21 @@ class TestSpeculativeReviewRegressions:
                               speculative_k=2)
         ref = target.generate(ids, max_new_tokens=4).numpy()
         np.testing.assert_array_equal(out.numpy(), ref)
+
+
+class TestSpeculativeComposition:
+    def test_weight_only_quant_target(self, models):
+        # wq-converted target + draft: the compiled program must thread
+        # the quantized params/buffers as arguments like any others
+        from paddle_tpu.nn.quant import convert_to_weight_only
+        import copy
+        target, draft = models
+        qt = _model(3, 64, 0)  # fresh copy of the target config/seed
+        convert_to_weight_only(qt, algo="weight_only_int8",
+                               exclude=("lm_head",))
+        ids = paddle.to_tensor(
+            np.random.default_rng(11).integers(0, 96, (1, 6)))
+        ref = qt.generate(ids, max_new_tokens=8).numpy()
+        spec = qt.generate(ids, max_new_tokens=8, draft_model=draft,
+                           speculative_k=3).numpy()
+        np.testing.assert_array_equal(spec, ref)  # exact on quantized too
