@@ -81,8 +81,8 @@ def _unpadded_kernel_path(q, k, v, cq, ck, sc, causal, dropout):
     tk = k.shape[0]
     pq = (-tq) % 128
     pk = (-tk) % 128
-    if tq + pq != tk + pk:  # kernel streams K at q's padded length
-        return None
+    # round-4: the kernel grid is rectangular (streamed forward), so
+    # cross-length packed totals (tq+pq != tk+pk) ride the kernel too
     if _shape_reason((1, tq + pq, h, d),
                      (1, tk + pk, k.shape[1], d)) is not None:
         return None

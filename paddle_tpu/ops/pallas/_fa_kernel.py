@@ -82,11 +82,49 @@ def _stat_cols(stat, n):
     return jnp.tile(stat, (1, n // LANES))
 
 
+def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg):
+    """The one canonical masking preamble shared by all four kernels:
+    apply causal (q0/k0 = absolute positions of the block's first row/
+    column, `offset = sk - sq` shifts the diagonal), an additive mask
+    block, and segment-id matching (negative ids never match) to raw
+    scores s [bq, bk]. Keeping a single copy is what guarantees the
+    forward and both backward kernels mask identically."""
+    bq, bk = s.shape
+    if causal:
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        s = jnp.where(qpos + offset >= kpos, s, -jnp.inf)
+    if mask_blk is not None:
+        s = s + mask_blk
+    if qseg is not None:
+        s = jnp.where((qseg == kseg) & (qseg >= 0) & (kseg >= 0), s,
+                      -jnp.inf)
+    return s
+
+
+def _online_softmax_step(s, v, m, l, acc):
+    """One online-softmax block update (shared by both forward kernels):
+    (m, l, acc) carry ← masked scores s [bq, bk] and values v [bk, D].
+    Fully-masked-so-far rows keep m = -inf; exps run against a finite
+    max so the accumulators stay nan-free."""
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.exp(m - m_safe)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return m_new, l_new, acc * corr + pv
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
-                   seq_len, has_mask, has_seg, want_lse):
+                   seq_len, has_seg, want_lse):
+    """Resident-K/V forward: full-sequence K/V in VMEM, fori_loop streams
+    k blocks with a causal-pruned upper bound (the bench path). Masked
+    and cross-length calls route to `_fa_fwd_stream_kernel` instead."""
     i = 0
-    mask_ref = rest[i] if has_mask else None
-    i += 1 if has_mask else 0
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
@@ -108,31 +146,11 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
         v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-            kpos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        if has_mask:
-            s = s + mask_ref[0, :, pl.ds(i * block_k, block_k)]
-        if has_seg:
-            kseg = kseg_ref[0, :, pl.ds(i * block_k, block_k)]  # [1, bk]
-            live = (qseg == kseg) & (qseg >= 0) & (kseg >= 0)
-            s = jnp.where(live, s, -jnp.inf)
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
-        # a row can be ENTIRELY masked in this block (segment/mask
-        # rows): m_new stays -inf and exp(-inf - -inf) would poison the
-        # accumulators with nan — run the exps against a finite max
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe)
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        corr = jnp.exp(m - m_safe)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc_new = acc * corr + pv
-        return m_new, l_new, acc_new
+        kseg = kseg_ref[0, :, pl.ds(i * block_k, block_k)] \
+            if has_seg else None                      # [1, bk]
+        s = _masked_scores(s, qi * bq, i * block_k, causal, 0, None,
+                           qseg if has_seg else None, kseg)
+        return _online_softmax_step(s, v, m, l, acc)
 
     def seg_gated_body(i, carry):
         # packed segments are monotone: this (q, k) block pair is dead
@@ -158,6 +176,81 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     if lse_ref is not None:
         lse = m + jnp.log(jnp.maximum(l, 1e-30))          # [bq, 1]
         lse_ref[0] = jnp.broadcast_to(lse, (bq, LANES))
+
+
+def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                          block_q, block_k, n_kb, offset, has_mask,
+                          has_seg, want_lse):
+    """Streamed forward: grid = (B*H, n_qb, n_kb) with the online-softmax
+    state (m, l, acc) in VMEM scratch persisted across the sequential
+    innermost k axis — the same revisit-accumulation layout as the
+    backward kernels. Unlike `_fa_fwd_kernel` (full-sequence K/V resident,
+    fori_loop over k), every operand block here is O(block), so the mask
+    streams as (block_q, block_k) slabs (no `_MASK_FWD_MAX_S` cap) and
+    Q/KV lengths may differ (`offset = sk - sq` shifts the causal
+    diagonal, matching the reference's tril(k=sk-sq) semantics)."""
+    i = 0
+    mask_ref = rest[i] if has_mask else None
+    i += 1 if has_mask else 0
+    qseg_ref = rest[i] if has_seg else None
+    kseg_ref = rest[i + 1] if has_seg else None
+    i += 2 if has_seg else 0
+    o_ref = rest[i]
+    i += 1
+    lse_ref = rest[i] if want_lse else None
+    i += 1 if want_lse else 0
+    m_scr, l_scr, acc_scr = rest[i], rest[i + 1], rest[i + 2]
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _masked_scores(
+            s, qi * block_q, kj * block_k, causal, offset,
+            mask_ref[0] if has_mask else None,
+            qseg_ref[0][:, :1] if has_seg else None,
+            kseg_ref[0] if has_seg else None)
+        m_new, l_new, acc_new = _online_softmax_step(
+            s, v, m_scr[:, :1], l_scr[:, :1], acc_scr[...])
+        acc_scr[...] = acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    live = None
+    if causal:
+        live = qi * block_q + block_q - 1 + offset >= kj * block_k
+    if has_seg:
+        # packed segments are monotone: the block pair is dead unless
+        # the segment ranges overlap
+        kseg = kseg_ref[0]
+        qseg = qseg_ref[0][:, :1]
+        ov = (jnp.max(qseg) >= jnp.min(kseg)) & \
+             (jnp.min(qseg) <= jnp.max(kseg))
+        live = ov if live is None else jnp.logical_and(live, ov)
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
 def _bh(x, b, h, s, d):
@@ -189,12 +282,21 @@ def _seg_layouts(q_seg, kv_seg):
 def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                block_k=None, interpret=False, return_lse=False, mask=None,
                q_seg=None, kv_seg=None):
-    """q: [B, S, H, D]; k/v: [B, S, Hkv, D] (Hkv | H → GQA in-kernel)
-    → out [B, S, H, D] (+ lse [B*H, S, LANES]).
+    """q: [B, Sq, H, D]; k/v: [B, Sk, Hkv, D] (Hkv | H → GQA in-kernel)
+    → out [B, Sq, H, D] (+ lse [B*H, Sq, LANES]).
 
-    mask: additive f32 [B|1, H|1, S, S]. q_seg/kv_seg: int32 [B, S]
-    packed segment ids (negative ids never match → padding rows)."""
-    b, s, h, d = q.shape
+    mask: additive f32 [B|1, H|1, Sq, Sk]. q_seg/kv_seg: int32 [B, Sq] /
+    [B, Sk] packed segment ids (negative ids never match → padding).
+
+    Two kernel layouts behind one entry:
+      - `sq == sk` and no mask → `_fa_fwd_kernel` (full-seq K/V resident
+        in VMEM, fori_loop streams k blocks, causal prunes the loop
+        bound — the bench-validated path, untouched).
+      - mask present or `sq != sk` → `_fa_fwd_stream_kernel` (3-D grid,
+        O(block) operands, mask streamed per (q, k) block, causal offset
+        `sk - sq` matching the reference's tril(k=sk-sq))."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
     hkv = k.shape[2]
     assert h % hkv == 0, (h, hkv)
     g = h // hkv
@@ -203,58 +305,92 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
         block_q = _env_block("PADDLE_TPU_FA_BLOCK_Q", 128)
     if block_k is None:
         block_k = _env_block("PADDLE_TPU_FA_BLOCK_K", 128)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0
 
-    qb = _bh(q, b, h, s, d)
-    kb = _bh(k, b, hkv, s, d)
-    vb = _bh(v, b, hkv, s, d)
+    qb = _bh(q, b, h, sq, d)
+    kb = _bh(k, b, hkv, sk, d)
+    vb = _bh(v, b, hkv, sk, d)
     has_mask = mask is not None
     has_seg = q_seg is not None
+    streamed = has_mask or sq != sk
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
 
-    kernel = functools.partial(_fa_fwd_kernel, scale=sc, causal=causal,
-                               block_k=block_k, seq_len=s,
-                               has_mask=has_mask, has_seg=has_seg,
-                               want_lse=return_lse)
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, s, d), lambda i, j: (kvrow(i), 0, 0)),
-        pl.BlockSpec((1, s, d), lambda i, j: (kvrow(i), 0, 0)),
-    ]
     args = [qb, kb, vb]
-    if has_mask:
-        mrows, row_fn = _mask_rows(mask, b, h)
-        in_specs.append(pl.BlockSpec(
-            (1, block_q, s), lambda i, j: (row_fn(i // h, i % h), j, 0)))
-        args.append(mrows)
-    if has_seg:
-        qs, ks = _seg_layouts(q_seg, kv_seg)
-        in_specs.append(pl.BlockSpec((1, block_q, LANES),
-                                     lambda i, j: (i // h, j, 0)))
-        in_specs.append(pl.BlockSpec((1, 1, s),
-                                     lambda i, j: (i // h, 0, 0)))
-        args.extend([qs, ks])
+    out_shape = [_sds((b * h, sq, d), q.dtype, qb, kb, vb)]
+    if not streamed:
+        kernel = functools.partial(_fa_fwd_kernel, scale=sc, causal=causal,
+                                   block_k=block_k, seq_len=sk,
+                                   has_seg=has_seg, want_lse=return_lse)
+        grid = (b * h, sq // block_q)
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (kvrow(i), 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (kvrow(i), 0, 0)),
+        ]
+        if has_seg:
+            qs, ks = _seg_layouts(q_seg, kv_seg)
+            in_specs.append(pl.BlockSpec((1, block_q, LANES),
+                                         lambda i, j: (i // h, j, 0)))
+            in_specs.append(pl.BlockSpec((1, 1, sk),
+                                         lambda i, j: (i // h, 0, 0)))
+            args.extend([qs, ks])
+        out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
+        if return_lse:
+            out_shape.append(
+                _sds((b * h, sq, LANES), jnp.float32, qb, kb, vb))
+            out_specs.append(
+                pl.BlockSpec((1, block_q, LANES), lambda i, j: (i, j, 0)))
+        scratch_shapes = []
+    else:
+        n_kb = sk // block_k
+        kernel = functools.partial(
+            _fa_fwd_stream_kernel, scale=sc, causal=causal,
+            block_q=block_q, block_k=block_k, n_kb=n_kb, offset=sk - sq,
+            has_mask=has_mask, has_seg=has_seg, want_lse=return_lse)
+        grid = (b * h, sq // block_q, n_kb)
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (kvrow(i), t, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, t: (kvrow(i), t, 0)),
+        ]
+        if has_mask:
+            mrows, row_fn = _mask_rows(mask, b, h)
+            in_specs.append(pl.BlockSpec(
+                (1, block_q, block_k),
+                lambda i, j, t: (row_fn(i // h, i % h), j, t)))
+            args.append(mrows)
+        if has_seg:
+            qs, ks = _seg_layouts(q_seg, kv_seg)
+            in_specs.append(pl.BlockSpec((1, block_q, LANES),
+                                         lambda i, j, t: (i // h, j, 0)))
+            in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                         lambda i, j, t: (i // h, 0, t)))
+            args.extend([qs, ks])
+        out_specs = [pl.BlockSpec((1, block_q, d),
+                                  lambda i, j, t: (i, j, 0))]
+        if return_lse:
+            out_shape.append(
+                _sds((b * h, sq, LANES), jnp.float32, qb, kb, vb))
+            out_specs.append(pl.BlockSpec((1, block_q, LANES),
+                                          lambda i, j, t: (i, j, 0)))
+        scratch_shapes = [pltpu.VMEM((block_q, LANES), jnp.float32),
+                          pltpu.VMEM((block_q, LANES), jnp.float32),
+                          pltpu.VMEM((block_q, d), jnp.float32)]
 
-    out_shape = [_sds((b * h, s, d), q.dtype, qb, kb, vb)]
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))]
-    if return_lse:
-        out_shape.append(
-            _sds((b * h, s, LANES), jnp.float32, qb, kb, vb))
-        out_specs.append(
-            pl.BlockSpec((1, block_q, LANES), lambda i, j: (i, j, 0)))
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        grid=(b * h, s // block_q),
+        grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(*args)
-    out = jnp.moveaxis(res[0].reshape(b, h, s, d), 1, 2)
+    out = jnp.moveaxis(res[0].reshape(b, h, sq, d), 1, 2)
     if return_lse:
         return out, res[1]
     return out
@@ -262,7 +398,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, scale, causal, block_k, block_q, has_mask,
-                      has_seg):
+                      has_seg, offset=0):
     """grid = (B*H, n_qb, n_kb); dq block revisited across the innermost
     kb axis (index map drops it), accumulating in an f32 out ref — the
     VMEM-bounded layout: every operand block is O(block · D), nothing is
@@ -294,18 +430,10 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta_t = _stat_cols(delta_ref[0], bk)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-            kpos = kj * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (1, bk), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        if has_mask:
-            s = s + mask_ref[0]
-        if has_seg:
-            qsg = qseg_ref[0][:, :1]
-            ksg = kseg_ref[0]
-            s = jnp.where((qsg == ksg) & (qsg >= 0) & (ksg >= 0), s,
-                          -jnp.inf)
+        s = _masked_scores(s, qi * bq, kj * bk, causal, offset,
+                           mask_ref[0] if has_mask else None,
+                           qseg_ref[0][:, :1] if has_seg else None,
+                           kseg_ref[0] if has_seg else None)
         p = jnp.exp(s - lse_t)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -317,7 +445,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # skip blocks entirely above the diagonal (no live q >= k pair)
-        live = (qi + 1) * block_q - 1 >= kj * block_k
+        live = (qi + 1) * block_q - 1 + offset >= kj * block_k
         pl.when(live)(compute)
     else:
         compute()
@@ -325,7 +453,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        *rest, scale, causal, block_q, block_k, n_qb,
-                       has_mask, has_seg):
+                       has_mask, has_seg, offset=0):
     """grid = (B*Hkv, n_kb, G·n_qb); dk/dv blocks revisited across the
     innermost axis — which enumerates (query-head-in-group, q block) —
     accumulated in f32 out refs (same VMEM-bounded design as
@@ -358,19 +486,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         bq = q.shape[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qj * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, 1), 0)
-            kpos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (1, bk), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        if has_mask:
-            s = s + mask_ref[0]
-        if has_seg:
-            qsg = qseg_ref[0][:, :1]
-            ksg = kseg_ref[0]
-            s = jnp.where((qsg == ksg) & (qsg >= 0) & (ksg >= 0), s,
-                          -jnp.inf)
+        s = _masked_scores(s, qj * bq, ki * bk, causal, offset,
+                           mask_ref[0] if has_mask else None,
+                           qseg_ref[0][:, :1] if has_seg else None,
+                           kseg_ref[0] if has_seg else None)
         p = jnp.exp(s - _stat_cols(lse_ref[0], bk))       # [bq, bk]
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         # dv += p^T @ do   (contract over q rows — dim 0 on both)
@@ -386,7 +505,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * scale
 
     if causal:
-        live = (qj + 1) * block_q - 1 >= ki * block_k
+        live = (qj + 1) * block_q - 1 + offset >= ki * block_k
         pl.when(live)(compute)
     else:
         compute()
@@ -405,28 +524,33 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
 
     Returns (dq, dk, dv) in the input dtypes (dk/dv at Hkv heads — the
     GQA group-sum happens in-kernel via revisit accumulation).
+
+    Q/KV lengths may differ (`offset = sk - sq` shifts the causal
+    diagonal, matching the forward).
     """
-    b, s, h, d = q.shape
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
     hkv = k.shape[2]
     g = h // hkv
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    offset = sk - sq
     if block_q is None:
         block_q = _env_block("PADDLE_TPU_FA_BWD_BLOCK_Q", 128)
     if block_k is None:
         block_k = _env_block("PADDLE_TPU_FA_BWD_BLOCK_K", 128)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0
 
-    qb, ob, dob = (_bh(x, b, h, s, d) for x in (q, o, do))
-    kb = _bh(k, b, hkv, s, d)
-    vb = _bh(v, b, hkv, s, d)
+    qb, ob, dob = (_bh(x, b, h, sq, d) for x in (q, o, do))
+    kb = _bh(k, b, hkv, sk, d)
+    vb = _bh(v, b, hkv, sk, d)
     # delta = rowsum(dO * O), broadcast to the lane-minor layout in XLA
     delta = jnp.sum(ob.astype(jnp.float32) * dob.astype(jnp.float32),
-                    axis=-1, keepdims=True)              # [B*H, S, 1]
+                    axis=-1, keepdims=True)              # [B*H, Sq, 1]
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)[..., None]
-    delta = jnp.broadcast_to(delta, (b * h, s, LANES))
+    delta = jnp.broadcast_to(delta, (b * h, sq, LANES))
 
     has_mask = mask is not None
     has_seg = q_seg is not None
@@ -435,8 +559,8 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
     if has_seg:
         qs, ks = _seg_layouts(q_seg, kv_seg)
 
-    n_qb = s // block_q
-    n_kb = s // block_k
+    n_qb = sq // block_q
+    n_kb = sk // block_k
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
@@ -466,8 +590,9 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
                           block_k=block_k, block_q=block_q,
-                          has_mask=has_mask, has_seg=has_seg),
-        out_shape=_sds((b * h, s, d), jnp.float32, qb, kb, vb, dob, lse),
+                          has_mask=has_mask, has_seg=has_seg,
+                          offset=offset),
+        out_shape=_sds((b * h, sq, d), jnp.float32, qb, kb, vb, dob, lse),
         grid=(b * h, n_qb, n_kb),
         in_specs=in_specs,
         out_specs=q_row,
@@ -506,10 +631,11 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
                           block_q=block_q, block_k=block_k, n_qb=n_qb,
-                          has_mask=has_mask, has_seg=has_seg),
-        out_shape=[_sds((b * hkv, s, d), jnp.float32, qb, kb, vb, dob,
+                          has_mask=has_mask, has_seg=has_seg,
+                          offset=offset),
+        out_shape=[_sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,
                         lse),
-                   _sds((b * hkv, s, d), jnp.float32, qb, kb, vb, dob,
+                   _sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,
                         lse)],
         grid=(b * hkv, n_kb, g * n_qb),
         in_specs=in_specs2,
@@ -517,7 +643,7 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         interpret=interpret,
     )(*args2)
 
-    def unbh(x, heads, dt):
-        return jnp.moveaxis(x.reshape(b, heads, s, d), 1, 2).astype(dt)
-    return (unbh(dq, h, q.dtype), unbh(dk, hkv, k.dtype),
-            unbh(dv, hkv, v.dtype))
+    def unbh(x, heads, seq, dt):
+        return jnp.moveaxis(x.reshape(b, heads, seq, d), 1, 2).astype(dt)
+    return (unbh(dq, h, sq, q.dtype), unbh(dk, hkv, sk, k.dtype),
+            unbh(dv, hkv, sk, v.dtype))

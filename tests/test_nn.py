@@ -327,6 +327,40 @@ class TestFlashVarlenKernelPath:
             np.testing.assert_allclose(got[s:e], np.asarray(ref[0]),
                                        atol=3e-4)
 
+    def test_varlen_cross_length_kernel(self, monkeypatch):
+        """Round-4: different packed q/k totals (varlen cross-attention)
+        ride the rectangular kernel grid — no O(tq·tk) fallback."""
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa_mod
+        from paddle_tpu.nn.functional.flash_attention import (
+            flash_attn_unpadded)
+        from paddle_tpu.ops.pallas.flash_attention import _attention_ref
+        monkeypatch.setattr(fa_mod, "_FORCE_INTERPRET", True)
+        fa_mod.reset_dispatch_stats()
+        rng = np.random.default_rng(4)
+        q_lens, k_lens = [50, 80], [170, 150]   # tq=130→256, tk=320→384
+        cq = np.cumsum([0] + q_lens).astype(np.int32)
+        ck = np.cumsum([0] + k_lens).astype(np.int32)
+        H, D = 2, 64
+        q = rng.standard_normal((sum(q_lens), H, D)).astype(np.float32)
+        k = rng.standard_normal((sum(k_lens), H, D)).astype(np.float32)
+        v = rng.standard_normal((sum(k_lens), H, D)).astype(np.float32)
+        out, _ = flash_attn_unpadded(
+            P.to_tensor(q), P.to_tensor(k), P.to_tensor(v),
+            P.to_tensor(cq), P.to_tensor(ck), max(q_lens), max(k_lens),
+            causal=False)
+        stats = fa_mod.dispatch_stats()
+        assert stats["pallas"] >= 1 and stats["fallback"] == 0, stats
+        got = np.asarray(out._data)
+        for i in range(len(q_lens)):
+            ref = _attention_ref(
+                jnp.asarray(q[None, cq[i]:cq[i + 1]]),
+                jnp.asarray(k[None, ck[i]:ck[i + 1]]),
+                jnp.asarray(v[None, ck[i]:ck[i + 1]]), causal=False)
+            np.testing.assert_allclose(got[cq[i]:cq[i + 1]],
+                                       np.asarray(ref[0]), atol=3e-4)
+
     def test_varlen_kernel_grad(self, monkeypatch):
         import numpy as np
         import paddle_tpu.ops.pallas.flash_attention as fa_mod

@@ -360,3 +360,122 @@ class TestDispatchDiscipline:
         with pytest.raises(RuntimeError, match="fell back"):
             fa._flash_core(q2, k2, v2, False, None)
         fa.reset_dispatch_stats()
+
+
+class TestKernelStreamedForward:
+    """Round-4 (VERDICT r3 item 3): the forward streams (block_q, block_k)
+    mask slabs through a 3-D grid with VMEM-scratch online-softmax state
+    (no `_MASK_FWD_MAX_S` cap), and the grid is rectangular — q and kv
+    lengths may differ, with the causal diagonal shifted by sk - sq
+    (the reference's tril(k=sk-sq) semantics)."""
+
+    def test_masked_long_seq_8192_dispatch_and_parity(self, monkeypatch):
+        """Masked attention at s=8192 runs IN-KERNEL through the dispatch
+        layer (the round-3 forward held the mask as a [block_q, S] slab
+        capped at S<=4096 and fell back above it) and matches the
+        reference."""
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        monkeypatch.setenv("PADDLE_TPU_FA_BLOCK_Q", "512")
+        monkeypatch.setenv("PADDLE_TPU_FA_BLOCK_K", "512")
+        fa.reset_dispatch_stats()
+        q, k, v = qkv(b=1, s=8192, h=1, d=64, seed=3)
+        m = np.zeros((1, 1, 8192, 8192), np.float32)
+        m[..., ::7] = -1e9
+        m = jnp.asarray(m)
+        out = fa._flash_core_ext(q, k, v, m, None, None, True, None)
+        stats = fa.dispatch_stats()
+        assert stats["pallas"] == 1 and stats["fallback"] == 0, stats
+        ref = _attention_ref(q, k, v, mask=m, causal=True)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_length_forward(self, causal):
+        """sq < sk (decode/chunked-prefill shape), GQA heads."""
+        q, _, _ = qkv(b=2, s=256, h=4, d=64)
+        _, k, v = qkv(b=2, s=512, h=2, d=64, seed=5)
+        out = fa_forward(q, k, v, causal=causal, interpret=True)
+        ref = _attention_ref(q, k, v, causal=causal)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_length_backward(self, causal):
+        import jax
+        from paddle_tpu.ops.pallas._fa_kernel import fa_backward
+        q, _, _ = qkv(b=2, s=256, h=4, d=64)
+        _, k, v = qkv(b=2, s=512, h=2, d=64, seed=5)
+        g = jnp.asarray(np.random.default_rng(7).standard_normal(
+            q.shape).astype(np.float32))
+        out, lse = fa_forward(q, k, v, causal=causal, interpret=True,
+                              return_lse=True)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=causal,
+                                 interpret=True)
+        _, vjp = jax.vjp(lambda a, b_, c: _attention_ref(
+            a, b_, c, causal=causal), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        for got, ref, name in [(dq, rdq, "dq"), (dk, rdk, "dk"),
+                               (dv, rdv, "dv")]:
+            assert np.allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-3), \
+                (name, np.abs(np.asarray(got) - np.asarray(ref)).max())
+        assert dk.shape == k.shape and dq.shape == q.shape
+
+    def test_cross_length_sk_lt_sq_fully_masked_rows(self):
+        """sq > sk causal: rows i with i + (sk - sq) < 0 attend nothing
+        and must produce exactly 0 (the reference nan-guards to 0)."""
+        q, _, _ = qkv(b=1, s=512, h=2, d=64)
+        _, k, v = qkv(b=1, s=256, h=2, d=64, seed=5)
+        out = fa_forward(q, k, v, causal=True, interpret=True)
+        ref = _attention_ref(q, k, v, causal=True)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+        assert np.allclose(np.asarray(out)[0, :256], 0.0)
+
+    def test_cross_length_masked_uneven_blocks(self):
+        rng = np.random.default_rng(13)
+        q, _, _ = qkv(b=1, s=256, h=2, d=64)
+        _, k, v = qkv(b=1, s=512, h=2, d=64, seed=5)
+        m = jnp.asarray(rng.standard_normal((1, 1, 256, 512))
+                        .astype(np.float32))
+        out = fa_forward(q, k, v, causal=True, mask=m, interpret=True,
+                         block_q=128, block_k=256)
+        ref = _attention_ref(q, k, v, causal=True, mask=m)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_cross_length_dispatch_engaged(self, monkeypatch):
+        """_shape_reason no longer rejects sq != sk (the round-3
+        cross-length fallback is gone)."""
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        q, _, _ = qkv(b=1, s=256, h=2, d=64)
+        _, k, v = qkv(b=1, s=512, h=2, d=64, seed=5)
+        out = fa._flash_core_ext(q, k, v, None, None, None, True, None)
+        stats = fa.dispatch_stats()
+        assert stats["pallas"] == 1 and stats["fallback"] == 0, stats
+        ref = _attention_ref(q, k, v, causal=True)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_streamed_with_segments_and_mask(self):
+        """mask + segments + causal compose in the streamed kernel."""
+        from paddle_tpu.ops.pallas.flash_attention import _ref_ext
+        rng = np.random.default_rng(17)
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        seg = _seg_ids(2, 256, 3)
+        m = jnp.asarray(rng.standard_normal((2, 1, 256, 256))
+                        .astype(np.float32))
+        out = fa_forward(q, k, v, causal=True, mask=m, q_seg=seg,
+                         kv_seg=seg, interpret=True)
+        ref = _ref_ext(q, k, v, m, seg, seg, True, None)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_streamed_lse_matches_resident_kernel(self):
+        """The streamed kernel's lse agrees with the resident-K/V kernel
+        (same rows, mask=0 forces the streamed path)."""
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        zero_m = jnp.zeros((1, 1, 256, 256), jnp.float32)
+        o1, l1 = fa_forward(q, k, v, causal=True, interpret=True,
+                            return_lse=True)
+        o2, l2 = fa_forward(q, k, v, causal=True, mask=zero_m,
+                            interpret=True, return_lse=True)
+        assert np.allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+        assert np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)

@@ -213,13 +213,40 @@ errs["seg_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
                                             y.astype(jnp.float32))))
                       for x, y in zip((dq2, dk2, dv2), r2))
 
-# additive mask, fwd
+# additive mask, fwd (streamed forward kernel — 3-D grid + VMEM scratch)
 m = jnp.asarray(np.where(rng.random((b, 1, s, s)) < 0.15, -np.inf,
                          0.0).astype(np.float32))
 out3 = fa_forward(qf, kf, vf, mask=m)
 ref3 = _attention_ref(qf, kf, vf, mask=m)
 errs["mask_fwd"] = float(jnp.max(jnp.abs(out3.astype(jnp.float32) -
                                          ref3.astype(jnp.float32))))
+
+# masked BACKWARD through the streamed fwd's lse (round-4)
+out3l, lse3 = fa_forward(qf, kf, vf, mask=m, return_lse=True)
+dq3, dk3, dv3 = fa_backward(qf, kf, vf, out3l, lse3, gf, mask=m)
+_, vjp3 = jax.vjp(lambda a, b_, c: _attention_ref(a, b_, c, mask=m),
+                  qf, kf, vf)
+r3 = vjp3(gf)
+errs["mask_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
+                                             y.astype(jnp.float32))))
+                       for x, y in zip((dq3, dk3, dv3), r3))
+
+# cross-length (sq != sk) causal + GQA: rectangular grid, fwd + bwd
+# (round-4 — the first on-chip compile of the sq != sk shape class)
+sq2 = s // 2
+qc = jnp.asarray(rng.standard_normal((b, sq2, 8, d)), jnp.bfloat16)
+gc = jnp.asarray(rng.standard_normal((b, sq2, 8, d)), jnp.bfloat16)
+out4, lse4 = fa_forward(qc, k, v, causal=True, return_lse=True)
+ref4 = _attention_ref(qc, k, v, causal=True)
+errs["xlen_fwd"] = float(jnp.max(jnp.abs(out4.astype(jnp.float32) -
+                                         ref4.astype(jnp.float32))))
+dq4, dk4, dv4 = fa_backward(qc, k, v, out4, lse4, gc, causal=True)
+_, vjp4 = jax.vjp(lambda a, b_, c: _attention_ref(a, b_, c, causal=True),
+                  qc, k, v)
+r4 = vjp4(gc)
+errs["xlen_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
+                                             y.astype(jnp.float32))))
+                       for x, y in zip((dq4, dk4, dv4), r4))
 print(json.dumps(errs))
 """
 
@@ -236,3 +263,6 @@ class TestOnChipKernelExtensions:
         assert r["seg_fwd"] < 5e-2, r
         assert r["seg_bwd"] < 1e-1, r
         assert r["mask_fwd"] < 5e-2, r
+        assert r["mask_bwd"] < 1e-1, r
+        assert r["xlen_fwd"] < 5e-2, r
+        assert r["xlen_bwd"] < 1e-1, r
