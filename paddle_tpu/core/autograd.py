@@ -274,13 +274,20 @@ def apply(fn, *tensors, name: str = ""):
             node.unpack = unpack
             # Device-memory relief — the point of an offload pack: once an
             # INTERMEDIATE input (produced by the tape, not a leaf/param)
-            # is packed, swap its live device array for a host copy.
+            # is packed TO HOST, swap its live device array for a host
+            # copy. Only when the pack result is itself a host ndarray —
+            # identity/logging/requantize packs keep device arrays in
+            # place (no forced sync per recorded op — ADVICE r3 #1).
             # numpy is a transparent stand-in (jnp ops re-upload on use);
             # no version bump — this is not a user-visible value change.
             import numpy as _np
-            for t in tensors:
-                if t._node is not None and \
-                        not isinstance(t._data, _np.ndarray):
+            for t, p in zip(tensors, node.packed):
+                if t._node is not None and isinstance(p, _np.ndarray) \
+                        and not isinstance(t._data, _np.ndarray):
+                    # copy the LIVE value off-device — never substitute
+                    # the pack result itself: a lossy same-shape pack
+                    # (fp16 roundtrip) must feed only the backward
+                    # re-derivation, not the forward-visible value
                     t._data = _np.asarray(t._data)
         elif microjit:
             # lazy backward: the pullback is derived inside a cached jit

@@ -34,8 +34,16 @@ class LookAhead:
         self.alpha = float(alpha)
         self.k = int(k)
         self._steps = 0
-        self._params = [p for g in inner_optimizer._param_groups
-                        for p in g["params"]]
+        # dedupe by identity: a param in several groups must appear once,
+        # or the save (keyed on _slow) and load (enumerating _params)
+        # index spaces misalign (ADVICE r3 #2)
+        self._params = []
+        _seen: set = set()
+        for g in inner_optimizer._param_groups:
+            for p in g["params"]:
+                if id(p) not in _seen:
+                    _seen.add(id(p))
+                    self._params.append(p)
         with no_grad():
             self._slow = {id(p): np.asarray(p._data).copy()
                           for p in self._params}
@@ -64,8 +72,10 @@ class LookAhead:
 
     def state_dict(self):
         return {"inner": self.inner_optimizer.state_dict(),
-                "slow": {str(i): v for i, (k_, v) in
-                         enumerate(self._slow.items())},
+                # enumerate self._params (the same sequence set_state_dict
+                # walks) — not _slow insertion order
+                "slow": {str(i): self._slow[id(p)]
+                         for i, p in enumerate(self._params)},
                 "steps": self._steps,
                 "alpha": self.alpha, "k": self.k}
 

@@ -615,6 +615,12 @@ class DataLoader:
         if not self._iterable and self.batch_sampler is None:
             # batch_size=None: unbatched passthrough is host-trivial —
             # worker pools iterate self.batch_sampler and would crash
+            if self.num_workers and self.num_workers > 0:
+                import warnings as _warnings
+                _warnings.warn(
+                    "DataLoader(batch_size=None) iterates synchronously; "
+                    f"num_workers={self.num_workers} is ignored on the "
+                    "unbatched passthrough path")
             return self._iter_sync()
         if self.num_workers and self.num_workers > 0:
             import multiprocessing as mp

@@ -148,6 +148,24 @@ def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
     return (out.reshape(b, s, nh, d).astype(q.dtype), k_buf, v_buf)
 
 
+import weakref as _weakref
+
+_SPEC_UIDS: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_SPEC_UID_NEXT = 0
+
+
+def _draft_uid(draft):
+    """Monotonic uid per live draft model (weak-keyed, never reused) —
+    part of the speculative program-cache key."""
+    global _SPEC_UID_NEXT
+    uid = _SPEC_UIDS.get(draft)
+    if uid is None:
+        uid = _SPEC_UID_NEXT
+        _SPEC_UID_NEXT += 1
+        _SPEC_UIDS[draft] = uid
+    return uid
+
+
 class GenerationMixin:
     """Adds .generate() to a causal-LM Layer exposing
     `_forward_cached(input_ids, caches, offset)` →
@@ -279,8 +297,13 @@ class GenerationMixin:
         import weakref
         # cache entry carries the draft WEAKREF and is validated by
         # identity on every hit — id()-keying would let a recycled
-        # address alias a different draft (CLAUDE.md: pin by identity)
-        sig = (b, s, max_new, "spec", k, eos, cache_dtype, sample_cfg)
+        # address alias a different draft (CLAUDE.md: pin by identity).
+        # The signature also carries a stable per-draft uid (monotonic,
+        # never reused) so two live drafts with identical shapes hold
+        # SEPARATE entries — alternating between drafts must not evict
+        # and retrace (ADVICE r3 #4).
+        sig = (b, s, max_new, "spec", _draft_uid(draft), k, eos,
+               cache_dtype, sample_cfg)
         ent = self._gen_program(sig)
         fn = None
         if ent is not None:
@@ -288,6 +311,15 @@ class GenerationMixin:
             if ref() is draft:
                 fn = cached_fn
         if fn is None:
+            # sweep entries whose draft died — per-draft uids are never
+            # reused, so without this a rebuild-the-draft loop would
+            # grow the cache without bound
+            dead = [s_ for s_, v_ in self._gen_cache.items()
+                    if isinstance(v_, tuple) and len(v_) == 2
+                    and isinstance(v_[0], weakref.ReferenceType)
+                    and v_[0]() is None]
+            for s_ in dead:
+                del self._gen_cache[s_]
             ref = weakref.ref(draft)
             fn = jax.jit(functools.partial(
                 _speculative_pure, self, ref, s, max_new,

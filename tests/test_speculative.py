@@ -192,6 +192,29 @@ class TestSpeculativeReviewRegressions:
         np.testing.assert_array_equal(out.numpy(), ref)
 
 
+    def test_two_live_drafts_coexist_in_cache(self, models):
+        """Alternating between two same-shape drafts must not evict and
+        retrace the jitted program each switch (ADVICE r3 #4): each
+        draft holds its own cache entry keyed by a stable uid."""
+        target, _ = models
+        ids = paddle.to_tensor(
+            np.random.default_rng(10).integers(0, 96, (1, 4)))
+        d1 = _model(1, 32, 11)
+        d2 = _model(1, 32, 12)
+        ref = target.generate(ids, max_new_tokens=4).numpy()
+        for d in (d1, d2):
+            out = target.generate(ids, max_new_tokens=4, draft_model=d,
+                                  speculative_k=2)
+            np.testing.assert_array_equal(out.numpy(), ref)
+        n_after_both = len(target._gen_cache)
+        for d in (d1, d2, d1, d2):
+            out = target.generate(ids, max_new_tokens=4, draft_model=d,
+                                  speculative_k=2)
+            np.testing.assert_array_equal(out.numpy(), ref)
+        # alternating again added no entries (each draft kept its own)
+        assert len(target._gen_cache) == n_after_both
+
+
 class TestSpeculativeComposition:
     def test_weight_only_quant_target(self, models):
         # wq-converted target + draft: the compiled program must thread

@@ -130,6 +130,36 @@ class TestSavedTensorsHooks:
         assert np.isfinite(lin.weight.grad.numpy()).all()
 
 
+class TestHooksNoSpuriousOffload:
+    """ADVICE r3 #1: a non-offloading pack (identity/logging) must NOT
+    force intermediates to host — only a pack returning a host ndarray
+    triggers the device→host swap."""
+
+    def test_identity_pack_keeps_device_arrays(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(lambda t: t,
+                                                 lambda p: p):
+            h = x * x          # intermediate
+            y = paddle.sum(h * x)
+        assert not isinstance(h._data, np.ndarray), \
+            "identity pack forced a host offload"
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0, 27.0])
+
+    def test_offload_pack_swaps_to_host(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: np.asarray(t._data), lambda p: p):
+            h = x * x
+            y = paddle.sum(h * x)
+        assert isinstance(h._data, np.ndarray), \
+            "host-offload pack left the device array live"
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0, 27.0])
+
+
 class TestLazyGuard:
     def test_deferred_then_materialized_on_forward(self):
         with paddle.LazyGuard():
