@@ -63,7 +63,14 @@ class ElasticManager:
     def members(self):
         now = time.time()
         out = []
-        for k in self.store.keys():
+        try:
+            # TCPStore filters server-side; each beat is O(heartbeat
+            # keys), not O(total store keys)
+            ks = self.store.keys("heartbeat/")
+        except TypeError:          # dict-like store without prefix arg
+            ks = [k for k in self.store.keys()
+                  if k.startswith("heartbeat/")]
+        for k in ks:
             if not k.startswith("heartbeat/"):
                 continue
             try:

@@ -73,7 +73,7 @@ def _shift_master(master, incarnation):
 
 def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
            max_restarts=0, elastic_level=0, run_mode="collective",
-           min_nodes=None, max_reforms=5):
+           min_nodes=None, max_reforms=5, start_nodes=None):
     """Spawn nnodes containers of `script` with the env protocol; watch &
     restart per elastic_level:
 
@@ -88,13 +88,14 @@ def launch(script, script_args=(), nnodes=1, master=None, log_dir="log",
 
     Scale-in/out signal: write the target world size to
     `{log_dir}/scale_to`; the watcher re-forms to any size within
-    [min_nodes, nnodes_at_launch·… max observed] bounds.
+    [min_nodes, nnodes]. `start_nodes` (default nnodes) starts the job
+    below its maximum so capacity arriving later can scale it OUT.
     """
     min_np = min_nodes if min_nodes is not None else \
         (1 if elastic_level >= 2 else nnodes)
     max_np = max(nnodes, min_np)
     incarnation = 0
-    cur_n = nnodes
+    cur_n = min(max(start_nodes or nnodes, min_np), max_np)
 
     def start_world(n, inc):
         cs = []
@@ -198,7 +199,11 @@ def main(argv=None):
         prog="python -m paddle_tpu.distributed.launch")
     p.add_argument("--nnodes", type=str, default="1",
                    help="world size N, or MIN:MAX for an elastic job "
-                        "(starts at MAX, may re-form down to MIN)")
+                        "(starts at MAX unless --start_nodes says "
+                        "otherwise; re-forms within [MIN, MAX])")
+    p.add_argument("--start_nodes", type=int, default=None,
+                   help="elastic: initial world size (< MAX leaves room "
+                        "to scale OUT via the scale_to signal)")
     p.add_argument("--master", type=str, default=None)
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=0)
@@ -221,7 +226,8 @@ def main(argv=None):
                   master=args.master, log_dir=args.log_dir,
                   max_restarts=args.max_restarts,
                   elastic_level=elastic_level,
-                  run_mode=args.run_mode, min_nodes=min_nodes)
+                  run_mode=args.run_mode, min_nodes=min_nodes,
+                  start_nodes=args.start_nodes)
 
 
 if __name__ == "__main__":

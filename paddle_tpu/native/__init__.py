@@ -97,6 +97,8 @@ class TCPStore:
             lib.pd_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.pd_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.pd_store_keys.argtypes = [ctypes.c_void_p]
+            lib.pd_store_keys_prefix.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p]
             lib.pd_store_fetch.argtypes = [ctypes.c_void_p,
                                            ctypes.c_char_p, ctypes.c_int]
             lib.pd_store_delete.argtypes = [ctypes.c_void_p,
@@ -162,8 +164,14 @@ class TCPStore:
     def delete(self, key: str):
         self.lib().pd_store_delete(self._client, key.encode())
 
-    def keys(self):
-        n = self.lib().pd_store_keys(self._client)
+    def keys(self, prefix: str = ""):
+        """List keys; `prefix` filters SERVER-side (the elastic
+        heartbeat scan stays O(matching keys), not O(total store))."""
+        if prefix:
+            n = self.lib().pd_store_keys_prefix(self._client,
+                                                prefix.encode())
+        else:
+            n = self.lib().pd_store_keys(self._client)
         if n < 0:
             raise RuntimeError("TCPStore.keys failed")
         raw = self._fetch(n).decode()

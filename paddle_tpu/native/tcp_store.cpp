@@ -206,10 +206,14 @@ class MasterDaemon {
           break;
         }
         case KEYS: {
+          // `key` carries an optional PREFIX: only matching keys are
+          // returned (empty = all). Server-side filtering keeps the
+          // elastic heartbeat scan O(matching), not O(total store).
           std::string joined;
           {
             std::lock_guard<std::mutex> g(mu_);
             for (auto& [k, _] : kv_) {
+              if (!key.empty() && k.rfind(key, 0) != 0) continue;
               joined += k;
               joined += '\n';
             }
@@ -321,10 +325,10 @@ class Client {
     return true;
   }
 
-  bool keys(std::string* out) {
+  bool keys(const std::string& prefix, std::string* out) {
     std::lock_guard<std::mutex> g(mu_);
     uint8_t op = KEYS;
-    if (!write_all(fd_, &op, 1) || !write_blob(fd_, "")) return false;
+    if (!write_all(fd_, &op, 1) || !write_blob(fd_, prefix)) return false;
     return read_blob(fd_, out);
   }
 
@@ -392,7 +396,13 @@ int pd_store_wait(void* h, const char* key) {
 }
 
 int pd_store_keys(void* h) {
-  if (!static_cast<Client*>(h)->keys(&g_last_result)) return -2;
+  if (!static_cast<Client*>(h)->keys("", &g_last_result)) return -2;
+  return static_cast<int>(g_last_result.size());
+}
+
+// prefix-filtered key listing (server-side) — empty prefix = all keys
+int pd_store_keys_prefix(void* h, const char* prefix) {
+  if (!static_cast<Client*>(h)->keys(prefix, &g_last_result)) return -2;
   return static_cast<int>(g_last_result.size());
 }
 

@@ -42,6 +42,24 @@ class TestNativeTCPStore:
         client.close()
         master.close()
 
+    def test_keys_prefix_filter(self):
+        """Round-4: keys(prefix) filters server-side — the elastic
+        heartbeat scan is O(matching), not O(total store keys)."""
+        from paddle_tpu.native import TCPStore
+        port = _free_port()
+        master = TCPStore(port=port, is_master=True)
+        client = TCPStore(port=port)
+        for i in range(8):
+            master.set(f"bulk/{i}", b"x")
+        master.set("heartbeat/a", b"1")
+        master.set("heartbeat/b", b"2")
+        assert sorted(client.keys("heartbeat/")) == \
+            ["heartbeat/a", "heartbeat/b"]
+        assert client.keys("nomatch/") == []
+        assert len(client.keys()) == 10
+        client.close()
+        master.close()
+
     def test_rendezvous_pattern(self):
         from paddle_tpu.native import TCPStore
         port = _free_port()

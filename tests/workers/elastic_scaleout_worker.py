@@ -1,0 +1,49 @@
+"""Elastic scale-out worker (round 4, VERDICT r3 item 8): the job starts
+at world size 1 (below its --nnodes max of 2); the worker signals new
+capacity by writing the target world size to the launcher's scale_to
+file; the launcher (elastic_level>=2) re-forms the job at world size 2
+with recomputed ranks and a bumped PADDLE_ELASTIC_RESTART, and every
+worker resumes from the checkpoint. Mirrors elastic_scalein_worker.py:
+no collectives — the launcher's membership behavior is the unit under
+test."""
+import json
+import os
+import sys
+import time
+
+OUT = sys.argv[1]
+LOG_DIR = sys.argv[2]
+TOTAL = 20
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+inc = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+assert 0 <= rank < world, (rank, world)
+
+ckpt = os.path.join(OUT, "state.json")
+state = {"step": 0}
+resumed = 0
+if inc > 0 and os.path.exists(ckpt):
+    state = json.load(open(ckpt))
+    resumed = state["step"]
+
+while state["step"] < TOTAL:
+    state["step"] += 1
+    if rank == 0:
+        tmp = ckpt + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, ckpt)  # atomic: SIGTERM must not corrupt it
+    if world == 1 and inc == 0 and state["step"] == 4:
+        # capacity arrived: ask the launcher to scale the job OUT
+        tmp = os.path.join(LOG_DIR, "scale_to.tmp")
+        with open(tmp, "w") as f:
+            f.write("2")
+        os.replace(tmp, os.path.join(LOG_DIR, "scale_to"))
+    time.sleep(0.3)
+
+if rank == 0:
+    with open(os.path.join(OUT, "scaleout_result.json"), "w") as f:
+        json.dump({"world": world, "incarnation": inc,
+                   "resumed_from": resumed,
+                   "final_step": state["step"]}, f)
