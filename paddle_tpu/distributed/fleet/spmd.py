@@ -58,6 +58,42 @@ def _add_sharding(spec, shape, sharding_degree, axis="sharding"):
     return None
 
 
+def _reshard_identity(a):
+    return a
+
+
+_reshard_jits: dict = {}
+
+
+def device_put_global(x, sharding):
+    """`jax.device_put` that also works when `sharding` spans
+    NON-addressable devices — the multi-controller regime (one process
+    per host, one global mesh; SURVEY §2.4). Contract: every process
+    passes the same host value (replicated-input SPMD); each contributes
+    its addressable shards via make_array_from_process_local_data.
+    Single-controller (fully addressable) takes the plain device_put
+    path unchanged."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array):
+        if x.sharding == sharding:
+            return x
+        if not x.is_fully_addressable:
+            # global → global reshard: route through a jitted identity
+            # (device_put cannot target non-addressable shardings);
+            # cached per target sharding so repeat reshards hit the
+            # jit cache instead of re-tracing
+            fn = _reshard_jits.get(sharding)
+            if fn is None:
+                fn = jax.jit(_reshard_identity, out_shardings=sharding)
+                _reshard_jits[sharding] = fn
+            return fn(x)
+        x = np.asarray(x)
+    else:
+        x = np.asarray(x)
+    return jax.make_array_from_process_local_data(sharding, x, x.shape)
+
+
 def param_spec(param, shape, stage, sharding_degree, mp_degree) -> P:
     """Decide the PartitionSpec for a parameter.
 
@@ -119,6 +155,12 @@ class SPMDTrainer:
                 getattr(st, "amp", False):
             amp_level = st.amp_configs.get("level", "O1")
         self.amp_level = amp_level
+        # multi-controller: the mesh spans devices owned by other
+        # processes (v5p-pod regime); arguments need explicit global
+        # placement before jit
+        self._multi_controller = any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.sharding_degree = ax.get("sharding", 1)
         self.mp_degree = ax.get("mp", 1)
@@ -157,12 +199,13 @@ class SPMDTrainer:
         ZeRO-3's 'parameters are sharded at rest' + TP weight layout."""
         for (n, p), spec in zip(self._train_named, self._pspecs):
             s = NamedSharding(self.mesh, spec)
-            p._data = jax.device_put(p._data, s)
+            p._data = device_put_global(p._data, s)
         for (n, p), spec in zip(self._frozen_named, self._fspecs):
-            p._data = jax.device_put(p._data, NamedSharding(self.mesh, spec))
+            p._data = device_put_global(p._data,
+                                        NamedSharding(self.mesh, spec))
         for n, b in self._buf_named:
-            b._data = jax.device_put(b._data,
-                                     NamedSharding(self.mesh, P()))
+            b._data = device_put_global(b._data,
+                                        NamedSharding(self.mesh, P()))
         self._placed = True
 
     def _state_sharding(self, pspec, arr_shape):
@@ -429,7 +472,7 @@ class SPMDTrainer:
             self._jits[do_update] = fn
         if self.k_steps > 1 and self._gacc is None:
             self._gacc = [
-                jax.device_put(
+                device_put_global(
                     jnp.zeros(p._data.shape, jnp.float32),
                     self._state_sharding(sp, tuple(p._data.shape)))
                 for (_, p), sp in zip(self._train_named, self._pspecs)]
@@ -437,6 +480,23 @@ class SPMDTrainer:
         if do_update:
             opt._step_count += 1
         key = _random.next_key()
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_i = jnp.asarray(opt._step_count, jnp.int32)
+        if self._multi_controller:
+            # every argument must be a GLOBAL array (jit cannot
+            # auto-place process-local arrays onto non-addressable
+            # shardings). After the first step every leaf already IS a
+            # correctly-sharded jit output, and device_put_global
+            # returns it untouched; single-controller skips this block
+            # entirely (the jit's in_shardings do the placement).
+            rep = NamedSharding(self.mesh, P())
+            states = [jax.tree.map(
+                lambda a, sp=sp: device_put_global(
+                    a, self._state_sharding(sp, a.shape)), st)
+                for st, sp in zip(states, self._pspecs)]
+            key = device_put_global(key, rep)
+            lr = device_put_global(lr, rep)
+            step_i = device_put_global(step_i, rep)
         def _batch_sharding(nd):
             if self.sep_degree > 1 and nd == 2:
                 return NamedSharding(self.mesh,
@@ -444,7 +504,7 @@ class SPMDTrainer:
             return NamedSharding(self.mesh, batch_spec(nd))
 
         batch_arrays = [
-            jax.device_put(t._data, _batch_sharding(t._data.ndim))
+            device_put_global(t._data, _batch_sharding(t._data.ndim))
             for t in inputs + labels]
         out = fn(
             key,
@@ -453,8 +513,8 @@ class SPMDTrainer:
             [b._data for _, b in self._buf_named],
             states,
             gacc,
-            jnp.asarray(opt.get_lr(), jnp.float32),
-            jnp.asarray(opt._step_count, jnp.int32),
+            lr,
+            step_i,
             *batch_arrays)
         if not do_update:
             loss_v, new_buf, new_gacc = out
