@@ -155,6 +155,16 @@ def main():
         "wall_s": round(dt, 3), "wall_quarter_s": round(dt_q, 3),
         "fixed_overhead_s_est":
             round(overhead, 3) if marginal else None,
+        # verify-round accounting → measured acceptance (spec mode):
+        # prefill yields token 1; R rounds yield the other new−1 at ≤k+1
+        # each ⇒ mean accepted per round = (new−1)/R − 1 of k proposed
+        # (generation.py: rounds == ceil((new−1)/(k+1)) at acceptance 1)
+        "spec_rounds": getattr(model, "_last_spec_rounds", None)
+            if spec_k else None,
+        "spec_acceptance": (round(
+            ((new - 1) / model._last_spec_rounds - 1) / spec_k, 3)
+            if spec_k and getattr(model, "_last_spec_rounds", None)
+            else None),
     }))
 
 

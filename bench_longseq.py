@@ -27,11 +27,16 @@ from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,  # noqa: E402
                                LlamaPretrainingCriterion, flops_per_token)
 
 on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+# remat-policy knob (VERDICT r3 item 2): PADDLE_TPU_RECOMPUTE_GRAN =
+# full (default) | full_attn (save flash outputs, skip their recompute)
+import os  # noqa: E402
+gran = os.environ.get("PADDLE_TPU_RECOMPUTE_GRAN", "full")
 if on_tpu:
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                       intermediate_size=5504, num_hidden_layers=8,
                       num_attention_heads=16,
                       max_position_embeddings=seq, recompute=recompute,
+                      recompute_granularity=gran,
                       fuse_linear_cross_entropy=fuse, dtype="bfloat16")
 else:
     seq = min(seq, 256)
@@ -39,6 +44,7 @@ else:
                       intermediate_size=256, num_hidden_layers=2,
                       num_attention_heads=4,
                       max_position_embeddings=seq, recompute=recompute,
+                      recompute_granularity=gran,
                       fuse_linear_cross_entropy=fuse)
 P.seed(0)
 model = LlamaForCausalLM(cfg)
@@ -66,6 +72,6 @@ dt = time.perf_counter() - t0
 tok_s = batch * seq * iters / dt
 mfu = tok_s * flops_per_token(cfg, seq) / detect_peak()[0]
 print(json.dumps({"batch": batch, "seq": seq, "recompute": recompute,
-                  "tpu": on_tpu,
+                  "recompute_gran": gran, "tpu": on_tpu,
                   "fuse_ce": fuse, "tok_s": round(tok_s, 1),
                   "mfu": round(mfu, 4), "loss": loss_val}))

@@ -399,6 +399,9 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         new = jax.numpy.asarray(arr).astype(t._data.dtype)
         if sharding is not None and hasattr(sharding, "mesh"):
-            new = jax.device_put(new, sharding)  # reshard to live layout
+            # reshard to the live layout; multi-controller meshes
+            # (non-addressable devices) ride the global-placement helper
+            from ..fleet.spmd import device_put_global
+            new = device_put_global(new, sharding)
         t._inplace_update(new)
     return missing

@@ -456,36 +456,37 @@ def _pipeline_train_step(pp: PipelineParallel, opt, inputs: Tensor,
                 f"divisible by dp×sharding={data_degree}; batch will be "
                 "REPLICATED across the data axes (data parallelism "
                 "disabled for this step)\n")
-    micro_in = jax.device_put(
+    from .spmd import device_put_global as _dpg
+    micro_in = _dpg(
         inputs._data.reshape((M, mb) + tuple(bshape[1:])), ns(mb_spec))
-    micro_lab = jax.device_put(
+    micro_lab = _dpg(
         labels._data.reshape((M, labels._data.shape[0] // M) +
                              tuple(labels._data.shape[1:])), ns(mb_spec))
 
-    put = lambda sh: (lambda x: jax.device_put(x, sh))
+    put = lambda sh: (lambda x: _dpg(x, sh))
     (loss_v, new_pre, new_post, new_blk, new_pre_st, new_post_st,
      new_blk_st) = fn(
-        jax.device_put(key, rep),
+        _dpg(key, rep),
         [put(sh)(p._data) for sh, (_, p) in zip(pre_sh, pre_named)],
         [put(sh)(p._data) for sh, (_, p) in zip(post_sh, post_named)],
         [put(sh)(a) for sh, a in zip(blk_sh, blk_stacked)],
         # states follow their param's spec (pp/sharding/TP dims) so
         # ZeRO-sharded embed/head moments never materialize whole
         [jax.tree.map(
-            lambda leaf, sp=sh.spec: jax.device_put(
+            lambda leaf, sp=sh.spec: _dpg(
                 leaf, ns(_prepost_state_spec(sp, leaf.shape))), st)
          for sh, st in zip(pre_sh, pre_states)],
         [jax.tree.map(
-            lambda leaf, sp=sh.spec: jax.device_put(
+            lambda leaf, sp=sh.spec: _dpg(
                 leaf, ns(_prepost_state_spec(sp, leaf.shape))), st)
          for sh, st in zip(post_sh, post_states)],
         [jax.tree.map(
-            lambda leaf, sp=sh.spec: jax.device_put(
+            lambda leaf, sp=sh.spec: _dpg(
                 leaf, ns(_pp_state_spec(sp, leaf.shape, zstage,
                                         sharding_degree))), st)
          for sh, st in zip(blk_sh, blk_state_list)],
-        jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), rep),
-        jax.device_put(jnp.asarray(opt._step_count, jnp.int32), rep),
+        _dpg(jnp.asarray(opt.get_lr(), jnp.float32), rep),
+        _dpg(jnp.asarray(opt._step_count, jnp.int32), rep),
         micro_in, micro_lab)
 
     for (n, p), arr in zip(pre_named, new_pre):

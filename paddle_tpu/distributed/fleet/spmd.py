@@ -62,7 +62,11 @@ def _reshard_identity(a):
     return a
 
 
+# bounded: elastic re-forms build fresh meshes whose old shardings can
+# never hit again — FIFO-evict so retired meshes/executables are not
+# pinned for the process lifetime
 _reshard_jits: dict = {}
+_RESHARD_CACHE_MAX = 8
 
 
 def device_put_global(x, sharding):
@@ -85,6 +89,8 @@ def device_put_global(x, sharding):
             # jit cache instead of re-tracing
             fn = _reshard_jits.get(sharding)
             if fn is None:
+                while len(_reshard_jits) >= _RESHARD_CACHE_MAX:
+                    _reshard_jits.pop(next(iter(_reshard_jits)))
                 fn = jax.jit(_reshard_identity, out_shardings=sharding)
                 _reshard_jits[sharding] = fn
             return fn(x)

@@ -192,6 +192,13 @@ class LlamaAttention(Layer):
                 out = _apply(_ref_attn_fn(causal, False), q, k, v,
                              name="attention_ref")
         out = out.reshape([b, s, nh * hd])
+        if self.cfg.recompute and self.training and \
+                self.cfg.recompute_granularity == "full_attn":
+            # tag for the save_only_these_names remat policy: backward
+            # reuses the attention output instead of re-running the
+            # flash forward (recompute.py::recompute granularity knob)
+            from ..distributed.fleet.recompute import mark_saveable
+            out = mark_saveable(out, "attn_out")
         out = self.o_proj(out)
         if cache is not None:
             return out, cache
@@ -252,14 +259,34 @@ class LlamaDecoderLayer(Layer):
                                                 cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def _block(self, x, position_ids=None, attn_mask=None):
-        h = x + self.self_attn(self.input_layernorm(x), position_ids,
-                               attn_mask)
+    def _block(self, x, position_ids=None, attn_mask=None, attn_fn=None):
+        """One canonical residual structure for every remat granularity
+        (attn_fn lets core_attn wrap JUST the attention in recompute
+        without duplicating the residual arithmetic)."""
+        if attn_fn is None:
+            def attn_fn(hn):
+                return self.self_attn(hn, position_ids, attn_mask)
+        h = x + attn_fn(self.input_layernorm(x))
         return h + self.mlp(self.post_attention_layernorm(h))
 
     def forward(self, x, position_ids=None, attn_mask=None):
         if self.cfg.recompute and self.training:
             from ..distributed.fleet.recompute import recompute
+            gran = self.cfg.recompute_granularity
+            if gran == "core_attn":
+                # reference parity (recompute_granularity="core_attn"):
+                # only the attention sublayer is recomputed; the MLP
+                # saves its activations normally
+                class _Attn(Layer):
+                    def __init__(s):
+                        super().__init__()
+                        s.inner = self.self_attn
+
+                    def forward(s, hn):
+                        return s.inner(hn, position_ids, attn_mask)
+                return self._block(
+                    x, position_ids, attn_mask,
+                    attn_fn=lambda hn: recompute(_Attn(), hn))
 
             class _Body(Layer):
                 def __init__(s):
@@ -268,7 +295,7 @@ class LlamaDecoderLayer(Layer):
 
                 def forward(s, h):
                     return s.inner._block(h, position_ids, attn_mask)
-            return recompute(_Body(), x)
+            return recompute(_Body(), x, granularity=gran)
         return self._block(x, position_ids, attn_mask)
 
     def forward_cached(self, x, k_buf, v_buf, offset):

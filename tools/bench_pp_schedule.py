@@ -1,0 +1,201 @@
+"""Measure the collective-scan pipeline schedule's wasted work vs the
+ideal 1F1B bubble (round 4, VERDICT r3 item 5).
+
+The design note (`fleet/pipeline.py::PipelineParallel.SCHEDULES`) claims
+the lockstep collective scan's compute-bubble fraction equals 1F1B's
+(S−1)/(M·V+S−1) and that zero-bubble collapses into 1F1B+VPP under
+lockstep SPMD. This script MEASURES that instead of asserting it:
+
+1. tick count — `jax.lax.scan` is instrumented during the real trace of
+   the compiled pipeline step; the recorded trip count is the schedule's
+   actual length (claim: exactly M·V + S − 1 ticks, every stage running
+   one chunk body per tick, live or garbage).
+2. wall time — the step is timed across M ∈ {2, 4, 8}; a linear fit
+   wall ≈ c + b·ticks validates that a garbage tick costs the same as a
+   live one (lockstep), so the wasted-WALL fraction equals the tick
+   bubble fraction b·(S−1)/wall.
+3. VPP — V=2 at M=S shows the (S−1)/(M·V+S−1) reduction.
+
+Run on the 8-device virtual CPU mesh:
+    python tools/bench_pp_schedule.py
+Prints one table row per (S, M, V) plus the fit per S.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as P  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.fleet import (DistributedStrategy, LayerDesc,  # noqa: E402
+                                          PipelineLayer)
+
+D = 512          # block width: make a tick's FLOPs dominate overheads
+BATCH_PER_MICRO = 4
+
+
+class Block(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc1 = nn.Linear(d, d)
+        self.fc2 = nn.Linear(d, d)
+
+    def forward(self, x):
+        return P.tanh(self.fc2(P.tanh(self.fc1(x)))) + x
+
+
+class Head(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = nn.Linear(d, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class Stem(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return P.tanh(self.fc(x))
+
+
+def _reset_fleet():
+    from paddle_tpu.distributed.fleet.fleet import _state
+    from paddle_tpu.distributed.fleet.topology import \
+        set_hybrid_communicate_group
+    _state.initialized = False
+    _state.strategy = None
+    _state.hcg = None
+    set_hybrid_communicate_group(None)
+
+
+class ScanRecorder:
+    """Record jax.lax.scan trip counts traced while active."""
+
+    def __init__(self):
+        self.lengths = []
+        self._orig = None
+
+    def __enter__(self):
+        self._orig = jax.lax.scan
+
+        def wrapped(f, init, xs=None, length=None, **kw):
+            n = length
+            if n is None and xs is not None:
+                n = jax.tree.leaves(xs)[0].shape[0]
+            self.lengths.append(int(n))
+            return self._orig(f, init, xs, length=length, **kw)
+
+        jax.lax.scan = wrapped
+        return self
+
+    def __exit__(self, *exc):
+        jax.lax.scan = self._orig
+        return False
+
+
+def run_case(S, M, V=1, reps=5, mse=None, nblocks=None):
+    _reset_fleet()
+    P.seed(0)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": S}
+    pc = {"accumulate_steps": M, "micro_batch_size": BATCH_PER_MICRO,
+          "schedule": "FThenB"}  # no remat: isolate SCHEDULE work
+    strategy.pipeline_configs = pc
+    fleet.init(is_collective=True, strategy=strategy)
+    if nblocks is None:
+        nblocks = S * V
+    pipe = PipelineLayer(
+        layers=[Stem(D)] + [LayerDesc(Block, D) for _ in range(nblocks)] +
+               [Head(D)],
+        num_stages=S, loss_fn=mse,
+        num_virtual_pipeline_stages=V)
+    opt = P.optimizer.SGD(0.01, parameters=pipe.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    model = fleet.distributed_model(pipe)
+    rng = np.random.default_rng(0)
+    x = P.to_tensor(rng.standard_normal(
+        (M * BATCH_PER_MICRO, D)).astype(np.float32))
+    y = P.to_tensor(rng.standard_normal(
+        (M * BATCH_PER_MICRO, 4)).astype(np.float32))
+
+    with ScanRecorder() as rec:
+        model.train_batch((x, y), opt)        # trace + compile
+    ticks = max(rec.lengths) if rec.lengths else -1
+
+    model.train_batch((x, y), opt)            # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        loss = model.train_batch((x, y), opt)
+    lv = float(loss.numpy())                  # dependent fetch
+    dt = (time.perf_counter() - t0) / reps
+    return ticks, dt, lv
+
+
+def main():
+    def mse(pred, lab):
+        return ((pred - lab) ** 2).mean()
+
+    rows = []
+    print(f"{'S':>2} {'M':>2} {'V':>2} {'ticks':>6} {'M·V+S-1':>8} "
+          f"{'bubble=(S-1)/ticks':>19} {'wall ms':>9}")
+    for S in (2, 4):
+        series = []
+        for M in (2, 4, 8):
+            ticks, dt, _ = run_case(S, M, mse=mse)
+            pred = S - 1 + M
+            bub = (S - 1) / ticks if ticks > 0 else float("nan")
+            print(f"{S:>2} {M:>2} {1:>2} {ticks:>6} {pred:>8} "
+                  f"{bub:>19.3f} {dt * 1e3:>9.1f}")
+            rows.append({"S": S, "M": M, "V": 1, "ticks": ticks,
+                         "predicted_ticks": pred, "wall_s": dt})
+            series.append((ticks, dt))
+        # linear fit wall = c + b·ticks over the M sweep
+        t = np.array([s[0] for s in series], float)
+        w = np.array([s[1] for s in series], float)
+        b, c = np.polyfit(t, w, 1)
+        r = np.corrcoef(t, w)[0, 1]
+        print(f"   S={S}: wall ≈ {c * 1e3:.1f} ms + {b * 1e3:.2f} ms/tick"
+              f"  (r={r:.4f}) → garbage tick ≈ live tick (lockstep)")
+        rows.append({"S": S, "fit_ms_per_tick": b * 1e3,
+                     "fit_intercept_ms": c * 1e3, "fit_r": r})
+    # VPP: SAME model (2S blocks) at V=1 (chunk = 2 blocks/tick) vs V=2
+    # (chunk = 1 block/tick, 2M·+S−1 ticks): per-tick work halves while
+    # ticks ~double, and the bubble drops (S-1)/(M+S-1) →
+    # (S-1)/(2M+S-1) as the design note predicts
+    for S in (2, 4):
+        M = S
+        t1, d1, _ = run_case(S, M, V=1, mse=mse, nblocks=2 * S)
+        t2, d2, _ = run_case(S, M, V=2, mse=mse, nblocks=2 * S)
+        print(f"VPP S={S} M={M} (same 2S-block model): "
+              f"V=1 ticks={t1} bubble={(S - 1) / t1:.3f} "
+              f"wall={d1 * 1e3:.1f}ms | "
+              f"V=2 ticks={t2} bubble={(S - 1) / t2:.3f} "
+              f"wall={d2 * 1e3:.1f}ms")
+        rows.append({"S": S, "M": M, "vpp": {"v1_ticks": t1,
+                                             "v2_ticks": t2,
+                                             "v1_wall_s": d1,
+                                             "v2_wall_s": d2}})
+    out = {"rows": rows}
+    with open(".bench_pp_schedule.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("written .bench_pp_schedule.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Real-draft speculative acceptance curve (round 4, VERDICT r3 item 6).
+
+Round 3 shipped token-exact speculative decoding but the only measured
+acceptance was the degenerate self-draft 1.0; the serving-speedup claim
+in FEASIBILITY.md was a model. This measures the real thing:
+
+- target: byte-level LLaMA (4 layers) trained on local text (the repo's
+  docs, same recipe as tools/eval_kv8_quality.py);
+- draft: 1-layer model trained on the SAME data (the practical
+  distill-from-corpus draft) — acceptance < 1;
+- for k in {1, 2, 4, 8}: greedy generate with/without the draft, record
+  verify rounds → measured acceptance, plus the marginal decode rate
+  (two-point measurement, relay/noise-proof) → measured speedup.
+
+CPU numbers stand in for the chip when the tunnel is down (wall ratios,
+not absolute rates, are the product here); the same script runs on TPU
+unchanged.
+
+Run: python tools/bench_spec_acceptance.py [--steps 300]
+Writes BENCH_spec_acceptance.json at the repo root.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import paddle_tpu as P  # noqa: E402
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from tools.eval_kv8_quality import corpus, train  # noqa: E402
+
+PROMPT = 64
+NEW = 256
+
+
+def build(layers, seed, maxpos):
+    cfg = LlamaConfig(vocab_size=256, hidden_size=256,
+                      intermediate_size=688, num_hidden_layers=layers,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=maxpos, dtype="float32")
+    P.seed(seed)
+    return LlamaForCausalLM(cfg)
+
+
+def marginal_rate(model, prompts, gen_kw, new=NEW):
+    """Two-point marginal decode rate (PERF.md protocol): extra tokens /
+    extra wall between a full and a quarter run, min of 2 samples."""
+    new_q = max(1, new // 4)
+    for warm_n in (new, new_q):
+        out = model.generate(P.to_tensor(prompts[0]),
+                             max_new_tokens=warm_n, **gen_kw)
+        out._data.block_until_ready()
+
+    def timed(n, ids):
+        best = float("inf")
+        for k in range(2):
+            x = P.to_tensor(ids[k])
+            t0 = time.perf_counter()
+            out = model.generate(x, max_new_tokens=n, **gen_kw)
+            int(np.asarray(out._data).sum())
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    dt_q = timed(new_q, prompts[1:3])
+    dt = timed(new, prompts[3:5])
+    if dt <= dt_q:
+        return None, dt
+    return prompts[0].shape[0] * (new - new_q) / (dt - dt_q), dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    train_arr, held = corpus()
+    maxpos = PROMPT + NEW + 16
+    target = build(4, 0, maxpos)
+    print("training target (4 layers)...", flush=True)
+    train(target, train_arr, args.steps)
+    target.eval()
+    draft = build(1, 1, maxpos)
+    print("training draft (1 layer, same data)...", flush=True)
+    train(draft, train_arr, args.steps)
+    draft.eval()
+
+    # prompts drawn from held-out text (the distribution that matters)
+    rng = np.random.default_rng(2)
+    prompts = []
+    for _ in range(8):
+        starts = rng.integers(0, len(held) - PROMPT, args.batch)
+        prompts.append(np.stack([held[s:s + PROMPT] for s in starts])
+                       .astype(np.int32))
+
+    base_rate, base_wall = marginal_rate(target, prompts, {})
+    print(f"vanilla greedy: marginal {base_rate and round(base_rate, 1)} "
+          f"tok/s wall {base_wall:.2f}s", flush=True)
+
+    rows = []
+    for k in (1, 2, 4, 8):
+        kw = dict(draft_model=draft, speculative_k=k)
+        rate, wall = marginal_rate(target, prompts, kw)
+        rounds = target._last_spec_rounds
+        # prefill yields token 1; R rounds yield the other NEW−1 tokens
+        acc = ((NEW - 1) / rounds - 1) / k if rounds else None
+        speedup = rate / base_rate if rate and base_rate else None
+        row = {"k": k, "rounds": rounds, "acceptance": acc,
+               "marginal_tok_s": rate and round(rate, 1),
+               "wall_s": round(wall, 2),
+               "speedup_vs_greedy": speedup and round(speedup, 2)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    out = {"metric": "speculative_acceptance_curve",
+           "target_layers": 4, "draft_layers": 1,
+           "train_steps": args.steps, "batch": args.batch,
+           "prompt": PROMPT, "new_tokens": NEW,
+           "backend": jax.default_backend(),
+           "greedy_marginal_tok_s": base_rate and round(base_rate, 1),
+           "rows": rows}
+    with open(os.path.join(REPO, "BENCH_spec_acceptance.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print("written BENCH_spec_acceptance.json")
+
+
+if __name__ == "__main__":
+    main()

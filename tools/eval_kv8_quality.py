@@ -1,0 +1,159 @@
+"""int8-KV quality row (round 4, VERDICT r3 item 9): task-level eval of
+the decode-path quantization — held-out perplexity under (a) bf16/f32 KV
+cache, (b) int8 KV cache, (c) int8 KV + weight-only int8 — beyond the
+95.8% greedy-token-agreement bound from round 3.
+
+Method: a small byte-level LLaMA is trained on local text (the repo's
+own docs — no network), then held-out NLL is computed TEACHER-FORCED
+THROUGH THE CACHED DECODE PATH (`_forward_cached` step by step), i.e.
+through exactly the cache layout + post-dot scale algebra the serving
+path uses (`generation.py::cached_attention`). The deltas between the
+three configs isolate what int8 KV / int8 weights do to generation-time
+quality.
+
+Run: python tools/eval_kv8_quality.py [--steps 300]
+Writes BENCH_kv8_quality.json at the repo root.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import paddle_tpu as P  # noqa: E402
+from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,  # noqa: E402
+                                     LlamaPretrainingCriterion)
+
+SEQ = 192
+BATCH = 8
+
+
+def corpus():
+    """Byte-level corpus from the repo's own markdown docs."""
+    txt = []
+    for pat in ("*.md", "docs/*.md"):
+        for path in sorted(glob.glob(os.path.join(REPO, pat))):
+            with open(path, "rb") as f:
+                txt.append(f.read())
+    data = b"\n\n".join(txt)
+    arr = np.frombuffer(data, np.uint8).astype(np.int32)
+    n_held = 16 * 1024
+    return arr[:-n_held], arr[-n_held:]
+
+
+def batches(arr, rng, n):
+    for _ in range(n):
+        starts = rng.integers(0, len(arr) - SEQ - 1, BATCH)
+        yield np.stack([arr[s:s + SEQ + 1] for s in starts])
+
+
+def train(model, arr, steps, lr=3e-3):
+    crit = LlamaPretrainingCriterion(model.cfg)
+    opt = P.optimizer.AdamW(lr, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for i, chunk in enumerate(batches(arr, rng, steps)):
+        ids = P.to_tensor(chunk[:, :-1])
+        labels = P.to_tensor(chunk[:, 1:])
+        logits = model(ids)
+        loss = crit(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if i % 50 == 0:
+            print(f"step {i}: loss {float(loss.numpy()):.3f} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+    return float(loss.numpy())
+
+
+def heldout_nll_cached(model, held, cache_dtype, n_seq=16):
+    """Teacher-forced NLL through the cached decode path (one token per
+    step — the exact serving layout, incl. int8 post-dot scales)."""
+    seqs = np.stack([held[i * SEQ:(i + 1) * SEQ + 1]
+                     for i in range(n_seq)])
+    ids = jnp.asarray(seqs[:, :-1])
+    tgt = seqs[:, 1:]
+    caches = model._init_caches(n_seq, SEQ, cache_dtype)
+    weights = [t._data for t in model._gen_state_tensors()]
+
+    def step(warrs, caches, tok, off):
+        saved = []
+        tensors = model._gen_state_tensors()
+        for t, w in zip(tensors, warrs):
+            saved.append(t._data)
+            t._data = w
+        try:
+            logits, caches = model._forward_cached(tok, caches, off)
+        finally:
+            for t, s in zip(tensors, saved):
+                t._data = s
+        return jax.nn.log_softmax(logits[:, -1].astype(jnp.float32),
+                                  -1), caches
+
+    jstep = jax.jit(step)
+    nll = np.zeros((n_seq,), np.float64)
+    for t in range(SEQ):
+        logp, caches = jstep(weights, caches, ids[:, t:t + 1],
+                             jnp.asarray(t))
+        lp = np.asarray(logp)
+        if t < SEQ - 1:
+            nll += -lp[np.arange(n_seq), tgt[:, t]]
+    tokens = n_seq * (SEQ - 1)
+    return float(nll.sum() / tokens)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    args = ap.parse_args()
+
+    train_arr, held = corpus()
+    print(f"corpus: {len(train_arr)} train bytes, {len(held)} held-out")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=256,
+                      intermediate_size=688, num_hidden_layers=4,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=SEQ + 8, dtype="float32")
+    P.seed(0)
+    model = LlamaForCausalLM(cfg)
+    final_loss = train(model, train_arr, args.steps)
+    model.eval()
+
+    nll_fp = heldout_nll_cached(model, held, None)
+    nll_kv8 = heldout_nll_cached(model, held, "int8")
+
+    from paddle_tpu.nn.quant import convert_to_weight_only
+    convert_to_weight_only(model, algo="weight_only_int8")
+    nll_wq = heldout_nll_cached(model, held, "int8")
+
+    row = {
+        "task": "heldout byte-level LM NLL via cached decode path",
+        "train_steps": args.steps, "train_loss": final_loss,
+        "config": {"hidden": 256, "layers": 4, "heads": 4, "kv_heads": 2,
+                   "seq": SEQ},
+        "nll_bf16_cache": nll_fp,
+        "nll_int8_kv": nll_kv8,
+        "nll_int8_kv_int8_weights": nll_wq,
+        "ppl_bf16_cache": float(np.exp(nll_fp)),
+        "ppl_int8_kv": float(np.exp(nll_kv8)),
+        "ppl_int8_kv_int8_weights": float(np.exp(nll_wq)),
+        "delta_nll_int8_kv": nll_kv8 - nll_fp,
+        "delta_nll_int8_kv_int8_weights": nll_wq - nll_fp,
+    }
+    print(json.dumps(row, indent=1))
+    with open(os.path.join(REPO, "BENCH_kv8_quality.json"), "w") as f:
+        json.dump(row, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

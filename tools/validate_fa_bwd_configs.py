@@ -1,0 +1,76 @@
+"""Interpret-mode validation of FA backward block configs at the EXACT
+long-seq bench shape (round 4, VERDICT r3 item 2; CLAUDE.md round-3b
+protocol: a small-shape smoke does NOT clear a bwd block config — the
+fa_bwd_bk256 config passed s=512 then hung Mosaic at s=1024 and killed
+the tunnel, incident #2).
+
+This validates NUMERICS of each candidate (block_q, block_k) at
+s=8192 / d=128 / causal / bf16 (the bench_longseq kernel shape; h=1
+stands in for h=16 — the grid's instance count scales with h but every
+per-instance tile shape, loop bound, and VMEM footprint is h-independent).
+Mosaic compile behavior is NOT covered here: each PASSING config still
+needs one detached on-chip smoke at the full bench shape before any
+sweep, with round artifacts banked first.
+
+Run: python tools/validate_fa_bwd_configs.py
+Writes .fa_bwd_configs.json (consumed by PERF.md round-4 table).
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.ops.pallas._fa_kernel import fa_backward, fa_forward  # noqa: E402
+from paddle_tpu.ops.pallas.flash_attention import _attention_ref  # noqa: E402
+
+S = 8192
+D = 128
+CONFIGS = [(128, 128), (256, 128), (128, 256), (256, 256), (512, 128)]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q, k, v, g = [jnp.asarray(rng.standard_normal(
+        (1, S, 1, D)).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+        for _ in range(4)]
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    print("reference grads (O(S²) XLA, once)...", flush=True)
+    _, vjp = jax.vjp(lambda a, b, c: _attention_ref(a, b, c, causal=True),
+                     qf, kf, vf)
+    rdq, rdk, rdv = vjp(g.astype(jnp.float32))
+    rows = []
+    for bq, bk in CONFIGS:
+        t0 = time.time()
+        out, lse = fa_forward(q, k, v, causal=True, interpret=True,
+                              return_lse=True)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=True,
+                                 interpret=True, block_q=bq, block_k=bk)
+        errs = {n: float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                         r.astype(jnp.float32))))
+                for n, a, r in (("dq", dq, rdq), ("dk", dk, rdk),
+                                ("dv", dv, rdv))}
+        ok = all(e < 0.12 for e in errs.values())  # bf16 @ s=8192 scale
+        row = {"block_q": bq, "block_k": bk, "errs": errs,
+               "numerics_ok": ok, "wall_s": round(time.time() - t0, 1),
+               "onchip_smoke": "PENDING (tunnel)"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    with open(os.path.join(REPO, ".fa_bwd_configs.json"), "w") as f:
+        json.dump({"shape": {"s": S, "d": D, "causal": True,
+                             "dtype": "bfloat16"}, "rows": rows}, f,
+                  indent=1)
+    print("written .fa_bwd_configs.json")
+
+
+if __name__ == "__main__":
+    main()
