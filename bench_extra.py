@@ -43,11 +43,13 @@ def bert_amp_o2(trace: bool = False):
                                    (batch, seq)).astype(np.int32))
     labels = P.to_tensor(rng.integers(0, 2, (batch,)).astype(np.int64))
 
-    m.train_batch([ids], [labels])  # compile
-    m.train_batch([ids], [labels])
-    jax.effects_barrier()
-
     if trace:
+        # the per-step program is only used for the trace capture —
+        # compile it only on that path (each compile is a round-trip
+        # through the fragile remote-compile service)
+        m.train_batch([ids], [labels])
+        m.train_batch([ids], [labels])
+        jax.effects_barrier()
         import os
         os.makedirs("traces", exist_ok=True)
         with jax.profiler.trace("traces/bert_amp_o2"):
@@ -55,14 +57,23 @@ def bert_amp_o2(trace: bool = False):
                 m.train_batch([ids], [labels])
             jax.effects_barrier()
 
-    # timed region ends fetching the last step's loss: on axon only a
-    # dependent fetch proves execution (PERF.md round-3 hygiene notes);
-    # steps differ via the updated params so no request is cache-served
+    # DEVICE LOOP (round 4): the per-step `train_batch` loop used
+    # through round 3 paid one axon dispatch+fetch round-trip PER STEP —
+    # at BERT's small step time the relay overhead dominated the wall
+    # and the "flat ~12% MFU" was measuring the relay, not the chip
+    # (PERF.md round-3: the device ran 255 ms steps inside a 24 s wall
+    # window during contention). One lax.scan program over all iters =
+    # one dispatch + one dependent fetch, same as bench.py.
+    ids_l = P.to_tensor(np.broadcast_to(
+        np.asarray(ids._data)[None], (iters,) + tuple(ids.shape)).copy())
+    lab_l = P.to_tensor(np.broadcast_to(
+        np.asarray(labels._data)[None],
+        (iters,) + tuple(labels.shape)).copy())
+    warm = m.train_batch_loop([ids_l], [lab_l])  # compile the loop
+    float(np.asarray(warm._data)[-1])  # drain warmup before timing
     t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = m.train_batch([ids], [labels])
-    loss = float(np.asarray(loss._data if hasattr(loss, "_data")
-                            else loss))
+    losses = m.train_batch_loop([ids_l], [lab_l])
+    loss = float(np.asarray(losses._data)[-1])  # dependent fetch
     dt = time.perf_counter() - t0
 
     tok_s = batch * seq * iters / dt

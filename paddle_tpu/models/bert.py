@@ -84,9 +84,17 @@ class BertLayer(Layer):
 
     def forward(self, x, attn_mask=None):
         b, s = x.shape[0], x.shape[1]
-        q = self.q(x).reshape([b, s, self.nh, self.hd])
-        k = self.k(x).reshape([b, s, self.nh, self.hd])
-        v = self.v(x).reshape([b, s, self.nh, self.hd])
+        # fused QKV: ONE [h, 3h] matmul instead of three [h, h] — at
+        # BERT-base width (768 = 6 MXU tiles) the wider N dimension
+        # (2304 = 18 tiles) feeds the systolic array better; the concat
+        # of the param views is a cheap fusion and keeps the reference
+        # q/k/v state_dict layout
+        qkv_w = P.concat([self.q.weight, self.k.weight, self.v.weight],
+                         axis=1)
+        qkv_b = P.concat([self.q.bias, self.k.bias, self.v.bias])
+        qkv = F.linear(x, qkv_w, qkv_b).reshape([b, s, 3, self.nh,
+                                                 self.hd])
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
         ctx = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_p,
             training=self.training)
