@@ -236,6 +236,14 @@ class PipelineParallel(Layer):
     #:   - "1F1B" (default): jax.checkpoint on the chunk body — backward
     #:     recomputes block internals from the per-tick carry, capping
     #:     the stash at the carry chain (the reference 1F1B memory cap).
+    #:
+    #: MEASURED (round 4, tools/bench_pp_schedule.py, PERF.md table):
+    #: the traced scan length is exactly M·V+S−1 in every measured
+    #: configuration (S=2,4 × M=2,4,8 at V=1; (S=2,M=2) and (S=4,M=4)
+    #: at V=2) and wall time is linear in ticks (r ≥ 0.985), so the
+    #: wasted-work fraction equals the ideal 1F1B bubble
+    #: (S−1)/(M·V+S−1) — e.g. S=4 M=4: 0.429, reduced to 0.273 by V=2
+    #: on the same model (wall 396.8 → 291.2 ms).
     SCHEDULES = ("1F1B", "FThenB")
 
     def __init__(self, layers: PipelineLayer, hcg, strategy):

@@ -161,12 +161,18 @@ class LlamaAttention(Layer):
                         "dropped); pack sequences or pad with causal "
                         "semantics instead")
                 from ..distributed.fleet.long_context import (
-                    ring_flash_attention, ulysses_attention)
+                    _sep_group, ring_flash_attention, ulysses_attention)
                 if nkv != nh:
-                    # GQA through the sep composition repeats KV to full
-                    # heads (the in-kernel GQA path does not yet compose
-                    # with the sep collectives' head/sequence layouts)
-                    k, v = _repeat_kv(k, v, nh // nkv)
+                    # GQA rides the sep composition NATIVELY (round 4):
+                    # ring rotates K/V whole (no head split — the kernel
+                    # handles GQA); Ulysses' alltoall splits each
+                    # tensor's own head count, so native KV heads work
+                    # whenever sep | nkv. Only the indivisible Ulysses
+                    # case still repeats (a G× K/V HBM cost).
+                    grp = _sep_group()
+                    if (self.cfg.context_parallel == "ulysses"
+                            and grp is not None and nkv % grp.nranks):
+                        k, v = _repeat_kv(k, v, nh // nkv)
                 cp = ring_flash_attention \
                     if self.cfg.context_parallel == "ring" \
                     else ulysses_attention
