@@ -1009,3 +1009,4 @@ def pad(x, pad_, mode="constant", value=0.0, data_format="NCHW", name=None):
 from .extended import *  # noqa: E402,F401,F403
 from .extended2 import *  # noqa: E402,F401,F403
 from .extended3 import *  # noqa: E402,F401,F403
+from .flash_attention import flashmask_attention  # noqa: E402,F401

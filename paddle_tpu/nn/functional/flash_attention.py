@@ -22,9 +22,10 @@ from ...core.autograd import apply
 from ...core.tensor import Tensor
 from ...ops._base import ensure_tensor
 from ...ops.pallas.flash_attention import flash_attention  # noqa: F401
+from ...ops.pallas.flash_attention import flashmask_attention  # noqa: F401
 
 __all__ = ["flash_attention", "flash_attn_unpadded",
-           "scaled_dot_product_attention"]
+           "flashmask_attention", "scaled_dot_product_attention"]
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,

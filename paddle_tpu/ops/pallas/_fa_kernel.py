@@ -82,18 +82,26 @@ def _stat_cols(stat, n):
     return jnp.tile(stat, (1, n // LANES))
 
 
-def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg):
+def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg,
+                   fm=None):
     """The one canonical masking preamble shared by all four kernels:
     apply causal (q0/k0 = absolute positions of the block's first row/
     column, `offset = sk - sq` shifts the diagonal), an additive mask
-    block, and segment-id matching (negative ids never match) to raw
-    scores s [bq, bk]. Keeping a single copy is what guarantees the
-    forward and both backward kernels mask identically."""
+    block, segment-id matching (negative ids never match), and the
+    FlashMask column bounds (`fm = (start, end)` [1, bk] int32: query
+    rows in [start_j, end_j) of key column j are masked — the O(S)
+    compact mask, SURVEY §5.7c) to raw scores s [bq, bk]. Keeping a
+    single copy is what guarantees the forward and both backward
+    kernels mask identically."""
     bq, bk = s.shape
-    if causal:
+    if causal or fm is not None:
         qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    if causal:
         kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         s = jnp.where(qpos + offset >= kpos, s, -jnp.inf)
+    if fm is not None:
+        mstart, mend = fm
+        s = jnp.where((qpos >= mstart) & (qpos < mend), -jnp.inf, s)
     if mask_blk is not None:
         s = s + mask_blk
     if qseg is not None:
@@ -180,7 +188,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
 
 def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                           block_q, block_k, n_kb, offset, has_mask,
-                          has_seg, want_lse):
+                          has_seg, has_fm, want_lse):
     """Streamed forward: grid = (B*H, n_qb, n_kb) with the online-softmax
     state (m, l, acc) in VMEM scratch persisted across the sequential
     innermost k axis — the same revisit-accumulation layout as the
@@ -195,6 +203,9 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
+    fms_ref = rest[i] if has_fm else None
+    fme_ref = rest[i + 1] if has_fm else None
+    i += 2 if has_fm else 0
     o_ref = rest[i]
     i += 1
     lse_ref = rest[i] if want_lse else None
@@ -220,7 +231,8 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             s, qi * block_q, kj * block_k, causal, offset,
             mask_ref[0] if has_mask else None,
             qseg_ref[0][:, :1] if has_seg else None,
-            kseg_ref[0] if has_seg else None)
+            kseg_ref[0] if has_seg else None,
+            fm=(fms_ref[0], fme_ref[0]) if has_fm else None)
         m_new, l_new, acc_new = _online_softmax_step(
             s, v, m_scr[:, :1], l_scr[:, :1], acc_scr[...])
         acc_scr[...] = acc_new
@@ -238,6 +250,14 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         ov = (jnp.max(qseg) >= jnp.min(kseg)) & \
              (jnp.min(qseg) <= jnp.max(kseg))
         live = ov if live is None else jnp.logical_and(live, ov)
+    if has_fm:
+        # block fully dead iff EVERY column masks the whole q block:
+        # start_j <= q0 and end_j >= q0 + bq for all j
+        q0 = qi * block_q
+        all_dead = (jnp.max(fms_ref[0]) <= q0) & \
+                   (jnp.min(fme_ref[0]) >= q0 + block_q)
+        alive = jnp.logical_not(all_dead)
+        live = alive if live is None else jnp.logical_and(live, alive)
     if live is None:
         compute()
     else:
@@ -279,14 +299,31 @@ def _seg_layouts(q_seg, kv_seg):
     return qs, ks
 
 
+def _fm_rows(fm, b, h):
+    """FlashMask column bounds [B|1, H|1, Sk] int32 →
+    ([MB·MH, 1, Sk], row_fn) — same head/batch broadcast contract as
+    `_mask_rows`."""
+    mb, mh = fm.shape[0], fm.shape[1]
+    rows = fm.astype(jnp.int32).reshape(mb * mh, 1, fm.shape[2])
+
+    def row_fn(bi, hi):
+        r = bi % mb if mb == 1 else bi
+        c = hi % mh if mh == 1 else hi
+        return (r if mb > 1 else 0) * mh + (c if mh > 1 else 0)
+    return rows, row_fn
+
+
 def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                block_k=None, interpret=False, return_lse=False, mask=None,
-               q_seg=None, kv_seg=None):
+               q_seg=None, kv_seg=None, fm_start=None, fm_end=None):
     """q: [B, Sq, H, D]; k/v: [B, Sk, Hkv, D] (Hkv | H → GQA in-kernel)
     → out [B, Sq, H, D] (+ lse [B*H, Sq, LANES]).
 
     mask: additive f32 [B|1, H|1, Sq, Sk]. q_seg/kv_seg: int32 [B, Sq] /
     [B, Sk] packed segment ids (negative ids never match → padding).
+    fm_start/fm_end: FlashMask column bounds [B|1, H|1, Sk] int32 —
+    query rows in [fm_start_j, fm_end_j) of key column j are masked; the
+    whole mask costs O(Sk) HBM instead of a dense O(Sq·Sk) slab.
 
     Two kernel layouts behind one entry:
       - `sq == sk` and no mask → `_fa_fwd_kernel` (full-seq K/V resident
@@ -314,7 +351,8 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
     vb = _bh(v, b, hkv, sk, d)
     has_mask = mask is not None
     has_seg = q_seg is not None
-    streamed = has_mask or sq != sk
+    has_fm = fm_start is not None
+    streamed = has_mask or has_fm or sq != sk
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
@@ -350,7 +388,8 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
         kernel = functools.partial(
             _fa_fwd_stream_kernel, scale=sc, causal=causal,
             block_q=block_q, block_k=block_k, n_kb=n_kb, offset=sk - sq,
-            has_mask=has_mask, has_seg=has_seg, want_lse=return_lse)
+            has_mask=has_mask, has_seg=has_seg, has_fm=has_fm,
+            want_lse=return_lse)
         grid = (b * h, sq // block_q, n_kb)
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
@@ -370,6 +409,14 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
             in_specs.append(pl.BlockSpec((1, 1, block_k),
                                          lambda i, j, t: (i // h, 0, t)))
             args.extend([qs, ks])
+        if has_fm:
+            fs_rows, fm_row = _fm_rows(fm_start, b, h)
+            fe_rows, _ = _fm_rows(fm_end, b, h)
+            fm_spec = pl.BlockSpec(
+                (1, 1, block_k),
+                lambda i, j, t: (fm_row(i // h, i % h), 0, t))
+            in_specs.extend([fm_spec, fm_spec])
+            args.extend([fs_rows, fe_rows])
         out_specs = [pl.BlockSpec((1, block_q, d),
                                   lambda i, j, t: (i, j, 0))]
         if return_lse:
@@ -398,7 +445,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, scale, causal, block_k, block_q, has_mask,
-                      has_seg, offset=0):
+                      has_seg, has_fm=False, offset=0):
     """grid = (B*H, n_qb, n_kb); dq block revisited across the innermost
     kb axis (index map drops it), accumulating in an f32 out ref — the
     VMEM-bounded layout: every operand block is O(block · D), nothing is
@@ -410,6 +457,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
+    fms_ref = rest[i] if has_fm else None
+    fme_ref = rest[i + 1] if has_fm else None
+    i += 2 if has_fm else 0
     dq_ref = rest[i]
 
     qi = pl.program_id(1)
@@ -433,7 +483,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _masked_scores(s, qi * bq, kj * bk, causal, offset,
                            mask_ref[0] if has_mask else None,
                            qseg_ref[0][:, :1] if has_seg else None,
-                           kseg_ref[0] if has_seg else None)
+                           kseg_ref[0] if has_seg else None,
+                           fm=(fms_ref[0], fme_ref[0]) if has_fm
+                           else None)
         p = jnp.exp(s - lse_t)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -453,7 +505,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        *rest, scale, causal, block_q, block_k, n_qb,
-                       has_mask, has_seg, offset=0):
+                       has_mask, has_seg, has_fm=False, offset=0):
     """grid = (B*Hkv, n_kb, G·n_qb); dk/dv blocks revisited across the
     innermost axis — which enumerates (query-head-in-group, q block) —
     accumulated in f32 out refs (same VMEM-bounded design as
@@ -465,6 +517,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
+    fms_ref = rest[i] if has_fm else None
+    fme_ref = rest[i + 1] if has_fm else None
+    i += 2 if has_fm else 0
     dk_ref = rest[i]
     dv_ref = rest[i + 1]
 
@@ -489,7 +544,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _masked_scores(s, qj * bq, ki * bk, causal, offset,
                            mask_ref[0] if has_mask else None,
                            qseg_ref[0][:, :1] if has_seg else None,
-                           kseg_ref[0] if has_seg else None)
+                           kseg_ref[0] if has_seg else None,
+                           fm=(fms_ref[0], fme_ref[0]) if has_fm
+                           else None)
         p = jnp.exp(s - _stat_cols(lse_ref[0], bk))       # [bq, bk]
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         # dv += p^T @ do   (contract over q rows — dim 0 on both)
@@ -513,7 +570,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
                 block_q=None, block_k=None, interpret=False, dlse=None,
-                mask=None, q_seg=None, kv_seg=None):
+                mask=None, q_seg=None, kv_seg=None, fm_start=None,
+                fm_end=None):
     """FlashAttention-2 backward. q,o,do: [B,S,H,D]; k,v: [B,S,Hkv,D];
     lse: [B*H,S,LANES].
 
@@ -554,10 +612,14 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
 
     has_mask = mask is not None
     has_seg = q_seg is not None
+    has_fm = fm_start is not None
     if has_mask:
         mrows, mrow_fn = _mask_rows(mask, b, h)
     if has_seg:
         qs, ks = _seg_layouts(q_seg, kv_seg)
+    if has_fm:
+        fs_rows, fm_row = _fm_rows(fm_start, b, h)
+        fe_rows, _ = _fm_rows(fm_end, b, h)
 
     n_qb = sq // block_q
     n_kb = sk // block_k
@@ -586,12 +648,18 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         in_specs.append(pl.BlockSpec((1, 1, block_k),
                                      lambda i, j, t: (i // h, 0, t)))
         args.extend([qs, ks])
+    if has_fm:
+        fm_spec = pl.BlockSpec(
+            (1, 1, block_k),
+            lambda i, j, t: (fm_row(i // h, i % h), 0, t))
+        in_specs.extend([fm_spec, fm_spec])
+        args.extend([fs_rows, fe_rows])
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
                           block_k=block_k, block_q=block_q,
                           has_mask=has_mask, has_seg=has_seg,
-                          offset=offset),
+                          has_fm=has_fm, offset=offset),
         out_shape=_sds((b * h, sq, d), jnp.float32, qb, kb, vb, dob, lse),
         grid=(b * h, n_qb, n_kb),
         in_specs=in_specs,
@@ -627,12 +695,19 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         in_specs2.append(pl.BlockSpec(
             (1, 1, block_k), lambda i, j, t: (i // hkv, 0, j)))
         args2.extend([qs, ks])
+    if has_fm:
+        fm_spec2 = pl.BlockSpec(
+            (1, 1, block_k),
+            lambda i, j, t: (fm_row(i // hkv,
+                                    (i % hkv) * g + t // n_qb), 0, j))
+        in_specs2.extend([fm_spec2, fm_spec2])
+        args2.extend([fs_rows, fe_rows])
 
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
                           block_q=block_q, block_k=block_k, n_qb=n_qb,
                           has_mask=has_mask, has_seg=has_seg,
-                          offset=offset),
+                          has_fm=has_fm, offset=offset),
         out_shape=[_sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,
                         lse),
                    _sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,

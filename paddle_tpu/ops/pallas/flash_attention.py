@@ -403,3 +403,142 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+# ---------------------------------------------------------------------------
+# FlashMask (SURVEY §5.7c): compact column-bound masks at O(Sk) memory.
+# Column j masks query rows [fm_start_j, fm_end_j) — the dense [Sq, Sk]
+# additive slab never exists; the kernels stream (start, end) per key
+# block and skip fully-dead blocks.
+
+
+def _fm_dense_mask(fm_start, fm_end, sq):
+    """Dense additive oracle for the column bounds ([B|1, H|1, Sk] →
+    [B|1, H|1, Sq, Sk] 0/-inf). Tests + fallback only."""
+    rows = jnp.arange(sq)[None, None, :, None]
+    dead = (rows >= fm_start[:, :, None, :]) & \
+           (rows < fm_end[:, :, None, :])
+    return jnp.where(dead, -jnp.inf, 0.0).astype(jnp.float32)
+
+
+def _fm_ref(q, k, v, fm_start, fm_end, causal, scale):
+    m = _fm_dense_mask(fm_start, fm_end, q.shape[1])
+    return _attention_ref(q, k, v, mask=m, causal=causal, scale=scale)
+
+
+def _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale, want_lse,
+                   site):
+    """One shared kernel-dispatch body for both fm entry points: returns
+    the kernel result or None after the standard counted fallback."""
+    if not _want_pallas():
+        return None
+    reason = _shape_reason(q.shape, k.shape)
+    if reason is None:
+        try:
+            from ._fa_kernel import fa_forward
+            res = fa_forward(q, k, v, causal=causal, scale=scale,
+                             return_lse=want_lse,
+                             interpret=_FORCE_INTERPRET,
+                             fm_start=fm_start, fm_end=fm_end)
+            _note_pallas()
+            return res
+        except Exception as e:
+            _fallback(site, e)
+    else:
+        _fallback(f"{site}: {reason}")
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_core_fm(q, k, v, fm_start, fm_end, causal, scale):
+    out = _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale,
+                         False, "flashmask_forward")
+    if out is not None:
+        return out
+    return _fm_ref(q, k, v, fm_start, fm_end, causal, scale)
+
+
+def _fm_fwd(q, k, v, fm_start, fm_end, causal, scale):
+    res = _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale,
+                         True, "flashmask_forward(train)")
+    if res is not None:
+        out, lse_l = res
+        return out, (q, k, v, out, lse_l, fm_start, fm_end)
+    out = _fm_ref(q, k, v, fm_start, fm_end, causal, scale)
+    return out, (q, k, v, None, None, fm_start, fm_end)
+
+
+def _fm_bwd(causal, scale, res, g):
+    q, k, v, out, lse_l, fm_start, fm_end = res
+    if lse_l is not None:
+        from ._fa_kernel import fa_backward
+        dq, dk, dv = fa_backward(q, k, v, out, lse_l, g, causal=causal,
+                                 scale=scale, interpret=_FORCE_INTERPRET,
+                                 fm_start=fm_start, fm_end=fm_end)
+    else:
+        _, vjp_fn = jax.vjp(
+            lambda q_, k_, v_: _fm_ref(q_, k_, v_, fm_start, fm_end,
+                                       causal, scale), q, k, v)
+        dq, dk, dv = vjp_fn(g)
+    return (dq, dk, dv, _int_zero(fm_start), _int_zero(fm_end))
+
+
+_flash_core_fm.defvjp(_fm_fwd, _fm_bwd)
+
+
+def _normalize_startend(startend_row_indices, sk):
+    """PaddleNLP FlashMask layout [B, H|1, Sk, C] int32 → (start, end)
+    [B, H|1, Sk]. C=1: rows [start_j, Sq) masked (the LT-start causal
+    document form); C=2: the [start_j, end_j) band."""
+    idx = startend_row_indices
+    if idx.ndim != 4 or idx.shape[2] != sk or idx.shape[3] not in (1, 2):
+        raise ValueError(
+            "startend_row_indices must be [B, H|1, Sk, 1|2] int32, got "
+            f"{tuple(idx.shape)}")
+    start = idx[..., 0].astype(jnp.int32)
+    if idx.shape[3] == 2:
+        end = idx[..., 1].astype(jnp.int32)
+    else:
+        end = jnp.full_like(start, jnp.iinfo(jnp.int32).max)
+    return start, end
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=True, window_size=None,
+                        return_softmax_lse=False, fixed_seed_offset=None,
+                        rng_name="", training=True, name=None):
+    """Reference-parity API: paddle.nn.functional.flashmask_attention —
+    attention with a COMPACT column-wise mask ([B, H|1, Sk, 1|2] int32
+    start/end query-row bounds per key column; O(Sk) memory) instead of
+    a dense [Sq, Sk] mask. Composes with causal."""
+    if window_size is not None:
+        raise NotImplementedError(
+            "flashmask_attention window_size: express sliding windows "
+            "via startend_row_indices (start = j + window + 1 bounds)")
+    q = query
+    k = key
+    v = value
+    sk = k.shape[1]
+    drop_p = dropout if training else 0.0
+    if startend_row_indices is None:
+        out = flash_attention_bshd(q, k, v, causal=causal,
+                                   dropout_p=drop_p)
+        return (out, None) if return_softmax_lse else out
+    raw = startend_row_indices._data \
+        if hasattr(startend_row_indices, "_data") else \
+        jnp.asarray(startend_row_indices)
+    fm_start, fm_end = _normalize_startend(raw, sk)
+    b, h = q.shape[0], q.shape[2]
+    if fm_start.shape[0] not in (1, b) or fm_start.shape[1] not in (1, h):
+        # reject BEFORE the kernel: an out-of-range BlockSpec row index
+        # would be silently clamped (wrong output, no error)
+        raise ValueError(
+            f"startend_row_indices batch/head dims "
+            f"{tuple(raw.shape[:2])} incompatible with q "
+            f"[B={b}, H={h}]")
+
+    def f(qa, ka, va):
+        return _flash_core_fm(qa, ka, va, fm_start, fm_end, causal, None)
+    out = apply(f, q, k, v, name="flashmask_attention")
+    out = _maybe_dropout(out, drop_p)
+    return (out, None) if return_softmax_lse else out

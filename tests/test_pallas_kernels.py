@@ -479,3 +479,99 @@ class TestKernelStreamedForward:
                             interpret=True, return_lse=True)
         assert np.allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
         assert np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+class TestFlashMask:
+    """Round-4 (SURVEY §5.7c): FlashMask — compact column-bound masks at
+    O(Sk) memory, streamed per key block with dead-block skip. Oracle =
+    the dense additive mask the bounds describe."""
+
+    def _bounds(self, b, sk, c, seed=0, alive_col0=True):
+        rng = np.random.default_rng(seed)
+        starts = rng.integers(1, sk, (b, 1, sk, 1)).astype(np.int32)
+        if alive_col0:
+            starts[:, :, 0, 0] = sk  # keep every causal row alive
+        if c == 1:
+            return starts
+        ends = starts + rng.integers(1, sk // 2, (b, 1, sk, 1))
+        return np.concatenate([starts, ends.astype(np.int32)], axis=-1)
+
+    def _dense(self, idx, sq):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _fm_dense_mask, _normalize_startend)
+        s, e = _normalize_startend(jnp.asarray(idx), idx.shape[2])
+        return _fm_dense_mask(s, e, sq)
+
+    @pytest.mark.parametrize("c", [1, 2])
+    def test_forward_parity(self, c):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _normalize_startend)
+        q, k, v = qkv(b=2, s=256, h=2, d=64)
+        idx = self._bounds(2, 256, c)
+        s_, e_ = _normalize_startend(jnp.asarray(idx), 256)
+        out = fa_forward(q, k, v, causal=True, interpret=True,
+                         fm_start=s_, fm_end=e_)
+        ref = _attention_ref(q, k, v, mask=self._dense(idx, 256),
+                             causal=True)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_backward_parity_band(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas._fa_kernel import fa_backward
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _normalize_startend)
+        q, k, v = qkv(b=1, s=256, h=4, d=64)      # GQA q heads
+        _, k, v = qkv(b=1, s=256, h=2, d=64, seed=5)
+        idx = self._bounds(1, 256, 2, seed=3)
+        s_, e_ = _normalize_startend(jnp.asarray(idx), 256)
+        g = jnp.asarray(np.random.default_rng(7).standard_normal(
+            q.shape).astype(np.float32))
+        out, lse = fa_forward(q, k, v, causal=True, interpret=True,
+                              return_lse=True, fm_start=s_, fm_end=e_)
+        dq, dk, dv = fa_backward(q, k, v, out, lse, g, causal=True,
+                                 interpret=True, fm_start=s_, fm_end=e_)
+        m = self._dense(idx, 256)
+        _, vjp = jax.vjp(lambda a, b_, c_: _attention_ref(
+            a, b_, c_, mask=m, causal=True), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        for got, ref, name in [(dq, rdq, "dq"), (dk, rdk, "dk"),
+                               (dv, rdv, "dv")]:
+            assert np.allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-3), \
+                (name, np.abs(np.asarray(got) - np.asarray(ref)).max())
+
+    def test_public_api_dispatch_and_grad(self, monkeypatch):
+        import paddle_tpu as P
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        rng = np.random.default_rng(1)
+        q = P.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                        .astype(np.float32), stop_gradient=False)
+        k = P.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                        .astype(np.float32), stop_gradient=False)
+        v = P.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                        .astype(np.float32), stop_gradient=False)
+        idx = P.to_tensor(self._bounds(1, 256, 1, seed=2))
+        out = P.nn.functional.flashmask_attention(
+            q, k, v, startend_row_indices=idx, causal=True)
+        stats = fa.dispatch_stats()
+        assert stats["pallas"] == 1 and stats["fallback"] == 0, stats
+        out.sum().backward()
+        for t in (q, k, v):
+            assert t.grad is not None
+            assert np.isfinite(np.asarray(t.grad._data)).all()
+
+    def test_fully_masked_rows_zero(self):
+        """A row masked in every live column outputs exactly 0 (and the
+        kernel never NaNs — the dense-oracle vjp would)."""
+        import jax.numpy as jnp
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        s_ = jnp.zeros((1, 1, 256), jnp.int32)       # all rows masked
+        e_ = jnp.full((1, 1, 256), 2 ** 31 - 1, jnp.int32)
+        out = fa_forward(q, k, v, causal=True, interpret=True,
+                         fm_start=s_, fm_end=e_)
+        assert np.allclose(np.asarray(out), 0.0)

@@ -231,6 +231,25 @@ errs["mask_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
                                              y.astype(jnp.float32))))
                        for x, y in zip((dq3, dk3, dv3), r3))
 
+# FlashMask column bounds (round-4): fwd + bwd through the compact-mask
+# refs — first on-chip compile of the (1, 1, block_k) int32 bound specs
+fms = jnp.asarray(np.where(np.arange(s) % 3 == 0, s // 2, s)[None, None]
+                  .astype(np.int32))
+fme = jnp.full((1, 1, s), 2 ** 31 - 1, jnp.int32)
+out_fm, lse_fm = fa_forward(qf, kf, vf, causal=True, return_lse=True,
+                            fm_start=fms, fm_end=fme)
+from paddle_tpu.ops.pallas.flash_attention import _fm_dense_mask
+mdense = _fm_dense_mask(fms, fme, s)
+ref_fm = _attention_ref(qf, kf, vf, mask=mdense, causal=True)
+errs["flashmask_fwd"] = float(jnp.max(jnp.abs(
+    out_fm.astype(jnp.float32) - ref_fm.astype(jnp.float32))))
+dqf, dkf, dvf = fa_backward(qf, kf, vf, out_fm, lse_fm, gf, causal=True,
+                            fm_start=fms, fm_end=fme)
+errs["flashmask_bwd_finite"] = float(
+    jnp.isfinite(dqf.astype(jnp.float32)).all() &
+    jnp.isfinite(dkf.astype(jnp.float32)).all() &
+    jnp.isfinite(dvf.astype(jnp.float32)).all())
+
 # cross-length (sq != sk) causal + GQA: rectangular grid, fwd + bwd
 # (round-4 — the first on-chip compile of the sq != sk shape class)
 sq2 = s // 2
@@ -264,5 +283,7 @@ class TestOnChipKernelExtensions:
         assert r["seg_bwd"] < 1e-1, r
         assert r["mask_fwd"] < 5e-2, r
         assert r["mask_bwd"] < 1e-1, r
+        assert r["flashmask_fwd"] < 5e-2, r
+        assert r["flashmask_bwd_finite"] == 1.0, r
         assert r["xlen_fwd"] < 5e-2, r
         assert r["xlen_bwd"] < 1e-1, r

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 chip capture list (VERDICT r3 item 1), in the prescribed order.
+# Run DETACHED on a healthy tunnel with a QUIET VM:
+#   setsid bash tools/chip_capture_r4.sh > .bench_r4/capture.log 2>&1 &
+# then poll the log. NEVER SIGTERM a step mid-compile (CLAUDE.md chip
+# hygiene: that wedges the grant / can kill the remote compile service).
+# Each step is wedge-proofed by its own tunnel probe; if the tunnel dies
+# mid-list the remaining steps CPU-fallback and say so in their JSON.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+
+stamp() { date -u +%H:%M:%S; }
+run() {
+  echo "=== $(stamp) $*"
+  "$@"
+  echo "=== $(stamp) rc=$?"
+}
+
+# 1. kernel parity on-chip — first run of the round-4 masked-bwd +
+#    cross-length shapes on real hardware
+run env PADDLE_TPU_CHIP_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
+
+# 2. headline MFU (driver metric)
+run python bench.py
+cp -f BENCH_extra.json .bench_r4/ 2>/dev/null || true
+
+# 3. long-seq row, then the remat-policy lever on the same shape
+run python bench_longseq.py 1 8192
+run env PADDLE_TPU_RECOMPUTE_GRAN=full_attn python bench_longseq.py 1 8192
+
+# 4. decode: int8 KV + weight-only int8 (the round-3b capture re-run)
+run python bench_generate.py 8 128 512 --kv int8 --wq int8
+
+# 5. speculative serving capture (FEASIBILITY one-command) — now records
+#    measured acceptance
+run python bench_generate.py 1 128 512 --spec 4 --wq int8 --kv int8
+
+# 6. BERT AMP-O2 via the device loop (first non-relay-dominated number)
+run python bench_extra.py
+
+echo "=== $(stamp) capture list complete"
