@@ -511,14 +511,37 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     attention with a COMPACT column-wise mask ([B, H|1, Sk, 1|2] int32
     start/end query-row bounds per key column; O(Sk) memory) instead of
     a dense [Sq, Sk] mask. Composes with causal."""
-    if window_size is not None:
-        raise NotImplementedError(
-            "flashmask_attention window_size: express sliding windows "
-            "via startend_row_indices (start = j + window + 1 bounds)")
     q = query
     k = key
     v = value
     sk = k.shape[1]
+    if window_size is not None:
+        # sliding-window causal attention IS an LT-start bound: key
+        # column j is visible to query rows [j, j+w], i.e. rows
+        # >= j+w+1 masked — O(Sk) bounds, no dense mask
+        if startend_row_indices is not None:
+            raise NotImplementedError(
+                "flashmask_attention: window_size combined with "
+                "startend_row_indices is not supported — fold the "
+                "window into the start bounds (min(start_j, j+w+1))")
+        if not causal:
+            raise NotImplementedError(
+                "flashmask_attention window_size requires causal=True "
+                "(the reference's sliding-window form)")
+        w = window_size[0] if isinstance(window_size, (tuple, list)) \
+            else int(window_size)
+        if w < 0:
+            # reference sentinel: -1 / (-1, -1) = window disabled
+            window_size = None
+        else:
+            # bottom-right-aligned coordinates (the rectangular-grid
+            # causal convention, offset = sk - sq): key j is visible to
+            # query row i iff i + offset - w <= j <= i + offset, so
+            # column j masks rows >= j + w + 1 - offset
+            offset = sk - q.shape[1]
+            startend_row_indices = jnp.maximum(
+                jnp.arange(sk, dtype=jnp.int32) + w + 1 - offset, 0
+            )[None, None, :, None]
     drop_p = dropout if training else 0.0
     if startend_row_indices is None:
         out = flash_attention_bshd(q, k, v, causal=causal,

@@ -565,6 +565,65 @@ class TestFlashMask:
             assert t.grad is not None
             assert np.isfinite(np.asarray(t.grad._data)).all()
 
+    def test_sliding_window_via_bounds(self, monkeypatch):
+        """window_size=w == dense band mask: row i attends [i-w, i]."""
+        import paddle_tpu as P
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        rng = np.random.default_rng(9)
+        qn = rng.standard_normal((1, 256, 2, 64)).astype(np.float32)
+        kn = rng.standard_normal((1, 256, 2, 64)).astype(np.float32)
+        vn = rng.standard_normal((1, 256, 2, 64)).astype(np.float32)
+        w = 17
+        out = P.nn.functional.flashmask_attention(
+            P.to_tensor(qn), P.to_tensor(kn), P.to_tensor(vn),
+            window_size=w, causal=True)
+        i = np.arange(256)[:, None]
+        j = np.arange(256)[None, :]
+        band = (j <= i) & (j >= i - w)
+        m = jnp.asarray(np.where(band, 0.0, -np.inf)[None, None]
+                        .astype(np.float32))
+        ref = _attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                             jnp.asarray(vn), mask=m)
+        assert np.allclose(np.asarray(out._data), np.asarray(ref),
+                           atol=2e-4)
+
+    def test_sliding_window_cross_length_and_sentinel(self, monkeypatch):
+        """Chunked-prefill shape (sq < sk): the window is bottom-right
+        aligned (row i ~ absolute position i + sk - sq); window_size=-1
+        is the reference 'disabled' sentinel (plain causal)."""
+        import paddle_tpu as P
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        rng = np.random.default_rng(13)
+        sq, sk, w = 128, 512, 17
+        qn = rng.standard_normal((1, sq, 2, 64)).astype(np.float32)
+        kn = rng.standard_normal((1, sk, 2, 64)).astype(np.float32)
+        vn = rng.standard_normal((1, sk, 2, 64)).astype(np.float32)
+        out = P.nn.functional.flashmask_attention(
+            P.to_tensor(qn), P.to_tensor(kn), P.to_tensor(vn),
+            window_size=w, causal=True)
+        off = sk - sq
+        i = np.arange(sq)[:, None] + off      # absolute positions
+        j = np.arange(sk)[None, :]
+        band = (j <= i) & (j >= i - w)
+        m = jnp.asarray(np.where(band, 0.0, -np.inf)[None, None]
+                        .astype(np.float32))
+        ref = _attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                             jnp.asarray(vn), mask=m)
+        assert np.allclose(np.asarray(out._data), np.asarray(ref),
+                           atol=2e-4)
+        # sentinel: -1 == no window == plain causal
+        out2 = P.nn.functional.flashmask_attention(
+            P.to_tensor(qn), P.to_tensor(kn), P.to_tensor(vn),
+            window_size=(-1, -1), causal=True)
+        ref2 = _attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                              jnp.asarray(vn), causal=True)
+        assert np.allclose(np.asarray(out2._data), np.asarray(ref2),
+                           atol=2e-4)
+
     def test_fully_masked_rows_zero(self):
         """A row masked in every live column outputs exactly 0 (and the
         kernel never NaNs — the dense-oracle vjp would)."""
