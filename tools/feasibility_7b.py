@@ -26,6 +26,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--sep", type=int, default=1,
+                    help="context-parallel degree (Ulysses on the flash "
+                         "core) — the 7B LONG-CONTEXT layout")
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--json-out", default=None)
@@ -58,7 +61,7 @@ def main():
                                    LlamaPretrainingCriterion,
                                    flops_per_token)
 
-    sharding_degree = args.devices // args.mp
+    sharding_degree = args.devices // (args.mp * args.sep)
     # global batch must divide the data axes (dp × sharding)
     if args.batch % sharding_degree != 0:
         args.batch = sharding_degree
@@ -66,6 +69,9 @@ def main():
     hc = {"sharding_degree": sharding_degree}
     if args.mp > 1:
         hc["mp_degree"] = args.mp
+    if args.sep > 1:
+        hc["sep_degree"] = args.sep
+        assert args.seq % args.sep == 0
     strategy.hybrid_configs = hc
     strategy.sharding = True
     strategy.sharding_configs = {"stage": 3}
@@ -77,9 +83,14 @@ def main():
     cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
                       intermediate_size=11008, num_hidden_layers=32,
                       num_attention_heads=32,
-                      max_position_embeddings=args.seq,
-                      recompute=True, fuse_linear_cross_entropy=True,
-                      tensor_parallel=args.mp > 1, dtype="bfloat16")
+                      max_position_embeddings=args.seq, recompute=True,
+                      # the sep trainer computes its own sharded token
+                      # CE (globally shifted labels) — fused CE is the
+                      # single-controller head-side variant
+                      fuse_linear_cross_entropy=args.sep == 1,
+                      tensor_parallel=args.mp > 1,
+                      context_parallel="ulysses" if args.sep > 1
+                      else None, dtype="bfloat16")
     P.seed(0)
     print(f"building 7B model on host ({args.devices} virtual devices, "
           f"mp={args.mp}, sharding={sharding_degree})...", flush=True)
@@ -162,6 +173,7 @@ def main():
     rec = {
         "devices": args.devices,
         "mp": args.mp,
+        "sep": args.sep,
         "sharding_degree": sharding_degree,
         "seq": args.seq,
         "batch_per_step": args.batch,
