@@ -1,85 +1,25 @@
-"""Probe: AOT-compile the sep (Ulysses context-parallel) ZeRO-3 stepper
-at a given (hidden, layers, seq, recompute, dtype) on the 8-dev virtual
-CPU mesh. Used to bisect an XLA CPU-backend 'Invalid binary instruction
-opcode copy' check failure seen at 0.5B/7B scale (round 4); the TPU
-backend does not share the CPU emitter. Usage:
+"""Thin wrapper kept for the FEASIBILITY.md round-4 citations: the sep
+compile bisect now lives in feasibility_7b.py's --hidden/--layers/
+--dtype/--no-recompute flags (one maintained call site for the fragile
+SPMDTrainer._build/lower coupling).
+
     python tools/_sep_compile_probe.py SEQ HIDDEN LAYERS RECOMPUTE DTYPE
+==  python tools/feasibility_7b.py --devices 8 --sep 4 --seq SEQ
+        --hidden HIDDEN --layers LAYERS [--no-recompute] --dtype DTYPE
 """
-import os
 import sys
 
-os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
-                           + os.environ.get("XLA_FLAGS", ""))
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-from bench import force_cpu  # noqa: E402
+from feasibility_7b import main  # noqa: E402  (same directory)
 
-force_cpu()
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass
-
-import paddle_tpu as P  # noqa: E402
-from paddle_tpu.distributed import fleet  # noqa: E402
-from paddle_tpu.distributed.fleet import DistributedStrategy  # noqa: E402
-from paddle_tpu.distributed.fleet.spmd import SPMDTrainer  # noqa: E402
-from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
-
-SEQ = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-HID = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-LAY = int(sys.argv[3]) if len(sys.argv) > 3 else 8
-REC = (sys.argv[4] != "0") if len(sys.argv) > 4 else True
-DT = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
-
-strategy = DistributedStrategy()
-strategy.hybrid_configs = {"sharding_degree": 2, "sep_degree": 4}
-strategy.sharding = True
-strategy.sharding_configs = {"stage": 3}
-fleet.init(is_collective=True, strategy=strategy)
-from paddle_tpu.distributed.fleet.fleet import _state  # noqa: E402
-
-mesh = _state.hcg.mesh
-cfg = LlamaConfig(vocab_size=32000, hidden_size=HID,
-                  intermediate_size=HID * 11 // 4 // 16 * 16,
-                  num_hidden_layers=LAY,
-                  num_attention_heads=max(1, HID // 128),
-                  max_position_embeddings=SEQ, recompute=REC,
-                  context_parallel="ulysses", dtype=DT)
-P.seed(0)
-model = LlamaForCausalLM(cfg)
-if DT == "bfloat16":
-    model.to(dtype="bfloat16")
-opt = P.optimizer.AdamW(1e-4, parameters=model.parameters(),
-                        multi_precision=True)
-tr = SPMDTrainer(model, opt, None, mesh, strategy)
-states_abs = [{"moment1": jax.ShapeDtypeStruct(tuple(p.shape),
-                                               jnp.float32),
-               "moment2": jax.ShapeDtypeStruct(tuple(p.shape),
-                                               jnp.float32),
-               "master": jax.ShapeDtypeStruct(tuple(p.shape),
-                                              jnp.float32)}
-              for _, p in tr._train_named]
-batch_sds = jax.ShapeDtypeStruct((2, SEQ), jnp.int32)
-fn = tr._build(1, 1, (states_abs, [2, 2]), do_update=True)
-pdt = jnp.bfloat16 if DT == "bfloat16" else jnp.float32
-print(f"lowering sep probe seq={SEQ} h={HID} L={LAY} rec={REC} "
-      f"{DT}...", flush=True)
-lowered = fn.lower(
-    jax.ShapeDtypeStruct((2,), jnp.uint32),
-    [jax.ShapeDtypeStruct(tuple(p.shape), pdt)
-     for _, p in tr._train_named],
-    [jax.ShapeDtypeStruct(tuple(p.shape), pdt)
-     for _, p in tr._frozen_named],
-    [jax.ShapeDtypeStruct(tuple(b.shape), b._data.dtype)
-     for _, b in tr._buf_named],
-    states_abs, [],
-    jax.ShapeDtypeStruct((), jnp.float32),
-    jax.ShapeDtypeStruct((), jnp.int32),
-    batch_sds, batch_sds)
-print("lowering OK; compiling...", flush=True)
-lowered.compile()
-print("COMPILED OK")
+if __name__ == "__main__":
+    seq = sys.argv[1] if len(sys.argv) > 1 else "2048"
+    hid = sys.argv[2] if len(sys.argv) > 2 else "2048"
+    lay = sys.argv[3] if len(sys.argv) > 3 else "8"
+    rec = sys.argv[4] if len(sys.argv) > 4 else "1"
+    dt = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
+    argv = ["--devices", "8", "--sep", "4", "--seq", seq,
+            "--hidden", hid, "--layers", lay, "--dtype", dt]
+    if rec == "0":
+        argv.append("--no-recompute")
+    sys.argv = [sys.argv[0]] + argv
+    main()

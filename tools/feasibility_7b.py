@@ -31,8 +31,20 @@ def main():
                          "core) — the 7B LONG-CONTEXT layout")
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--hidden", type=int, default=4096)
+    ap.add_argument("--layers", type=int, default=32)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"))
+    ap.add_argument("--no-recompute", action="store_true")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.mp * args.sep > args.devices or \
+            args.devices % (args.mp * args.sep):
+        ap.error(f"--devices {args.devices} must be a multiple of "
+                 f"mp*sep = {args.mp * args.sep}")
+    if args.seq % max(args.sep, 1):
+        ap.error(f"--seq {args.seq} must be divisible by --sep "
+                 f"{args.sep}")
 
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={args.devices} "
@@ -71,7 +83,6 @@ def main():
         hc["mp_degree"] = args.mp
     if args.sep > 1:
         hc["sep_degree"] = args.sep
-        assert args.seq % args.sep == 0
     strategy.hybrid_configs = hc
     strategy.sharding = True
     strategy.sharding_configs = {"stage": 3}
@@ -79,23 +90,29 @@ def main():
     from paddle_tpu.distributed.fleet.fleet import _state
     mesh = _state.hcg.mesh
 
-    # the REAL LLaMA-2-7B architecture; bf16 params, remat, fused CE
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
-                      intermediate_size=11008, num_hidden_layers=32,
-                      num_attention_heads=32,
-                      max_position_embeddings=args.seq, recompute=True,
+    # default: the REAL LLaMA-2-7B architecture (--hidden/--layers
+    # shrink it for compile-bisect probes); bf16 params, remat, fused CE
+    hid = args.hidden
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=hid,
+                      intermediate_size=(11008 if hid == 4096 else
+                                         hid * 11 // 4 // 16 * 16),
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=max(1, hid // 128),
+                      max_position_embeddings=args.seq,
+                      recompute=not args.no_recompute,
                       # the sep trainer computes its own sharded token
                       # CE (globally shifted labels) — fused CE is the
                       # single-controller head-side variant
                       fuse_linear_cross_entropy=args.sep == 1,
                       tensor_parallel=args.mp > 1,
                       context_parallel="ulysses" if args.sep > 1
-                      else None, dtype="bfloat16")
+                      else None, dtype=args.dtype)
     P.seed(0)
     print(f"building 7B model on host ({args.devices} virtual devices, "
           f"mp={args.mp}, sharding={sharding_degree})...", flush=True)
     model = LlamaForCausalLM(cfg)
-    model.to(dtype="bfloat16")
+    if args.dtype == "bfloat16":
+        model.to(dtype="bfloat16")
     crit = LlamaPretrainingCriterion(cfg)
     if cfg.fuse_linear_cross_entropy:
         crit.bind(model)
@@ -143,11 +160,12 @@ def main():
     batch_sds = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32)
     fn = trainer._build(1, 1, (states_abs, [2, 2]), do_update=True)
     key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    pdt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     lowered = fn.lower(
         key,
-        [jax.ShapeDtypeStruct(tuple(p.shape), jnp.bfloat16)
+        [jax.ShapeDtypeStruct(tuple(p.shape), pdt)
          for _, p in trainer._train_named],
-        [jax.ShapeDtypeStruct(tuple(p.shape), jnp.bfloat16)
+        [jax.ShapeDtypeStruct(tuple(p.shape), pdt)
          for _, p in trainer._frozen_named],
         [jax.ShapeDtypeStruct(tuple(b.shape), b._data.dtype)
          for _, b in trainer._buf_named],
