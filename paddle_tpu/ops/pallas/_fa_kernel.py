@@ -88,9 +88,10 @@ def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg,
     apply causal (q0/k0 = absolute positions of the block's first row/
     column, `offset = sk - sq` shifts the diagonal), an additive mask
     block, segment-id matching (negative ids never match), and the
-    FlashMask column bounds (`fm = (start, end)` [1, bk] int32: query
-    rows in [start_j, end_j) of key column j are masked — the O(S)
-    compact mask, SURVEY §5.7c) to raw scores s [bq, bk]. Keeping a
+    FlashMask column bounds (`fm` = one or two (start, end) [1, bk]
+    int32 pairs: query rows in [start_j, end_j) of key column j are
+    masked per band — the O(S) compact mask, SURVEY §5.7c) to raw
+    scores s [bq, bk]. Keeping a
     single copy is what guarantees the forward and both backward
     kernels mask identically."""
     bq, bk = s.shape
@@ -100,8 +101,11 @@ def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg,
         kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         s = jnp.where(qpos + offset >= kpos, s, -jnp.inf)
     if fm is not None:
-        mstart, mend = fm
-        s = jnp.where((qpos >= mstart) & (qpos < mend), -jnp.inf, s)
+        # one or two [start, end) row bands per column (the C=4
+        # FlashMask form carries a second band)
+        for bi in range(0, len(fm), 2):
+            mstart, mend = fm[bi], fm[bi + 1]
+            s = jnp.where((qpos >= mstart) & (qpos < mend), -jnp.inf, s)
     if mask_blk is not None:
         s = s + mask_blk
     if qseg is not None:
@@ -188,7 +192,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
 
 def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                           block_q, block_k, n_kb, offset, has_mask,
-                          has_seg, has_fm, want_lse):
+                          has_seg, n_fm, want_lse):
     """Streamed forward: grid = (B*H, n_qb, n_kb) with the online-softmax
     state (m, l, acc) in VMEM scratch persisted across the sequential
     innermost k axis — the same revisit-accumulation layout as the
@@ -203,9 +207,8 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
-    fms_ref = rest[i] if has_fm else None
-    fme_ref = rest[i + 1] if has_fm else None
-    i += 2 if has_fm else 0
+    fm_refs = rest[i:i + n_fm]
+    i += n_fm
     o_ref = rest[i]
     i += 1
     lse_ref = rest[i] if want_lse else None
@@ -232,7 +235,7 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             mask_ref[0] if has_mask else None,
             qseg_ref[0][:, :1] if has_seg else None,
             kseg_ref[0] if has_seg else None,
-            fm=(fms_ref[0], fme_ref[0]) if has_fm else None)
+            fm=tuple(r[0] for r in fm_refs) if n_fm else None)
         m_new, l_new, acc_new = _online_softmax_step(
             s, v, m_scr[:, :1], l_scr[:, :1], acc_scr[...])
         acc_scr[...] = acc_new
@@ -250,12 +253,13 @@ def _fa_fwd_stream_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         ov = (jnp.max(qseg) >= jnp.min(kseg)) & \
              (jnp.min(qseg) <= jnp.max(kseg))
         live = ov if live is None else jnp.logical_and(live, ov)
-    if has_fm:
-        # block fully dead iff EVERY column masks the whole q block:
-        # start_j <= q0 and end_j >= q0 + bq for all j
+    if n_fm:
+        # block fully dead if EVERY column's FIRST band covers the
+        # whole q block (sufficient condition — a second band only
+        # masks more): start_j <= q0 and end_j >= q0 + bq for all j
         q0 = qi * block_q
-        all_dead = (jnp.max(fms_ref[0]) <= q0) & \
-                   (jnp.min(fme_ref[0]) >= q0 + block_q)
+        all_dead = (jnp.max(fm_refs[0][0]) <= q0) & \
+                   (jnp.min(fm_refs[1][0]) >= q0 + block_q)
         alive = jnp.logical_not(all_dead)
         live = alive if live is None else jnp.logical_and(live, alive)
     if live is None:
@@ -315,7 +319,8 @@ def _fm_rows(fm, b, h):
 
 def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                block_k=None, interpret=False, return_lse=False, mask=None,
-               q_seg=None, kv_seg=None, fm_start=None, fm_end=None):
+               q_seg=None, kv_seg=None, fm_start=None, fm_end=None,
+               fm_start2=None, fm_end2=None):
     """q: [B, Sq, H, D]; k/v: [B, Sk, Hkv, D] (Hkv | H → GQA in-kernel)
     → out [B, Sq, H, D] (+ lse [B*H, Sq, LANES]).
 
@@ -324,6 +329,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
     fm_start/fm_end: FlashMask column bounds [B|1, H|1, Sk] int32 —
     query rows in [fm_start_j, fm_end_j) of key column j are masked; the
     whole mask costs O(Sk) HBM instead of a dense O(Sq·Sk) slab.
+    fm_start2/fm_end2: optional SECOND band per column (the C=4 form).
 
     Two kernel layouts behind one entry:
       - `sq == sk` and no mask → `_fa_fwd_kernel` (full-seq K/V resident
@@ -351,8 +357,10 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
     vb = _bh(v, b, hkv, sk, d)
     has_mask = mask is not None
     has_seg = q_seg is not None
-    has_fm = fm_start is not None
-    streamed = has_mask or has_fm or sq != sk
+    fm_all = [a for a in (fm_start, fm_end, fm_start2, fm_end2)
+              if a is not None]
+    n_fm = len(fm_all)
+    streamed = has_mask or n_fm or sq != sk
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
@@ -388,7 +396,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
         kernel = functools.partial(
             _fa_fwd_stream_kernel, scale=sc, causal=causal,
             block_q=block_q, block_k=block_k, n_kb=n_kb, offset=sk - sq,
-            has_mask=has_mask, has_seg=has_seg, has_fm=has_fm,
+            has_mask=has_mask, has_seg=has_seg, n_fm=n_fm,
             want_lse=return_lse)
         grid = (b * h, sq // block_q, n_kb)
         in_specs = [
@@ -409,14 +417,14 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
             in_specs.append(pl.BlockSpec((1, 1, block_k),
                                          lambda i, j, t: (i // h, 0, t)))
             args.extend([qs, ks])
-        if has_fm:
-            fs_rows, fm_row = _fm_rows(fm_start, b, h)
-            fe_rows, _ = _fm_rows(fm_end, b, h)
+        if n_fm:
+            fm_rows_all = [_fm_rows(a, b, h) for a in fm_all]
+            fm_row = fm_rows_all[0][1]
             fm_spec = pl.BlockSpec(
                 (1, 1, block_k),
                 lambda i, j, t: (fm_row(i // h, i % h), 0, t))
-            in_specs.extend([fm_spec, fm_spec])
-            args.extend([fs_rows, fe_rows])
+            in_specs.extend([fm_spec] * n_fm)
+            args.extend([r for r, _ in fm_rows_all])
         out_specs = [pl.BlockSpec((1, block_q, d),
                                   lambda i, j, t: (i, j, 0))]
         if return_lse:
@@ -445,7 +453,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, scale, causal, block_k, block_q, has_mask,
-                      has_seg, has_fm=False, offset=0):
+                      has_seg, n_fm=0, offset=0):
     """grid = (B*H, n_qb, n_kb); dq block revisited across the innermost
     kb axis (index map drops it), accumulating in an f32 out ref — the
     VMEM-bounded layout: every operand block is O(block · D), nothing is
@@ -457,9 +465,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
-    fms_ref = rest[i] if has_fm else None
-    fme_ref = rest[i + 1] if has_fm else None
-    i += 2 if has_fm else 0
+    fm_refs = rest[i:i + n_fm]
+    i += n_fm
     dq_ref = rest[i]
 
     qi = pl.program_id(1)
@@ -484,7 +491,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            mask_ref[0] if has_mask else None,
                            qseg_ref[0][:, :1] if has_seg else None,
                            kseg_ref[0] if has_seg else None,
-                           fm=(fms_ref[0], fme_ref[0]) if has_fm
+                           fm=tuple(r[0] for r in fm_refs) if n_fm
                            else None)
         p = jnp.exp(s - lse_t)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
@@ -505,7 +512,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        *rest, scale, causal, block_q, block_k, n_qb,
-                       has_mask, has_seg, has_fm=False, offset=0):
+                       has_mask, has_seg, n_fm=0, offset=0):
     """grid = (B*Hkv, n_kb, G·n_qb); dk/dv blocks revisited across the
     innermost axis — which enumerates (query-head-in-group, q block) —
     accumulated in f32 out refs (same VMEM-bounded design as
@@ -517,9 +524,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
-    fms_ref = rest[i] if has_fm else None
-    fme_ref = rest[i + 1] if has_fm else None
-    i += 2 if has_fm else 0
+    fm_refs = rest[i:i + n_fm]
+    i += n_fm
     dk_ref = rest[i]
     dv_ref = rest[i + 1]
 
@@ -545,7 +551,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            mask_ref[0] if has_mask else None,
                            qseg_ref[0][:, :1] if has_seg else None,
                            kseg_ref[0] if has_seg else None,
-                           fm=(fms_ref[0], fme_ref[0]) if has_fm
+                           fm=tuple(r[0] for r in fm_refs) if n_fm
                            else None)
         p = jnp.exp(s - _stat_cols(lse_ref[0], bk))       # [bq, bk]
         p = jnp.where(jnp.isfinite(s), p, 0.0)
@@ -571,7 +577,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
                 block_q=None, block_k=None, interpret=False, dlse=None,
                 mask=None, q_seg=None, kv_seg=None, fm_start=None,
-                fm_end=None):
+                fm_end=None, fm_start2=None, fm_end2=None):
     """FlashAttention-2 backward. q,o,do: [B,S,H,D]; k,v: [B,S,Hkv,D];
     lse: [B*H,S,LANES].
 
@@ -612,14 +618,16 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
 
     has_mask = mask is not None
     has_seg = q_seg is not None
-    has_fm = fm_start is not None
+    fm_all = [a for a in (fm_start, fm_end, fm_start2, fm_end2)
+              if a is not None]
+    n_fm = len(fm_all)
     if has_mask:
         mrows, mrow_fn = _mask_rows(mask, b, h)
     if has_seg:
         qs, ks = _seg_layouts(q_seg, kv_seg)
-    if has_fm:
-        fs_rows, fm_row = _fm_rows(fm_start, b, h)
-        fe_rows, _ = _fm_rows(fm_end, b, h)
+    if n_fm:
+        fm_rows_all = [_fm_rows(a, b, h) for a in fm_all]
+        fm_row = fm_rows_all[0][1]
 
     n_qb = sq // block_q
     n_kb = sk // block_k
@@ -648,18 +656,18 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         in_specs.append(pl.BlockSpec((1, 1, block_k),
                                      lambda i, j, t: (i // h, 0, t)))
         args.extend([qs, ks])
-    if has_fm:
+    if n_fm:
         fm_spec = pl.BlockSpec(
             (1, 1, block_k),
             lambda i, j, t: (fm_row(i // h, i % h), 0, t))
-        in_specs.extend([fm_spec, fm_spec])
-        args.extend([fs_rows, fe_rows])
+        in_specs.extend([fm_spec] * n_fm)
+        args.extend([r for r, _ in fm_rows_all])
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
                           block_k=block_k, block_q=block_q,
                           has_mask=has_mask, has_seg=has_seg,
-                          has_fm=has_fm, offset=offset),
+                          n_fm=n_fm, offset=offset),
         out_shape=_sds((b * h, sq, d), jnp.float32, qb, kb, vb, dob, lse),
         grid=(b * h, n_qb, n_kb),
         in_specs=in_specs,
@@ -695,19 +703,19 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         in_specs2.append(pl.BlockSpec(
             (1, 1, block_k), lambda i, j, t: (i // hkv, 0, j)))
         args2.extend([qs, ks])
-    if has_fm:
+    if n_fm:
         fm_spec2 = pl.BlockSpec(
             (1, 1, block_k),
             lambda i, j, t: (fm_row(i // hkv,
                                     (i % hkv) * g + t // n_qb), 0, j))
-        in_specs2.extend([fm_spec2, fm_spec2])
-        args2.extend([fs_rows, fe_rows])
+        in_specs2.extend([fm_spec2] * n_fm)
+        args2.extend([r for r, _ in fm_rows_all])
 
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
                           block_q=block_q, block_k=block_k, n_qb=n_qb,
                           has_mask=has_mask, has_seg=has_seg,
-                          has_fm=has_fm, offset=offset),
+                          n_fm=n_fm, offset=offset),
         out_shape=[_sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,
                         lse),
                    _sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,

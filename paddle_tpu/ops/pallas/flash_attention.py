@@ -412,24 +412,30 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 # block and skip fully-dead blocks.
 
 
-def _fm_dense_mask(fm_start, fm_end, sq):
+def _fm_dense_mask(fm_start, fm_end, sq, fm_start2=None, fm_end2=None):
     """Dense additive oracle for the column bounds ([B|1, H|1, Sk] →
-    [B|1, H|1, Sq, Sk] 0/-inf). Tests + fallback only."""
+    [B|1, H|1, Sq, Sk] 0/-inf); optional second band (C=4 form).
+    Tests + fallback only."""
     rows = jnp.arange(sq)[None, None, :, None]
     dead = (rows >= fm_start[:, :, None, :]) & \
            (rows < fm_end[:, :, None, :])
+    if fm_start2 is not None:
+        dead = dead | ((rows >= fm_start2[:, :, None, :]) &
+                       (rows < fm_end2[:, :, None, :]))
     return jnp.where(dead, -jnp.inf, 0.0).astype(jnp.float32)
 
 
-def _fm_ref(q, k, v, fm_start, fm_end, causal, scale):
-    m = _fm_dense_mask(fm_start, fm_end, q.shape[1])
+def _fm_ref(q, k, v, fm_start, fm_end, fm_start2, fm_end2, causal,
+            scale):
+    m = _fm_dense_mask(fm_start, fm_end, q.shape[1], fm_start2, fm_end2)
     return _attention_ref(q, k, v, mask=m, causal=causal, scale=scale)
 
 
-def _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale, want_lse,
-                   site):
+def _try_kernel_fm(q, k, v, fm, causal, scale, want_lse, site):
     """One shared kernel-dispatch body for both fm entry points: returns
-    the kernel result or None after the standard counted fallback."""
+    the kernel result or None after the standard counted fallback.
+    fm = (start, end, start2, end2) with None placeholders for the
+    single-band forms (fa_forward filters Nones)."""
     if not _want_pallas():
         return None
     reason = _shape_reason(q.shape, k.shape)
@@ -439,7 +445,8 @@ def _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale, want_lse,
             res = fa_forward(q, k, v, causal=causal, scale=scale,
                              return_lse=want_lse,
                              interpret=_FORCE_INTERPRET,
-                             fm_start=fm_start, fm_end=fm_end)
+                             fm_start=fm[0], fm_end=fm[1],
+                             fm_start2=fm[2], fm_end2=fm[3])
             _note_pallas()
             return res
         except Exception as e:
@@ -449,58 +456,70 @@ def _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale, want_lse,
     return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _flash_core_fm(q, k, v, fm_start, fm_end, causal, scale):
-    out = _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale,
-                         False, "flashmask_forward")
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _flash_core_fm(q, k, v, fm_start, fm_end, fm_start2, fm_end2,
+                   causal, scale):
+    fm = (fm_start, fm_end, fm_start2, fm_end2)
+    out = _try_kernel_fm(q, k, v, fm, causal, scale, False,
+                         "flashmask_forward")
     if out is not None:
         return out
-    return _fm_ref(q, k, v, fm_start, fm_end, causal, scale)
+    return _fm_ref(q, k, v, fm_start, fm_end, fm_start2, fm_end2,
+                   causal, scale)
 
 
-def _fm_fwd(q, k, v, fm_start, fm_end, causal, scale):
-    res = _try_kernel_fm(q, k, v, fm_start, fm_end, causal, scale,
-                         True, "flashmask_forward(train)")
+def _fm_fwd(q, k, v, fm_start, fm_end, fm_start2, fm_end2, causal,
+            scale):
+    fm = (fm_start, fm_end, fm_start2, fm_end2)
+    res = _try_kernel_fm(q, k, v, fm, causal, scale, True,
+                         "flashmask_forward(train)")
     if res is not None:
         out, lse_l = res
-        return out, (q, k, v, out, lse_l, fm_start, fm_end)
-    out = _fm_ref(q, k, v, fm_start, fm_end, causal, scale)
-    return out, (q, k, v, None, None, fm_start, fm_end)
+        return out, (q, k, v, out, lse_l, fm)
+    out = _fm_ref(q, k, v, fm_start, fm_end, fm_start2, fm_end2,
+                  causal, scale)
+    return out, (q, k, v, None, None, fm)
 
 
 def _fm_bwd(causal, scale, res, g):
-    q, k, v, out, lse_l, fm_start, fm_end = res
+    q, k, v, out, lse_l, fm = res
     if lse_l is not None:
         from ._fa_kernel import fa_backward
         dq, dk, dv = fa_backward(q, k, v, out, lse_l, g, causal=causal,
                                  scale=scale, interpret=_FORCE_INTERPRET,
-                                 fm_start=fm_start, fm_end=fm_end)
+                                 fm_start=fm[0], fm_end=fm[1],
+                                 fm_start2=fm[2], fm_end2=fm[3])
     else:
         _, vjp_fn = jax.vjp(
-            lambda q_, k_, v_: _fm_ref(q_, k_, v_, fm_start, fm_end,
-                                       causal, scale), q, k, v)
+            lambda q_, k_, v_: _fm_ref(q_, k_, v_, fm[0], fm[1], fm[2],
+                                       fm[3], causal, scale), q, k, v)
         dq, dk, dv = vjp_fn(g)
-    return (dq, dk, dv, _int_zero(fm_start), _int_zero(fm_end))
+    return tuple([dq, dk, dv] + [_int_zero(a) for a in fm])
 
 
 _flash_core_fm.defvjp(_fm_fwd, _fm_bwd)
 
 
 def _normalize_startend(startend_row_indices, sk):
-    """PaddleNLP FlashMask layout [B, H|1, Sk, C] int32 → (start, end)
-    [B, H|1, Sk]. C=1: rows [start_j, Sq) masked (the LT-start causal
-    document form); C=2: the [start_j, end_j) band."""
+    """PaddleNLP FlashMask layout [B, H|1, Sk, C] int32 →
+    (start, end[, start2, end2]) [B, H|1, Sk] row bands. C=1: rows
+    [start_j, Sq) masked (the LT-start causal document form); C=2: the
+    [start_j, end_j) band; C=4: two bands — [LTS, LTE) below and
+    [UTS, UTE) above (the bidirectional form)."""
     idx = startend_row_indices
-    if idx.ndim != 4 or idx.shape[2] != sk or idx.shape[3] not in (1, 2):
+    if idx.ndim != 4 or idx.shape[2] != sk or \
+            idx.shape[3] not in (1, 2, 4):
         raise ValueError(
-            "startend_row_indices must be [B, H|1, Sk, 1|2] int32, got "
-            f"{tuple(idx.shape)}")
+            "startend_row_indices must be [B, H|1, Sk, 1|2|4] int32, "
+            f"got {tuple(idx.shape)}")
     start = idx[..., 0].astype(jnp.int32)
+    if idx.shape[3] == 1:
+        return (start, jnp.full_like(start, jnp.iinfo(jnp.int32).max))
+    end = idx[..., 1].astype(jnp.int32)
     if idx.shape[3] == 2:
-        end = idx[..., 1].astype(jnp.int32)
-    else:
-        end = jnp.full_like(start, jnp.iinfo(jnp.int32).max)
-    return start, end
+        return (start, end)
+    return (start, end, idx[..., 2].astype(jnp.int32),
+            idx[..., 3].astype(jnp.int32))
 
 
 def flashmask_attention(query, key, value, startend_row_indices=None,
@@ -508,9 +527,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
                         return_softmax_lse=False, fixed_seed_offset=None,
                         rng_name="", training=True, name=None):
     """Reference-parity API: paddle.nn.functional.flashmask_attention —
-    attention with a COMPACT column-wise mask ([B, H|1, Sk, 1|2] int32
-    start/end query-row bounds per key column; O(Sk) memory) instead of
-    a dense [Sq, Sk] mask. Composes with causal."""
+    attention with a COMPACT column-wise mask ([B, H|1, Sk, 1|2|4]
+    int32 query-row bounds per key column; O(Sk) memory) instead of a
+    dense [Sq, Sk] mask: C=1 LT-start, C=2 one [start, end) band, C=4
+    two bands (bidirectional LT+UT). Composes with causal."""
     q = query
     k = key
     v = value
@@ -550,7 +570,8 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     raw = startend_row_indices._data \
         if hasattr(startend_row_indices, "_data") else \
         jnp.asarray(startend_row_indices)
-    fm_start, fm_end = _normalize_startend(raw, sk)
+    fm = _normalize_startend(raw, sk)
+    fm_start = fm[0]
     b, h = q.shape[0], q.shape[2]
     if fm_start.shape[0] not in (1, b) or fm_start.shape[1] not in (1, h):
         # reject BEFORE the kernel: an out-of-range BlockSpec row index
@@ -560,8 +581,11 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             f"{tuple(raw.shape[:2])} incompatible with q "
             f"[B={b}, H={h}]")
 
+    fm = tuple(fm) + (None,) * (4 - len(fm))   # fixed 4-slot protocol
+
     def f(qa, ka, va):
-        return _flash_core_fm(qa, ka, va, fm_start, fm_end, causal, None)
+        return _flash_core_fm(qa, ka, va, fm[0], fm[1], fm[2], fm[3],
+                              causal, None)
     out = apply(f, q, k, v, name="flashmask_attention")
     out = _maybe_dropout(out, drop_p)
     return (out, None) if return_softmax_lse else out

@@ -565,6 +565,47 @@ class TestFlashMask:
             assert t.grad is not None
             assert np.isfinite(np.asarray(t.grad._data)).all()
 
+    def test_bidirectional_c4_two_bands(self, monkeypatch):
+        """C=4 layout: [LTS, LTE) + [UTS, UTE) bands per column
+        (non-causal bidirectional form), fwd + grad parity vs the dense
+        two-band oracle."""
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as P
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        rng = np.random.default_rng(21)
+        qn, kn, vn = (rng.standard_normal((1, 256, 2, 64))
+                      .astype(np.float32) for _ in range(3))
+        lts = rng.integers(1, 200, (1, 1, 256, 1))
+        lte = lts + rng.integers(1, 40, (1, 1, 256, 1))
+        uts = rng.integers(200, 250, (1, 1, 256, 1))
+        ute = uts + rng.integers(1, 6, (1, 1, 256, 1))
+        idx = np.concatenate([lts, lte, uts, ute], -1).astype(np.int32)
+        q = P.to_tensor(qn, stop_gradient=False)
+        k = P.to_tensor(kn, stop_gradient=False)
+        v = P.to_tensor(vn, stop_gradient=False)
+        out = P.nn.functional.flashmask_attention(
+            q, k, v, startend_row_indices=P.to_tensor(idx), causal=False)
+        stats = fa.dispatch_stats()
+        assert stats["pallas"] == 1 and stats["fallback"] == 0, stats
+        m = fa._fm_dense_mask(
+            jnp.asarray(idx[..., 0]), jnp.asarray(idx[..., 1]), 256,
+            jnp.asarray(idx[..., 2]), jnp.asarray(idx[..., 3]))
+        ref = fa._attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                                jnp.asarray(vn), mask=m)
+        assert np.allclose(np.asarray(out._data), np.asarray(ref),
+                           atol=2e-4)
+        out.sum().backward()
+        _, vjp = jax.vjp(lambda a, b_, c: fa._attention_ref(
+            a, b_, c, mask=m), jnp.asarray(qn), jnp.asarray(kn),
+            jnp.asarray(vn))
+        rd = vjp(jnp.ones_like(out._data))
+        for got, refv in zip((q.grad, k.grad, v.grad), rd):
+            assert np.allclose(np.asarray(got._data), np.asarray(refv),
+                               atol=3e-3)
+
     def test_sliding_window_via_bounds(self, monkeypatch):
         """window_size=w == dense band mask: row i attends [i-w, i]."""
         import paddle_tpu as P
