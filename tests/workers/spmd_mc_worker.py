@@ -90,6 +90,67 @@ def run_config(hybrid_configs, model_cls, steps=3, stage=None):
     return losses
 
 
+def run_pipeline(steps=3):
+    """4D config through the PIPELINE runtime (pp2 × mp2 × sharding2 —
+    the dryrun's proven single-process composition) across the global
+    mesh."""
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    _reset_fleet()
+    strategy = DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 3, "sharding_degree": 2}
+    strategy.hybrid_configs = {"mp_degree": 2, "sharding_degree": 2,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    class Stem(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 16)
+
+        def forward(self, x):
+            return P.tanh(self.fc(x))
+
+    class Block(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+
+        def forward(self, x):
+            return P.tanh(self.fc(x)) + x
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    def mse(pred, lab):
+        return ((pred - lab) ** 2).mean()
+
+    P.seed(0)
+    pipe = PipelineLayer(
+        layers=[Stem()] + [LayerDesc(Block, 16) for _ in range(2)] +
+               [Head()],
+        num_stages=2, loss_fn=mse)
+    opt = P.optimizer.SGD(0.05, parameters=pipe.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    model = fleet.distributed_model(pipe)
+    rng = np.random.default_rng(1)
+    losses = []
+    for _ in range(steps):
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        y = rng.standard_normal((4, 4)).astype(np.float32)
+        loss = model.train_batch((P.to_tensor(x), P.to_tensor(y)), opt)
+        losses.append(float(np.asarray(loss._data)))
+    return losses
+
+
 def main():
     out_dir = sys.argv[1]
     dist.init_parallel_env()
@@ -100,7 +161,8 @@ def main():
 
     res = {"rank": rank,
            "zero3": run_config({"sharding_degree": 8}, MLP, stage=3),
-           "dp_tp": run_config({"dp_degree": 2, "mp_degree": 4}, TPMLP)}
+           "dp_tp": run_config({"dp_degree": 2, "mp_degree": 4}, TPMLP),
+           "pipeline_4d": run_pipeline()}
 
     with open(os.path.join(out_dir, f"spmd_mc.{rank}.json"), "w") as f:
         json.dump(res, f)
