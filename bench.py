@@ -44,14 +44,11 @@ def _tpu_usable(attempts=4, probe_timeout=120, backoff=45):
     import signal
     # Cheap pre-check: the axon relay rides local ports (CLAUDE.md); a
     # connection-refused means the tunnel's host-side process is gone —
-    # no amount of probing helps, and each probe costs minutes.
-    import socket
-    try:
-        s = socket.socket()
-        s.settimeout(2)
-        s.connect(("127.0.0.1", 8083))
-        s.close()
-    except OSError:
+    # no amount of probing helps, and each probe costs minutes. One
+    # shared implementation (paddle_tpu.device) so the port/timeout
+    # policy lives in one place.
+    from paddle_tpu.device import _tunnel_alive
+    if not _tunnel_alive():
         sys.stderr.write("tpu probe: axon tunnel port 8083 refused — "
                          "tunnel down, skipping device probes\n")
         return False

@@ -55,23 +55,60 @@ def is_compiled_with_rocm():
     return False
 
 
+_PROBE_CACHE = None
+
+
+def _tunnel_alive(port=8083, wait=2.0):
+    """Cheap socket check of the axon relay (CLAUDE.md: check the
+    tunnel BEFORE device probes — a dead tunnel makes every probe burn
+    its full timeout)."""
+    import socket
+    s = socket.socket()
+    s.settimeout(wait)
+    try:
+        s.connect(("127.0.0.1", port))
+        return True
+    except Exception:
+        return False
+    finally:
+        s.close()
+
+
 def _probe_devices(timeout=60):
     """Bounded SUBPROCESS device probe: a wedged TPU makes in-process
     jax.devices() hang forever with no exception (CLAUDE.md chip
-    hygiene), so never touch it directly here."""
+    hygiene), so never touch it directly here. The result is cached
+    per process (device inventory is static), and when the relay
+    socket is dead the probe forces the CPU platform up front instead
+    of waiting out the accelerator timeout."""
+    global _PROBE_CACHE
+    alive = _tunnel_alive()
+    if _PROBE_CACHE is not None:
+        result, was_forced = _PROBE_CACHE
+        # a forced-CPU inventory is only valid while the tunnel is
+        # down — re-probe once it comes back (recovery must be seen)
+        if not (was_forced and alive):
+            return result
     import subprocess
     import sys
-    code = ("import jax; "
+    force = "" if alive else \
+        "jax.config.update('jax_platforms', 'cpu'); "
+    code = ("import jax; " + force +
             "print(','.join(f'{d.platform}:{d.id}' for d in jax.devices()))")
+    out = []
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
                            timeout=timeout)
         if p.returncode == 0 and p.stdout.strip():
-            return p.stdout.strip().split(",")
+            out = p.stdout.strip().split(",")
     except Exception:
         pass
-    return []
+    if out:
+        # never cache a FAILED probe (a wedged chip mid-compile would
+        # otherwise pin 'cpu' for the process lifetime)
+        _PROBE_CACHE = (out, bool(force))
+    return out
 
 
 def get_all_device_type():

@@ -25,19 +25,22 @@ def _check(model, hw, num_classes=NUM_CLASSES):
     assert any(g is not None for g in grads)
 
 
+# 32px for the fully-convolutional (adaptive-pool) families — the test
+# checks output shape + grad flow, which is input-size-invariant; 64px
+# cost ~4x the conv time for no extra coverage (round-4 durations trim)
 @pytest.mark.parametrize("name,factory,hw", [
     ("alexnet", lambda: M.alexnet(num_classes=NUM_CLASSES), 224),
     ("squeezenet1_1",
-     lambda: M.squeezenet1_1(num_classes=NUM_CLASSES), 64),
-    ("densenet121", lambda: M.densenet121(num_classes=NUM_CLASSES), 64),
+     lambda: M.squeezenet1_1(num_classes=NUM_CLASSES), 32),
+    ("densenet121", lambda: M.densenet121(num_classes=NUM_CLASSES), 32),
     ("shufflenet_v2_x0_5",
-     lambda: M.shufflenet_v2_x0_5(num_classes=NUM_CLASSES), 64),
+     lambda: M.shufflenet_v2_x0_5(num_classes=NUM_CLASSES), 32),
     ("mobilenet_v1",
-     lambda: M.mobilenet_v1(scale=0.25, num_classes=NUM_CLASSES), 64),
+     lambda: M.mobilenet_v1(scale=0.25, num_classes=NUM_CLASSES), 32),
     ("mobilenet_v3_small",
-     lambda: M.mobilenet_v3_small(num_classes=NUM_CLASSES), 64),
+     lambda: M.mobilenet_v3_small(num_classes=NUM_CLASSES), 32),
     ("resnext50_32x4d",
-     lambda: M.resnext50_32x4d(num_classes=NUM_CLASSES), 64),
+     lambda: M.resnext50_32x4d(num_classes=NUM_CLASSES), 32),
 ])
 def test_zoo_forward_backward(name, factory, hw):
     P.seed(0)
