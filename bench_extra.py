@@ -1,7 +1,8 @@
 """Milestone-config benches beyond the headline bench.py (BASELINE.md
-"Milestone configs"): currently config 2 — BERT-base dynamic-graph
-fine-tune with AMP-O2 on a single TPU chip. Records tokens/sec (+ MFU
-proxy) to BENCH_extra.json and captures a jax.profiler trace artifact.
+"Milestone configs"): config 1 — ResNet-50/CIFAR-10 via the Model fit
+path — and config 2 — BERT-base dynamic-graph fine-tune with AMP-O2 on
+a single TPU chip. Records throughput rows to BENCH_extra.json and
+captures a jax.profiler trace artifact (--trace).
 
 Usage: python bench_extra.py [--trace]
 """
@@ -14,13 +15,34 @@ import time
 import numpy as np
 
 
+def _timed_device_loop(m, inputs, labels):
+    """The measurement-hygiene-critical harness, in ONE place: compile
+    + warm via a first loop run, DRAIN it with a dependent fetch, then
+    time exactly one device-loop dispatch whose timed region ends in a
+    dependent fetch of the last step's loss (axon: block_until_ready
+    alone does not prove execution; the momentum/optimizer update makes
+    the timed request distinct from the warm one, so the request cache
+    cannot fake it). Returns (last_loss, seconds)."""
+    warm = m.train_batch_loop(inputs, labels)
+    float(np.asarray(warm._data)[-1])
+    t0 = time.perf_counter()
+    losses = m.train_batch_loop(inputs, labels)
+    loss = float(np.asarray(losses._data)[-1])
+    return loss, time.perf_counter() - t0
+
+
+def _on_tpu():
+    import jax
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
 def bert_amp_o2(trace: bool = False):
     import jax
 
     import paddle_tpu as P
     from paddle_tpu.models import BertConfig, BertForSequenceClassification
 
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    on_tpu = _on_tpu()
     if on_tpu:
         cfg = BertConfig()  # BERT-base defaults
         batch, seq, iters = 32, 128, 20
@@ -69,12 +91,7 @@ def bert_amp_o2(trace: bool = False):
     lab_l = P.to_tensor(np.broadcast_to(
         np.asarray(labels._data)[None],
         (iters,) + tuple(labels.shape)).copy())
-    warm = m.train_batch_loop([ids_l], [lab_l])  # compile the loop
-    float(np.asarray(warm._data)[-1])  # drain warmup before timing
-    t0 = time.perf_counter()
-    losses = m.train_batch_loop([ids_l], [lab_l])
-    loss = float(np.asarray(losses._data)[-1])  # dependent fetch
-    dt = time.perf_counter() - t0
+    loss, dt = _timed_device_loop(m, [ids_l], [lab_l])
 
     tok_s = batch * seq * iters / dt
     # 6N FLOPs/token proxy (fine-tune fwd+bwd)
@@ -91,6 +108,38 @@ def bert_amp_o2(trace: bool = False):
     }
 
 
+def resnet50_cifar_fit():
+    """BASELINE config 1: ResNet-50 on CIFAR-10 via Model.fit-style
+    training (synthetic CIFAR-shaped data, device-loop timed region —
+    one dispatch + one dependent fetch). CPU-runnable per BASELINE.md;
+    on TPU the same program rides the chip."""
+    import paddle_tpu as P
+    from paddle_tpu.vision import models as M
+
+    on_tpu = _on_tpu()
+    batch, steps = (64, 20) if on_tpu else (16, 3)
+    P.seed(0)
+    model = M.resnet50(num_classes=10)
+    opt = P.optimizer.Momentum(0.01, momentum=0.9,
+                               parameters=model.parameters())
+    crit = P.nn.CrossEntropyLoss()
+    m = P.Model(model)
+    m.prepare(opt, crit)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((steps, batch, 3, 32, 32)).astype(np.float32)
+    y = rng.integers(0, 10, (steps, batch)).astype(np.int64)
+    xl, yl = P.to_tensor(x), P.to_tensor(y)
+    loss, dt = _timed_device_loop(m, [xl], [yl])
+    img_s = batch * steps / dt
+    return {
+        "metric": "resnet50_cifar10_fit"
+                  + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(img_s, 1),
+        "unit": "images/sec (fwd+bwd+momentum, Model device loop)",
+        "batch": batch, "steps": steps, "loss": loss,
+    }
+
+
 def main():
     trace = "--trace" in sys.argv
     # wedge-proofing (CLAUDE.md chip hygiene): probe in a bounded
@@ -101,11 +150,14 @@ def main():
         force_cpu()
     rec = bert_amp_o2(trace=trace)
     print(json.dumps(rec))
+    rec2 = resnet50_cifar_fit()
+    print(json.dumps(rec2))
     if "cpu_smoke" in rec["metric"]:
         # never clobber the committed on-chip capture with a fallback
         return
     with open("BENCH_extra.json", "w") as f:
-        json.dump(rec, f, indent=1)
+        json.dump({"bert_amp_o2": rec, "resnet50_cifar10": rec2}, f,
+                  indent=1)
 
 
 if __name__ == "__main__":

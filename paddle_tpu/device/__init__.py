@@ -74,34 +74,54 @@ def _tunnel_alive(port=8083, wait=2.0):
         s.close()
 
 
-def _probe_devices(timeout=60):
+# the force-CPU recipe for a probe subprocess under the axon
+# sitecustomize (CLAUDE.md: config.update alone is not enough once a
+# backend is baked; clearing when nothing initialized is harmless)
+_FORCE_CPU_SNIPPET = (
+    "from jax._src import xla_bridge as xb; "
+    "xb._clear_backends(); xb.get_backend.cache_clear(); "
+    "jax.config.update('jax_platforms', 'cpu'); ")
+
+
+def _probe_devices(timeout=60, grace=20):
     """Bounded SUBPROCESS device probe: a wedged TPU makes in-process
     jax.devices() hang forever with no exception (CLAUDE.md chip
-    hygiene), so never touch it directly here. The result is cached
-    per process (device inventory is static), and when the relay
-    socket is dead the probe forces the CPU platform up front instead
-    of waiting out the accelerator timeout."""
+    hygiene), so never touch it directly here. Successful results are
+    cached per process; a forced-CPU inventory re-probes once the
+    tunnel returns, and an accelerator inventory re-probes (forced)
+    once the tunnel dies. A timed-out probe child gets SIGTERM + grace,
+    never a straight SIGKILL (a kill mid-device-touch can wedge the
+    chip grant)."""
     global _PROBE_CACHE
-    alive = _tunnel_alive()
     if _PROBE_CACHE is not None:
         result, was_forced = _PROBE_CACHE
-        # a forced-CPU inventory is only valid while the tunnel is
-        # down — re-probe once it comes back (recovery must be seen)
-        if not (was_forced and alive):
+        alive = _tunnel_alive()
+        # forced-CPU + tunnel back → recovery must be seen;
+        # accelerator inventory + tunnel dead → stale, re-probe forced
+        if was_forced != alive:
             return result
+    else:
+        alive = _tunnel_alive()
     import subprocess
     import sys
-    force = "" if alive else \
-        "jax.config.update('jax_platforms', 'cpu'); "
+    force = "" if alive else _FORCE_CPU_SNIPPET
     code = ("import jax; " + force +
             "print(','.join(f'{d.platform}:{d.id}' for d in jax.devices()))")
     out = []
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
     try:
-        p = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=timeout)
-        if p.returncode == 0 and p.stdout.strip():
-            out = p.stdout.strip().split(",")
+        stdout, _ = proc.communicate(timeout=timeout)
+        if proc.returncode == 0 and stdout.strip():
+            out = stdout.strip().split(",")
+    except subprocess.TimeoutExpired:
+        proc.terminate()                      # SIGTERM, then grace
+        try:
+            proc.communicate(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()                       # last resort only
+            proc.communicate()
     except Exception:
         pass
     if out:
