@@ -129,7 +129,8 @@ class LlamaAttention(Layer):
             self.v_proj = Linear(h, kv_out, bias_attr=False)
             self.o_proj = Linear(h, h, bias_attr=False)
 
-    def forward(self, x, position_ids=None, attn_mask=None, cache=None):
+    def forward(self, x, position_ids=None, attn_mask=None, cache=None,
+                startend_row_indices=None):
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x)
         k = self.k_proj(x)
@@ -154,12 +155,13 @@ class LlamaAttention(Layer):
                     "expected 'ring' or 'ulysses'")
             from ..distributed._axis import current_axis_env
             if "sep" in current_axis_env():
-                if attn_mask is not None:
+                if attn_mask is not None or \
+                        startend_row_indices is not None:
                     raise NotImplementedError(
                         "context-parallel attention does not support "
-                        "attn_mask yet (pad masks would be silently "
-                        "dropped); pack sequences or pad with causal "
-                        "semantics instead")
+                        "attn_mask / attn_mask_startend_row_indices yet "
+                        "(masks would be silently dropped); pack "
+                        "sequences or pad with causal semantics instead")
                 from ..distributed.fleet.long_context import (
                     _sep_group, ring_flash_attention, ulysses_attention)
                 if nkv != nh:
@@ -178,7 +180,22 @@ class LlamaAttention(Layer):
                     else ulysses_attention
                 out = cp(q, k, v, causal=True)
                 return self.o_proj(out.reshape([b, s, nh * hd]))
-        if self.cfg.use_flash_attention:
+        if startend_row_indices is not None:
+            # FlashMask (reference: attn_mask_startend_row_indices) —
+            # compact column bounds at O(Sk) memory, kernel-native
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask and attn_mask_startend_row_indices are "
+                    "mutually exclusive")
+            if self.cfg.context_parallel:
+                raise NotImplementedError(
+                    "attn_mask_startend_row_indices does not compose "
+                    "with context_parallel yet")
+            from ..ops.pallas.flash_attention import flashmask_attention
+            out = flashmask_attention(
+                q, k, v, startend_row_indices=startend_row_indices,
+                causal=causal)
+        elif self.cfg.use_flash_attention:
             # GQA: K/V go in at their NATIVE head count — the Pallas
             # kernel indexes KV heads in its BlockSpec maps (round-3;
             # the old `repeat` paid G× K/V HBM traffic for nothing)
@@ -265,17 +282,21 @@ class LlamaDecoderLayer(Layer):
                                                 cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def _block(self, x, position_ids=None, attn_mask=None, attn_fn=None):
+    def _block(self, x, position_ids=None, attn_mask=None, attn_fn=None,
+               startend_row_indices=None):
         """One canonical residual structure for every remat granularity
         (attn_fn lets core_attn wrap JUST the attention in recompute
         without duplicating the residual arithmetic)."""
         if attn_fn is None:
             def attn_fn(hn):
-                return self.self_attn(hn, position_ids, attn_mask)
+                return self.self_attn(
+                    hn, position_ids, attn_mask,
+                    startend_row_indices=startend_row_indices)
         h = x + attn_fn(self.input_layernorm(x))
         return h + self.mlp(self.post_attention_layernorm(h))
 
-    def forward(self, x, position_ids=None, attn_mask=None):
+    def forward(self, x, position_ids=None, attn_mask=None,
+                startend_row_indices=None):
         if self.cfg.recompute and self.training:
             from ..distributed.fleet.recompute import recompute
             gran = self.cfg.recompute_granularity
@@ -289,7 +310,9 @@ class LlamaDecoderLayer(Layer):
                         s.inner = self.self_attn
 
                     def forward(s, hn):
-                        return s.inner(hn, position_ids, attn_mask)
+                        return s.inner(
+                            hn, position_ids, attn_mask,
+                            startend_row_indices=startend_row_indices)
                 return self._block(
                     x, position_ids, attn_mask,
                     attn_fn=lambda hn: recompute(_Attn(), hn))
@@ -300,9 +323,12 @@ class LlamaDecoderLayer(Layer):
                     s.inner = self
 
                 def forward(s, h):
-                    return s.inner._block(h, position_ids, attn_mask)
+                    return s.inner._block(
+                        h, position_ids, attn_mask,
+                        startend_row_indices=startend_row_indices)
             return recompute(_Body(), x, granularity=gran)
-        return self._block(x, position_ids, attn_mask)
+        return self._block(x, position_ids, attn_mask,
+                           startend_row_indices=startend_row_indices)
 
     def forward_cached(self, x, k_buf, v_buf, offset):
         a, k_buf, v_buf = self.self_attn.forward_cached(
@@ -330,7 +356,8 @@ class LlamaModel(Layer):
                                  for _ in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                attn_mask_startend_row_indices=None):
         x = self.embed_tokens(input_ids)
         if self.cfg.context_parallel and position_ids is None:
             from ..distributed._axis import current_axis_env
@@ -348,7 +375,8 @@ class LlamaModel(Layer):
             from ..distributed.fleet.sequence_parallel import scatter
             x = scatter(x, axis=1)
         for layer in self.layers:
-            x = layer(x, position_ids, attn_mask)
+            x = layer(x, position_ids, attn_mask,
+                      startend_row_indices=attn_mask_startend_row_indices)
         if self.cfg.sequence_parallel:
             from ..distributed.fleet.sequence_parallel import all_gather
             x = all_gather(x, axis=1)
@@ -389,8 +417,10 @@ class LlamaForCausalLM(Layer, GenerationMixin):
             # contracts against its transpose.
             self.lm_head = _TiedLMHead(self.llama.embed_tokens.weight)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
-        h = self.llama(input_ids, position_ids, attn_mask)
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                attn_mask_startend_row_indices=None):
+        h = self.llama(input_ids, position_ids, attn_mask,
+                       attn_mask_startend_row_indices)
         if self.cfg.fuse_linear_cross_entropy and self.training:
             # fused mode: the criterion applies the head chunk-by-chunk
             # fused with the CE (logits never materialize); eval/predict

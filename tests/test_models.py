@@ -270,3 +270,67 @@ class TestOverfitConvergence:
         # it by an order of magnitude
         assert first > 3.5, first
         assert last < 0.5, (first, last)
+
+
+class TestLlamaFlashMask:
+    """Round-4: attn_mask_startend_row_indices threads through the model
+    (reference: PaddleNLP document-packing training via FlashMask)."""
+
+    def _cfg(self, **kw):
+        from paddle_tpu.models.llama import LlamaConfig
+        return LlamaConfig(**{**dict(
+            vocab_size=128, hidden_size=256, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            dtype="float32"), **kw})
+
+    def test_document_packing_isolation(self, monkeypatch):
+        """Packed doc0's logits match running doc0 alone (columns of
+        doc0 masked for rows >= 128), kernel engaged per layer."""
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        P.seed(0)
+        model = LlamaForCausalLM(self._cfg())
+        ids = np.random.default_rng(0).integers(
+            0, 128, (1, 256)).astype(np.int32)
+        starts = np.full((1, 1, 256, 1), 2 ** 31 - 1, np.int32)
+        starts[:, :, :128, 0] = 128
+        out = model(P.to_tensor(ids),
+                    attn_mask_startend_row_indices=P.to_tensor(starts))
+        stats = fa.dispatch_stats()
+        assert stats["fallback"] == 0 and stats["pallas"] >= 2, stats
+        out0 = model(P.to_tensor(ids[:, :128]))
+        np.testing.assert_allclose(np.asarray(out._data)[:, :128],
+                                   np.asarray(out0._data), atol=1e-4)
+
+    def test_trains_with_remat(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             LlamaPretrainingCriterion)
+        cfg = self._cfg(recompute=True)
+        P.seed(0)
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion(cfg)
+        ids = np.random.default_rng(1).integers(
+            0, 128, (1, 256)).astype(np.int32)
+        starts = np.full((1, 1, 256, 1), 2 ** 31 - 1, np.int32)
+        starts[:, :, :128, 0] = 128
+        loss = crit(model(
+            P.to_tensor(ids),
+            attn_mask_startend_row_indices=P.to_tensor(starts)),
+            P.to_tensor(ids))
+        loss.backward()
+        g = model.llama.layers[0].self_attn.q_proj.weight.grad
+        assert g is not None
+        assert np.isfinite(np.asarray(g._data)).all()
+
+    def test_mutually_exclusive_with_attn_mask(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        P.seed(0)
+        model = LlamaForCausalLM(self._cfg())
+        ids = P.to_tensor(np.zeros((1, 128), np.int32))
+        m = P.to_tensor(np.ones((1, 1, 128, 128), bool))
+        idx = P.to_tensor(np.zeros((1, 1, 128, 1), np.int32))
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            model(ids, attn_mask=m, attn_mask_startend_row_indices=idx)
