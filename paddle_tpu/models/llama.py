@@ -187,6 +187,13 @@ class LlamaAttention(Layer):
                 raise ValueError(
                     "attn_mask and attn_mask_startend_row_indices are "
                     "mutually exclusive")
+            if cache is not None:
+                # cached decode offsets query rows into local new-token
+                # coordinates — globally-authored bounds would silently
+                # misalign
+                raise NotImplementedError(
+                    "attn_mask_startend_row_indices with a kv cache is "
+                    "not supported (query-row coordinates shift)")
             if self.cfg.context_parallel:
                 raise NotImplementedError(
                     "attn_mask_startend_row_indices does not compose "

@@ -428,7 +428,21 @@ def _fm_dense_mask(fm_start, fm_end, sq, fm_start2=None, fm_end2=None):
 def _fm_ref(q, k, v, fm_start, fm_end, fm_start2, fm_end2, causal,
             scale):
     m = _fm_dense_mask(fm_start, fm_end, q.shape[1], fm_start2, fm_end2)
-    return _attention_ref(q, k, v, mask=m, causal=causal, scale=scale)
+    # fully-masked rows (padding rows whose visible columns are all
+    # dead, or causally-dead rows at sq > sk): the kernel emits exact
+    # zeros with zero grads; softmax of an all--inf row would emit nan
+    # with NaN GRADS through the vjp. Fold causal INTO the mask, run
+    # dead rows unmasked (mask and causal both neutralized), and zero
+    # their output.
+    sq, sk = q.shape[1], k.shape[1]
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        m = jnp.where(cm[None, None], m, -jnp.inf)
+    dead_row = jnp.all(~jnp.isfinite(m), axis=-1)      # [B|1, H|1, Sq]
+    m_safe = jnp.where(dead_row[..., None], 0.0, m)
+    out = _attention_ref(q, k, v, mask=m_safe, causal=False,
+                         scale=scale)
+    return jnp.where(jnp.swapaxes(dead_row, 1, 2)[..., None], 0.0, out)
 
 
 def _try_kernel_fm(q, k, v, fm, causal, scale, want_lse, site):

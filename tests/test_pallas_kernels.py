@@ -665,6 +665,27 @@ class TestFlashMask:
         assert np.allclose(np.asarray(out2._data), np.asarray(ref2),
                            atol=2e-4)
 
+    def test_fully_masked_rows_fallback_grads_finite(self):
+        """The DENSE fallback (_fm_ref, off-TPU path) must match the
+        kernel's fully-masked-row contract: zero output AND zero (not
+        NaN) gradients — softmax-of-all--inf NaN'd packed-doc training
+        through the fallback until round 4."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import _fm_ref
+        q, k, v = qkv(b=1, s=128, h=2, d=32)   # head_dim off-kernel
+        start = jnp.zeros((1, 1, 128), jnp.int32)   # all rows masked
+        end = jnp.full((1, 1, 128), 2 ** 31 - 1, jnp.int32)
+
+        def loss(a, b_, c):
+            return (_fm_ref(a, b_, c, start, end, None, None, True,
+                            None) ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for arr in g:
+            assert np.isfinite(np.asarray(arr)).all()
+            assert np.allclose(np.asarray(arr), 0.0)
+
     def test_fully_masked_rows_zero(self):
         """A row masked in every live column outputs exactly 0 (and the
         kernel never NaNs — the dense-oracle vjp would)."""
