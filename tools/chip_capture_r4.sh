@@ -14,7 +14,8 @@ stamp() { date -u +%H:%M:%S; }
 run() {
   echo "=== $(stamp) $*"
   "$@"
-  echo "=== $(stamp) rc=$?"
+  local rc=$?   # capture BEFORE any further command substitution
+  echo "=== $(stamp) rc=$rc"
 }
 
 # 1. kernel parity on-chip — first run of the round-4 masked-bwd +
