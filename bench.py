@@ -153,10 +153,14 @@ def main():
                           num_attention_heads=4)
         batch, seq, iters = 2, 128, 3
 
+    from paddle_tpu.ops.pallas import flash_attention as _fa
     while True:
         # Build everything inside the retry loop: the train step donates
         # params/buffers/opt-states, so a failed execution can leave them
         # deleted — a fresh model/optimizer is required for the retry.
+        # Reset dispatch counters per attempt so the banked stats
+        # describe THIS measurement, not failed/earlier traces.
+        _fa.reset_dispatch_stats()
         P.seed(0)
         model = LlamaForCausalLM(cfg)
         if on_tpu:
@@ -236,6 +240,11 @@ def main():
         "loss": float(loss),
         "mfu_wall": round(mfu_wall, 4),
         "relay_overhead_s_est": round(max(0.0, t_s - iters_s * step_s), 3),
+        # kernel-engagement accounting IN the artifact: a silent Pallas
+        # fallback cost round 2 ~24 MFU points before it was root-caused
+        # — any fallback > 0 on TPU means the number is not a kernel
+        # number (flash_attention.py dispatch discipline)
+        "pallas_dispatch": _fa.dispatch_stats(),
     }
     if not tpu_ok:
         # a CPU proxy number carries NO evidence against the 50%-on-TPU
