@@ -64,13 +64,25 @@ class GPTAttention(Layer):
             self.qkv_proj = Linear(h, 3 * h)
             self.out_proj = Linear(h, h)
 
-    def forward(self, x):
+    def forward(self, x, attn_mask=None, startend_row_indices=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.nh, self.hd])
         q, k, v = qkv.unbind(axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.drop,
-            training=self.training)
+        if startend_row_indices is not None:
+            # FlashMask (reference: attn_mask_startend_row_indices) —
+            # document-packing masks at O(Sk) memory
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask and attn_mask_startend_row_indices are "
+                    "mutually exclusive")
+            from ..ops.pallas.flash_attention import flashmask_attention
+            out = flashmask_attention(
+                q, k, v, startend_row_indices=startend_row_indices,
+                dropout=self.drop, causal=True, training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=True,
+                dropout_p=self.drop, training=self.training)
         return self.out_proj(out.reshape([b, s, self.nh * self.hd]))
 
     def forward_cached(self, x, k_buf, v_buf, offset):
@@ -106,12 +118,14 @@ class GPTBlock(Layer):
             self.fc_out = Linear(cfg.intermediate_size, cfg.hidden_size)
         self.drop = Dropout(cfg.hidden_dropout_prob)
 
-    def _block(self, x):
-        x = x + self.drop(self.attn(self.ln_1(x)))
+    def _block(self, x, attn_mask=None, startend_row_indices=None):
+        x = x + self.drop(self.attn(
+            self.ln_1(x), attn_mask=attn_mask,
+            startend_row_indices=startend_row_indices))
         return x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)),
                                                 approximate=True)))
 
-    def forward(self, x):
+    def forward(self, x, attn_mask=None, startend_row_indices=None):
         if self.cfg.recompute and self.training:
             from ..distributed.fleet.recompute import recompute
 
@@ -123,9 +137,12 @@ class GPTBlock(Layer):
                     s.inner = outer
 
                 def forward(s, h):
-                    return s.inner._block(h)
+                    return s.inner._block(
+                        h, attn_mask=attn_mask,
+                        startend_row_indices=startend_row_indices)
             return recompute(_Body(), x)
-        return self._block(x)
+        return self._block(x, attn_mask=attn_mask,
+                           startend_row_indices=startend_row_indices)
 
     def forward_cached(self, x, k_buf, v_buf, offset):
         a, k_buf, v_buf = self.attn.forward_cached(self.ln_1(x), k_buf,
@@ -165,14 +182,16 @@ class GPTModel(Layer):
             new.append((kb, vb))
         return self.ln_f(x), new
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                attn_mask_startend_row_indices=None):
         s = input_ids.shape[1]
         if position_ids is None:
             position_ids = P.arange(s).unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         for block in self.h:
-            x = block(x)
+            x = block(x, attn_mask=attn_mask,
+                      startend_row_indices=attn_mask_startend_row_indices)
         return self.ln_f(x)
 
 
@@ -189,8 +208,11 @@ class GPTForCausalLM(Layer, GenerationMixin):
             self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
-        return self.lm_head(self.gpt(input_ids, position_ids))
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                attn_mask_startend_row_indices=None):
+        return self.lm_head(self.gpt(
+            input_ids, position_ids, attn_mask,
+            attn_mask_startend_row_indices=attn_mask_startend_row_indices))
 
     # -- static-cache generation hooks (GenerationMixin) ---------------------
     def _init_caches(self, batch, total_len, cache_dtype=None):

@@ -334,3 +334,83 @@ class TestLlamaFlashMask:
         idx = P.to_tensor(np.zeros((1, 1, 128, 1), np.int32))
         with pytest.raises(ValueError, match="mutually exclusive"):
             model(ids, attn_mask=m, attn_mask_startend_row_indices=idx)
+
+
+class TestGPTMasks:
+    """Round-4: GPT accepts attn_mask AND attn_mask_startend_row_indices
+    (it previously took neither — reference GPT forward carries an
+    attention_mask)."""
+
+    def _model(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        P.seed(0)
+        return GPTForCausalLM(GPTConfig(
+            vocab_size=128, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=256, hidden_dropout_prob=0.0,
+            attention_dropout_prob=0.0))
+
+    def test_flashmask_document_isolation(self, monkeypatch):
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        model = self._model()
+        ids = np.random.default_rng(0).integers(
+            0, 128, (1, 256)).astype(np.int32)
+        starts = np.full((1, 1, 256, 1), 2 ** 31 - 1, np.int32)
+        starts[:, :, :128, 0] = 128
+        out = model(P.to_tensor(ids),
+                    attn_mask_startend_row_indices=P.to_tensor(starts))
+        stats = fa.dispatch_stats()
+        assert stats["fallback"] == 0 and stats["pallas"] >= 2, stats
+        out0 = model(P.to_tensor(ids[:, :128]))
+        np.testing.assert_allclose(np.asarray(out._data)[:, :128],
+                                   np.asarray(out0._data), atol=1e-4)
+
+    def test_attn_mask_load_bearing(self):
+        """The padding mask must actually change row 1's outputs: its
+        first 48 positions equal running the 48-token prefix alone."""
+        model = self._model()
+        ids_np = np.random.default_rng(1).integers(
+            0, 128, (2, 64)).astype(np.int32)
+        keep = np.ones((2, 1, 1, 64), bool)
+        keep[1, :, :, 48:] = False          # pad tail of row 1
+        out = model(P.to_tensor(ids_np), attn_mask=P.to_tensor(keep))
+        assert list(out.shape) == [2, 64, 128]
+        alone = model(P.to_tensor(ids_np[1:2, :48]))
+        np.testing.assert_allclose(
+            np.asarray(out._data)[1, :48],
+            np.asarray(alone._data)[0], atol=1e-4)
+        # and the mask is not a no-op vs the unmasked run
+        unmasked = model(P.to_tensor(ids_np))
+        # causal: rows < 48 never see cols >= 48, so compare a late row
+        d = np.abs(np.asarray(out._data)[1, 60] -
+                   np.asarray(unmasked._data)[1, 60]).max()
+        assert d > 1e-4
+
+    def test_flashmask_trains_with_remat(self, monkeypatch):
+        """The recompute branch threads the mask closures (backward
+        replay must see the same bounds)."""
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        P.seed(0)
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=128, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=256, hidden_dropout_prob=0.0,
+            attention_dropout_prob=0.0, recompute=True))
+        ids = np.random.default_rng(2).integers(
+            0, 128, (1, 256)).astype(np.int32)
+        starts = np.full((1, 1, 256, 1), 2 ** 31 - 1, np.int32)
+        starts[:, :, :128, 0] = 128
+        crit = P.nn.CrossEntropyLoss()
+        logits = model(P.to_tensor(ids),
+                       attn_mask_startend_row_indices=P.to_tensor(starts))
+        loss = crit(logits.reshape([-1, 128]),
+                    P.to_tensor(ids.reshape(-1).astype(np.int64)))
+        loss.backward()
+        g = model.gpt.h[0].attn.qkv_proj.weight.grad
+        assert g is not None
+        assert np.isfinite(np.asarray(g._data)).all()
+        assert np.abs(np.asarray(g._data)).sum() > 0
