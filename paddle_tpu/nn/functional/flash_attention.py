@@ -52,6 +52,13 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         out = _unpadded_kernel_path(q, k, v, cq, ck, sc, causal)
         if out is not None:
             return out, None
+    elif dropout > 0.0 or return_softmax:
+        # COUNTED fallback on TPU (module discipline: no silent
+        # Pallas→XLA reroute — round-2 cost 24 MFU points silently)
+        from ...ops.pallas.flash_attention import _fallback, _want_pallas
+        if _want_pallas():
+            _fallback("flash_attn_unpadded prob-dropout/return_softmax: "
+                      "XLA reference (no in-kernel PRNG/probs path)")
 
     dkey = next_key() if dropout > 0.0 else None
 

@@ -200,17 +200,19 @@ class TestMultiControllerSPMD:
         res = [json.load(open(tmp_path / f"spmd_mc.{rk}.json"))
                for rk in range(2)]
         # both controllers observe the same global loss sequence
-        for key in ("zero3", "dp_tp", "pipeline_4d"):
+        for key in ("zero3", "dp_tp", "pipeline_4d", "sep", "ep"):
             assert np.allclose(res[0][key], res[1][key]), (key, res)
 
         # single-process oracle: same model/seed/data on this process's
         # own 8-device mesh (conftest), same fleet configs
         from tests.workers.spmd_mc_worker import (MLP, TPMLP, run_config,
-                                                  run_pipeline,
-                                                  _reset_fleet)
+                                                  run_ep, run_pipeline,
+                                                  run_sep, _reset_fleet)
         oracle_z3 = run_config({"sharding_degree": 8}, MLP, stage=3)
         oracle_tp = run_config({"dp_degree": 2, "mp_degree": 4}, TPMLP)
         oracle_pp = run_pipeline()
+        oracle_sep = run_sep()
+        oracle_ep = run_ep()
         _reset_fleet()
         assert np.allclose(res[0]["zero3"], oracle_z3, rtol=2e-3,
                            atol=2e-4), (res[0]["zero3"], oracle_z3)
@@ -219,6 +221,12 @@ class TestMultiControllerSPMD:
         # the PIPELINE runtime (pp2 x mp2 x ZeRO-3(2)) across processes
         assert np.allclose(res[0]["pipeline_4d"], oracle_pp, rtol=2e-3,
                            atol=2e-4), (res[0]["pipeline_4d"], oracle_pp)
+        # ring context-parallel training (sep) across processes
+        assert np.allclose(res[0]["sep"], oracle_sep, rtol=2e-3,
+                           atol=2e-4), (res[0]["sep"], oracle_sep)
+        # MoE expert-parallel step (sort dispatch) across processes
+        assert np.allclose(res[0]["ep"], oracle_ep, rtol=2e-3,
+                           atol=2e-4), (res[0]["ep"], oracle_ep)
 
 
 class TestElasticScaleOut:

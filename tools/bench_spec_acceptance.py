@@ -1,22 +1,28 @@
-"""Real-draft speculative acceptance curve (round 4, VERDICT r3 item 6).
+"""Real-draft speculative acceptance curve (round 4, VERDICT r3 item 6;
+round 5: KL-DISTILLED draft, VERDICT r4 missing #6 / next-round task 4).
 
-Round 3 shipped token-exact speculative decoding but the only measured
-acceptance was the degenerate self-draft 1.0; the serving-speedup claim
-in FEASIBILITY.md was a model. This measures the real thing:
+Round 4 measured the honest curve with a CE-trained 1-layer draft:
+acceptance 0.28/0.23/0.12/0.06 at k=1/2/4/8, best speedup 1.12x — the
+draft was the bottleneck, not the mechanism. Round 5 distills the draft
+the way a serving stack would:
 
 - target: byte-level LLaMA (4 layers) trained on local text (the repo's
-  docs, same recipe as tools/eval_kv8_quality.py);
-- draft: 1-layer model trained on the SAME data (the practical
-  distill-from-corpus draft) — acceptance < 1;
-- for k in {1, 2, 4, 8}: greedy generate with/without the draft, record
-  verify rounds → measured acceptance, plus the marginal decode rate
-  (two-point measurement, relay/noise-proof) → measured speedup.
+  docs, same recipe as tools/eval_kv8_quality.py), longer schedule;
+- draft: 1-layer model DISTILLED on the target's logits (full-softmax
+  KL at T=1, >=2k steps) — argmax agreement is what greedy speculative
+  acceptance pays for, and KL on soft targets is the standard recipe;
+- diagnostics: teacher-forced held-out argmax agreement (the acceptance
+  upper bound), then for k in {1, 2, 4, 8}: greedy generate with/
+  without the draft, verify rounds → measured acceptance, marginal
+  decode rate (two-point measurement, relay/noise-proof) → measured
+  speedup; plus a batch>1 row at the best k.
 
 CPU numbers stand in for the chip when the tunnel is down (wall ratios,
 not absolute rates, are the product here); the same script runs on TPU
 unchanged.
 
-Run: python tools/bench_spec_acceptance.py [--steps 300]
+Run: python tools/bench_spec_acceptance.py [--steps 1500]
+     [--distill-steps 2500]
 Writes BENCH_spec_acceptance.json at the repo root.
 """
 import argparse
@@ -51,6 +57,48 @@ def build(layers, seed, maxpos):
     return LlamaForCausalLM(cfg)
 
 
+def distill(draft, target, arr, steps, lr=3e-3):
+    """KL(teacher || student) on the target's full softmax (T=1): the
+    greedy-acceptance objective is argmax agreement, and matching the
+    whole distribution where the teacher is confident is what buys it."""
+    from tools.eval_kv8_quality import SEQ, batches
+    import paddle_tpu.nn.functional as F
+    target.eval()
+    opt = P.optimizer.AdamW(lr, parameters=draft.parameters())
+    rng = np.random.default_rng(3)
+    kl = None
+    t0 = time.time()
+    for i, chunk in enumerate(batches(arr, rng, steps)):
+        ids = P.to_tensor(chunk[:, :-1])
+        with P.no_grad():
+            t_logits = target(ids)
+        t_logp = F.log_softmax(t_logits.detach(), axis=-1)
+        s_logp = F.log_softmax(draft(ids), axis=-1)
+        kl = (t_logp.exp() * (t_logp - s_logp)).sum(-1).mean()
+        kl.backward()
+        opt.step()
+        opt.clear_grad()
+        if i % 100 == 0:
+            print(f"distill step {i}: KL {float(kl.numpy()):.4f} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+    return float(kl.numpy()) if kl is not None else float("nan")
+
+
+def argmax_agreement(draft, target, held, n_seq=24, seq=192):
+    """Teacher-forced held-out argmax agreement — the ceiling on greedy
+    speculative acceptance."""
+    rng = np.random.default_rng(7)
+    agree = total = 0
+    for _ in range(n_seq):
+        s = int(rng.integers(0, len(held) - seq))
+        ids = P.to_tensor(held[s:s + seq][None].astype(np.int32))
+        ta = np.argmax(np.asarray(target(ids)._data), -1)
+        da = np.argmax(np.asarray(draft(ids)._data), -1)
+        agree += int((ta == da).sum())
+        total += ta.size
+    return agree / total
+
+
 def marginal_rate(model, prompts, gen_kw, new=NEW):
     """Two-point marginal decode rate (PERF.md protocol): extra tokens /
     extra wall between a full and a quarter run, min of 2 samples."""
@@ -79,20 +127,27 @@ def marginal_rate(model, prompts, gen_kw, new=NEW):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--steps", type=int, default=1500)
+    ap.add_argument("--distill-steps", type=int, default=2500)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--batch2", type=int, default=4,
+                    help="second batch size measured at the best k")
     args = ap.parse_args()
 
     train_arr, held = corpus()
     maxpos = PROMPT + NEW + 16
     target = build(4, 0, maxpos)
-    print("training target (4 layers)...", flush=True)
+    print(f"training target (4 layers, {args.steps} steps)...", flush=True)
     train(target, train_arr, args.steps)
     target.eval()
     draft = build(1, 1, maxpos)
-    print("training draft (1 layer, same data)...", flush=True)
-    train(draft, train_arr, args.steps)
+    print(f"distilling draft (1 layer, {args.distill_steps} KL steps)...",
+          flush=True)
+    final_kl = distill(draft, target, train_arr, args.distill_steps)
     draft.eval()
+    agree = argmax_agreement(draft, target, held)
+    print(f"held-out argmax agreement {agree:.3f} (final KL "
+          f"{final_kl:.4f})", flush=True)
 
     # prompts drawn from held-out text (the distribution that matters)
     rng = np.random.default_rng(2)
@@ -121,13 +176,39 @@ def main():
         rows.append(row)
         print(json.dumps(row), flush=True)
 
+    # batch>1 at the best k (serving batches amortize the verify pass)
+    batch2_row = None
+    best = max(rows, key=lambda r: r["speedup_vs_greedy"] or 0)
+    if args.batch2 > args.batch and best["speedup_vs_greedy"]:
+        prompts2 = []
+        for _ in range(8):
+            starts = rng.integers(0, len(held) - PROMPT, args.batch2)
+            prompts2.append(
+                np.stack([held[s:s + PROMPT] for s in starts])
+                .astype(np.int32))
+        b2_base, _ = marginal_rate(target, prompts2, {})
+        b2_rate, _ = marginal_rate(
+            target, prompts2,
+            dict(draft_model=draft, speculative_k=best["k"]))
+        if b2_base and b2_rate:
+            batch2_row = {"batch": args.batch2, "k": best["k"],
+                          "marginal_tok_s": round(b2_rate, 1),
+                          "greedy_marginal_tok_s": round(b2_base, 1),
+                          "speedup_vs_greedy":
+                              round(b2_rate / b2_base, 2)}
+            print(json.dumps(batch2_row), flush=True)
+
     out = {"metric": "speculative_acceptance_curve",
            "target_layers": 4, "draft_layers": 1,
-           "train_steps": args.steps, "batch": args.batch,
+           "train_steps": args.steps,
+           "distill_steps": args.distill_steps,
+           "distill": "KL on target logits (T=1)",
+           "heldout_argmax_agreement": round(agree, 4),
+           "batch": args.batch,
            "prompt": PROMPT, "new_tokens": NEW,
            "backend": jax.default_backend(),
            "greedy_marginal_tok_s": base_rate and round(base_rate, 1),
-           "rows": rows}
+           "rows": rows, "batch2": batch2_row}
     with open(os.path.join(REPO, "BENCH_spec_acceptance.json"), "w") as f:
         json.dump(out, f, indent=1)
     print("written BENCH_spec_acceptance.json")
