@@ -114,11 +114,51 @@ def _masked_scores(s, q0, k0, causal, offset, mask_blk, qseg, kseg,
     return s
 
 
-def _online_softmax_step(s, v, m, l, acc):
+def _seed_lanes(seed):
+    """Dropout seed as a [1, LANES] int32 operand (lane-width minor dim
+    keeps Mosaic's tiling happy; kernels read element [0, 0])."""
+    s = jnp.asarray(seed, jnp.int32).reshape(-1)[:1]
+    return jnp.broadcast_to(s[None, :], (1, LANES))
+
+
+def _keep_scale(seed, bh, q0, k0, bq, bk, drop_p):
+    """Counter-based dropout mask for one (q-block, k-block) tile:
+    keep/(1-p) scale factors [bq, bk] f32, a PURE function of
+    (seed, flat head-batch, absolute row, absolute col) — the forward
+    and both backward kernels regenerate bit-identical masks, and tests
+    reconstruct them outside the kernel for exact oracles. Two rounds of
+    the murmur3 finalizer (fmix32) over a linear index combination; all
+    plain uint32 vector ops, so it runs under Mosaic AND interpret mode
+    (pltpu.prng_* has no CPU lowering). The same design as CUDA
+    flash-attn's in-kernel Philox dropout, TPU-native."""
+    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    bh_u = jnp.asarray(bh).astype(jnp.uint32)   # traced program_id ok
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1) ^
+         cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77) ^
+         (bh_u * jnp.uint32(0xC2B2AE3D)) ^
+         jnp.asarray(seed).astype(jnp.uint32))
+    for _ in range(2):
+        x = x ^ (x >> jnp.uint32(16))
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> jnp.uint32(13))
+        x = x * jnp.uint32(0xC2B2AE35)
+        x = x ^ (x >> jnp.uint32(16))
+    thresh = jnp.uint32(min(int(drop_p * 2.0 ** 32), 2 ** 32 - 1))
+    keep = (x >= thresh).astype(jnp.float32)
+    return keep * jnp.float32(1.0 / (1.0 - drop_p))
+
+
+def _online_softmax_step(s, v, m, l, acc, keep_scale=None):
     """One online-softmax block update (shared by both forward kernels):
     (m, l, acc) carry ← masked scores s [bq, bk] and values v [bk, D].
     Fully-masked-so-far rows keep m = -inf; exps run against a finite
-    max so the accumulators stay nan-free."""
+    max so the accumulators stay nan-free.
+
+    `keep_scale` (dropout): the PV accumulation uses the dropped+
+    rescaled probs while `l` keeps the UNdropped sum — out = acc/l then
+    equals dropout applied to the normalized softmax (the reference
+    prob-dropout semantics), and the lse is dropout-free."""
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_blk)
     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -126,17 +166,23 @@ def _online_softmax_step(s, v, m, l, acc):
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     corr = jnp.exp(m - m_safe)
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+    pd = p if keep_scale is None else p * keep_scale
+    pv = jax.lax.dot_general(pd, v, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     return m_new, l_new, acc * corr + pv
 
 
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
-                   seq_len, has_seg, want_lse):
+                   seq_len, has_seg, want_lse, drop_p=0.0):
     """Resident-K/V forward: full-sequence K/V in VMEM, fori_loop streams
     k blocks with a causal-pruned upper bound (the bench path). Masked
-    and cross-length calls route to `_fa_fwd_stream_kernel` instead."""
+    and cross-length calls route to `_fa_fwd_stream_kernel` instead.
+    `drop_p` > 0 (with a seed ref as the first extra operand) applies
+    in-kernel probability dropout via the counter-based `_keep_scale`
+    hash."""
     i = 0
+    seed_ref = rest[i] if drop_p > 0.0 else None
+    i += 1 if drop_p > 0.0 else 0
     qseg_ref = rest[i] if has_seg else None
     kseg_ref = rest[i + 1] if has_seg else None
     i += 2 if has_seg else 0
@@ -146,6 +192,9 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
     bq, d = q.shape
     qi = pl.program_id(1)
+    # program_id must be read at kernel top level (interpret mode does
+    # not rewrite it inside a fori_loop body) — hoist for the hash
+    bh = pl.program_id(0) if drop_p > 0.0 else None
     n_kb = seq_len // block_k
     if has_seg:
         qseg = qseg_ref[0][:, :1]                     # [bq, 1] int32
@@ -162,7 +211,10 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
             if has_seg else None                      # [1, bk]
         s = _masked_scores(s, qi * bq, i * block_k, causal, 0, None,
                            qseg if has_seg else None, kseg)
-        return _online_softmax_step(s, v, m, l, acc)
+        ks = _keep_scale(seed_ref[0, 0], bh, qi * bq,
+                         i * block_k, bq, block_k, drop_p) \
+            if drop_p > 0.0 else None
+        return _online_softmax_step(s, v, m, l, acc, keep_scale=ks)
 
     def seg_gated_body(i, carry):
         # packed segments are monotone: this (q, k) block pair is dead
@@ -337,7 +389,8 @@ def _check_fm_pairs(fm_start, fm_end, fm_start2, fm_end2):
 def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                block_k=None, interpret=False, return_lse=False, mask=None,
                q_seg=None, kv_seg=None, fm_start=None, fm_end=None,
-               fm_start2=None, fm_end2=None):
+               fm_start2=None, fm_end2=None, dropout_p=0.0,
+               dropout_seed=None):
     """q: [B, Sq, H, D]; k/v: [B, Sk, Hkv, D] (Hkv | H → GQA in-kernel)
     → out [B, Sq, H, D] (+ lse [B*H, Sq, LANES]).
 
@@ -379,6 +432,18 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
               if a is not None]
     n_fm = len(fm_all)
     streamed = has_mask or n_fm or sq != sk
+    drop_p = float(dropout_p)
+    if drop_p > 0.0:
+        if not drop_p < 1.0:
+            raise ValueError(
+                f"in-kernel dropout needs 0 <= p < 1, got {drop_p} "
+                "(p = 1 drops every link; use the reference path)")
+        if streamed:
+            raise NotImplementedError(
+                "in-kernel dropout rides the resident forward only "
+                "(sq == sk, no dense mask / FlashMask); dispatch should "
+                "have taken the XLA reference")
+        assert dropout_seed is not None
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
@@ -388,13 +453,18 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
     if not streamed:
         kernel = functools.partial(_fa_fwd_kernel, scale=sc, causal=causal,
                                    block_k=block_k, seq_len=sk,
-                                   has_seg=has_seg, want_lse=return_lse)
+                                   has_seg=has_seg, want_lse=return_lse,
+                                   drop_p=drop_p)
         grid = (b * h, sq // block_q)
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (kvrow(i), 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (kvrow(i), 0, 0)),
         ]
+        if drop_p > 0.0:
+            in_specs.append(pl.BlockSpec((1, LANES),
+                                         lambda i, j: (0, 0)))
+            args.append(_seed_lanes(dropout_seed))
         if has_seg:
             qs, ks = _seg_layouts(q_seg, kv_seg)
             in_specs.append(pl.BlockSpec((1, block_q, LANES),
@@ -471,13 +541,15 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, scale, causal, block_k, block_q, has_mask,
-                      has_seg, n_fm=0, offset=0):
+                      has_seg, n_fm=0, offset=0, drop_p=0.0):
     """grid = (B*H, n_qb, n_kb); dq block revisited across the innermost
     kb axis (index map drops it), accumulating in an f32 out ref — the
     VMEM-bounded layout: every operand block is O(block · D), nothing is
     sequence-length-resident (at s=8192 the previous full-K/V layout
     overflowed the 16 MB scoped VMEM)."""
     i = 0
+    seed_ref = rest[i] if drop_p > 0.0 else None
+    i += 1 if drop_p > 0.0 else 0
     mask_ref = rest[i] if has_mask else None
     i += 1 if has_mask else 0
     qseg_ref = rest[i] if has_seg else None
@@ -489,6 +561,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     qi = pl.program_id(1)
     kj = pl.program_id(2)
+    bh = pl.program_id(0) if drop_p > 0.0 else None
 
     @pl.when(kj == 0)
     def _init():
@@ -515,6 +588,11 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_p > 0.0:
+            # dpd = dL/dp through the dropout mask (same counter hash as
+            # the forward: identical keep pattern by construction)
+            dp = dp * _keep_scale(seed_ref[0, 0], bh,
+                                  qi * bq, kj * bk, bq, bk, drop_p)
         ds = p * (dp - delta_t)
         dq_ref[0] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -530,13 +608,18 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        *rest, scale, causal, block_q, block_k, n_qb,
-                       has_mask, has_seg, n_fm=0, offset=0):
+                       has_mask, has_seg, n_fm=0, offset=0, drop_p=0.0,
+                       h=None, hkv=None):
     """grid = (B*Hkv, n_kb, G·n_qb); dk/dv blocks revisited across the
     innermost axis — which enumerates (query-head-in-group, q block) —
     accumulated in f32 out refs (same VMEM-bounded design as
     _fa_bwd_dq_kernel; GQA's cross-head dk/dv sum falls out of the
-    revisit accumulation)."""
+    revisit accumulation). For dropout the hash needs the QUERY head's
+    flat (batch·H + h_q) index — reconstructed from this grid's
+    (batch·Hkv + h_kv, t) coordinates via the static h/hkv."""
     i = 0
+    seed_ref = rest[i] if drop_p > 0.0 else None
+    i += 1 if drop_p > 0.0 else 0
     mask_ref = rest[i] if has_mask else None
     i += 1 if has_mask else 0
     qseg_ref = rest[i] if has_seg else None
@@ -550,6 +633,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     t = pl.program_id(2)
     qj = t % n_qb
+    i0 = pl.program_id(0) if drop_p > 0.0 else None
 
     @pl.when(t == 0)
     def _init():
@@ -573,12 +657,21 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            else None)
         p = jnp.exp(s - _stat_cols(lse_ref[0], bk))       # [bq, bk]
         p = jnp.where(jnp.isfinite(s), p, 0.0)
-        # dv += p^T @ do   (contract over q rows — dim 0 on both)
-        dv_ref[0] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_p > 0.0:
+            g = h // hkv
+            bh_q = (i0 // hkv) * h + (i0 % hkv) * g + t // n_qb
+            ks_t = _keep_scale(seed_ref[0, 0], bh_q, qj * bq, ki * bk,
+                               bq, bk, drop_p)
+            pd = p * ks_t
+            dp = dp * ks_t
+        else:
+            pd = p
+        # dv += pd^T @ do   (contract over q rows — dim 0 on both)
+        dv_ref[0] += jax.lax.dot_general(
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - _stat_cols(delta_ref[0], bk))
         # dk += ds^T @ q
         dk_ref[0] += jax.lax.dot_general(
@@ -595,7 +688,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
                 block_q=None, block_k=None, interpret=False, dlse=None,
                 mask=None, q_seg=None, kv_seg=None, fm_start=None,
-                fm_end=None, fm_start2=None, fm_end2=None):
+                fm_end=None, fm_start2=None, fm_end2=None, dropout_p=0.0,
+                dropout_seed=None):
     """FlashAttention-2 backward. q,o,do: [B,S,H,D]; k,v: [B,S,Hkv,D];
     lse: [B*H,S,LANES].
 
@@ -662,8 +756,21 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
     k_col = pl.BlockSpec((1, block_k, d), lambda i, j, t: (kvrow(i), t, 0))
     q_stat = pl.BlockSpec((1, block_q, LANES), lambda i, j, t: (i, j, 0))
 
+    drop_p = float(dropout_p)
+    if drop_p > 0.0:
+        if not drop_p < 1.0:
+            raise ValueError(
+                f"in-kernel dropout needs 0 <= p < 1, got {drop_p}")
+        assert dropout_seed is not None and not (has_mask or n_fm), \
+            "in-kernel dropout: resident envelope only"
+        seed_arr = _seed_lanes(dropout_seed)
+        seed_spec3 = pl.BlockSpec((1, LANES), lambda i, j, t: (0, 0))
+
     in_specs = [q_row, k_col, k_col, q_row, q_stat, q_stat]
     args = [qb, kb, vb, dob, lse, delta]
+    if drop_p > 0.0:
+        in_specs.append(seed_spec3)
+        args.append(seed_arr)
     if has_mask:
         in_specs.append(pl.BlockSpec(
             (1, block_q, block_k),
@@ -686,7 +793,7 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         functools.partial(_fa_bwd_dq_kernel, scale=sc, causal=causal,
                           block_k=block_k, block_q=block_q,
                           has_mask=has_mask, has_seg=has_seg,
-                          n_fm=n_fm, offset=offset),
+                          n_fm=n_fm, offset=offset, drop_p=drop_p),
         out_shape=_sds((b * h, sq, d), jnp.float32, qb, kb, vb, dob, lse),
         grid=(b * h, n_qb, n_kb),
         in_specs=in_specs,
@@ -708,6 +815,9 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
 
     in_specs2 = [q_row2, k_col2, k_col2, q_row2, q_stat2, q_stat2]
     args2 = [qb, kb, vb, dob, lse, delta]
+    if drop_p > 0.0:
+        in_specs2.append(seed_spec3)
+        args2.append(seed_arr)
     if has_mask:
         in_specs2.append(pl.BlockSpec(
             (1, block_q, block_k),
@@ -734,7 +844,8 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         functools.partial(_fa_bwd_dkv_kernel, scale=sc, causal=causal,
                           block_q=block_q, block_k=block_k, n_qb=n_qb,
                           has_mask=has_mask, has_seg=has_seg,
-                          n_fm=n_fm, offset=offset),
+                          n_fm=n_fm, offset=offset, drop_p=drop_p,
+                          h=h, hkv=hkv),
         out_shape=[_sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,
                         lse),
                    _sds((b * hkv, sk, d), jnp.float32, qb, kb, vb, dob,

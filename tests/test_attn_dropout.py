@@ -165,6 +165,145 @@ class TestProbDropoutSemantics:
         assert np.allclose(e1, e2)
 
 
+def _hash_drop_oracle(qj, kj, vj, seed, p, causal=True, q_seg=None,
+                      kv_seg=None):
+    """Exact oracle for the IN-KERNEL counter-hash dropout: the keep
+    mask is a pure function of (seed, bh, row, col), so it reconstructs
+    outside the kernel bit-identically."""
+    from paddle_tpu.ops.pallas._fa_kernel import _keep_scale
+    b, sq, h, dh = qj.shape
+    sk, hkv = kj.shape[1], kj.shape[2]
+    kr, vr = kj, vj
+    if hkv != h:
+        kr = jnp.repeat(kr, h // hkv, axis=2)
+        vr = jnp.repeat(vr, h // hkv, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qj, kr,
+                        preferred_element_type=jnp.float32) / np.sqrt(dh)
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if q_seg is not None:
+        eq = (q_seg[:, None, :, None] == kv_seg[:, None, None, :]) & \
+             (q_seg[:, None, :, None] >= 0) & \
+             (kv_seg[:, None, None, :] >= 0)
+        logits = jnp.where(eq, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, -1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    ks = jnp.stack([
+        jnp.stack([_keep_scale(jnp.int32(seed), bi * h + hi, 0, 0,
+                               sq, sk, p) for hi in range(h)])
+        for bi in range(b)])                       # [b, h, sq, sk]
+    pd = probs * ks
+    return jnp.einsum("bhqk,bkhd->bqhd", pd, vr.astype(jnp.float32)) \
+        .astype(qj.dtype)
+
+
+class TestKernelHashDropout:
+    """In-kernel counter-hash dropout (round 5): interpret-mode kernels
+    vs the reconstructed-mask oracle — EXACT, fwd and bwd."""
+
+    def _qkv(self, b=1, s=256, h=2, hkv=None, d=64, seed=0):
+        rng = np.random.default_rng(seed)
+        hk = hkv or h
+        return (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                            jnp.float32),
+                jnp.asarray(rng.standard_normal((b, s, hk, d)),
+                            jnp.float32),
+                jnp.asarray(rng.standard_normal((b, s, hk, d)),
+                            jnp.float32))
+
+    def test_forward_exact_vs_oracle(self):
+        from paddle_tpu.ops.pallas._fa_kernel import fa_forward
+        qj, kj, vj = self._qkv()
+        seed = jnp.asarray([1234], jnp.int32)
+        out = fa_forward(qj, kj, vj, causal=True, interpret=True,
+                         dropout_p=0.3, dropout_seed=seed)
+        exp = _hash_drop_oracle(qj, kj, vj, 1234, 0.3, causal=True)
+        assert np.allclose(np.asarray(out), np.asarray(exp), atol=2e-4)
+
+    def test_forward_gqa_and_segments(self):
+        from paddle_tpu.ops.pallas._fa_kernel import fa_forward
+        qj, kj, vj = self._qkv(b=2, h=4, hkv=2)
+        seg = np.zeros((2, 256), np.int32)
+        seg[:, 128:] = 1
+        seg[:, 250:] = -1          # padding tail
+        segj = jnp.asarray(seg)
+        seed = jnp.asarray([77], jnp.int32)
+        out = fa_forward(qj, kj, vj, causal=False, interpret=True,
+                         q_seg=segj, kv_seg=segj,
+                         dropout_p=0.2, dropout_seed=seed)
+        exp = _hash_drop_oracle(qj, kj, vj, 77, 0.2, causal=False,
+                                q_seg=segj, kv_seg=segj)
+        assert np.allclose(np.asarray(out), np.asarray(exp), atol=2e-4)
+
+    def test_backward_exact_vs_oracle(self):
+        from paddle_tpu.ops.pallas._fa_kernel import (fa_backward,
+                                                      fa_forward)
+        qj, kj, vj = self._qkv(h=4, hkv=2)
+        seed = jnp.asarray([99], jnp.int32)
+        out, lse = fa_forward(qj, kj, vj, causal=True, interpret=True,
+                              return_lse=True, dropout_p=0.25,
+                              dropout_seed=seed)
+        g = jnp.ones_like(out)
+        dq, dk, dv = fa_backward(qj, kj, vj, out, lse, g, causal=True,
+                                 interpret=True, dropout_p=0.25,
+                                 dropout_seed=seed)
+
+        def loss(q_, k_, v_):
+            return _hash_drop_oracle(q_, k_, v_, 99, 0.25,
+                                     causal=True).sum()
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(qj, kj, vj)
+        assert np.allclose(np.asarray(dq), np.asarray(gq), atol=3e-3)
+        assert np.allclose(np.asarray(dk), np.asarray(gk), atol=3e-3)
+        assert np.allclose(np.asarray(dv), np.asarray(gv), atol=3e-3)
+
+    def test_deterministic_and_seed_sensitive(self):
+        from paddle_tpu.ops.pallas._fa_kernel import fa_forward
+        qj, kj, vj = self._qkv()
+        s1 = jnp.asarray([5], jnp.int32)
+        a = fa_forward(qj, kj, vj, causal=True, interpret=True,
+                       dropout_p=0.3, dropout_seed=s1)
+        b = fa_forward(qj, kj, vj, causal=True, interpret=True,
+                       dropout_p=0.3, dropout_seed=s1)
+        c = fa_forward(qj, kj, vj, causal=True, interpret=True,
+                       dropout_p=0.3, dropout_seed=jnp.asarray(
+                           [6], jnp.int32))
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_drop_fraction_tracks_p(self):
+        from paddle_tpu.ops.pallas._fa_kernel import _keep_scale
+        for p in (0.1, 0.3, 0.5):
+            ks = _keep_scale(jnp.int32(42), 3, 0, 0, 512, 512, p)
+            frac = float((np.asarray(ks) == 0.0).mean())
+            assert abs(frac - p) < 0.01, (p, frac)
+
+    def test_dispatch_and_train_grad(self, monkeypatch):
+        """PADDLE_TPU_FA_KERNEL_DROPOUT=1 routes dropout>0 training to
+        the kernel (no fallback), grads flow, eval stays exact."""
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        monkeypatch.setenv("PADDLE_TPU_FA_KERNEL_DROPOUT", "1")
+        rng = np.random.default_rng(0)
+        q = paddle.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                             .astype(np.float32))
+        k = paddle.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                             .astype(np.float32))
+        v = paddle.to_tensor(rng.standard_normal((1, 256, 2, 64))
+                             .astype(np.float32))
+        q.stop_gradient = False
+        fa.reset_dispatch_stats()
+        paddle.seed(9)
+        out = fa.flash_attention_bshd(q, k, v, causal=True,
+                                      dropout_p=0.3)
+        st = fa.dispatch_stats()
+        assert st["pallas"] >= 1 and st["fallback"] == 0, st
+        out.sum().backward()
+        assert np.abs(np.asarray(q.grad._data)).sum() > 0
+        base = fa.flash_attention_bshd(q, k, v, causal=True)
+        assert not np.allclose(np.asarray(out._data),
+                               np.asarray(base._data))
+
+
 class TestReturnSoftmax:
     def test_flash_attention_returns_real_probs(self):
         q, k, v = qkv()
@@ -242,13 +381,55 @@ class TestFlashMaskDropout:
                                           return_softmax_lse=True)
         assert lse is not None and list(lse.shape) == [1, 2, 16]
 
-    def test_lse_warns_when_unavailable(self):
+    def test_lse_real_with_startend(self):
+        """round 5: return_softmax_lse with startend bounds returns the
+        exact masked logsumexp (no more None shim)."""
+        q, k, v = qkv(b=1, s=16, h=2, d=8)
+        se_np = np.full((1, 1, 16, 1), 16, np.int32)
+        se_np[0, 0, 8:, 0] = 12
+        se = paddle.to_tensor(jnp.asarray(se_np))
+        out, lse = fa.flashmask_attention(q, k, v,
+                                          startend_row_indices=se,
+                                          return_softmax_lse=True)
+        assert lse is not None and list(lse.shape) == [1, 2, 16]
+        fm = fa._normalize_startend(jnp.asarray(se_np), 16)
+        m = fa._fm_causal_mask(tuple(fm) + (None,) * (4 - len(fm)),
+                               16, 16, True)
+        exp_out, exp_lse = fa._attention_ref_lse(
+            q._data, k._data, v._data, causal=False, mask=m)
+        assert np.allclose(np.asarray(lse._data), np.asarray(exp_lse),
+                           atol=1e-5)
+        assert np.allclose(np.asarray(out._data), np.asarray(exp_out),
+                           atol=1e-5)
+
+    def test_lse_dead_rows_finite_grads(self):
+        """Fully-masked rows through the lse REFERENCE path: zero
+        output, lse=-inf, and FINITE zero grads (logsumexp's raw VJP
+        would emit NaN) — the dead-row contract `_fm_ref` keeps."""
+        q, k, v = qkv(b=1, s=16, h=2, d=8, grad=True)
+        se_np = np.zeros((1, 1, 16, 2), np.int32)
+        se_np[..., 0] = 0
+        se_np[..., 1] = 16        # every column masks ALL query rows
+        se = paddle.to_tensor(jnp.asarray(se_np))
+        out, lse = fa.flashmask_attention(q, k, v,
+                                          startend_row_indices=se,
+                                          causal=False,
+                                          return_softmax_lse=True)
+        assert np.all(np.asarray(out._data) == 0.0)
+        assert np.all(np.isneginf(np.asarray(lse._data)))
+        out.sum().backward()
+        g = np.asarray(q.grad._data)
+        assert np.all(np.isfinite(g)) and np.allclose(g, 0.0)
+
+    def test_lse_warns_with_dropout(self):
         q, k, v = qkv(b=1, s=16, h=2, d=8)
         se = paddle.to_tensor(jnp.full((1, 1, 16, 1), 16, jnp.int32))
+        paddle.seed(3)
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             _, lse = fa.flashmask_attention(q, k, v,
                                             startend_row_indices=se,
+                                            dropout=0.2,
                                             return_softmax_lse=True)
         assert lse is None
         assert any("lse=None" in str(x.message) for x in w)

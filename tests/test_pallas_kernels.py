@@ -665,6 +665,123 @@ class TestFlashMask:
         assert np.allclose(np.asarray(out2._data), np.asarray(ref2),
                            atol=2e-4)
 
+    def test_window_composes_with_c1_bounds(self, monkeypatch):
+        """round 5: window_size + C=1 startend_row_indices folds to the
+        column-wise min of LT-starts — matches the dense AND of the two
+        masks."""
+        import paddle_tpu as P
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        rng = np.random.default_rng(21)
+        s, w = 256, 31
+        qn, kn, vn = (rng.standard_normal((1, s, 2, 64))
+                      .astype(np.float32) for _ in range(3))
+        # document mask: columns 64.. mask rows >= 128 (C=1 LT-start)
+        se = np.full((1, 1, s, 1), s, np.int32)
+        se[0, 0, 64:, 0] = 128
+        out = P.nn.functional.flashmask_attention(
+            P.to_tensor(qn), P.to_tensor(kn), P.to_tensor(vn),
+            startend_row_indices=P.to_tensor(jnp.asarray(se)),
+            window_size=w, causal=True)
+        i = np.arange(s)[:, None]
+        j = np.arange(s)[None, :]
+        keep = (j <= i) & (j >= i - w) & \
+            ~((i >= se[0, 0, :, 0][None, :]))
+        m = jnp.asarray(np.where(keep, 0.0, -np.inf)[None, None]
+                        .astype(np.float32))
+        ref = _attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                             jnp.asarray(vn), mask=m)
+        assert np.allclose(np.asarray(out._data), np.asarray(ref),
+                           atol=2e-4)
+
+    def test_window_composes_with_c2_band(self, monkeypatch):
+        """round 5: window_size + C=2 band promotes to the two-band C=4
+        form (band 2 = the window's LT region)."""
+        import paddle_tpu as P
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        rng = np.random.default_rng(22)
+        s, w = 256, 25
+        qn, kn, vn = (rng.standard_normal((1, s, 2, 64))
+                      .astype(np.float32) for _ in range(3))
+        # band mask: columns 32.. mask rows [96, 160) (C=2)
+        se = np.zeros((1, 1, s, 2), np.int32)
+        se[..., 0] = s
+        se[..., 1] = s
+        se[0, 0, 32:, 0] = 96
+        se[0, 0, 32:, 1] = 160
+        out = P.nn.functional.flashmask_attention(
+            P.to_tensor(qn), P.to_tensor(kn), P.to_tensor(vn),
+            startend_row_indices=P.to_tensor(jnp.asarray(se)),
+            window_size=w, causal=True)
+        i = np.arange(s)[:, None]
+        j = np.arange(s)[None, :]
+        band_dead = (i >= se[0, 0, :, 0][None, :]) & \
+            (i < se[0, 0, :, 1][None, :])
+        keep = (j <= i) & (j >= i - w) & ~band_dead
+        m = jnp.asarray(np.where(keep, 0.0, -np.inf)[None, None]
+                        .astype(np.float32))
+        ref = _attention_ref(jnp.asarray(qn), jnp.asarray(kn),
+                             jnp.asarray(vn), mask=m)
+        assert np.allclose(np.asarray(out._data), np.asarray(ref),
+                           atol=2e-4)
+
+    def test_fm_lse_kernel_matches_reference(self, monkeypatch):
+        """round 5: flash_core_fm_lse's kernel lse == masked logsumexp
+        oracle, and grads flow through (out, lse) jointly."""
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.ops.pallas.flash_attention as fa
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        rng = np.random.default_rng(31)
+        s = 256
+        qn, kn, vn = (jnp.asarray(rng.standard_normal((1, s, 2, 64))
+                                  .astype(np.float32)) for _ in range(3))
+        se = np.full((1, 1, s, 1), s, np.int32)
+        se[0, 0, 64:, 0] = 128
+        fm = fa._normalize_startend(jnp.asarray(se), s)
+        fm = tuple(fm) + (None,) * (4 - len(fm))
+        fa.reset_dispatch_stats()
+        out, lse = fa.flash_core_fm_lse(qn, kn, vn, fm[0], fm[1], fm[2],
+                                        fm[3], True, None)
+        assert fa.dispatch_stats()["pallas"] == 1
+        m = fa._fm_causal_mask(fm, s, s, True)
+        ref_out, ref_lse = fa._attention_ref_lse(qn, kn, vn,
+                                                 causal=False, mask=m)
+        assert np.allclose(np.asarray(out), np.asarray(ref_out),
+                           atol=2e-4)
+        assert np.allclose(np.asarray(lse), np.asarray(ref_lse),
+                           atol=2e-4)
+
+        def loss_k(a):
+            o, l = fa.flash_core_fm_lse(a, kn, vn, fm[0], fm[1], fm[2],
+                                        fm[3], True, None)
+            return o.sum() + 0.5 * l.sum()
+
+        def loss_r(a):
+            o, l = fa._attention_ref_lse(a, kn, vn, causal=False, mask=m)
+            return o.sum() + 0.5 * l.sum()
+        gk = jax.grad(loss_k)(qn)
+        gr = jax.grad(loss_r)(qn)
+        assert np.allclose(np.asarray(gk), np.asarray(gr), atol=3e-3)
+
+    def test_window_with_c4_raises(self):
+        import paddle_tpu as P
+        import jax.numpy as jnp
+        rng = np.random.default_rng(23)
+        s = 128
+        qn = rng.standard_normal((1, s, 2, 64)).astype(np.float32)
+        se = np.zeros((1, 1, s, 4), np.int32)
+        se[..., 0] = s
+        se[..., 1] = s
+        with pytest.raises(NotImplementedError, match="two bands"):
+            P.nn.functional.flashmask_attention(
+                P.to_tensor(qn), P.to_tensor(qn), P.to_tensor(qn),
+                startend_row_indices=P.to_tensor(jnp.asarray(se)),
+                window_size=9, causal=True)
+
     def test_fully_masked_rows_fallback_grads_finite(self):
         """The DENSE fallback (_fm_ref, off-TPU path) must match the
         kernel's fully-masked-row contract: zero output AND zero (not

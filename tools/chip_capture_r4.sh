@@ -40,4 +40,12 @@ run python bench_generate.py 1 128 512 --spec 4 --wq int8 --kv int8
 # 6. BERT AMP-O2 via the device loop (first non-relay-dominated number)
 run python bench_extra.py
 
+# 7. (round 5, VERDICT r4 missing #4) bf16 sep shard_map compile smoke —
+#    the program class whose CPU emitter crashes; TPU verdict wanted
+run python tools/sep_bf16_chip_smoke.py
+
+# 8. (round 5) in-kernel counter-hash dropout: first Mosaic compile +
+#    exact oracle parity; green clears PADDLE_TPU_FA_KERNEL_DROPOUT=1
+run python tools/kernel_dropout_chip_smoke.py
+
 echo "=== $(stamp) capture list complete"

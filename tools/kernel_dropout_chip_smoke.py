@@ -1,0 +1,103 @@
+"""On-chip validation smoke for in-kernel counter-hash dropout
+(round 5): Mosaic-compiles the dropout-enabled resident forward +
+both backward kernels at a small shape and checks EXACT parity against
+the reconstructed-mask XLA oracle (the keep mask is a pure function of
+(seed, bh, row, col) — same check as
+tests/test_attn_dropout.py::TestKernelHashDropout, but compiled by the
+real toolchain instead of interpret mode).
+
+Green here clears PADDLE_TPU_FA_KERNEL_DROPOUT=1 for production
+dispatch (flash-perf dropout>0 training — BERT-class models).
+
+Wedge-proofed: tunnel + subprocess probe first; CPU fallback says so.
+Writes .bench_r4/kernel_dropout_smoke.json.
+
+Run: python tools/kernel_dropout_chip_smoke.py
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _tpu_usable, force_cpu  # noqa: E402
+
+OUT = os.path.join(REPO, ".bench_r4", "kernel_dropout_smoke.json")
+
+
+def run(interp=False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas._fa_kernel import (_keep_scale,
+                                                  fa_backward,
+                                                  fa_forward)
+
+    rng = np.random.default_rng(0)
+    b, s, h, hkv, d = 1, 512, 4, 2, 64
+    qj = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    kj = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    vj = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    seed = jnp.asarray([1234], jnp.int32)
+    p = 0.3
+
+    def oracle(q_, k_, v_):
+        kr = jnp.repeat(k_, h // hkv, axis=2)
+        vr = jnp.repeat(v_, h // hkv, axis=2)
+        lg = jnp.einsum("bqhd,bkhd->bhqk", q_, kr,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        lg = jnp.where(cm, lg, -jnp.inf)
+        probs = jnp.where(jnp.isnan(jax.nn.softmax(lg, -1)), 0.0,
+                          jax.nn.softmax(lg, -1))
+        ks = jnp.stack([
+            jnp.stack([_keep_scale(seed[0], bi * h + hi, 0, 0, s, s, p)
+                       for hi in range(h)]) for bi in range(b)])
+        return jnp.einsum("bhqk,bkhd->bqhd", probs * ks, vr)
+
+    fwd = jax.jit(lambda q_, k_, v_: fa_forward(
+        q_, k_, v_, causal=True, return_lse=True, dropout_p=p,
+        dropout_seed=seed, interpret=interp))
+    out, lse = fwd(qj, kj, vj)
+    exp = jax.jit(oracle)(qj, kj, vj)
+    fwd_err = float(jnp.abs(out - exp).max())
+
+    g = jnp.ones_like(out)
+    bwd = jax.jit(lambda: fa_backward(qj, kj, vj, out, lse, g,
+                                      causal=True, dropout_p=p,
+                                      dropout_seed=seed,
+                                      interpret=interp))
+    dq, dk, dv = bwd()
+    go = jax.jit(jax.grad(lambda q_, k_, v_: oracle(q_, k_, v_).sum(),
+                          argnums=(0, 1, 2)))
+    gq, gk, gv = go(qj, kj, vj)
+    bwd_err = float(max(jnp.abs(dq - gq).max(), jnp.abs(dk - gk).max(),
+                        jnp.abs(dv - gv).max()))
+    return {"fwd_max_err": fwd_err, "bwd_max_err": bwd_err,
+            "pass": bool(fwd_err < 2e-4 and bwd_err < 3e-3),
+            "shape": [b, s, h, hkv, d], "dropout_p": p}
+
+
+def main():
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    if _tpu_usable():
+        backend = "tpu"
+    else:
+        force_cpu()
+        backend = "cpu"
+    try:
+        res = run(interp=backend != "tpu")
+        res["backend"] = backend
+        res["tpu_unavailable"] = backend != "tpu"
+    except Exception as e:
+        res = {"backend": backend, "pass": False,
+               "error": f"{type(e).__name__}: {e}"}
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""bf16 sep (context-parallel) TPU compile smoke (VERDICT r4 missing #4).
+
+FEASIBILITY.md round-4: the XLA *CPU* emitter crashes on ANY bf16
+shard_map-sep program ("Invalid binary instruction opcode copy"), so
+the flagship long-context bf16 config is compile-checked only in f32 on
+the virtual mesh. This smoke asks the TPU backend the same question at
+the scale one chip can answer: jit-compile and run a bf16 TRAIN step
+(ring flash attention + globally-shifted token CE + grads) inside
+shard_map over a sep mesh axis, on the real chip.
+
+Honest scope: the axis has ONE device (a single chip cannot host a
+multi-device mesh), so the ring ppermute is an identity and the
+inter-chip collective layout is NOT exercised here — that part is
+compile-checked on the 8-device virtual CPU mesh in f32
+(tools/feasibility_7b.py). What this run DOES establish is that the
+bf16 x shard_map x sep program class compiles through the TPU emitter
+(the CPU bug's trigger), and it is the first bf16 train-mode Mosaic
+compile of the flash kernel inside a shard_map body.
+
+Wedge-proofed: tunnel socket + subprocess probe before any device touch
+(CLAUDE.md chip hygiene). Writes .bench_r4/sep_bf16_smoke.json.
+
+Run: python tools/sep_bf16_chip_smoke.py
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _tpu_usable, force_cpu  # noqa: E402
+
+OUT = os.path.join(REPO, ".bench_r4", "sep_bf16_smoke.json")
+
+
+def run(backend):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import paddle_tpu as PT
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed._axis import axis_env
+    from paddle_tpu.distributed.fleet.long_context import \
+        ring_flash_attention
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
+    g = dist.new_group([0], axis_name="sep")
+    rng = np.random.default_rng(0)
+    b, s, h, d = 1, 256, 4, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                           jnp.bfloat16) for _ in range(3))
+    tgt = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+
+    def loss_body(qa, ka, va):
+        out = ring_flash_attention(PT.Tensor(qa), PT.Tensor(ka),
+                                   PT.Tensor(va), group=g, causal=True)
+        # shifted-CE stand-in: differentiable reduction with a psum over
+        # sep, matching the sep train step's global-loss structure
+        err = (out._data.astype(jnp.float32) -
+               tgt.astype(jnp.float32)) ** 2
+        return jax.lax.psum(err.mean(), "sep")
+
+    def step(qa, ka, va):
+        return jax.value_and_grad(
+            lambda q_: loss_body(q_, ka, va))(qa)
+
+    f = jax.jit(jax.shard_map(step, mesh=mesh,
+                              in_specs=(P(None, "sep"), P(None, "sep"),
+                                        P(None, "sep")),
+                              out_specs=(P(), P(None, "sep")),
+                              check_vma=False))
+    with axis_env("sep"):
+        loss, gq = f(q, k, v)
+    loss = float(jax.device_get(loss))
+    gnorm = float(jax.device_get(
+        (gq.astype(jnp.float32) ** 2).sum()) ** 0.5)
+    return {"backend": backend, "loss": loss, "grad_norm": gnorm,
+            "dtype": "bfloat16", "shape": [b, s, h, d],
+            "compiled": True, "finite": bool(loss == loss and
+                                             gnorm == gnorm)}
+
+
+def main():
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    if _tpu_usable():
+        backend = "tpu"
+    else:
+        force_cpu()
+        backend = "cpu-fallback (tpu_unavailable; NOTE: the CPU emitter "\
+            "bug this smoke exists to rule out on TPU may fire here)"
+    try:
+        res = run("tpu" if backend == "tpu" else "cpu")
+        res["tpu_unavailable"] = backend != "tpu"
+    except Exception as e:
+        res = {"backend": backend, "compiled": False,
+               "error": f"{type(e).__name__}: {e}"}
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
