@@ -443,7 +443,8 @@ def fa_forward(q, k, v, causal=False, scale=None, block_q=None,
                 "in-kernel dropout rides the resident forward only "
                 "(sq == sk, no dense mask / FlashMask); dispatch should "
                 "have taken the XLA reference")
-        assert dropout_seed is not None
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
 
     def kvrow(i):
         return (i // h) * hkv + (i % h) // g
@@ -761,8 +762,14 @@ def fa_backward(q, k, v, o, lse, do, causal=False, scale=None,
         if not drop_p < 1.0:
             raise ValueError(
                 f"in-kernel dropout needs 0 <= p < 1, got {drop_p}")
-        assert dropout_seed is not None and not (has_mask or n_fm), \
-            "in-kernel dropout: resident envelope only"
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        if has_mask or n_fm:
+            # a mask/fm forward never dropped these links — applying the
+            # keep mask here would return silently wrong gradients
+            raise NotImplementedError(
+                "in-kernel dropout backward: resident envelope only "
+                "(no dense mask / FlashMask)")
         seed_arr = _seed_lanes(dropout_seed)
         seed_spec3 = pl.BlockSpec((1, LANES), lambda i, j, t: (0, 0))
 

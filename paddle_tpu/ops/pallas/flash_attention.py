@@ -298,6 +298,40 @@ def _kernel_dropout_enabled() -> bool:
     return os.environ.get("PADDLE_TPU_FA_KERNEL_DROPOUT", "0") == "1"
 
 
+def _attention_ref_hash_dropout(q, k, v, seed, p, causal=True,
+                                q_seg=None, kv_seg=None):
+    """THE parity definition for in-kernel counter-hash dropout: XLA
+    attention with the keep mask reconstructed from `_keep_scale` (a
+    pure function of (seed, bh, row, col)). Single source of truth for
+    the interpret-mode tests AND the on-chip smoke — two hand-
+    maintained copies could drift and green-light a divergent kernel."""
+    from ._fa_kernel import _keep_scale
+    b, sq, h, dh = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    kr, vr = k, v
+    if hkv != h:
+        kr = jnp.repeat(kr, h // hkv, axis=2)
+        vr = jnp.repeat(vr, h // hkv, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                        preferred_element_type=jnp.float32) / (dh ** 0.5)
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if q_seg is not None:
+        eq = (q_seg[:, None, :, None] == kv_seg[:, None, None, :]) & \
+             (q_seg[:, None, :, None] >= 0) & \
+             (kv_seg[:, None, None, :] >= 0)
+        logits = jnp.where(eq, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, -1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    seed_s = jnp.asarray(seed).reshape(-1)[0]
+    ks = jnp.stack([
+        jnp.stack([_keep_scale(seed_s, bi * h + hi, 0, 0, sq, sk, p)
+                   for hi in range(h)]) for bi in range(b)])
+    return jnp.einsum("bhqk,bkhd->bqhd", probs * ks,
+                      vr.astype(jnp.float32)).astype(q.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
 def _flash_core_drop(q, k, v, seed, q_seg, kv_seg, causal, scale,
                      dropout_p):

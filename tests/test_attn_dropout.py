@@ -167,35 +167,12 @@ class TestProbDropoutSemantics:
 
 def _hash_drop_oracle(qj, kj, vj, seed, p, causal=True, q_seg=None,
                       kv_seg=None):
-    """Exact oracle for the IN-KERNEL counter-hash dropout: the keep
-    mask is a pure function of (seed, bh, row, col), so it reconstructs
-    outside the kernel bit-identically."""
-    from paddle_tpu.ops.pallas._fa_kernel import _keep_scale
-    b, sq, h, dh = qj.shape
-    sk, hkv = kj.shape[1], kj.shape[2]
-    kr, vr = kj, vj
-    if hkv != h:
-        kr = jnp.repeat(kr, h // hkv, axis=2)
-        vr = jnp.repeat(vr, h // hkv, axis=2)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qj, kr,
-                        preferred_element_type=jnp.float32) / np.sqrt(dh)
-    if causal:
-        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(cm, logits, -jnp.inf)
-    if q_seg is not None:
-        eq = (q_seg[:, None, :, None] == kv_seg[:, None, None, :]) & \
-             (q_seg[:, None, :, None] >= 0) & \
-             (kv_seg[:, None, None, :] >= 0)
-        logits = jnp.where(eq, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, -1)
-    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-    ks = jnp.stack([
-        jnp.stack([_keep_scale(jnp.int32(seed), bi * h + hi, 0, 0,
-                               sq, sk, p) for hi in range(h)])
-        for bi in range(b)])                       # [b, h, sq, sk]
-    pd = probs * ks
-    return jnp.einsum("bhqk,bkhd->bqhd", pd, vr.astype(jnp.float32)) \
-        .astype(qj.dtype)
+    """Exact oracle for the IN-KERNEL counter-hash dropout — the SHARED
+    definition (`_attention_ref_hash_dropout`), also used by the
+    on-chip smoke so the two can't drift."""
+    return fa._attention_ref_hash_dropout(qj, kj, vj, jnp.int32(seed),
+                                          p, causal=causal,
+                                          q_seg=q_seg, kv_seg=kv_seg)
 
 
 class TestKernelHashDropout:

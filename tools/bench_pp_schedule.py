@@ -163,6 +163,13 @@ def main():
         # V=2 chunk = 1 block/tick).
         for M in (2, 4, 8):
             for V in (1, 2):
+                if V > 1 and M % S != 0:
+                    # reference constraint: interleaved pipeline needs
+                    # accumulate_steps % pp_degree == 0
+                    rows.append({"S": S, "M": M, "V": V,
+                                 "skipped": "M % S != 0 (reference "
+                                 "interleave constraint)"})
+                    continue
                 ticks, dt, _ = run_case(S, M, V=V, mse=mse,
                                         nblocks=2 * S)
                 pred = M * V + S - 1
@@ -184,15 +191,16 @@ def main():
                   f"{b * 1e3:.2f} ms/tick  (r={r:.4f})")
             rows.append({"S": S, "V": V, "fit_ms_per_tick": b * 1e3,
                          "fit_intercept_ms": c * 1e3, "fit_r": r})
-    # one V=4 point per S (4S-block model, chunk = 1 block/tick)
+    # V=4 points (4S-block model, chunk = 1 block/tick); two M per S
+    # keeps the V>1 row count >= 8 despite the skipped (4, 2, 2) combo
     for S in (2, 4):
-        M = 4
-        ticks, dt, _ = run_case(S, M, V=4, mse=mse, nblocks=4 * S)
-        pred = M * 4 + S - 1
-        print(f"{S:>2} {M:>2} {4:>2} {ticks:>6} {pred:>8} "
-              f"{(S - 1) / ticks:>19.3f} {dt * 1e3:>9.1f}")
-        rows.append({"S": S, "M": M, "V": 4, "ticks": ticks,
-                     "predicted_ticks": pred, "wall_s": dt})
+        for M in (4, 8):
+            ticks, dt, _ = run_case(S, M, V=4, mse=mse, nblocks=4 * S)
+            pred = M * 4 + S - 1
+            print(f"{S:>2} {M:>2} {4:>2} {ticks:>6} {pred:>8} "
+                  f"{(S - 1) / ticks:>19.3f} {dt * 1e3:>9.1f}")
+            rows.append({"S": S, "M": M, "V": 4, "ticks": ticks,
+                         "predicted_ticks": pred, "wall_s": dt})
     # VPP summary: SAME model (2S blocks) at V=1 (chunk = 2 blocks/tick)
     # vs V=2 (chunk = 1 block/tick, 2M+S−1 ticks): per-tick work halves
     # while ticks ~double, and the bubble drops (S-1)/(M+S-1) →

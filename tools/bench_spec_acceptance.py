@@ -48,9 +48,9 @@ PROMPT = 64
 NEW = 256
 
 
-def build(layers, seed, maxpos):
-    cfg = LlamaConfig(vocab_size=256, hidden_size=256,
-                      intermediate_size=688, num_hidden_layers=layers,
+def build(layers, seed, maxpos, hidden=256, inter=688):
+    cfg = LlamaConfig(vocab_size=256, hidden_size=hidden,
+                      intermediate_size=inter, num_hidden_layers=layers,
                       num_attention_heads=4, num_key_value_heads=2,
                       max_position_embeddings=maxpos, dtype="float32")
     P.seed(seed)
@@ -132,6 +132,12 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--batch2", type=int, default=4,
                     help="second batch size measured at the best k")
+    ap.add_argument("--draft-hidden", type=int, default=128,
+                    help="draft width: the round-5 1.01x lesson is that "
+                    "a same-width 1-layer draft costs too much per "
+                    "round on the CPU marginal — the draft must be "
+                    "CHEAP, not just shallow")
+    ap.add_argument("--draft-inter", type=int, default=344)
     args = ap.parse_args()
 
     train_arr, held = corpus()
@@ -140,9 +146,10 @@ def main():
     print(f"training target (4 layers, {args.steps} steps)...", flush=True)
     train(target, train_arr, args.steps)
     target.eval()
-    draft = build(1, 1, maxpos)
-    print(f"distilling draft (1 layer, {args.distill_steps} KL steps)...",
-          flush=True)
+    draft = build(1, 1, maxpos, hidden=args.draft_hidden,
+                  inter=args.draft_inter)
+    print(f"distilling draft (1 layer, hidden {args.draft_hidden}, "
+          f"{args.distill_steps} KL steps)...", flush=True)
     final_kl = distill(draft, target, train_arr, args.distill_steps)
     draft.eval()
     agree = argmax_agreement(draft, target, held)
@@ -200,6 +207,7 @@ def main():
 
     out = {"metric": "speculative_acceptance_curve",
            "target_layers": 4, "draft_layers": 1,
+           "draft_hidden": args.draft_hidden,
            "train_steps": args.steps,
            "distill_steps": args.distill_steps,
            "distill": "KL on target logits (T=1)",
