@@ -266,6 +266,28 @@ r4 = vjp4(gc)
 errs["xlen_bwd"] = max(float(jnp.max(jnp.abs(x.astype(jnp.float32) -
                                              y.astype(jnp.float32))))
                        for x, y in zip((dq4, dk4, dv4), r4))
+
+# in-kernel counter-hash dropout (round-5): first Mosaic compile of the
+# dropout-enabled fwd + both bwd kernels; EXACT parity vs the shared
+# reconstructed-mask oracle (f32 so the oracle comparison is tight)
+from paddle_tpu.ops.pallas.flash_attention import \
+    _attention_ref_hash_dropout
+q5 = jnp.asarray(rng.standard_normal((1, s, 4, 64)), jnp.float32)
+k5 = jnp.asarray(rng.standard_normal((1, s, 2, 64)), jnp.float32)
+v5 = jnp.asarray(rng.standard_normal((1, s, 2, 64)), jnp.float32)
+g5 = jnp.asarray(rng.standard_normal((1, s, 4, 64)), jnp.float32)
+seed5 = jnp.asarray([1234], jnp.int32)
+out5, lse5 = fa_forward(q5, k5, v5, causal=True, return_lse=True,
+                        dropout_p=0.3, dropout_seed=seed5)
+ref5 = _attention_ref_hash_dropout(q5, k5, v5, seed5, 0.3, causal=True)
+errs["drop_fwd"] = float(jnp.max(jnp.abs(out5 - ref5)))
+dq5, dk5, dv5 = fa_backward(q5, k5, v5, out5, lse5, g5, causal=True,
+                            dropout_p=0.3, dropout_seed=seed5)
+gr5 = jax.grad(lambda a, b_, c: (_attention_ref_hash_dropout(
+    a, b_, c, seed5, 0.3, causal=True) * g5).sum(),
+    argnums=(0, 1, 2))(q5, k5, v5)
+errs["drop_bwd"] = max(float(jnp.max(jnp.abs(x - y)))
+                       for x, y in zip((dq5, dk5, dv5), gr5))
 print(json.dumps(errs))
 """
 
@@ -287,3 +309,5 @@ class TestOnChipKernelExtensions:
         assert r["flashmask_bwd_finite"] == 1.0, r
         assert r["xlen_fwd"] < 5e-2, r
         assert r["xlen_bwd"] < 1e-1, r
+        assert r["drop_fwd"] < 2e-4, r
+        assert r["drop_bwd"] < 3e-3, r

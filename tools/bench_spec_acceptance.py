@@ -138,12 +138,27 @@ def main():
                     "round on the CPU marginal — the draft must be "
                     "CHEAP, not just shallow")
     ap.add_argument("--draft-inter", type=int, default=344)
+    ap.add_argument("--target-hidden", type=int, default=256,
+                    help="target width (multiple of 4 heads): the CPU "
+                    "marginal is overhead-bound at h256 (per-call "
+                    "fixed cost ~0.8 of a step); a wider target makes "
+                    "draft/target cost ratios meaningful, the regime "
+                    "real serving runs")
+    ap.add_argument("--target-inter", type=int, default=None,
+                    help="default: hidden * 2.6875 (the 256/688 ratio)")
+    ap.add_argument("--target-layers", type=int, default=4)
     args = ap.parse_args()
+    if args.target_hidden % 4:
+        ap.error("--target-hidden must be divisible by the 4 heads")
+    if args.target_inter is None:
+        args.target_inter = round(args.target_hidden * 2.6875)
 
     train_arr, held = corpus()
     maxpos = PROMPT + NEW + 16
-    target = build(4, 0, maxpos)
-    print(f"training target (4 layers, {args.steps} steps)...", flush=True)
+    target = build(args.target_layers, 0, maxpos,
+                   hidden=args.target_hidden, inter=args.target_inter)
+    print(f"training target ({args.target_layers} layers, hidden "
+          f"{args.target_hidden}, {args.steps} steps)...", flush=True)
     train(target, train_arr, args.steps)
     target.eval()
     draft = build(1, 1, maxpos, hidden=args.draft_hidden,
@@ -206,7 +221,9 @@ def main():
             print(json.dumps(batch2_row), flush=True)
 
     out = {"metric": "speculative_acceptance_curve",
-           "target_layers": 4, "draft_layers": 1,
+           "target_layers": args.target_layers,
+           "target_hidden": args.target_hidden,
+           "draft_layers": 1,
            "draft_hidden": args.draft_hidden,
            "train_steps": args.steps,
            "distill_steps": args.distill_steps,
