@@ -121,31 +121,43 @@ def _seed_lanes(seed):
     return jnp.broadcast_to(s[None, :], (1, LANES))
 
 
+def _i32(v):
+    """Python int → int32 constant by two's-complement wraparound."""
+    return jnp.int32(((int(v) + 2 ** 31) % 2 ** 32) - 2 ** 31)
+
+
 def _keep_scale(seed, bh, q0, k0, bq, bk, drop_p):
     """Counter-based dropout mask for one (q-block, k-block) tile:
     keep/(1-p) scale factors [bq, bk] f32, a PURE function of
     (seed, flat head-batch, absolute row, absolute col) — the forward
     and both backward kernels regenerate bit-identical masks, and tests
     reconstruct them outside the kernel for exact oracles. Two rounds of
-    the murmur3 finalizer (fmix32) over a linear index combination; all
-    plain uint32 vector ops, so it runs under Mosaic AND interpret mode
-    (pltpu.prng_* has no CPU lowering). The same design as CUDA
-    flash-attn's in-kernel Philox dropout, TPU-native."""
+    the murmur3 finalizer (fmix32) over a linear index combination,
+    formulated ENTIRELY in int32 (wraparound mul/xor are bit-identical
+    to uint32; logical shifts via post-shift masks; the unsigned
+    threshold compare via sign-flip) — i32 is the best-supported Mosaic
+    integer type, and interpret mode runs the same ops (pltpu.prng_* has
+    no CPU lowering). The same design as CUDA flash-attn's in-kernel
+    Philox dropout, TPU-native."""
     rows = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    bh_u = jnp.asarray(bh).astype(jnp.uint32)   # traced program_id ok
-    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1) ^
-         cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77) ^
-         (bh_u * jnp.uint32(0xC2B2AE3D)) ^
-         jnp.asarray(seed).astype(jnp.uint32))
+    bh_i = jnp.asarray(bh).astype(jnp.int32)    # traced program_id ok
+    x = (rows * _i32(0x9E3779B1) ^
+         cols * _i32(0x85EBCA77) ^
+         (bh_i * _i32(0xC2B2AE3D)) ^
+         jnp.asarray(seed).astype(jnp.int32))
     for _ in range(2):
-        x = x ^ (x >> jnp.uint32(16))
-        x = x * jnp.uint32(0x85EBCA6B)
-        x = x ^ (x >> jnp.uint32(13))
-        x = x * jnp.uint32(0xC2B2AE35)
-        x = x ^ (x >> jnp.uint32(16))
-    thresh = jnp.uint32(min(int(drop_p * 2.0 ** 32), 2 ** 32 - 1))
-    keep = (x >= thresh).astype(jnp.float32)
+        # logical >> k on i32 = arithmetic >> k masked to the low bits
+        x = x ^ ((x >> 16) & _i32(0x0000FFFF))
+        x = x * _i32(0x85EBCA6B)
+        x = x ^ ((x >> 13) & _i32(0x0007FFFF))
+        x = x * _i32(0xC2B2AE35)
+        x = x ^ ((x >> 16) & _i32(0x0000FFFF))
+    # unsigned x >= thresh  ⟺  (x ^ INT_MIN) >=signed (thresh ^ INT_MIN)
+    thresh_u = min(int(drop_p * 2.0 ** 32), 2 ** 32 - 1)
+    xs = x ^ _i32(0x80000000)
+    ts = _i32(thresh_u ^ 0x80000000)
+    keep = (xs >= ts).astype(jnp.float32)
     return keep * jnp.float32(1.0 / (1.0 - drop_p))
 
 

@@ -255,6 +255,45 @@ class TestKernelHashDropout:
             frac = float((np.asarray(ks) == 0.0).mean())
             assert abs(frac - p) < 0.01, (p, frac)
 
+    def test_bert_trains_through_kernel_dropout(self, monkeypatch):
+        """Integration: a BERT-class model with attention_probs_dropout
+        trains end-to-end through the kernel-dropout dispatch (no
+        fallback), and eval is deterministic."""
+        monkeypatch.setattr(fa, "_FORCE_INTERPRET", True)
+        monkeypatch.setenv("PADDLE_TPU_FA_KERNEL_DROPOUT", "1")
+        from paddle_tpu.models import (BertConfig,
+                                       BertForSequenceClassification)
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=64, hidden_size=128,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         intermediate_size=256,
+                         max_position_embeddings=128,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.2)
+        model = BertForSequenceClassification(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 64, (2, 128))
+                               .astype(np.int32))
+        labels = paddle.to_tensor(np.array([0, 1], np.int32))
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        model.train()
+        fa.reset_dispatch_stats()
+        losses = []
+        for _ in range(2):
+            loss = loss_fn(model(ids), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        st = fa.dispatch_stats()
+        assert st["pallas"] >= 2 and st["fallback"] == 0, st
+        assert all(np.isfinite(losses)), losses
+        model.eval()
+        a = np.asarray(model(ids)._data)
+        b = np.asarray(model(ids)._data)
+        assert np.allclose(a, b)
+
     def test_dispatch_and_train_grad(self, monkeypatch):
         """PADDLE_TPU_FA_KERNEL_DROPOUT=1 routes dropout>0 training to
         the kernel (no fallback), grads flow, eval stays exact."""
