@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Tunnel liveness watcher: poll 127.0.0.1:8083 every 60 s.
+
+Writes `.tunnel_up` (flag file, contents = last-up UTC timestamp) while
+the socket accepts connections; removes it when it doesn't. Appends
+transitions to `.tunnel_watch.log`. Run detached:
+    setsid python3 .tunnel_watch.py >/dev/null 2>&1 &
+
+STALENESS: if this process dies while the tunnel is up, the flag file
+stays behind. Consumers MUST treat a flag whose mtime is older than
+180 s as "watcher dead, tunnel state unknown" and fall back to a
+direct socket probe.
+"""
+import os
+import socket
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLAG = os.path.join(HERE, ".tunnel_up")
+LOG = os.path.join(HERE, ".tunnel_watch.log")
+
+
+def up() -> bool:
+    # Same probe as paddle_tpu.device._tunnel_alive (port/timeout policy
+    # lives there); inlined so the watcher stays stdlib-only, with the
+    # shared helper preferred when the package imports cleanly.
+    try:
+        from paddle_tpu.device import _tunnel_alive
+        return _tunnel_alive()
+    except Exception:
+        pass
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", 8083))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def log(msg: str) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {msg}\n")
+
+
+def main() -> None:
+    prev = None
+    log("watcher start")
+    while True:
+        state = up()
+        if state != prev:
+            log("tunnel UP" if state else "tunnel DOWN")
+            prev = state
+        if state:
+            with open(FLAG, "w") as f:
+                f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        else:
+            try:
+                os.remove(FLAG)
+            except FileNotFoundError:
+                pass
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()

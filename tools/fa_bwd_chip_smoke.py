@@ -1,0 +1,134 @@
+"""ONE detached on-chip smoke for a single FA backward block config at
+the EXACT long-seq bench shape (CLAUDE.md round-3b protocol: a
+small-shape smoke does NOT clear a bwd block config — fa_bwd_bk256
+passed s=512 then hung Mosaic at s=1024 and killed the tunnel,
+PERF.md incident #2; numerics for every candidate are already banked
+interpret-mode in `.fa_bwd_configs.json`).
+
+What this does on a healthy chip, for the candidate (block_q, block_k)
+given on the command line:
+  1. fa_forward once (production config) at b=1 s=8192 h=16 d=128 bf16.
+  2. fa_backward with the CANDIDATE config — the first Mosaic compile of
+     this config at this shape. If Mosaic wedges, this process hangs and
+     the JSON never appears: poll the log, do NOT SIGTERM mid-compile.
+  3. Numerics cross-check vs the on-chip DEFAULT 128x128 backward
+     (itself oracle-validated) — max |delta| over dq/dk/dv.
+  4. Marginal timing for BOTH configs: wall(N=13 calls) - wall(N=3
+     calls) over 10, each call with a DISTINCT pre-scaled cotangent so
+     the axon request cache cannot serve repeats (CLAUDE.md axon
+     measurement hygiene), last result fetched to the host.
+
+Run (detached):
+  setsid bash -c 'python tools/fa_bwd_chip_smoke.py 256 128 \
+      > .bench_r4/fa_bwd_smoke_256x128.log 2>&1' &
+Writes .bench_r4/fa_bwd_smoke_{bq}x{bk}.json.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _tpu_usable  # noqa: E402
+
+B, S, H, D = 1, 8192, 16, 128
+
+
+def timed_marginal(fn, args_list):
+    """Wall time of len(args_list) sequential calls, last one fetched."""
+    t0 = time.time()
+    r = None
+    for a in args_list:
+        r = fn(*a)
+    r[0].block_until_ready()
+    float(r[0].sum())  # host fetch defeats the request cache
+    return time.time() - t0
+
+
+def main():
+    bq, bk = int(sys.argv[1]), int(sys.argv[2])
+    out_path = os.path.join(REPO, ".bench_r4", f"fa_bwd_smoke_{bq}x{bk}.json")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    res = {"block_q": bq, "block_k": bk,
+           "shape": {"b": B, "s": S, "h": H, "d": D, "dtype": "bfloat16",
+                     "causal": True}}
+    if not _tpu_usable():
+        res.update({"tpu_unavailable": True, "pass": False,
+                    "note": "no healthy chip; interpret numerics already "
+                            "banked in .fa_bwd_configs.json"})
+        with open(out_path, "w") as f:
+            json.dump(res, f, indent=1)
+        print(json.dumps(res))
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas._fa_kernel import fa_backward, fa_forward
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32)
+                    * 0.1).astype(jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32)
+                    * 0.1).astype(jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32)
+                    * 0.1).astype(jnp.bfloat16)
+    g = jnp.asarray(rng.standard_normal((B, S, H, D)).astype(np.float32)
+                    * 0.1).astype(jnp.bfloat16)
+
+    print(f"[{time.strftime('%H:%M:%S')}] forward (production config)...",
+          flush=True)
+    fwd = jax.jit(lambda q_, k_, v_: fa_forward(q_, k_, v_, causal=True,
+                                                return_lse=True))
+    o, lse = fwd(q, k, v)
+    o.block_until_ready()
+
+    def make_bwd(bq_, bk_):
+        return jax.jit(lambda q_, k_, v_, o_, l_, g_: fa_backward(
+            q_, k_, v_, o_, l_, g_, causal=True, block_q=bq_, block_k=bk_))
+
+    print(f"[{time.strftime('%H:%M:%S')}] candidate {bq}x{bk}: first "
+          "Mosaic compile at the bench shape (hang here = wedge; do not "
+          "SIGTERM)...", flush=True)
+    bwd_c = make_bwd(bq, bk)
+    t0 = time.time()
+    dq_c, dk_c, dv_c = bwd_c(q, k, v, o, lse, g)
+    dq_c.block_until_ready()
+    res["candidate_first_call_s"] = round(time.time() - t0, 1)
+    print(f"[{time.strftime('%H:%M:%S')}] candidate compiled+ran in "
+          f"{res['candidate_first_call_s']}s", flush=True)
+
+    bwd_d = make_bwd(128, 128)
+    dq_d, dk_d, dv_d = bwd_d(q, k, v, o, lse, g)
+    err = float(max(jnp.abs(dq_c.astype(jnp.float32)
+                            - dq_d.astype(jnp.float32)).max(),
+                    jnp.abs(dk_c.astype(jnp.float32)
+                            - dk_d.astype(jnp.float32)).max(),
+                    jnp.abs(dv_c.astype(jnp.float32)
+                            - dv_d.astype(jnp.float32)).max()))
+    res["max_abs_delta_vs_default"] = err
+
+    # Distinct cotangents per call -> no request-cache hits.
+    scales = [jnp.bfloat16(1.0 + 0.001 * i) for i in range(16)]
+    gs = [g * s for s in scales]
+    g_warm = g * jnp.bfloat16(0.5)  # outside `scales`: the warm-up must
+    # not collide with any timed request or the cache serves the repeat
+    for name, bwd in (("candidate", bwd_c), ("default", bwd_d)):
+        call = lambda gg, _b=bwd: _b(q, k, v, o, lse, gg)  # noqa: E731
+        call(g_warm)[0].block_until_ready()  # warm (already compiled)
+        w3 = timed_marginal(call, [(x,) for x in gs[:3]])
+        w13 = timed_marginal(call, [(x,) for x in gs[3:]])
+        res[f"{name}_ms_per_bwd"] = round((w13 - w3) / 10 * 1e3, 2)
+    res["speedup_vs_default"] = round(
+        res["default_ms_per_bwd"] / max(res["candidate_ms_per_bwd"], 1e-9), 3)
+    res["pass"] = bool(err < 0.02)  # identical math, bf16 accumulation order
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
