@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Chip-grant recovery poller: every 4 min, one bounded device probe.
+
+Each probe is a fresh interpreter on the default (axon) platform doing a
+single tiny device op; if it hangs (wedged grant) it is killed at 75 s —
+probe processes are the one class of chip-touching work that is safe to
+kill (bench.py::_tpu_usable does the same; they are device-open
+attempts, never mid-compile). Skips the probe entirely while the tunnel
+socket is down (each probe burns minutes; connection-refused means no
+probe can help — CLAUDE.md).
+
+Writes `.chip_ok` (contents = UTC timestamp) on the first success and
+exits. Appends attempts to `.chip_watch.log`. Run detached:
+    setsid python3 .chip_watch.py >/dev/null 2>&1 &
+Same staleness rule as `.tunnel_up`: consumers treat an old mtime as
+"unknown, re-probe".
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLAG = os.path.join(HERE, ".chip_ok")
+LOG = os.path.join(HERE, ".chip_watch.log")
+
+PROBE = ("import jax; d = jax.devices()[0]; "
+         "import jax.numpy as jnp; "
+         "x = (jnp.zeros((8, 8)) + 1).sum(); x.block_until_ready(); "
+         "print('CHIP-OK', d.platform)")
+
+
+def log(msg):
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {msg}\n")
+
+
+def tunnel_up():
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", 8083))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def main():
+    log("chip watcher start")
+    while True:
+        if not tunnel_up():
+            log("tunnel down; probe skipped")
+        else:
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            try:
+                p = subprocess.run([sys.executable, "-c", PROBE],
+                                   capture_output=True, text=True,
+                                   timeout=75, env=env, cwd=HERE)
+                if p.returncode == 0 and "CHIP-OK" in p.stdout:
+                    log(f"chip RECOVERED: {p.stdout.strip()}")
+                    with open(FLAG, "w") as f:
+                        f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()))
+                    return
+                log(f"probe rc={p.returncode}: {p.stderr[-200:]}")
+            except subprocess.TimeoutExpired:
+                log("probe timeout (75s) — grant still wedged")
+        time.sleep(240)
+
+
+if __name__ == "__main__":
+    main()
