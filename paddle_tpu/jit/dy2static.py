@@ -37,9 +37,15 @@ Two SOT-tier pre-passes run before the lowering (round-3):
   free, so deferring is sound). Nested loops compose bottom-up: an inner
   loop's extracted return surfaces as a conditional return for the outer
   pass to extract again.
+- **loop-else lowering** (round-6): ``while/for … else`` desugars to a
+  post-loop ``if not brk: <else>`` on the same flag the escape lowering
+  carries (Python runs the else iff the loop was never broken out of;
+  an extracted in-loop return exits via break, so it skips the else
+  exactly as Python does). A loop-else with no break at the loop's own
+  level is unconditional post-loop code and splits off directly.
 
 The transform is best-effort and safe: constructs it can't lower
-(loop-else with break, returns under try within a loop, global/nonlocal
+(returns under try within a loop, global/nonlocal
 rebinding) are left untouched — tracing then raises and
 `to_static` falls back to eager, recording the graph-break reason (the
 SOT-fallback contract; see `paddle_tpu.jit.graph_break_report`).
@@ -418,13 +424,17 @@ def _contains(nodes, kinds, top_only_kinds=()):
 
 
 def _has_loop_escape(body):
-    """break/continue that would escape THIS loop (i.e. not inside a
-    nested loop)."""
+    """break/continue that would escape THIS loop — i.e. not inside a
+    nested loop's body. A break/continue in a nested loop's `else`
+    clause is OUTSIDE that loop and DOES belong to this one."""
     found = []
 
     def walk(n):
-        if isinstance(n, _SCOPE_NODES + (ast.For, ast.While,
-                                         ast.AsyncFor)):
+        if isinstance(n, _SCOPE_NODES):
+            return
+        if isinstance(n, (ast.For, ast.While, ast.AsyncFor)):
+            for s in n.orelse:  # else-clause escapes target THIS loop
+                walk(s)
             return
         if isinstance(n, (ast.Break, ast.Continue)):
             found.append(n)
@@ -527,9 +537,14 @@ def _escapes_only_under_ifs(stmts):
             if not _escapes_only_under_ifs(st.orelse or []):
                 return False
             continue
-        if isinstance(st, _SCOPE_NODES + (ast.For, ast.While,
-                                          ast.AsyncFor)):
-            continue  # escapes inside belong to the nested loop/scope
+        if isinstance(st, _SCOPE_NODES):
+            continue  # escapes inside belong to the nested scope
+        if isinstance(st, (ast.For, ast.While, ast.AsyncFor)):
+            if _has_loop_escape([st]):
+                # an escape in the nested loop's ELSE clause belongs to
+                # this loop but is not under plain ifs — can't rewrite
+                return False
+            continue  # body escapes belong to the nested loop
         if _has_loop_escape([st]):  # Try/With/… containing an escape
             return False
     return True
@@ -577,6 +592,7 @@ class _PreLower:
             return st
         if isinstance(st, (ast.While, ast.For)):
             st.body = self.block(st.body)  # inner loops first (bottom-up)
+            st.orelse = self.block(st.orelse)
             return self._maybe_desugar_loop(st)
         if isinstance(st, ast.With):
             st.body = self.block(st.body)
@@ -685,9 +701,21 @@ class _PreLower:
     def _maybe_desugar_loop(self, st):
         if not _has_loop_escape(st.body) and not _has_return(st.body):
             return st
-        if st.orelse:
-            return st        # loop-else + break semantics: keep Python
         orig = st  # any bail below must return the UNMODIFIED loop
+        orelse_post = list(st.orelse or [])
+        if orelse_post:
+            # loop-else (round-6): Python runs the else iff the loop was
+            # never broken out of — exactly ``if not brk`` on the flag
+            # the escape lowering already carries. An extracted in-loop
+            # `return` exits via break, so it skips the else as Python
+            # does; plain exhaustion and `continue` leave brk False and
+            # the else runs. Detach it here (shallow copy — the desugar
+            # builds new lists and never mutates the body in place, and
+            # _extract_loop_returns deepcopies before its own mutation —
+            # so bails return the untouched original) and let the
+            # desugar emit the guard.
+            st = copy.copy(st)
+            st.orelse = []
         prologue_ret, post_ret = [], []
         if _has_return(st.body):
             new_st, prologue_ret, post_ret = self._extract_loop_returns(st)
@@ -709,12 +737,12 @@ class _PreLower:
                     not _assigned_names([st.test]):
                 # (walrus in the test would bind inside the generated
                 # thunk lambda's scope — same guard as visit_While)
-                lowered = self._desugar_while(st)
+                lowered = self._desugar_while(st, orelse_post)
             elif (isinstance(st, ast.For)
                     and isinstance(st.target, ast.Name)
                     and _is_range_call(st.iter)
                     and not _assigned_names([st.iter])):
-                lowered = self._desugar_for(st)
+                lowered = self._desugar_for(st, orelse_post)
         except _BudgetExceeded:
             lowered = None   # graft blowup: keep the Python loop (eager)
         if lowered is None:
@@ -770,16 +798,25 @@ class _PreLower:
         out.extend(self._copy(cont_tail))
         return out
 
-    def _desugar_while(self, st):
+    def _else_guard(self, brk, orelse_post):
+        """Post-loop ``if not brk: <loop-else>`` — the else body runs
+        exactly when the loop was never broken out of."""
+        return ast.If(test=_call_helper("loop_not", [_name(brk)]),
+                      body=list(orelse_post), orelse=[])
+
+    def _desugar_while(self, st, orelse_post=()):
         i = self._uid()
         brk = f"_jstf_brk{i}"
         body = self._lower_escapes(st.body, brk, cont_tail=[])
         self.changed = True
-        return [self._assign(brk, ast.Constant(False)),
-                ast.While(test=self._guard_test(brk, st.test),
-                          body=body or [ast.Pass()], orelse=[])]
+        out = [self._assign(brk, ast.Constant(False)),
+               ast.While(test=self._guard_test(brk, st.test),
+                         body=body or [ast.Pass()], orelse=[])]
+        if orelse_post:
+            out.append(self._else_guard(brk, orelse_post))
+        return out
 
-    def _desugar_for(self, st):
+    def _desugar_for(self, st, orelse_post=()):
         u = self._uid()
         iv, brk = f"_jstf_i{u}", f"_jstf_brk{u}"
         start, stop, step = (f"_jstf_start{u}", f"_jstf_stop{u}",
@@ -814,7 +851,10 @@ class _PreLower:
             _call_helper("range_cond", [_name(iv), _name(stop),
                                         _name(step)])])
         self.changed = True
-        return prologue + [ast.While(test=test, body=loop_body, orelse=[])]
+        out = prologue + [ast.While(test=test, body=loop_body, orelse=[])]
+        if orelse_post:
+            out.append(self._else_guard(brk, orelse_post))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -922,8 +962,26 @@ class _CFTransformer(ast.NodeTransformer):
         self.changed = True
         return _peek_stmts(names) + [tdef, fdef, assign]
 
+    def _split_loop_else(self, node, lower):
+        """Loop-else with no break at this loop's level: Python ALWAYS
+        runs the else — it is plain statements after the loop. (Breaks
+        were desugared by _PreLower; a loop still carrying both an else
+        and a break only occurs on its bail paths, and those keep the
+        Python loop anyway.)"""
+        self.changed = True
+        inner = type(node)(**{f: getattr(node, f) for f in node._fields})
+        inner.orelse = []
+        out = lower(inner)
+        out = out if isinstance(out, list) else [out]
+        for s in node.orelse:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
     # -- while -------------------------------------------------------------
     def visit_While(self, node):
+        if node.orelse and not _has_loop_escape(node.body):
+            return self._split_loop_else(node, self.visit_While)
         self.generic_visit(node)
         body = node.body
         if node.orelse or _blockers(body) or _has_return(body) or \
@@ -948,6 +1006,8 @@ class _CFTransformer(ast.NodeTransformer):
 
     # -- for i in range(...) ----------------------------------------------
     def visit_For(self, node):
+        if node.orelse and not _has_loop_escape(node.body):
+            return self._split_loop_else(node, self.visit_For)
         self.generic_visit(node)
         body = node.body
         if (node.orelse or _blockers(body) or _has_return(body) or

@@ -797,3 +797,214 @@ class TestZeroArgSuper:
         # x=1: y=2, no helper; x=3: y=4 > 3 → +Base3.val()=1 → 5
         assert np.allclose(f(t([1.0])).numpy(), [2.0])
         assert np.allclose(f(t([3.0])).numpy(), [5.0])
+
+
+class TestLoopElse:
+    """Loop-else lowering (round-6): `while/for … else` compiles — the
+    else body runs iff the loop was never broken out of, on the same
+    brk flag the escape lowering carries. Previously a documented
+    graph-break form."""
+
+    def test_while_break_else_traced(self):
+        @to_static
+        def f(x, lim):
+            s = x * 0.0
+            while s.sum() < 100.0:
+                s = s + x
+                if s.sum() >= lim.sum():
+                    break
+            else:
+                s = s - 1000.0
+            return s
+
+        # break taken at s=6 → else skipped
+        out = f(t([2.0]), t([5.0]))
+        assert np.allclose(out.numpy(), [6.0])
+        # test exhausts (s reaches 100) before lim=1e9 → else runs
+        out2 = f(t([2.0]), t([1e9]))
+        assert np.allclose(out2.numpy(), [100.0 - 1000.0])
+        _compiled_ok(f)
+
+    def test_for_range_break_else_traced(self):
+        @to_static
+        def f(x, n, lim):
+            acc = x * 0.0
+            found = x.sum() * 0.0
+            for i in range(n):
+                acc = acc + x
+                if acc.sum() >= lim.sum():
+                    found = found + 1.0
+                    break
+            else:
+                acc = acc * 0.0 - 7.0
+            return acc, found
+
+        n = P.to_tensor(np.int32(4))
+        # lim=3: break at acc=4 on i=1 → else skipped
+        acc, found = f(t([2.0]), n, t([3.0]))
+        assert np.allclose(acc.numpy(), [4.0])
+        assert float(np.asarray(found.numpy())) == 1.0
+        # lim huge: exhausts → else rewrites acc
+        acc2, found2 = f(t([2.0]), n, t([1e9]))
+        assert np.allclose(acc2.numpy(), [-7.0])
+        assert float(np.asarray(found2.numpy())) == 0.0
+        _compiled_ok(f)
+
+    def test_for_else_no_break_always_runs(self):
+        @to_static
+        def f(x, n):
+            s = x * 0.0
+            for _ in range(n):
+                s = s + x
+            else:
+                s = s + 0.5
+            return s
+
+        out = f(t([1.0]), P.to_tensor(np.int32(3)))
+        assert np.allclose(out.numpy(), [3.5])
+        # zero-iteration loop: else still runs (Python semantics)
+        out0 = f(t([1.0]), P.to_tensor(np.int32(0)))
+        assert np.allclose(out0.numpy(), [0.5])
+        _compiled_ok(f)
+
+    def test_while_else_no_break_always_runs(self):
+        @to_static
+        def f(x):
+            s = x * 0.0
+            while s.sum() < 3.0:
+                s = s + x
+            else:
+                s = s + 0.25
+            return s
+
+        assert np.allclose(f(t([1.0])).numpy(), [3.25])
+        _compiled_ok(f)
+
+    def test_return_in_loop_skips_else(self):
+        """An in-loop return exits the function — the else must NOT run
+        (the extraction exits via break, which skips it)."""
+        @to_static
+        def f(x, lim):
+            s = x * 0.0
+            for _ in range(4):
+                s = s + x
+                if s.sum() >= lim.sum():
+                    return s * 10.0
+            else:
+                s = s - 1.0
+            return s
+
+        # returns inside loop at s=4 (i=1) → 40, else skipped
+        assert np.allclose(f(t([2.0]), t([3.0])).numpy(), [40.0])
+        # exhausts: s=8 → else → 7
+        assert np.allclose(f(t([2.0]), t([1e9])).numpy(), [7.0])
+        _compiled_ok(f)
+
+    def test_continue_still_runs_else(self):
+        @to_static
+        def f(x, n):
+            s = x * 0.0
+            for i in range(n):
+                if i % 2 == 0:
+                    continue
+                s = s + x
+            else:
+                s = s + 0.5
+            return s
+
+        # odd i in range(5): 1, 3 → 2 adds, else runs
+        out = f(t([1.0]), P.to_tensor(np.int32(5)))
+        assert np.allclose(out.numpy(), [2.5])
+        _compiled_ok(f)
+
+    def test_else_with_return_traced(self):
+        @to_static
+        def f(x, lim):
+            s = x * 0.0
+            while s.sum() < 10.0:
+                s = s + x
+                if s.sum() >= lim.sum():
+                    break
+            else:
+                return s * 0.0 - 5.0
+            return s
+
+        # break at s=6 → post-loop return s
+        assert np.allclose(f(t([3.0]), t([5.0])).numpy(), [6.0])
+        # exhausts at s=12 → else returns -5
+        assert np.allclose(f(t([3.0]), t([1e9])).numpy(), [-5.0])
+        _compiled_ok(f)
+
+    def test_concrete_break_else_python_semantics(self):
+        """Concrete predicates: flag machinery runs in plain Python and
+        must preserve exact loop-else semantics."""
+        @to_static
+        def f(x, stop_at):
+            hits = 0
+            for i in range(6):
+                x = x + 1.0
+                hits = i
+                if i == stop_at:
+                    break
+            else:
+                x = x - 100.0
+            return x, hits
+
+        out, hits = f(t([0.0]), 2)
+        assert np.allclose(out.numpy(), [3.0])
+        # stop_at outside the range: else runs
+        out2, _ = f(t([0.0]), 99)
+        assert np.allclose(out2.numpy(), [6.0 - 100.0])
+
+    def test_nested_loop_else_break_targets_outer(self):
+        """A break in a NESTED loop's else clause targets the OUTER
+        loop (it is outside the inner loop). The outer else must be
+        skipped — this shape conservatively stays a Python loop (the
+        escape is not under plain ifs), so eager semantics apply."""
+        @to_static
+        def f(x):
+            s = x
+            while float(s.sum()) < 10.0:
+                for _ in range(3):
+                    s = s + 1.0
+                else:
+                    break  # targets the outer while
+            else:
+                s = s - 100.0
+            return s
+
+        # inner for always exhausts -> its else breaks the outer while
+        # on the first pass; outer else must NOT run: 0 + 3 = 3
+        assert np.allclose(f(t([0.0])).numpy(), [3.0])
+
+    def test_inner_break_and_else_break_compose(self):
+        """Inner loop with its OWN break plus an else that breaks the
+        outer loop: the inner else lowers to `if not inner_brk: break`,
+        a plain conditional escape the outer desugar handles."""
+        @to_static
+        def f(x, inner_lim):
+            s = x * 0.0
+            rounds = x.sum() * 0.0
+            while s.sum() < 50.0:
+                rounds = rounds + 1.0
+                for _ in range(4):
+                    s = s + x
+                    if s.sum() >= inner_lim.sum():
+                        break  # inner's own break: else skipped
+                else:
+                    break  # inner exhausted: stop the outer loop
+            else:
+                s = s - 1000.0
+            return s, rounds
+
+        # inner_lim huge: inner exhausts on pass 1 -> outer breaks at
+        # s=4, outer else skipped
+        s, rounds = f(t([1.0]), t([1e9]))
+        assert np.allclose(s.numpy(), [4.0])
+        assert float(np.asarray(rounds.numpy())) == 1.0
+        # inner_lim=2: pass 1 adds 2 (break at s=2), every later pass
+        # re-enters with s>=2 and breaks after ONE add — s reaches 50
+        # on pass 49; the while test then fails -> outer else runs
+        s2, rounds2 = f(t([1.0]), t([2.0]))
+        assert np.allclose(s2.numpy(), [50.0 - 1000.0])
+        assert float(np.asarray(rounds2.numpy())) == 49.0
