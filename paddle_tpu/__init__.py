@@ -38,7 +38,14 @@ from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
-from . import linalg  # noqa: F401
+# `from . import linalg` would short-circuit on the attribute the ops
+# star-import already bound (the ops.linalg SUBMODULE — IMPORT_FROM
+# checks the package attr before importing), silently shadowing the
+# full paddle_tpu/linalg/ package (cond/ormqr/vecdot were unreachable
+# via `paddle_tpu.linalg` until round 6). Force the real submodule.
+import importlib as _importlib  # noqa: E402
+
+linalg = _importlib.import_module(".linalg", __name__)
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

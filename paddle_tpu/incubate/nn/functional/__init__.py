@@ -415,3 +415,98 @@ def fused_multi_transformer(
     if caches_out is not None:
         return x, caches_out
     return x
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+        sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+        qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+        rotary_emb_dims=0, use_neox_rotary_style=False,
+        compute_dtype="default", out_scale=-1, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0):
+    """Decode-phase fused attention (reference paddle.incubate.nn.
+    functional.masked_multihead_attention — upstream path unverified,
+    mount empty): one new token's packed qkv attends over the KV cache,
+    which is updated in place at the current position.
+
+    x: [bsz, 3*num_head*dim_head] (seq_len=1 decode step).
+    cache_kv: [2, bsz, num_head, max_seq_len, dim_head].
+    src_mask: additive mask broadcast onto [bsz, 1, 1, t+1] scores.
+    sequence_lengths: [bsz, 1] int32 current lengths (write position);
+    when None the position is src_mask.shape[-1] - 1 for every row.
+
+    Returns (out [bsz, num_head*dim_head], cache_kv_out). TPU-native
+    shape: the cache update is one batched scatter and the
+    attention a masked softmax over the static max_seq_len axis — the
+    same compiled-decode pattern models/generation.py uses, so XLA fuses
+    it into the standard single-token HBM-bound program.
+
+    Quantized in/out (qkv_out_scale/out_shift/out_smooth/out_scale),
+    variable-batch cum_offsets, beam search offsets, and fused rotary
+    are not supported on this path — models apply RoPE via
+    fused_rotary_position_embedding before the cache write instead
+    (loud guard below, matching the repo's unsupported-argument
+    discipline)."""
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    for nm, val in (("cum_offsets", cum_offsets),
+                    ("rotary_tensor", rotary_tensor),
+                    ("beam_cache_offset", beam_cache_offset),
+                    ("qkv_out_scale", qkv_out_scale),
+                    ("out_shift", out_shift), ("out_smooth", out_smooth)):
+        if val is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {nm} is not supported "
+                "(quant/beam/fused-rope paths)")
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: out_scale quantization")
+    if seq_len != 1:
+        raise NotImplementedError(
+            "masked_multihead_attention handles one decode step "
+            f"(seq_len=1), got {seq_len}")
+    x = ensure_tensor(x)
+    cache_kv = ensure_tensor(cache_kv)
+    _, bsz, nh, max_len, hd = cache_kv.shape
+    args = [x, cache_kv]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    if src_mask is not None:
+        args.append(ensure_tensor(src_mask))
+    if sequence_lengths is not None:
+        args.append(ensure_tensor(sequence_lengths))
+
+    def f(xa, ca, *rest):
+        rest = list(rest)
+        ba = rest.pop(0) if bias is not None else None
+        ma = rest.pop(0) if src_mask is not None else None
+        sl = rest.pop(0) if sequence_lengths is not None else None
+        qkv = xa if ba is None else xa + ba
+        q, k, v = (t.reshape(bsz, nh, hd)
+                   for t in jnp.split(qkv, 3, axis=-1))
+        if sl is not None:
+            pos = sl.reshape(bsz).astype(jnp.int32)       # per row
+        elif ma is not None:
+            pos = jnp.full((bsz,), ma.shape[-1] - 1, jnp.int32)
+        else:
+            raise ValueError("need sequence_lengths or src_mask to "
+                             "locate the decode position")
+        # cache write at per-row pos: one batched scatter, O(B·H·D)
+        # writes (not a full-cache blend — this is the decode hot path)
+        bi = jnp.arange(bsz)
+        kc = ca[0].at[bi, :, pos, :].set(k.astype(ca.dtype))
+        vc = ca[1].at[bi, :, pos, :].set(v.astype(ca.dtype))
+        scores = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / (hd ** 0.5)
+        valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, L]
+        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+        if ma is not None:
+            span = ma.shape[-1]
+            scores = scores.at[:, :, :span].add(
+                ma.reshape(bsz, 1, span).astype(jnp.float32))
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhl,bhld->bhd", probs,
+                         vc.astype(jnp.float32)).astype(xa.dtype)
+        return out.reshape(bsz, nh * hd), jnp.stack([kc, vc])
+
+    return apply(f, *args, name="masked_multihead_attention")

@@ -23,7 +23,8 @@ from ..core.random import next_key
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-           "ChainDataset", "Subset", "random_split", "Sampler",
+           "ChainDataset", "ConcatDataset", "Subset", "random_split",
+           "Sampler",
            "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "WeightedRandomSampler", "DataLoader",
            "get_worker_info", "default_collate_fn",
@@ -82,6 +83,38 @@ class ChainDataset(IterableDataset):
     def __iter__(self):
         for d in self.datasets:
             yield from d
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of map-style datasets: index i addresses the
+    dataset whose cumulative-length bucket contains i (reference
+    paddle.io.ConcatDataset; path unverified — mount empty)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets should not be an empty iterable")
+        for d in self.datasets:
+            if isinstance(d, IterableDataset):
+                raise TypeError(
+                    "ConcatDataset does not support IterableDataset")
+        self.cumulative_sizes = list(
+            np.cumsum([len(d) for d in self.datasets]))
+
+    def __len__(self):
+        return int(self.cumulative_sizes[-1])
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            if idx < -n:
+                raise IndexError("index out of range")
+            idx += n
+        elif idx >= n:
+            raise IndexError("index out of range")
+        di = int(np.searchsorted(self.cumulative_sizes, idx, side="right"))
+        prev = self.cumulative_sizes[di - 1] if di > 0 else 0
+        return self.datasets[di][idx - int(prev)]
 
 
 class Subset(Dataset):

@@ -969,7 +969,7 @@ class _CFTransformer(ast.NodeTransformer):
         and a break only occurs on its bail paths, and those keep the
         Python loop anyway.)"""
         self.changed = True
-        inner = type(node)(**{f: getattr(node, f) for f in node._fields})
+        inner = copy.copy(node)
         inner.orelse = []
         out = lower(inner)
         out = out if isinstance(out, list) else [out]

@@ -2,3 +2,19 @@
 from ..ops.linalg import *  # noqa: F401,F403
 from ..ops.math import matmul  # noqa: F401
 from ..ops.extras2 import cond, ormqr, vecdot  # noqa: E402,F401
+
+
+def matrix_transpose(x, name=None):
+    """paddle.linalg.matrix_transpose: swap the last two dims (batched
+    matrix transpose; reference path unverified — mount empty)."""
+    import jax.numpy as jnp
+
+    from ..core.autograd import apply
+    from ..ops._base import ensure_tensor
+    x = ensure_tensor(x)
+    if len(x.shape) < 2:
+        raise ValueError(
+            "matrix_transpose expects at least a 2-D tensor, got "
+            f"{len(x.shape)}-D")
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x,
+                 name="matrix_transpose")

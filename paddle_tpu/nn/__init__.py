@@ -27,7 +27,8 @@ from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    LayerNorm, LocalResponseNorm, RMSNorm, SpectralNorm,
                    SyncBatchNorm)
 from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
-                      AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+                      AvgPool1D, AvgPool2D, FractionalMaxPool2D,
+                      FractionalMaxPool3D, MaxPool1D, MaxPool2D)
 from .rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, SimpleRNN,
                   SimpleRNNCell)
 from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,

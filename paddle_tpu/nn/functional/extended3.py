@@ -359,3 +359,156 @@ def sparse_attention(query, key, value, sparse_csr_offset,
 
 
 __all__ += ["sparse_attention"]
+
+# -- fractional max pooling (round-6) ---------------------------------------
+#
+# Reference: paddle.nn.functional.fractional_max_pool2d/3d (python/paddle/
+# nn/functional/pooling.py — upstream path unverified, mount empty), after
+# Graham, "Fractional Max-Pooling". Two region modes:
+#   * kernel_size given: pseudorandom OVERLAPPING regions of fixed width
+#     k at starts s_i = floor((i+u)*alpha) - floor(u*alpha) with
+#     alpha = (in-k)/(out-1) and s_last = in-k (the torch/aten interval
+#     formula — torch-oracle-testable).
+#   * kernel_size None: DISJOINT regions with edges
+#     a_i = ceil(alpha*(i+u)) - ceil(alpha*u), alpha = in/out — a_0 = 0,
+#     a_out = in, widths in {floor(alpha), ceil(alpha)} (the paper's
+#     pseudorandom increment sequence).
+# u in (0,1) is one scalar (the reference's `random_u`). Regions are
+# computed in NumPy at trace time (u is host-side; shapes stay static)
+# and the pool is one rectangular multi-axis gather + masked max — no
+# dynamic shapes, XLA-friendly.
+
+def _frac_intervals(in_sz, out_sz, k, u):
+    if k is not None:
+        if k > in_sz:
+            raise ValueError(f"kernel_size {k} exceeds input size {in_sz}")
+        if out_sz == 1:
+            starts = np.asarray([in_sz - k], dtype=np.int64)
+        else:
+            alpha = (in_sz - k) / (out_sz - 1)
+            starts = (np.floor((np.arange(out_sz - 1) + u) * alpha)
+                      - np.floor(u * alpha)).astype(np.int64)
+            starts = np.concatenate([starts, [in_sz - k]])
+        widths = np.full(out_sz, k, dtype=np.int64)
+    else:
+        alpha = in_sz / out_sz
+        edges = (np.ceil(alpha * (np.arange(out_sz + 1) + u))
+                 - np.ceil(alpha * u)).astype(np.int64)
+        edges[0], edges[-1] = 0, in_sz
+        starts, widths = edges[:-1], np.diff(edges)
+    if (widths <= 0).any() or (starts < 0).any() or \
+            (starts + widths > in_sz).any():
+        raise ValueError(
+            f"invalid fractional pool regions: input {in_sz}, output "
+            f"{out_sz}, kernel {k} (output_size larger than input?)")
+    return starts, widths
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u,
+                         return_mask, ndim, name):
+    x = ensure_tensor(x)
+    if len(x.shape) != ndim + 2:
+        raise ValueError(f"{name} expects a {ndim + 2}-D NC"
+                         f"{'DHW'[3 - ndim:]} tensor, got "
+                         f"{len(x.shape)}-D")
+    tup = lambda v: (v,) * ndim if isinstance(v, int) else tuple(v)
+    outs = tup(output_size)
+    ks = (None,) * ndim if kernel_size is None else tup(kernel_size)
+    if random_u is None:
+        import jax.random as jrandom
+        u = float(jrandom.uniform(next_key(), (), minval=1e-6,
+                                  maxval=1.0 - 1e-6))
+    else:
+        u = float(random_u)
+        if not 0.0 < u < 1.0:
+            raise ValueError(f"random_u must be in (0, 1), got {u}")
+    spatial = tuple(x.shape[2:])
+    starts_widths = [_frac_intervals(spatial[d], outs[d], ks[d], u)
+                     for d in range(ndim)]
+    # per-axis gather tables [out_d, wmax_d] + validity masks
+    idxs, valids, wmaxs = [], [], []
+    for d in range(ndim):
+        starts, widths = starts_widths[d]
+        wmax = int(widths.max())
+        idx = np.minimum(starts[:, None] + np.arange(wmax)[None, :],
+                         spatial[d] - 1)
+        valids.append(np.arange(wmax)[None, :] < widths[:, None])
+        idxs.append(idx)
+        wmaxs.append(wmax)
+
+    def f(a):
+        g = a
+        # joint gather: after the loop g is [N, C, o0, w0, o1, w1, ...]
+        for d in range(ndim):
+            g = jnp.take(g, jnp.asarray(idxs[d].reshape(-1)),
+                         axis=2 + 2 * d)
+            g = g.reshape(g.shape[:2 + 2 * d] + (outs[d], wmaxs[d])
+                          + g.shape[3 + 2 * d:])
+        # [N, C, o0, o1, ..., w0, w1, ...]
+        perm = ((0, 1) + tuple(2 + 2 * d for d in range(ndim))
+                + tuple(3 + 2 * d for d in range(ndim)))
+        g = jnp.transpose(g, perm)
+        flat = g.reshape(g.shape[:2 + ndim] + (-1,))
+        vmask = valids[0]
+        shape_v = [outs[0], wmaxs[0]]
+        for d in range(1, ndim):
+            # outer-and across axes -> [o0, .., od, w0, .., wd]
+            vmask = (vmask.reshape(shape_v[:len(shape_v) // 2]
+                                   + [1] + shape_v[len(shape_v) // 2:]
+                                   + [1])
+                     & valids[d].reshape([1] * (len(shape_v) // 2)
+                                         + [outs[d]]
+                                         + [1] * (len(shape_v) // 2)
+                                         + [wmaxs[d]]))
+            shape_v = ([*shape_v[:len(shape_v) // 2], outs[d]]
+                       + shape_v[len(shape_v) // 2:] + [wmaxs[d]])
+            vmask = vmask.reshape(shape_v)
+        vflat = jnp.asarray(vmask.reshape(tuple(outs) + (-1,)))
+        flat = jnp.where(vflat, flat, -jnp.inf)
+        out = jnp.max(flat, axis=-1)
+        if not return_mask:
+            return out
+        am = jnp.argmax(flat, axis=-1)          # [N, C, o0, o1, ...]
+        # decompose the within-region flat argmax into per-axis window
+        # offsets, then map through the gather tables to absolute
+        # coordinates and the reference's flattened spatial index
+        offs = []
+        rem = am
+        for d in reversed(range(ndim)):
+            offs.insert(0, rem % wmaxs[d])
+            rem = rem // wmaxs[d]
+        flat_abs = None
+        for d in range(ndim):
+            table = jnp.asarray(idxs[d])        # [o_d, wmax_d]
+            od_index = jnp.arange(outs[d])
+            abs_d = table[od_index.reshape(
+                [1] * (2 + d) + [outs[d]] + [1] * (ndim - 1 - d)),
+                offs[d]]
+            flat_abs = abs_d if flat_abs is None else \
+                flat_abs * spatial[d] + abs_d
+        return out, flat_abs.astype(jnp.int32)
+
+    if return_mask:
+        out, mask = apply(f, x, name=name)
+        return out, mask.detach()
+    return apply(f, x, name=name)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """paddle.nn.functional.fractional_max_pool2d (NCHW). See the
+    section note for the region formulas; `return_mask` returns flat
+    H*W argmax positions (the max_unpool convention)."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """paddle.nn.functional.fractional_max_pool3d (NCDHW); mask indices
+    flatten D*H*W."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")
+
+
+__all__ += ["fractional_max_pool2d", "fractional_max_pool3d"]

@@ -79,3 +79,32 @@ class AdaptiveAvgPool1D(Layer):
 
     def forward(self, x):
         return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    """paddle.nn.FractionalMaxPool2D (round-6): pseudorandom fractional
+    pooling regions — see functional.fractional_max_pool2d."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(
+            x, self.output_size, kernel_size=self.kernel_size,
+            random_u=self.random_u, return_mask=self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(
+            x, self.output_size, kernel_size=self.kernel_size,
+            random_u=self.random_u, return_mask=self.return_mask)

@@ -347,3 +347,45 @@ class TestNewCallbacks:
             seq.append(float(s()))
             s.step()
         assert seq == [1.0, 0.5, 0.25]
+
+
+class TestConcatDataset:
+    """paddle.io.ConcatDataset parity (round-6): bucketed indexing over
+    concatenated map-style datasets."""
+
+    def test_indexing_and_len(self):
+        from paddle_tpu.io import ConcatDataset
+        a, b = SquaresDataset(3), SquaresDataset(5)
+        cd = ConcatDataset([a, b])
+        assert len(cd) == 8
+        # first bucket
+        assert np.allclose(cd[2][1], [4.0])
+        # second bucket restarts the inner index
+        assert np.allclose(cd[3][0], [0.0])
+        assert np.allclose(cd[7][1], [16.0])
+        # negatives wrap from the end
+        assert np.allclose(cd[-1][1], [16.0])
+        assert np.allclose(cd[-8][0], [0.0])
+        with pytest.raises(IndexError):
+            cd[8]
+        with pytest.raises(IndexError):
+            cd[-9]
+
+    def test_rejects_iterable_and_empty(self):
+        from paddle_tpu.io import ConcatDataset, IterableDataset
+
+        class It(IterableDataset):
+            def __iter__(self):
+                yield 1
+
+        with pytest.raises(TypeError):
+            ConcatDataset([SquaresDataset(2), It()])
+        with pytest.raises(ValueError):
+            ConcatDataset([])
+
+    def test_through_dataloader(self):
+        from paddle_tpu.io import ConcatDataset
+        cd = ConcatDataset([SquaresDataset(2), SquaresDataset(2)])
+        xs = [float(np.asarray(x.numpy()).ravel()[0])
+              for x, _ in DataLoader(cd, batch_size=1)]
+        assert xs == [0.0, 1.0, 0.0, 1.0]
