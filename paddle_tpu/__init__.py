@@ -112,6 +112,12 @@ def is_compiled_with_custom_device(device_name):
     return device_name in ("tpu", "axon")
 
 
+def get_cudnn_version():
+    """paddle.get_cudnn_version: None when not built with CUDA (the
+    reference contract) — always None on this TPU-native build."""
+    return None
+
+
 from .ops.logic import histogram_bin_edges  # noqa: E402,F401
 
 

@@ -1,8 +1,11 @@
 """paddle_tpu.distributed.fleet (reference: paddle.distributed.fleet)."""
 from . import utils  # noqa: F401
-from .fleet import (Fleet, HybridParallelWrapper, distributed_model,  # noqa: F401
-                    distributed_optimizer, get_hybrid_group, init,
-                    is_initialized)
+from .fleet import (Fleet, HybridParallelWrapper, barrier_worker,  # noqa: F401
+                    distributed_model, distributed_optimizer,
+                    get_hybrid_group, init, init_worker, is_first_worker,
+                    is_initialized, save_inference_model,
+                    save_persistables, stop_worker, worker_endpoints,
+                    worker_index, worker_num)
 from .hybrid_optimizer import (DygraphShardingOptimizer,  # noqa: F401
                                DygraphShardingOptimizerV2,
                                HybridParallelOptimizer)

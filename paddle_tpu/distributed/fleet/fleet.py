@@ -183,3 +183,75 @@ class Fleet:
 
     def stop_worker(self):
         pass
+
+
+# -- module-level worker-info forwards (round-6) ---------------------------
+# The reference exposes the singleton's bound methods as fleet.* module
+# functions (python/paddle/distributed/fleet/__init__.py — unverified).
+
+def worker_index():
+    return Fleet().worker_index()
+
+
+def worker_num():
+    return Fleet().worker_num()
+
+
+def is_first_worker():
+    return Fleet().is_first_worker()
+
+
+def worker_endpoints(to_string=False):
+    return Fleet().worker_endpoints(to_string)
+
+
+def barrier_worker():
+    return Fleet().barrier_worker()
+
+
+def stop_worker():
+    return Fleet().stop_worker()
+
+
+def init_worker():
+    """Collective mode needs no parameter-server warmup; no-op (the
+    reference's PS path is survey-sanctioned out of scope)."""
+
+
+def save_inference_model(executor, dirname, feeded_var_names, target_vars,
+                         main_program=None, export_for_deployment=True):
+    """fleet.save_inference_model: rank-0 delegate to the static-path
+    saver (StableHLO artifact). The reference passes feed NAMES —
+    resolved here to the program's feed placeholder tensors."""
+    from ... import static as _static
+    if Fleet().worker_index() != 0:
+        return
+    prog = main_program or _static.default_main_program()
+
+    def resolve(v):
+        if not isinstance(v, str):
+            return v
+        key = prog._feeds.get(v)
+        if key is None:
+            raise ValueError(f"feed variable {v!r} is not a data() var "
+                             "of the program")
+        for t in prog._pins:
+            if id(t) == key:
+                return t
+        raise ValueError(f"feed variable {v!r} placeholder not found")
+
+    feeds = [resolve(v) for v in (feeded_var_names or [])]
+    _static.save_inference_model(dirname, feeds, list(target_vars),
+                                 executor=executor,
+                                 program=main_program)
+
+
+def save_persistables(executor, dirname, main_program=None):
+    """fleet.save_persistables: rank-0 delegate to static.save."""
+    from ... import static as _static
+    if Fleet().worker_index() != 0:
+        return
+    prog = main_program
+    if prog is None:
+        prog = _static.default_main_program()
+    _static.save(prog, dirname)

@@ -130,3 +130,103 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
     return _jit_load(path_prefix)
+
+
+def cpu_places(device_count=None):
+    """paddle.static.cpu_places (reference python/paddle/base/framework
+    — unverified): CPU places; count defaults to 1 (the reference reads
+    CPU_NUM)."""
+    import os
+
+    from ..core.device import Place
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [Place("cpu", i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """paddle.static.cuda_places, TPU-natively: places of the visible
+    ACCELERATOR devices (tpu under axon/PJRT — the role 'cuda_places'
+    plays in reference code is "give me the accelerators"). Falls back
+    to CPU places when no accelerator is attached."""
+    import jax
+
+    from ..core.device import Place
+    kinds = {"tpu": "tpu", "axon": "tpu", "gpu": "gpu", "cuda": "gpu"}
+    devs = [d for d in jax.local_devices()
+            if d.platform in kinds]
+    if not devs:
+        return cpu_places(len(device_ids) if device_ids else None)
+    if device_ids is None:
+        device_ids = range(len(devs))
+    return [Place(kinds[devs[i].platform], i) for i in device_ids]
+
+
+def save(program, path_prefix, protocol=4):
+    """paddle.static.save: persist the program's parameters
+    (``.pdparams``) and the remaining float leaf state, e.g. optimizer
+    moments pinned by minimize (``.pdopt``). Positional format — the
+    reference keys by variable name; record-time ids are not stable
+    across processes, so entries are (name, array) pairs restored by
+    position into the SAME program structure."""
+    import pickle
+
+    import numpy as np
+
+    from ..core.tensor import Parameter
+    params, state = [], []
+    for t in program._leaves.values():
+        entry = (getattr(t, "name", None), np.asarray(t._data))
+        (params if isinstance(t, Parameter) else state).append(entry)
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+    if state:
+        with open(path_prefix + ".pdopt", "wb") as f:
+            pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    """paddle.static.load: restore what `save` wrote, by position."""
+    import os
+    import pickle
+
+    import jax.numpy as jnp
+
+    from ..core.tensor import Parameter
+    with open(path_prefix + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    state = []
+    if os.path.exists(path_prefix + ".pdopt"):
+        with open(path_prefix + ".pdopt", "rb") as f:
+            state = pickle.load(f)
+    targets_p = [t for t in program._leaves.values()
+                 if isinstance(t, Parameter)]
+    targets_s = [t for t in program._leaves.values()
+                 if not isinstance(t, Parameter)]
+    if len(params) != len(targets_p):
+        raise ValueError(
+            f"checkpoint has {len(params)} parameters, program has "
+            f"{len(targets_p)} — was it saved from this program?")
+    if state and len(state) != len(targets_s):
+        raise ValueError(
+            f"checkpoint has {len(state)} aux-state entries, program "
+            f"has {len(targets_s)} — rebuild the program to the same "
+            "point (e.g. run minimize before load) or delete the "
+            ".pdopt file for a params-only restore")
+    for t, (_, arr) in zip(targets_p, params):
+        t._inplace_update(jnp.asarray(arr).astype(t._data.dtype))
+    for t, (_, arr) in zip(targets_s, state):
+        t._inplace_update(jnp.asarray(arr).astype(t._data.dtype))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """paddle.static.normalize_program: prune a trained program down to
+    the inference graph for the given feeds/fetches. The record-replay
+    design makes this the test-mode clone (dead-record elimination at
+    run time keeps exactly the ops reaching the fetches)."""
+    return program.clone(for_test=True)
+
+
+from .program import gradients  # noqa: E402,F401
+
+__all__ += ["cpu_places", "cuda_places", "save", "load",
+            "normalize_program", "gradients"]

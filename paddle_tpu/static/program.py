@@ -389,3 +389,106 @@ class Executor:
 
     def close(self):
         pass
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              program=None):
+    """paddle.static.gradients: append records computing d(sum targets)
+    / d(inputs) for ARBITRARY program values (feeds, parameters, or
+    intermediates — reference python/paddle/base/backward.py gradients,
+    unverified). Same TPU-native design as append_backward: ONE record
+    replaying the forward sub-program under jax.grad; an intermediate
+    input is treated as an independent variable by substituting it
+    right after the record that produced it (standard cut-the-graph
+    semantics), and `no_grad_set` values are routed through
+    lax.stop_gradient at their production site. Returns one gradient
+    Tensor per input (fetchable program values)."""
+    prog = program if program is not None else default_main_program()
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    tg = target_gradients
+    if tg is not None:
+        tg = list(tg) if isinstance(tg, (list, tuple)) else [tg]
+        if len(tg) != len(targets):
+            raise ValueError("target_gradients must match targets")
+        for t in tg:
+            if t is not None and id(t) not in prog._produced \
+                    and id(t) not in prog._leaves:
+                prog._leaves[id(t)] = t
+                prog._pins.append(t)
+    for t in targets:
+        if id(t) not in prog._produced and id(t) not in prog._leaves:
+            raise ValueError("gradients: target was not produced by this "
+                             "Program")
+    known = set(prog._produced) | set(prog._leaves) \
+        | set(prog._feeds.values())
+    for x in inputs:
+        if id(x) not in known:
+            raise ValueError("gradients: input is not a value of this "
+                             "Program (feed, parameter, or op output)")
+    stop_keys = {id(s) for s in (no_grad_set or ())}
+    fwd_records = list(prog._records)
+    input_keys = [id(x) for x in inputs]
+    input_dtypes = [x._data.dtype for x in inputs]
+    target_keys = [id(t) for t in targets]
+    tg_keys = [None if tg is None or tg[i] is None else id(tg[i])
+               for i in range(len(targets))]
+    feed_keys = tuple(prog._feeds[n] for n in sorted(prog._feeds))
+    leaf_keys = tuple(prog._leaves.keys())
+    in_keys = feed_keys + leaf_keys
+
+    def _replay(e, sub):
+        """Run fwd_records over env e; `sub` maps value-key -> override
+        array (the independent variables). Overrides apply to seed
+        values immediately and to produced values at their production
+        site; no_grad_set values get stop_gradient at production."""
+        e = dict(e)
+        for k, v in sub.items():
+            if k in e:
+                e[k] = v
+        for rec in fwd_records:
+            args = [e[k] for k in rec.in_keys]
+            out = rec.fn(*args)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            e.update(zip(rec.out_keys, outs))
+            for k in rec.out_keys:
+                if k in sub:
+                    e[k] = sub[k]
+                elif k in stop_keys:
+                    e[k] = jax.lax.stop_gradient(e[k])
+        return e
+
+    def _grads_fn(*args):
+        env0 = dict(zip(in_keys, args))
+        base = _replay(env0, {})
+
+        def total(xval, key):
+            # each input differentiated INDEPENDENTLY (reference
+            # semantics): only this input's value is cut from the
+            # graph, so another requested input does not sever a path
+            # the current one flows through
+            e = _replay(env0, {key: xval})
+            s = jnp.float32(0.0)
+            for i, tk in enumerate(target_keys):
+                ct = (e[tg_keys[i]] if tg_keys[i] is not None
+                      else jnp.ones_like(e[tk]))
+                s = s + jnp.sum(e[tk].astype(jnp.float32)
+                                * ct.astype(jnp.float32))
+            return s
+
+        # one grad per input; XLA CSEs the shared replays inside the jit
+        return tuple(
+            jax.grad(total)(base[k], k).astype(dt)
+            for k, dt in zip(input_keys, input_dtypes))
+
+    grad_tensors = [Tensor(jnp.zeros_like(x._data)) for x in inputs]
+    for x, g in zip(inputs, grad_tensors):
+        g.name = (getattr(x, "name", None) or "var") + "@GRAD"
+    prog._produced.update(id(g) for g in grad_tensors)
+    prog._pins.extend(grad_tensors)
+    prog._records.append(_Record(
+        _grads_fn, in_keys, tuple(id(g) for g in grad_tensors),
+        "gradients", kind="backward"))
+    return grad_tensors

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..core.autograd import apply as _apply
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 from ..ops._base import ensure_tensor
@@ -399,3 +401,244 @@ class DeformConv2D(Layer):
         s, p, d, dg, g = self._args
         return deform_conv2d(x, offset, self.weight, self.bias, s, p, d,
                              dg, g, mask)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order
+              =False, name=None):
+    """SSD prior (anchor) box generation (reference paddle.vision.ops.
+    prior_box — upstream python/paddle/vision/ops.py, unverified).
+    input: [N, C, H, W] feature map; image: [N, C, Him, Wim]. Returns
+    (boxes [H, W, num_priors, 4] normalized xmin/ymin/xmax/ymax,
+    variances broadcast to the same shape). Pure elementwise decode —
+    one fused XLA kernel."""
+    input, image = ensure_tensor(input), ensure_tensor(image)
+    H, W = input.shape[2], input.shape[3]
+    Him, Wim = image.shape[2], image.shape[3]
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    min_sizes = [float(m) for m in min_sizes]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must pair with min_sizes")
+    step_w = float(steps[0]) or Wim / W
+    step_h = float(steps[1]) or Him / H
+    # per-cell prior (w, h) list in the reference's order
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                s = (ms * max_sizes[i]) ** 0.5
+                whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+        else:
+            for ar in ars:
+                whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+            if max_sizes:
+                s = (ms * max_sizes[i]) ** 0.5
+                whs.append((s, s))
+
+    def f(_in, _img):
+        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+        cx = cx[None, :, None] / Wim                        # [1, W, 1]
+        cy = cy[:, None, None] / Him                        # [H, 1, 1]
+        bw = jnp.asarray([w for w, _ in whs], jnp.float32)[None, None, :] \
+            / (2.0 * Wim)
+        bh = jnp.asarray([h for _, h in whs], jnp.float32)[None, None, :] \
+            / (2.0 * Him)
+        boxes = jnp.stack(jnp.broadcast_arrays(
+            cx - bw, cy - bh, cx + bw, cy + bh), axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return _apply(f, input, image, name="prior_box")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference paddle.vision.ops.matrix_nms —
+    unverified). Decay-based soft suppression: for each candidate the
+    min over higher-scored same-class boxes of decay(iou)/decay(max iou
+    of the suppressor) — all-pairs, no sequential worklist, so it is
+    one masked matrix program on the VPU (the design the paper picked
+    for parallel hardware; exact, not an approximation).
+
+    bboxes [N, M, 4], scores [N, C, M]. Static-shape contract: returns
+    (out [N*keep_top_k, 6] rows (label, score, x1, y1, x2, y2) with
+    score 0 padding, rois_num [N], index [N*keep_top_k, 1])."""
+    bboxes, scores = ensure_tensor(bboxes), ensure_tensor(scores)
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    # pixel-coordinate boxes measure +1 wide/tall (same convention as
+    # box_coder's `norm` above)
+    off = 0.0 if normalized else 1.0
+
+    def one_image(boxes, scr):
+        # flatten candidates over classes (skip background)
+        cls_ids = jnp.arange(C)
+        keep_cls = cls_ids != background_label
+        flat_scores = jnp.where(keep_cls[:, None], scr, -1.0).reshape(-1)
+        flat_cls = jnp.repeat(cls_ids, M)
+        flat_box = jnp.tile(jnp.arange(M), C)
+        ok = flat_scores > score_threshold
+        flat_scores = jnp.where(ok, flat_scores, -1.0)
+        k = min(nms_top_k, C * M)
+        top_scores, top_idx = jax.lax.top_k(flat_scores, k)
+        tcls = flat_cls[top_idx]
+        tbox = boxes[flat_box[top_idx]]                       # [k, 4]
+        valid = top_scores > score_threshold
+        # pairwise IoU over the top-k
+        area = jnp.maximum(tbox[:, 2] - tbox[:, 0] + off, 0.0) * \
+            jnp.maximum(tbox[:, 3] - tbox[:, 1] + off, 0.0)
+        lt = jnp.maximum(tbox[:, None, :2], tbox[None, :, :2])
+        rb = jnp.minimum(tbox[:, None, 2:], tbox[None, :, 2:])
+        wh = jnp.maximum(rb - lt + off, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+        # suppressor mask: higher-scored (earlier in top-k), same class
+        ii = jnp.arange(k)
+        sup = (ii[None, :] < ii[:, None]) & \
+            (tcls[:, None] == tcls[None, :]) & \
+            valid[None, :] & valid[:, None]
+        iou_s = jnp.where(sup, iou, 0.0)                      # [i, j]
+        # comp[j]: suppressor j's own max IoU with ITS higher-scored
+        # peers (the paper's normalizer)
+        comp = jnp.max(iou_s, axis=1)                         # [k]
+        if use_gaussian:
+            decay = jnp.exp(-(iou_s ** 2 - comp[None, :] ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1.0 - iou_s) / jnp.maximum(1.0 - comp[None, :],
+                                                1e-10)
+        decay = jnp.where(sup, decay, 1.0)
+        factor = jnp.min(decay, axis=1)
+        new_scores = jnp.where(valid, top_scores * factor, 0.0)
+        keep = new_scores > post_threshold
+        new_scores = jnp.where(keep, new_scores, 0.0)
+        kk = min(keep_top_k, k)
+        fin_scores, fin_idx = jax.lax.top_k(new_scores, kk)
+        rows = jnp.concatenate([
+            tcls[fin_idx, None].astype(boxes.dtype),
+            fin_scores[:, None].astype(boxes.dtype),
+            tbox[fin_idx]], axis=1)
+        cnt = jnp.sum((fin_scores > 0).astype(jnp.int32))
+        src = flat_box[top_idx][fin_idx]
+        return rows, cnt, src[:, None].astype(jnp.int32)
+
+    def f(ba, sa):
+        rows, cnt, idx = jax.vmap(one_image)(ba, sa)
+        return (rows.reshape(-1, 6), cnt.astype(jnp.int32),
+                idx.reshape(-1, 1))
+
+    out, rois_num, index = _apply(f, bboxes, scores,
+                                  name="matrix_nms")
+    res = [out]
+    if return_rois_num:
+        res.append(rois_num)
+    if return_index:
+        res.append(index)
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN; reference paddle.vision.
+    ops.psroi_pool — unverified). x: [N, C, H, W] with C = out_c*ps*ps;
+    each (ph, pw) output bin average-pools its OWN channel group —
+    static-shape bin averaging via masked means, vmapped over rois."""
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    if oh != ow:
+        raise NotImplementedError("psroi_pool needs square output_size "
+                                  "(position-sensitive channel split)")
+    N, C, H, W = x.shape
+    if C % (oh * ow) != 0:
+        raise ValueError(f"channels {C} not divisible by "
+                         f"output_size^2 {oh * ow}")
+    out_c = C // (oh * ow)
+    bn = [int(v) for v in np.asarray(boxes_num.numpy()
+                                     if hasattr(boxes_num, "numpy")
+                                     else boxes_num)]
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def one_roi(box, img):
+        x1, y1, x2, y2 = (box[i] * spatial_scale for i in range(4))
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / ow, rh / oh
+        ph = jnp.arange(oh, dtype=jnp.float32)
+        pw = jnp.arange(ow, dtype=jnp.float32)
+        hs = jnp.floor(y1 + ph * bh)[:, None]        # [oh, 1]
+        he = jnp.ceil(y1 + (ph + 1) * bh)[:, None]
+        ws = jnp.floor(x1 + pw * bw)[None, :]        # [1, ow]
+        we = jnp.ceil(x1 + (pw + 1) * bw)[None, :]
+        ih = jnp.arange(H, dtype=jnp.float32)
+        iw = jnp.arange(W, dtype=jnp.float32)
+        # bin membership masks [oh, H] / [ow, W]
+        mh = (ih[None, :] >= hs) & (ih[None, :] < he)  # [oh, H]
+        mw = (iw[None, :] >= ws.T) & (iw[None, :] < we.T)  # [ow, W]
+        feat = img.reshape(out_c, oh * ow, H, W)
+        # per (ph, pw): mean over the bin of channel group ph*ow+pw
+        m2 = (mh[:, None, :, None] & mw[None, :, None, :]).astype(
+            jnp.float32)                              # [oh, ow, H, W]
+        cnt = jnp.maximum(m2.sum((-1, -2)), 1.0)       # [oh, ow]
+        grp = feat.reshape(out_c, oh, ow, H, W)
+        s = jnp.einsum("cijhw,ijhw->cij", grp, m2)
+        return s / cnt
+
+    def f(xa, ba):
+        imgs = xa[jnp.asarray(img_of_roi)]            # [R, C, H, W]
+        return jax.vmap(one_roi)(ba, imgs)
+
+    return _apply(f, x, boxes, name="psroi_pool")
+
+
+def read_file(filename, name=None):
+    """paddle.vision.ops.read_file: raw bytes as a uint8 1-D tensor
+    (host IO — eager only, like the reference CPU kernel)."""
+    with open(filename, "rb") as fh:
+        data = fh.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, dtype=np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """paddle.vision.ops.decode_jpeg: JPEG bytes tensor → [C, H, W]
+    uint8 (PIL-backed host decode; the reference uses nvjpeg on GPU —
+    same contract, eager only)."""
+    import io as _io
+
+    from PIL import Image
+    x = ensure_tensor(x)
+    raw = bytes(np.asarray(x._data, dtype=np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode != "unchanged":
+        img = img.convert(mode.upper() if mode != "gray" else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["prior_box", "matrix_nms", "psroi_pool", "read_file",
+            "decode_jpeg"]
