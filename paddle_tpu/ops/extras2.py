@@ -570,3 +570,71 @@ def lu_solve(b, lu, pivots, trans="N", name=None):
 
 __all__ += ["erfc", "gammainc", "gammaincc", "nanstd", "nanvar",
             "cartesian_prod", "lu_solve"]
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    from .indexing import inplace_rebind
+    from .manipulation import flatten as _flatten
+    return inplace_rebind(
+        x, lambda s: _flatten(s, start_axis=start_axis,
+                              stop_axis=stop_axis))
+
+
+def lerp_(x, y, weight, name=None):
+    from .indexing import inplace_rebind
+    from .math import lerp as _lerp
+    return inplace_rebind(x, lambda s: _lerp(s, ensure_tensor(y), weight))
+
+
+def erfinv_(x, name=None):
+    from .indexing import inplace_rebind
+    from .math import erfinv as _erfinv
+    return inplace_rebind(x, lambda s: _erfinv(s))
+
+
+def index_add_(x, index, axis, value, name=None):
+    from .indexing import inplace_rebind
+    from .manipulation import index_add as _index_add
+    return inplace_rebind(
+        x, lambda s: _index_add(s, index, axis, ensure_tensor(value)))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """paddle.Tensor.fill_diagonal_tensor: write tensor `y` along the
+    (dim1, dim2) diagonal of `x` (out-of-place; reference python/paddle/
+    tensor/manipulation.py — unverified)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        n1, n2 = a.shape[d1], a.shape[d2]
+        if offset >= 0:
+            m = min(n1, n2 - offset)
+            rows = jnp.arange(m)
+            cols = rows + offset
+        else:
+            m = min(n1 + offset, n2)
+            rows = jnp.arange(m) - offset
+            cols = jnp.arange(m)
+        # move (d1, d2) to the back, scatter the diagonal, move back
+        rest = [i for i in range(nd) if i not in (d1, d2)]
+        perm = rest + [d1, d2]
+        at = jnp.transpose(a, perm)
+        at = at.at[..., rows, cols].set(b)  # y's last axis = the diagonal
+        inv = [perm.index(i) for i in range(nd)]
+        return jnp.transpose(at, inv)
+
+    return apply(f, x, y, name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from .indexing import inplace_rebind
+    return inplace_rebind(
+        x, lambda s: fill_diagonal_tensor(s, ensure_tensor(y),
+                                          offset=offset, dim1=dim1,
+                                          dim2=dim2))
+
+
+__all__ += ["flatten_", "lerp_", "erfinv_", "index_add_",
+            "fill_diagonal_tensor", "fill_diagonal_tensor_"]

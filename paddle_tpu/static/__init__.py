@@ -226,7 +226,7 @@ def normalize_program(program, feed_vars, fetch_vars, **kwargs):
     return program.clone(for_test=True)
 
 
-from .program import gradients  # noqa: E402,F401
+from .program import gradients, py_func  # noqa: E402,F401
 
 __all__ += ["cpu_places", "cuda_places", "save", "load",
-            "normalize_program", "gradients"]
+            "normalize_program", "gradients", "py_func"]

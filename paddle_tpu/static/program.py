@@ -492,3 +492,91 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
         _grads_fn, in_keys, tuple(id(g) for g in grad_tensors),
         "gradients", kind="backward"))
     return grad_tensors
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """paddle.static.py_func: embed a host Python callable as an op of
+    the static program (reference python/paddle/static/nn/common.py —
+    unverified). TPU-native realization: the record's fn wraps `func`
+    in `jax.pure_callback` (XLA host callback), so the compiled replay
+    calls back into Python with concrete arrays; `backward_func` (if
+    given) becomes the custom VJP, also as a host callback. `out` is
+    the pre-created placeholder Tensor(s) fixing shape/dtype — the
+    reference contract.
+
+    The callable must be PURE per XLA semantics (it may run 0+ times,
+    and never under dead-code paths)."""
+    prog = default_main_program()
+    if prog is None:
+        raise RuntimeError("py_func requires an active program_guard")
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    single = not isinstance(out, (list, tuple))
+    out_specs = [jax.ShapeDtypeStruct(tuple(t._data.shape),
+                                      t._data.dtype) for t in outs]
+
+    def host_fwd(*arrays):
+        import numpy as _np
+        res = func(*[Tensor(jnp.asarray(a)) for a in arrays])
+        rs = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(_np.asarray(r._data if isinstance(r, Tensor) else r,
+                                 dtype=s.dtype)
+                     for r, s in zip(rs, out_specs))
+
+    if backward_func is None:
+        def fn(*arrays):
+            r = jax.pure_callback(host_fwd, tuple(out_specs), *arrays)
+            return tuple(r)
+    else:
+        # reference contract: backward_func receives the forward INPUTS,
+        # then the forward OUTPUTS, then the output grads — minus any
+        # variable listed in skip_vars_in_backward_input (which may name
+        # inputs OR outputs, e.g. tanh's backward wants (y, dy) with x
+        # skipped) — and returns grads for the inputs x, in order.
+        skip = {id(s) for s in (skip_vars_in_backward_input or ())}
+        keep_x = [i for i, t in enumerate(xs) if id(t) not in skip]
+        keep_o = [j for j, t in enumerate(outs) if id(t) not in skip]
+
+        @jax.custom_vjp
+        def core(*arrays):
+            return tuple(jax.pure_callback(host_fwd, tuple(out_specs),
+                                           *arrays))
+
+        def core_fwd(*arrays):
+            res = core(*arrays)
+            return res, (arrays, res)
+
+        def core_bwd(saved, cts):
+            arrays, fwd_outs = saved
+            in_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in arrays]
+            n_x, n_o = len(keep_x), len(keep_o)
+
+            def host_bwd(*packed):
+                import numpy as _np
+                vals = [Tensor(jnp.asarray(a))
+                        for a in packed[:n_x + n_o]]
+                gouts = [Tensor(jnp.asarray(g))
+                         for g in packed[n_x + n_o:]]
+                gin = backward_func(*vals, *gouts)
+                gs = gin if isinstance(gin, (list, tuple)) else [gin]
+                return tuple(
+                    _np.zeros(s.shape, s.dtype) if g is None
+                    else _np.asarray(g._data if isinstance(g, Tensor)
+                                     else g, dtype=s.dtype)
+                    for g, s in zip(gs, in_specs))
+
+            picked = ([arrays[i] for i in keep_x]
+                      + [fwd_outs[j] for j in keep_o])
+            gs = jax.pure_callback(host_bwd, tuple(in_specs),
+                                   *picked, *cts)
+            return tuple(gs)
+
+        core.defvjp(core_fwd, core_bwd)
+
+        def fn(*arrays):
+            return core(*arrays)
+
+    prog.record(fn, xs, outs, name="py_func")
+    return outs[0] if single else outs

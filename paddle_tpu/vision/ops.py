@@ -642,3 +642,280 @@ def decode_jpeg(x, mode="unchanged", name=None):
 
 __all__ += ["prior_box", "matrix_nms", "psroi_pool", "read_file",
             "decode_jpeg"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference paddle.vision.ops.yolo_loss /
+    phi yolov3_loss kernel — upstream unverified; formulas follow the
+    YOLOv3 paper + the reference kernel structure):
+
+    - x: [N, A*(5+class_num), H, W] raw head output (A = len(anchor_mask));
+    - gt_box [N, B, 4] normalized (cx, cy, w, h), gt_label [N, B],
+      gt_score [N, B] (mixup weight, default 1);
+    - per-gt responsibility: best wh-IoU over ALL anchors; the gt is
+      assigned only if that anchor belongs to this head's anchor_mask,
+      at cell (floor(cx*W), floor(cy*H));
+    - sigmoid-CE for x/y/objectness/class, L1 for w/h, box weight
+      (2 − w·h)·score; negatives whose best IoU with any gt exceeds
+      `ignore_thresh` are ignored; label smoothing moves targets to
+      (1−δ, δ), δ = min(1/class_num, 1/40).
+
+    TPU-native: everything is dense [N, A, H, W] target maps built by a
+    lax.fori_loop of per-gt scatters (deterministic last-writer, B is
+    small) + one fused elementwise loss — no dynamic shapes. Returns
+    the per-sample loss [N]."""
+    x = ensure_tensor(x)
+    gt_box, gt_label = ensure_tensor(gt_box), ensure_tensor(gt_label)
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(ensure_tensor(gt_score))
+    anchors = [float(a) for a in anchors]
+    amask = [int(a) for a in anchor_mask]
+    A = len(amask)
+    n_anchors = len(anchors) // 2
+    N, C, H, W = x.shape
+    if C != A * (5 + class_num):
+        raise ValueError(f"x channels {C} != len(anchor_mask)*(5+cls) "
+                         f"= {A * (5 + class_num)}")
+    B = gt_box.shape[1]
+    in_w, in_h = W * downsample_ratio, H * downsample_ratio
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32) / in_w   # normalized
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32) / in_h
+    aw = aw_all[jnp.asarray(amask)]
+    ah = ah_all[jnp.asarray(amask)]
+    delta = min(1.0 / class_num, 1.0 / 40.0) if use_label_smooth else 0.0
+    sx = float(scale_x_y)
+
+    def bce(logit, label):
+        # sigmoid cross entropy with logits, stable form
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def f(xa, gb, gl, *rest):
+        gs = rest[0] if rest else jnp.ones((N, B), jnp.float32)
+        xa = xa.reshape(N, A, 5 + class_num, H, W).astype(jnp.float32)
+        tx, ty, tw, th = xa[:, :, 0], xa[:, :, 1], xa[:, :, 2], xa[:, :, 3]
+        tobj = xa[:, :, 4]
+        tcls = xa[:, :, 5:]                       # [N, A, cls, H, W]
+        gb = gb.astype(jnp.float32)
+        gs = gs.astype(jnp.float32)
+        valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)          # [N, B]
+
+        # decoded pred boxes (normalized) for the ignore mask
+        ix = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        iy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        px = (ix + sx * jax.nn.sigmoid(tx) - 0.5 * (sx - 1.0)) / W
+        py = (iy + sx * jax.nn.sigmoid(ty) - 0.5 * (sx - 1.0)) / H
+        pw = aw[None, :, None, None] * jnp.exp(tw)
+        phh = ah[None, :, None, None] * jnp.exp(th)
+        # IoU pred [N,A,H,W] x gt [N,B] -> max over B
+        px1, py1 = px - pw / 2, py - phh / 2
+        px2, py2 = px + pw / 2, py + phh / 2
+        gx1 = (gb[..., 0] - gb[..., 2] / 2)[:, None, None, None, :]
+        gy1 = (gb[..., 1] - gb[..., 3] / 2)[:, None, None, None, :]
+        gx2 = (gb[..., 0] + gb[..., 2] / 2)[:, None, None, None, :]
+        gy2 = (gb[..., 1] + gb[..., 3] / 2)[:, None, None, None, :]
+        iw = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                         - jnp.maximum(px1[..., None], gx1), 0.0)
+        ih = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                         - jnp.maximum(py1[..., None], gy1), 0.0)
+        inter = iw * ih
+        union = (pw * phh)[..., None] + \
+            (gb[..., 2] * gb[..., 3])[:, None, None, None, :] - inter
+        iou = jnp.where(valid[:, None, None, None, :],
+                        inter / jnp.maximum(union, 1e-10), 0.0)
+        ignore = jnp.max(iou, axis=-1) > ignore_thresh       # [N,A,H,W]
+
+        # per-gt responsible anchor over ALL anchors (wh IoU)
+        ginter = jnp.minimum(gb[..., 2:3], aw_all[None, None, :]) * \
+            jnp.minimum(gb[..., 3:4], ah_all[None, None, :])
+        gunion = gb[..., 2:3] * gb[..., 3:4] + \
+            (aw_all * ah_all)[None, None, :] - ginter
+        best = jnp.argmax(ginter / jnp.maximum(gunion, 1e-10), -1)
+        slot_of = jnp.full((n_anchors,), -1, jnp.int32)
+        for s, a in enumerate(amask):
+            slot_of = slot_of.at[a].set(s)
+        slot = slot_of[best]                                  # [N, B]
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        assigned = valid & (slot >= 0)
+
+        # dense target maps via deterministic per-gt scatter
+        zero = jnp.zeros((N, A, H, W), jnp.float32)
+        maps0 = {"pos": zero, "tx": zero, "ty": zero, "tw": zero,
+                 "th": zero, "wt": zero, "score": zero,
+                 "label": jnp.zeros((N, A, H, W), jnp.int32)}
+        nidx = jnp.arange(N)
+
+        def body(b, maps):
+            ok = assigned[:, b]                                # [N]
+            s = jnp.where(ok, slot[:, b], 0)
+            jj = jnp.where(ok, gj[:, b], 0)
+            ii = jnp.where(ok, gi[:, b], 0)
+
+            def put(m, v):
+                cur = m[nidx, s, jj, ii]
+                new = jnp.where(ok, v, cur)
+                return m.at[nidx, s, jj, ii].set(
+                    new.astype(m.dtype))
+
+            txv = gb[:, b, 0] * W - ii.astype(jnp.float32)
+            tyv = gb[:, b, 1] * H - jj.astype(jnp.float32)
+            twv = jnp.log(jnp.maximum(
+                gb[:, b, 2] / jnp.maximum(aw[s], 1e-10), 1e-10))
+            thv = jnp.log(jnp.maximum(
+                gb[:, b, 3] / jnp.maximum(ah[s], 1e-10), 1e-10))
+            wtv = (2.0 - gb[:, b, 2] * gb[:, b, 3]) * gs[:, b]
+            maps = dict(maps)
+            maps["pos"] = put(maps["pos"], jnp.ones((N,)))
+            maps["tx"] = put(maps["tx"], txv)
+            maps["ty"] = put(maps["ty"], tyv)
+            maps["tw"] = put(maps["tw"], twv)
+            maps["th"] = put(maps["th"], thv)
+            maps["wt"] = put(maps["wt"], wtv)
+            maps["score"] = put(maps["score"], gs[:, b])
+            maps["label"] = put(maps["label"], gl[:, b].astype(jnp.int32))
+            return maps
+
+        maps = jax.lax.fori_loop(0, B, body, maps0)
+        pos = maps["pos"]
+
+        loss_xy = maps["wt"] * (bce(tx, maps["tx"]) + bce(ty, maps["ty"]))
+        loss_wh = maps["wt"] * (jnp.abs(tw - maps["tw"])
+                                + jnp.abs(th - maps["th"]))
+        obj_pos = maps["score"] * bce(tobj, jnp.ones_like(tobj))
+        obj_neg = bce(tobj, jnp.zeros_like(tobj))
+        loss_obj = jnp.where(pos > 0, obj_pos,
+                             jnp.where(ignore, 0.0, obj_neg))
+        onehot = jax.nn.one_hot(maps["label"], class_num,
+                                axis=2)                     # [N,A,cls,H,W]
+        cls_target = onehot * (1.0 - delta) + (1 - onehot) * delta
+        loss_cls = maps["score"][:, :, None] * \
+            bce(tcls, cls_target) * pos[:, :, None]
+        per_sample = (jnp.sum((loss_xy + loss_wh) * pos, axis=(1, 2, 3))
+                      + jnp.sum(loss_obj, axis=(1, 2, 3))
+                      + jnp.sum(loss_cls, axis=(1, 2, 3, 4)))
+        return per_sample
+
+    return _apply(f, *args, name="yolo_loss")
+
+
+__all__ += ["yolo_loss"]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference paddle.vision.ops.
+    distribute_fpn_proposals — unverified): level = floor(log2(
+    sqrt(area)/refer_scale + eps)) + refer_level, clamped to
+    [min_level, max_level]. Returns (multi_rois list low→high level,
+    restore_ind [R, 1], rois_num_per_level list or None).
+
+    EAGER-ONLY: per-level counts are data-dependent (ragged output), so
+    this is a host op like the reference's CPU kernel; under tracing it
+    raises (use level masks for a compiled pipeline)."""
+    fpn_rois = ensure_tensor(fpn_rois)
+    if isinstance(fpn_rois._data, jax.core.Tracer):
+        raise RuntimeError(
+            "distribute_fpn_proposals is eager-only (ragged outputs); "
+            "compute level masks instead inside jit")
+    rois = np.asarray(fpn_rois._data, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = np.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+    h = np.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / float(refer_scale) + 1e-8)) \
+        + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, order = [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        order.append(idx)
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.shape[0])
+    restore_ind = Tensor(jnp.asarray(restore[:, None].astype(np.int32)))
+    if rois_num is not None:
+        rn = np.asarray(ensure_tensor(rois_num)._data)
+        img_of = np.repeat(np.arange(rn.shape[0]), rn)
+        per_level = [
+            Tensor(jnp.asarray(np.bincount(
+                img_of[lvl == L], minlength=rn.shape[0]).astype(np.int32)))
+            for L in range(min_level, max_level + 1)]
+        return multi_rois, restore_ind, per_level
+    return multi_rois, restore_ind, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference paddle.vision.ops.
+    generate_proposals — unverified): decode anchor deltas → clip to the
+    image → drop boxes smaller than min_size → top pre_nms_top_n by
+    score → greedy NMS → top post_nms_top_n. EAGER-ONLY host op (ragged
+    output), composed from box_coder-style decode + this module's nms.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2]
+    (h, w); anchors [H, W, A, 4] or [H*W*A, 4]; variances same shape.
+    Returns (rpn_rois [R, 4], rpn_roi_probs [R, 1][, rois_num])."""
+    scores, bbox_deltas = ensure_tensor(scores), ensure_tensor(bbox_deltas)
+    if isinstance(scores._data, jax.core.Tracer):
+        raise RuntimeError("generate_proposals is eager-only (ragged "
+                           "outputs)")
+    sc = np.asarray(scores._data, np.float32)
+    bd = np.asarray(bbox_deltas._data, np.float32)
+    isz = np.asarray(ensure_tensor(img_size)._data, np.float32)
+    anc = np.asarray(ensure_tensor(anchors)._data, np.float32).reshape(-1, 4)
+    var = np.asarray(ensure_tensor(variances)._data,
+                     np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, s.shape[0])
+        top = np.argsort(-s)[:k]
+        s_k, d_k, a_k, v_k = s[top], d[top], anc[top], var[top]
+        # decode (box_coder decode_center_size semantics)
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw / 2
+        acy = a_k[:, 1] + ah / 2
+        cx = v_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = v_k[:, 1] * d_k[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(v_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        ih, iw = isz[n, 0], isz[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s_k = boxes[keep], s_k[keep]
+        if boxes.shape[0]:
+            kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                                  iou_threshold=nms_thresh,
+                                  scores=Tensor(jnp.asarray(s_k)),
+                                  top_k=post_nms_top_n).numpy())
+            boxes, s_k = boxes[kept], s_k[kept]
+        all_rois.append(boxes)
+        all_probs.append(s_k[:, None])
+        nums.append(boxes.shape[0])
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              if all_rois else np.zeros((0, 4))))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0)
+                               if all_probs else np.zeros((0, 1))))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
+
+
+__all__ += ["distribute_fpn_proposals", "generate_proposals"]

@@ -240,3 +240,237 @@ class TestVisionDetectionOps:
             pickle.dump([("m", np.zeros(2, np.float32))] * 3, f)
         with pytest.raises(ValueError):
             static.load(prog, pfx)
+
+
+class TestInplaceMethodFills:
+    def test_flatten_lerp_erfinv(self):
+        torch = pytest.importorskip("torch")
+        a = P.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        r = a.flatten_()
+        assert a.shape == [6] and r is a
+        x = np.float32([1.0, 2.0])
+        y = np.float32([3.0, 6.0])
+        xt = P.to_tensor(x.copy())
+        xt.lerp_(P.to_tensor(y), 0.25)
+        assert np.allclose(xt.numpy(), x + 0.25 * (y - x))
+        v = np.float32([-0.5, 0.0, 0.7])
+        vt = P.to_tensor(v.copy())
+        vt.erfinv_()
+        assert np.allclose(vt.numpy(),
+                           torch.erfinv(torch.tensor(v)).numpy(),
+                           atol=1e-6)
+
+    def test_index_add_inplace_torch_oracle(self):
+        torch = pytest.importorskip("torch")
+        bt = P.to_tensor(np.zeros((3, 2), np.float32))
+        bt.index_add_(P.to_tensor(np.asarray([0, 2], np.int64)), 0,
+                      P.to_tensor(np.float32([[1, 1], [2, 2]])))
+        tb = torch.zeros(3, 2)
+        tb.index_add_(0, torch.tensor([0, 2]),
+                      torch.tensor([[1., 1], [2, 2]]))
+        assert np.allclose(bt.numpy(), tb.numpy())
+
+    def test_fill_diagonal_tensor(self):
+        m = np.zeros((3, 4), np.float32)
+        d = np.float32([9, 8, 7])
+        got = P.to_tensor(m.copy()).fill_diagonal_tensor(P.to_tensor(d))
+        assert np.allclose(got.numpy()[np.arange(3), np.arange(3)], d)
+        assert got.numpy().sum() == d.sum()
+        g2 = P.to_tensor(m.copy())
+        g2.fill_diagonal_tensor_(P.to_tensor(np.float32([5, 6, 4])),
+                                 offset=1)
+        assert np.allclose(g2.numpy()[np.arange(3), np.arange(3) + 1],
+                           [5, 6, 4])
+
+
+class TestPyFunc:
+    def test_forward_and_custom_backward(self):
+        """Reference contract: backward_func receives (inputs, outputs,
+        out-grads) in order — here (x, y, dy)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            out_ph = P.to_tensor(np.zeros(3, np.float32))
+
+            def host_square(t):
+                return P.to_tensor(t.numpy() ** 2)
+
+            def host_square_bwd(t, y_, gout):
+                return P.to_tensor(2 * t.numpy() * gout.numpy())
+
+            y = static.py_func(host_square, x, out_ph,
+                               backward_func=host_square_bwd)
+            loss = y.sum()
+            (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        xv = np.float32([1, -2, 3])
+        yv, gv = exe.run(prog, feed={"x": xv}, fetch_list=[y, gx])
+        assert np.allclose(yv, xv ** 2)
+        assert np.allclose(gv, 2 * xv)
+
+    def test_tanh_backward_from_output_with_skip(self):
+        """The canonical reference example: tanh's backward uses the
+        OUTPUT only — backward_func(y, dy) with x skipped."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            out_ph = P.to_tensor(np.zeros(4, np.float32))
+
+            def host_tanh(t):
+                return P.to_tensor(np.tanh(t.numpy()))
+
+            def host_tanh_bwd(y_, dy):
+                return P.to_tensor(dy.numpy() * (1 - y_.numpy() ** 2))
+
+            y = static.py_func(host_tanh, x, out_ph,
+                               backward_func=host_tanh_bwd,
+                               skip_vars_in_backward_input=[x])
+            loss = y.sum()
+            (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        xv = np.float32([0.5, -1.0, 2.0, 0.0])
+        yv, gv = exe.run(prog, feed={"x": xv}, fetch_list=[y, gx])
+        assert np.allclose(yv, np.tanh(xv), atol=1e-6)
+        assert np.allclose(gv, 1 - np.tanh(xv) ** 2, atol=1e-6)
+
+    def test_multi_output_forward_only(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            ph = [P.to_tensor(np.zeros(4, np.float32)),
+                  P.to_tensor(np.zeros(4, np.float32))]
+            a, b = static.py_func(
+                lambda t: (P.to_tensor(t.numpy() + 1),
+                           P.to_tensor(t.numpy() * 3)), x, ph)
+        exe = static.Executor()
+        xv = np.float32([0, 1, 2, 3])
+        av, bv = exe.run(prog, feed={"x": xv}, fetch_list=[a, b])
+        assert np.allclose(av, xv + 1) and np.allclose(bv, xv * 3)
+
+
+class TestYoloLoss:
+    ANCHORS = [10, 14, 23, 27, 37, 58]
+    MASK = [0, 1]
+
+    def test_analytic_single_positive(self):
+        import math
+
+        from paddle_tpu.vision.ops import yolo_loss
+        N, H, W, cls, ds = 1, 4, 4, 3, 8
+        in_w = W * ds
+        x0 = np.zeros((N, 2 * (5 + cls), H, W), np.float32)
+        gt = np.zeros((N, 1, 4), np.float32)
+        gt[0, 0] = [2.5 / W, 1.5 / H, 10 / in_w, 14 / in_w]  # anchor 0 wh
+        lb = np.asarray([[1]], np.int32)
+        got = float(yolo_loss(P.to_tensor(x0), P.to_tensor(gt),
+                              P.to_tensor(lb), self.ANCHORS, self.MASK,
+                              cls, 0.7, ds,
+                              use_label_smooth=False).numpy()[0])
+        # zero logits: every BCE term is log 2; wh L1 is 0 (exact anchor)
+        wt = 2.0 - (10 / in_w) * (14 / in_w)
+        expect = (wt * 2 * math.log(2)            # x + y
+                  + math.log(2)                   # obj positive
+                  + (2 * H * W - 1) * math.log(2)  # negatives
+                  + cls * math.log(2))            # class row
+        assert abs(got - expect) < 1e-3
+
+    def test_ignore_thresh_and_score_weighting(self):
+        from paddle_tpu.vision.ops import yolo_loss
+        N, H, W, cls, ds = 1, 4, 4, 2, 8
+        in_w = W * ds
+        rng = np.random.default_rng(1)
+        x = (rng.standard_normal((N, 2 * (5 + cls), H, W)) * 0.1
+             ).astype(np.float32)
+        gt = np.zeros((N, 1, 4), np.float32)
+        gt[0, 0] = [2.5 / W, 1.5 / H, 10 / in_w, 14 / in_w]
+        lb = np.asarray([[0]], np.int32)
+        base = float(yolo_loss(P.to_tensor(x), P.to_tensor(gt),
+                               P.to_tensor(lb), self.ANCHORS, self.MASK,
+                               cls, 0.7, ds).numpy()[0])
+        # ignore_thresh=0: every negative with ANY overlap is ignored ->
+        # loss strictly decreases
+        loose = float(yolo_loss(P.to_tensor(x), P.to_tensor(gt),
+                                P.to_tensor(lb), self.ANCHORS, self.MASK,
+                                cls, 0.0, ds).numpy()[0])
+        assert loose < base
+        # gt_score scales the positive terms
+        half = float(yolo_loss(
+            P.to_tensor(x), P.to_tensor(gt), P.to_tensor(lb),
+            self.ANCHORS, self.MASK, cls, 0.7, ds,
+            gt_score=P.to_tensor(np.asarray([[0.5]], np.float32))
+        ).numpy()[0])
+        assert half < base
+
+    def test_grads_and_jit(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.vision.ops import yolo_loss
+        N, H, W, cls, ds = 2, 4, 4, 2, 8
+        rng = np.random.default_rng(2)
+        x = (rng.standard_normal((N, 2 * (5 + cls), H, W)) * 0.1
+             ).astype(np.float32)
+        gt = np.zeros((N, 2, 4), np.float32)
+        gt[:, 0] = [0.4, 0.4, 0.3, 0.35]
+        lb = np.zeros((N, 2), np.int32)
+        xt = P.to_tensor(x)
+        xt.stop_gradient = False
+        loss = yolo_loss(xt, P.to_tensor(gt), P.to_tensor(lb),
+                         self.ANCHORS, self.MASK, cls, 0.7, ds)
+        loss.sum().backward()
+        g = xt.grad.numpy()
+        assert np.isfinite(g).all() and (g != 0).any()
+
+        fn = to_static(lambda a, b, c: yolo_loss(
+            a, b, c, self.ANCHORS, self.MASK, cls, 0.7, ds))
+        lv = fn(P.to_tensor(x), P.to_tensor(gt), P.to_tensor(lb))
+        assert np.allclose(lv.numpy(), loss.numpy(), atol=1e-5)
+
+
+class TestRaggedDetectionOps:
+    def test_distribute_fpn_proposals(self):
+        from paddle_tpu.vision.ops import distribute_fpn_proposals
+        # areas chosen to land on levels 2, 3, 4 (refer 224 @ level 4)
+        rois = np.asarray([
+            [0, 0, 56, 56],     # scale 56  -> level 2
+            [0, 0, 112, 112],   # scale 112 -> level 3
+            [0, 0, 224, 224],   # scale 224 -> level 4
+            [0, 0, 60, 50],     # ~55 -> level 2
+        ], np.float32)
+        multi, restore, per_lvl = distribute_fpn_proposals(
+            P.to_tensor(rois), 2, 4, 4, 224,
+            rois_num=P.to_tensor(np.asarray([4], np.int32)))
+        sizes = [m.shape[0] for m in multi]
+        assert sizes == [2, 1, 1]
+        # restore index maps the concatenated-by-level order back
+        cat = np.concatenate([m.numpy() for m in multi], 0)
+        ri = restore.numpy().ravel()
+        assert np.allclose(cat[ri], rois)
+        assert [int(np.asarray(p.numpy())[0]) for p in per_lvl] == \
+            [2, 1, 1]
+
+    def test_generate_proposals(self):
+        from paddle_tpu.vision.ops import generate_proposals
+        H = W = 4
+        A = 2
+        rng = np.random.default_rng(0)
+        scores = rng.random((1, A, H, W)).astype(np.float32)
+        deltas = np.zeros((1, 4 * A, H, W), np.float32)  # identity decode
+        # anchors: 16x16 boxes at each cell
+        ys, xs = np.mgrid[0:H, 0:W]
+        anc = np.stack([xs * 8, ys * 8, xs * 8 + 16, ys * 8 + 16],
+                       -1).astype(np.float32)
+        anc = np.repeat(anc[:, :, None, :], A, 2)
+        var = np.ones_like(anc)
+        rois, probs, num = generate_proposals(
+            P.to_tensor(scores), P.to_tensor(deltas),
+            P.to_tensor(np.asarray([[64.0, 64.0]], np.float32)),
+            P.to_tensor(anc), P.to_tensor(var),
+            pre_nms_top_n=32, post_nms_top_n=8, nms_thresh=0.5,
+            min_size=1.0, return_rois_num=True)
+        n = int(np.asarray(num.numpy())[0])
+        assert 1 <= n <= 8
+        assert rois.shape[0] == n and probs.shape == [n, 1]
+        r = rois.numpy()
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+        # probs sorted descending (NMS keeps by score rank)
+        p = probs.numpy().ravel()
+        assert (np.diff(p) <= 1e-6).all()
