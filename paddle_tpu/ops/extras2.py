@@ -154,8 +154,7 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
         else:
             c = jnp.arange(min(m, n + offset))
             r = c - offset
-        v2 = jnp.moveaxis(v, -1, -1)  # diag values on the last dim
-        a2 = a2.at[..., r, c].set(v2.astype(a.dtype))
+        a2 = a2.at[..., r, c].set(v.astype(a.dtype))  # diag on last dim
         return jnp.moveaxis(a2, (-2, -1), (axis1, axis2))
     return apply(f, x, y, name="diagonal_scatter")
 
@@ -602,30 +601,10 @@ def index_add_(x, index, axis, value, name=None):
 def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
     """paddle.Tensor.fill_diagonal_tensor: write tensor `y` along the
     (dim1, dim2) diagonal of `x` (out-of-place; reference python/paddle/
-    tensor/manipulation.py — unverified)."""
-    x, y = ensure_tensor(x), ensure_tensor(y)
-
-    def f(a, b):
-        nd = a.ndim
-        d1, d2 = dim1 % nd, dim2 % nd
-        n1, n2 = a.shape[d1], a.shape[d2]
-        if offset >= 0:
-            m = min(n1, n2 - offset)
-            rows = jnp.arange(m)
-            cols = rows + offset
-        else:
-            m = min(n1 + offset, n2)
-            rows = jnp.arange(m) - offset
-            cols = jnp.arange(m)
-        # move (d1, d2) to the back, scatter the diagonal, move back
-        rest = [i for i in range(nd) if i not in (d1, d2)]
-        perm = rest + [d1, d2]
-        at = jnp.transpose(a, perm)
-        at = at.at[..., rows, cols].set(b)  # y's last axis = the diagonal
-        inv = [perm.index(i) for i in range(nd)]
-        return jnp.transpose(at, inv)
-
-    return apply(f, x, y, name="fill_diagonal_tensor")
+    tensor/manipulation.py — unverified). Same semantics as
+    diagonal_scatter above — delegated."""
+    return diagonal_scatter(x, y, offset=offset, axis1=dim1, axis2=dim2,
+                            name=name)
 
 
 def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):

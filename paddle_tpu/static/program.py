@@ -526,7 +526,12 @@ def py_func(func, x, out, backward_func=None,
 
     if backward_func is None:
         def fn(*arrays):
-            r = jax.pure_callback(host_fwd, tuple(out_specs), *arrays)
+            # no backward_func: gradient stops here (zero), like the
+            # cpp_extension op default — a bare pure_callback would
+            # raise an opaque JAX error from inside the replay instead
+            r = jax.pure_callback(host_fwd, tuple(out_specs),
+                                  *[jax.lax.stop_gradient(a)
+                                    for a in arrays])
             return tuple(r)
     else:
         # reference contract: backward_func receives the forward INPUTS,
@@ -549,8 +554,13 @@ def py_func(func, x, out, backward_func=None,
 
         def core_bwd(saved, cts):
             arrays, fwd_outs = saved
-            in_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for a in arrays]
+            # integer primals take float0 cotangents (custom_vjp
+            # contract); only float inputs get host-computed grads
+            float_ix = [i for i, a in enumerate(arrays)
+                        if jnp.issubdtype(a.dtype, jnp.inexact)]
+            in_specs = [jax.ShapeDtypeStruct(arrays[i].shape,
+                                             arrays[i].dtype)
+                        for i in float_ix]
             n_x, n_o = len(keep_x), len(keep_o)
 
             def host_bwd(*packed):
@@ -561,6 +571,8 @@ def py_func(func, x, out, backward_func=None,
                          for g in packed[n_x + n_o:]]
                 gin = backward_func(*vals, *gouts)
                 gs = gin if isinstance(gin, (list, tuple)) else [gin]
+                if len(gs) == len(arrays) and len(gs) != len(float_ix):
+                    gs = [gs[i] for i in float_ix]  # grads for all x
                 return tuple(
                     _np.zeros(s.shape, s.dtype) if g is None
                     else _np.asarray(g._data if isinstance(g, Tensor)
@@ -569,9 +581,18 @@ def py_func(func, x, out, backward_func=None,
 
             picked = ([arrays[i] for i in keep_x]
                       + [fwd_outs[j] for j in keep_o])
-            gs = jax.pure_callback(host_bwd, tuple(in_specs),
-                                   *picked, *cts)
-            return tuple(gs)
+            fgs = jax.pure_callback(host_bwd, tuple(in_specs),
+                                    *picked, *cts)
+            fgs = list(fgs)
+            out_gs = []
+            for i, a in enumerate(arrays):
+                if i in float_ix:
+                    out_gs.append(fgs.pop(0))
+                else:
+                    import numpy as _np
+                    out_gs.append(_np.zeros(a.shape,
+                                            jax.dtypes.float0))
+            return tuple(out_gs)
 
         core.defvjp(core_fwd, core_bwd)
 

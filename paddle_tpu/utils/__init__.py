@@ -92,21 +92,7 @@ def run_check():
     return ok
 
 
-class _CppExtensionStub:
-    """Reference paddle.utils.cpp_extension builds pybind11 custom ops;
-    this image has no pybind11 — native extensions here use the ctypes
-    C-ABI pattern (see paddle_tpu/native/). Any attribute access
-    (cpp_extension.load / .setup / .CppExtension) fails loudly with
-    that guidance."""
-
-    def __getattr__(self, name):
-        raise NotImplementedError(
-            f"cpp_extension.{name} is not available (no pybind11 in "
-            "this environment); write a C ABI + ctypes binding instead "
-            "— see paddle_tpu/native/ for the pattern.")
-
-
-cpp_extension = _CppExtensionStub()
+from . import cpp_extension  # noqa: E402,F401  (real since round 6)
 
 
 from . import dlpack  # noqa: E402,F401

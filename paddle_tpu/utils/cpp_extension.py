@@ -1,0 +1,206 @@
+"""Custom C++ host ops: the TPU-native realization of
+paddle.utils.cpp_extension (reference python/paddle/utils/cpp_extension/
+— upstream unverified, mount empty).
+
+The reference builds pybind11/CUDA custom kernels via PD_BUILD_OP. On
+this stack the split is:
+
+- DEVICE custom kernels are Pallas (`paddle_tpu.ops.pallas`) — a C++
+  CUDA kernel has no TPU meaning; Mosaic is the custom-kernel path.
+- HOST custom ops (pre/post-processing, tokenizers, lookups, C++ speed
+  on the CPU side of the program) are what this module builds: your
+  C++ is g++-compiled into a shared library at a documented C ABI,
+  dlopened via ctypes (no pybind11 in this image), and each exported
+  function is wrapped as a framework op that works EAGERLY and under
+  `jit`/`to_static` (through `jax.pure_callback`) with an optional
+  Python `grad_fn` for differentiability.
+
+The C ABI each op must export (f32 data, any rank):
+
+    extern "C" void NAME(const float** inputs,   // n_inputs data ptrs
+                         const int64_t* sizes,   // n_inputs elem counts
+                         int32_t n_inputs,
+                         float* output,          // pre-allocated
+                         int64_t out_size);
+
+Example:
+
+    // my_ops.cc
+    #include <cstdint>
+    extern "C" void scale_add(const float** in, const int64_t* sz,
+                              int32_t n, float* out, int64_t osz) {
+        for (int64_t i = 0; i < osz; ++i)
+            out[i] = 2.0f * in[0][i] + in[1][i];
+    }
+
+    ext = cpp_extension.load(name="my_ext", sources=["my_ops.cc"],
+                             functions=["scale_add"])
+    z = ext.scale_add(x, y)                   # shape of x by default
+    z = ext.scale_add(x, y, out_shape=(4,))   # explicit output shape
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+__all__ = ["load", "get_build_directory", "CppExtension", "setup"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR") or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class _LoadedExtension:
+    def __init__(self, name, lib_path, functions):
+        self._name = name
+        self._lib_path = lib_path
+        self._lib = ctypes.CDLL(lib_path)
+        self._ops = {}
+        for fname in functions:
+            fn = getattr(self._lib, fname)  # raises if not exported
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+            self._ops[fname] = fn
+            setattr(self, fname, self._make_op(fname))
+
+    def _make_op(self, fname):
+        cfn = self._ops[fname]
+
+        def host_call(out_shape, out_dtype, *arrays):
+            import numpy as np
+            ins = [np.ascontiguousarray(np.asarray(a, dtype=np.float32))
+                   for a in arrays]
+            out = np.zeros(out_shape, np.float32)
+            in_ptrs = (ctypes.POINTER(ctypes.c_float) * len(ins))(
+                *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                  for a in ins])
+            sizes = (ctypes.c_int64 * len(ins))(*[a.size for a in ins])
+            cfn(in_ptrs, sizes, len(ins),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.size)
+            return out.astype(out_dtype)
+
+        def op(*tensors, out_shape=None, grad_fn=None, name=None):
+            import numpy as _np
+
+            import jax
+            import jax.numpy as jnp
+
+            from ..core.autograd import apply
+            from ..ops._base import ensure_tensor
+            ts = [ensure_tensor(t) for t in tensors]
+            shape = tuple(out_shape) if out_shape is not None \
+                else tuple(ts[0]._data.shape)
+            dtype = ts[0]._data.dtype
+            spec = jax.ShapeDtypeStruct(shape, dtype)
+
+            def call(*arrays):
+                return jax.pure_callback(
+                    lambda *a: host_call(shape, dtype, *a), spec,
+                    *arrays)
+
+            if grad_fn is None:
+                # gradient stops at the host op (zero)
+                def f(*arrays):
+                    return call(*[jax.lax.stop_gradient(a)
+                                  for a in arrays])
+            else:
+                @jax.custom_vjp
+                def f(*arrays):
+                    return call(*arrays)
+
+                def fwd(*arrays):
+                    return f(*arrays), arrays
+
+                def bwd(arrays, ct):
+                    gs = grad_fn(arrays, ct)
+                    gs = gs if isinstance(gs, (list, tuple)) else (gs,)
+                    out = []
+                    for g, a in zip(gs, arrays):
+                        if not jnp.issubdtype(a.dtype, jnp.inexact):
+                            # integer primal -> float0 cotangent
+                            out.append(_np.zeros(a.shape,
+                                                 jax.dtypes.float0))
+                        elif g is None:
+                            out.append(jnp.zeros(a.shape, a.dtype))
+                        else:
+                            out.append(jnp.asarray(g, a.dtype))
+                    return tuple(out)
+
+                f.defvjp(fwd, bwd)
+            return apply(f, *ts, name=name or f"{self._name}.{fname}")
+
+        op.__name__ = fname
+        return op
+
+
+def load(name, sources, functions=None, extra_cxx_cflags=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile `sources` (C++ at the module-doc C ABI) into a cached
+    shared library and return an extension object whose attributes are
+    the wrapped ops. `functions` lists the exported symbol names
+    (required — there is no PD_BUILD_OP registry to introspect).
+    Rebuilds only when source content or flags change (content hash)."""
+    if not functions:
+        raise ValueError(
+            "cpp_extension.load needs functions=[...]: the exported C "
+            "symbol names (the C ABI replaces PD_BUILD_OP introspection)")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    flags = list(extra_cxx_cflags or [])
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as fh:
+            h.update(fh.read())
+    h.update(" ".join(flags).encode())
+    build = build_directory or get_build_directory()
+    lib_path = os.path.join(build, f"{name}_{h.hexdigest()[:16]}.so")
+    if not os.path.exists(lib_path):
+        # compile to a temp name + atomic rename: an interrupted or
+        # concurrent build must never leave a corrupt .so at the cache
+        # path (os.path.exists would trust it forever)
+        tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + flags + srcs + ["-o", tmp_path])
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            try:
+                os.remove(tmp_path)
+            except FileNotFoundError:
+                pass
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{r.stderr[-2000:]}")
+        os.replace(tmp_path, lib_path)
+    return _LoadedExtension(name, lib_path, functions)
+
+
+class CppExtension:
+    """setup()-style descriptor (reference API shape). `setup` builds
+    immediately via `load` — there is no setuptools install step for
+    the ctypes path."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = [sources] if isinstance(sources, str) \
+            else list(sources)
+        self.kwargs = kwargs
+
+
+def setup(name, ext_modules, functions=None, **kwargs):
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    out = []
+    for e in exts:
+        out.append(load(name=name, sources=e.sources,
+                        functions=functions or e.kwargs.get("functions"),
+                        **kwargs))
+    return out[0] if len(out) == 1 else out

@@ -474,3 +474,48 @@ class TestRaggedDetectionOps:
         # probs sorted descending (NMS keeps by score rank)
         p = probs.numpy().ravel()
         assert (np.diff(p) <= 1e-6).all()
+
+    def test_int_input_differentiable_float0(self):
+        """An integer input (e.g. indices) must take a float0 cotangent,
+        not break differentiation of the float inputs."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            idx = P.to_tensor(np.asarray([2, 0], np.int32))
+            ph = P.to_tensor(np.zeros(2, np.float32))
+
+            def host_gather(t, ii):
+                return P.to_tensor(t.numpy()[ii.numpy()])
+
+            def host_gather_bwd(t, ii, y_, dy):
+                g = np.zeros_like(t.numpy())
+                np.add.at(g, ii.numpy(), dy.numpy())
+                return P.to_tensor(g), None
+
+            y = static.py_func(host_gather, [x, idx], ph,
+                               backward_func=host_gather_bwd)
+            loss = (y * y).sum()
+            (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        xv = np.float32([1, 2, 3, 4])
+        yv, gv = exe.run(prog, feed={"x": xv}, fetch_list=[y, gx])
+        assert np.allclose(yv, [3, 1])
+        ref = np.zeros(4, np.float32)
+        ref[2], ref[0] = 2 * 3, 2 * 1
+        assert np.allclose(gv, ref)
+
+    def test_no_backward_gradient_stops_cleanly(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            h = x * 2.0
+            ph = P.to_tensor(np.zeros(3, np.float32))
+            y = static.py_func(
+                lambda t: P.to_tensor(t.numpy() + 1.0), h, ph)
+            loss = (y + x).sum()
+            (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        (gv,) = exe.run(prog, feed={"x": np.float32([1, 2, 3])},
+                        fetch_list=[gx])
+        # grad flows only through the direct +x path; py_func stops it
+        assert np.allclose(gv, [1, 1, 1])
