@@ -11,3 +11,44 @@ from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,
 __all__ = ["Callback", "CallbackList", "EarlyStopping", "LRScheduler",
            "ModelCheckpoint", "ProgBarLogger", "ReduceLROnPlateau",
            "VisualDL"]
+
+
+class WandbCallback(Callback):
+    """Reference paddle.callbacks.WandbCallback: logs metrics to Weights
+    & Biases. Requires the `wandb` package (not in this image) — the
+    constructor raises with that guidance, matching the reference's
+    import-time requirement."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires `wandb` (pip install wandb)"
+            ) from e
+        super().__init__()
+        self._settings = dict(project=project, entity=entity, name=name,
+                              dir=dir, mode=mode, job_type=job_type,
+                              **kwargs)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        import wandb
+        self._run = wandb.init(**{k: v for k, v in
+                                  self._settings.items()
+                                  if v is not None})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._run is not None and logs:
+            self._run.log({k: v for k, v in logs.items()
+                           if isinstance(v, (int, float))},
+                          step=epoch)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+__all__.append("WandbCallback")

@@ -160,3 +160,7 @@ class ModelAverage:
             for p in self._params:
                 p.set_value(self._backup[id(p)])
         self._backup = None
+
+
+from ..optimizer.optimizers import LBFGS  # noqa: E402,F401  (reference
+# re-exports the LBFGS implementation under incubate.optimizer too)
