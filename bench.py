@@ -254,7 +254,10 @@ def main():
         rec["note"] = ("no TPU evidence this run (CPU fallback smoke); "
                        "last committed on-chip capture: "
                        "BENCH_tpu_capture_r3.json (56.7% MFU, PERF.md "
-                       "round-3 capture log)")
+                       "round-3 capture log); round-6 on-chip test "
+                       "evidence (FA fwd/bwd + AdamW + C++ loader PASS "
+                       "before incident #3): "
+                       ".bench_r4/capture_0801_step1.txt")
     print(json.dumps(rec))
 
 

@@ -45,6 +45,11 @@ class WandbCallback(Callback):
                            if isinstance(v, (int, float))},
                           step=epoch)
 
+    def on_eval_end(self, logs=None):
+        if self._run is not None and logs:
+            self._run.log({f"eval/{k}": v for k, v in logs.items()
+                           if isinstance(v, (int, float))})
+
     def on_train_end(self, logs=None):
         if self._run is not None:
             self._run.finish()

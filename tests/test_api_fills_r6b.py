@@ -519,3 +519,39 @@ class TestRaggedDetectionOps:
                         fetch_list=[gx])
         # grad flows only through the direct +x path; py_func stops it
         assert np.allclose(gv, [1, 1, 1])
+
+
+class TestWandbCallback:
+    def test_requires_wandb(self):
+        import paddle_tpu.callbacks as cb
+        with pytest.raises(ModuleNotFoundError):
+            cb.WandbCallback(project="x")
+
+    def test_hook_plumbing_with_stub(self):
+        import sys
+        import types
+
+        import paddle_tpu.callbacks as cb
+        logged = []
+
+        class _Run:
+            def log(self, d, step=None):
+                logged.append((dict(d), step))
+
+            def finish(self):
+                logged.append(("finish", None))
+
+        stub = types.ModuleType("wandb")
+        stub.init = lambda **kw: _Run()
+        sys.modules["wandb"] = stub
+        try:
+            w = cb.WandbCallback(project="p", name="n")
+            w.on_train_begin()
+            w.on_epoch_end(3, {"loss": 0.5, "acc": 0.9, "skip": "str"})
+            w.on_eval_end({"loss": 0.4})
+            w.on_train_end()
+        finally:
+            del sys.modules["wandb"]
+        assert logged[0] == ({"loss": 0.5, "acc": 0.9}, 3)
+        assert logged[1] == ({"eval/loss": 0.4}, None)
+        assert logged[2] == ("finish", None)

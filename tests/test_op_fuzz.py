@@ -1,0 +1,147 @@
+"""Bounded deterministic fuzz of core op semantics vs the NumPy oracle
+(SURVEY.md §4 test strategy: oracle parity). ~200 cases, seeded — no
+hypothesis shrinking needed; failures print the exact case."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+RNG = np.random.default_rng(20260801)
+
+BIN_OPS = [
+    ("add", np.add), ("subtract", np.subtract),
+    ("multiply", np.multiply), ("maximum", np.maximum),
+    ("minimum", np.minimum),
+]
+SHAPES = [(), (1,), (3,), (2, 3), (3, 1), (1, 3), (2, 1, 4), (2, 3, 4)]
+DTYPES = [np.float32, np.float64, np.int32, np.int64]
+
+
+def _rand(shape, dt):
+    if np.issubdtype(dt, np.integer):
+        return RNG.integers(-5, 6, size=shape).astype(dt)
+    return (RNG.standard_normal(shape) * 2).astype(dt)
+
+
+class TestBinaryBroadcastFuzz:
+    @pytest.mark.parametrize("opname,npop", BIN_OPS)
+    def test_broadcast_pairs(self, opname, npop):
+        op = getattr(P, opname)
+        checked = 0
+        for sa in SHAPES:
+            for sb in SHAPES:
+                try:
+                    np.broadcast_shapes(sa, sb)
+                except ValueError:
+                    continue
+                dt = DTYPES[checked % len(DTYPES)]
+                a, b = _rand(sa, dt), _rand(sb, dt)
+                got = op(P.to_tensor(a), P.to_tensor(b)).numpy()
+                ref = npop(a, b)
+                assert got.shape == ref.shape, (opname, sa, sb, dt)
+                assert np.allclose(got.astype(np.float64),
+                                   ref.astype(np.float64),
+                                   rtol=1e-5, atol=1e-6), \
+                    (opname, sa, sb, dt)
+                checked += 1
+        assert checked > 30
+
+    def test_scalar_promotion(self):
+        # python scalar operands keep weak-type promotion (no silent
+        # upcast of the tensor dtype)
+        for dt in (np.float32, np.int32):
+            a = _rand((3,), dt)
+            got = (P.to_tensor(a) + 2).numpy()
+            assert got.dtype == dt, dt
+            assert np.allclose(got, a + 2)
+
+
+class TestReductionFuzz:
+    REDUCTIONS = [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                  ("min", np.min), ("prod", np.prod)]
+
+    @pytest.mark.parametrize("opname,npop", REDUCTIONS)
+    def test_axes_keepdim(self, opname, npop):
+        for shape in [(3,), (2, 3), (2, 3, 4)]:
+            a = _rand(shape, np.float32)
+            nd = len(shape)
+            axes = [None] + list(range(nd)) + [tuple(range(nd))] \
+                + ([(0, nd - 1)] if nd > 1 else [])
+            for ax in axes:
+                for kd in (False, True):
+                    t = P.to_tensor(a)
+                    got = getattr(t, opname)(axis=ax, keepdim=kd).numpy()
+                    ref = npop(a, axis=ax, keepdims=kd)
+                    assert np.asarray(got).shape == np.asarray(ref).shape, \
+                        (opname, shape, ax, kd)
+                    assert np.allclose(got, ref, rtol=1e-5), \
+                        (opname, shape, ax, kd)
+
+    def test_argminmax_ties_first(self):
+        a = np.float32([[3, 1, 1], [2, 2, 0]])
+        assert np.array_equal(P.to_tensor(a).argmin(axis=1).numpy(),
+                              a.argmin(1))
+        assert np.array_equal(P.to_tensor(a).argmax(axis=0).numpy(),
+                              a.argmax(0))
+
+
+class TestIndexingFuzz:
+    def test_basic_and_advanced(self):
+        a = _rand((4, 5, 6), np.float32)
+        t = P.to_tensor(a)
+        cases = [
+            np.s_[1], np.s_[-1], np.s_[1:3], np.s_[::2], np.s_[::-1],
+            np.s_[1, 2], np.s_[:, -2], np.s_[..., 0], np.s_[None, 1],
+            np.s_[1:3, ::2, ::-1],
+        ]
+        for c in cases:
+            got = t[c].numpy()
+            assert np.allclose(got, a[c]), c
+        idx = np.asarray([2, 0, 3])
+        assert np.allclose(t[P.to_tensor(idx)].numpy(), a[idx])
+        m = a[:, 0, 0] > 0
+        assert np.allclose(t[P.to_tensor(m)].numpy(), a[m])
+
+    def test_setitem_slices(self):
+        a = _rand((4, 5), np.float32)
+        t = P.to_tensor(a.copy())
+        t[1:3, ::2] = 7.0
+        ref = a.copy()
+        ref[1:3, ::2] = 7.0
+        assert np.allclose(t.numpy(), ref)
+
+
+class TestManipulationFuzz:
+    def test_reshape_transpose_roundtrips(self):
+        for shape in [(6,), (2, 3), (2, 3, 4)]:
+            a = _rand(shape, np.float32)
+            t = P.to_tensor(a)
+            flat = t.reshape([-1])
+            assert np.allclose(flat.numpy(), a.reshape(-1))
+            back = flat.reshape(list(shape))
+            assert np.allclose(back.numpy(), a)
+            if len(shape) >= 2:
+                perm = list(range(len(shape)))[::-1]
+                assert np.allclose(t.transpose(perm).numpy(),
+                                   a.transpose(perm))
+
+    def test_concat_split_roundtrip(self):
+        a = _rand((4, 6), np.float32)
+        t = P.to_tensor(a)
+        parts = P.split(t, 3, axis=1)
+        assert len(parts) == 3
+        cat = P.concat(parts, axis=1)
+        assert np.allclose(cat.numpy(), a)
+        u = P.split(t, [2, 4], axis=1)
+        assert u[0].shape == [4, 2] and u[1].shape == [4, 4]
+
+    def test_where_gather_scatter(self):
+        a = _rand((5, 3), np.float32)
+        b = _rand((5, 3), np.float32)
+        c = a > 0
+        got = P.where(P.to_tensor(c), P.to_tensor(a),
+                      P.to_tensor(b)).numpy()
+        assert np.allclose(got, np.where(c, a, b))
+        idx = np.asarray([3, 1], np.int64)
+        g = P.gather(P.to_tensor(a), P.to_tensor(idx), axis=0)
+        assert np.allclose(g.numpy(), a[idx])
