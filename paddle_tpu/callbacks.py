@@ -32,6 +32,7 @@ class WandbCallback(Callback):
                               dir=dir, mode=mode, job_type=job_type,
                               **kwargs)
         self._run = None
+        self._last_epoch = 0
 
     def on_train_begin(self, logs=None):
         import wandb
@@ -40,15 +41,20 @@ class WandbCallback(Callback):
                                   if v is not None})
 
     def on_epoch_end(self, epoch, logs=None):
+        self._last_epoch = epoch
         if self._run is not None and logs:
             self._run.log({k: v for k, v in logs.items()
                            if isinstance(v, (int, float))},
                           step=epoch)
 
     def on_eval_end(self, logs=None):
+        # same step stream as on_epoch_end: a step-less log would bump
+        # wandb's internal counter and make later epoch steps
+        # non-monotonic (silently dropped)
         if self._run is not None and logs:
             self._run.log({f"eval/{k}": v for k, v in logs.items()
-                           if isinstance(v, (int, float))})
+                           if isinstance(v, (int, float))},
+                          step=self._last_epoch)
 
     def on_train_end(self, logs=None):
         if self._run is not None:

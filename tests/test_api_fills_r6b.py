@@ -523,12 +523,15 @@ class TestRaggedDetectionOps:
 
 class TestWandbCallback:
     def test_requires_wandb(self):
+        import importlib.util
+
         import paddle_tpu.callbacks as cb
+        if importlib.util.find_spec("wandb") is not None:
+            pytest.skip("wandb installed; the guard path is moot")
         with pytest.raises(ModuleNotFoundError):
             cb.WandbCallback(project="x")
 
-    def test_hook_plumbing_with_stub(self):
-        import sys
+    def test_hook_plumbing_with_stub(self, monkeypatch):
         import types
 
         import paddle_tpu.callbacks as cb
@@ -543,15 +546,13 @@ class TestWandbCallback:
 
         stub = types.ModuleType("wandb")
         stub.init = lambda **kw: _Run()
-        sys.modules["wandb"] = stub
-        try:
-            w = cb.WandbCallback(project="p", name="n")
-            w.on_train_begin()
-            w.on_epoch_end(3, {"loss": 0.5, "acc": 0.9, "skip": "str"})
-            w.on_eval_end({"loss": 0.4})
-            w.on_train_end()
-        finally:
-            del sys.modules["wandb"]
+        monkeypatch.setitem(__import__("sys").modules, "wandb", stub)
+        w = cb.WandbCallback(project="p", name="n")
+        w.on_train_begin()
+        w.on_epoch_end(3, {"loss": 0.5, "acc": 0.9, "skip": "str"})
+        w.on_eval_end({"loss": 0.4})
+        w.on_train_end()
         assert logged[0] == ({"loss": 0.5, "acc": 0.9}, 3)
-        assert logged[1] == ({"eval/loss": 0.4}, None)
+        # eval logs ride the SAME step stream as epoch logs (monotonic)
+        assert logged[1] == ({"eval/loss": 0.4}, 3)
         assert logged[2] == ("finish", None)
