@@ -26,10 +26,17 @@ from .sharding_api import group_sharded_parallel, save_group_sharded_model  # no
 
 # auto-parallel surface
 from .auto_parallel.api import (ProcessMesh, Replicate, Shard, Partial,  # noqa: F401
-                                shard_tensor, reshard, dtensor_from_fn,
-                                shard_layer, unshard_dtensor)
+                                Strategy, shard_tensor, reshard,
+                                dtensor_from_fn, shard_layer,
+                                unshard_dtensor)
 from . import sharding  # noqa: F401
 from . import utils  # noqa: F401
+
+
+def is_available():
+    """paddle.distributed.is_available: the collective package is
+    always built into this stack."""
+    return True
 
 
 def shard_optimizer(optimizer, shard_fn=None):

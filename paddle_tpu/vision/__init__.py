@@ -3,3 +3,32 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+
+# image IO backend (reference paddle.vision get/set_image_backend,
+# image_load — upstream python/paddle/vision/image.py, unverified).
+# 'pil' is the only backend in this image ('cv2' would need opencv).
+_image_backend = "pil"
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got "
+                         f"{backend!r}")
+    if backend == "cv2":
+        raise NotImplementedError("cv2 backend needs opencv (not in "
+                                  "this image); use 'pil'")
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """Load an image file -> PIL.Image (pil backend)."""
+    b = backend or _image_backend
+    if b != "pil":
+        raise NotImplementedError(f"backend {b!r}; only 'pil' available")
+    from PIL import Image
+    return Image.open(path)

@@ -172,7 +172,7 @@ class TestVisionDetectionOps:
                                - (oc * 4 + ph * 2 + pw)) < 1e-5
 
     def test_read_decode_jpeg(self, tmp_path):
-        PIL = pytest.importorskip("PIL")
+        pytest.importorskip("PIL")
         import io as _io
 
         from PIL import Image
@@ -556,3 +556,60 @@ class TestWandbCallback:
         # eval logs ride the SAME step stream as epoch logs (monotonic)
         assert logged[1] == ({"eval/loss": 0.4}, 3)
         assert logged[2] == ("finish", None)
+
+
+class TestMiscNamespaceFills:
+    def test_fleet_utils_localfs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import (HDFSClient,
+                                                        LocalFS)
+        fs = LocalFS()
+        d = str(tmp_path)
+        fs.mkdirs(os.path.join(d, "sub"))
+        fs.touch(os.path.join(d, "f.txt"))
+        dirs, files = fs.ls_dir(d)
+        assert dirs == ["sub"] and files == ["f.txt"]
+        assert fs.is_dir(os.path.join(d, "sub"))
+        assert fs.is_file(os.path.join(d, "f.txt"))
+        fs.mv(os.path.join(d, "f.txt"), os.path.join(d, "g.txt"))
+        assert fs.is_exist(os.path.join(d, "g.txt"))
+        fs.delete(os.path.join(d, "sub"))
+        assert not fs.is_exist(os.path.join(d, "sub"))
+        with pytest.raises(NotImplementedError):
+            HDFSClient()
+
+    def test_distributed_availability_and_strategy(self):
+        import paddle_tpu.distributed as D
+        assert D.is_available() is True
+        s = D.Strategy()
+        assert s is not None
+
+    def test_vision_image_backend(self, tmp_path):
+        import paddle_tpu.vision as V
+        pytest.importorskip("PIL")
+        from PIL import Image
+        assert V.get_image_backend() == "pil"
+        with pytest.raises(ValueError):
+            V.set_image_backend("bogus")
+        with pytest.raises(NotImplementedError):
+            V.set_image_backend("cv2")
+        p = str(tmp_path / "img.png")
+        Image.new("RGB", (4, 3), (10, 20, 30)).save(p)
+        img = V.image_load(p)
+        assert img.size == (4, 3)
+
+    def test_localfs_mv_validates_src_and_dir_copy(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        dst = tmp_path / "precious"
+        dst.write_text("checkpoint")
+        # failed-save mv must NOT destroy the destination
+        with pytest.raises(FileNotFoundError):
+            fs.mv(str(tmp_path / "never_written"), str(dst),
+                  overwrite=True)
+        assert dst.read_text() == "checkpoint"
+        # checkpoints are directory trees: upload/download must copy them
+        ck = tmp_path / "ckpt"
+        (ck / "state").mkdir(parents=True)
+        (ck / "state" / "w.bin").write_text("x")
+        fs.upload(str(ck), str(tmp_path / "share"))
+        assert (tmp_path / "share" / "state" / "w.bin").read_text() == "x"
