@@ -67,6 +67,21 @@ class LlamaConfig:
     recompute: bool = False
     recompute_granularity: str = "full"
     dtype: str = "float32"
+    # Mixture-of-Experts FFN (reference: incubate MoELayer + the
+    # PaddleNLP MoE-LLaMA family): >0 replaces the dense SwiGLU MLP
+    # with `moe_num_experts` expert FFNs behind a top-k gate on every
+    # `moe_layer_interval`-th decoder layer. The expert dim carries a
+    # dist_spec on the 'sharding' mesh axis, so fleet/SPMDTrainer
+    # shards experts (EP) exactly like the driver dryrun's EP leg.
+    # Gate balance: criterion(model=...) adds moe_aux_loss_weight *
+    # model.moe_aux_loss(). Composes with recompute only at
+    # granularity "core_attn" (full-layer remat would close the aux
+    # loss over a checkpoint trace — loud guard at build time).
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_layer_interval: int = 1
+    moe_aux_loss_weight: float = 0.01
 
     @staticmethod
     def llama2_7b(**kw):
@@ -280,14 +295,29 @@ class LlamaMLP(Layer):
 
 
 class LlamaDecoderLayer(Layer):
-    def __init__(self, cfg: LlamaConfig):
+    def __init__(self, cfg: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.cfg = cfg
         self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.self_attn = LlamaAttention(cfg)
         self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
                                                 cfg.rms_norm_eps)
-        self.mlp = LlamaMLP(cfg)
+        if (cfg.moe_num_experts > 0
+                and layer_idx % max(cfg.moe_layer_interval, 1) == 0):
+            if cfg.recompute and cfg.recompute_granularity != "core_attn":
+                raise NotImplementedError(
+                    "MoE layers with full-layer recompute would close "
+                    "the gate aux loss over a checkpoint trace; use "
+                    "recompute_granularity='core_attn' (attention-only "
+                    "remat) with moe_num_experts > 0")
+            from ..incubate.moe import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size, cfg.intermediate_size,
+                                cfg.moe_num_experts,
+                                top_k=cfg.moe_top_k,
+                                capacity_factor=cfg.moe_capacity_factor,
+                                ep_axis="sharding")
+        else:
+            self.mlp = LlamaMLP(cfg)
 
     def _block(self, x, position_ids=None, attn_mask=None, attn_fn=None,
                startend_row_indices=None):
@@ -359,9 +389,20 @@ class LlamaModel(Layer):
         else:
             self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size,
                                           weight_attr=emb_attr)
-        self.layers = LayerList([LlamaDecoderLayer(cfg)
-                                 for _ in range(cfg.num_hidden_layers)])
+        self.layers = LayerList([LlamaDecoderLayer(cfg, layer_idx=i)
+                                 for i in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def moe_aux_loss(self):
+        """Sum of the gate load-balance losses set by the last forward
+        (None when no MoE layer ran). Read it in the SAME trace as that
+        forward (criterion(model=...) does)."""
+        total = None
+        for layer in self.layers:
+            aux = getattr(layer.mlp, "l_aux", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return total
 
     def forward(self, input_ids, position_ids=None, attn_mask=None,
                 attn_mask_startend_row_indices=None):
@@ -435,8 +476,20 @@ class LlamaForCausalLM(Layer, GenerationMixin):
             # a shape test — tells the criterion this is hidden, so a
             # model with hidden_size == vocab_size can't misroute.
             h._fused_hidden = True
-            return h
-        return self.lm_head(h)
+            out = h
+        else:
+            out = self.lm_head(h)
+        if self.cfg.moe_num_experts > 0:
+            # stash the gate aux loss ON the output: the criterion then
+            # folds in the aux of the EXACT forward that produced these
+            # logits (immune to interleaved eval/decode forwards
+            # overwriting layer state, and trace-consistent under jit)
+            out._moe_aux = self.llama.moe_aux_loss()
+        return out
+
+    def moe_aux_loss(self):
+        """See LlamaModel.moe_aux_loss (None for dense configs)."""
+        return self.llama.moe_aux_loss()
 
     # -- static-cache generation hooks (GenerationMixin) ---------------------
     def _init_caches(self, batch, total_len, cache_dtype=None):
@@ -470,7 +523,7 @@ class LlamaPretrainingCriterion(Layer):
     fused mode = use_fused_linear_cross_entropy)."""
 
     def __init__(self, cfg: LlamaConfig = None, ignore_index=-100,
-                 lm_head_weight=None):
+                 lm_head_weight=None, model=None):
         super().__init__()
         self.ignore_index = ignore_index
         # getattr: the criterion is shared across model families whose
@@ -485,29 +538,56 @@ class LlamaPretrainingCriterion(Layer):
         # plain object attr: Layer.__setattr__ would register the head
         # weight as this criterion's own parameter (double-counting it)
         object.__setattr__(self, "_head_w", lm_head_weight)
+        # MoE gate balance: `model=` lets the criterion add the aux loss
+        # set by the forward that produced `logits` (same trace — works
+        # eagerly, under to_static, and inside fleet steppers). Plain
+        # object attr: the model must not become a sub-layer of the
+        # criterion (parameter double-counting again).
+        self._moe_w = float(getattr(cfg, "moe_aux_loss_weight", 0.0)) \
+            if cfg is not None and getattr(cfg, "moe_num_experts", 0) \
+            else 0.0
+        object.__setattr__(self, "_moe_model", model)
         if self.parallel:
             self.pce = ParallelCrossEntropy(ignore_index=ignore_index)
 
     def bind(self, model):
         """Grab the LM head weight for fused mode (model built after the
-        criterion, the common construction order)."""
+        criterion, the common construction order) — and the model ref
+        for the MoE aux fallback, so both attach mechanisms behave
+        identically."""
         object.__setattr__(self, "_head_w", model.lm_head.weight)
+        object.__setattr__(self, "_moe_model", model)
         return self
 
     def forward(self, logits, labels):
         if self.fuse and getattr(logits, "_fused_hidden", False):
-            return self._fused_loss(logits, labels)
+            return self._add_moe_aux(self._fused_loss(logits, labels),
+                                     logits)
         # logits [B, S, V]; labels [B, S] — predict token t+1
         lg = logits[:, :-1, :]
         lb = labels[:, 1:]
         if self.parallel:
             loss = self.pce(lg, lb)
             mask = (lb != self.ignore_index).astype("float32")
-            return (loss * mask).sum() / P.maximum(
-                mask.sum(), P.to_tensor(1.0))
-        return F.cross_entropy(
+            return self._add_moe_aux(
+                (loss * mask).sum() / P.maximum(
+                    mask.sum(), P.to_tensor(1.0)), logits)
+        return self._add_moe_aux(F.cross_entropy(
             lg.reshape([-1, lg.shape[-1]]), lb.reshape([-1]),
-            ignore_index=self.ignore_index)
+            ignore_index=self.ignore_index), logits)
+
+    def _add_moe_aux(self, loss, logits):
+        if not self._moe_w:
+            return loss
+        # prefer the aux stashed ON the logits: it belongs to the exact
+        # forward that produced them (interleaved eval/decode forwards
+        # cannot corrupt it); model= / bind() is the fallback
+        aux = getattr(logits, "_moe_aux", None)
+        if aux is None and self._moe_model is not None:
+            aux = self._moe_model.moe_aux_loss()
+        if aux is not None:
+            loss = loss + self._moe_w * aux
+        return loss
 
     def _fused_loss(self, hidden, labels):
         """Chunked head-matmul + CE: each sequence chunk's [B,C,V] logits
@@ -659,6 +739,14 @@ def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages=None,
             "fuse_linear_cross_entropy is not supported in the pipeline "
             "form yet — the pipe head materializes logits, which would "
             "silently defeat the flag's purpose")
+    if cfg.moe_num_experts > 0:
+        raise NotImplementedError(
+            "moe_num_experts > 0 is not supported in the pipeline form: "
+            "the gate aux loss would be silently dropped by the staged "
+            "loss (and per-stage aux extraction through the collective "
+            "scan is not wired). Train MoE under the SPMD engine with "
+            "the expert dim on the 'sharding' axis (the EP regime — "
+            "see tests/test_llama_moe.py)")
     if cfg.tie_word_embeddings:
         if cfg.tensor_parallel:
             raise NotImplementedError(
@@ -676,8 +764,8 @@ def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages=None,
         post = [_LlamaPipeHead(cfg)]
     return PipelineLayer(
         layers=pre +
-               [LayerDesc(LlamaDecoderLayer, cfg)
-                for _ in range(cfg.num_hidden_layers)] +
+               [LayerDesc(LlamaDecoderLayer, cfg, layer_idx=i)
+                for i in range(cfg.num_hidden_layers)] +
                post,
         num_stages=num_stages,
         num_virtual_pipeline_stages=num_virtual_pipeline_stages,
