@@ -60,3 +60,71 @@ def graph_break_report():
                 "reasons": reasons,
             })
     return report
+
+
+def memory_analysis(fn, *example_inputs, **example_kwargs):
+    """Compile `fn` (a function or Layer) for the given example inputs
+    and return XLA's buffer-assignment statistics — the HBM budgeting
+    tool for TPU programs (role of the reference's memory profiling /
+    paddle.device.*.max_memory_allocated on the compiled-graph side;
+    here the numbers come from the compiler's static plan, available
+    BEFORE running a step).
+
+    Parameters AND buffers of every involved Layer ride as program
+    arguments (jit-captured constants would be folded and
+    under-report), using the same functionalization helpers as
+    to_static; nested tuple/list/dict inputs and outputs are
+    tree-flattened. Live layer state is restored after tracing.
+
+    Returns a dict: peak_bytes (compare against the chip's HBM),
+    argument_bytes, output_bytes, temp_bytes (activations/workspace),
+    generated_code_bytes, and *_mb conveniences.
+    """
+    import jax
+
+    from ..core.tensor import Tensor
+    from ..nn.layer import Layer
+    from .to_static import _discover_layers, _tree_flatten_tensors
+
+    layers = [fn] if isinstance(fn, Layer) else list(
+        _discover_layers(fn, example_inputs, example_kwargs, ()))
+    state = []
+    for layer in layers:
+        state.extend(p for _, p in layer.named_parameters())
+        state.extend(b for _, b in layer.named_buffers())
+    in_tensors, rebuild_in, _ = _tree_flatten_tensors(
+        (example_inputs, example_kwargs))
+    saved = [t._data for t in state]
+
+    def pure(state_arrays, in_arrays):
+        for t, arr in zip(state, state_arrays):
+            t._data = arr
+        try:
+            a2, k2 = rebuild_in([Tensor(a) for a in in_arrays])
+            out = fn(*a2, **k2)
+        finally:
+            # the trace binds tracers onto live params/buffers (incl.
+            # in-place buffer updates like batch_norm's running stats);
+            # restore so nothing leaks out of the closed trace
+            for t, arr in zip(state, saved):
+                t._data = arr
+        out_tensors, _, _ = _tree_flatten_tensors(out)
+        return [t._data for t in out_tensors]
+
+    compiled = jax.jit(pure).lower(
+        saved, [t._data for t in in_tensors]).compile()
+    return _mem_stats_dict(compiled.memory_analysis())
+
+
+def _mem_stats_dict(ma):
+    mb = 1024.0 * 1024.0
+    d = {
+        "peak_bytes": int(ma.peak_memory_in_bytes),
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    d.update({k.replace("_bytes", "_mb"): round(v / mb, 3)
+              for k, v in list(d.items())})
+    return d
