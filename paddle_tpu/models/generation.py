@@ -185,20 +185,50 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, num_beams=1, length_penalty=0.0,
-                 cache_dtype=None, draft_model=None, speculative_k=4):
+                 cache_dtype=None, draft_model=None, speculative_k=4,
+                 repetition_penalty=1.0, min_new_tokens=0):
         """Returns generated token ids [B, max_new_tokens].
 
         num_beams > 1 runs beam search (do_sample must be False): beams
         ride the batch dim of the SAME static-cache decode loop, with
         per-step cache/beam reordering via a batched gather — one jitted
         program like the sampling path. length_penalty applies the GNMT
-        ((5+len)/6)**p normalization at final beam selection."""
+        ((5+len)/6)**p normalization at final beam selection.
+
+        repetition_penalty (reference CTRL convention): logits of every
+        token already seen (prompt + generated) are divided by the
+        penalty when positive, multiplied when negative — a [B, vocab]
+        seen-mask rides the decode carry. min_new_tokens bans
+        eos_token_id for the first N generated tokens. Both are
+        greedy/sampling-path features (loud guard on beam/speculative)."""
         ids = input_ids._data if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
         b, s = ids.shape
         eos = -1 if eos_token_id is None else int(eos_token_id)
         cache_dtype = _normalize_cache_dtype(cache_dtype)
+        rp = float(repetition_penalty)
+        min_new = int(min_new_tokens)
+        if rp <= 0.0:
+            raise ValueError(f"repetition_penalty must be > 0, got {rp}")
+        if (rp != 1.0 or min_new > 0) and \
+                (int(num_beams) > 1 or draft_model is not None):
+            raise NotImplementedError(
+                "repetition_penalty / min_new_tokens are wired into the "
+                "greedy/sampling decode loop only (num_beams=1, no "
+                "draft_model)")
+        if min_new > int(max_new_tokens):
+            raise ValueError(
+                f"min_new_tokens({min_new}) exceeds "
+                f"max_new_tokens({int(max_new_tokens)})")
+        vocab_sz = getattr(getattr(self, "cfg", None), "vocab_size", None)
+        if min_new > 0 and eos >= 0 and vocab_sz is not None \
+                and eos >= int(vocab_sz):
+            # jit's clamped out-of-bounds .at[] would silently ban the
+            # LAST vocab token instead of the (bogus) eos id
+            raise ValueError(
+                f"eos_token_id({eos}) out of range for vocab_size"
+                f"({vocab_sz})")
         if draft_model is not None:
             if int(num_beams) > 1:
                 raise NotImplementedError(
@@ -233,13 +263,13 @@ class GenerationMixin:
                 f"max_position_embeddings({maxpos})")
         sig = (b, s, int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p), eos,
-               cache_dtype)
+               cache_dtype, rp, min_new)
         fn = self._gen_program(sig)
         if fn is None:
             fn = jax.jit(functools.partial(
                 _generate_pure, self, s, int(max_new_tokens),
                 bool(do_sample), float(temperature), int(top_k),
-                float(top_p), eos, cache_dtype))
+                float(top_p), eos, cache_dtype, rp, min_new))
             self._gen_cache[sig] = fn
         key = _random.next_key() if seed is None else \
             jax.random.PRNGKey(seed)
@@ -450,7 +480,8 @@ def _beam_body(model, prompt_len, max_new, K, eos, lenpen,
 
 
 def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
-                   top_k, top_p, eos, cache_dtype, warrs, ids, key):
+                   top_k, top_p, eos, cache_dtype, rp, min_new, warrs,
+                   ids, key):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
@@ -458,38 +489,71 @@ def _generate_pure(model, prompt_len, max_new, do_sample, temperature,
     try:
         return _generate_body(model, prompt_len, max_new, do_sample,
                               temperature, top_k, top_p, eos, cache_dtype,
-                              ids, key)
+                              rp, min_new, ids, key)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
 def _generate_body(model, prompt_len, max_new, do_sample, temperature,
-                   top_k, top_p, eos, cache_dtype, ids, key):
+                   top_k, top_p, eos, cache_dtype, rp, min_new, ids, key):
     b = ids.shape[0]
     total = prompt_len + max_new
     caches = model._init_caches(b, total, cache_dtype)
 
+    use_rp = rp != 1.0
+    use_minnew = min_new > 0 and eos >= 0
+    plain = not (use_rp or use_minnew)
+
+    def adjust(logits, seen, new_idx):
+        """Repetition penalty (CTRL convention: seen tokens' logits
+        divided by rp when positive, multiplied when negative) + eos ban
+        below min_new_tokens. `new_idx` = 1-based index of the token
+        about to be sampled. NEVER called on the plain path — the
+        default decode must stay bit-identical to the pre-feature
+        program (incl. logits dtype into sampling)."""
+        lg = logits.astype(jnp.float32)
+        if use_rp:
+            pen = jnp.where(lg > 0, lg / rp, lg * rp)
+            lg = jnp.where(seen, pen, lg)
+        if use_minnew:
+            banned = new_idx <= min_new
+            lg = lg.at[:, eos].set(
+                jnp.where(banned, -jnp.inf, lg[:, eos]))
+        return lg
+
     # prefill: whole prompt in one pass
     logits, caches = model._forward_cached(ids, caches, 0)
+    if use_rp:
+        seen0 = jnp.zeros((b, logits.shape[-1]), bool).at[
+            jnp.arange(b)[:, None], ids].set(True)
+    else:
+        seen0 = jnp.zeros((b, 1), bool)  # inert carry placeholder
     key, sub = jax.random.split(key)
-    tok = _sample_token(logits[:, -1], sub, do_sample, temperature,
-                        top_k, top_p)
+    lg = logits[:, -1] if plain else \
+        adjust(logits[:, -1], seen0, jnp.asarray(1, jnp.int32))
+    tok = _sample_token(lg, sub, do_sample, temperature, top_k, top_p)
+    if use_rp:
+        seen0 = seen0.at[jnp.arange(b), tok].set(True)
     finished = (tok == eos)
 
     def step(carry, i):
-        caches, tok, key, finished = carry
+        caches, tok, key, finished, seen = carry
         logits, caches = model._forward_cached(
             tok[:, None], caches, prompt_len + i)
         key, sub = jax.random.split(key)
-        nxt = _sample_token(logits[:, -1], sub, do_sample, temperature,
+        lg = logits[:, -1] if plain else adjust(logits[:, -1], seen,
+                                                i + 2)
+        nxt = _sample_token(lg, sub, do_sample, temperature,
                             top_k, top_p)
         nxt = jnp.where(finished, jnp.asarray(eos, jnp.int32), nxt)
+        if use_rp:
+            seen = seen.at[jnp.arange(b), nxt].set(True)
         finished = finished | (nxt == eos)
-        return (caches, nxt, key, finished), tok
+        return (caches, nxt, key, finished, seen), tok
 
-    (caches, tok, key, finished), toks = jax.lax.scan(
-        step, (caches, tok, key, finished),
+    (caches, tok, key, finished, _), toks = jax.lax.scan(
+        step, (caches, tok, key, finished, seen0),
         jnp.arange(max_new - 1, dtype=jnp.int32))
     # toks holds tokens emitted BEFORE each step; append the final one
     all_toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],

@@ -174,3 +174,4 @@ class TestMoELlamaSPMD:
         v = float(np.asarray(loss.numpy() if hasattr(loss, "numpy")
                              else loss))
         assert np.isfinite(v) and v > 0
+
