@@ -148,3 +148,62 @@ class TestManipulationFuzz:
         idx = np.asarray([3, 1], np.int64)
         g = P.gather(P.to_tensor(a), P.to_tensor(idx), axis=0)
         assert np.allclose(g.numpy(), a[idx])
+
+
+class TestActivationOracleFuzz:
+    """Elementwise nn.functional surface vs the torch oracle over a
+    range-stressing grid (negatives, zeros, large values)."""
+
+    GRID = np.float32([-50, -3.7, -1.0, -0.25, 0.0, 1e-6, 0.5, 1.0,
+                       3.7, 50]).reshape(2, 5)
+
+    PAIRS = [
+        ("relu", "relu", {}),
+        ("relu6", "relu6", {}),
+        ("gelu", "gelu", {}),
+        ("silu", "silu", {}),
+        ("softplus", "softplus", {}),
+        ("mish", "mish", {}),
+        ("hardswish", "hardswish", {}),
+        ("hardsigmoid", "hardsigmoid", {}),
+        ("elu", "elu", {"alpha": 1.3}),
+        ("celu", "celu", {"alpha": 1.3}),
+        ("leaky_relu", "leaky_relu", {"negative_slope": 0.07}),
+        ("softsign", "softsign", {}),
+        ("tanhshrink", "tanhshrink", {}),
+        ("softshrink", "softshrink", {}),
+        ("hardshrink", "hardshrink", {}),
+        ("log_sigmoid", "logsigmoid", {}),
+        ("sigmoid", "sigmoid", {}),
+        ("selu", "selu", {}),
+    ]
+
+    @pytest.mark.parametrize("ours,theirs,kw",
+                             PAIRS, ids=[p[0] for p in PAIRS])
+    def test_matches_torch(self, ours, theirs, kw):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.nn.functional as F
+        fn = getattr(F, ours)
+        tfn = getattr(torch.nn.functional, theirs)
+        tkw = dict(kw)
+        if ours == "leaky_relu":
+            got = fn(P.to_tensor(self.GRID), kw["negative_slope"])
+            ref = tfn(torch.tensor(self.GRID), kw["negative_slope"])
+        else:
+            got = fn(P.to_tensor(self.GRID), **kw)
+            ref = tfn(torch.tensor(self.GRID), **tkw)
+        assert np.allclose(got.numpy(), ref.numpy(),
+                           rtol=2e-5, atol=2e-6), (ours, got.numpy(),
+                                                   ref.numpy())
+
+    def test_softmax_logsoftmax_stability(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.nn.functional as F
+        x = np.float32([[1e4, 1e4 + 1, -1e4], [0.0, 1.0, 2.0]])
+        got = F.softmax(P.to_tensor(x), axis=-1).numpy()
+        ref = torch.softmax(torch.tensor(x), -1).numpy()
+        assert np.allclose(got, ref, atol=1e-6)
+        gl = F.log_softmax(P.to_tensor(x), axis=-1).numpy()
+        rl = torch.log_softmax(torch.tensor(x), -1).numpy()
+        assert np.allclose(gl, rl, atol=1e-5)
+        assert np.isfinite(gl).all()
