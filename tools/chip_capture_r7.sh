@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-7 chip capture list — SAFE-FIRST reordering of chip_capture_r4.sh.
+#
+# Lesson from incident #3 (PERF.md): the first-time Mosaic compiles of the
+# streamed/FlashMask/dropout kernels are the step class that can wedge the
+# grant; when they ran FIRST (08-01 morning window) the wedge cost every
+# other capture in the list AND left the grant dead for the driver's own
+# bench.py. This list banks the known-good program classes first (they all
+# compiled on-chip in round 3: bench.py headline, longseq s=8192, decode,
+# BERT loop), and only then attempts the new-kernel smokes. Each step is
+# individually wedge-proofed (bounded subprocess probe + CPU fallback).
+# Every step's stdout JSON is banked into .bench_r4/ the moment it lands
+# (tee — the log alone is not an artifact).
+#
+# Run DETACHED on a healthy tunnel with a QUIET VM:
+#   setsid bash tools/chip_capture_r7.sh > .bench_r4/capture_r7.log 2>&1 &
+# NEVER SIGTERM a step mid-compile (CLAUDE.md chip hygiene).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+
+stamp() { date -u +%H:%M:%S; }
+run() {
+  echo "=== $(stamp) $*"
+  "$@"
+  local rc=$?
+  echo "=== $(stamp) rc=$rc"
+}
+
+# ---- SAFE TIER: program classes already proven on-chip in round 3 ----
+
+# 1. headline MFU (the driver metric; round-3 capture was 56.7%)
+run bash -o pipefail -c 'python bench.py | tee .bench_r4/bench_headline_r7.json'
+
+# 2. long-seq row, then the remat-policy lever on the same shape
+run bash -o pipefail -c 'python bench_longseq.py 1 8192 | tee .bench_r4/longseq_8192_r7.json'
+run bash -o pipefail -c 'PADDLE_TPU_RECOMPUTE_GRAN=full_attn python bench_longseq.py 1 8192 | tee .bench_r4/longseq_8192_fullattn_r7.json'
+
+# 3. decode: int8 KV + weight-only int8 (round-3b program classes)
+run bash -o pipefail -c 'python bench_generate.py 8 128 512 --kv int8 --wq int8 | tee .bench_r4/decode_int8_r7.json'
+
+# 4. speculative serving capture (records measured acceptance)
+run bash -o pipefail -c 'python bench_generate.py 1 128 512 --spec 4 --wq int8 --kv int8 | tee .bench_r4/decode_spec_r7.json'
+
+# 5. BERT AMP-O2 + ResNet via the device loop (first non-relay number);
+#    bank the artifact before any kernel-dropout re-run overwrites it
+run python bench_extra.py
+cp -f BENCH_extra.json .bench_r4/BENCH_extra_r7.json 2>/dev/null || true
+
+# ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
+
+# 6. kernel parity on-chip — split per-family tests (streamed fwd,
+#    cross-length, FlashMask, in-kernel dropout: first Mosaic compiles)
+run env PADDLE_TPU_CHIP_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
+
+# 7. bf16 sep shard_map compile smoke (VERDICT r4 missing #4)
+run python tools/sep_bf16_chip_smoke.py
+
+# 8. in-kernel counter-hash dropout parity smoke; green clears
+#    PADDLE_TPU_FA_KERNEL_DROPOUT=1
+run python tools/kernel_dropout_chip_smoke.py
+
+echo "=== $(stamp) capture list complete"
