@@ -7,3 +7,5 @@ from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
                     LlamaForCausalLMPipe, LlamaModel,
                     LlamaPretrainingCriterion, count_params,
                     flops_per_token)
+from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
+                 T5Model)

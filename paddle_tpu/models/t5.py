@@ -1,0 +1,410 @@
+"""T5 encoder-decoder family (text-to-text transformer).
+
+Reference surface: the Paddle-ecosystem T5 (upstream PaddleNLP
+paddlenlp/transformers/t5/modeling.py, unverified — see SURVEY.md §2.2
+"Misc domains"): RMS layer norm without bias, relative-position-bucket
+attention bias (layer 0 of each stack owns the bias table, later layers
+reuse the computed bias), NO 1/sqrt(d) attention scaling, bias-free
+linears, ReLU or gated-GELU feed-forward, shared input embedding, and a
+tied LM head whose logits scale by d_model**-0.5. Parity is tested
+against the `transformers` torch implementation by weight transplant
+(tests/test_models_vit_t5.py) — encoder states, teacher-forced logits,
+and greedy generation token-for-token.
+
+TPU-first notes:
+- Attention is inline tensor ops (softmax(QK^T + bias)V): the learnable
+  relative bias must receive gradients, so it cannot ride the detached
+  attn_mask of scaled_dot_product_attention. XLA fuses the additive
+  bias into the score matmul epilogue.
+- generate() compiles ONE decode program (prefill + lax.scan over
+  steps) with static self-attention KV caches written at absolute
+  offsets and cross-attention K/V precomputed once from the encoder
+  states. Weights and encoder states enter as ARGUMENTS, never
+  jit-captured constants (models/generation.py round-3 lesson: baked
+  constants overflow the remote-compile transport and pin stale
+  weights).
+- The relative-bias row for a decode step is computed from the traced
+  offset with integer ops + one embedding gather — no dynamic shapes.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from ..nn import Dropout, Embedding, Layer, LayerList, Linear, RMSNorm
+from ..nn import functional as F
+from .generation import _sample_token
+from ..core import random as _random
+
+__all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration"]
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # or "gated-gelu" (t5 v1.1)
+    pad_token_id: int = 0
+    eos_token_id: int = 1
+    decoder_start_token_id: int = 0
+    tie_word_embeddings: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        return T5Config(**{**dict(
+            vocab_size=128, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+            num_decoder_layers=2, num_heads=4, dropout_rate=0.0), **kw})
+
+
+# T5's layer norm IS RMS norm (no mean subtraction, no bias) — reuse the
+# shared fused op instead of re-implementing it (nn/norm.py::RMSNorm).
+T5LayerNorm = RMSNorm
+
+
+def _relative_position_bucket(rel, bidirectional, num_buckets,
+                              max_distance):
+    """T5 bucketing of key_pos - query_pos (jnp int32 in, int32 out)."""
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    # max(n, 1) only guards the unselected branch (is_small covers n <
+    # max_exact); keeps the log formula EXACTLY the reference's
+    big = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    big = jnp.minimum(big, num_buckets - 1)
+    return ret + jnp.where(is_small, n, big)
+
+
+class T5Attention(Layer):
+    def __init__(self, cfg: T5Config, has_bias_table: bool,
+                 bidirectional: bool):
+        super().__init__()
+        self.nh = cfg.num_heads
+        self.hd = cfg.d_kv
+        inner = self.nh * self.hd
+        self.q = Linear(cfg.d_model, inner, bias_attr=False)
+        self.k = Linear(cfg.d_model, inner, bias_attr=False)
+        self.v = Linear(cfg.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, cfg.d_model, bias_attr=False)
+        self.bidirectional = bidirectional
+        self.num_buckets = cfg.relative_attention_num_buckets
+        self.max_distance = cfg.relative_attention_max_distance
+        self.relative_attention_bias = (
+            Embedding(self.num_buckets, self.nh) if has_bias_table
+            else None)
+
+    def compute_bias(self, sq, sk, q_offset=0):
+        """[1, nh, sq, sk] additive bias from the layer-0 bucket table."""
+        qpos = jnp.arange(sq, dtype=jnp.int32)[:, None] + q_offset
+        kpos = jnp.arange(sk, dtype=jnp.int32)[None, :]
+        bucket = _relative_position_bucket(
+            kpos - qpos, self.bidirectional, self.num_buckets,
+            self.max_distance)
+        table = self.relative_attention_bias.weight  # [buckets, nh]
+        bias = F.embedding(Tensor(bucket.reshape(-1)), table)
+        return bias.reshape([sq, sk, self.nh]).transpose(
+            [2, 0, 1]).unsqueeze(0)
+
+    def _heads(self, x, proj):
+        b, s = x.shape[0], x.shape[1]
+        return proj(x).reshape([b, s, self.nh, self.hd]).transpose(
+            [0, 2, 1, 3])
+
+    def forward(self, x, kv=None, position_bias=None, causal=False):
+        """x [B,Sq,D]; kv [B,Sk,D] for cross-attention (None = self).
+        NO 1/sqrt(d) scaling (reference semantics)."""
+        b, sq = x.shape[0], x.shape[1]
+        src = x if kv is None else kv
+        sk = src.shape[1]
+        q = self._heads(x, self.q)
+        k = self._heads(src, self.k)
+        v = self._heads(src, self.v)
+        scores = P.matmul(q, k.transpose([0, 1, 3, 2]))  # [B,nh,Sq,Sk]
+        if position_bias is not None:
+            scores = scores + position_bias
+        if causal:
+            neg = P.to_tensor(
+                jnp.where(jnp.arange(sk)[None, :]
+                          > jnp.arange(sq)[:, None], -1e9, 0.0)
+                .astype("float32"))
+            scores = scores + neg
+        probs = F.softmax(scores, axis=-1)
+        ctx = P.matmul(probs, v).transpose([0, 2, 1, 3]).reshape(
+            [b, sq, self.nh * self.hd])
+        return self.o(ctx)
+
+
+class T5FF(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.gated = cfg.feed_forward_proj == "gated-gelu"
+        if self.gated:
+            self.wi_0 = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+            self.wi_1 = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        else:
+            self.wi = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        self.wo = Linear(cfg.d_ff, cfg.d_model, bias_attr=False)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, x):
+        if self.gated:
+            h = F.gelu(self.wi_0(x)) * self.wi_1(x)
+        else:
+            h = F.relu(self.wi(x))
+        return self.wo(self.dropout(h))
+
+
+class T5Block(Layer):
+    def __init__(self, cfg: T5Config, is_decoder: bool, layer_idx: int):
+        super().__init__()
+        self.is_decoder = is_decoder
+        eps = cfg.layer_norm_epsilon
+        self.self_norm = T5LayerNorm(cfg.d_model, eps)
+        self.self_attn = T5Attention(cfg, has_bias_table=(layer_idx == 0),
+                                     bidirectional=not is_decoder)
+        if is_decoder:
+            self.cross_norm = T5LayerNorm(cfg.d_model, eps)
+            self.cross_attn = T5Attention(cfg, has_bias_table=False,
+                                          bidirectional=True)
+        self.ff_norm = T5LayerNorm(cfg.d_model, eps)
+        self.ff = T5FF(cfg)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, x, enc=None, position_bias=None):
+        x = x + self.dropout(self.self_attn(
+            self.self_norm(x), position_bias=position_bias,
+            causal=self.is_decoder))
+        if self.is_decoder:
+            x = x + self.dropout(self.cross_attn(self.cross_norm(x),
+                                                 kv=enc))
+        return x + self.dropout(self.ff(self.ff_norm(x)))
+
+
+class T5Stack(Layer):
+    def __init__(self, cfg: T5Config, is_decoder: bool, embed: Embedding):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.embed = embed
+        n = cfg.num_decoder_layers if is_decoder else cfg.num_layers
+        self.block = LayerList([T5Block(cfg, is_decoder, i)
+                                for i in range(n)])
+        self.final_layer_norm = T5LayerNorm(cfg.d_model,
+                                            cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, input_ids, enc=None):
+        x = self.dropout(self.embed(input_ids))
+        sq = x.shape[1]
+        bias = self.block[0].self_attn.compute_bias(sq, sq)
+        for blk in self.block:
+            x = blk(x, enc=enc, position_bias=bias)
+        return self.dropout(self.final_layer_norm(x))
+
+
+class T5Model(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model)
+        self.encoder = T5Stack(cfg, is_decoder=False, embed=self.shared)
+        self.decoder = T5Stack(cfg, is_decoder=True, embed=self.shared)
+
+    def forward(self, input_ids, decoder_input_ids):
+        enc = self.encoder(input_ids)
+        return self.decoder(decoder_input_ids, enc=enc), enc
+
+
+class T5ForConditionalGeneration(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.t5 = T5Model(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(cfg.d_model, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def _logits(self, dec):
+        if self.lm_head is not None:
+            return self.lm_head(dec)
+        # tied head: logits scale by d_model**-0.5 (reference semantics)
+        return P.matmul(dec * (self.cfg.d_model ** -0.5),
+                        self.t5.shared.weight.t())
+
+    def forward(self, input_ids, decoder_input_ids, labels=None):
+        dec, _ = self.t5(input_ids, decoder_input_ids)
+        logits = self._logits(dec)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]),
+            labels.reshape([-1]), ignore_index=self.cfg.pad_token_id)
+        return loss, logits
+
+    # -- compiled encoder-decoder generation ---------------------------
+    def _gen_tensors(self):
+        return [p for _, p in self.named_parameters()]
+
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, seed=None):
+        """Greedy/sampling encoder-decoder generation.
+
+        Returns [B, max_new_tokens] decoder tokens (eos-padded). One
+        jitted program: encoder pass + cross-K/V precompute + prefill on
+        the start token + lax.scan decode with static self-attn caches.
+        """
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, s_enc = ids.shape
+        warrs = [t._data for t in self._gen_tensors()]
+        sig = (b, s_enc, int(max_new_tokens), bool(do_sample),
+               float(temperature), int(top_k), float(top_p))
+        cache = getattr(self, "_t5_gen_cache", None)
+        if cache is None:
+            cache = self._t5_gen_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _t5_generate_pure, self, int(max_new_tokens),
+                bool(do_sample), float(temperature), int(top_k),
+                float(top_p)))
+            cache[sig] = fn
+        key = _random.next_key() if seed is None else \
+            jax.random.PRNGKey(seed)
+        was_training = getattr(self, "training", False)
+        if was_training:
+            self.eval()
+        try:
+            return Tensor(fn(warrs, ids, key))
+        finally:
+            if was_training:
+                self.train()
+
+
+def _t5_generate_pure(model, max_new, do_sample, temperature, top_k,
+                      top_p, warrs, ids, key):
+    tensors = model._gen_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, warrs):
+        t._data = arr
+    try:
+        return _t5_generate_body(model, max_new, do_sample, temperature,
+                                 top_k, top_p, ids, key)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _t5_generate_body(model, max_new, do_sample, temperature, top_k,
+                      top_p, ids, key):
+    cfg = model.cfg
+    b = ids.shape[0]
+    nh, hd = cfg.num_heads, cfg.d_kv
+    eos = cfg.eos_token_id
+    dec_blocks = model.t5.decoder.block
+
+    enc = model.t5.encoder(Tensor(ids))  # [B, S_enc, D]
+
+    # cross-attention K/V once per layer
+    cross = []
+    for blk in dec_blocks:
+        at = blk.cross_attn
+        cross.append((at._heads(enc, at.k)._data,
+                      at._heads(enc, at.v)._data))
+
+    bias_attn = dec_blocks[0].self_attn  # layer-0 bucket table
+
+    def dec_step(tok, caches, offset):
+        """One decoder position: tok [B] at absolute `offset`.
+        Returns (logits [B, V], caches)."""
+        x = model.t5.decoder.embed(Tensor(tok[:, None]))  # [B,1,D]
+        kpos = jnp.arange(caches[0][0].shape[1], dtype=jnp.int32)
+        visible = (kpos <= offset)[None, None, None, :]
+        bias = bias_attn.compute_bias(1, caches[0][0].shape[1],
+                                      q_offset=offset)._data
+        new = []
+        for blk, (ck, cv), (kb, vb) in zip(dec_blocks, caches, cross):
+            at = blk.self_attn
+            y = blk.self_norm(x)
+            q = at._heads(y, at.q)._data  # [B,nh,1,hd]
+            k1 = at._heads(y, at.k)._data
+            v1 = at._heads(y, at.v)._data
+            kb_s = jax.lax.dynamic_update_slice(
+                ck, jnp.swapaxes(k1, 1, 2), (0, offset, 0, 0))
+            vb_s = jax.lax.dynamic_update_slice(
+                cv, jnp.swapaxes(v1, 1, 2), (0, offset, 0, 0))
+            new.append((kb_s, vb_s))
+            ks = jnp.swapaxes(kb_s, 1, 2)  # [B,nh,T,hd]
+            vs = jnp.swapaxes(vb_s, 1, 2)
+            sc = jnp.einsum("bhqd,bhkd->bhqk", q, ks) + bias
+            sc = jnp.where(visible, sc, -1e9)
+            pr = jax.nn.softmax(sc, axis=-1)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", pr, vs)
+            x = x + Tensor(at.o(Tensor(
+                jnp.swapaxes(ctx, 1, 2).reshape(b, 1, nh * hd)))._data)
+            # cross-attention (precomputed K/V; full visibility)
+            ca = blk.cross_attn
+            y2 = blk.cross_norm(x)
+            q2 = ca._heads(y2, ca.q)._data
+            sc2 = jnp.einsum("bhqd,bhkd->bhqk", q2, kb)
+            pr2 = jax.nn.softmax(sc2, axis=-1)
+            ctx2 = jnp.einsum("bhqk,bhkd->bhqd", pr2, vb)
+            x = x + Tensor(ca.o(Tensor(
+                jnp.swapaxes(ctx2, 1, 2).reshape(b, 1, nh * hd)))._data)
+            x = x + blk.ff(blk.ff_norm(x))
+        x = model.t5.decoder.final_layer_norm(x)
+        logits = model._logits(x)._data[:, 0]
+        return logits, new
+
+    total = max_new  # decoder positions 0..max_new-1
+    caches = [(jnp.zeros((b, total, nh, hd), jnp.float32),
+               jnp.zeros((b, total, nh, hd), jnp.float32))
+              for _ in dec_blocks]
+
+    start = jnp.full((b,), cfg.decoder_start_token_id, jnp.int32)
+    logits, caches = dec_step(start, caches, jnp.asarray(0, jnp.int32))
+    key, sub = jax.random.split(key)
+    tok = _sample_token(logits, sub, do_sample, temperature, top_k, top_p)
+    finished = (tok == eos)
+
+    def step(carry, i):
+        caches, tok, key, finished = carry
+        logits, caches = dec_step(tok, caches, i + 1)
+        key, sub = jax.random.split(key)
+        nxt = _sample_token(logits, sub, do_sample, temperature, top_k,
+                            top_p)
+        nxt = jnp.where(finished, jnp.asarray(eos, jnp.int32), nxt)
+        finished = finished | (nxt == eos)
+        return (caches, nxt, key, finished), tok
+
+    (caches, tok, key, finished), toks = jax.lax.scan(
+        step, (caches, tok, key, finished),
+        jnp.arange(max_new - 1, dtype=jnp.int32))
+    return jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],
+                           axis=1)

@@ -20,3 +20,5 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,  # noqa: F401
 from .squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
                          squeezenet1_1)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .vit import (VisionTransformer, ViTConfig, vit_b_16,  # noqa: F401
+                  vit_b_32, vit_l_16, vit_h_14)

@@ -1,0 +1,163 @@
+"""Vision Transformer family (ViT-B/16, ViT-B/32, ViT-L/16, ViT-H/14).
+
+Reference surface: the standard pre-LN ViT (Dosovitskiy et al.) as
+shipped in the Paddle ecosystem's classification model zoo (upstream
+PaddleClas ppcls/arch/backbone/model_zoo/vision_transformer.py,
+unverified — see SURVEY.md §2.2 "Vision"). Parity is tested against the
+`transformers` torch implementation by weight transplant
+(tests/test_models_vit_t5.py).
+
+TPU-first notes:
+- Patch embedding is a Conv2D with kernel=stride=patch — XLA lowers a
+  non-overlapping conv to one [N_patches, P²·C]×[P²·C, H] matmul, which
+  is exactly the MXU-friendly shape (ViT-B/16: 768-wide, 6 MXU tiles).
+- The encoder is pre-LN (LN → attn → residual, LN → MLP → residual) —
+  one fused attention per layer via scaled_dot_product_attention, which
+  routes to the Pallas flash kernel at supported shapes.
+- CLS token + learned position table are plain parameters broadcast in
+  the traced program; no dynamic shapes anywhere, so a single XLA
+  computation covers the whole forward.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ...nn import (Conv2D, Dropout, GELU, Layer, LayerList, LayerNorm,
+                   Linear)
+from ...nn import functional as F
+
+__all__ = ["VisionTransformer", "ViTConfig", "vit_b_16", "vit_b_32",
+           "vit_l_16", "vit_h_14"]
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 1000
+
+    @staticmethod
+    def tiny(**kw):
+        return ViTConfig(**{**dict(
+            image_size=32, patch_size=8, hidden_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=128, num_classes=10), **kw})
+
+
+class PatchEmbed(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.projection = Conv2D(cfg.num_channels, cfg.hidden_size,
+                                 cfg.patch_size, stride=cfg.patch_size)
+        self.num_patches = (cfg.image_size // cfg.patch_size) ** 2
+
+    def forward(self, x):
+        # [B, C, H, W] -> [B, hidden, H/P, W/P] -> [B, N, hidden]
+        x = self.projection(x)
+        b, h = x.shape[0], x.shape[1]
+        return x.reshape([b, h, -1]).transpose([0, 2, 1])
+
+
+class ViTLayer(Layer):
+    """Pre-LN transformer block (LN→MHA→res, LN→MLP→res)."""
+
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.nh = cfg.num_attention_heads
+        self.hd = h // self.nh
+        self.norm_before = LayerNorm(h, cfg.layer_norm_eps)
+        self.q = Linear(h, h)
+        self.k = Linear(h, h)
+        self.v = Linear(h, h)
+        self.attn_out = Linear(h, h)
+        self.norm_after = LayerNorm(h, cfg.layer_norm_eps)
+        self.mlp_in = Linear(h, cfg.intermediate_size)
+        self.mlp_out = Linear(cfg.intermediate_size, h)
+        self.act = GELU()
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.attn_dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        y = self.norm_before(x)
+        # fused QKV (one [h, 3h] matmul; see models/bert.py for the MXU
+        # rationale) while keeping the reference per-projection params
+        qkv_w = P.concat([self.q.weight, self.k.weight, self.v.weight],
+                         axis=1)
+        qkv_b = P.concat([self.q.bias, self.k.bias, self.v.bias])
+        qkv = F.linear(y, qkv_w, qkv_b).reshape([b, s, 3, self.nh,
+                                                 self.hd])
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_dropout_p,
+            training=self.training)
+        x = x + self.dropout(self.attn_out(
+            ctx.reshape([b, s, self.nh * self.hd])))
+        y = self.mlp_out(self.act(self.mlp_in(self.norm_after(x))))
+        return x + self.dropout(y)
+
+
+class VisionTransformer(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.patch_embed = PatchEmbed(cfg)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter((1, 1, cfg.hidden_size))
+        self.position_embeddings = self.create_parameter(
+            (1, n + 1, cfg.hidden_size))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.encoder = LayerList([ViTLayer(cfg)
+                                  for _ in range(cfg.num_hidden_layers)])
+        self.norm = LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.head = (Linear(cfg.hidden_size, cfg.num_classes)
+                     if cfg.num_classes else None)
+
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        cls = P.expand(self.cls_token, [x.shape[0], 1, self.cfg.hidden_size])
+        x = P.concat([cls, x], axis=1) + self.position_embeddings
+        x = self.dropout(x)
+        for layer in self.encoder:
+            x = layer(x)
+        return self.norm(x)
+
+    def forward(self, x):
+        feats = self.forward_features(x)
+        if self.head is None:
+            return feats
+        return self.head(feats[:, 0])
+
+
+def _vit(**kw):
+    return VisionTransformer(ViTConfig(**kw))
+
+
+def vit_b_16(num_classes=1000, **kw):
+    return _vit(num_classes=num_classes, **kw)
+
+
+def vit_b_32(num_classes=1000, **kw):
+    return _vit(patch_size=32, num_classes=num_classes, **kw)
+
+
+def vit_l_16(num_classes=1000, **kw):
+    return _vit(hidden_size=1024, num_hidden_layers=24,
+                num_attention_heads=16, intermediate_size=4096,
+                num_classes=num_classes, **kw)
+
+
+def vit_h_14(num_classes=1000, **kw):
+    return _vit(patch_size=14, hidden_size=1280, num_hidden_layers=32,
+                num_attention_heads=16, intermediate_size=5120,
+                num_classes=num_classes, **kw)

@@ -43,9 +43,16 @@ run bash -o pipefail -c 'python bench_generate.py 8 128 512 --kv int8 --wq int8 
 run bash -o pipefail -c 'python bench_generate.py 1 128 512 --spec 4 --wq int8 --kv int8 | tee .bench_r4/decode_spec_r7.json'
 
 # 5. BERT AMP-O2 + ResNet via the device loop (first non-relay number);
-#    bank the artifact before any kernel-dropout re-run overwrites it
+#    bank the artifact before any kernel-dropout re-run overwrites it.
+#    Only bank a file NEWER than the step start — a stale repo-root
+#    BENCH_extra.json from a previous round must not be re-labeled r7.
+touch .bench_r4/.step5_start
 run python bench_extra.py
-cp -f BENCH_extra.json .bench_r4/BENCH_extra_r7.json 2>/dev/null || true
+if [ BENCH_extra.json -nt .bench_r4/.step5_start ]; then
+  cp -f BENCH_extra.json .bench_r4/BENCH_extra_r7.json
+else
+  echo "=== step 5 produced no fresh BENCH_extra.json; NOT banking"
+fi
 
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 

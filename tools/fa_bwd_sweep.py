@@ -26,6 +26,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_DIR = os.path.join(REPO, ".bench_r4")
 BUDGET_S = 900  # per-candidate wait; first Mosaic compile at s=8192 is slow
+# A wedged grant has been observed to stay wedged ~50 min (incident #3)
+# — the in-flight-child guard must outlast that, not just the budget.
+IN_FLIGHT_S = 4500
 
 
 def candidates():
@@ -100,7 +103,7 @@ def main():
             # else: a CPU-fallback artifact from a dead-chip run — re-run.
         if (os.path.exists(smoke_log) and not os.path.exists(art)
                 and time.time() - os.path.getmtime(smoke_log)
-                < 2 * BUDGET_S):
+                < IN_FLIGHT_S):
             # A recent log with no artifact means a previous sweep's child
             # may still be compiling this config — launching a second
             # first-time Mosaic compile of the same shape on a possibly
