@@ -9,3 +9,5 @@ from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
                     flops_per_token)
 from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
                  T5Model)
+from .whisper import (WhisperConfig, WhisperModel,  # noqa: F401
+                      WhisperForConditionalGeneration)

@@ -1,0 +1,188 @@
+"""Shared compiled decode loop for encoder-decoder families (T5,
+Whisper).
+
+One jitted program per (shape, sampling) signature: encoder pass →
+cross-attention K/V precompute (once per decoder layer) → prefill on the
+start token → `lax.scan` over decode steps with static self-attention
+KV caches written at absolute offsets. Weights enter as ARGUMENTS (the
+models/generation.py round-3 lesson: jit-captured weight constants
+overflow the remote-compile transport and pin stale weights).
+
+A model opts in by implementing `_encdec_spec(inputs)` returning a dict:
+  encode      () -> Tensor [B, S_enc, D]           encoder forward
+  blocks      decoder blocks with the protocol attrs self_norm /
+              self_attn / cross_norm / cross_attn / ff_norm / ff, where
+              each attention has q/k/v/o Linears, `_heads`, `nh`, `hd`,
+              and an optional `scale` multiplied into q (T5: absent ⇒
+              1.0 — reference T5 is unscaled; Whisper: d_head**-0.5)
+  embed_step  (tok [B], offset) -> Tensor [B, 1, D]  token+pos embed
+  bias_step   (offset, total) -> jnp [1, nh, 1, total] | None
+  final_norm  Layer
+  logits      (Tensor [B, 1, D]) -> Tensor [B, 1, V]
+  eos, start  token ids
+plus `_gen_tensors()` (the parameter list swapped for the traced args).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from .generation import _sample_token
+
+__all__ = ["EncDecGenerationMixin"]
+
+
+class EncDecGenerationMixin:
+    def _gen_tensors(self):
+        return [p for _, p in self.named_parameters()]
+
+    def _max_decoder_positions(self):
+        """Override to bound max_new_tokens (a learned position table
+        would otherwise be CLAMP-gathered under jit — silently wrong
+        tokens past the table, no exception)."""
+        return None
+
+    @no_grad()
+    def generate(self, inputs, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, seed=None):
+        """Greedy/sampling decode; returns [B, max_new_tokens] tokens
+        (eos-padded past the first eos)."""
+        maxpos = self._max_decoder_positions()
+        if maxpos is not None and int(max_new_tokens) > maxpos:
+            raise ValueError(
+                f"generate: max_new_tokens({int(max_new_tokens)}) "
+                f"exceeds the decoder position table ({maxpos})")
+        arr = inputs._data if isinstance(inputs, Tensor) \
+            else jnp.asarray(inputs)
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            arr = arr.astype(jnp.int32)
+        warrs = [t._data for t in self._gen_tensors()]
+        sig = (arr.shape, str(arr.dtype), int(max_new_tokens),
+               bool(do_sample), float(temperature), int(top_k),
+               float(top_p))
+        cache = getattr(self, "_encdec_gen_cache", None)
+        if cache is None:
+            cache = self._encdec_gen_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _encdec_pure, self, int(max_new_tokens), bool(do_sample),
+                float(temperature), int(top_k), float(top_p)))
+            cache[sig] = fn
+        key = _random.next_key() if seed is None else \
+            jax.random.PRNGKey(seed)
+        was_training = getattr(self, "training", False)
+        if was_training:
+            self.eval()
+        try:
+            return Tensor(fn(warrs, arr, key))
+        finally:
+            if was_training:
+                self.train()
+
+
+def _encdec_pure(model, max_new, do_sample, temperature, top_k, top_p,
+                 warrs, inputs, key):
+    tensors = model._gen_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, a in zip(tensors, warrs):
+        t._data = a
+    try:
+        return _encdec_body(model, max_new, do_sample, temperature,
+                            top_k, top_p, inputs, key)
+    finally:
+        for t, a in saved:
+            t._data = a
+
+
+def _encdec_body(model, max_new, do_sample, temperature, top_k, top_p,
+                 inputs, key):
+    spec = model._encdec_spec(Tensor(inputs))
+    blocks = spec["blocks"]
+    eos, start_id = spec["eos"], spec["start"]
+    b = inputs.shape[0]
+
+    enc = spec["encode"]()  # [B, S_enc, D]
+
+    cross = []
+    for blk in blocks:
+        at = blk.cross_attn
+        cross.append((at._heads(enc, at.k)._data,
+                      at._heads(enc, at.v)._data))
+
+    nh = blocks[0].self_attn.nh
+    hd = blocks[0].self_attn.hd
+
+    def dec_step(tok, caches, offset):
+        """One decoder position at absolute `offset` →
+        (logits [B, V], caches)."""
+        x = spec["embed_step"](tok, offset)  # Tensor [B,1,D]
+        total = caches[0][0].shape[1]
+        kpos = jnp.arange(total, dtype=jnp.int32)
+        visible = (kpos <= offset)[None, None, None, :]
+        bias = spec["bias_step"](offset, total)
+        new = []
+        for blk, (ck, cv), (kb, vb) in zip(blocks, caches, cross):
+            at = blk.self_attn
+            y = blk.self_norm(x)
+            scale = getattr(at, "scale", 1.0)
+            q = at._heads(y, at.q)._data * scale  # [B,nh,1,hd]
+            k1 = at._heads(y, at.k)._data
+            v1 = at._heads(y, at.v)._data
+            ck = jax.lax.dynamic_update_slice(
+                ck, jnp.swapaxes(k1, 1, 2), (0, offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, jnp.swapaxes(v1, 1, 2), (0, offset, 0, 0))
+            new.append((ck, cv))
+            sc = jnp.einsum("bhqd,bhkd->bhqk", q,
+                            jnp.swapaxes(ck, 1, 2))
+            if bias is not None:
+                sc = sc + bias
+            sc = jnp.where(visible, sc, -1e9)
+            pr = jax.nn.softmax(sc, axis=-1)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", pr,
+                             jnp.swapaxes(cv, 1, 2))
+            x = x + Tensor(at.o(Tensor(
+                jnp.swapaxes(ctx, 1, 2).reshape(b, 1, nh * hd)))._data)
+            ca = blk.cross_attn
+            y2 = blk.cross_norm(x)
+            q2 = ca._heads(y2, ca.q)._data * getattr(ca, "scale", 1.0)
+            sc2 = jnp.einsum("bhqd,bhkd->bhqk", q2, kb)
+            pr2 = jax.nn.softmax(sc2, axis=-1)
+            ctx2 = jnp.einsum("bhqk,bhkd->bhqd", pr2, vb)
+            x = x + Tensor(ca.o(Tensor(
+                jnp.swapaxes(ctx2, 1, 2).reshape(b, 1, nh * hd)))._data)
+            x = x + blk.ff(blk.ff_norm(x))
+        x = spec["final_norm"](x)
+        return spec["logits"](x)._data[:, 0], new
+
+    caches = [(jnp.zeros((b, max_new, nh, hd), jnp.float32),
+               jnp.zeros((b, max_new, nh, hd), jnp.float32))
+              for _ in blocks]
+
+    start = jnp.full((b,), start_id, jnp.int32)
+    logits, caches = dec_step(start, caches, jnp.asarray(0, jnp.int32))
+    key, sub = jax.random.split(key)
+    tok = _sample_token(logits, sub, do_sample, temperature, top_k, top_p)
+    finished = (tok == eos)
+
+    def step(carry, i):
+        caches, tok, key, finished = carry
+        logits, caches = dec_step(tok, caches, i + 1)
+        key, sub = jax.random.split(key)
+        nxt = _sample_token(logits, sub, do_sample, temperature, top_k,
+                            top_p)
+        nxt = jnp.where(finished, jnp.asarray(eos, jnp.int32), nxt)
+        finished = finished | (nxt == eos)
+        return (caches, nxt, key, finished), tok
+
+    (caches, tok, key, finished), toks = jax.lax.scan(
+        step, (caches, tok, key, finished),
+        jnp.arange(max_new - 1, dtype=jnp.int32))
+    return jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],
+                           axis=1)

@@ -28,19 +28,15 @@ TPU-first notes:
 """
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 
-import jax
 import jax.numpy as jnp
 
 import paddle_tpu as P
 from ..core.tensor import Tensor
-from ..core.autograd import no_grad
 from ..nn import Dropout, Embedding, Layer, LayerList, Linear, RMSNorm
 from ..nn import functional as F
-from .generation import _sample_token
-from ..core import random as _random
+from .encdec import EncDecGenerationMixin
 
 __all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration"]
 
@@ -237,7 +233,7 @@ class T5Model(Layer):
         return self.decoder(decoder_input_ids, enc=enc), enc
 
 
-class T5ForConditionalGeneration(Layer):
+class T5ForConditionalGeneration(Layer, EncDecGenerationMixin):
     def __init__(self, cfg: T5Config):
         super().__init__()
         self.cfg = cfg
@@ -265,146 +261,22 @@ class T5ForConditionalGeneration(Layer):
             labels.reshape([-1]), ignore_index=self.cfg.pad_token_id)
         return loss, logits
 
-    # -- compiled encoder-decoder generation ---------------------------
-    def _gen_tensors(self):
-        return [p for _, p in self.named_parameters()]
+    # -- compiled encoder-decoder generation (models/encdec.py) --------
+    def _encdec_spec(self, inputs):
+        dec = self.t5.decoder
+        bias_attn = dec.block[0].self_attn  # layer-0 bucket table
 
-    @no_grad()
-    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 temperature=1.0, top_k=0, top_p=1.0, seed=None):
-        """Greedy/sampling encoder-decoder generation.
+        def bias_step(offset, total):
+            return bias_attn.compute_bias(1, total, q_offset=offset)._data
 
-        Returns [B, max_new_tokens] decoder tokens (eos-padded). One
-        jitted program: encoder pass + cross-K/V precompute + prefill on
-        the start token + lax.scan decode with static self-attn caches.
-        """
-        ids = input_ids._data if isinstance(input_ids, Tensor) \
-            else jnp.asarray(input_ids)
-        ids = ids.astype(jnp.int32)
-        b, s_enc = ids.shape
-        warrs = [t._data for t in self._gen_tensors()]
-        sig = (b, s_enc, int(max_new_tokens), bool(do_sample),
-               float(temperature), int(top_k), float(top_p))
-        cache = getattr(self, "_t5_gen_cache", None)
-        if cache is None:
-            cache = self._t5_gen_cache = {}
-        fn = cache.get(sig)
-        if fn is None:
-            fn = jax.jit(functools.partial(
-                _t5_generate_pure, self, int(max_new_tokens),
-                bool(do_sample), float(temperature), int(top_k),
-                float(top_p)))
-            cache[sig] = fn
-        key = _random.next_key() if seed is None else \
-            jax.random.PRNGKey(seed)
-        was_training = getattr(self, "training", False)
-        if was_training:
-            self.eval()
-        try:
-            return Tensor(fn(warrs, ids, key))
-        finally:
-            if was_training:
-                self.train()
-
-
-def _t5_generate_pure(model, max_new, do_sample, temperature, top_k,
-                      top_p, warrs, ids, key):
-    tensors = model._gen_tensors()
-    saved = [(t, t._data) for t in tensors]
-    for t, arr in zip(tensors, warrs):
-        t._data = arr
-    try:
-        return _t5_generate_body(model, max_new, do_sample, temperature,
-                                 top_k, top_p, ids, key)
-    finally:
-        for t, arr in saved:
-            t._data = arr
-
-
-def _t5_generate_body(model, max_new, do_sample, temperature, top_k,
-                      top_p, ids, key):
-    cfg = model.cfg
-    b = ids.shape[0]
-    nh, hd = cfg.num_heads, cfg.d_kv
-    eos = cfg.eos_token_id
-    dec_blocks = model.t5.decoder.block
-
-    enc = model.t5.encoder(Tensor(ids))  # [B, S_enc, D]
-
-    # cross-attention K/V once per layer
-    cross = []
-    for blk in dec_blocks:
-        at = blk.cross_attn
-        cross.append((at._heads(enc, at.k)._data,
-                      at._heads(enc, at.v)._data))
-
-    bias_attn = dec_blocks[0].self_attn  # layer-0 bucket table
-
-    def dec_step(tok, caches, offset):
-        """One decoder position: tok [B] at absolute `offset`.
-        Returns (logits [B, V], caches)."""
-        x = model.t5.decoder.embed(Tensor(tok[:, None]))  # [B,1,D]
-        kpos = jnp.arange(caches[0][0].shape[1], dtype=jnp.int32)
-        visible = (kpos <= offset)[None, None, None, :]
-        bias = bias_attn.compute_bias(1, caches[0][0].shape[1],
-                                      q_offset=offset)._data
-        new = []
-        for blk, (ck, cv), (kb, vb) in zip(dec_blocks, caches, cross):
-            at = blk.self_attn
-            y = blk.self_norm(x)
-            q = at._heads(y, at.q)._data  # [B,nh,1,hd]
-            k1 = at._heads(y, at.k)._data
-            v1 = at._heads(y, at.v)._data
-            kb_s = jax.lax.dynamic_update_slice(
-                ck, jnp.swapaxes(k1, 1, 2), (0, offset, 0, 0))
-            vb_s = jax.lax.dynamic_update_slice(
-                cv, jnp.swapaxes(v1, 1, 2), (0, offset, 0, 0))
-            new.append((kb_s, vb_s))
-            ks = jnp.swapaxes(kb_s, 1, 2)  # [B,nh,T,hd]
-            vs = jnp.swapaxes(vb_s, 1, 2)
-            sc = jnp.einsum("bhqd,bhkd->bhqk", q, ks) + bias
-            sc = jnp.where(visible, sc, -1e9)
-            pr = jax.nn.softmax(sc, axis=-1)
-            ctx = jnp.einsum("bhqk,bhkd->bhqd", pr, vs)
-            x = x + Tensor(at.o(Tensor(
-                jnp.swapaxes(ctx, 1, 2).reshape(b, 1, nh * hd)))._data)
-            # cross-attention (precomputed K/V; full visibility)
-            ca = blk.cross_attn
-            y2 = blk.cross_norm(x)
-            q2 = ca._heads(y2, ca.q)._data
-            sc2 = jnp.einsum("bhqd,bhkd->bhqk", q2, kb)
-            pr2 = jax.nn.softmax(sc2, axis=-1)
-            ctx2 = jnp.einsum("bhqk,bhkd->bhqd", pr2, vb)
-            x = x + Tensor(ca.o(Tensor(
-                jnp.swapaxes(ctx2, 1, 2).reshape(b, 1, nh * hd)))._data)
-            x = x + blk.ff(blk.ff_norm(x))
-        x = model.t5.decoder.final_layer_norm(x)
-        logits = model._logits(x)._data[:, 0]
-        return logits, new
-
-    total = max_new  # decoder positions 0..max_new-1
-    caches = [(jnp.zeros((b, total, nh, hd), jnp.float32),
-               jnp.zeros((b, total, nh, hd), jnp.float32))
-              for _ in dec_blocks]
-
-    start = jnp.full((b,), cfg.decoder_start_token_id, jnp.int32)
-    logits, caches = dec_step(start, caches, jnp.asarray(0, jnp.int32))
-    key, sub = jax.random.split(key)
-    tok = _sample_token(logits, sub, do_sample, temperature, top_k, top_p)
-    finished = (tok == eos)
-
-    def step(carry, i):
-        caches, tok, key, finished = carry
-        logits, caches = dec_step(tok, caches, i + 1)
-        key, sub = jax.random.split(key)
-        nxt = _sample_token(logits, sub, do_sample, temperature, top_k,
-                            top_p)
-        nxt = jnp.where(finished, jnp.asarray(eos, jnp.int32), nxt)
-        finished = finished | (nxt == eos)
-        return (caches, nxt, key, finished), tok
-
-    (caches, tok, key, finished), toks = jax.lax.scan(
-        step, (caches, tok, key, finished),
-        jnp.arange(max_new - 1, dtype=jnp.int32))
-    return jnp.concatenate([jnp.swapaxes(toks, 0, 1), tok[:, None]],
-                           axis=1)
+        return {
+            "encode": lambda: self.t5.encoder(inputs),
+            "blocks": dec.block,
+            "embed_step": lambda tok, offset: dec.embed(
+                Tensor(tok[:, None])),
+            "bias_step": bias_step,
+            "final_norm": dec.final_layer_norm,
+            "logits": self._logits,
+            "eos": self.cfg.eos_token_id,
+            "start": self.cfg.decoder_start_token_id,
+        }

@@ -1,0 +1,256 @@
+"""Whisper speech-recognition family (audio encoder-decoder).
+
+Reference surface: the Paddle-ecosystem Whisper (upstream PaddleSpeech
+paddlespeech/s2t/models/whisper/, unverified — see SURVEY.md §2.2 "Misc
+domains"): log-mel features → two 1-D convs (the second stride-2) →
+pre-LN transformer encoder with fixed sinusoidal positions, and a
+pre-LN decoder with learned positions, causal self-attention,
+cross-attention, and an LM head tied to the token embedding. Attention
+scales q by d_head**-0.5; k projections carry no bias. Parity is tested
+against the `transformers` torch implementation by weight transplant
+(tests/test_models_whisper.py) — encoder states, teacher-forced logits,
+and greedy generation token-for-token.
+
+TPU-first notes:
+- The mel front-end pairs with paddle_tpu.audio.features (log-mel
+  spectrograms) — an end-to-end audio→token path on-device.
+- Convs are Conv1D over [B, mels, T] (NCL): XLA lowers stride-2 k=3
+  convs to MXU-friendly contractions at Whisper widths.
+- generate() rides the shared compiled encoder-decoder decode loop
+  (models/encdec.py): one jitted program, weights as arguments, static
+  absolute-offset KV caches, cross-K/V precomputed once.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as P
+from ..core.tensor import Tensor
+from ..nn import (Conv1D, Dropout, Embedding, GELU, Layer, LayerList,
+                  LayerNorm, Linear)
+from ..nn import functional as F
+from .encdec import EncDecGenerationMixin
+
+__all__ = ["WhisperConfig", "WhisperModel",
+           "WhisperForConditionalGeneration"]
+
+
+@dataclass
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384          # whisper-tiny
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    encoder_attention_heads: int = 6
+    decoder_attention_heads: int = 6
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    pad_token_id: int = 50256
+    eos_token_id: int = 50256
+    decoder_start_token_id: int = 50257
+
+    @staticmethod
+    def tiny(**kw):
+        return WhisperConfig(**{**dict(
+            vocab_size=128, num_mel_bins=16, d_model=64,
+            encoder_layers=2, decoder_layers=2,
+            encoder_attention_heads=4, decoder_attention_heads=4,
+            encoder_ffn_dim=128, decoder_ffn_dim=128,
+            max_source_positions=50, max_target_positions=32,
+            pad_token_id=0, eos_token_id=1,
+            decoder_start_token_id=2), **kw})
+
+
+def _sinusoids(length, channels):
+    """Fixed sinusoidal table (reference encoder positions)."""
+    log_timescale = np.log(10000) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    t = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1).astype(
+        np.float32)
+
+
+class WhisperAttention(Layer):
+    """Scaled MHA; k projection has no bias (reference convention)."""
+
+    def __init__(self, d, nh):
+        super().__init__()
+        self.nh = nh
+        self.hd = d // nh
+        self.scale = self.hd ** -0.5
+        self.q = Linear(d, d)
+        self.k = Linear(d, d, bias_attr=False)
+        self.v = Linear(d, d)
+        self.o = Linear(d, d)
+
+    def _heads(self, x, proj):
+        b, s = x.shape[0], x.shape[1]
+        return proj(x).reshape([b, s, self.nh, self.hd]).transpose(
+            [0, 2, 1, 3])
+
+    def forward(self, x, kv=None, causal=False):
+        b, sq = x.shape[0], x.shape[1]
+        src = x if kv is None else kv
+        # sdpa applies the 1/sqrt(hd) scaling — exactly `scale`
+        ctx = F.scaled_dot_product_attention(
+            self.q(x).reshape([b, sq, self.nh, self.hd]),
+            self.k(src).reshape([b, src.shape[1], self.nh, self.hd]),
+            self.v(src).reshape([b, src.shape[1], self.nh, self.hd]),
+            is_causal=causal, training=self.training)
+        return self.o(ctx.reshape([b, sq, self.nh * self.hd]))
+
+
+class WhisperEncoderLayer(Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d, eps = cfg.d_model, cfg.layer_norm_eps
+        self.self_norm = LayerNorm(d, eps)
+        self.self_attn = WhisperAttention(d, cfg.encoder_attention_heads)
+        self.ff_norm = LayerNorm(d, eps)
+        self.fc1 = Linear(d, cfg.encoder_ffn_dim)
+        self.fc2 = Linear(cfg.encoder_ffn_dim, d)
+        self.act = GELU()
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.self_attn(self.self_norm(x)))
+        return x + self.dropout(self.fc2(self.act(
+            self.fc1(self.ff_norm(x)))))
+
+
+class WhisperDecoderLayer(Layer):
+    """Protocol-compatible with models/encdec.py (self_norm/self_attn/
+    cross_norm/cross_attn/ff_norm/ff)."""
+
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d, eps = cfg.d_model, cfg.layer_norm_eps
+        self.self_norm = LayerNorm(d, eps)
+        self.self_attn = WhisperAttention(d, cfg.decoder_attention_heads)
+        self.cross_norm = LayerNorm(d, eps)
+        self.cross_attn = WhisperAttention(d,
+                                           cfg.decoder_attention_heads)
+        self.ff_norm = LayerNorm(d, eps)
+        self._fc1 = Linear(d, cfg.decoder_ffn_dim)
+        self._fc2 = Linear(cfg.decoder_ffn_dim, d)
+        self._act = GELU()
+        self.dropout = Dropout(cfg.dropout)
+
+    def ff(self, x):
+        return self._fc2(self._act(self._fc1(x)))
+
+    def forward(self, x, enc):
+        x = x + self.dropout(self.self_attn(self.self_norm(x),
+                                            causal=True))
+        x = x + self.dropout(self.cross_attn(self.cross_norm(x), kv=enc))
+        return x + self.dropout(self.ff(self.ff_norm(x)))
+
+
+class WhisperEncoder(Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.conv1 = Conv1D(cfg.num_mel_bins, d, 3, padding=1)
+        self.conv2 = Conv1D(d, d, 3, stride=2, padding=1)
+        self.act = GELU()
+        # fixed sinusoidal positions, stored as a (frozen) parameter so
+        # transplant/state_dict round-trips match the reference layout
+        self.embed_positions = self.create_parameter(
+            (cfg.max_source_positions, d))
+        self.embed_positions.set_value(P.to_tensor(
+            _sinusoids(cfg.max_source_positions, d)))
+        self.embed_positions.stop_gradient = True
+        self.layers = LayerList([WhisperEncoderLayer(cfg)
+                                 for _ in range(cfg.encoder_layers)])
+        self.layer_norm = LayerNorm(d, cfg.layer_norm_eps)
+
+    def forward(self, input_features):
+        # [B, mels, T] -> [B, T//2, D]
+        x = self.act(self.conv1(input_features))
+        x = self.act(self.conv2(x))
+        x = x.transpose([0, 2, 1])
+        x = x + self.embed_positions[:x.shape[1]]
+        for layer in self.layers:
+            x = layer(x)
+        return self.layer_norm(x)
+
+
+class WhisperDecoder(Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        d = cfg.d_model
+        self.embed_tokens = Embedding(cfg.vocab_size, d)
+        self.embed_positions = self.create_parameter(
+            (cfg.max_target_positions, d))
+        self.layers = LayerList([WhisperDecoderLayer(cfg)
+                                 for _ in range(cfg.decoder_layers)])
+        self.layer_norm = LayerNorm(d, cfg.layer_norm_eps)
+
+    def forward(self, input_ids, enc):
+        s = input_ids.shape[1]
+        x = self.embed_tokens(input_ids) + self.embed_positions[:s]
+        for layer in self.layers:
+            x = layer(x, enc)
+        return self.layer_norm(x)
+
+
+class WhisperModel(Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.encoder = WhisperEncoder(cfg)
+        self.decoder = WhisperDecoder(cfg)
+
+    def forward(self, input_features, decoder_input_ids):
+        enc = self.encoder(input_features)
+        return self.decoder(decoder_input_ids, enc), enc
+
+
+class WhisperForConditionalGeneration(Layer, EncDecGenerationMixin):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = WhisperModel(cfg)
+
+    def _logits(self, dec):
+        # tied head, no scaling (reference convention)
+        return P.matmul(dec, self.model.decoder.embed_tokens.weight.t())
+
+    def forward(self, input_features, decoder_input_ids, labels=None):
+        dec, _ = self.model(input_features, decoder_input_ids)
+        logits = self._logits(dec)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+        return loss, logits
+
+    def _max_decoder_positions(self):
+        return self.cfg.max_target_positions
+
+    def _encdec_spec(self, inputs):
+        dec = self.model.decoder
+
+        def embed_step(tok, offset):
+            x = dec.embed_tokens(Tensor(tok[:, None]))
+            pos = Tensor(dec.embed_positions._data[offset][None, None])
+            return x + pos
+
+        return {
+            "encode": lambda: self.model.encoder(inputs),
+            "blocks": dec.layers,
+            "embed_step": embed_step,
+            "bias_step": lambda offset, total: None,
+            "final_norm": dec.layer_norm,
+            "logits": self._logits,
+            "eos": self.cfg.eos_token_id,
+            "start": self.cfg.decoder_start_token_id,
+        }
