@@ -11,3 +11,5 @@ from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
                  T5Model)
 from .whisper import (WhisperConfig, WhisperModel,  # noqa: F401
                       WhisperForConditionalGeneration)
+from .clip import (CLIPConfig, CLIPModel, CLIPTextConfig,  # noqa: F401
+                   CLIPVisionConfig, clip_loss)

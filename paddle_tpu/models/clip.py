@@ -1,0 +1,234 @@
+"""CLIP vision-language family (contrastive image-text pretraining).
+
+Reference surface: the Paddle-ecosystem CLIP (upstream PaddleMIX
+paddlemix/models/clip/, unverified — see SURVEY.md §2.2 "Misc
+domains"): a ViT image tower (class embedding + conv patch embed
+without bias + learned positions + pre-LN encoder + post-LN on the CLS
+pooled state) and a causal text tower (token + learned positions,
+pre-LN encoder, final LN, pooled at the first eos position), projected
+into a shared space by bias-free linears, with a learnable temperature
+`logit_scale`. QuickGELU (x·σ(1.702x)) activations — the original CLIP
+nonlinearity, distinct from tanh-approx GELU. Parity is tested against
+the `transformers` torch implementation by weight transplant
+(tests/test_models_clip.py): both towers' pooled features and the
+similarity logits.
+
+TPU-first notes:
+- Both towers are single XLA programs of MXU-shaped matmuls; the
+  contrastive InfoNCE loss (`clip_loss`) is one [B, B] logits matmul +
+  two cross-entropies — on a device mesh the feature all_gather
+  composes with data parallel exactly like the reference's global
+  batch.
+- Image and text towers share one encoder-layer implementation; the
+  causal text mask is a static additive constant folded by XLA.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from ..core.tensor import Tensor
+from ..nn import Conv2D, Embedding, Layer, LayerList, LayerNorm, Linear
+from ..nn import functional as F
+
+__all__ = ["CLIPConfig", "CLIPTextConfig", "CLIPVisionConfig",
+           "CLIPModel", "clip_loss"]
+
+
+@dataclass
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 49407
+
+
+@dataclass
+class CLIPVisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+
+
+@dataclass
+class CLIPConfig:
+    text_config: CLIPTextConfig = field(default_factory=CLIPTextConfig)
+    vision_config: CLIPVisionConfig = field(
+        default_factory=CLIPVisionConfig)
+    projection_dim: int = 512
+    logit_scale_init_value: float = 2.6592
+
+    @staticmethod
+    def tiny(**kw):
+        return CLIPConfig(
+            text_config=CLIPTextConfig(
+                vocab_size=99, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=24, eos_token_id=98),
+            vision_config=CLIPVisionConfig(
+                hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                image_size=32, patch_size=8),
+            projection_dim=32, **kw)
+
+
+def quick_gelu(x):
+    """x * sigmoid(1.702 x) — the original CLIP activation."""
+    return x * F.sigmoid(1.702 * x)
+
+
+class CLIPAttention(Layer):
+    def __init__(self, d, nh):
+        super().__init__()
+        self.nh = nh
+        self.hd = d // nh
+        self.q = Linear(d, d)
+        self.k = Linear(d, d)
+        self.v = Linear(d, d)
+        self.o = Linear(d, d)
+
+    def forward(self, x, causal=False):
+        b, s = x.shape[0], x.shape[1]
+        # fused QKV: one [d, 3d] matmul (house pattern — models/bert.py)
+        # while keeping the reference per-projection state_dict layout
+        qkv_w = P.concat([self.q.weight, self.k.weight, self.v.weight],
+                         axis=1)
+        qkv_b = P.concat([self.q.bias, self.k.bias, self.v.bias])
+        qkv = F.linear(x, qkv_w, qkv_b).reshape([b, s, 3, self.nh,
+                                                 self.hd])
+        ctx = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+            is_causal=causal, training=self.training)
+        return self.o(ctx.reshape([b, s, self.nh * self.hd]))
+
+
+class CLIPEncoderLayer(Layer):
+    """Shared by both towers (pre-LN, QuickGELU MLP)."""
+
+    def __init__(self, d, nh, ffn, eps):
+        super().__init__()
+        self.layer_norm1 = LayerNorm(d, eps)
+        self.self_attn = CLIPAttention(d, nh)
+        self.layer_norm2 = LayerNorm(d, eps)
+        self.fc1 = Linear(d, ffn)
+        self.fc2 = Linear(ffn, d)
+
+    def forward(self, x, causal=False):
+        x = x + self.self_attn(self.layer_norm1(x), causal=causal)
+        return x + self.fc2(quick_gelu(self.fc1(self.layer_norm2(x))))
+
+
+class CLIPVisionTower(Layer):
+    def __init__(self, cfg: CLIPVisionConfig):
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.hidden_size
+        self.class_embedding = self.create_parameter((d,))
+        self.patch_embedding = Conv2D(cfg.num_channels, d,
+                                      cfg.patch_size,
+                                      stride=cfg.patch_size,
+                                      bias_attr=False)
+        n = (cfg.image_size // cfg.patch_size) ** 2 + 1
+        self.position_embedding = Embedding(n, d)
+        self.pre_layernorm = LayerNorm(d, cfg.layer_norm_eps)
+        self.layers = LayerList([
+            CLIPEncoderLayer(d, cfg.num_attention_heads,
+                             cfg.intermediate_size, cfg.layer_norm_eps)
+            for _ in range(cfg.num_hidden_layers)])
+        self.post_layernorm = LayerNorm(d, cfg.layer_norm_eps)
+
+    def forward(self, pixel_values):
+        x = self.patch_embedding(pixel_values)
+        b, d = x.shape[0], x.shape[1]
+        x = x.reshape([b, d, -1]).transpose([0, 2, 1])
+        cls = P.expand(self.class_embedding.reshape([1, 1, d]),
+                       [b, 1, d])
+        x = P.concat([cls, x], axis=1)
+        x = x + self.position_embedding.weight[:x.shape[1]]
+        x = self.pre_layernorm(x)
+        for layer in self.layers:
+            x = layer(x)
+        return self.post_layernorm(x[:, 0])  # pooled CLS
+
+
+class CLIPTextTower(Layer):
+    def __init__(self, cfg: CLIPTextConfig):
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.hidden_size
+        self.token_embedding = Embedding(cfg.vocab_size, d)
+        self.position_embedding = Embedding(cfg.max_position_embeddings,
+                                            d)
+        self.layers = LayerList([
+            CLIPEncoderLayer(d, cfg.num_attention_heads,
+                             cfg.intermediate_size, cfg.layer_norm_eps)
+            for _ in range(cfg.num_hidden_layers)])
+        self.final_layer_norm = LayerNorm(d, cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        x = (self.token_embedding(input_ids)
+             + self.position_embedding.weight[:s])
+        for layer in self.layers:
+            x = layer(x, causal=True)
+        x = self.final_layer_norm(x)
+        # pooled at the FIRST eos position (reference convention)
+        ids = input_ids._data
+        eos_pos = jnp.argmax(
+            (ids == self.cfg.eos_token_id).astype(jnp.int32), axis=-1)
+        b = x.shape[0]
+        return x[P.to_tensor(jnp.arange(b)), P.to_tensor(eos_pos)]
+
+
+class CLIPModel(Layer):
+    def __init__(self, cfg: CLIPConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.vision_model = CLIPVisionTower(cfg.vision_config)
+        self.text_model = CLIPTextTower(cfg.text_config)
+        self.visual_projection = Linear(cfg.vision_config.hidden_size,
+                                        cfg.projection_dim,
+                                        bias_attr=False)
+        self.text_projection = Linear(cfg.text_config.hidden_size,
+                                      cfg.projection_dim,
+                                      bias_attr=False)
+        self.logit_scale = self.create_parameter((1,))
+        self.logit_scale.set_value(P.full(
+            [1], cfg.logit_scale_init_value))
+
+    def get_image_features(self, pixel_values):
+        return self.visual_projection(self.vision_model(pixel_values))
+
+    def get_text_features(self, input_ids):
+        return self.text_projection(self.text_model(input_ids))
+
+    def forward(self, input_ids, pixel_values):
+        """Returns (logits_per_image [Bi, Bt], logits_per_text
+        [Bt, Bi]) at the learned temperature."""
+        img = self.get_image_features(pixel_values)
+        txt = self.get_text_features(input_ids)
+        img = img / P.norm(img, axis=-1, keepdim=True)
+        txt = txt / P.norm(txt, axis=-1, keepdim=True)
+        scale = P.exp(self.logit_scale)
+        logits_per_text = P.matmul(txt, img.t()) * scale
+        return logits_per_text.t(), logits_per_text
+
+
+def clip_loss(logits_per_text):
+    """Symmetric InfoNCE over the in-batch similarity matrix."""
+    n = logits_per_text.shape[0]
+    labels = P.to_tensor(jnp.arange(n))
+    t = F.cross_entropy(logits_per_text, labels)
+    i = F.cross_entropy(logits_per_text.t(), labels)
+    return 0.5 * (t + i)

@@ -1,0 +1,157 @@
+"""CLIP family parity vs the `transformers` torch oracle (weight
+transplant — same strategy as tests/test_models_vit_t5.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _t(a):
+    return P.to_tensor(np.asarray(a.detach().numpy()))
+
+
+def _set(p, a):
+    p.set_value(_t(a))
+
+
+def _tiny_hf():
+    from transformers import CLIPConfig as HFConfig, CLIPModel
+    cfg = HFConfig(
+        text_config=dict(vocab_size=99, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=24, eos_token_id=98,
+                         pad_token_id=0, bos_token_id=97),
+        vision_config=dict(hidden_size=64, intermediate_size=128,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           image_size=32, patch_size=8),
+        projection_dim=32)
+    torch.manual_seed(3)
+    return CLIPModel(cfg).eval()
+
+
+def _copy_layer(oo, ho):
+    at = ho.self_attn
+    _set(oo.self_attn.q.weight, at.q_proj.weight.T)
+    _set(oo.self_attn.q.bias, at.q_proj.bias)
+    _set(oo.self_attn.k.weight, at.k_proj.weight.T)
+    _set(oo.self_attn.k.bias, at.k_proj.bias)
+    _set(oo.self_attn.v.weight, at.v_proj.weight.T)
+    _set(oo.self_attn.v.bias, at.v_proj.bias)
+    _set(oo.self_attn.o.weight, at.out_proj.weight.T)
+    _set(oo.self_attn.o.bias, at.out_proj.bias)
+    _set(oo.layer_norm1.weight, ho.layer_norm1.weight)
+    _set(oo.layer_norm1.bias, ho.layer_norm1.bias)
+    _set(oo.layer_norm2.weight, ho.layer_norm2.weight)
+    _set(oo.layer_norm2.bias, ho.layer_norm2.bias)
+    _set(oo.fc1.weight, ho.mlp.fc1.weight.T)
+    _set(oo.fc1.bias, ho.mlp.fc1.bias)
+    _set(oo.fc2.weight, ho.mlp.fc2.weight.T)
+    _set(oo.fc2.bias, ho.mlp.fc2.bias)
+
+
+def _transplant(hf):
+    from paddle_tpu.models.clip import CLIPConfig, CLIPModel
+    ours = CLIPModel(CLIPConfig.tiny())
+    ours.eval()
+    v_o, v_h = ours.vision_model, hf.vision_model
+    v_o.class_embedding.set_value(_t(v_h.embeddings.class_embedding))
+    _set(v_o.patch_embedding.weight,
+         v_h.embeddings.patch_embedding.weight)
+    _set(v_o.position_embedding.weight,
+         v_h.embeddings.position_embedding.weight)
+    _set(v_o.pre_layernorm.weight, v_h.pre_layrnorm.weight)
+    _set(v_o.pre_layernorm.bias, v_h.pre_layrnorm.bias)
+    for oo, ho in zip(v_o.layers, v_h.encoder.layers):
+        _copy_layer(oo, ho)
+    _set(v_o.post_layernorm.weight, v_h.post_layernorm.weight)
+    _set(v_o.post_layernorm.bias, v_h.post_layernorm.bias)
+
+    t_o, t_h = ours.text_model, hf.text_model
+    _set(t_o.token_embedding.weight,
+         t_h.embeddings.token_embedding.weight)
+    _set(t_o.position_embedding.weight,
+         t_h.embeddings.position_embedding.weight)
+    for oo, ho in zip(t_o.layers, t_h.encoder.layers):
+        _copy_layer(oo, ho)
+    _set(t_o.final_layer_norm.weight, t_h.final_layer_norm.weight)
+    _set(t_o.final_layer_norm.bias, t_h.final_layer_norm.bias)
+
+    _set(ours.visual_projection.weight, hf.visual_projection.weight.T)
+    _set(ours.text_projection.weight, hf.text_projection.weight.T)
+    ours.logit_scale.set_value(_t(hf.logit_scale.reshape(1)))
+    return ours
+
+
+def _batch(rng, b=3):
+    px = rng.standard_normal((b, 3, 32, 32)).astype(np.float32)
+    ids = np.concatenate(
+        [np.full((b, 1), 97), rng.integers(1, 97, (b, 8)),
+         np.full((b, 1), 98), np.zeros((b, 2))], axis=1).astype(np.int64)
+    return px, ids
+
+
+class TestCLIPParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        hf = _tiny_hf()
+        return hf, _transplant(hf)
+
+    def test_image_features_match_oracle(self, pair):
+        hf, ours = pair
+        px, _ = _batch(np.random.default_rng(0))
+        with torch.no_grad():
+            ref = hf.get_image_features(torch.tensor(px)).numpy()
+        got = np.asarray(ours.get_image_features(P.to_tensor(px))._data)
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=1e-3)
+
+    def test_text_features_match_oracle(self, pair):
+        hf, ours = pair
+        _, ids = _batch(np.random.default_rng(1))
+        with torch.no_grad():
+            ref = hf.get_text_features(torch.tensor(ids)).numpy()
+        got = np.asarray(ours.get_text_features(
+            P.to_tensor(ids.astype(np.int32)))._data)
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=1e-3)
+
+    def test_similarity_logits_match_oracle(self, pair):
+        hf, ours = pair
+        px, ids = _batch(np.random.default_rng(2))
+        with torch.no_grad():
+            out = hf(input_ids=torch.tensor(ids),
+                     pixel_values=torch.tensor(px))
+            ref_i = out.logits_per_image.numpy()
+            ref_t = out.logits_per_text.numpy()
+        li, lt = ours(P.to_tensor(ids.astype(np.int32)),
+                      P.to_tensor(px))
+        np.testing.assert_allclose(np.asarray(li._data), ref_i,
+                                   atol=3e-4, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(lt._data), ref_t,
+                                   atol=3e-4, rtol=1e-3)
+
+    def test_contrastive_training_decreases_loss(self):
+        # fresh model: training must not mutate the class-scoped
+        # transplanted fixture the parity tests compare to the oracle
+        from paddle_tpu.models.clip import (CLIPConfig, CLIPModel,
+                                            clip_loss)
+        from paddle_tpu.optimizer import AdamW
+        ours = CLIPModel(CLIPConfig.tiny())
+        ours.train()
+        opt = AdamW(learning_rate=1e-3, parameters=ours.parameters())
+        rng = np.random.default_rng(3)
+        px, ids = _batch(rng, b=4)
+        pxt = P.to_tensor(px)
+        idt = P.to_tensor(ids.astype(np.int32))
+        losses = []
+        for _ in range(8):
+            _, lt = ours(idt, pxt)
+            loss = clip_loss(lt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+        ours.eval()
