@@ -22,3 +22,5 @@ from .squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .vit import (VisionTransformer, ViTConfig, vit_b_16,  # noqa: F401
                   vit_b_32, vit_l_16, vit_h_14)
+from .swin import (SwinTransformer, SwinConfig, swin_t,  # noqa: F401
+                   swin_s, swin_b)

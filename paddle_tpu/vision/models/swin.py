@@ -1,0 +1,294 @@
+"""Swin Transformer family (hierarchical shifted-window attention).
+
+Reference surface: the Paddle-ecosystem Swin (upstream PaddleClas
+ppcls/arch/backbone/model_zoo/swin_transformer.py, unverified — see
+SURVEY.md §2.2 "Vision"): 4-stage hierarchy (patch merging halves the
+grid and doubles channels), W×W windowed attention with a learned
+relative-position-bias table, and a cyclic-shift on every second block
+whose cross-region pairs are masked. Parity is tested against the
+`transformers` torch implementation by weight transplant
+(tests/test_models_swin.py).
+
+TPU-first notes:
+- Window partitioning is pure STATIC reshapes/transposes ([B, H/w, w,
+  W/w, w, C] → [B·nW, w², C]) — no gather, no dynamic shapes; XLA fuses
+  them into the surrounding matmuls' layouts.
+- The shifted-window attention mask and the relative-position index are
+  compile-time numpy constants (per stage resolution), so the whole
+  forward is one XLA program with only MXU matmuls and elementwise ops.
+- The cyclic shift is jnp.roll (lax.concatenate of two slices) — cheap
+  on TPU, differentiable, and shape-preserving.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as P
+from ...nn import Dropout, GELU, Layer, LayerList, LayerNorm, Linear
+from ...nn import functional as F
+from ...nn.conv import Conv2D
+
+__all__ = ["SwinTransformer", "SwinConfig", "swin_t", "swin_s", "swin_b"]
+
+
+@dataclass
+class SwinConfig:
+    image_size: int = 224
+    patch_size: int = 4
+    num_channels: int = 3
+    embed_dim: int = 96
+    depths: tuple = (2, 2, 6, 2)
+    num_heads: tuple = (3, 6, 12, 24)
+    window_size: int = 7
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    num_classes: int = 1000
+
+    @staticmethod
+    def tiny(**kw):
+        return SwinConfig(**{**dict(
+            image_size=32, patch_size=4, embed_dim=32, depths=(2, 2),
+            num_heads=(2, 4), window_size=4, mlp_ratio=2.0,
+            num_classes=10), **kw})
+
+
+def _rel_index(w):
+    """[w², w²] int index into the (2w-1)² relative-bias table."""
+    coords = np.stack(np.meshgrid(np.arange(w), np.arange(w),
+                                  indexing="ij")).reshape(2, -1)
+    rel = (coords[:, :, None] - coords[:, None, :]).transpose(1, 2, 0)
+    rel = rel + np.array([w - 1, w - 1])
+    return (rel[..., 0] * (2 * w - 1) + rel[..., 1]).astype(np.int32)
+
+
+def _shift_mask(h, w_grid, w, s):
+    """[nW, w², w²] additive mask (-100 across shifted-region pairs)."""
+    img = np.zeros((h, w_grid), np.int32)
+    cnt = 0
+    for hs in (slice(0, -w), slice(-w, -s), slice(-s, None)):
+        for ws in (slice(0, -w), slice(-w, -s), slice(-s, None)):
+            img[hs, ws] = cnt
+            cnt += 1
+    m = img.reshape(h // w, w, w_grid // w, w).transpose(
+        0, 2, 1, 3).reshape(-1, w * w)
+    return np.where(m[:, None, :] != m[:, :, None], -100.0,
+                    0.0).astype(np.float32)
+
+
+def _partition(x, w):
+    """[B, H, W, C] -> [B·nW, w², C] (static reshapes only)."""
+    b, h, wg, c = x.shape
+    x = x.reshape([b, h // w, w, wg // w, w, c])
+    x = x.transpose([0, 1, 3, 2, 4, 5])
+    return x.reshape([-1, w * w, c])
+
+
+def _unpartition(x, w, h, wg):
+    """[B·nW, w², C] -> [B, H, W, C]."""
+    c = x.shape[-1]
+    x = x.reshape([-1, h // w, wg // w, w, w, c])
+    x = x.transpose([0, 1, 3, 2, 4, 5])
+    return x.reshape([-1, h, wg, c])
+
+
+class WindowAttention(Layer):
+    def __init__(self, d, nh, w):
+        super().__init__()
+        self.nh = nh
+        self.hd = d // nh
+        self.w = w
+        self.query = Linear(d, d)
+        self.key = Linear(d, d)
+        self.value = Linear(d, d)
+        self.proj = Linear(d, d)
+        self.relative_position_bias_table = self.create_parameter(
+            ((2 * w - 1) ** 2, nh))
+        self._rel_idx = _rel_index(w).reshape(-1)  # static constant
+
+    def _bias(self):
+        """[1, nh, w², w²] gathered from the learned table."""
+        tbl = self.relative_position_bias_table
+        flat = tbl[P.to_tensor(self._rel_idx)]  # [w⁴, nh]
+        w2 = self.w * self.w
+        return flat.reshape([w2, w2, self.nh]).transpose(
+            [2, 0, 1]).unsqueeze(0)
+
+    def forward(self, x, mask=None):
+        """x [Bw, w², C]; mask [nW, w², w²] additive or None."""
+        bw, n = x.shape[0], x.shape[1]
+        qkv_w = P.concat([self.query.weight, self.key.weight,
+                          self.value.weight], axis=1)
+        qkv_b = P.concat([self.query.bias, self.key.bias,
+                          self.value.bias])
+        qkv = F.linear(x, qkv_w, qkv_b).reshape([bw, n, 3, self.nh,
+                                                 self.hd])
+        q = qkv[:, :, 0].transpose([0, 2, 1, 3]) * (self.hd ** -0.5)
+        k = qkv[:, :, 1].transpose([0, 2, 1, 3])
+        v = qkv[:, :, 2].transpose([0, 2, 1, 3])
+        attn = P.matmul(q, k.transpose([0, 1, 3, 2])) + self._bias()
+        if mask is not None:
+            nw = mask.shape[0]
+            attn = attn.reshape([bw // nw, nw, self.nh, n, n]) + \
+                mask.unsqueeze(1).unsqueeze(0)
+            attn = attn.reshape([bw, self.nh, n, n])
+        attn = F.softmax(attn, axis=-1)
+        out = P.matmul(attn, v).transpose([0, 2, 1, 3]).reshape(
+            [bw, n, self.nh * self.hd])
+        return self.proj(out)
+
+
+class SwinBlock(Layer):
+    def __init__(self, d, nh, resolution, w, shift, mlp_ratio, eps,
+                 dropout):
+        super().__init__()
+        self.res = resolution
+        # reference behavior: no window beyond the grid, no shift then
+        self.w = min(w, resolution)
+        self.shift = 0 if resolution <= w else shift
+        self.norm_before = LayerNorm(d, eps)
+        self.attn = WindowAttention(d, nh, self.w)
+        self.norm_after = LayerNorm(d, eps)
+        hidden = int(d * mlp_ratio)
+        self.mlp_in = Linear(d, hidden)
+        self.mlp_out = Linear(hidden, d)
+        self.act = GELU()
+        self.dropout = Dropout(dropout)
+        self._mask = (_shift_mask(resolution, resolution, self.w,
+                                  self.shift)
+                      if self.shift > 0 else None)
+
+    def forward(self, x):
+        """x [B, H·W, C] (token layout between blocks, matching the
+        reference)."""
+        b, c = x.shape[0], x.shape[2]
+        h = wg = self.res
+        shortcut = x
+        x = self.norm_before(x).reshape([b, h, wg, c])
+        if self.shift:
+            x = P.roll(x, shifts=[-self.shift, -self.shift], axis=[1, 2])
+        xw = _partition(x, self.w)
+        mask = P.to_tensor(self._mask) if self._mask is not None else None
+        xw = self.attn(xw, mask=mask)
+        x = _unpartition(xw, self.w, h, wg)
+        if self.shift:
+            x = P.roll(x, shifts=[self.shift, self.shift], axis=[1, 2])
+        x = shortcut + self.dropout(x.reshape([b, h * wg, c]))
+        y = self.mlp_out(self.act(self.mlp_in(self.norm_after(x))))
+        return x + self.dropout(y)
+
+
+class PatchMerging(Layer):
+    """[B, H·W, C] -> [B, (H/2)·(W/2), 2C]: 2×2 concat → norm →
+    bias-free reduction (reference order)."""
+
+    def __init__(self, d, resolution, eps):
+        super().__init__()
+        self.res = resolution
+        self.norm = LayerNorm(4 * d, eps)
+        self.reduction = Linear(4 * d, 2 * d, bias_attr=False)
+
+    def forward(self, x):
+        b, c = x.shape[0], x.shape[2]
+        h = wg = self.res
+        x = x.reshape([b, h, wg, c])
+        x = P.concat([x[:, 0::2, 0::2], x[:, 1::2, 0::2],
+                      x[:, 0::2, 1::2], x[:, 1::2, 1::2]], axis=-1)
+        x = x.reshape([b, (h // 2) * (wg // 2), 4 * c])
+        return self.reduction(self.norm(x))
+
+
+class SwinStage(Layer):
+    def __init__(self, d, nh, depth, resolution, w, mlp_ratio, eps,
+                 dropout, downsample):
+        super().__init__()
+        self.blocks = LayerList([
+            SwinBlock(d, nh, resolution, w,
+                      shift=(0 if i % 2 == 0 else w // 2),
+                      mlp_ratio=mlp_ratio, eps=eps, dropout=dropout)
+            for i in range(depth)])
+        self.downsample = (PatchMerging(d, resolution, eps)
+                           if downsample else None)
+
+    def forward(self, x):
+        for blk in self.blocks:
+            x = blk(x)
+        if self.downsample is not None:
+            x = self.downsample(x)
+        return x
+
+
+class SwinTransformer(Layer):
+    def __init__(self, cfg: SwinConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.patch_embed = Conv2D(cfg.num_channels, cfg.embed_dim,
+                                  cfg.patch_size, stride=cfg.patch_size)
+        self.embed_norm = LayerNorm(cfg.embed_dim, cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.dropout)
+        res = cfg.image_size // cfg.patch_size
+        # Unlike the reference (which pads odd grids), this build keeps
+        # every shape static for XLA — validate divisibility up front
+        # instead of crashing with an opaque reshape error mid-forward.
+        r = res
+        for i in range(len(cfg.depths)):
+            w = min(cfg.window_size, r)
+            if r % w != 0:
+                raise ValueError(
+                    f"stage {i} grid {r}x{r} is not divisible by "
+                    f"window_size {w}; pick image_size/patch_size/"
+                    f"window_size so every stage grid divides the "
+                    f"window (reference behavior pads instead)")
+            if i < len(cfg.depths) - 1 and r % 2 != 0:
+                raise ValueError(
+                    f"stage {i} grid {r}x{r} is odd — PatchMerging "
+                    f"needs even grids at every non-final stage")
+            r //= 2
+        stages = []
+        d = cfg.embed_dim
+        for i, (depth, nh) in enumerate(zip(cfg.depths, cfg.num_heads)):
+            last = i == len(cfg.depths) - 1
+            stages.append(SwinStage(
+                d, nh, depth, res, cfg.window_size, cfg.mlp_ratio,
+                cfg.layer_norm_eps, cfg.dropout, downsample=not last))
+            if not last:
+                d *= 2
+                res //= 2
+        self.stages = LayerList(stages)
+        self.norm = LayerNorm(d, cfg.layer_norm_eps)
+        self.head = (Linear(d, cfg.num_classes)
+                     if cfg.num_classes else None)
+
+    def forward_features(self, x):
+        """[B, C, H, W] -> (tokens [B, N, D], pooled [B, D])."""
+        x = self.patch_embed(x)
+        b, d = x.shape[0], x.shape[1]
+        x = x.reshape([b, d, -1]).transpose([0, 2, 1])
+        x = self.dropout(self.embed_norm(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.norm(x)
+        return x, x.mean(axis=1)
+
+    def forward(self, x):
+        tokens, pooled = self.forward_features(x)
+        if self.head is None:
+            return tokens, pooled
+        return self.head(pooled)
+
+
+def swin_t(num_classes=1000, **kw):
+    return SwinTransformer(SwinConfig(num_classes=num_classes, **kw))
+
+
+def swin_s(num_classes=1000, **kw):
+    return SwinTransformer(SwinConfig(
+        depths=(2, 2, 18, 2), num_classes=num_classes, **kw))
+
+
+def swin_b(num_classes=1000, **kw):
+    return SwinTransformer(SwinConfig(
+        embed_dim=128, num_heads=(4, 8, 16, 32), depths=(2, 2, 18, 2),
+        num_classes=num_classes, **kw))
