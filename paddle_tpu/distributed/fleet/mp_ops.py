@@ -110,3 +110,33 @@ def _c_concat(x, group=None, axis=-1):
 
     f.defvjp(fwd, bwd)
     return apply(f, x, name="c_concat")
+
+
+def _c_concat_grad_reduce(x, group=None, axis=0):
+    """All-gather whose backward is the EXACT transpose: psum_scatter.
+
+    `_c_concat`'s slice-backward assumes the post-gather compute is
+    replicated across the group (Megatron-SP), so every rank's cotangent
+    already carries the full downstream sensitivity. When each rank
+    computes a DIFFERENT function of the gathered tensor (e.g. its local
+    rows of a global contrastive logit matrix), rank s's loss depends on
+    rank r's slice — those cross-rank cotangents live on rank s and a
+    slice would drop them. Summing cotangents across the group before
+    slicing (psum_scatter) is the mathematical vjp of all_gather."""
+    if not _live(group):
+        return x
+    ax_name = group.axis_name
+
+    @jax.custom_vjp
+    def f(a):
+        return jax.lax.all_gather(a, ax_name, axis=axis, tiled=True)
+
+    def fwd(a):
+        return f(a), None
+
+    def bwd(_, g):
+        return (jax.lax.psum_scatter(g, ax_name, scatter_dimension=axis,
+                                     tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, x, name="c_concat_grad_reduce")

@@ -12,4 +12,4 @@ from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
 from .whisper import (WhisperConfig, WhisperModel,  # noqa: F401
                       WhisperForConditionalGeneration)
 from .clip import (CLIPConfig, CLIPModel, CLIPTextConfig,  # noqa: F401
-                   CLIPVisionConfig, clip_loss)
+                   CLIPVisionConfig, clip_loss, clip_global_loss)

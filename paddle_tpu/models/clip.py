@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as P
@@ -34,7 +35,7 @@ from ..nn import Conv2D, Embedding, Layer, LayerList, LayerNorm, Linear
 from ..nn import functional as F
 
 __all__ = ["CLIPConfig", "CLIPTextConfig", "CLIPVisionConfig",
-           "CLIPModel", "clip_loss"]
+           "CLIPModel", "clip_loss", "clip_global_loss"]
 
 
 @dataclass
@@ -232,3 +233,36 @@ def clip_loss(logits_per_text):
     t = F.cross_entropy(logits_per_text, labels)
     i = F.cross_entropy(logits_per_text.t(), labels)
     return 0.5 * (t + i)
+
+
+def clip_global_loss(image_features, text_features, logit_scale,
+                     group=None):
+    """GLOBAL-batch symmetric InfoNCE across a data-parallel group.
+
+    The reference trains CLIP with the contrastive matrix over the
+    global batch, not each rank's shard. Inside a traced SPMD step
+    (shard_map over the dp axis), features are all-gathered with the
+    EXACT vjp (grad psum_scatter back to the owning rank —
+    `mp_ops._c_concat_grad_reduce`), each rank computes its local rows
+    against all global columns, and labels are offset by the rank's
+    shard. Returns this rank's mean loss; the global loss is its pmean,
+    and the surrounding dp grad sync (which averages) yields exactly
+    d(global loss)/dθ. With `group=None` (or untraced) it degrades to
+    the local in-batch loss.
+    """
+    img = image_features / P.norm(image_features, axis=-1, keepdim=True)
+    txt = text_features / P.norm(text_features, axis=-1, keepdim=True)
+    scale = P.exp(logit_scale)
+    from ..distributed.fleet.mp_ops import _c_concat_grad_reduce, _live
+    if group is None or not _live(group):
+        lt = P.matmul(txt, img.t()) * scale
+        return clip_loss(lt)
+    all_img = _c_concat_grad_reduce(img, group, axis=0)
+    all_txt = _c_concat_grad_reduce(txt, group, axis=0)
+    b = txt.shape[0]
+    offset = jax.lax.axis_index(group.axis_name) * b
+    labels = P.to_tensor(jnp.arange(b) + offset)
+    lt = P.matmul(txt, all_img.t()) * scale   # [B_local, B_global]
+    li = P.matmul(img, all_txt.t()) * scale
+    return 0.5 * (F.cross_entropy(lt, labels)
+                  + F.cross_entropy(li, labels))

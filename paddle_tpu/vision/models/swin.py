@@ -44,6 +44,7 @@ class SwinConfig:
     window_size: int = 7
     mlp_ratio: float = 4.0
     dropout: float = 0.0
+    attention_dropout: float = 0.0
     layer_norm_eps: float = 1e-5
     num_classes: int = 1000
 
@@ -95,11 +96,12 @@ def _unpartition(x, w, h, wg):
 
 
 class WindowAttention(Layer):
-    def __init__(self, d, nh, w):
+    def __init__(self, d, nh, w, attn_dropout=0.0):
         super().__init__()
         self.nh = nh
         self.hd = d // nh
         self.w = w
+        self.attn_dropout = attn_dropout
         self.query = Linear(d, d)
         self.key = Linear(d, d)
         self.value = Linear(d, d)
@@ -135,6 +137,11 @@ class WindowAttention(Layer):
                 mask.unsqueeze(1).unsqueeze(0)
             attn = attn.reshape([bw, self.nh, n, n])
         attn = F.softmax(attn, axis=-1)
+        if self.attn_dropout > 0.0:
+            # reference semantics: dropout on the attention
+            # PROBABILITIES (links), after the softmax
+            attn = F.dropout(attn, p=self.attn_dropout,
+                             training=self.training)
         out = P.matmul(attn, v).transpose([0, 2, 1, 3]).reshape(
             [bw, n, self.nh * self.hd])
         return self.proj(out)
@@ -142,23 +149,25 @@ class WindowAttention(Layer):
 
 class SwinBlock(Layer):
     def __init__(self, d, nh, resolution, w, shift, mlp_ratio, eps,
-                 dropout):
+                 dropout, attn_dropout=0.0, shift_mask=None):
         super().__init__()
         self.res = resolution
         # reference behavior: no window beyond the grid, no shift then
         self.w = min(w, resolution)
         self.shift = 0 if resolution <= w else shift
         self.norm_before = LayerNorm(d, eps)
-        self.attn = WindowAttention(d, nh, self.w)
+        self.attn = WindowAttention(d, nh, self.w,
+                                    attn_dropout=attn_dropout)
         self.norm_after = LayerNorm(d, eps)
         hidden = int(d * mlp_ratio)
         self.mlp_in = Linear(d, hidden)
         self.mlp_out = Linear(hidden, d)
         self.act = GELU()
         self.dropout = Dropout(dropout)
-        self._mask = (_shift_mask(resolution, resolution, self.w,
-                                  self.shift)
-                      if self.shift > 0 else None)
+        # the [nW, w², w²] mask is shared per stage (SwinStage owns the
+        # single device copy) — per-block copies would bake duplicate
+        # constants into jitted programs (CLAUDE.md large-constant rule)
+        self._mask = shift_mask if self.shift > 0 else None
 
     def forward(self, x):
         """x [B, H·W, C] (token layout between blocks, matching the
@@ -170,11 +179,11 @@ class SwinBlock(Layer):
         if self.shift:
             x = P.roll(x, shifts=[-self.shift, -self.shift], axis=[1, 2])
         xw = _partition(x, self.w)
-        mask = P.to_tensor(self._mask) if self._mask is not None else None
-        xw = self.attn(xw, mask=mask)
+        xw = self.attn(xw, mask=self._mask)
         x = _unpartition(xw, self.w, h, wg)
         if self.shift:
-            x = P.roll(x, shifts=[self.shift, self.shift], axis=[1, 2])
+            x = P.roll(x, shifts=[self.shift, self.shift],
+                       axis=[1, 2])
         x = shortcut + self.dropout(x.reshape([b, h * wg, c]))
         y = self.mlp_out(self.act(self.mlp_in(self.norm_after(x))))
         return x + self.dropout(y)
@@ -202,12 +211,18 @@ class PatchMerging(Layer):
 
 class SwinStage(Layer):
     def __init__(self, d, nh, depth, resolution, w, mlp_ratio, eps,
-                 dropout, downsample):
+                 dropout, downsample, attn_dropout=0.0):
         super().__init__()
+        weff = min(w, resolution)
+        shift = 0 if resolution <= w else weff // 2
+        mask = (P.to_tensor(_shift_mask(resolution, resolution, weff,
+                                        shift))
+                if shift > 0 and depth > 1 else None)  # one device copy
         self.blocks = LayerList([
             SwinBlock(d, nh, resolution, w,
                       shift=(0 if i % 2 == 0 else w // 2),
-                      mlp_ratio=mlp_ratio, eps=eps, dropout=dropout)
+                      mlp_ratio=mlp_ratio, eps=eps, dropout=dropout,
+                      attn_dropout=attn_dropout, shift_mask=mask)
             for i in range(depth)])
         self.downsample = (PatchMerging(d, resolution, eps)
                            if downsample else None)
@@ -252,7 +267,8 @@ class SwinTransformer(Layer):
             last = i == len(cfg.depths) - 1
             stages.append(SwinStage(
                 d, nh, depth, res, cfg.window_size, cfg.mlp_ratio,
-                cfg.layer_norm_eps, cfg.dropout, downsample=not last))
+                cfg.layer_norm_eps, cfg.dropout, downsample=not last,
+                attn_dropout=cfg.attention_dropout))
             if not last:
                 d *= 2
                 res //= 2
