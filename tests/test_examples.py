@@ -46,3 +46,13 @@ class TestExamples:
             extra_env={"XLA_FLAGS":
                        "--xla_force_host_platform_device_count=8"})
         assert "hybrid-parallel training parity OK" in out
+
+    def test_train_clip_contrastive(self):
+        out = _run_example("train_clip_contrastive.py", args=("--cpu",))
+        assert "CLIP contrastive training OK" in out
+
+    def test_train_clip_contrastive_mesh(self):
+        out = _run_example("train_clip_contrastive.py",
+                           args=("--cpu", "--mesh"), timeout=540)
+        assert "global-batch(mesh dp=4)" in out
+        assert "CLIP contrastive training OK" in out
