@@ -24,3 +24,5 @@ from .vit import (VisionTransformer, ViTConfig, vit_b_16,  # noqa: F401
                   vit_b_32, vit_l_16, vit_h_14)
 from .swin import (SwinTransformer, SwinConfig, swin_t,  # noqa: F401
                    swin_s, swin_b)
+from .convnext import (ConvNeXt, ConvNeXtConfig,  # noqa: F401
+                       convnext_tiny, convnext_small, convnext_base)

@@ -1,0 +1,137 @@
+"""ConvNeXt family (modernized convolutional backbone).
+
+Reference surface: the Paddle-ecosystem ConvNeXt (upstream PaddleClas
+ppcls/arch/backbone/model_zoo/convnext.py, unverified — see SURVEY.md
+§2.2 "Vision"): 4-stage hierarchy of depthwise-7×7 blocks with
+channels-last LayerNorm, a 4× pointwise MLP, learnable per-channel
+layer scale, and 2×2 stride-2 downsample convs between stages. Parity
+is tested against the `transformers` torch implementation by weight
+transplant (tests/test_models_convnext.py).
+
+TPU-first notes:
+- The block body (LN → Linear 4C → GELU → Linear C → scale) runs in
+  NHWC token layout, so both pointwise convs ARE MXU matmuls; only the
+  depthwise 7×7 rides the conv unit (XLA feature_group_count).
+- Layer scale is a [C] parameter broadcast — XLA fuses it into the
+  pwconv2 epilogue.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ...nn import GELU, Layer, LayerList, LayerNorm, Linear
+from ...nn.conv import Conv2D
+
+__all__ = ["ConvNeXt", "ConvNeXtConfig", "convnext_tiny",
+           "convnext_small", "convnext_base"]
+
+_INTERNAL_EPS = 1e-6  # reference-hardcoded for all non-final norms
+
+
+@dataclass
+class ConvNeXtConfig:
+    num_channels: int = 3
+    patch_size: int = 4
+    hidden_sizes: tuple = (96, 192, 384, 768)
+    depths: tuple = (3, 3, 9, 3)
+    layer_scale_init: float = 1e-6
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 1000
+
+    @staticmethod
+    def tiny(**kw):
+        return ConvNeXtConfig(**{**dict(
+            hidden_sizes=(16, 32, 64, 96), depths=(2, 2, 2, 2),
+            num_classes=10), **kw})
+
+
+class ConvNeXtBlock(Layer):
+    def __init__(self, d, cfg: ConvNeXtConfig):
+        super().__init__()
+        self.dwconv = Conv2D(d, d, 7, padding=3, groups=d)
+        # reference hardcodes eps=1e-6 on block/embed/downsample
+        # norms; cfg.layer_norm_eps applies only to the final LN
+        self.layernorm = LayerNorm(d, _INTERNAL_EPS)
+        self.pwconv1 = Linear(d, 4 * d)
+        self.pwconv2 = Linear(4 * d, d)
+        self.act = GELU()
+        self.layer_scale_parameter = self.create_parameter((d,))
+        self.layer_scale_parameter.set_value(
+            P.full([d], cfg.layer_scale_init))
+
+    def forward(self, x):
+        """x [B, C, H, W]."""
+        y = self.dwconv(x)
+        y = y.transpose([0, 2, 3, 1])  # NHWC: pointwise convs = matmuls
+        y = self.pwconv2(self.act(self.pwconv1(self.layernorm(y))))
+        y = self.layer_scale_parameter * y
+        return x + y.transpose([0, 3, 1, 2])
+
+
+class _ChannelsFirstLN(Layer):
+    """LayerNorm over C of an NCHW tensor (reference embedding/downsample
+    norm) — one transpose round-trip; XLA folds it into neighbors."""
+
+    def __init__(self, d, eps):
+        super().__init__()
+        self.norm = LayerNorm(d, eps)
+
+    def forward(self, x):
+        return self.norm(x.transpose([0, 2, 3, 1])).transpose(
+            [0, 3, 1, 2])
+
+
+class ConvNeXt(Layer):
+    def __init__(self, cfg: ConvNeXtConfig):
+        super().__init__()
+        self.cfg = cfg
+        hs = cfg.hidden_sizes
+        self.patch_embed = Conv2D(cfg.num_channels, hs[0],
+                                  cfg.patch_size, stride=cfg.patch_size)
+        self.embed_norm = _ChannelsFirstLN(hs[0], _INTERNAL_EPS)
+        self.down_norms = LayerList([
+            _ChannelsFirstLN(hs[i], _INTERNAL_EPS)
+            for i in range(len(hs) - 1)])
+        self.down_convs = LayerList([
+            Conv2D(hs[i], hs[i + 1], 2, stride=2)
+            for i in range(len(hs) - 1)])
+        self.stages = LayerList([
+            LayerList([ConvNeXtBlock(hs[i], cfg)
+                       for _ in range(cfg.depths[i])])
+            for i in range(len(hs))])
+        self.norm = LayerNorm(hs[-1], cfg.layer_norm_eps)
+        self.head = (Linear(hs[-1], cfg.num_classes)
+                     if cfg.num_classes else None)
+
+    def forward_features(self, x):
+        """[B, C, H, W] -> pooled [B, D] (reference: LN of spatial
+        mean)."""
+        x = self.embed_norm(self.patch_embed(x))
+        for i, stage in enumerate(self.stages):
+            if i > 0:
+                x = self.down_convs[i - 1](self.down_norms[i - 1](x))
+            for blk in stage:
+                x = blk(x)
+        return self.norm(x.mean(axis=[2, 3]))
+
+    def forward(self, x):
+        pooled = self.forward_features(x)
+        if self.head is None:
+            return pooled
+        return self.head(pooled)
+
+
+def convnext_tiny(num_classes=1000, **kw):
+    return ConvNeXt(ConvNeXtConfig(num_classes=num_classes, **kw))
+
+
+def convnext_small(num_classes=1000, **kw):
+    return ConvNeXt(ConvNeXtConfig(
+        depths=(3, 3, 27, 3), num_classes=num_classes, **kw))
+
+
+def convnext_base(num_classes=1000, **kw):
+    return ConvNeXt(ConvNeXtConfig(
+        hidden_sizes=(128, 256, 512, 1024), depths=(3, 3, 27, 3),
+        num_classes=num_classes, **kw))
