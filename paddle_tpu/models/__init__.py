@@ -13,3 +13,5 @@ from .whisper import (WhisperConfig, WhisperModel,  # noqa: F401
                       WhisperForConditionalGeneration)
 from .clip import (CLIPConfig, CLIPModel, CLIPTextConfig,  # noqa: F401
                    CLIPVisionConfig, clip_loss, clip_global_loss)
+from .wav2vec2 import (Wav2Vec2Config, Wav2Vec2Model,  # noqa: F401
+                       Wav2Vec2ForCTC)

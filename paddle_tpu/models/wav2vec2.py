@@ -1,0 +1,205 @@
+"""wav2vec 2.0 family (self-supervised speech encoder + CTC head).
+
+Reference surface: the Paddle-ecosystem wav2vec2 (upstream PaddleSpeech
+paddlespeech/s2t/models/wav2vec2/, unverified — see SURVEY.md §2.2
+"Misc domains"): raw waveform → strided 1-D conv feature extractor
+(group-norm on the first layer, GELU), feature projection, a
+convolutional relative position embedding (weight-normalized grouped
+conv), post-LN transformer encoder, and a CTC head fine-tuned with
+`F.ctc_loss`. Parity is tested against the `transformers` torch
+implementation by weight transplant (tests/test_models_wav2vec2.py).
+
+TPU-first notes:
+- The conv front-end is a fixed chain of static-stride convs — XLA
+  compiles the whole wave→logits path as one program with no dynamic
+  shapes; frame counts for CTC derive from the same static formula.
+- CTC uses the in-house lax.scan alpha recursion (ops already on-device
+  — no warpctc host dependency).
+- SpecAugment-style time masking is a training-data concern upstream of
+  the model here (the reference's masked_spec_embed path); fine-tune
+  recipes mask features before the encoder.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as P
+from ..nn import GELU, GroupNorm, Layer, LayerList, LayerNorm, Linear
+from ..nn import functional as F
+from ..nn.conv import Conv1D
+
+__all__ = ["Wav2Vec2Config", "Wav2Vec2Model", "Wav2Vec2ForCTC"]
+
+
+@dataclass
+class Wav2Vec2Config:
+    vocab_size: int = 32
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    conv_dim: tuple = (512, 512, 512, 512, 512, 512, 512)
+    conv_kernel: tuple = (10, 3, 3, 3, 3, 2, 2)
+    conv_stride: tuple = (5, 2, 2, 2, 2, 2, 2)
+    num_conv_pos_embeddings: int = 128
+    num_conv_pos_embedding_groups: int = 16
+    layer_norm_eps: float = 1e-5
+    pad_token_id: int = 0  # CTC blank
+
+    @staticmethod
+    def tiny(**kw):
+        return Wav2Vec2Config(**{**dict(
+            vocab_size=32, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            conv_dim=(16, 16, 16), conv_kernel=(10, 3, 3),
+            conv_stride=(5, 2, 2), num_conv_pos_embeddings=16,
+            num_conv_pos_embedding_groups=4), **kw})
+
+    def feat_lengths(self, wave_lengths):
+        """Frame count after the conv stack (static stride formula)."""
+        out = np.asarray(wave_lengths)
+        for k, s in zip(self.conv_kernel, self.conv_stride):
+            out = (out - k) // s + 1
+        return out
+
+
+class FeatureExtractor(Layer):
+    """Strided conv stack on the raw wave; group norm on layer 0 only
+    (reference 'group' norm mode)."""
+
+    def __init__(self, cfg: Wav2Vec2Config):
+        super().__init__()
+        dims = (1,) + tuple(cfg.conv_dim)
+        self.convs = LayerList([
+            Conv1D(dims[i], dims[i + 1], cfg.conv_kernel[i],
+                   stride=cfg.conv_stride[i], bias_attr=False)
+            for i in range(len(cfg.conv_kernel))])
+        self.group_norm = GroupNorm(cfg.conv_dim[0], cfg.conv_dim[0])
+        self.act = GELU()
+
+    def forward(self, wave):
+        """[B, T] -> [B, T', C]."""
+        x = wave.unsqueeze(1)  # [B, 1, T]
+        for i, conv in enumerate(self.convs):
+            x = conv(x)
+            if i == 0:
+                x = self.group_norm(x)  # F.group_norm handles NCL
+            x = self.act(x)
+        return x.transpose([0, 2, 1])
+
+
+class PosConvEmbed(Layer):
+    """Weight-normalized grouped conv position embedding (stored as the
+    effective weight; the torch parametrization is materialized at
+    transplant)."""
+
+    def __init__(self, cfg: Wav2Vec2Config):
+        super().__init__()
+        k = cfg.num_conv_pos_embeddings
+        self.k = k
+        self.conv = Conv1D(cfg.hidden_size, cfg.hidden_size, k,
+                           padding=k // 2,
+                           groups=cfg.num_conv_pos_embedding_groups)
+        self.act = GELU()
+
+    def forward(self, x):
+        """[B, S, D] -> [B, S, D]."""
+        y = self.conv(x.transpose([0, 2, 1]))
+        if self.k % 2 == 0:
+            y = y[:, :, :-1]  # reference trims the extra frame
+        return self.act(y).transpose([0, 2, 1])
+
+
+class Wav2Vec2EncoderLayer(Layer):
+    """POST-LN block (reference base-model convention)."""
+
+    def __init__(self, cfg: Wav2Vec2Config):
+        super().__init__()
+        d = cfg.hidden_size
+        self.nh = cfg.num_attention_heads
+        self.hd = d // self.nh
+        self.q = Linear(d, d)
+        self.k = Linear(d, d)
+        self.v = Linear(d, d)
+        self.o = Linear(d, d)
+        self.layer_norm = LayerNorm(d, cfg.layer_norm_eps)
+        self.ff_in = Linear(d, cfg.intermediate_size)
+        self.ff_out = Linear(cfg.intermediate_size, d)
+        self.final_layer_norm = LayerNorm(d, cfg.layer_norm_eps)
+        self.act = GELU()
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv_w = P.concat([self.q.weight, self.k.weight, self.v.weight],
+                         axis=1)
+        qkv_b = P.concat([self.q.bias, self.k.bias, self.v.bias])
+        qkv = F.linear(x, qkv_w, qkv_b).reshape([b, s, 3, self.nh,
+                                                 self.hd])
+        ctx = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+            training=self.training)
+        x = self.layer_norm(x + self.o(ctx.reshape([b, s, -1])))
+        return self.final_layer_norm(
+            x + self.ff_out(self.act(self.ff_in(x))))
+
+
+class Wav2Vec2Model(Layer):
+    def __init__(self, cfg: Wav2Vec2Config):
+        super().__init__()
+        self.cfg = cfg
+        self.feature_extractor = FeatureExtractor(cfg)
+        self.fp_norm = LayerNorm(cfg.conv_dim[-1], cfg.layer_norm_eps)
+        self.fp_proj = Linear(cfg.conv_dim[-1], cfg.hidden_size)
+        self.pos_conv_embed = PosConvEmbed(cfg)
+        self.encoder_norm = LayerNorm(cfg.hidden_size,
+                                      cfg.layer_norm_eps)
+        self.layers = LayerList([Wav2Vec2EncoderLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+
+    def forward(self, wave):
+        """[B, T] raw wave -> [B, T', D] encoder states."""
+        feats = self.feature_extractor(wave)
+        x = self.fp_proj(self.fp_norm(feats))
+        x = x + self.pos_conv_embed(x)
+        x = self.encoder_norm(x)
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class Wav2Vec2ForCTC(Layer):
+    def __init__(self, cfg: Wav2Vec2Config):
+        super().__init__()
+        self.cfg = cfg
+        self.wav2vec2 = Wav2Vec2Model(cfg)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, wave, labels=None, label_lengths=None,
+                wave_lengths=None):
+        """wave [B, T]; labels [B, L] (blank = pad_token_id). Returns
+        logits [B, T', V], or (ctc_loss, logits) with labels.
+
+        For zero-padded batches pass `wave_lengths` [B] (true sample
+        counts) — CTC input lengths derive via the conv stride formula
+        (cfg.feat_lengths); without it every row is scored over the
+        full frame count, which silently mis-weights padded rows."""
+        logits = self.lm_head(self.wav2vec2(wave))
+        if labels is None:
+            return logits
+        b, t = logits.shape[0], logits.shape[1]
+        if wave_lengths is not None:
+            wl = np.asarray(wave_lengths._data if hasattr(
+                wave_lengths, "_data") else wave_lengths)
+            input_lengths = P.to_tensor(
+                self.cfg.feat_lengths(wl).astype(np.int32))
+        else:
+            input_lengths = P.to_tensor(np.full((b,), t, np.int32))
+        if label_lengths is None:
+            label_lengths = P.to_tensor(np.full(
+                (b,), int(labels.shape[1]), np.int32))
+        loss = F.ctc_loss(logits.transpose([1, 0, 2]), labels,
+                          input_lengths, label_lengths,
+                          blank=self.cfg.pad_token_id)
+        return loss, logits
