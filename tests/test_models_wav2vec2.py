@@ -176,3 +176,17 @@ class TestWav2Vec2Parity:
         loss_full, _ = ours(P.to_tensor(padded),
                             labels=P.to_tensor(labels))
         assert abs(float(loss_full) - float(loss_len)) > 1e-3
+
+    def test_padded_labels_derive_lengths(self, pair):
+        """pad_token_id-padded transcripts score identically to their
+        unpadded form (label_lengths derives from non-pad counts — a
+        full-width default would score pad slots as real symbols)."""
+        _, ours = pair
+        rng = np.random.default_rng(4)
+        wave = P.to_tensor(rng.standard_normal((1, 800))
+                           .astype(np.float32) * 0.1)
+        lab = rng.integers(1, 32, (1, 3)).astype(np.int32)
+        l1, _ = ours(wave, labels=P.to_tensor(lab))
+        padded = np.concatenate([lab, np.zeros((1, 2), np.int32)], 1)
+        l2, _ = ours(wave, labels=P.to_tensor(padded))
+        assert abs(float(l1) - float(l2)) < 1e-5
