@@ -58,8 +58,11 @@ class Wav2Vec2Config:
             num_conv_pos_embedding_groups=4), **kw})
 
     def feat_lengths(self, wave_lengths):
-        """Frame count after the conv stack (static stride formula)."""
-        out = np.asarray(wave_lengths)
+        """Frame count after the conv stack (static stride formula).
+        Pure integer arithmetic — works on numpy arrays, lists, AND
+        traced jnp arrays (safe inside a jitted train step)."""
+        out = wave_lengths if hasattr(wave_lengths, "shape") \
+            else np.asarray(wave_lengths)
         for k, s in zip(self.conv_kernel, self.conv_stride):
             out = (out - k) // s + 1
         return out
@@ -190,14 +193,10 @@ class Wav2Vec2ForCTC(Layer):
             return logits
         b, t = logits.shape[0], logits.shape[1]
         if wave_lengths is not None:
-            # the stride formula is pure integer arithmetic — it works
-            # unchanged on numpy AND traced jnp arrays (no np.asarray:
-            # that would crash on tracers under a jitted train step)
             wl = wave_lengths._data if hasattr(wave_lengths, "_data") \
                 else wave_lengths
-            for k, s in zip(self.cfg.conv_kernel, self.cfg.conv_stride):
-                wl = (wl - k) // s + 1
-            input_lengths = P.to_tensor(wl).astype("int32")
+            input_lengths = P.to_tensor(
+                self.cfg.feat_lengths(wl)).astype("int32")
         else:
             input_lengths = P.to_tensor(np.full((b,), t, np.int32))
         if label_lengths is None:

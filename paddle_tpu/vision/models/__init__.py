@@ -26,3 +26,5 @@ from .swin import (SwinTransformer, SwinConfig, swin_t,  # noqa: F401
                    swin_s, swin_b)
 from .convnext import (ConvNeXt, ConvNeXtConfig,  # noqa: F401
                        convnext_tiny, convnext_small, convnext_base)
+from .yolov3 import (YOLOv3, YOLOv3Config, DarkNet53,  # noqa: F401
+                     yolov3_darknet53)
