@@ -15,3 +15,5 @@ from .clip import (CLIPConfig, CLIPModel, CLIPTextConfig,  # noqa: F401
                    CLIPVisionConfig, clip_loss, clip_global_loss)
 from .wav2vec2 import (Wav2Vec2Config, Wav2Vec2Model,  # noqa: F401
                        Wav2Vec2ForCTC)
+from .ddpm import (UNet2DConfig, UNet2DModel, DDPMScheduler,  # noqa: F401
+                   DDIMScheduler, ddpm_train_loss)
