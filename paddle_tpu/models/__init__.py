@@ -17,3 +17,7 @@ from .wav2vec2 import (Wav2Vec2Config, Wav2Vec2Model,  # noqa: F401
                        Wav2Vec2ForCTC)
 from .ddpm import (UNet2DConfig, UNet2DModel, DDPMScheduler,  # noqa: F401
                    DDIMScheduler, ddpm_train_loss)
+from .deepfm import DeepFM, DeepFMConfig  # noqa: F401
+from .dcgan import (DCGANConfig, Generator as DCGANGenerator,  # noqa: F401
+                    Discriminator as DCGANDiscriminator,
+                    gan_bce_losses)

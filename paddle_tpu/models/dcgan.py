@@ -1,0 +1,123 @@
+"""DCGAN family (adversarial image generation).
+
+Reference surface: the Paddle-ecosystem GAN stack (upstream PaddleGAN
+ppgan/models/ — DCGAN generator/discriminator + the alternating
+BCE-adversarial recipe, unverified; see SURVEY.md §2.2 "Misc
+domains"): transposed-conv generator from a latent vector, strided-conv
+discriminator with BatchNorm/LeakyReLU, non-saturating generator loss.
+
+TPU-first notes:
+- G and D steps are each one XLA program; the alternating update works
+  through the standard tape (`d_loss.backward()` only populates D
+  grads when G's graph is detached — `fake.detach()` — exactly the
+  reference's idiom).
+- Conv2DTranspose lowers to XLA conv_general_dilated transposes — MXU
+  matmuls at these widths.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ..nn import (BatchNorm2D, Conv2D, Conv2DTranspose, Layer,
+                  LeakyReLU, ReLU, Sequential, Sigmoid, Tanh)
+from ..nn import functional as F
+
+__all__ = ["DCGANConfig", "Generator", "Discriminator",
+           "gan_bce_losses"]
+
+
+@dataclass
+class DCGANConfig:
+    latent_dim: int = 100
+    base_channels: int = 64
+    image_channels: int = 3
+    image_size: int = 32   # must be a power of two >= 8
+
+    @staticmethod
+    def tiny(**kw):
+        return DCGANConfig(**{**dict(
+            latent_dim=16, base_channels=8, image_channels=1,
+            image_size=16), **kw})
+
+
+class Generator(Layer):
+    """z [B, latent] -> image [B, C, S, S] in (-1, 1)."""
+
+    def __init__(self, cfg: DCGANConfig):
+        super().__init__()
+        self.cfg = cfg
+        n_up = 0
+        s = 4
+        while s < cfg.image_size:
+            s *= 2
+            n_up += 1
+        c = cfg.base_channels * 2 ** n_up
+        self.project = Conv2DTranspose(cfg.latent_dim, c, 4)
+        blocks = []
+        for i in range(n_up):
+            cout = c // 2
+            blocks += [BatchNorm2D(c), ReLU(),
+                       Conv2DTranspose(c, cout, 4, stride=2, padding=1)]
+            c = cout
+        self.blocks = Sequential(*blocks)
+        self.out = Sequential(BatchNorm2D(c), ReLU(),
+                              Conv2D(c, cfg.image_channels, 3,
+                                     padding=1), Tanh())
+
+    def forward(self, z):
+        x = self.project(z.reshape([z.shape[0], self.cfg.latent_dim,
+                                    1, 1]))
+        return self.out(self.blocks(x))
+
+
+class Discriminator(Layer):
+    """image -> real/fake logit [B]."""
+
+    def __init__(self, cfg: DCGANConfig):
+        super().__init__()
+        c = cfg.base_channels
+        layers = [Conv2D(cfg.image_channels, c, 4, stride=2, padding=1),
+                  LeakyReLU(0.2)]
+        s = cfg.image_size // 2
+        while s > 4:
+            layers += [Conv2D(c, c * 2, 4, stride=2, padding=1),
+                       BatchNorm2D(c * 2), LeakyReLU(0.2)]
+            c *= 2
+            s //= 2
+        self.features = Sequential(*layers)
+        self.head = Conv2D(c, 1, s)
+
+    def forward(self, x):
+        return self.head(self.features(x)).reshape([x.shape[0]])
+
+
+def discriminator_loss(d, real, fake):
+    """D maximizes log D(x) + log(1−D(G(z))) on a DETACHED fake (G
+    receives no gradient from this loss)."""
+    logit_real = d(real)
+    logit_fake = d(fake.detach())
+    d_loss = (F.binary_cross_entropy_with_logits(
+        logit_real, P.ones_like(logit_real))
+        + F.binary_cross_entropy_with_logits(
+            logit_fake, P.zeros_like(logit_fake)))
+    return d_loss
+
+
+def generator_loss(d, fake):
+    """Non-saturating G loss −log D(G(z)). Call AFTER the D optimizer
+    step, with a FRESH d(fake) forward: a G loss computed before
+    opt_d.step() holds references to D's pre-update weights, and the
+    in-place optimizer update would (correctly) fault the tape's
+    version check at backward time."""
+    logit = d(fake)
+    return F.binary_cross_entropy_with_logits(logit,
+                                              P.ones_like(logit))
+
+
+def gan_bce_losses(d, real, fake):
+    """Convenience for NON-interleaved use (no optimizer step between
+    the two backwards): returns (d_loss, g_loss) from one pass. For the
+    standard alternating recipe use discriminator_loss / step /
+    generator_loss."""
+    return discriminator_loss(d, real, fake), generator_loss(d, fake)

@@ -1,0 +1,78 @@
+"""DeepFM recommendation family (CTR prediction).
+
+Reference surface: the Paddle-ecosystem recommender stack (upstream
+PaddleRec models/rank/deepfm/, unverified — see SURVEY.md §2.2 "Misc
+domains"): first-order linear term over sparse features, second-order
+factorization-machine interactions via the sum-square identity, and a
+deep MLP over concatenated field embeddings; sigmoid CTR output. The
+FM term is tested against an explicit O(F²) pairwise-product oracle
+(tests/test_models_deepfm_dcgan.py).
+
+TPU-first notes:
+- All field embeddings gather in one lookup ([B, F] ids into a shared
+  table) and the FM sum-square identity turns the O(F²) interaction
+  into two [B, F, K] reductions — elementwise ops XLA fuses with the
+  MLP's first matmul.
+- Static [B, F] feature layout (one id per field) keeps the whole
+  train step a single XLA program; multi-hot fields are handled
+  upstream by the data pipeline as field repetition, as in the
+  reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..nn import Embedding, Layer, Linear, ReLU, Sequential
+from ..nn import functional as F
+
+__all__ = ["DeepFMConfig", "DeepFM"]
+
+
+@dataclass
+class DeepFMConfig:
+    num_features: int = 100000   # total vocabulary over all fields
+    num_fields: int = 26
+    embedding_dim: int = 8
+    mlp_hidden: tuple = (128, 64)
+
+    @staticmethod
+    def tiny(**kw):
+        return DeepFMConfig(**{**dict(
+            num_features=64, num_fields=6, embedding_dim=4,
+            mlp_hidden=(16, 8)), **kw})
+
+
+class DeepFM(Layer):
+    def __init__(self, cfg: DeepFMConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embedding = Embedding(cfg.num_features, cfg.embedding_dim)
+        self.linear = Embedding(cfg.num_features, 1)  # first-order w_i
+        self.bias = self.create_parameter((1,), is_bias=True)
+        layers = []
+        d = cfg.num_fields * cfg.embedding_dim
+        for h in cfg.mlp_hidden:
+            layers += [Linear(d, h), ReLU()]
+            d = h
+        layers.append(Linear(d, 1))
+        self.mlp = Sequential(*layers)
+
+    def fm_second_order(self, emb):
+        """[B, F, K] -> [B] via 0.5·Σ_k((Σ_f v)² − Σ_f v²) — the
+        sum-square identity for Σ_{i<j}⟨v_i, v_j⟩."""
+        s = emb.sum(axis=1)                 # [B, K]
+        sq = (emb ** 2).sum(axis=1)         # [B, K]
+        return 0.5 * (s ** 2 - sq).sum(axis=-1)
+
+    def forward(self, feat_ids):
+        """feat_ids [B, F] int ids -> CTR logits [B]."""
+        emb = self.embedding(feat_ids)                     # [B, F, K]
+        first = self.linear(feat_ids).squeeze(-1).sum(axis=1)
+        second = self.fm_second_order(emb)
+        b, f = feat_ids.shape[0], feat_ids.shape[1]
+        deep = self.mlp(emb.reshape(
+            [b, f * self.cfg.embedding_dim])).squeeze(-1)
+        return first + second + deep + self.bias
+
+    def predict_ctr(self, feat_ids):
+        return F.sigmoid(self.forward(feat_ids))

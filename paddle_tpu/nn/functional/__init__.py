@@ -297,28 +297,56 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         pads = padding.upper()
     else:
         pads = [(int(p), int(p)) for p in padding]
-
+    opad = (output_padding,) * nd if isinstance(output_padding, int) \
+        else tuple(output_padding)
     lhs_spec = "NCHW" if data_format == "NCHW" else "NHWC"
-    dn = jax.lax.conv_dimension_numbers(
-        tuple(x.shape), tuple(weight.shape), (lhs_spec, "IOHW", lhs_spec))
+    # (dimension numbers are built inside f from the TRANSFORMED
+    # kernel's OIHW layout)
+    if output_size is not None and not isinstance(pads, str):
+        # reference semantics: output_size picks the output_padding
+        # implied by out = (in-1)*s - 2p + d(k-1) + 1 + opad
+        sp = [lhs_spec.index(c) for c in "HW"]
+        osize = (output_size,) * nd if isinstance(output_size, int) \
+            else tuple(int(s) for s in output_size)
+        opad = tuple(
+            osize[i] - ((x.shape[sp[i]] - 1) * stride[i]
+                        - pads[i][0] - pads[i][1]
+                        + dilation[i] * (weight.shape[2 + i] - 1) + 1)
+            for i in range(nd))
+        if any(o < 0 or o >= stride[i] for i, o in enumerate(opad)):
+            raise ValueError(
+                f"output_size {osize} unreachable for this "
+                f"stride/padding/kernel (implied output_padding "
+                f"{opad})")
 
     def f(a, w, *b):
         if isinstance(pads, str):
             pad_cfg = pads
         else:
-            # transpose conv padding: SAME-style inverse of forward padding
+            # transpose conv padding: SAME-style inverse of forward
+            # padding; output_padding extends the HIGH side
             pad_cfg = [
                 (dilation[i] * (w.shape[2 + i] - 1) - pads[i][0],
-                 dilation[i] * (w.shape[2 + i] - 1) - pads[i][1])
+                 dilation[i] * (w.shape[2 + i] - 1) - pads[i][1]
+                 + opad[i])
                 for i in range(nd)]
+        # Kernel transpose done manually (jax 0.9 dropped the
+        # transpose_kernel kwarg): the transposed conv IS a forward
+        # conv on the stride-dilated input with the kernel spatially
+        # FLIPPED and its in/out axes swapped. Reference weight layout
+        # is [in, out/groups, kh, kw]; the equivalent forward-conv
+        # kernel is [out, in/groups, kh, kw] (grouped swap).
+        cin, cog = w.shape[0], w.shape[1]
+        wt = w.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = jnp.swapaxes(wt, 1, 2).reshape(
+            (groups * cog, cin // groups) + w.shape[2:])
+        wt = wt[:, :, ::-1, ::-1]
         out = jax.lax.conv_general_dilated(
-            a, jnp.swapaxes(w, 0, 1) if False else w,
-            window_strides=(1, 1), padding=pad_cfg,
+            a, wt, window_strides=(1, 1), padding=pad_cfg,
             lhs_dilation=stride, rhs_dilation=dilation,
             dimension_numbers=jax.lax.conv_dimension_numbers(
-                a.shape, w.shape, (lhs_spec, "IOHW", lhs_spec)),
-            feature_group_count=groups,
-            transpose_kernel=True)
+                a.shape, wt.shape, (lhs_spec, "OIHW", lhs_spec)),
+            feature_group_count=groups)
         if b:
             c_axis = lhs_spec.index("C")
             shape = [1] * out.ndim
@@ -327,6 +355,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         return out
     if bias is not None:
         bias = ensure_tensor(bias)
+        (bias,) = amp_autocast((bias,), "conv")
         return apply(f, x, weight, bias, name="conv2d_transpose")
     return apply(f, x, weight, name="conv2d_transpose")
 
