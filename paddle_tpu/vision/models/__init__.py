@@ -28,3 +28,4 @@ from .convnext import (ConvNeXt, ConvNeXtConfig,  # noqa: F401
                        convnext_tiny, convnext_small, convnext_base)
 from .yolov3 import (YOLOv3, YOLOv3Config, DarkNet53,  # noqa: F401
                      yolov3_darknet53)
+from .unet import UNet, UNetConfig, unet  # noqa: F401

@@ -1,0 +1,102 @@
+"""U-Net semantic-segmentation family.
+
+Reference surface: the Paddle-ecosystem segmentation stack (upstream
+PaddleSeg paddleseg/models/unet.py, unverified — see SURVEY.md §2.2
+"Vision"): double-conv encoder stages with max-pool downsampling,
+transposed-conv upsampling with skip concatenation, and a 1×1
+classifier head; trained with cross-entropy (+ optional dice). The
+end-to-end evidence is a synthetic-mask overfit that must reach high
+IoU (tests/test_models_unet.py).
+
+TPU-first notes:
+- Static-shape conv/pool/transpose-conv chain — one XLA program per
+  image size; the transposed convs ride the grouped-kernel-transpose
+  lowering in nn.functional.conv2d_transpose.
+- Per-pixel cross-entropy reshapes [B, C, H, W] → [B·H·W, C] once; XLA
+  fuses the softmax into the final 1×1 conv epilogue.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ...nn import (BatchNorm2D, Conv2D, Conv2DTranspose, Layer,
+                   LayerList, MaxPool2D, ReLU, Sequential)
+from ...nn import functional as F
+
+__all__ = ["UNet", "UNetConfig", "unet"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 3
+    num_classes: int = 19
+    base_channels: int = 64
+    depth: int = 4   # number of down/up stages
+
+    @staticmethod
+    def tiny(**kw):
+        return UNetConfig(**{**dict(
+            in_channels=1, num_classes=3, base_channels=8,
+            depth=2), **kw})
+
+
+def _double_conv(cin, cout):
+    return Sequential(
+        Conv2D(cin, cout, 3, padding=1, bias_attr=False),
+        BatchNorm2D(cout), ReLU(),
+        Conv2D(cout, cout, 3, padding=1, bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+class UNet(Layer):
+    def __init__(self, cfg: UNetConfig):
+        super().__init__()
+        self.cfg = cfg
+        c = cfg.base_channels
+        self.inc = _double_conv(cfg.in_channels, c)
+        downs = []
+        for i in range(cfg.depth):
+            downs.append(_double_conv(c * 2 ** i, c * 2 ** (i + 1)))
+        self.downs = LayerList(downs)
+        self.pool = MaxPool2D(2)
+        ups, upconvs = [], []
+        for i in reversed(range(cfg.depth)):
+            upconvs.append(Conv2DTranspose(c * 2 ** (i + 1), c * 2 ** i,
+                                           2, stride=2))
+            ups.append(_double_conv(c * 2 ** (i + 1), c * 2 ** i))
+        self.upconvs = LayerList(upconvs)
+        self.ups = LayerList(ups)
+        self.head = Conv2D(c, cfg.num_classes, 1)
+
+    def forward(self, x):
+        """[B, C, H, W] -> per-pixel logits [B, num_classes, H, W]
+        (H, W divisible by 2**depth)."""
+        h = self.inc(x)
+        skips = [h]
+        for down in self.downs:
+            h = down(self.pool(h))
+            skips.append(h)
+        skips.pop()
+        for upconv, up in zip(self.upconvs, self.ups):
+            h = upconv(h)
+            h = up(P.concat([skips.pop(), h], axis=1))
+        return self.head(h)
+
+    def loss(self, logits, labels, dice_weight=0.0):
+        """Per-pixel CE (+ optional dice). labels [B, H, W] int."""
+        c = logits.shape[1]
+        flat = logits.transpose([0, 2, 3, 1]).reshape([-1, c])
+        ce = F.cross_entropy(flat, labels.reshape([-1]))
+        if dice_weight:
+            probs = F.softmax(logits, axis=1)
+            oneh = F.one_hot(labels, c).transpose([0, 3, 1, 2])
+            inter = (probs * oneh).sum(axis=[2, 3])
+            denom = probs.sum(axis=[2, 3]) + oneh.sum(axis=[2, 3])
+            dice = 1.0 - (2.0 * inter / (denom + 1e-5)).mean()
+            ce = ce + dice_weight * dice
+        return ce
+
+
+def unet(num_classes=19, **kw):
+    return UNet(UNetConfig(num_classes=num_classes, **kw))
