@@ -1,0 +1,47 @@
+#!/bin/bash
+# Auto-fire the round capture list at the first healthy chip window.
+# Waits for .chip_ok (written by .chip_watch.py on first successful
+# bounded probe), then waits until .vm_busy is absent (the builder
+# touches .vm_busy during CPU-heavy work — suite runs, big builds —
+# because relay starvation collapses bench numbers; see CLAUDE.md), then
+# RE-PROBES the chip (the .chip_ok may be hours stale after a long
+# vm_busy wait; firing on a dead chip would burn the once-guard on
+# CPU-fallback numbers). Only a fresh successful probe consumes the
+# atomic mkdir once-guard and launches tools/chip_capture_r7.sh
+# (SAFE-FIRST list) detached. If the re-probe fails, .chip_ok is
+# removed, .chip_watch.py is restarted (it exits after its first
+# success), and the chain goes back to waiting.
+# Probe subprocesses are the ONE killable class of chip work (CLAUDE.md)
+# — the `timeout 75` here is safe.
+# No pgrep anywhere (round-4 addenda: self-match hazard).
+set -u
+cd "$(dirname "$0")"
+while true; do
+  while [ ! -f .chip_ok ]; do sleep 30; done
+  echo "$(date -u +%H:%M:%S) chip_ok seen" >> .capture_chain.log
+  while [ -f .vm_busy ]; do sleep 30; done
+  # Tunnel socket BEFORE any device probe (CLAUDE.md round-3b: each
+  # probe burns minutes; connection-refused means no probe can help).
+  if ! timeout 3 python3 -c "import socket; s=socket.socket(); s.settimeout(3); s.connect(('127.0.0.1',8083))" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) tunnel down at fire time; resuming wait" >> .capture_chain.log
+    sleep 60
+    continue
+  fi
+  if timeout 75 python3 -c "import jax; import jax.numpy as jnp; x=(jnp.zeros((8,8))+1).sum(); x.block_until_ready(); print('CHIP-OK', jax.devices()[0].platform)" 2>/dev/null | grep -q CHIP-OK; then
+    if ! mkdir .capture_fired 2>/dev/null; then
+      echo "$(date -u +%H:%M:%S) capture already fired; exiting" >> .capture_chain.log
+      exit 0
+    fi
+    mkdir -p .bench_r4
+    echo "$(date -u +%H:%M:%S) fresh probe OK — firing chip_capture_r7.sh" >> .capture_chain.log
+    setsid bash tools/chip_capture_r7.sh > .bench_r4/capture_r7.log 2>&1 &
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) stale .chip_ok (re-probe failed); resuming watch" >> .capture_chain.log
+  rm -f .chip_ok
+  # Restart the watcher only if it looks dead (it logs every ~4-5 min;
+  # a live watcher would double the probe cadence if restarted).
+  if [ ! -f .chip_watch.log ] || [ -n "$(find .chip_watch.log -mmin +7)" ]; then
+    setsid python3 .chip_watch.py > /dev/null 2>&1 &
+  fi
+done
