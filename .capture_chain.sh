@@ -27,7 +27,7 @@ while true; do
     sleep 60
     continue
   fi
-  if timeout 75 python3 -c "import jax; import jax.numpy as jnp; x=(jnp.zeros((8,8))+1).sum(); x.block_until_ready(); print('CHIP-OK', jax.devices()[0].platform)" 2>/dev/null | grep -q CHIP-OK; then
+  if timeout 75 python3 -c "import jax; import jax.numpy as jnp; x=(jnp.zeros((8,8))+1).sum(); x.block_until_ready(); print('CHIP-OK', jax.devices()[0].platform)" 2>/dev/null | grep -qE 'CHIP-OK (axon|tpu)'; then
     if ! mkdir .capture_fired 2>/dev/null; then
       echo "$(date -u +%H:%M:%S) capture already fired; exiting" >> .capture_chain.log
       exit 0

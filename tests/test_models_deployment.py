@@ -1,0 +1,68 @@
+"""Round-7 families through the DEPLOYMENT stack: jit.save → StableHLO
+→ inference Predictor, output parity vs the eager model — the workflow
+a migrating user ships with (reference: save_inference_model +
+paddle.inference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit.save_load import InputSpec
+
+
+def _roundtrip(net, x, tmp_path, name):
+    net.eval()
+    expect = np.asarray(net(P.to_tensor(x))._data)
+    prefix = str(tmp_path / name)
+    P.jit.save(net, prefix,
+               input_spec=[InputSpec(list(x.shape), "float32")])
+    outs = create_predictor(Config(prefix)).run([x])
+    np.testing.assert_allclose(outs[0], expect, rtol=2e-4, atol=2e-4)
+    return outs[0]
+
+
+class TestNewFamiliesDeploy:
+    def test_vit_deploys(self, tmp_path):
+        from paddle_tpu.vision.models import VisionTransformer, ViTConfig
+        P.seed(0)
+        net = VisionTransformer(ViTConfig.tiny())
+        x = np.random.default_rng(0).standard_normal(
+            (2, 3, 32, 32)).astype(np.float32)
+        out = _roundtrip(net, x, tmp_path, "vit")
+        assert out.shape == (2, 10)
+
+    def test_swin_deploys(self, tmp_path):
+        from paddle_tpu.vision.models import SwinTransformer, SwinConfig
+        P.seed(1)
+        net = SwinTransformer(SwinConfig.tiny())
+        x = np.random.default_rng(1).standard_normal(
+            (1, 3, 32, 32)).astype(np.float32)
+        out = _roundtrip(net, x, tmp_path, "swin")
+        assert out.shape == (1, 10)
+
+    def test_convnext_deploys(self, tmp_path):
+        from paddle_tpu.vision.models import ConvNeXt, ConvNeXtConfig
+        P.seed(2)
+        net = ConvNeXt(ConvNeXtConfig.tiny())
+        x = np.random.default_rng(2).standard_normal(
+            (1, 3, 32, 32)).astype(np.float32)
+        out = _roundtrip(net, x, tmp_path, "convnext")
+        assert out.shape == (1, 10)
+
+    def test_unet_deploys(self, tmp_path):
+        from paddle_tpu.vision.models import UNet, UNetConfig
+        P.seed(3)
+        net = UNet(UNetConfig.tiny())
+        x = np.random.default_rng(3).standard_normal(
+            (1, 1, 32, 32)).astype(np.float32)
+        out = _roundtrip(net, x, tmp_path, "unet")
+        assert out.shape == (1, 3, 32, 32)
+
+    def test_wav2vec2_encoder_deploys(self, tmp_path):
+        from paddle_tpu.models import Wav2Vec2Config, Wav2Vec2ForCTC
+        P.seed(4)
+        net = Wav2Vec2ForCTC(Wav2Vec2Config.tiny())
+        x = np.random.default_rng(4).standard_normal(
+            (1, 800)).astype(np.float32) * 0.1
+        out = _roundtrip(net, x, tmp_path, "w2v")
+        assert out.shape[0] == 1 and out.shape[2] == 32
