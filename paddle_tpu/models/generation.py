@@ -81,12 +81,16 @@ def _quantize_q8(x):
     return codes, s
 
 
-def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
+def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale,
+                     window=None):
     """Write k/v at `offset` into the static cache and attend q over the
     whole buffer with the absolute-position causal mask.
 
     q: [B, S, H, D]; k_new/v_new: [B, S, KV, D];
     k_buf/v_buf: [B, T, KV, D]; offset: scalar int (traced ok).
+    `window`: Mistral-style sliding window — keys older than
+    qpos-window+1 are masked out (the cache stays full-length; entries
+    beyond the band are simply never attended).
     Returns (out [B, S, H, D], k_buf, v_buf).
     """
     b, s, nh, d = q.shape
@@ -137,6 +141,8 @@ def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale):
     qpos = off + jnp.arange(s)
     kpos = jnp.arange(T)
     mask = kpos[None, :] <= qpos[:, None]            # [S, T]
+    if window is not None:
+        mask = mask & (kpos[None, :] > qpos[:, None] - int(window))
     sc = jnp.where(mask[None, None, None], sc, -jnp.inf)
     p = jax.nn.softmax(sc, axis=-1)
     if vf is None:  # int8: fold v scales into the probabilities ([T] axis)

@@ -64,6 +64,11 @@ class LlamaConfig:
     # 'sep' (SPMDTrainer wires this when sep_degree > 1); both degrade
     # to dense attention when no sep axis is live.
     context_parallel: str | None = None
+    # Mistral-style sliding-window attention (reference: PaddleNLP
+    # mistral family): each token attends to at most `sliding_window`
+    # previous positions. Training rides the FlashMask window bounds
+    # (O(Sk) memory); cached decode bands the absolute-position mask.
+    sliding_window: int | None = None
     recompute: bool = False
     recompute_granularity: str = "full"
     dtype: str = "float32"
@@ -94,6 +99,17 @@ class LlamaConfig:
         return LlamaConfig(**{**dict(
             hidden_size=5120, intermediate_size=13824,
             num_hidden_layers=40, num_attention_heads=40), **kw})
+
+    @staticmethod
+    def mistral_7b(**kw):
+        # Mistral-7B v0.1 pairing: rope_theta stays 1e4 WITH the 4096
+        # sliding window (v0.2/v0.3 moved to theta=1e6 and DISABLED the
+        # window — pass sliding_window=None, rope_theta=1e6 for those)
+        return LlamaConfig(**{**dict(
+            hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=32768,
+            sliding_window=4096), **kw})
 
     @staticmethod
     def tiny(**kw):
@@ -195,6 +211,26 @@ class LlamaAttention(Layer):
                     else ulysses_attention
                 out = cp(q, k, v, causal=True)
                 return self.o_proj(out.reshape([b, s, nh * hd]))
+        sw = self.cfg.sliding_window
+        if sw:
+            # loud guards, not silent drops (file convention): the
+            # window only composes with causal flash/flashmask and the
+            # static-cache decode path
+            if cache is not None:
+                raise NotImplementedError(
+                    "sliding_window with the concat-cache forward is "
+                    "not supported; decode through generate()'s "
+                    "static-cache path (which bands the mask)")
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "sliding_window does not compose with a dense "
+                    "attn_mask; use packed sequences via "
+                    "attn_mask_startend_row_indices (FlashMask folds "
+                    "the window into the column bounds)")
+            if self.cfg.context_parallel:
+                raise NotImplementedError(
+                    "sliding_window with context_parallel is not "
+                    "wired yet")
         if startend_row_indices is not None:
             # FlashMask (reference: attn_mask_startend_row_indices) —
             # compact column bounds at O(Sk) memory, kernel-native
@@ -214,9 +250,30 @@ class LlamaAttention(Layer):
                     "attn_mask_startend_row_indices does not compose "
                     "with context_parallel yet")
             from ..ops.pallas.flash_attention import flashmask_attention
+            # Mistral's sliding_window counts SELF among the w visible
+            # positions; flashmask's window_size counts w positions
+            # BEFORE self — hence the w-1 bridge (test-covered)
             out = flashmask_attention(
                 q, k, v, startend_row_indices=startend_row_indices,
-                causal=causal)
+                causal=causal,
+                window_size=(int(sw) - 1 if sw else None))
+        elif sw and self.cfg.use_flash_attention:
+            from ..ops.pallas.flash_attention import flashmask_attention
+            out = flashmask_attention(q, k, v, causal=True,
+                                      window_size=int(sw) - 1)
+        elif sw:
+            # XLA debug path: dense banded additive mask
+            import jax.numpy as _jnp
+            qp = _jnp.arange(s)[:, None]
+            kp = _jnp.arange(s)[None, :]
+            band = _jnp.where((kp <= qp) & (kp > qp - int(sw)), 0.0,
+                              -1e9).astype(_jnp.float32)
+            if nkv != nh:
+                k, v = _repeat_kv(k, v, nh // nkv)
+            from ..core.autograd import apply as _apply
+            out = _apply(_ref_attn_fn(False, True), q, k, v,
+                         Tensor(band[None, None]),
+                         name="attention_ref")
         elif self.cfg.use_flash_attention:
             # GQA: K/V go in at their NATIVE head count — the Pallas
             # kernel indexes KV heads in its BlockSpec maps (round-3;
@@ -269,7 +326,7 @@ class LlamaAttention(Layer):
             rotary_emb_base=self.cfg.rope_theta)
         out, k_buf, v_buf = cached_attention(
             q._data, k._data, v._data, k_buf, v_buf, offset,
-            1.0 / (hd ** 0.5))
+            1.0 / (hd ** 0.5), window=self.cfg.sliding_window)
         out = Tensor(out).reshape([b, s, nh * hd])
         return self.o_proj(out), k_buf, v_buf
 
