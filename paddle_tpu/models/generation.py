@@ -141,7 +141,8 @@ def cached_attention(q, k_new, v_new, k_buf, v_buf, offset, scale,
     qpos = off + jnp.arange(s)
     kpos = jnp.arange(T)
     mask = kpos[None, :] <= qpos[:, None]            # [S, T]
-    if window is not None:
+    if window:  # 0/None both mean disabled (an all-False band would
+        # -inf every score and NaN the softmax)
         mask = mask & (kpos[None, :] > qpos[:, None] - int(window))
     sc = jnp.where(mask[None, None, None], sc, -jnp.inf)
     p = jax.nn.softmax(sc, axis=-1)

@@ -179,6 +179,12 @@ class LlamaAttention(Layer):
             v = P.concat([cache[1], v], axis=1)
             cache = (k, v)
         causal = cache is None
+        if self.cfg.sliding_window and self.cfg.context_parallel:
+            # must precede the context-parallel branch: a live sep axis
+            # would otherwise return full-causal attention below and
+            # silently drop the window
+            raise NotImplementedError(
+                "sliding_window with context_parallel is not wired yet")
         if self.cfg.context_parallel and cache is None:
             if self.cfg.context_parallel not in ("ring", "ulysses"):
                 raise ValueError(
@@ -227,10 +233,6 @@ class LlamaAttention(Layer):
                     "attn_mask; use packed sequences via "
                     "attn_mask_startend_row_indices (FlashMask folds "
                     "the window into the column bounds)")
-            if self.cfg.context_parallel:
-                raise NotImplementedError(
-                    "sliding_window with context_parallel is not "
-                    "wired yet")
         if startend_row_indices is not None:
             # FlashMask (reference: attn_mask_startend_row_indices) —
             # compact column bounds at O(Sk) memory, kernel-native
@@ -326,7 +328,8 @@ class LlamaAttention(Layer):
             rotary_emb_base=self.cfg.rope_theta)
         out, k_buf, v_buf = cached_attention(
             q._data, k._data, v._data, k_buf, v_buf, offset,
-            1.0 / (hd ** 0.5), window=self.cfg.sliding_window)
+            1.0 / (hd ** 0.5),
+            window=(self.cfg.sliding_window or None))
         out = Tensor(out).reshape([b, s, nh * hd])
         return self.o_proj(out), k_buf, v_buf
 

@@ -56,3 +56,8 @@ class TestExamples:
                            args=("--cpu", "--mesh"), timeout=540)
         assert "global-batch(mesh dp=4)" in out
         assert "CLIP contrastive training OK" in out
+
+    def test_asr_whisper(self):
+        out = _run_example("asr_whisper.py", args=("--cpu", "--steps", "80"),
+                           timeout=600)
+        assert "ASR training OK" in out
