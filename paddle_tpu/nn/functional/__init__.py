@@ -663,7 +663,10 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         pad = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] +
                       [(0, 0)] * (a.ndim - 2))
         acc = sum(pad[:, i:i + c] for i in range(size))
-        return a / (k + alpha * acc) ** beta
+        # reference semantics (and torch's): alpha scales the window
+        # MEAN, not the raw sum — paddle computes the window term via
+        # avg_pool, i.e. divides by `size`
+        return a / (k + alpha * acc / size) ** beta
     return apply(f, x, name="local_response_norm")
 
 # ---------------------------------------------------------------------------
@@ -810,12 +813,15 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     loss, mask = apply(f, input, lab, name="cross_entropy")
     mask = mask.detach()
     if w is not None:
-        wt = apply(lambda ww, li: jnp.take(ww, li, axis=0), w, lab,
-                   name="ce_weight")
+        # safe gather: ignore_index is out of bounds and jnp.take's
+        # fill mode would inject NaN (0·NaN poisons the masked row)
+        wt = apply(lambda ww, li: jnp.take(
+            ww, jnp.where(li == ignore_index, 0, li), axis=0),
+            w, lab, name="ce_weight")
+        wt = wt * mask.astype(wt.dtype)
         loss = loss * wt
         if reduction == "mean":
-            denom = (wt * mask.astype(wt.dtype)).sum()
-            return loss.sum() / denom
+            return loss.sum() / wt.sum()
     if reduction == "mean":
         denom = mask.astype(loss.dtype).sum()
         return loss.sum() / denom
@@ -844,10 +850,16 @@ def _nll_impl(input, label, weight, ignore_index, reduction):
     mask = mask.detach()
     if weight is not None:
         w = ensure_tensor(weight)
-        wt = apply(lambda ww, li: jnp.take(ww, li, axis=0), w, label)
+        # gather weights at a SAFE index: ignore_index (-100) is out of
+        # bounds, and jnp.take's fill mode would yield NaN, which then
+        # poisons the masked-out row's 0·NaN product
+        wt = apply(lambda ww, li: jnp.take(
+            ww, jnp.where(li == ignore_index, 0, li), axis=0),
+            w, label)
+        wt = wt * mask.astype(wt.dtype)
         loss = loss * wt
         if reduction == "mean":
-            return loss.sum() / (wt * mask.astype(wt.dtype)).sum()
+            return loss.sum() / wt.sum()
     if reduction == "mean":
         return loss.sum() / mask.astype(loss.dtype).sum()
     if reduction == "sum":
