@@ -373,19 +373,39 @@ def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode, mean_div,
         p = [(0, 0), (0, 0), (padding, padding), (padding, padding)]
     elif isinstance(padding, str):
         p = padding.upper()
+        if ceil_mode:
+            raise NotImplementedError(
+                f"{name}: ceil_mode with string padding is not "
+                "supported")
     else:
         p = [(0, 0), (0, 0)] + [(int(a), int(a)) for a in padding]
+    if ceil_mode and not isinstance(p, str):
+        # include the last partial window (reference/torch semantics):
+        # extend the HIGH pad so out = ceil((size+2p-k)/s)+1, clamped so
+        # the last window still STARTS inside input+pad_low. Extra pad
+        # uses `init` (max: -inf) and contributes 0 to the avg count —
+        # exactly the exclusive divisor the reference uses.
+        for i in (0, 1):
+            size = int(x.shape[2 + i])
+            lo, hi = p[2 + i]
+            span = size + lo + hi - k[i]
+            out_floor = span // s[i] + 1
+            out_ceil = -(-span // s[i]) + 1
+            if out_ceil > out_floor and \
+                    (out_ceil - 1) * s[i] < size + lo:
+                p[2 + i] = (lo, hi + (out_ceil - 1) * s[i] + k[i]
+                            - size - lo - hi)
 
     def f(a):
         window = (1, 1) + k
         strides = (1, 1) + s
+        pad_cfg = p if isinstance(p, str) else p
         out = jax.lax.reduce_window(a, init, reducer, window, strides,
-                                    p if isinstance(p, str) else p)
+                                    pad_cfg)
         if mean_div:
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                        strides,
-                                        p if isinstance(p, str) else p)
+                                        strides, pad_cfg)
             out = out / cnt
         return out
     return apply(f, x, name=name)
@@ -461,7 +481,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return out.squeeze(-1), mask.squeeze(-1)
     out = max_pool2d(x.unsqueeze(-1), (kernel_size, 1),
                      (stride or kernel_size, 1),
-                     (padding, 0) if isinstance(padding, int) else padding)
+                     (padding, 0) if isinstance(padding, int) else padding,
+                     ceil_mode=ceil_mode)
     return out.squeeze(-1)
 
 
@@ -470,7 +491,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
     x = ensure_tensor(x)
     out = avg_pool2d(x.unsqueeze(-1), (kernel_size, 1),
                      (stride or kernel_size, 1),
-                     (padding, 0) if isinstance(padding, int) else padding)
+                     (padding, 0) if isinstance(padding, int) else padding,
+                     ceil_mode=ceil_mode)
     return out.squeeze(-1)
 
 

@@ -13,10 +13,12 @@ class MaxPool2D(Layer):
         super().__init__()
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.ceil_mode = padding, ceil_mode
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode)
+                            self.ceil_mode,
+                            return_mask=self.return_mask)
 
 
 class AvgPool2D(Layer):
@@ -38,9 +40,13 @@ class MaxPool1D(Layer):
         super().__init__()
         self.kernel_size, self.stride, self.padding = (kernel_size, stride,
                                                        padding)
+        self.return_mask = return_mask
+        self.ceil_mode = ceil_mode
 
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool1D(Layer):
@@ -67,9 +73,11 @@ class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     return_mask=self.return_mask)
 
 
 class AdaptiveAvgPool1D(Layer):
