@@ -191,6 +191,27 @@ class Dirichlet(Distribution):
     def __init__(self, concentration):
         self.concentration = ensure_tensor(concentration)
 
+    @property
+    def mean(self):
+        return apply(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            return c * (a0 - c) / (a0 ** 2 * (a0 + 1))
+        return apply(f, self.concentration)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.concentration)
+
+        def f(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), -1))
+        return apply(f, value, self.concentration)
+
     def sample(self, shape=()):
         k = next_key()
         return Tensor(jrandom.dirichlet(k, self.concentration._data,
@@ -228,6 +249,19 @@ class Gumbel(Distribution):
         self.loc = ensure_tensor(loc)
         self.scale = ensure_tensor(scale, ref=self.loc)
 
+    @property
+    def mean(self):
+        return apply(lambda m, s: m + s * 0.5772156649015329,
+                     self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.loc)
+
+        def f(v, m, s):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply(f, value, self.loc, self.scale)
+
     def sample(self, shape=()):
         k = next_key()
         shp = tuple(shape) + tuple(self.loc.shape)
@@ -256,6 +290,11 @@ class LogNormal(Distribution):
     def __init__(self, loc, scale):
         self.base = Normal(loc, scale)
 
+    def log_prob(self, value):
+        import paddle_tpu as P
+        value = ensure_tensor(value)
+        return self.base.log_prob(P.log(value)) - P.log(value)
+
     def sample(self, shape=()):
         import paddle_tpu as P
         return P.exp(self.base.sample(shape))
@@ -266,18 +305,46 @@ class Multinomial(Distribution):
         self.total_count = total_count
         self.probs_t = ensure_tensor(probs)
 
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.probs_t)
+
+        def f(v, p):
+            p = p / jnp.sum(p, -1, keepdims=True)  # reference normalizes
+            n = float(self.total_count)
+            return (jax.scipy.special.gammaln(n + 1)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                    + jnp.sum(jax.scipy.special.xlogy(v, p), -1))
+        return apply(f, value, self.probs_t)
+
     def sample(self, shape=()):
         k = next_key()
-        out = jrandom.multinomial(
-            k, self.total_count,
-            self.probs_t._data, shape=tuple(shape) +
-            tuple(self.probs_t.shape[:-1]) if shape else None)
+        p = self.probs_t._data
+        p = p / jnp.sum(p, -1, keepdims=True)  # reference normalizes
+        # jax multinomial's `shape` is the FULL output shape (batch +
+        # category dim) and `n` must broadcast over the batch
+        full = tuple(shape) + tuple(p.shape)
+        n = jnp.full(full[:-1], float(self.total_count))
+        out = jrandom.multinomial(k, n, p, shape=full)
         return Tensor(out)
 
 
 class Poisson(Distribution):
     def __init__(self, rate):
         self.rate = ensure_tensor(rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def log_prob(self, value):
+        value = ensure_tensor(value, ref=self.rate)
+        return apply(lambda v, r: v * jnp.log(r) - r
+                     - jax.scipy.special.gammaln(v + 1),
+                     value, self.rate)
 
     def sample(self, shape=()):
         k = next_key()

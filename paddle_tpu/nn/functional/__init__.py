@@ -364,7 +364,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode, mean_div,
-            name):
+            name, exclusive=True):
     x = ensure_tensor(x)
     k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
     stride = stride or k
@@ -399,13 +399,16 @@ def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode, mean_div,
     def f(a):
         window = (1, 1) + k
         strides = (1, 1) + s
-        pad_cfg = p if isinstance(p, str) else p
+        pad_cfg = p
         out = jax.lax.reduce_window(a, init, reducer, window, strides,
                                     pad_cfg)
         if mean_div:
-            ones = jnp.ones_like(a)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                        strides, pad_cfg)
+            if exclusive:  # divide by the VALID element count
+                ones = jnp.ones_like(a)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                            window, strides, pad_cfg)
+            else:          # reference exclusive=False: full window size
+                cnt = float(k[0] * k[1])
             out = out / cnt
         return out
     return apply(f, x, name=name)
@@ -466,7 +469,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool2d(x, kernel_size, stride, padding, jax.lax.add, 0.0,
-                   ceil_mode, True, "avg_pool2d")
+                   ceil_mode, True, "avg_pool2d", exclusive=exclusive)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -492,7 +495,7 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
     out = avg_pool2d(x.unsqueeze(-1), (kernel_size, 1),
                      (stride or kernel_size, 1),
                      (padding, 0) if isinstance(padding, int) else padding,
-                     ceil_mode=ceil_mode)
+                     ceil_mode=ceil_mode, exclusive=exclusive)
     return out.squeeze(-1)
 
 

@@ -28,10 +28,11 @@ class AvgPool2D(Layer):
         super().__init__()
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.ceil_mode = padding, ceil_mode
+        self.exclusive = exclusive
 
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode)
+                            self.ceil_mode, exclusive=self.exclusive)
 
 
 class MaxPool1D(Layer):
@@ -55,9 +56,12 @@ class AvgPool1D(Layer):
         super().__init__()
         self.kernel_size, self.stride, self.padding = (kernel_size, stride,
                                                        padding)
+        self.exclusive, self.ceil_mode = exclusive, ceil_mode
 
     def forward(self, x):
-        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode)
 
 
 class AdaptiveAvgPool2D(Layer):

@@ -1,6 +1,9 @@
 """Vision transforms (reference: paddle.vision.transforms — upstream,
-unverified; see SURVEY.md §2.2). Operate on numpy CHW float arrays (host
-side, pre-device-transfer, as the reference does on PIL/cv2 images).
+unverified; see SURVEY.md §2.2). Host-side numpy, layout-ADAPTIVE like
+the reference pipeline: a 3-D array whose LAST dim is 1/3/4 is treated
+as HWC (the PIL/cv2 convention the reference's geometric transforms see
+before ToTensor/Transpose), anything else as CHW. Geometric transforms
+(crops, pads, flips) resolve their spatial axes per input.
 """
 from __future__ import annotations
 
@@ -9,6 +12,21 @@ import numpy as np
 __all__ = ["Compose", "Normalize", "ToTensor", "Transpose", "Resize",
            "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
            "RandomVerticalFlip", "BrightnessTransform", "Pad"]
+
+
+
+
+def _hwc(img):
+    """True when a 3-D array is HWC (last dim a channel count) — the
+    layout the reference's geometric transforms always see (PIL/cv2,
+    pre-ToTensor). When both first and last dims look channel-like the
+    HWC reading wins, matching the reference pipeline order."""
+    return img.ndim == 3 and img.shape[-1] in (1, 3, 4)
+
+
+def _spatial(img):
+    """(h_axis, w_axis) for this layout."""
+    return (0, 1) if _hwc(img) else (img.ndim - 2, img.ndim - 1)
 
 
 class Compose:
@@ -69,7 +87,7 @@ class Resize:
         import jax
         import jax.numpy as jnp
         arr = jnp.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = arr.ndim == 3 and not _hwc(arr)
         if chw:
             out_shape = (arr.shape[0],) + self.size
         else:
@@ -86,14 +104,20 @@ class RandomCrop:
 
     def __call__(self, img):
         img = np.asarray(img)
+        ha, wa = _spatial(img)
         if self.padding:
             p = self.padding
-            img = np.pad(img, [(0, 0), (p, p), (p, p)], mode="constant")
-        h, w = img.shape[-2:]
+            cfg = [(0, 0)] * img.ndim
+            cfg[ha] = cfg[wa] = (p, p)
+            img = np.pad(img, cfg, mode="constant")
+        h, w = img.shape[ha], img.shape[wa]
         th, tw = self.size
         i = self._rng.integers(0, h - th + 1)
         j = self._rng.integers(0, w - tw + 1)
-        return img[..., i:i + th, j:j + tw]
+        sl = [slice(None)] * img.ndim
+        sl[ha] = slice(i, i + th)
+        sl[wa] = slice(j, j + tw)
+        return img[tuple(sl)]
 
 
 class CenterCrop:
@@ -102,11 +126,15 @@ class CenterCrop:
 
     def __call__(self, img):
         img = np.asarray(img)
-        h, w = img.shape[-2:]
+        ha, wa = _spatial(img)
+        h, w = img.shape[ha], img.shape[wa]
         th, tw = self.size
         i = (h - th) // 2
         j = (w - tw) // 2
-        return img[..., i:i + th, j:j + tw]
+        sl = [slice(None)] * img.ndim
+        sl[ha] = slice(i, i + th)
+        sl[wa] = slice(j, j + tw)
+        return img[tuple(sl)]
 
 
 class RandomHorizontalFlip:
@@ -116,7 +144,8 @@ class RandomHorizontalFlip:
 
     def __call__(self, img):
         if self._rng.random() < self.prob:
-            return np.asarray(img)[..., ::-1].copy()
+            img = np.asarray(img)
+            return np.flip(img, axis=_spatial(img)[1]).copy()
         return img
 
 
@@ -127,7 +156,8 @@ class RandomVerticalFlip:
 
     def __call__(self, img):
         if self._rng.random() < self.prob:
-            return np.asarray(img)[..., ::-1, :].copy()
+            img = np.asarray(img)
+            return np.flip(img, axis=_spatial(img)[0]).copy()
         return img
 
 
@@ -148,12 +178,16 @@ class Pad:
         self.padding = padding
 
     def __call__(self, img):
+        img = np.asarray(img)
+        ha, wa = _spatial(img)
         p = self.padding
+        cfg = [(0, 0)] * img.ndim
         if isinstance(p, int):
-            cfg = [(0, 0), (p, p), (p, p)]
-        else:
-            cfg = [(0, 0), (p[1], p[3]), (p[0], p[2])]
-        return np.pad(np.asarray(img), cfg, mode="constant")
+            cfg[ha] = cfg[wa] = (p, p)
+        else:  # reference order: (left, top, right, bottom)
+            cfg[ha] = (p[1], p[3])
+            cfg[wa] = (p[0], p[2])
+        return np.pad(img, cfg, mode="constant")
 
 
 from . import functional  # noqa: E402
@@ -176,7 +210,7 @@ class RandomResizedCrop:
     def __call__(self, img):
         import random as _r
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = arr.ndim == 3 and not _hwc(arr)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         area = h * w
         for _ in range(10):
@@ -276,7 +310,7 @@ class RandomErasing:
         if _r.random() > self.prob:
             return img
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = arr.ndim == 3 and not _hwc(arr)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         for _ in range(10):
             target = h * w * _r.uniform(*self.scale)
@@ -302,7 +336,7 @@ class RandomAffine:
     def __call__(self, img):
         import random as _r
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = arr.ndim == 3 and not _hwc(arr)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         angle = _r.uniform(*self.degrees)
         tx = ty = 0
@@ -328,7 +362,7 @@ class RandomPerspective:
         if _r.random() > self.prob:
             return img
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        chw = arr.ndim == 3 and not _hwc(arr)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         dx = self.d * w / 2
         dy = self.d * h / 2

@@ -21,9 +21,12 @@ def _chw(img):
     img = np.asarray(img, dtype=np.float32)
     if img.ndim == 2:
         return img[None], "HW"
-    if img.ndim == 3 and img.shape[0] in (1, 3, 4):
-        return img, "CHW"
-    return np.transpose(img, (2, 0, 1)), "HWC"
+    # HWC (PIL/cv2 convention, what the reference's transforms see
+    # pre-ToTensor) wins when both dims look channel-like — matches
+    # the geometric transforms' _hwc heuristic
+    if img.ndim == 3 and img.shape[-1] in (1, 3, 4):
+        return np.transpose(img, (2, 0, 1)), "HWC"
+    return img, "CHW"
 
 
 def _restore(img, fmt):

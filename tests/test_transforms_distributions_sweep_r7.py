@@ -1,0 +1,144 @@
+"""Round-7 coverage sweep: vision.transforms (numpy/torchvision-free
+oracles) and distribution families never named in tests (scipy
+oracles). Same audit class as the functional/layer sweeps."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.vision import transforms as T
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+rng = np.random.default_rng(13)
+
+
+def _img(h=16, w=12):
+    return rng.integers(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+class TestTransforms:
+    def test_to_tensor_scales_and_chw(self):
+        img = _img()
+        t = T.ToTensor()(img)
+        assert t.shape == (3, 16, 12)
+        np.testing.assert_allclose(
+            np.asarray(t), img.transpose(2, 0, 1) / 255.0, atol=1e-6)
+
+    def test_normalize(self):
+        x = rng.random((3, 8, 8)).astype(np.float32)
+        out = T.Normalize(mean=[0.5, 0.4, 0.3],
+                          std=[0.2, 0.3, 0.4])(x)
+        ref = (x - np.array([0.5, 0.4, 0.3])[:, None, None]) \
+            / np.array([0.2, 0.3, 0.4])[:, None, None]
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+    def test_center_and_random_crop(self):
+        img = _img(17, 13)
+        c = T.CenterCrop((8, 6))(img)
+        assert np.asarray(c).shape[:2] == (8, 6)
+        # center crop content: offset floor((17-8)/2)=4, floor((13-6)/2)=3
+        np.testing.assert_array_equal(np.asarray(c),
+                                      img[4:12, 3:9])
+        P.seed(0)
+        r = T.RandomCrop((8, 6))(img)
+        assert np.asarray(r).shape[:2] == (8, 6)
+
+    def test_flips_deterministic_at_p1(self):
+        img = _img()
+        h = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(np.asarray(h), img[:, ::-1])
+        v = T.RandomVerticalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(np.asarray(v), img[::-1])
+
+    def test_pad_and_transpose_and_gray(self):
+        img = _img(4, 5)
+        p = np.asarray(T.Pad(2)(img))
+        assert p.shape[:2] == (8, 9)
+        np.testing.assert_array_equal(p[2:6, 2:7], img)
+        tr = np.asarray(T.Transpose()(img.astype(np.float32)))
+        assert tr.shape == (3, 4, 5)
+        g = np.asarray(T.Grayscale()(img))
+        assert g.shape[2] == 1
+        ref = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+               + 0.114 * img[..., 2])
+        np.testing.assert_allclose(g[..., 0].astype(np.float32), ref,
+                                   atol=1.0)
+
+    def test_color_jitters_identity_at_one(self):
+        img = _img().astype(np.float32)
+        for cls in (T.BrightnessTransform, T.ContrastTransform,
+                    T.SaturationTransform):
+            out = np.asarray(cls(0.0)(img))  # zero jitter = identity
+            np.testing.assert_allclose(out, img, atol=1e-3)
+
+    def test_compose_chains(self):
+        img = _img()
+        pipe = T.Compose([T.Resize((8, 8)), T.ToTensor()])
+        out = pipe(img)
+        assert np.asarray(out).shape == (3, 8, 8)
+
+
+class TestDistributions:
+    def test_dirichlet_moments_and_logprob(self):
+        from paddle_tpu.distribution import Dirichlet
+        conc = np.array([2.0, 3.0, 5.0], np.float32)
+        d = Dirichlet(P.to_tensor(conc))
+        np.testing.assert_allclose(np.asarray(d.mean._data),
+                                   conc / conc.sum(), atol=1e-6)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        ref = scipy_stats.dirichlet.logpdf(x, conc)
+        got = float(d.log_prob(P.to_tensor(x)))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        P.seed(0)
+        s = np.asarray(d.sample([2000])._data)
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), conc / conc.sum(),
+                                   atol=0.05)
+
+    def test_gumbel_lognormal_poisson_logprobs(self):
+        from paddle_tpu.distribution import Gumbel, LogNormal, Poisson
+        g = Gumbel(P.to_tensor(1.0), P.to_tensor(2.0))
+        ref = scipy_stats.gumbel_r.logpdf(2.5, loc=1.0, scale=2.0)
+        np.testing.assert_allclose(
+            float(g.log_prob(P.to_tensor(2.5))), ref, atol=1e-5)
+        ln = LogNormal(P.to_tensor(0.3), P.to_tensor(0.8))
+        ref2 = scipy_stats.lognorm.logpdf(1.7, 0.8,
+                                          scale=np.exp(0.3))
+        np.testing.assert_allclose(
+            float(ln.log_prob(P.to_tensor(1.7))), ref2, atol=1e-5)
+        po = Poisson(P.to_tensor(3.5))
+        ref3 = scipy_stats.poisson.logpmf(2, 3.5)
+        np.testing.assert_allclose(
+            float(po.log_prob(P.to_tensor(2.0))), ref3, atol=1e-5)
+
+    def test_multinomial_logprob_and_sample(self):
+        from paddle_tpu.distribution import Multinomial
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        m = Multinomial(10, P.to_tensor(probs))
+        x = np.array([2.0, 3.0, 5.0], np.float32)
+        ref = scipy_stats.multinomial.logpmf(x, 10, probs)
+        np.testing.assert_allclose(float(m.log_prob(P.to_tensor(x))),
+                                   ref, atol=1e-5)
+        P.seed(1)
+        s = np.asarray(m.sample([500])._data)
+        assert (s.sum(-1) == 10).all()
+        np.testing.assert_allclose(s.mean(0), 10 * probs, atol=0.5)
+
+    def test_transforms_compose(self):
+        from paddle_tpu.distribution import (ChainTransform,
+                                             ExpTransform,
+                                             PowerTransform,
+                                             SoftmaxTransform)
+        t = ChainTransform([ExpTransform(),
+                            PowerTransform(P.to_tensor(2.0))])
+        x = P.to_tensor(np.array([0.5, 1.0], np.float32))
+        y = np.asarray(t.forward(x)._data)
+        np.testing.assert_allclose(y, np.exp([0.5, 1.0]) ** 2,
+                                   rtol=1e-5)
+        back = np.asarray(t.inverse(t.forward(x))._data)
+        np.testing.assert_allclose(back, [0.5, 1.0], atol=1e-5)
+        sm = SoftmaxTransform()
+        z = P.to_tensor(np.array([1.0, 2.0, 0.5], np.float32))
+        out = np.asarray(sm.forward(z)._data)
+        e = np.exp(np.array([1.0, 2.0, 0.5]) - 2.0)
+        np.testing.assert_allclose(out, e / e.sum(), rtol=1e-5)
