@@ -302,7 +302,12 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     lhs_spec = "NCHW" if data_format == "NCHW" else "NHWC"
     # (dimension numbers are built inside f from the TRANSFORMED
     # kernel's OIHW layout)
-    if output_size is not None and not isinstance(pads, str):
+    if output_size is not None and isinstance(pads, str):
+        raise NotImplementedError(
+            "conv2d_transpose: output_size with string padding is not "
+            "supported (the implied output_padding needs explicit "
+            "pad amounts)")
+    if output_size is not None:
         # reference semantics: output_size picks the output_padding
         # implied by out = (in-1)*s - 2p + d(k-1) + 1 + opad
         sp = [lhs_spec.index(c) for c in "HW"]
@@ -364,7 +369,16 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode, mean_div,
-            name, exclusive=True):
+            name, exclusive=True, data_format="NCHW",
+            divisor_override=None):
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            f"{name}: data_format={data_format!r} is not supported "
+            "(NCHW only — a silent NHWC pool would reduce W and C "
+            "together)")
+    if divisor_override is not None:
+        raise NotImplementedError(
+            f"{name}: divisor_override is not supported")
     x = ensure_tensor(x)
     k = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
     stride = stride or k
@@ -420,7 +434,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
         return _max_pool2d_with_mask(x, kernel_size, stride, padding,
                                      ceil_mode)
     return _pool2d(x, kernel_size, stride, padding, jax.lax.max,
-                   -jnp.inf, ceil_mode, False, "max_pool2d")
+                   -jnp.inf, ceil_mode, False, "max_pool2d",
+                   data_format=data_format)
 
 
 def _max_pool2d_with_mask(x, kernel_size, stride, padding, ceil_mode):
@@ -469,7 +484,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool2d(x, kernel_size, stride, padding, jax.lax.add, 0.0,
-                   ceil_mode, True, "avg_pool2d", exclusive=exclusive)
+                   ceil_mode, True, "avg_pool2d", exclusive=exclusive,
+                   data_format=data_format,
+                   divisor_override=divisor_override)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,

@@ -43,6 +43,15 @@ class TestSlidingWindow:
         # load-bearing: the full-causal oracle differs
         full = np.asarray(oracle(ids)._data)
         assert np.abs(full - ref).max() > 1e-3
+        # the XLA debug path (use_flash_attention=False) builds its own
+        # dense band — must agree with the same oracle
+        dense = LlamaForCausalLM(LlamaConfig.tiny(
+            sliding_window=W, num_key_value_heads=2,
+            use_flash_attention=False))
+        dense.set_state_dict(m.state_dict())
+        dense.eval()
+        got2 = np.asarray(dense(ids)._data)
+        np.testing.assert_allclose(got2, ref, atol=3e-4, rtol=1e-3)
 
     def test_cached_decode_matches_banded_rollout(self, pair):
         m, oracle = pair

@@ -1,0 +1,239 @@
+"""Round-7 sweep: optimizers/LR schedulers/metrics/samplers/audio
+functional never named in tests — torch / sklearn / scipy / closed-form
+oracles (same audit class as the other round-7 sweeps)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+torch = pytest.importorskip("torch")
+sk_metrics = pytest.importorskip("sklearn.metrics")
+scipy_signal = pytest.importorskip("scipy.signal")
+
+rng = np.random.default_rng(17)
+
+
+def _train_pair(our_cls, torch_cls, our_kw, torch_kw, steps=5):
+    """Run both optimizers on the same quadratic; return trajectories."""
+    w0 = rng.standard_normal((4,)).astype(np.float32)
+    g = rng.standard_normal((5, 4)).astype(np.float32)
+
+    w = P.to_tensor(w0.copy())
+    w.stop_gradient = False
+    opt = our_cls(parameters=[w], **our_kw)
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch_cls([tw], **torch_kw)
+    for i in range(steps):
+        loss = (w * P.to_tensor(g[i % 5])).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        topt.zero_grad()
+        tl = (tw * torch.tensor(g[i % 5])).sum()
+        tl.backward()
+        topt.step()
+    return np.asarray(w._data), tw.detach().numpy()
+
+
+class TestOptimizers:
+    def test_adagrad_matches_torch(self):
+        from paddle_tpu.optimizer import Adagrad
+        ours, ref = _train_pair(
+            Adagrad, torch.optim.Adagrad,
+            dict(learning_rate=0.1, initial_accumulator_value=0.1,
+                 epsilon=1e-10),
+            dict(lr=0.1, initial_accumulator_value=0.1, eps=1e-10))
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_adamax_matches_torch(self):
+        from paddle_tpu.optimizer import Adamax
+        ours, ref = _train_pair(
+            Adamax, torch.optim.Adamax,
+            dict(learning_rate=0.05, beta1=0.9, beta2=0.99,
+                 epsilon=1e-8),
+            dict(lr=0.05, betas=(0.9, 0.99), eps=1e-8))
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_adadelta_matches_torch(self):
+        from paddle_tpu.optimizer import Adadelta
+        ours, ref = _train_pair(
+            Adadelta, torch.optim.Adadelta,
+            dict(learning_rate=1.0, rho=0.9, epsilon=1e-6),
+            dict(lr=1.0, rho=0.9, eps=1e-6))
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+class TestLRSchedulers:
+    def _lrs(self, sched, n=8):
+        out = []
+        for _ in range(n):
+            out.append(float(sched()))
+            sched.step()
+        return np.asarray(out)
+
+    def test_exponential_and_multistep_and_piecewise(self):
+        from paddle_tpu.optimizer.lr import (ExponentialDecay,
+                                             MultiStepDecay,
+                                             PiecewiseDecay)
+        got = self._lrs(ExponentialDecay(0.5, gamma=0.9))
+        np.testing.assert_allclose(got, 0.5 * 0.9 ** np.arange(8),
+                                   rtol=1e-6)
+        got2 = self._lrs(MultiStepDecay(1.0, milestones=[3, 6],
+                                        gamma=0.1))
+        np.testing.assert_allclose(
+            got2, [1, 1, 1, .1, .1, .1, .01, .01], rtol=1e-6)
+        got3 = self._lrs(PiecewiseDecay(boundaries=[2, 5],
+                                        values=[1.0, 0.5, 0.1]))
+        np.testing.assert_allclose(
+            got3, [1, 1, .5, .5, .5, .1, .1, .1], rtol=1e-6)
+
+    def test_noam_polynomial_inverse_natural(self):
+        from paddle_tpu.optimizer.lr import (InverseTimeDecay,
+                                             NaturalExpDecay, NoamDecay,
+                                             PolynomialDecay)
+        d, warm = 64, 4
+        got = self._lrs(NoamDecay(d_model=d, warmup_steps=warm,
+                                  learning_rate=1.0), n=6)
+        # reference clamps epoch >= 1, so step 0 repeats step 1
+        steps = np.maximum(np.arange(0, 6), 1)
+        ref = d ** -0.5 * np.minimum(steps ** -0.5,
+                                     steps * warm ** -1.5)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        got2 = self._lrs(PolynomialDecay(1.0, decay_steps=4,
+                                         end_lr=0.1, power=2.0), n=6)
+        t = np.minimum(np.arange(6), 4)
+        ref2 = (1.0 - 0.1) * (1 - t / 4) ** 2 + 0.1
+        np.testing.assert_allclose(got2, ref2, rtol=1e-5)
+        got3 = self._lrs(InverseTimeDecay(1.0, gamma=0.5), n=4)
+        np.testing.assert_allclose(got3, 1.0 / (1 + 0.5 *
+                                                np.arange(4)),
+                                   rtol=1e-6)
+        got4 = self._lrs(NaturalExpDecay(1.0, gamma=0.3), n=4)
+        np.testing.assert_allclose(got4, np.exp(-0.3 * np.arange(4)),
+                                   rtol=1e-6)
+
+    def test_lambda_onecycle_cyclic_warmrestarts_run(self):
+        from paddle_tpu.optimizer.lr import (
+            CosineAnnealingWarmRestarts, CyclicLR, LambdaDecay,
+            OneCycleLR)
+        got = self._lrs(LambdaDecay(2.0, lr_lambda=lambda e: 1 /
+                                    (1 + e)), n=4)
+        np.testing.assert_allclose(got, 2.0 / (1 + np.arange(4)),
+                                   rtol=1e-6)
+        oc = self._lrs(OneCycleLR(max_learning_rate=1.0,
+                                  total_steps=10), n=10)
+        assert oc.max() <= 1.0 + 1e-6 and oc.argmax() not in (0, 9)
+        cy = self._lrs(CyclicLR(base_learning_rate=0.1,
+                                max_learning_rate=1.0,
+                                step_size_up=3), n=12)
+        assert cy.min() >= 0.1 - 1e-6 and cy.max() <= 1.0 + 1e-6
+        assert (np.diff(cy[:3]) > 0).all()
+        wr = self._lrs(CosineAnnealingWarmRestarts(1.0, T_0=4), n=9)
+        np.testing.assert_allclose(wr[4], 1.0, rtol=1e-5)  # restart
+        assert (np.diff(wr[:4]) < 0).all()
+
+
+class TestMetrics:
+    def test_precision_recall_vs_sklearn(self):
+        from paddle_tpu.metric import Precision, Recall
+        preds = rng.random(200).astype(np.float32)
+        labels = rng.integers(0, 2, 200)
+        p = Precision()
+        p.update(preds, labels)
+        r = Recall()
+        r.update(preds, labels)
+        hard = (preds > 0.5).astype(int)
+        np.testing.assert_allclose(
+            p.accumulate(),
+            sk_metrics.precision_score(labels, hard), atol=1e-6)
+        np.testing.assert_allclose(
+            r.accumulate(), sk_metrics.recall_score(labels, hard),
+            atol=1e-6)
+
+    def test_auc_vs_sklearn(self):
+        from paddle_tpu.metric import Auc
+        labels = rng.integers(0, 2, 500)
+        scores = np.clip(labels * 0.4 + rng.random(500) * 0.6, 0, 1)
+        probs = np.stack([1 - scores, scores], 1).astype(np.float32)
+        a = Auc()
+        a.update(probs, labels[:, None])
+        ref = sk_metrics.roc_auc_score(labels, scores)
+        np.testing.assert_allclose(a.accumulate(), ref, atol=5e-3)
+
+
+class TestSamplers:
+    def test_samplers_cover_and_weight(self):
+        from paddle_tpu.io import (RandomSampler, SequenceSampler,
+                                   Subset, WeightedRandomSampler)
+
+        class DS:
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return i
+
+        ds = DS()
+        assert list(SequenceSampler(ds)) == list(range(10))
+        P.seed(3)
+        r = list(RandomSampler(ds))
+        assert sorted(r) == list(range(10))
+        w = WeightedRandomSampler(
+            weights=[0.0, 0.0, 1.0, 1.0], num_samples=200,
+            replacement=True)
+        picks = np.asarray(list(w))
+        assert set(picks) <= {2, 3}
+        sub = Subset(ds, [3, 7])
+        assert len(sub) == 2 and sub[1] == 7
+
+    def test_chain_and_compose_datasets(self):
+        from paddle_tpu.io import ChainDataset, ComposeDataset
+
+        class It:
+            def __init__(self, vals):
+                self.vals = vals
+
+            def __iter__(self):
+                return iter(self.vals)
+
+        # comprehension, not list(): list() probes __len__, which
+        # IterableDataset deliberately raises on (reference contract)
+        ch = [v for v in ChainDataset([It([1, 2]), It([3])])]
+        assert ch == [1, 2, 3]
+
+        class M:
+            def __init__(self, base):
+                self.b = base
+
+            def __len__(self):
+                return len(self.b)
+
+            def __getitem__(self, i):
+                return (self.b[i],)
+
+        comp = ComposeDataset([M([1, 2]), M([10, 20])])
+        assert tuple(comp[1]) == (2, 20)
+
+
+class TestAudioFunctional:
+    def test_get_window_vs_scipy(self):
+        from paddle_tpu.audio.functional import get_window
+        for name in ("hann", "hamming", "blackman"):
+            ref = scipy_signal.get_window(name, 32, fftbins=True)
+            got = np.asarray(get_window(name, 32)._data)
+            np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_mel_fft_frequencies_and_power_to_db(self):
+        from paddle_tpu.audio.functional import (fft_frequencies,
+                                                 mel_frequencies,
+                                                 power_to_db)
+        f = np.asarray(fft_frequencies(sr=16000, n_fft=8)._data)
+        np.testing.assert_allclose(f, np.fft.rfftfreq(8, 1 / 16000),
+                                   atol=1e-4)
+        m = np.asarray(mel_frequencies(n_mels=5, f_min=0.0,
+                                       f_max=8000.0)._data)
+        assert m[0] == 0.0 and abs(m[-1] - 8000.0) < 1.0
+        assert (np.diff(m) > 0).all()
+        x = np.asarray([1.0, 0.1, 10.0], np.float32)
+        db = np.asarray(power_to_db(P.to_tensor(x), top_db=None)._data)
+        np.testing.assert_allclose(db, 10 * np.log10(x), atol=1e-5)
