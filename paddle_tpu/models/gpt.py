@@ -200,7 +200,17 @@ class GPTForCausalLM(Layer, GenerationMixin):
         super().__init__()
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
-        if cfg.tensor_parallel:
+        if cfg.tie_word_embeddings:
+            # reference GPT ties the LM head to wte (the config flag was
+            # previously accepted-and-ignored — a separate random head)
+            if cfg.tensor_parallel:
+                raise NotImplementedError(
+                    "tie_word_embeddings with tensor_parallel GPT is "
+                    "not wired (the vocab-parallel tied head needs the "
+                    "embedding's shard layout)")
+            from .llama import _TiedLMHead
+            self.lm_head = _TiedLMHead(self.gpt.wte.weight)
+        elif cfg.tensor_parallel:
             self.lm_head = ColumnParallelLinear(
                 cfg.hidden_size, cfg.vocab_size, has_bias=False,
                 gather_output=False)
