@@ -21,3 +21,4 @@ from .deepfm import DeepFM, DeepFMConfig  # noqa: F401
 from .dcgan import (DCGANConfig, Generator as DCGANGenerator,  # noqa: F401
                     Discriminator as DCGANDiscriminator,
                     gan_bce_losses)
+from .albert import AlbertConfig, AlbertModel  # noqa: F401

@@ -1,0 +1,127 @@
+"""ALBERT family (parameter-shared BERT variant).
+
+Reference surface: the Paddle-ecosystem ALBERT (upstream PaddleNLP
+paddlenlp/transformers/albert/modeling.py, unverified — see SURVEY.md
+§2.2 "Misc domains"): factorized embeddings (embedding_size <
+hidden_size with a projection into the encoder width) and CROSS-LAYER
+PARAMETER SHARING — one transformer layer's weights applied
+num_hidden_layers times. Parity is tested against the `transformers`
+torch implementation by weight transplant
+(tests/test_models_albert.py).
+
+TPU-first notes:
+- The shared layer is the natural lax.scan/weight-reuse shape: one set
+  of weights, L applications — XLA compiles ONE layer program and the
+  loop reuses it (the Python loop over a shared Layer traces the same
+  parameters each iteration; no per-layer weight copies exist at all).
+- Post-LN ordering matches the reference exactly (attention LN, then
+  the full-layer LN after the FFN residual).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as P
+from ..nn import (Dropout, Embedding, Layer, LayerNorm, Linear,
+                  Tanh)
+from ..nn import functional as F
+
+__all__ = ["AlbertConfig", "AlbertModel"]
+
+
+@dataclass
+class AlbertConfig:
+    vocab_size: int = 30000
+    embedding_size: int = 128
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def tiny(**kw):
+        return AlbertConfig(**{**dict(
+            vocab_size=128, embedding_size=32, hidden_size=64,
+            num_hidden_layers=3, num_attention_heads=4,
+            intermediate_size=128, max_position_embeddings=64), **kw})
+
+
+class AlbertSharedLayer(Layer):
+    """The ONE transformer layer applied at every depth (post-LN)."""
+
+    def __init__(self, cfg: AlbertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.nh = cfg.num_attention_heads
+        self.hd = h // self.nh
+        self.q = Linear(h, h)
+        self.k = Linear(h, h)
+        self.v = Linear(h, h)
+        self.attn_out = Linear(h, h)
+        self.attn_norm = LayerNorm(h, cfg.layer_norm_eps)
+        self.ffn = Linear(h, cfg.intermediate_size)
+        self.ffn_out = Linear(cfg.intermediate_size, h)
+        self.full_norm = LayerNorm(h, cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.attn_dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv_w = P.concat([self.q.weight, self.k.weight, self.v.weight],
+                         axis=1)
+        qkv_b = P.concat([self.q.bias, self.k.bias, self.v.bias])
+        qkv = F.linear(x, qkv_w, qkv_b).reshape([b, s, 3, self.nh,
+                                                 self.hd])
+        ctx = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+            attn_mask=attn_mask, dropout_p=self.attn_dropout_p,
+            training=self.training)
+        x = self.attn_norm(x + self.dropout(self.attn_out(
+            ctx.reshape([b, s, self.nh * self.hd]))))
+        y = self.ffn_out(F.gelu(self.ffn(x), approximate=True))
+        return self.full_norm(x + self.dropout(y))
+
+
+class AlbertModel(Layer):
+    def __init__(self, cfg: AlbertConfig):
+        super().__init__()
+        self.cfg = cfg
+        e = cfg.embedding_size
+        self.word_embeddings = Embedding(cfg.vocab_size, e)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             e)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, e)
+        self.embed_norm = LayerNorm(e, cfg.layer_norm_eps)
+        self.embed_proj = Linear(e, cfg.hidden_size)
+        self.shared_layer = AlbertSharedLayer(cfg)  # ONE layer, reused
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = Tanh()
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = P.zeros_like(input_ids)
+        pos = P.arange(s).unsqueeze(0)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(pos)
+             + self.token_type_embeddings(token_type_ids))
+        x = self.dropout(self.embed_norm(x))
+        x = self.embed_proj(x)
+        am = None
+        if attention_mask is not None:
+            if attention_mask.ndim == 2:  # [B, S] padding mask
+                am = ((1.0 - attention_mask.astype("float32")) *
+                      -1e9).unsqueeze(1).unsqueeze(1)
+            else:  # pre-built additive mask (BertModel convention)
+                am = attention_mask
+        for _ in range(self.cfg.num_hidden_layers):
+            x = self.shared_layer(x, attn_mask=am)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
