@@ -66,3 +66,45 @@ class TestNewFamiliesDeploy:
             (1, 800)).astype(np.float32) * 0.1
         out = _roundtrip(net, x, tmp_path, "w2v")
         assert out.shape[0] == 1 and out.shape[2] == 32
+
+    def test_clip_image_tower_deploys(self, tmp_path):
+        from paddle_tpu.models import CLIPConfig, CLIPModel
+
+        class ImageTower(P.nn.Layer):
+            def __init__(self, clip):
+                super().__init__()
+                self.clip = clip
+
+            def forward(self, px):
+                return self.clip.get_image_features(px)
+
+        P.seed(5)
+        net = ImageTower(CLIPModel(CLIPConfig.tiny()))
+        x = np.random.default_rng(5).standard_normal(
+            (2, 3, 32, 32)).astype(np.float32)
+        out = _roundtrip(net, x, tmp_path, "clip_img")
+        assert out.shape == (2, 32)
+
+    def test_albert_deploys(self, tmp_path):
+        from paddle_tpu.models import AlbertConfig, AlbertModel
+
+        class Pooled(P.nn.Layer):
+            def __init__(self, albert):
+                super().__init__()
+                self.albert = albert
+
+            def forward(self, ids):
+                return self.albert(ids)[1]
+
+        P.seed(6)
+        net = Pooled(AlbertModel(AlbertConfig.tiny()))
+        x = np.random.default_rng(6).integers(
+            0, 128, (2, 10)).astype(np.int32)
+        net.eval()
+        expect = np.asarray(net(P.to_tensor(x))._data)
+        prefix = str(tmp_path / "albert")
+        P.jit.save(net, prefix,
+                   input_spec=[InputSpec([2, 10], "int32")])
+        outs = create_predictor(Config(prefix)).run([x])
+        np.testing.assert_allclose(outs[0], expect, rtol=2e-4,
+                                   atol=2e-4)
