@@ -21,7 +21,8 @@ from ..core.autograd import apply
 from ..core.tensor import Tensor, to_tensor
 from ..nn.layer import Layer
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing",
+           "LinearChainCrf", "LinearChainCrfLoss"]
 
 
 def _viterbi_jax(potentials, lengths, trans, include_bos_eos_tag):
@@ -270,3 +271,5 @@ class Movielens:
 
 
 __all__ += ["Imdb", "Movielens"]
+
+from .crf import LinearChainCrf, LinearChainCrfLoss  # noqa: E402,F401

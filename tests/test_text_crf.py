@@ -1,0 +1,152 @@
+"""Linear-chain CRF: EXACT brute-force oracle (enumerate all tag paths
+at small T,N for log Z, gold score, and the Viterbi argmax path), plus
+a BiGRU-CRF tagger that must learn a synthetic BIO pattern."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.text.crf import LinearChainCrf, LinearChainCrfLoss
+
+rng = np.random.default_rng(23)
+
+
+def _brute(em, trans, start, stop):
+    """(logZ, best_score, best_path) by full enumeration."""
+    t, n = em.shape
+    scores = {}
+    for path in itertools.product(range(n), repeat=t):
+        s = start[path[0]] + em[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + em[i, path[i]]
+        s += stop[path[-1]]
+        scores[path] = s
+    vals = np.asarray(list(scores.values()))
+    m = vals.max()
+    logz = m + np.log(np.exp(vals - m).sum())
+    best = max(scores, key=scores.get)
+    return logz, scores[best], np.asarray(best)
+
+
+class TestCrfExactOracle:
+    def test_log_partition_gold_and_decode(self):
+        t, n = 4, 3
+        P.seed(0)
+        crf = LinearChainCrf(n)
+        em = rng.standard_normal((2, t, n)).astype(np.float32)
+        labels = rng.integers(0, n, (2, t)).astype(np.int64)
+        lengths = np.asarray([t, t], np.int64)
+        trans = np.asarray(crf.transitions._data)
+        start = np.asarray(crf.start_scores._data)
+        stop = np.asarray(crf.stop_scores._data)
+
+        logz = np.asarray(crf.log_partition(
+            P.to_tensor(em), P.to_tensor(lengths))._data)
+        gold = np.asarray(crf.gold_score(
+            P.to_tensor(em), P.to_tensor(labels),
+            P.to_tensor(lengths))._data)
+        dec_scores, paths = crf.decode(P.to_tensor(em),
+                                       P.to_tensor(lengths))
+        for b in range(2):
+            ref_z, ref_best, ref_path = _brute(em[b], trans, start,
+                                               stop)
+            np.testing.assert_allclose(logz[b], ref_z, atol=1e-4)
+            # gold score formula vs enumeration of that exact path
+            s = start[labels[b, 0]] + em[b, 0, labels[b, 0]]
+            for i in range(1, t):
+                s += trans[labels[b, i - 1], labels[b, i]] \
+                    + em[b, i, labels[b, i]]
+            s += stop[labels[b, -1]]
+            np.testing.assert_allclose(gold[b], s, atol=1e-4)
+            np.testing.assert_array_equal(
+                np.asarray(paths._data)[b], ref_path)
+
+    def test_ragged_lengths(self):
+        """A shorter row's log Z equals the unpadded computation."""
+        t, n = 5, 3
+        P.seed(1)
+        crf = LinearChainCrf(n)
+        em = rng.standard_normal((1, t, n)).astype(np.float32)
+        short = 3
+        z_padded = float(crf.log_partition(
+            P.to_tensor(em), P.to_tensor(np.asarray([short])))._data[0])
+        z_exact = float(crf.log_partition(
+            P.to_tensor(em[:, :short]),
+            P.to_tensor(np.asarray([short])))._data[0])
+        np.testing.assert_allclose(z_padded, z_exact, atol=1e-5)
+
+    def test_nll_positive_and_minimized_by_gold(self):
+        """NLL > 0 always; pushing emissions toward the gold labels
+        drives it toward 0 (sanity of sign conventions)."""
+        n = 3
+        P.seed(2)
+        crf = LinearChainCrf(n)
+        loss_fn = LinearChainCrfLoss(crf)
+        labels = rng.integers(0, n, (2, 4)).astype(np.int64)
+        lengths = P.to_tensor(np.asarray([4, 4]))
+        em_random = rng.standard_normal((2, 4, n)).astype(np.float32)
+        l1 = float(loss_fn(P.to_tensor(em_random), lengths,
+                           P.to_tensor(labels)))
+        onehot = np.eye(n)[labels].astype(np.float32) * 20.0
+        l2 = float(loss_fn(P.to_tensor(onehot), lengths,
+                           P.to_tensor(labels)))
+        assert l1 > 0 and l2 > 0
+        assert l2 < l1 * 0.1
+
+
+class TestBiGruCrfTagger:
+    def test_learns_synthetic_bio_pattern(self):
+        """Tokens 10..19 start an entity (B), 20..29 continue it (I),
+        others are O — the BiGRU-CRF must recover the tagging."""
+        from paddle_tpu import nn
+        from paddle_tpu.optimizer import Adam
+
+        P.seed(4)
+        V, N, T, H = 40, 3, 12, 32
+
+        class Tagger(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, H)
+                self.gru = nn.GRU(H, H // 2, direction="bidirect")
+                self.proj = nn.Linear(H, N)
+                self.crf = LinearChainCrf(N)
+
+            def emissions(self, ids):
+                x = self.emb(ids)
+                h, _ = self.gru(x)
+                return self.proj(h)
+
+        def make_batch(b):
+            ids = rng.integers(0, 10, (b, T))
+            tags = np.zeros((b, T), np.int64)
+            for r in range(b):
+                s = rng.integers(0, T - 3)
+                ln = rng.integers(1, 3)
+                ids[r, s] = rng.integers(10, 20)
+                tags[r, s] = 1
+                for k in range(1, ln + 1):
+                    ids[r, s + k] = rng.integers(20, 30)
+                    tags[r, s + k] = 2
+            return ids.astype(np.int64), tags
+
+        m = Tagger()
+        m.train()
+        loss_fn = LinearChainCrfLoss(m.crf)
+        opt = Adam(5e-3, parameters=m.parameters())
+        lengths = P.to_tensor(np.full((16,), T, np.int64))
+        for step in range(60):
+            ids, tags = make_batch(16)
+            em = m.emissions(P.to_tensor(ids))
+            loss = loss_fn(em, lengths, P.to_tensor(tags))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        m.eval()
+        ids, tags = make_batch(32)
+        em = m.emissions(P.to_tensor(ids))
+        _, paths = m.crf.decode(em, P.to_tensor(
+            np.full((32,), T, np.int64)))
+        acc = (np.asarray(paths._data) == tags).mean()
+        assert acc > 0.95, acc
