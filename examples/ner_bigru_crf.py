@@ -1,0 +1,94 @@
+"""Sequence labeling with a BiGRU-CRF (the reference's classic
+lexical-analysis stack): Embedding → bidirectional GRU → Linear →
+linear-chain CRF trained with the forward-algorithm NLL, decoded with
+Viterbi.
+
+Synthetic BIO task: tokens 10..19 begin an entity, 20..29 continue it,
+everything else is O. A few dozen steps reach ~100% token accuracy.
+
+    python examples/ner_bigru_crf.py --cpu [--steps 60]
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as P  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.optimizer import Adam  # noqa: E402
+from paddle_tpu.text import (LinearChainCrf,  # noqa: E402
+                             LinearChainCrfLoss)
+
+V, N, T, H = 40, 3, 12, 32
+TAGS = ["O", "B-ENT", "I-ENT"]
+
+
+class Tagger(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(V, H)
+        self.gru = nn.GRU(H, H // 2, direction="bidirect")
+        self.proj = nn.Linear(H, N)
+        self.crf = LinearChainCrf(N)
+
+    def emissions(self, ids):
+        h, _ = self.gru(self.emb(ids))
+        return self.proj(h)
+
+
+def make_batch(rng, b):
+    ids = rng.integers(0, 10, (b, T))
+    tags = np.zeros((b, T), np.int64)
+    for r in range(b):
+        s = rng.integers(0, T - 3)
+        ln = rng.integers(1, 3)
+        ids[r, s] = rng.integers(10, 20)
+        tags[r, s] = 1
+        for k in range(1, ln + 1):
+            ids[r, s + k] = rng.integers(20, 30)
+            tags[r, s + k] = 2
+    return ids.astype(np.int64), tags
+
+
+def main():
+    steps = 60
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    P.seed(4)
+    rng = np.random.default_rng(0)
+    m = Tagger()
+    m.train()
+    loss_fn = LinearChainCrfLoss(m.crf)
+    opt = Adam(5e-3, parameters=m.parameters())
+    lengths = P.to_tensor(np.full((16,), T, np.int64))
+    for step in range(steps):
+        ids, tags = make_batch(rng, 16)
+        loss = loss_fn(m.emissions(P.to_tensor(ids)), lengths,
+                       P.to_tensor(tags))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 20 == 0 or step == steps - 1:
+            print(f"step {step:3d}  crf-nll {float(loss):.4f}")
+    m.eval()
+    ids, tags = make_batch(rng, 32)
+    _, paths = m.crf.decode(m.emissions(P.to_tensor(ids)),
+                            P.to_tensor(np.full((32,), T, np.int64)))
+    acc = float((np.asarray(paths._data) == tags).mean())
+    print(f"token accuracy {acc:.3f}")
+    sent = ids[0]
+    decoded = np.asarray(paths._data)[0]
+    print("sample:", " ".join(f"{t}/{TAGS[g]}" for t, g in
+                              zip(sent, decoded)))
+    print(f"NER training OK (acc {acc:.2f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,8 @@ class TestExamples:
         out = _run_example("asr_whisper.py", args=("--cpu", "--steps", "80"),
                            timeout=600)
         assert "ASR training OK" in out
+
+    def test_ner_bigru_crf(self):
+        out = _run_example("ner_bigru_crf.py", args=("--cpu", "--steps", "50"),
+                           timeout=600)
+        assert "NER training OK" in out
