@@ -22,3 +22,4 @@ from .dcgan import (DCGANConfig, Generator as DCGANGenerator,  # noqa: F401
                     Discriminator as DCGANDiscriminator,
                     gan_bce_losses)
 from .albert import AlbertConfig, AlbertModel  # noqa: F401
+from .roberta import RobertaConfig, RobertaModel  # noqa: F401

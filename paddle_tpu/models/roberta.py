@@ -1,0 +1,48 @@
+"""RoBERTa family — BERT architecture with RoBERTa's conventions.
+
+Reference surface: the Paddle-ecosystem RoBERTa (upstream PaddleNLP
+paddlenlp/transformers/roberta/modeling.py, unverified — see SURVEY.md
+§2.2): identical encoder to BERT; the differences are conventions —
+position ids START AT padding_idx+1 (pad=1 ⇒ positions 2..), a single
+token type, and LayerNorm eps 1e-5. Re-uses BertModel outright (one
+encoder implementation) and overrides only the position-id convention;
+transplant parity vs the transformers torch oracle in
+tests/test_models_roberta.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as P
+from .bert import BertConfig, BertModel
+
+__all__ = ["RobertaConfig", "RobertaModel"]
+
+
+class RobertaConfig(BertConfig):
+    @staticmethod
+    def tiny(**kw):
+        return RobertaConfig(**{**dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            # +2: rows 0/1 are reserved (pad) in the reference table
+            max_position_embeddings=130, type_vocab_size=1,
+            layer_norm_eps=1e-5, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0), **kw})
+
+
+class RobertaModel(BertModel):
+    """BertModel with RoBERTa position semantics (offset past the pad
+    index: position of token i is i + padding_idx + 1 = i + 2)."""
+
+    PAD_OFFSET = 2
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if position_ids is None:
+            s = input_ids.shape[1]
+            position_ids = P.to_tensor(
+                (np.arange(s) + self.PAD_OFFSET)[None].astype(
+                    np.int32))
+        return super().forward(input_ids, token_type_ids, position_ids,
+                               attention_mask)
