@@ -11,7 +11,7 @@ tests/test_models_roberta.py.
 """
 from __future__ import annotations
 
-import numpy as np
+from dataclasses import dataclass
 
 import paddle_tpu as P
 from .bert import BertConfig, BertModel
@@ -19,30 +19,43 @@ from .bert import BertConfig, BertModel
 __all__ = ["RobertaConfig", "RobertaModel"]
 
 
+@dataclass
 class RobertaConfig(BertConfig):
+    # RoBERTa conventions as the CLASS defaults (not only in tiny()):
+    # +2 reserved pad rows in the position table, single token type,
+    # eps 1e-5 — a plain RobertaConfig() is usable as-is
+    vocab_size: int = 50265
+    max_position_embeddings: int = 514
+    type_vocab_size: int = 1
+    layer_norm_eps: float = 1e-5
+
     @staticmethod
     def tiny(**kw):
         return RobertaConfig(**{**dict(
             vocab_size=256, hidden_size=64, num_hidden_layers=2,
             num_attention_heads=4, intermediate_size=128,
-            # +2: rows 0/1 are reserved (pad) in the reference table
-            max_position_embeddings=130, type_vocab_size=1,
-            layer_norm_eps=1e-5, hidden_dropout_prob=0.0,
+            max_position_embeddings=130, hidden_dropout_prob=0.0,
             attention_probs_dropout_prob=0.0), **kw})
 
 
 class RobertaModel(BertModel):
-    """BertModel with RoBERTa position semantics (offset past the pad
-    index: position of token i is i + padding_idx + 1 = i + 2)."""
+    """BertModel with RoBERTa position semantics: the reference derives
+    positions from the NON-PAD cumsum (pad slots get position
+    padding_idx=1; real tokens are numbered 2.. over non-pad tokens
+    only), so padded batches match the torch oracle too."""
 
-    PAD_OFFSET = 2
+    PADDING_IDX = 1
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
         if position_ids is None:
             s = input_ids.shape[1]
-            position_ids = P.to_tensor(
-                (np.arange(s) + self.PAD_OFFSET)[None].astype(
-                    np.int32))
+            if attention_mask is not None and attention_mask.ndim == 2:
+                m = attention_mask.astype("int32")
+                position_ids = (P.cumsum(m, axis=1) * m
+                                + self.PADDING_IDX)
+            else:
+                position_ids = (P.arange(s).unsqueeze(0)
+                                + (self.PADDING_IDX + 1))
         return super().forward(input_ids, token_type_ids, position_ids,
                                attention_mask)

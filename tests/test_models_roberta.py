@@ -89,3 +89,24 @@ def test_position_offset_is_load_bearing(pair):
         np.arange(8)[None].astype(np.int32)))  # BERT-style, no offset
     assert np.abs(np.asarray(a._data) - np.asarray(b._data)).max() \
         > 1e-3
+
+
+def test_padded_batch_matches_oracle(pair):
+    """HF derives positions from the non-pad cumsum — a padded batch's
+    REAL tokens must match the oracle (the convention the plain
+    arange+2 would break)."""
+    hf, ours = pair
+    rng = np.random.default_rng(2)
+    ids = rng.integers(2, 256, (2, 10))
+    ids[0, 7:] = 1  # right-pad with pad_token_id=1
+    am = (ids != 1).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 attention_mask=torch.tensor(am)).last_hidden_state
+    seq, _ = ours(P.to_tensor(ids.astype(np.int32)),
+                  attention_mask=P.to_tensor(am.astype(np.float32)))
+    got = np.asarray(seq._data)
+    np.testing.assert_allclose(got[0, :7], ref.numpy()[0, :7],
+                               atol=3e-4, rtol=1e-3)
+    np.testing.assert_allclose(got[1], ref.numpy()[1], atol=3e-4,
+                               rtol=1e-3)
