@@ -1,0 +1,152 @@
+"""Continuous-batching serving bench: replay a synthetic Poisson arrival
+trace through `paddle_tpu.serving.ServingEngine` on a small LLaMA-family
+model and report throughput + latency.
+
+Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new] [--smoke]
+
+Measurement (PERF.md round-3 method): the decode rate is a TWO-POINT
+MARGINAL — the SAME trace is replayed at a quarter decode budget and at
+the full budget, and tokens/s = extra tokens / extra wall. That cancels
+the fixed per-replay overhead (compile-cache warmup, relay dispatch on
+axon, host scheduling) that otherwise understates the device rate.
+TTFT percentiles come from the full-budget replay (TTFT is budget-
+independent). Axon hygiene: every engine step already ends in a host
+fetch of the sampled tokens, so no request-caching hazard.
+
+Prints ONE JSON line and banks it to BENCH_serving.json.
+Wedge-proofing: TPU health is probed in a bounded subprocess
+(bench.py::_tpu_usable) with CPU fallback — this driver never hangs on
+a dead chip/tunnel.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+smoke = "--smoke" in sys.argv
+if smoke:
+    sys.argv.remove("--smoke")
+n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
+rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
+max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
+
+
+def make_trace(n, rate, vocab, seed=0):
+    """Poisson arrivals (exponential gaps) with mixed prompt lengths."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, n)
+    arrivals = np.cumsum(gaps)
+    prompts = [rng.integers(0, vocab, int(rng.integers(8, 65)))
+               .astype(np.int32) for _ in range(n)]
+    return arrivals, prompts
+
+
+def replay(model, arrivals, prompts, new_tokens, **engine_kw):
+    """Wall-clock replay: requests join the engine when their arrival
+    time passes; steps run continuously (idle steps are cheap)."""
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(model, **engine_kw)
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, prompts))
+    n_total = len(pending)
+    done_tokens = 0
+    while True:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, p = pending.pop(0)
+            eng.add_request(p, max_new_tokens=new_tokens)
+        if not pending and eng.scheduler.all_done():
+            break
+        if eng.scheduler.all_done():
+            time.sleep(min(0.002, max(0.0, pending[0][0] - now)))
+            continue
+        for ev in eng.step():
+            if ev["type"] == "finish":
+                done_tokens += ev["n_tokens"]
+    wall = time.perf_counter() - t0
+    res = eng.results()
+    assert len(res) == n_total, (len(res), n_total)
+    return wall, done_tokens, eng.metrics
+
+
+def main():
+    from bench import _tpu_usable, force_cpu  # wedge-safe probe + reroute
+    tpu_ok = False if smoke else _tpu_usable(attempts=2, probe_timeout=90,
+                                             backoff=20)
+    import jax
+    if not tpu_ok:
+        force_cpu()
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    maxlen = 64 + max_new + 1
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16,
+                          max_position_embeddings=maxlen,
+                          dtype="bfloat16")
+        num_pages = 4096
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=maxlen)
+        num_pages = 1024
+    P.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    engine_kw = dict(page_size=16, num_pages=num_pages, max_batch=8,
+                     prefill_chunk=32, max_seq_len=maxlen)
+
+    arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
+    new_q = max(1, max_new // 4)
+
+    # warmup: compile every bucketed program class off the clock
+    warm_n = min(4, n_requests)
+    replay(model, np.zeros(warm_n), prompts[:warm_n], new_q, **engine_kw)
+    replay(model, np.zeros(warm_n), prompts[:warm_n], max_new,
+           **engine_kw)
+
+    wall_q, toks_q, _ = replay(model, arrivals, prompts, new_q,
+                               **engine_kw)
+    wall, toks, metrics = replay(model, arrivals, prompts, max_new,
+                                 **engine_kw)
+
+    marginal = None
+    if wall > wall_q and toks > toks_q:
+        marginal = (toks - toks_q) / (wall - wall_q)
+    e2e = toks / wall
+    m = metrics.export()
+    out = {
+        "metric": "serving_tok_per_s" + ("" if on_tpu else "_cpu"),
+        "value": round(marginal, 1) if marginal else round(e2e, 1),
+        "unit": "decode tokens/sec (continuous batching, "
+                + ("two-point marginal" if marginal else
+                   "end-to-end — marginal unavailable") + ")",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "e2e_tok_per_s": round(e2e, 1),
+        "wall_s": round(wall, 3), "wall_quarter_s": round(wall_q, 3),
+        "ttft_p50_s": m["ttft_s"]["p50"],
+        "ttft_p99_s": m["ttft_s"]["p99"],
+        "inter_token_p50_s": m["inter_token_s"]["p50"],
+        "page_occupancy_max": m["page_occupancy"]["max"],
+        "preemptions": m["preemptions"],
+        "deadline_evictions": m["deadline_evictions"],
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving.json", "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,7 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
+from . import serving  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401

@@ -8,8 +8,12 @@ KV caches written at absolute offsets. Weights enter as ARGUMENTS (the
 models/generation.py round-3 lesson: jit-captured weight constants
 overflow the remote-compile transport and pin stale weights).
 
-A model opts in by implementing `_encdec_spec(inputs)` returning a dict:
+A model opts in by implementing `_encdec_spec(inputs, enc_mask=None)`
+returning a dict:
   encode      () -> Tensor [B, S_enc, D]           encoder forward
+              (the model decides what enc_mask means for its OWN
+              encoder — T5 masks encoder self-attention keys; Whisper's
+              conv-downsampled audio encoder ignores it)
   blocks      decoder blocks with the protocol attrs self_norm /
               self_attn / cross_norm / cross_attn / ff_norm / ff, where
               each attention has q/k/v/o Linears, `_heads`, `nh`, `hd`,
@@ -47,11 +51,27 @@ class EncDecGenerationMixin:
         tokens past the table, no exception)."""
         return None
 
+    def _encoder_pad_id(self):
+        """Pad token id of the ENCODER input vocabulary, or None when
+        padding is not detectable (e.g. float audio features). Drives
+        the loud padded-batch-without-mask guard in generate()."""
+        return None
+
     @no_grad()
     def generate(self, inputs, max_new_tokens=32, do_sample=False,
-                 temperature=1.0, top_k=0, top_p=1.0, seed=None):
+                 temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                 encoder_attention_mask=None):
         """Greedy/sampling decode; returns [B, max_new_tokens] tokens
-        (eos-padded past the first eos)."""
+        (eos-padded past the first eos).
+
+        encoder_attention_mask [B, S_enc] (1 = real, 0 = pad) masks
+        padded encoder positions out of CROSS-ATTENTION (−1e9 additive,
+        reference generate semantics) and is threaded to the model's
+        encoder via `_encdec_spec` (T5 masks encoder self-attention with
+        it too). Padded batches WITHOUT a mask raise loudly when the
+        model can detect padding (`_encoder_pad_id`) — silently
+        attending to pad positions diverged from the reference
+        (ADVICE.md #1)."""
         maxpos = self._max_decoder_positions()
         if maxpos is not None and int(max_new_tokens) > maxpos:
             raise ValueError(
@@ -61,10 +81,31 @@ class EncDecGenerationMixin:
             else jnp.asarray(inputs)
         if jnp.issubdtype(arr.dtype, jnp.integer):
             arr = arr.astype(jnp.int32)
+        mask = encoder_attention_mask
+        if mask is not None:
+            mask = mask._data if isinstance(mask, Tensor) \
+                else jnp.asarray(mask)
+            mask = mask.astype(jnp.float32)
+            if mask.shape[0] != arr.shape[0]:
+                raise ValueError(
+                    f"encoder_attention_mask batch({mask.shape[0]}) != "
+                    f"inputs batch({arr.shape[0]})")
+        else:
+            pad_id = self._encoder_pad_id()
+            if pad_id is not None and \
+                    jnp.issubdtype(arr.dtype, jnp.integer) and \
+                    bool((arr == pad_id).any()):
+                raise ValueError(
+                    f"encoder inputs contain pad_token_id({pad_id}) but "
+                    "no encoder_attention_mask was passed: cross-"
+                    "attention would silently attend to pad positions. "
+                    "Pass encoder_attention_mask (1 = real, 0 = pad), "
+                    "or an all-ones mask if those tokens are "
+                    "intentional.")
         warrs = [t._data for t in self._gen_tensors()]
         sig = (arr.shape, str(arr.dtype), int(max_new_tokens),
                bool(do_sample), float(temperature), int(top_k),
-               float(top_p))
+               float(top_p), mask is not None)
         cache = getattr(self, "_encdec_gen_cache", None)
         if cache is None:
             cache = self._encdec_gen_cache = {}
@@ -72,7 +113,8 @@ class EncDecGenerationMixin:
         if fn is None:
             fn = jax.jit(functools.partial(
                 _encdec_pure, self, int(max_new_tokens), bool(do_sample),
-                float(temperature), int(top_k), float(top_p)))
+                float(temperature), int(top_k), float(top_p),
+                mask is not None))
             cache[sig] = fn
         key = _random.next_key() if seed is None else \
             jax.random.PRNGKey(seed)
@@ -80,34 +122,45 @@ class EncDecGenerationMixin:
         if was_training:
             self.eval()
         try:
-            return Tensor(fn(warrs, arr, key))
+            if mask is not None:
+                return Tensor(fn(warrs, arr, mask, key))
+            return Tensor(fn(warrs, arr, None, key))
         finally:
             if was_training:
                 self.train()
 
 
 def _encdec_pure(model, max_new, do_sample, temperature, top_k, top_p,
-                 warrs, inputs, key):
+                 has_mask, warrs, inputs, enc_mask, key):
     tensors = model._gen_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, a in zip(tensors, warrs):
         t._data = a
     try:
         return _encdec_body(model, max_new, do_sample, temperature,
-                            top_k, top_p, inputs, key)
+                            top_k, top_p, inputs,
+                            enc_mask if has_mask else None, key)
     finally:
         for t, a in saved:
             t._data = a
 
 
 def _encdec_body(model, max_new, do_sample, temperature, top_k, top_p,
-                 inputs, key):
-    spec = model._encdec_spec(Tensor(inputs))
+                 inputs, enc_mask, key):
+    spec = model._encdec_spec(
+        Tensor(inputs),
+        enc_mask=(Tensor(enc_mask) if enc_mask is not None else None))
     blocks = spec["blocks"]
     eos, start_id = spec["eos"], spec["start"]
     b = inputs.shape[0]
 
     enc = spec["encode"]()  # [B, S_enc, D]
+    # padded encoder keys out of cross-attention: −1e9 additive
+    # (ADVICE.md #1 — reference generate semantics for ragged batches)
+    cross_bias = None
+    if enc_mask is not None:
+        cross_bias = jnp.where(enc_mask > 0, 0.0,
+                               -1e9)[:, None, None, :]
 
     cross = []
     for blk in blocks:
@@ -153,6 +206,8 @@ def _encdec_body(model, max_new, do_sample, temperature, top_k, top_p,
             y2 = blk.cross_norm(x)
             q2 = ca._heads(y2, ca.q)._data * getattr(ca, "scale", 1.0)
             sc2 = jnp.einsum("bhqd,bhkd->bhqk", q2, kb)
+            if cross_bias is not None:
+                sc2 = sc2 + cross_bias
             pr2 = jax.nn.softmax(sc2, axis=-1)
             ctx2 = jnp.einsum("bhqk,bhkd->bhqd", pr2, vb)
             x = x + Tensor(ca.o(Tensor(

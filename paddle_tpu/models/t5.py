@@ -94,6 +94,16 @@ def _relative_position_bucket(rel, bidirectional, num_buckets,
     return ret + jnp.where(is_small, n, big)
 
 
+def _mask_to_bias(mask):
+    """[B, S] keep-mask (1 real / 0 pad) → [B,1,1,S] additive bias
+    Tensor (0 keep / −1e9 drop), or None passthrough."""
+    if mask is None:
+        return None
+    arr = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor(jnp.where(arr > 0, 0.0, -1e9)
+                  .astype(jnp.float32)[:, None, None, :])
+
+
 class T5Attention(Layer):
     def __init__(self, cfg: T5Config, has_bias_table: bool,
                  bidirectional: bool):
@@ -129,9 +139,11 @@ class T5Attention(Layer):
         return proj(x).reshape([b, s, self.nh, self.hd]).transpose(
             [0, 2, 1, 3])
 
-    def forward(self, x, kv=None, position_bias=None, causal=False):
+    def forward(self, x, kv=None, position_bias=None, causal=False,
+                mask_bias=None):
         """x [B,Sq,D]; kv [B,Sk,D] for cross-attention (None = self).
-        NO 1/sqrt(d) scaling (reference semantics)."""
+        NO 1/sqrt(d) scaling (reference semantics). mask_bias
+        [B,1,1,Sk] additive (0 keep / −1e9 drop) masks padded keys."""
         b, sq = x.shape[0], x.shape[1]
         src = x if kv is None else kv
         sk = src.shape[1]
@@ -141,6 +153,8 @@ class T5Attention(Layer):
         scores = P.matmul(q, k.transpose([0, 1, 3, 2]))  # [B,nh,Sq,Sk]
         if position_bias is not None:
             scores = scores + position_bias
+        if mask_bias is not None:
+            scores = scores + mask_bias
         if causal:
             neg = P.to_tensor(
                 jnp.where(jnp.arange(sk)[None, :]
@@ -189,13 +203,14 @@ class T5Block(Layer):
         self.ff = T5FF(cfg)
         self.dropout = Dropout(cfg.dropout_rate)
 
-    def forward(self, x, enc=None, position_bias=None):
+    def forward(self, x, enc=None, position_bias=None,
+                self_mask_bias=None, cross_mask_bias=None):
         x = x + self.dropout(self.self_attn(
             self.self_norm(x), position_bias=position_bias,
-            causal=self.is_decoder))
+            causal=self.is_decoder, mask_bias=self_mask_bias))
         if self.is_decoder:
-            x = x + self.dropout(self.cross_attn(self.cross_norm(x),
-                                                 kv=enc))
+            x = x + self.dropout(self.cross_attn(
+                self.cross_norm(x), kv=enc, mask_bias=cross_mask_bias))
         return x + self.dropout(self.ff(self.ff_norm(x)))
 
 
@@ -211,12 +226,20 @@ class T5Stack(Layer):
                                             cfg.layer_norm_epsilon)
         self.dropout = Dropout(cfg.dropout_rate)
 
-    def forward(self, input_ids, enc=None):
+    def forward(self, input_ids, enc=None, attn_mask=None,
+                enc_mask=None):
+        """attn_mask [B, S] (1 real / 0 pad) masks THIS stack's
+        self-attention keys; enc_mask masks the encoder keys in the
+        decoder's cross-attention (ADVICE.md #1)."""
         x = self.dropout(self.embed(input_ids))
         sq = x.shape[1]
         bias = self.block[0].self_attn.compute_bias(sq, sq)
+        self_bias = _mask_to_bias(attn_mask)
+        cross_bias = _mask_to_bias(enc_mask)
         for blk in self.block:
-            x = blk(x, enc=enc, position_bias=bias)
+            x = blk(x, enc=enc, position_bias=bias,
+                    self_mask_bias=self_bias,
+                    cross_mask_bias=cross_bias)
         return self.dropout(self.final_layer_norm(x))
 
 
@@ -228,9 +251,11 @@ class T5Model(Layer):
         self.encoder = T5Stack(cfg, is_decoder=False, embed=self.shared)
         self.decoder = T5Stack(cfg, is_decoder=True, embed=self.shared)
 
-    def forward(self, input_ids, decoder_input_ids):
-        enc = self.encoder(input_ids)
-        return self.decoder(decoder_input_ids, enc=enc), enc
+    def forward(self, input_ids, decoder_input_ids,
+                attention_mask=None):
+        enc = self.encoder(input_ids, attn_mask=attention_mask)
+        return self.decoder(decoder_input_ids, enc=enc,
+                            enc_mask=attention_mask), enc
 
 
 class T5ForConditionalGeneration(Layer, EncDecGenerationMixin):
@@ -251,8 +276,10 @@ class T5ForConditionalGeneration(Layer, EncDecGenerationMixin):
         return P.matmul(dec * (self.cfg.d_model ** -0.5),
                         self.t5.shared.weight.t())
 
-    def forward(self, input_ids, decoder_input_ids, labels=None):
-        dec, _ = self.t5(input_ids, decoder_input_ids)
+    def forward(self, input_ids, decoder_input_ids, labels=None,
+                attention_mask=None):
+        dec, _ = self.t5(input_ids, decoder_input_ids,
+                         attention_mask=attention_mask)
         logits = self._logits(dec)
         if labels is None:
             return logits
@@ -262,7 +289,10 @@ class T5ForConditionalGeneration(Layer, EncDecGenerationMixin):
         return loss, logits
 
     # -- compiled encoder-decoder generation (models/encdec.py) --------
-    def _encdec_spec(self, inputs):
+    def _encoder_pad_id(self):
+        return self.cfg.pad_token_id
+
+    def _encdec_spec(self, inputs, enc_mask=None):
         dec = self.t5.decoder
         bias_attn = dec.block[0].self_attn  # layer-0 bucket table
 
@@ -270,7 +300,8 @@ class T5ForConditionalGeneration(Layer, EncDecGenerationMixin):
             return bias_attn.compute_bias(1, total, q_offset=offset)._data
 
         return {
-            "encode": lambda: self.t5.encoder(inputs),
+            "encode": lambda: self.t5.encoder(inputs,
+                                              attn_mask=enc_mask),
             "blocks": dec.block,
             "embed_step": lambda tok, offset: dec.embed(
                 Tensor(tok[:, None])),

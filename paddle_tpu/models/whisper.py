@@ -236,7 +236,10 @@ class WhisperForConditionalGeneration(Layer, EncDecGenerationMixin):
     def _max_decoder_positions(self):
         return self.cfg.max_target_positions
 
-    def _encdec_spec(self, inputs):
+    def _encdec_spec(self, inputs, enc_mask=None):
+        # enc_mask (post-conv frame resolution) is consumed CENTRALLY by
+        # the encdec loop's cross-attention; the audio encoder itself
+        # has no pad semantics to mask (float features, conv stride).
         dec = self.model.decoder
 
         def embed_step(tok, offset):

@@ -1,0 +1,32 @@
+"""paddle_tpu.serving — continuous-batching inference engine with a
+block-paged KV cache (reference capability: Paddle's serving stack —
+paddle.inference at scale / FastDeploy — and the vLLM/TPU
+ragged-paged-attention design, PAPERS.md).
+
+Layers:
+- :mod:`kv_cache`   — paged K/V pool: free-list allocator, per-sequence
+  page tables, refcounted copy-on-fork (n>1 sampling), budget sizing.
+- :mod:`attention`  — paged attention: jax gather reference path
+  (oracle-parity with the contiguous static cache) + a Pallas stub
+  gated behind ``PADDLE_TPU_PAGED_KERNEL`` (interpret-mode only).
+- :mod:`scheduler`  — continuous batching: watermark admission, chunked
+  prefill, decode-priority iteration, deadlines, LIFO preemption.
+- :mod:`engine`     — bucketed fixed-shape compiled step (weights as
+  arguments) + :mod:`metrics` (TTFT / inter-token / occupancy JSON).
+
+Driver: ``bench_serving.py`` (repo root) replays a Poisson trace and
+emits the BENCH_serving artifact. Docs: ``docs/SERVING.md``.
+"""
+from .attention import paged_attention, paged_attention_ref  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache  # noqa: F401
+from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
+from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
+                        SchedulerOutput)
+
+__all__ = [
+    "PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
+    "paged_attention", "paged_attention_ref",
+    "Scheduler", "SchedulerOutput", "Request", "RequestState",
+    "ServingEngine", "ServingMetrics", "Counter", "Histogram",
+]

@@ -1,0 +1,147 @@
+"""Paged attention: attend a query block over K/V read through a page
+table (PAPERS.md "Ragged Paged Attention" — the TPU serving kernel
+shape; reference capability: vLLM PagedAttention).
+
+Two paths, selected by ``PADDLE_TPU_PAGED_KERNEL``:
+
+- default — a pure jax/lax GATHER reference: pages are gathered into a
+  contiguous [B, P·page_size, KV, D] view and attention runs exactly
+  like models/generation.py::cached_attention (same einsums, same f32
+  accumulation, same absolute-position mask), so it is CPU-testable and
+  oracle-comparable against the contiguous static-cache path to 1e-5.
+- ``PADDLE_TPU_PAGED_KERNEL=1`` — a Pallas kernel STUB for the decode
+  (S=1) shape, validated in INTERPRET MODE ONLY this round (CLAUDE.md:
+  no first-time Mosaic compiles in the bench path while the chip grant
+  is wedged). It streams pages with an online-softmax accumulator — the
+  structure the real kernel needs — but reads the whole page pool per
+  grid cell, which a Mosaic build must replace with per-page DMA to
+  respect the O(block) VMEM invariant before it can be compile-gated.
+
+Both paths accept GQA natively (query heads grouped over KV heads, no
+materialized head repeat) and a Mistral-style sliding ``window``.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "paged_attention_ref"]
+
+
+def paged_attention(q, k_pages, v_pages, page_table, context_lens,
+                    q_offsets, *, scale, window=None):
+    """q [B,S,H,D]; k_pages/v_pages [NP, page_size, KV, D];
+    page_table [B,P] int32 (pad = scratch page 0); context_lens [B]
+    int32 — valid K tokens per row INCLUDING any just scattered;
+    q_offsets [B] int32 — absolute position of each row's first query.
+    Returns [B,S,H,D] in q.dtype.
+    """
+    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1" \
+            and q.shape[1] == 1:
+        return _paged_attention_kernel(q, k_pages, v_pages, page_table,
+                                       context_lens, q_offsets,
+                                       scale=scale, window=window)
+    return paged_attention_ref(q, k_pages, v_pages, page_table,
+                               context_lens, q_offsets, scale=scale,
+                               window=window)
+
+
+def paged_attention_ref(q, k_pages, v_pages, page_table, context_lens,
+                        q_offsets, *, scale, window=None):
+    """Gather-based reference path (see module docstring)."""
+    b, s, nh, d = q.shape
+    _, ps, nkv, _ = k_pages.shape
+    p = page_table.shape[1]
+    t = p * ps
+    # [B,P] pages -> contiguous [B,T,KV,D] logical view
+    kg = k_pages[page_table].reshape(b, t, nkv, d)
+    vg = v_pages[page_table].reshape(b, t, nkv, d)
+    g = nh // nkv
+    qg = q.reshape(b, s, nkv, g, d).astype(jnp.float32)
+    sc = jnp.einsum("bskgd,btkd->bkgst", qg,
+                    kg.astype(jnp.float32)) * scale
+    qpos = q_offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    kpos = jnp.arange(t, dtype=jnp.int32)
+    mask = kpos[None, None, :] <= qpos[:, :, None]            # [B,S,T]
+    mask = mask & (kpos[None, None, :] < context_lens[:, None, None])
+    if window:  # 0/None both disable (all-False band would NaN softmax)
+        mask = mask & (kpos[None, None, :] > qpos[:, :, None]
+                       - int(window))
+    sc = jnp.where(mask[:, None, None], sc, -jnp.inf)
+    pr = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", pr, vg.astype(jnp.float32))
+    return out.reshape(b, s, nh, d).astype(q.dtype)
+
+
+def _paged_attention_kernel(q, k_pages, v_pages, page_table,
+                            context_lens, q_offsets, *, scale,
+                            window=None):
+    """Decode-shape (S=1) Pallas stub, interpret mode only (see module
+    docstring). Grid over batch; one online-softmax pass over the page
+    list per cell."""
+    from jax.experimental import pallas as pl
+
+    b, s, nh, d = q.shape
+    assert s == 1, "kernel stub covers the decode (S=1) shape only"
+    np_, ps, nkv, _ = k_pages.shape
+    p = page_table.shape[1]
+    g = nh // nkv
+    win = int(window) if window else 0
+
+    def kernel(pt_ref, cl_ref, qo_ref, q_ref, k_ref, v_ref, o_ref):
+        pt = pt_ref[...][0]                       # [P]
+        cl = cl_ref[...][0]
+        qpos = qo_ref[...][0]
+        qh = q_ref[...][0, 0].astype(jnp.float32).reshape(nkv, g, d)
+        # interpret-mode full read; a Mosaic build must DMA per page
+        k_all = k_ref[...]
+        v_all = v_ref[...]
+
+        def body(i, carry):
+            m, l, acc = carry
+            page = pt[i]
+            kb = jax.lax.dynamic_index_in_dim(
+                k_all, page, 0, keepdims=False).astype(jnp.float32)
+            vb = jax.lax.dynamic_index_in_dim(
+                v_all, page, 0, keepdims=False).astype(jnp.float32)
+            sc = jnp.einsum("kgd,tkd->kgt", qh, kb) * scale  # [KV,g,PS]
+            tpos = i * ps + jnp.arange(ps, dtype=jnp.int32)
+            ok = (tpos <= qpos) & (tpos < cl)
+            if win:
+                ok = ok & (tpos > qpos - win)
+            sc = jnp.where(ok[None, None, :], sc, -jnp.inf)
+            m2 = jnp.maximum(m, sc.max(-1))
+            # dead blocks (all masked) keep the accumulator untouched:
+            # exp guards avoid -inf minus -inf NaNs
+            alive = jnp.isfinite(m2)
+            alpha = jnp.where(alive, jnp.exp(m - m2), 1.0)
+            pexp = jnp.where(alive[..., None],
+                             jnp.exp(sc - m2[..., None]), 0.0)
+            l2 = l * alpha + pexp.sum(-1)
+            acc2 = acc * alpha[..., None] + \
+                jnp.einsum("kgt,tkd->kgd", pexp, vb)
+            return m2, l2, acc2
+
+        m0 = jnp.full((nkv, g), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((nkv, g), jnp.float32)
+        a0 = jnp.zeros((nkv, g, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, p, body, (m0, l0, a0))
+        out = acc / jnp.maximum(l, 1e-20)[..., None]
+        o_ref[...] = out.reshape(1, nh, d).astype(o_ref.dtype)
+
+    full_k = pl.BlockSpec(k_pages.shape, lambda i: (0, 0, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, p), lambda i: (i, 0)),
+                  pl.BlockSpec((1,), lambda i: (i,)),
+                  pl.BlockSpec((1,), lambda i: (i,)),
+                  pl.BlockSpec((1, 1, nh, d), lambda i: (i, 0, 0, 0)),
+                  full_k, full_k],
+        out_specs=pl.BlockSpec((1, nh, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nh, d), q.dtype),
+        interpret=True,
+    )(page_table, context_lens, q_offsets, q, k_pages, v_pages)
+    return out[:, None]

@@ -1,0 +1,443 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+Reference capability: the serving loop of vLLM / Paddle FastDeploy —
+admission, chunked prefill, batched decode, preemption — realized
+TPU-natively (SURVEY.md §7 static-shape stance):
+
+- ONE step program class, compiled per BUCKETED shape: decode runs at
+  batch buckets (powers of two up to ``max_batch``, S=1), prefill runs
+  at (B=1, S=``prefill_chunk``). The jit trace cache is therefore
+  bounded by ``log2(max_batch) + 2`` programs for the engine's lifetime.
+- Weights enter every compiled step as ARGUMENTS, never baked constants
+  (the round-3 HTTP-413 lesson in models/generation.py): weight updates
+  flow through with NO recompile and NO stale-constant hazard, and the
+  serialized program stays O(HLO). Parameter-object replacement rewires
+  positionally (order comes from the module tree, which is stable) —
+  the same contract the generate() program cache relies on.
+- Padded lanes are real lanes pointed at the cache's SCRATCH page: every
+  program sees fully-defined fixed-shape operands; garbage lanes are
+  masked on the host.
+- The decode loop targets RoPE causal-LM families (LLaMA zoo shape:
+  ``model.llama`` or a module exposing embed_tokens/layers/norm +
+  lm_head); positions are computed analytically, so chunk padding can
+  run past the context limit without a table clamp-gather hazard.
+
+The engine is host-driven: ``step()`` runs one scheduler iteration
+(decode-priority batch + at most one prefill chunk), fetches logits,
+samples on the host, and advances request state. ``run()`` loops until
+drained. All device work is CPU-mesh testable; nothing here compiles a
+first-time Mosaic kernel (the paged Pallas stub stays interpret-gated).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+
+import numpy as np
+
+from .kv_cache import OutOfPages, PagedKVCache
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    def __init__(self, model, *, page_size=16, num_pages=None,
+                 hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
+                 max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
+                 cache_dtype=None):
+        cfg = getattr(model, "cfg", None)
+        core = getattr(model, "llama", model)
+        for attr in ("embed_tokens", "layers", "norm"):
+            if not hasattr(core, attr):
+                raise TypeError(
+                    "ServingEngine needs a LLaMA-family causal LM "
+                    "(model.llama or a core module with embed_tokens/"
+                    f"layers/norm); {type(model).__name__} lacks {attr!r}")
+        if not hasattr(model, "lm_head"):
+            raise TypeError("model must expose lm_head")
+        if cfg is None:
+            raise TypeError("model must carry a .cfg")
+        self.model = model
+        self._core = core
+        nh = cfg.num_attention_heads
+        nkv = getattr(cfg, "num_key_value_heads", None) or nh
+        hd = cfg.hidden_size // nh
+        self.max_seq_len = int(max_seq_len
+                               or cfg.max_position_embeddings)
+        maxpos = getattr(cfg, "max_position_embeddings", None)
+        if maxpos is not None and self.max_seq_len > maxpos:
+            raise ValueError(
+                f"max_seq_len({self.max_seq_len}) exceeds "
+                f"max_position_embeddings({maxpos})")
+        if cache_dtype is None:
+            cache_dtype = ("bfloat16"
+                           if getattr(cfg, "dtype", "float32")
+                           == "bfloat16" else "float32")
+        self.cache = PagedKVCache(
+            cfg.num_hidden_layers, nkv, hd, page_size=page_size,
+            num_pages=num_pages,
+            hbm_budget_bytes=(int(hbm_budget_mb * 2 ** 20)
+                              if hbm_budget_mb is not None else None),
+            dtype=cache_dtype)
+        self.max_pages_per_seq = math.ceil(
+            self.max_seq_len / self.cache.page_size)
+        self.scheduler = Scheduler(self.cache, max_batch=max_batch,
+                                   prefill_chunk=prefill_chunk,
+                                   watermark_frac=watermark_frac)
+        self.metrics = ServingMetrics()
+        self.eos = eos_token_id
+        self.window = getattr(cfg, "sliding_window", None) or None
+        self._step_fn = None          # one jit fn; traces per bucket
+        self._last_logits_probe = None  # row-0 logits of the last step
+        self._requests: dict[int, Request] = {}
+        self._finished: dict[int, Request] = {}
+        self._rngs: dict[int, np.random.Generator] = {}
+
+    # -- public API --------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
+                    do_sample=False, temperature=1.0, top_k=0,
+                    seed=None, n=1):
+        """Queue a request; returns its req_id (n>1 returns the PARENT id
+        — forked children surface as their own req_ids in events)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens}")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt({prompt.size}) + max_new_tokens"
+                f"({max_new_tokens}) exceeds max_seq_len"
+                f"({self.max_seq_len})")
+        if n > 1 and not do_sample:
+            raise ValueError("n>1 needs do_sample=True (greedy forks "
+                             "would be identical streams)")
+        now = self._now()
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      arrival=now,
+                      deadline=(now + deadline_s
+                                if deadline_s is not None else None),
+                      do_sample=bool(do_sample),
+                      temperature=float(temperature), top_k=int(top_k),
+                      seed=seed, n=int(n))
+        self._requests[req.req_id] = req
+        self._rngs[req.req_id] = np.random.default_rng(seed)
+        self.scheduler.add(req)
+        return req.req_id
+
+    def step(self):
+        """One scheduler iteration. Returns a list of event dicts
+        ({"type": "token"|"finish", "req_id", ...})."""
+        was_training = getattr(self.model, "training", False)
+        if was_training:
+            self.model.eval()
+        try:
+            return self._step_inner()
+        finally:
+            if was_training:
+                self.model.train()
+
+    def _step_inner(self):
+        now = self._now()
+        out = self.scheduler.schedule(now)
+        events = []
+        for r in out.expired:  # graceful: pages freed, partial output kept
+            if self.cache.has_seq(r.seq_id):
+                self.cache.free_seq(r.seq_id)
+            self.metrics.deadline_evictions.inc()
+            self._record_finish(r, events)
+        if out.decode:
+            self._decode_batch(out.decode, events)
+        if out.prefill is not None:
+            req, start, end = out.prefill
+            # the decode batch may have preempted the prefilling request
+            if req.state == RequestState.PREFILLING:
+                self._prefill_chunk(req, start, end, events)
+        if not out.decode and out.prefill is None and not out.expired \
+                and self.scheduler.waiting \
+                and not self.scheduler.live_requests():
+            # idle engine + blocked admission head: loud, not a silent
+            # spin — the request can never fit
+            req = self.scheduler.waiting[0]
+            need = self.cache.pages_for(len(req.token_history()) + 1)
+            if need + self.scheduler.watermark_pages \
+                    > self.cache.allocatable_pages:
+                raise RuntimeError(
+                    f"request {req.req_id} can never be admitted: needs "
+                    f"{need} pages + {self.scheduler.watermark_pages} "
+                    f"watermark > {self.cache.allocatable_pages} "
+                    "allocatable; grow the cache budget or shrink the "
+                    "prompt")
+        self.metrics.queue_depth.record(self.scheduler.queue_depth())
+        self.metrics.page_occupancy.record(self.cache.occupancy())
+        return events
+
+    def run(self, max_steps=100000):
+        """Step until every queued request finished; returns
+        {req_id: {"tokens", "finish_reason", "preemptions"}}."""
+        steps = 0
+        while not self.scheduler.all_done():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"serving loop did not drain in {max_steps} steps "
+                    "(starvation or a stuck request)")
+        return self.results()
+
+    def results(self):
+        return {rid: {"tokens": list(r.out_tokens),
+                      "finish_reason": r.finish_reason,
+                      "preemptions": r.preemptions}
+                for rid, r in self._finished.items()}
+
+    # -- internals ---------------------------------------------------------
+    @staticmethod
+    def _now():
+        return time.perf_counter()
+
+    def _bucket(self, n):
+        b = 1
+        while b < n:
+            b <<= 1
+        return min(b, self.scheduler.max_batch)
+
+    def _alloc_with_preemption(self, req, n_tokens):
+        """Allocate slots for req, preempting by page pressure (newest
+        victim first) until it fits or no victim remains."""
+        while True:
+            try:
+                slots, copies = self.cache.append_slots(req.seq_id,
+                                                        n_tokens)
+            except OutOfPages:
+                victim = self.scheduler.pick_victim(exclude=(req,))
+                if victim is None:
+                    raise RuntimeError(
+                        f"KV cache too small: request {req.req_id} "
+                        f"cannot fit even alone "
+                        f"(allocatable={self.cache.allocatable_pages} "
+                        f"pages of {self.cache.page_size} tokens)")
+                self._preempt(victim)
+                continue
+            if copies:
+                self.cache.apply_copies(copies)
+                self.metrics.cow_copies.inc(len(copies))
+            return slots
+
+    def _preempt(self, victim):
+        if self.cache.has_seq(victim.seq_id):
+            self.cache.free_seq(victim.seq_id)
+        self.scheduler.preempt(victim)
+        self.metrics.preemptions.inc()
+
+    def _decode_batch(self, reqs, events):
+        alloc = []
+        for r in reqs:
+            if r.state != RequestState.RUNNING:
+                continue  # preempted by an earlier member's allocation
+            slots = self._alloc_with_preemption(r, 1)
+            alloc.append((r, int(slots[0])))
+        active = [(r, s) for r, s in alloc
+                  if r.state == RequestState.RUNNING]
+        if not active:
+            return
+        bb = self._bucket(len(active))
+        ids = np.zeros((bb, 1), np.int32)
+        positions = np.zeros((bb, 1), np.int32)
+        pt = np.zeros((bb, self.max_pages_per_seq), np.int32)
+        cl = np.ones(bb, np.int32)       # 1, not 0: keeps padded-lane
+        slot_map = np.zeros((bb, 1), np.int32)  # softmax NaN-free
+        last_idx = np.zeros(bb, np.int32)
+        for i, (r, slot) in enumerate(active):
+            hist_len = r.prompt.size + len(r.out_tokens)
+            ids[i, 0] = r.out_tokens[-1]
+            positions[i, 0] = hist_len - 1
+            pt[i] = self.cache.page_table(r.seq_id,
+                                          self.max_pages_per_seq)
+            cl[i] = hist_len
+            slot_map[i, 0] = slot
+        logits = self._run_step(ids, positions, pt, cl, slot_map,
+                                last_idx)
+        self.metrics.decode_steps.inc()
+        self.metrics.batch_size.record(len(active))
+        for i, (r, _) in enumerate(active):
+            self._emit_token(r, logits[i], events)
+
+    def _prefill_chunk(self, req, start, end, events):
+        if not self.cache.has_seq(req.seq_id):
+            self.cache.alloc_seq(req.seq_id)
+        hist = req.token_history()
+        chunk = hist[start:end]
+        n = int(chunk.size)
+        slots = self._alloc_with_preemption(req, n)
+        c = self.scheduler.prefill_chunk
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :n] = chunk
+        positions = (start
+                     + np.arange(c, dtype=np.int32))[None, :]
+        pt = self.cache.page_table(req.seq_id,
+                                   self.max_pages_per_seq)[None, :]
+        cl = np.asarray([start + n], np.int32)
+        slot_map = np.zeros((1, c), np.int32)  # padding -> scratch slots
+        slot_map[0, :n] = slots
+        last_idx = np.asarray([n - 1], np.int32)
+        logits = self._run_step(ids, positions, pt, cl, slot_map,
+                                last_idx)
+        self.metrics.prefill_chunks.inc()
+        self.scheduler.prefill_advanced(req, end)
+        if req.state != RequestState.RUNNING:
+            return  # more chunks to go
+        # prefill complete: fork BEFORE sampling (children share the
+        # prefix pages; the parent may finish — and free — immediately)
+        children = []
+        for i in range(1, req.n):
+            children.append(self._fork(req, i))
+        self._emit_token(req, logits[0], events)
+        for child in children:
+            self._emit_token(child, logits[0], events)
+
+    def _fork(self, parent, i):
+        child = Request(prompt=parent.prompt,
+                        max_new_tokens=parent.max_new_tokens,
+                        arrival=parent.arrival, deadline=parent.deadline,
+                        do_sample=parent.do_sample,
+                        temperature=parent.temperature,
+                        top_k=parent.top_k,
+                        seed=(parent.seed or 0) + i, n=1)
+        child.parent_id = parent.req_id
+        child.first_token_at = None
+        self.cache.fork(parent.seq_id, child.seq_id)
+        self._requests[child.req_id] = child
+        self._rngs[child.req_id] = np.random.default_rng(child.seed)
+        self.scheduler.register_fork(child)
+        return child
+
+    def _emit_token(self, req, logits_row, events):
+        tok = self._sample(req, logits_row)
+        req.out_tokens.append(tok)
+        now = self._now()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self.metrics.ttft_s.record(now - req.arrival)
+        else:
+            self.metrics.inter_token_s.record(now - req.last_token_at)
+        req.last_token_at = now
+        self.metrics.tokens_generated.inc()
+        events.append({"type": "token", "req_id": req.req_id,
+                       "token": tok})
+        if self.eos is not None and tok == self.eos:
+            self._finish(req, "stop", events)
+        elif len(req.out_tokens) >= req.max_new_tokens:
+            self._finish(req, "length", events)
+
+    def _finish(self, req, reason, events):
+        if self.cache.has_seq(req.seq_id):
+            self.cache.free_seq(req.seq_id)
+        self.scheduler.finish(req, reason)
+        self._record_finish(req, events)
+
+    def _record_finish(self, req, events):
+        self.metrics.requests_finished.inc()
+        self._finished[req.req_id] = req
+        events.append({"type": "finish", "req_id": req.req_id,
+                       "reason": req.finish_reason,
+                       "n_tokens": len(req.out_tokens)})
+
+    def _sample(self, req, logits_row):
+        lg = np.asarray(logits_row, np.float32)
+        if not req.do_sample:
+            return int(lg.argmax())
+        if req.temperature != 1.0:
+            lg = lg / max(req.temperature, 1e-6)
+        if req.top_k and req.top_k < lg.size:
+            kth = np.partition(lg, -req.top_k)[-req.top_k]
+            lg = np.where(lg < kth, -np.inf, lg)
+        lg = lg - lg.max()
+        p = np.exp(lg)
+        p /= p.sum()
+        return int(self._rngs[req.req_id].choice(lg.size, p=p))
+
+    def _run_step(self, ids, positions, pt, cl, slot_map, last_idx):
+        import jax
+        import jax.numpy as jnp
+        if self._step_fn is None:
+            # bucketed shapes bound this single fn's trace cache to
+            # log2(max_batch)+2 entries; weights ride as arguments
+            self._step_fn = jax.jit(functools.partial(
+                _paged_step_pure, self.model, self._core, self.window))
+        warrs = [t._data for t in self.model._gen_state_tensors()]
+        logits, k_pages, v_pages = self._step_fn(
+            warrs, jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(pt), jnp.asarray(cl), jnp.asarray(slot_map),
+            jnp.asarray(last_idx), self.cache.k_pages,
+            self.cache.v_pages)
+        self.cache.k_pages = list(k_pages)
+        self.cache.v_pages = list(v_pages)
+        out = np.asarray(logits, np.float32)
+        self._last_logits_probe = out[0]  # parity-test observability
+        return out
+
+
+# -- the compiled step (weights as arguments; generation.py idiom) ---------
+
+def _paged_step_pure(model, core, window, warrs, ids, positions, pt, cl,
+                     slot_map, last_idx, k_pages, v_pages):
+    tensors = model._gen_state_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, warrs):
+        t._data = arr
+    try:
+        return _paged_step_body(model, core, window, ids, positions, pt,
+                                cl, slot_map, last_idx, k_pages, v_pages)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _paged_step_body(model, core, window, ids, positions, pt, cl,
+                     slot_map, last_idx, k_pages, v_pages):
+    import jax.numpy as jnp
+
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+    from ..incubate.nn.functional import fused_rotary_position_embedding
+    from .attention import paged_attention
+
+    b, s = ids.shape
+    flat_slots = slot_map.reshape(-1)
+    with no_grad():
+        x = core.embed_tokens(Tensor(ids))
+        pos_t = Tensor(positions)
+        new_k, new_v = [], []
+        for layer, kp, vp in zip(core.layers, k_pages, v_pages):
+            at = layer.self_attn
+            nh, nkv, hd = at.num_heads, at.num_kv_heads, at.head_dim
+            y = layer.input_layernorm(x)
+            q = at.q_proj(y).reshape([b, s, nh, hd])
+            k = at.k_proj(y).reshape([b, s, nkv, hd])
+            v = at.v_proj(y).reshape([b, s, nkv, hd])
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, None, position_ids=pos_t,
+                rotary_emb_base=at.cfg.rope_theta)
+            npg, ps, _, _ = kp.shape
+            kp = kp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                k._data.reshape(b * s, nkv, hd).astype(kp.dtype)
+            ).reshape(npg, ps, nkv, hd)
+            vp = vp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                v._data.reshape(b * s, nkv, hd).astype(vp.dtype)
+            ).reshape(npg, ps, nkv, hd)
+            new_k.append(kp)
+            new_v.append(vp)
+            out = paged_attention(
+                q._data, kp, vp, pt, cl, positions[:, 0],
+                scale=1.0 / (hd ** 0.5), window=window)
+            h = x + at.o_proj(Tensor(out).reshape([b, s, nh * hd]))
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+        x = core.norm(x)
+        h_last = x._data[jnp.arange(b), last_idx]        # [B, D]
+        logits = model.lm_head(Tensor(h_last[:, None, :]))._data[:, 0]
+    return logits.astype(jnp.float32), new_k, new_v

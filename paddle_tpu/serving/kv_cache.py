@@ -1,0 +1,244 @@
+"""Block-paged KV cache — the serving engine's memory subsystem.
+
+Reference capability: vLLM's PagedAttention block manager and the TPU
+ragged-paged-attention cache layout (PAPERS.md "Ragged Paged Attention");
+Paddle analogue: FastDeploy/paddle.inference KV cache management.
+
+Design (SURVEY.md §7 static-shape stance):
+- K/V live in per-layer device buffers of shape
+  ``[num_pages, page_size, n_kv_heads, head_dim]`` — FIXED shape for the
+  whole engine lifetime, so every compiled step program sees the same
+  cache operands and the jit cache stays bounded.
+- The HOST owns all bookkeeping (free list, per-sequence page tables,
+  refcounts): allocation never traces, and the device only ever sees
+  int32 page-table/slot arrays as program ARGUMENTS.
+- Page 0 is a reserved SCRATCH page: padded batch lanes write their
+  garbage K/V there and padded page-table entries point at it, so every
+  lane of a fixed-shape program has defined (masked-out) memory to touch.
+- Copy-on-fork for n>1 sampling: ``fork()`` shares pages by refcount;
+  the first append into a SHARED partial tail page triggers a
+  copy-on-write (the allocator returns the page copies for the engine to
+  apply on device before scattering new K/V).
+
+Sizing: pass ``num_pages`` directly or an ``hbm_budget_bytes`` — the
+constructor derives the page count from the per-page byte cost across
+all layers (both K and V), the way an engine start-up would budget VMEM/
+HBM headroom left over after weights.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+
+import numpy as np
+
+__all__ = ["PagedKVCache", "OutOfPages", "SCRATCH_PAGE"]
+
+# page 0 is never handed to a sequence: padded lanes scatter/gather there
+SCRATCH_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """Raised by the allocator when the free list cannot cover a request
+    — the scheduler's signal to preempt or defer admission."""
+
+    def __init__(self, needed, free):
+        super().__init__(
+            f"paged KV cache exhausted: need {needed} page(s), "
+            f"{free} free")
+        self.needed = needed
+        self.free = free
+
+
+class PagedKVCache:
+    """Fixed-size-page KV pool with a free-list allocator, per-sequence
+    page tables, and refcounted copy-on-fork sharing.
+
+    Host bookkeeping is transactional: an allocation either fully
+    succeeds or raises :class:`OutOfPages` with no state mutated, so the
+    engine can preempt and retry safely.
+    """
+
+    def __init__(self, n_layers, n_kv_heads, head_dim, *, page_size=16,
+                 num_pages=None, hbm_budget_bytes=None, dtype="float32"):
+        import jax.numpy as jnp
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.dtype = jnp.dtype(dtype)
+        per_page = self.page_bytes_per_page(
+            n_layers, n_kv_heads, head_dim, page_size, self.dtype)
+        if num_pages is None:
+            if hbm_budget_bytes is None:
+                raise ValueError(
+                    "size the cache with either num_pages or "
+                    "hbm_budget_bytes")
+            num_pages = int(hbm_budget_bytes) // per_page
+        num_pages = int(num_pages)
+        # scratch + at least one allocatable page
+        if num_pages < 2:
+            raise ValueError(
+                f"cache budget yields {num_pages} page(s); need >= 2 "
+                f"({per_page} bytes/page across {n_layers} layers)")
+        self.num_pages = num_pages
+        self.bytes_total = num_pages * per_page
+        # device buffers: per layer, [num_pages, page_size, n_kv, hd]
+        shape = (num_pages, self.page_size, self.n_kv_heads, self.head_dim)
+        self.k_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.n_layers)]
+        self.v_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.n_layers)]
+        # host bookkeeping
+        self._free = deque(range(1, num_pages))  # page 0 = scratch
+        self._rc = np.zeros(num_pages, np.int32)
+        self._tables: dict[object, list[int]] = {}
+        self._lens: dict[object, int] = {}
+
+    # -- sizing helpers ---------------------------------------------------
+    @staticmethod
+    def page_bytes_per_page(n_layers, n_kv_heads, head_dim, page_size,
+                            dtype):
+        """Bytes one page costs across every layer's K and V buffers."""
+        import jax.numpy as jnp
+        return (2 * int(n_layers) * int(page_size) * int(n_kv_heads)
+                * int(head_dim) * jnp.dtype(dtype).itemsize)
+
+    def pages_for(self, n_tokens):
+        """Pages a sequence of n_tokens occupies."""
+        return math.ceil(max(int(n_tokens), 0) / self.page_size)
+
+    # -- observability ----------------------------------------------------
+    @property
+    def allocatable_pages(self):
+        return self.num_pages - 1  # minus scratch
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.allocatable_pages - len(self._free)
+
+    def occupancy(self):
+        return self.used_pages / max(self.allocatable_pages, 1)
+
+    def has_seq(self, seq_id):
+        return seq_id in self._tables
+
+    def seq_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def live_seqs(self):
+        return list(self._tables)
+
+    # -- sequence lifecycle -----------------------------------------------
+    def alloc_seq(self, seq_id):
+        """Register an empty sequence (pages arrive via append_slots)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        self._tables[seq_id] = []
+        self._lens[seq_id] = 0
+
+    def fork(self, parent_id, child_id):
+        """Copy-on-fork: the child SHARES the parent's pages (refcounts
+        bumped); the first append into the shared partial tail page
+        copy-on-writes it. O(pages) host work, zero device copies."""
+        if child_id in self._tables:
+            raise ValueError(f"sequence {child_id!r} already allocated")
+        table = self._tables[parent_id]
+        for p in table:
+            self._rc[p] += 1
+        self._tables[child_id] = list(table)
+        self._lens[child_id] = self._lens[parent_id]
+
+    def free_seq(self, seq_id):
+        """Release a sequence's pages (refcounted). Unknown ids raise —
+        the double-free guard the allocator invariants tests pin."""
+        if seq_id not in self._tables:
+            raise KeyError(
+                f"free_seq: unknown (or already freed) sequence "
+                f"{seq_id!r}")
+        for p in self._tables.pop(seq_id):
+            self._rc[p] -= 1
+            if self._rc[p] < 0:  # pragma: no cover - internal invariant
+                raise AssertionError(f"page {p} refcount underflow")
+            if self._rc[p] == 0:
+                self._free.append(p)
+        del self._lens[seq_id]
+
+    # -- allocation --------------------------------------------------------
+    def append_slots(self, seq_id, n_tokens):
+        """Reserve flat slot ids (page * page_size + offset) for the next
+        ``n_tokens`` of ``seq_id``, allocating pages as needed.
+
+        Returns ``(slots int32 [n_tokens], copies list[(src, dst)])``:
+        ``copies`` is non-empty when a shared partial tail page had to be
+        copy-on-written — the engine MUST ``apply_copies(copies)`` on the
+        device buffers before scattering the new K/V.
+
+        Transactional: raises :class:`OutOfPages` (no state touched) when
+        the free list cannot cover the pages needed.
+        """
+        if n_tokens <= 0:
+            raise ValueError(f"append_slots: n_tokens={n_tokens}")
+        table = self._tables[seq_id]
+        ln = self._lens[seq_id]
+        off = ln % self.page_size
+        cow = (off != 0 and table and self._rc[table[-1]] > 1)
+        new_pages = self.pages_for(ln + n_tokens) - self.pages_for(ln)
+        need = new_pages + (1 if cow else 0)
+        if need > len(self._free):
+            raise OutOfPages(need, len(self._free))
+        copies = []
+        if cow:
+            fresh = self._free.popleft()
+            self._rc[fresh] = 1
+            self._rc[table[-1]] -= 1  # shared page: rc stays >= 1
+            copies.append((table[-1], fresh))
+            table[-1] = fresh
+        slots = np.empty(n_tokens, np.int32)
+        for i in range(n_tokens):
+            pos = ln + i
+            if pos % self.page_size == 0:
+                page = self._free.popleft()
+                self._rc[page] = 1
+                table.append(page)
+            slots[i] = table[pos // self.page_size] * self.page_size \
+                + pos % self.page_size
+        self._lens[seq_id] = ln + n_tokens
+        return slots, copies
+
+    def apply_copies(self, copies):
+        """Perform pending copy-on-write page copies on the device
+        buffers (one batched gather-scatter per layer)."""
+        if not copies:
+            return
+        import jax.numpy as jnp
+        srcs = jnp.asarray([s for s, _ in copies], jnp.int32)
+        dsts = jnp.asarray([d for _, d in copies], jnp.int32)
+        self.k_pages = [kp.at[dsts].set(kp[srcs]) for kp in self.k_pages]
+        self.v_pages = [vp.at[dsts].set(vp[srcs]) for vp in self.v_pages]
+
+    def page_table(self, seq_id, max_pages):
+        """Padded int32 page-table row for the fixed-shape step program
+        (padding points at the scratch page; masked by context_len)."""
+        table = self._tables[seq_id]
+        if len(table) > max_pages:
+            raise ValueError(
+                f"sequence {seq_id!r} spans {len(table)} pages > "
+                f"max_pages_per_seq {max_pages}")
+        row = np.full(max_pages, SCRATCH_PAGE, np.int32)
+        row[:len(table)] = table
+        return row
+
+    def refcount(self, page):
+        return int(self._rc[page])
+
+    def pages_held(self, seq_id):
+        """Pages currently mapped by seq_id (0 for unknown sequences) —
+        admission accounting for admitted-but-unallocated requests."""
+        return len(self._tables.get(seq_id, ()))

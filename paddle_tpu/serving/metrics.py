@@ -1,0 +1,82 @@
+"""Serving observability: counters + reservoir histograms exported as
+JSON for the bench harness (PERF.md convention: one JSON artifact per
+measurement, banked the moment it lands).
+
+Host-side and allocation-light by design — metrics must never add a
+device sync; the engine records values it already fetched.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["Counter", "Histogram", "ServingMetrics"]
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+    def export(self):
+        return self.value
+
+
+class Histogram:
+    """Bounded reservoir of samples; percentiles computed at export.
+    Keeps the LAST `cap` samples (serving metrics care about recent
+    behavior; a trace replay fits entirely)."""
+
+    def __init__(self, cap=65536):
+        self.cap = int(cap)
+        self._samples: list[float] = []
+        self.count = 0
+
+    def record(self, v):
+        self.count += 1
+        self._samples.append(float(v))
+        if len(self._samples) > self.cap:
+            del self._samples[: len(self._samples) - self.cap]
+
+    def percentile(self, p):
+        if not self._samples:
+            return None
+        return float(np.percentile(np.asarray(self._samples), p))
+
+    def export(self):
+        if not self._samples:
+            return {"count": self.count, "mean": None, "p50": None,
+                    "p99": None, "max": None}
+        a = np.asarray(self._samples)
+        return {"count": self.count,
+                "mean": float(a.mean()),
+                "p50": float(np.percentile(a, 50)),
+                "p99": float(np.percentile(a, 99)),
+                "max": float(a.max())}
+
+
+class ServingMetrics:
+    """The engine's counter/histogram set (names are the export keys)."""
+
+    def __init__(self):
+        self.ttft_s = Histogram()             # arrival -> first token
+        self.inter_token_s = Histogram()      # gap between tokens
+        self.queue_depth = Histogram()        # waiting queue, per step
+        self.batch_size = Histogram()         # decode lanes, per step
+        self.page_occupancy = Histogram()     # used/allocatable, per step
+        self.prefill_chunks = Counter()
+        self.decode_steps = Counter()
+        self.tokens_generated = Counter()
+        self.requests_finished = Counter()
+        self.preemptions = Counter()
+        self.deadline_evictions = Counter()
+        self.cow_copies = Counter()
+
+    def export(self):
+        return {name: m.export() for name, m in vars(self).items()}
+
+    def to_json(self, **extra):
+        return json.dumps({**self.export(), **extra})

@@ -7,6 +7,8 @@ before ToTensor/Transpose), anything else as CHW. Geometric transforms
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 __all__ = ["Compose", "Normalize", "ToTensor", "Transpose", "Resize",
@@ -16,17 +18,39 @@ __all__ = ["Compose", "Normalize", "ToTensor", "Transpose", "Resize",
 
 
 
-def _hwc(img):
+def _hwc(img, data_format=None):
     """True when a 3-D array is HWC (last dim a channel count) — the
     layout the reference's geometric transforms always see (PIL/cv2,
-    pre-ToTensor). When both first and last dims look channel-like the
-    HWC reading wins, matching the reference pipeline order."""
-    return img.ndim == 3 and img.shape[-1] in (1, 3, 4)
+    pre-ToTensor).
+
+    ``data_format`` ("HWC"/"CHW", case-insensitive) overrides the
+    heuristic — the geometric transforms expose it as a constructor
+    kwarg. Without an override, an AMBIGUOUS shape (both first and last
+    dims look channel-like, e.g. 3×H×3) warns and falls back to the HWC
+    reading — the reference pipeline order — instead of silently
+    guessing (ADVICE.md #2)."""
+    if data_format is not None:
+        df = str(data_format).upper()
+        if df not in ("HWC", "CHW"):
+            raise ValueError(
+                f"data_format must be 'HWC' or 'CHW', got {data_format!r}")
+        return df == "HWC"
+    if img.ndim != 3:
+        return False
+    last = img.shape[-1] in (1, 3, 4)
+    if last and img.shape[0] in (1, 3, 4):
+        warnings.warn(
+            f"ambiguous 3-D image layout {img.shape}: both first and "
+            "last dims look channel-like; assuming HWC. Pass "
+            "data_format='CHW' (or 'HWC') to the transform to resolve "
+            "explicitly.", stacklevel=3)
+    return last
 
 
-def _spatial(img):
+def _spatial(img, data_format=None):
     """(h_axis, w_axis) for this layout."""
-    return (0, 1) if _hwc(img) else (img.ndim - 2, img.ndim - 1)
+    return (0, 1) if _hwc(img, data_format) \
+        else (img.ndim - 2, img.ndim - 1)
 
 
 class Compose:
@@ -80,14 +104,15 @@ class Transpose:
 
 
 class Resize:
-    def __init__(self, size, interpolation="bilinear"):
+    def __init__(self, size, interpolation="bilinear", data_format=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.data_format = data_format
 
     def __call__(self, img):
         import jax
         import jax.numpy as jnp
         arr = jnp.asarray(img)
-        chw = arr.ndim == 3 and not _hwc(arr)
+        chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
         if chw:
             out_shape = (arr.shape[0],) + self.size
         else:
@@ -97,14 +122,16 @@ class Resize:
 
 
 class RandomCrop:
-    def __init__(self, size, padding=0, pad_if_needed=False):
+    def __init__(self, size, padding=0, pad_if_needed=False,
+                 data_format=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.padding = padding
+        self.data_format = data_format
         self._rng = np.random.default_rng(0)
 
     def __call__(self, img):
         img = np.asarray(img)
-        ha, wa = _spatial(img)
+        ha, wa = _spatial(img, self.data_format)
         if self.padding:
             p = self.padding
             cfg = [(0, 0)] * img.ndim
@@ -121,12 +148,13 @@ class RandomCrop:
 
 
 class CenterCrop:
-    def __init__(self, size):
+    def __init__(self, size, data_format=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.data_format = data_format
 
     def __call__(self, img):
         img = np.asarray(img)
-        ha, wa = _spatial(img)
+        ha, wa = _spatial(img, self.data_format)
         h, w = img.shape[ha], img.shape[wa]
         th, tw = self.size
         i = (h - th) // 2
@@ -138,26 +166,30 @@ class CenterCrop:
 
 
 class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, data_format=None):
         self.prob = prob
+        self.data_format = data_format
         self._rng = np.random.default_rng(0)
 
     def __call__(self, img):
         if self._rng.random() < self.prob:
             img = np.asarray(img)
-            return np.flip(img, axis=_spatial(img)[1]).copy()
+            return np.flip(img,
+                           axis=_spatial(img, self.data_format)[1]).copy()
         return img
 
 
 class RandomVerticalFlip:
-    def __init__(self, prob=0.5):
+    def __init__(self, prob=0.5, data_format=None):
         self.prob = prob
+        self.data_format = data_format
         self._rng = np.random.default_rng(0)
 
     def __call__(self, img):
         if self._rng.random() < self.prob:
             img = np.asarray(img)
-            return np.flip(img, axis=_spatial(img)[0]).copy()
+            return np.flip(img,
+                           axis=_spatial(img, self.data_format)[0]).copy()
         return img
 
 
@@ -174,12 +206,14 @@ class BrightnessTransform:
 
 
 class Pad:
-    def __init__(self, padding, fill=0, padding_mode="constant"):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 data_format=None):
         self.padding = padding
+        self.data_format = data_format
 
     def __call__(self, img):
         img = np.asarray(img)
-        ha, wa = _spatial(img)
+        ha, wa = _spatial(img, self.data_format)
         p = self.padding
         cfg = [(0, 0)] * img.ndim
         if isinstance(p, int):
@@ -202,15 +236,16 @@ class RandomResizedCrop:
     """Random area/aspect crop then resize (reference semantics)."""
 
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
-                 interpolation="bilinear"):
+                 interpolation="bilinear", data_format=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.scale = scale
         self.ratio = ratio
+        self.data_format = data_format
 
     def __call__(self, img):
         import random as _r
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and not _hwc(arr)
+        chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         area = h * w
         for _ in range(10):
@@ -301,16 +336,17 @@ class HueTransform:
 
 class RandomErasing:
     def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
-                 value=0, inplace=False):
+                 value=0, inplace=False, data_format=None):
         self.prob, self.scale, self.ratio = prob, scale, ratio
         self.value = value
+        self.data_format = data_format
 
     def __call__(self, img):
         import random as _r
         if _r.random() > self.prob:
             return img
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and not _hwc(arr)
+        chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         for _ in range(10):
             target = h * w * _r.uniform(*self.scale)
@@ -326,17 +362,19 @@ class RandomErasing:
 
 class RandomAffine:
     def __init__(self, degrees, translate=None, scale=None, shear=None,
-                 interpolation="bilinear", fill=0, center=None):
+                 interpolation="bilinear", fill=0, center=None,
+                 data_format=None):
         self.degrees = (-degrees, degrees) if isinstance(
             degrees, (int, float)) else tuple(degrees)
         self.translate, self.scale_rng, self.shear = translate, scale, \
             shear
         self.fill = fill
+        self.data_format = data_format
 
     def __call__(self, img):
         import random as _r
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and not _hwc(arr)
+        chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         angle = _r.uniform(*self.degrees)
         tx = ty = 0
@@ -352,17 +390,18 @@ class RandomAffine:
 
 class RandomPerspective:
     def __init__(self, prob=0.5, distortion_scale=0.5,
-                 interpolation="bilinear", fill=0):
+                 interpolation="bilinear", fill=0, data_format=None):
         self.prob = prob
         self.d = distortion_scale
         self.fill = fill
+        self.data_format = data_format
 
     def __call__(self, img):
         import random as _r
         if _r.random() > self.prob:
             return img
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and not _hwc(arr)
+        chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         dx = self.d * w / 2
         dy = self.d * h / 2

@@ -9,6 +9,10 @@ import paddle_tpu as P
 import paddle_tpu.nn.functional as F
 
 torch = pytest.importorskip("torch")
+
+# cert marker (ADVICE.md #3): under PADDLE_TPU_CERT_RUN=1 the conftest
+# makes these oracle deps mandatory (missing -> run FAILS, not skips)
+pytestmark = pytest.mark.certification
 TF = torch.nn.functional
 
 rng = np.random.default_rng(7)

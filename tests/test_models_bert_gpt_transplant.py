@@ -12,6 +12,10 @@ import paddle_tpu as P
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
+# cert marker (ADVICE.md #3): under PADDLE_TPU_CERT_RUN=1 the conftest
+# makes these oracle deps mandatory (missing -> run FAILS, not skips)
+pytestmark = pytest.mark.certification
+
 
 def _t(a):
     return P.to_tensor(np.asarray(a.detach().numpy()))

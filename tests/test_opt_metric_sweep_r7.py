@@ -7,6 +7,10 @@ import pytest
 import paddle_tpu as P
 
 torch = pytest.importorskip("torch")
+
+# cert marker (ADVICE.md #3): under PADDLE_TPU_CERT_RUN=1 the conftest
+# makes these oracle deps mandatory (missing -> run FAILS, not skips)
+pytestmark = pytest.mark.certification
 sk_metrics = pytest.importorskip("sklearn.metrics")
 scipy_signal = pytest.importorskip("scipy.signal")
 

@@ -142,3 +142,67 @@ class TestDistributions:
         out = np.asarray(sm.forward(z)._data)
         e = np.exp(np.array([1.0, 2.0, 0.5]) - 2.0)
         np.testing.assert_allclose(out, e / e.sum(), rtol=1e-5)
+
+
+class TestLayoutDataFormatOverride:
+    """ADVICE.md #2 (round 8): ambiguous 3-D layouts (both first and
+    last dims channel-like, e.g. 3xHx3) warn and honor an explicit
+    data_format override instead of silently preferring HWC."""
+
+    def test_ambiguous_shape_warns(self):
+        img = rng.integers(0, 255, (3, 16, 3)).astype(np.uint8)
+        with pytest.warns(UserWarning, match="ambiguous"):
+            T.CenterCrop(2)(img)
+
+    def test_unambiguous_shapes_do_not_warn(self):
+        import warnings as _w
+        for shape in ((16, 12, 3), (3, 16, 12)):
+            img = rng.integers(0, 255, shape).astype(np.uint8)
+            with _w.catch_warnings():
+                _w.simplefilter("error")
+                T.CenterCrop(2)(img)
+
+    def test_chw_override_resolves_spatial_axes(self):
+        # genuine CHW image whose width looks channel-like: 3 x 16 x 3
+        img = rng.integers(0, 255, (3, 16, 3)).astype(np.uint8)
+        out = T.CenterCrop((4, 2), data_format="CHW")(img)
+        assert out.shape == (3, 4, 2)
+        # the heuristic default would have cropped the WRONG axes
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            wrong = T.CenterCrop((4, 2))(img)
+        assert wrong.shape != out.shape
+
+    def test_hwc_override_and_validation(self):
+        img = rng.integers(0, 255, (4, 16, 4)).astype(np.uint8)
+        out = T.CenterCrop((2, 6), data_format="HWC")(img)
+        assert out.shape == (2, 6, 4)
+        with pytest.raises(ValueError, match="data_format"):
+            T.CenterCrop(2, data_format="NCHW")(img)
+
+    def test_override_on_every_geometric_transform(self):
+        """The full surface added in this sweep's round: every
+        geometric transform takes data_format."""
+        import warnings as _w
+        img = rng.integers(0, 255, (3, 20, 10)).astype(np.uint8)
+        ts = [T.Resize((8, 8), data_format="CHW"),
+              T.RandomCrop(4, data_format="CHW"),
+              T.CenterCrop(4, data_format="CHW"),
+              T.RandomHorizontalFlip(1.0, data_format="CHW"),
+              T.RandomVerticalFlip(1.0, data_format="CHW"),
+              T.Pad(1, data_format="CHW"),
+              T.RandomResizedCrop(4, data_format="CHW"),
+              T.RandomErasing(1.0, data_format="CHW"),
+              T.RandomAffine(5, data_format="CHW"),
+              T.RandomPerspective(1.0, 0.2, data_format="CHW")]
+        with _w.catch_warnings():
+            _w.simplefilter("error")  # override => no ambiguity warning
+            for t in ts:
+                out = np.asarray(t(img))
+                assert out.shape[0] == 3, type(t).__name__
+
+    def test_flip_chw_override_flips_width_axis(self):
+        img = np.arange(3 * 5 * 3).reshape(3, 5, 3).astype(np.float32)
+        out = T.RandomHorizontalFlip(1.0, data_format="CHW")(img)
+        np.testing.assert_array_equal(np.asarray(out), img[:, :, ::-1])

@@ -54,6 +54,12 @@ else
   echo "=== step 5 produced no fresh BENCH_extra.json; NOT banking"
 fi
 
+# 5b. serving-engine smoke (round 8): continuous-batching replay on a
+#     known-good program class (plain XLA gather attention — the paged
+#     Pallas stub stays interpret-gated, so NO first-time Mosaic compile
+#     here; safe to run before the risk tier).
+run bash tools/serving_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

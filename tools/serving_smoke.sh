@@ -1,0 +1,19 @@
+#!/bin/bash
+# Serving-engine smoke for the chip-capture list (append AFTER the safe
+# tier): replays a tiny Poisson trace through the continuous-batching
+# engine and banks the JSON artifact.
+#
+# Wedge-proofing (CLAUDE.md chip hygiene): bench_serving.py probes TPU
+# health in a BOUNDED subprocess (bench.py::_tpu_usable — tunnel-socket
+# pre-check, SIGTERM-only, never SIGKILL) and falls back to CPU, so this
+# script cannot hang on a dead chip and never kills a mid-compile
+# process. The serving paged-attention Pallas stub stays interpret-gated
+# (PADDLE_TPU_PAGED_KERNEL unset here), so no first-time Mosaic compile
+# runs on the chip from this smoke.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_smoke.sh > .bench_r4/serving_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke | tee .bench_r4/serving_smoke.json
