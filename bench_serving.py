@@ -2,7 +2,17 @@
 trace through `paddle_tpu.serving.ServingEngine` on a small LLaMA-family
 model and report throughput + latency.
 
-Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new] [--smoke]
+Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
+                               [--smoke] [--server]
+
+`--server` replays the SAME trace over real sockets: a ServingServer is
+bound on an ephemeral localhost port and a thread-per-request load
+generator POSTs `/v1/completions` with `stream=true`, collecting SSE
+chunks (so the full front-end — HTTP parse, SSE framing, per-request
+stream queues, the engine-loop lock — sits on the measured path). The
+two-point marginal discipline is unchanged: fresh server per replay,
+quarter vs full decode budget, marginal tokens/s. Artifact:
+BENCH_serving_http.json (offline mode keeps BENCH_serving.json).
 
 Measurement (PERF.md round-3 method): the decode rate is a TWO-POINT
 MARGINAL — the SAME trace is replayed at a quarter decode budget and at
@@ -29,6 +39,9 @@ import numpy as np
 smoke = "--smoke" in sys.argv
 if smoke:
     sys.argv.remove("--smoke")
+server_mode = "--server" in sys.argv
+if server_mode:
+    sys.argv.remove("--server")
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -72,6 +85,55 @@ def replay(model, arrivals, prompts, new_tokens, **engine_kw):
     return wall, done_tokens, eng.metrics
 
 
+def replay_http(model, arrivals, prompts, new_tokens, **engine_kw):
+    """Wall-clock replay over real sockets: a fresh ServingServer per
+    replay; one loader thread per request fires at its Poisson arrival
+    time and streams `/v1/completions` SSE to completion."""
+    import http.client
+    import threading
+
+    from paddle_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(model, **engine_kw)
+    srv = ServingServer(eng, max_queued=len(prompts) + 1)
+    host, port = srv.start()
+    counts = [0] * len(prompts)
+    errors = []
+
+    def fire(i, due, prompt, t0):
+        time.sleep(max(0.0, due - (time.perf_counter() - t0)))
+        try:
+            c = http.client.HTTPConnection(host, port, timeout=600)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [int(t) for t in prompt],
+                 "max_tokens": new_tokens, "stream": True}),
+                {"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 200, r.status
+            n = 0
+            for raw in r:
+                if raw.startswith(b"data: ") and b"token_id" in raw:
+                    n += 1
+            counts[i] = n
+            c.close()
+        except Exception as e:  # surfaced after join; bench must not hang
+            errors.append((i, repr(e)))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=fire, args=(i, a, p, t0),
+                                daemon=True)
+               for i, (a, p) in enumerate(zip(arrivals, prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    srv.close()
+    assert not errors, errors[:4]
+    assert all(n == new_tokens for n in counts), counts
+    return wall, sum(counts), eng.metrics
+
+
 def main():
     from bench import _tpu_usable, force_cpu  # wedge-safe probe + reroute
     tpu_ok = False if smoke else _tpu_usable(attempts=2, probe_timeout=90,
@@ -107,17 +169,18 @@ def main():
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
     new_q = max(1, max_new // 4)
+    run = replay_http if server_mode else replay
 
     # warmup: compile every bucketed program class off the clock
     warm_n = min(4, n_requests)
-    replay(model, np.zeros(warm_n), prompts[:warm_n], new_q, **engine_kw)
-    replay(model, np.zeros(warm_n), prompts[:warm_n], max_new,
-           **engine_kw)
+    run(model, np.zeros(warm_n), prompts[:warm_n], new_q, **engine_kw)
+    run(model, np.zeros(warm_n), prompts[:warm_n], max_new,
+        **engine_kw)
 
-    wall_q, toks_q, _ = replay(model, arrivals, prompts, new_q,
-                               **engine_kw)
-    wall, toks, metrics = replay(model, arrivals, prompts, max_new,
-                                 **engine_kw)
+    wall_q, toks_q, _ = run(model, arrivals, prompts, new_q,
+                            **engine_kw)
+    wall, toks, metrics = run(model, arrivals, prompts, max_new,
+                              **engine_kw)
 
     marginal = None
     if wall > wall_q and toks > toks_q:
@@ -125,9 +188,12 @@ def main():
     e2e = toks / wall
     m = metrics.export()
     out = {
-        "metric": "serving_tok_per_s" + ("" if on_tpu else "_cpu"),
+        "metric": ("serving_http_tok_per_s" if server_mode
+                   else "serving_tok_per_s") + ("" if on_tpu else "_cpu"),
         "value": round(marginal, 1) if marginal else round(e2e, 1),
-        "unit": "decode tokens/sec (continuous batching, "
+        "unit": "decode tokens/sec ("
+                + ("HTTP/SSE front-end, " if server_mode else "")
+                + "continuous batching, "
                 + ("two-point marginal" if marginal else
                    "end-to-end — marginal unavailable") + ")",
         "n_requests": n_requests, "rate_per_s": rate,
@@ -142,9 +208,14 @@ def main():
         "deadline_evictions": m["deadline_evictions"],
         "smoke": smoke,
     }
+    if server_mode:
+        out["rejections"] = m["rejections"]
+        out["cancellations"] = m["cancellations"]
     line = json.dumps(out)
     print(line)
-    with open("BENCH_serving.json", "w") as f:
+    artifact = ("BENCH_serving_http.json" if server_mode
+                else "BENCH_serving.json")
+    with open(artifact, "w") as f:
         f.write(line + "\n")
 
 

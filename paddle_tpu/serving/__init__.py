@@ -12,21 +12,40 @@ Layers:
 - :mod:`scheduler`  — continuous batching: watermark admission, chunked
   prefill, decode-priority iteration, deadlines, LIFO preemption.
 - :mod:`engine`     — bucketed fixed-shape compiled step (weights as
-  arguments) + :mod:`metrics` (TTFT / inter-token / occupancy JSON).
+  arguments) + :mod:`metrics` (TTFT / inter-token / occupancy JSON +
+  Prometheus exposition). Round 9: per-token ``on_event`` streaming,
+  ``cancel()`` (pages freed, queues purged), ``drain()`` mode,
+  env-gated fault injection at the step boundary, failure-path page
+  release.
+- :mod:`frontend`   — thread-safe request bridge: lock-serialized
+  engine loop thread, per-request token streams, reservation-based
+  load shedding (429) and graceful drain (503).
+- :mod:`server`     — stdlib OpenAI-compatible HTTP front-end:
+  /v1/completions + /v1/chat/completions (SSE streaming), /healthz,
+  /metrics; disconnect-driven cancellation.
 
-Driver: ``bench_serving.py`` (repo root) replays a Poisson trace and
-emits the BENCH_serving artifact. Docs: ``docs/SERVING.md``.
+Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
+offline through the engine, or over real sockets with ``--server`` —
+and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
 """
 from .attention import paged_attention, paged_attention_ref  # noqa: F401
-from .engine import ServingEngine  # noqa: F401
+from .engine import (EngineDraining, FaultInjected,  # noqa: F401
+                     ServingEngine)
+from .frontend import (Rejected, RequestStream,  # noqa: F401
+                       ServingFrontend, Unavailable)
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache  # noqa: F401
-from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      ServingMetrics)
 from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
                         SchedulerOutput)
+from .server import ServingServer  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
     "paged_attention", "paged_attention_ref",
     "Scheduler", "SchedulerOutput", "Request", "RequestState",
-    "ServingEngine", "ServingMetrics", "Counter", "Histogram",
+    "ServingEngine", "EngineDraining", "FaultInjected",
+    "ServingMetrics", "Counter", "Gauge", "Histogram",
+    "ServingFrontend", "RequestStream", "Rejected", "Unavailable",
+    "ServingServer",
 ]

@@ -31,7 +31,10 @@ first-time Mosaic kernel (the paged Pallas stub stays interpret-gated).
 from __future__ import annotations
 
 import functools
+import json
+import logging
 import math
+import os
 import time
 
 import numpy as np
@@ -40,14 +43,28 @@ from .kv_cache import OutOfPages, PagedKVCache
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
 
-__all__ = ["ServingEngine"]
+__all__ = ["EngineDraining", "FaultInjected", "ServingEngine"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+
+class EngineDraining(RuntimeError):
+    """Raised by add_request once drain() started — in-flight work
+    finishes; new admissions are refused (the front-end maps it to
+    HTTP 503)."""
+
+
+class FaultInjected(RuntimeError):
+    """The env-gated fault hook fired at a step boundary. Injected
+    BEFORE any device work or state mutation, so the step is safely
+    retryable — the front-end loop counts it and keeps stepping."""
 
 
 class ServingEngine:
     def __init__(self, model, *, page_size=16, num_pages=None,
                  hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
-                 cache_dtype=None):
+                 cache_dtype=None, on_event=None):
         cfg = getattr(model, "cfg", None)
         core = getattr(model, "llama", model)
         for attr in ("embed_tokens", "layers", "norm"):
@@ -95,6 +112,14 @@ class ServingEngine:
         self._requests: dict[int, Request] = {}
         self._finished: dict[int, Request] = {}
         self._rngs: dict[int, np.random.Generator] = {}
+        # streaming callback: called synchronously with every event dict
+        # the moment it is emitted (token/finish), from the thread that
+        # runs step(). Must be cheap and non-blocking — the front-end
+        # uses it to route tokens into per-request stream queues.
+        self.on_event = on_event
+        self._draining = False
+        self._fault_rng = np.random.default_rng(
+            int(os.environ.get("PADDLE_TPU_SERVING_FAULT_SEED", "0")))
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
@@ -102,6 +127,10 @@ class ServingEngine:
                     seed=None, n=1):
         """Queue a request; returns its req_id (n>1 returns the PARENT id
         — forked children surface as their own req_ids in events)."""
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining: in-flight requests finish, new "
+                "admissions are refused")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -132,6 +161,7 @@ class ServingEngine:
     def step(self):
         """One scheduler iteration. Returns a list of event dicts
         ({"type": "token"|"finish", "req_id", ...})."""
+        self._maybe_inject_fault()
         was_training = getattr(self.model, "training", False)
         if was_training:
             self.model.eval()
@@ -174,20 +204,96 @@ class ServingEngine:
                     "prompt")
         self.metrics.queue_depth.record(self.scheduler.queue_depth())
         self.metrics.page_occupancy.record(self.cache.occupancy())
+        self.metrics.queue_depth_gauge.set(self.scheduler.queue_depth())
+        self.metrics.page_occupancy_gauge.set(self.cache.occupancy())
+        self.metrics.running_gauge.set(len(self.scheduler.running))
         return events
 
     def run(self, max_steps=100000):
         """Step until every queued request finished; returns
-        {req_id: {"tokens", "finish_reason", "preemptions"}}."""
+        {req_id: {"tokens", "finish_reason", "preemptions"}}.
+
+        On ANY failure the live requests' pages are returned to the free
+        list (requests are requeued for recompute, generated tokens
+        kept), so the engine stays reusable: a later run() retries them
+        and — greedy or seeded — reproduces the uninterrupted streams.
+        """
         steps = 0
-        while not self.scheduler.all_done():
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(
-                    f"serving loop did not drain in {max_steps} steps "
-                    "(starvation or a stuck request)")
+        try:
+            while not self.scheduler.all_done():
+                self.step()
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError(
+                        f"serving loop did not drain in {max_steps} "
+                        "steps (starvation or a stuck request)")
+        except Exception:
+            self.release_live()
+            raise
         return self.results()
+
+    def cancel(self, req_id):
+        """Cancel a live request: frees its KV pages, purges it from
+        every scheduler queue, and emits a ``finish`` event with reason
+        ``"cancelled"`` (partial output is kept in results()). Returns
+        True if the request was live, False for unknown/finished ids.
+
+        NOT safe to call concurrently with step() — the front-end
+        serializes both under one lock; direct users call it between
+        steps.
+        """
+        req = self._requests.get(req_id)
+        if req is None or req.state == RequestState.FINISHED:
+            return False
+        if self.cache.has_seq(req.seq_id):
+            self.cache.free_seq(req.seq_id)
+        self.scheduler.remove(req)
+        req.state = RequestState.FINISHED
+        req.finish_reason = "cancelled"
+        self.metrics.cancellations.inc()
+        self._record_finish(req, [])
+        return True
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def start_drain(self):
+        """Refuse new admissions; everything already queued (waiting/
+        prefilling/running) keeps going to completion."""
+        self._draining = True
+
+    def drain(self, max_steps=100000):
+        """start_drain() + run(): finish all in-flight work while
+        rejecting admissions; returns results()."""
+        self.start_drain()
+        return self.run(max_steps)
+
+    def release_live(self):
+        """Error path: free every live request's pages and requeue the
+        requests (front of queue, recompute-style — generated tokens
+        kept) so a failed run() leaves the allocator clean and the
+        engine reusable."""
+        for r in self.scheduler.live_requests():
+            if self.cache.has_seq(r.seq_id):
+                self.cache.free_seq(r.seq_id)
+            self.scheduler.preempt(r)
+
+    def _maybe_inject_fault(self):
+        """Env-gated fault hook, evaluated at the step BOUNDARY (before
+        any device work or state mutation, so a raised step is safely
+        retryable): PADDLE_TPU_SERVING_FAULT_LATENCY_S sleeps,
+        PADDLE_TPU_SERVING_FAULT_ERROR_RATE raises FaultInjected with
+        that probability (PADDLE_TPU_SERVING_FAULT_SEED seeds it)."""
+        lat = os.environ.get("PADDLE_TPU_SERVING_FAULT_LATENCY_S")
+        if lat:
+            time.sleep(float(lat))
+        rate = os.environ.get("PADDLE_TPU_SERVING_FAULT_ERROR_RATE")
+        if rate and self._fault_rng.random() < float(rate):
+            self.metrics.faults_injected.inc()
+            raise FaultInjected(
+                "injected step fault "
+                f"(PADDLE_TPU_SERVING_FAULT_ERROR_RATE={rate})")
 
     def results(self):
         return {rid: {"tokens": list(r.out_tokens),
@@ -327,8 +433,8 @@ class ServingEngine:
             self.metrics.inter_token_s.record(now - req.last_token_at)
         req.last_token_at = now
         self.metrics.tokens_generated.inc()
-        events.append({"type": "token", "req_id": req.req_id,
-                       "token": tok})
+        self._event({"type": "token", "req_id": req.req_id,
+                     "token": tok}, events)
         if self.eos is not None and tok == self.eos:
             self._finish(req, "stop", events)
         elif len(req.out_tokens) >= req.max_new_tokens:
@@ -343,9 +449,32 @@ class ServingEngine:
     def _record_finish(self, req, events):
         self.metrics.requests_finished.inc()
         self._finished[req.req_id] = req
-        events.append({"type": "finish", "req_id": req.req_id,
-                       "reason": req.finish_reason,
-                       "n_tokens": len(req.out_tokens)})
+        self._event({"type": "finish", "req_id": req.req_id,
+                     "reason": req.finish_reason,
+                     "n_tokens": len(req.out_tokens)}, events)
+        if _log.isEnabledFor(logging.INFO):
+            n = len(req.out_tokens)
+            ttft = (req.first_token_at - req.arrival
+                    if req.first_token_at is not None else None)
+            tpot = ((req.last_token_at - req.first_token_at) / (n - 1)
+                    if n > 1 else None)
+            _log.info(json.dumps({
+                "event": "request_finished", "req_id": req.req_id,
+                "reason": req.finish_reason, "n_tokens": n,
+                "prompt_tokens": int(req.prompt.size),
+                "ttft_s": ttft, "tpot_s": tpot,
+                "preemptions": req.preemptions,
+                "parent_id": req.parent_id}))
+
+    def _event(self, ev, events):
+        events.append(ev)
+        if self.on_event is not None:
+            self.on_event(ev)
+
+    def request(self, req_id):
+        """Look up a Request by id (live or finished) — the front-end
+        uses this to map forked children onto their parent's stream."""
+        return self._requests.get(req_id)
 
     def _sample(self, req, logits_row):
         lg = np.asarray(logits_row, np.float32)

@@ -1,0 +1,300 @@
+"""Thread-safe bridge between concurrent clients and the single-threaded
+:class:`~paddle_tpu.serving.engine.ServingEngine` loop.
+
+The engine is strictly single-threaded (host bookkeeping + a jit step);
+the front-end owns it behind ONE lock and a dedicated loop thread:
+
+- ``submit()`` (any thread) admits a request under the lock and returns
+  a :class:`RequestStream` — a queue the loop thread feeds via the
+  engine's ``on_event`` callback, so tokens stream out as they are
+  sampled (no drain-then-return).
+- **Load shedding** (the no-preemption envelope): a submission is
+  REJECTED (:class:`Rejected` → HTTP 429) when the waiting queue is at
+  ``max_queued`` or when reserving the request's WORST-CASE page need
+  (full prompt+max_new_tokens, ×n for forks) on top of every already
+  accepted request's outstanding reservation would dip into the
+  scheduler watermark. Reservation admission is deliberately more
+  conservative than the engine's own history+1 watermark check: every
+  accepted request can grow to completion without the allocator ever
+  raising OutOfPages, so an over-capacity burst is shed with 429s and
+  NEVER evicts a running decode. (Direct engine users keep the
+  preemption elasticity; the shed gate is a front-end policy.)
+- ``cancel()`` (any thread) frees the request's pages and purges the
+  scheduler queues synchronously under the lock.
+- ``drain()`` stops admissions (:class:`Unavailable` → HTTP 503),
+  finishes all in-flight work, then parks the loop thread.
+- The loop SURVIVES injected step faults (engine.FaultInjected — the
+  hook fires before any state mutation, so the step is retried); any
+  other loop exception is fatal: live pages are released
+  (``engine.release_live``), every open stream gets an error event, and
+  the front-end reports ``"failed"``.
+
+Capacity math and engine state are only ever read/written under the
+lock, so a submission races neither the step loop nor other submitters.
+The lock is held across a whole engine step — including the first-call
+jit trace — so a submit may block for one step duration; that IS the
+backpressure.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .engine import FaultInjected
+
+__all__ = ["Rejected", "RequestStream", "ServingFrontend", "Unavailable"]
+
+
+class Rejected(RuntimeError):
+    """Load-shed admission (maps to HTTP 429: retry later)."""
+
+
+class Unavailable(RuntimeError):
+    """Front-end draining or failed (maps to HTTP 503)."""
+
+
+class RequestStream:
+    """Per-submission event stream. For ``n>1`` sampling the forked
+    children's events arrive on the SAME stream, tagged with a stable
+    ``index`` (0 = the submitted parent, 1.. = forks in creation order);
+    the stream completes after ``n`` finish events."""
+
+    def __init__(self, req_id, n=1):
+        self.req_id = req_id
+        self.n = int(n)
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._ids = {req_id: 0}
+        self._finished = 0
+        self.error = None
+
+    # -- loop-thread side --------------------------------------------------
+    def _index_for(self, rid):
+        if rid not in self._ids:
+            self._ids[rid] = len(self._ids)
+        return self._ids[rid]
+
+    def _push(self, ev):
+        if ev["type"] == "finish":
+            self._finished += 1
+        self._q.put(ev)
+
+    def _fail(self, exc):
+        self.error = exc
+        self._q.put({"type": "error", "message": str(exc)})
+
+    @property
+    def done(self):
+        return self._finished >= self.n
+
+    def all_ids(self):
+        """Every req_id feeding this stream (parent + known forks)."""
+        return list(self._ids)
+
+    # -- client side -------------------------------------------------------
+    def events(self, timeout=120.0):
+        """Yield event dicts ({"type": "token"|"finish", "index", ...})
+        until all n samples finished. Raises TimeoutError when no event
+        lands within ``timeout`` seconds, RuntimeError when the engine
+        loop died."""
+        finishes = 0
+        while finishes < self.n:
+            try:
+                ev = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.req_id}: no event within "
+                    f"{timeout}s") from None
+            if ev["type"] == "error":
+                raise RuntimeError(
+                    f"engine loop failed: {ev['message']}")
+            yield ev
+            if ev["type"] == "finish":
+                finishes += 1
+
+    def result(self, timeout=120.0):
+        """Block until complete; returns a list of n dicts
+        ({"tokens", "finish_reason"}) ordered by sample index."""
+        out = [{"tokens": [], "finish_reason": None}
+               for _ in range(self.n)]
+        for ev in self.events(timeout=timeout):
+            slot = out[ev["index"]]
+            if ev["type"] == "token":
+                slot["tokens"].append(ev["token"])
+            else:
+                slot["finish_reason"] = ev["reason"]
+        return out
+
+
+class ServingFrontend:
+    def __init__(self, engine, *, max_queued=64, poll_interval_s=0.001):
+        if engine.on_event is not None:
+            raise ValueError("engine already has an on_event consumer")
+        engine.on_event = self._on_event
+        self.engine = engine
+        self.max_queued = int(max_queued)
+        self.poll_interval_s = float(poll_interval_s)
+        self.lock = threading.Lock()
+        self.error = None
+        self._streams: dict[int, RequestStream] = {}
+        self._state = "ok"            # ok | draining | failed
+        self._thread = None
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("front-end already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-engine-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def state(self):
+        return self._state
+
+    def drain(self, timeout=120.0):
+        """Stop admissions, finish every in-flight request, stop the
+        loop thread. Returns True when fully drained within timeout."""
+        with self.lock:
+            if self._state == "ok":
+                self._state = "draining"
+                self.engine.start_drain()
+        ok = self._drained.wait(timeout)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        return ok and self._state != "failed"
+
+    def close(self, timeout=120.0):
+        return self.drain(timeout)
+
+    # -- client API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, **kw):
+        """Admit a request; returns a RequestStream. Raises Rejected
+        (429) under load shed, Unavailable (503) when draining/failed,
+        ValueError for malformed requests."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(kw.get("n", 1))
+        with self.lock:
+            if self._state != "ok":
+                raise Unavailable(f"front-end is {self._state}")
+            self._check_capacity(prompt.size, int(max_new_tokens), n)
+            rid = self.engine.add_request(
+                prompt, max_new_tokens=int(max_new_tokens), **kw)
+            stream = RequestStream(rid, n)
+            self._streams[rid] = stream
+        return stream
+
+    def cancel(self, req_id):
+        """Cancel a submission (parent + any forks on its stream);
+        pages return to the free list before this call returns. True
+        if anything was actually cancelled."""
+        with self.lock:
+            stream = self._streams.get(req_id)
+            ids = stream.all_ids() if stream is not None else [req_id]
+            hit = False
+            for rid in ids:
+                hit = self.engine.cancel(rid) or hit
+        return hit
+
+    def health(self):
+        with self.lock:
+            eng = self.engine
+            return {"status": self._state,
+                    "waiting": eng.scheduler.queue_depth(),
+                    "live": len(eng.scheduler.live_requests()),
+                    "free_pages": eng.cache.free_pages,
+                    "requests_finished":
+                        eng.metrics.requests_finished.value}
+
+    def prometheus(self):
+        """Refresh the point-in-time gauges and render the exposition."""
+        with self.lock:
+            eng = self.engine
+            m = eng.metrics
+            m.queue_depth_gauge.set(eng.scheduler.queue_depth())
+            m.page_occupancy_gauge.set(eng.cache.occupancy())
+            m.running_gauge.set(len(eng.scheduler.running))
+            return m.to_prometheus()
+
+    # -- internals ---------------------------------------------------------
+    def _check_capacity(self, prompt_len, max_new, n):
+        """Reservation admission (no-preemption envelope): reject when
+        the waiting queue is full or the worst-case page need cannot be
+        covered on top of all outstanding reservations + watermark."""
+        eng = self.engine
+        sched, cache = eng.scheduler, eng.cache
+        if sched.queue_depth() >= self.max_queued:
+            eng.metrics.rejections.inc()
+            raise Rejected(
+                f"intake queue full ({self.max_queued} waiting)")
+        need = cache.pages_for(prompt_len + max_new) * n
+        promised = 0
+        for r in sched.live_requests():
+            promised += max(
+                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
+                * r.n - cache.pages_held(r.seq_id))
+        for r in sched.waiting:
+            promised += cache.pages_for(
+                r.prompt.size + r.max_new_tokens) * r.n
+        if need + promised + sched.watermark_pages > cache.free_pages:
+            eng.metrics.rejections.inc()
+            raise Rejected(
+                f"over capacity: need {need} page(s), "
+                f"{cache.free_pages} free - {promised} reserved - "
+                f"{sched.watermark_pages} watermark")
+
+    def _on_event(self, ev):
+        # runs in whichever thread holds the lock and drives the engine
+        # (the loop thread via step(), a handler thread via cancel())
+        rid = ev["req_id"]
+        stream = self._streams.get(rid)
+        if stream is None:
+            req = self.engine.request(rid)
+            pid = getattr(req, "parent_id", None)
+            if pid is None or pid not in self._streams:
+                return  # not a front-end submission
+            stream = self._streams[pid]
+            self._streams[rid] = stream
+        stream._push(dict(ev, index=stream._index_for(rid)))
+        if ev["type"] == "finish" and stream.done:
+            for r in stream.all_ids():
+                self._streams.pop(r, None)
+
+    def _loop(self):
+        eng = self.engine
+        try:
+            while not self._stop.is_set():
+                with self.lock:
+                    idle = eng.scheduler.all_done()
+                    if not idle:
+                        try:
+                            eng.step()
+                        except FaultInjected:
+                            pass  # counted; boundary fault — retry next
+                        except Exception as exc:  # fatal: clean + report
+                            self._fail_locked(exc)
+                            return
+                    elif self._state == "draining":
+                        return
+                # idle: nap off-lock; busy: yield so submitters can
+                # grab the lock between steps
+                time.sleep(self.poll_interval_s if idle else 0)
+        finally:
+            self._drained.set()
+
+    def _fail_locked(self, exc):
+        self._state = "failed"
+        self.error = exc
+        try:
+            self.engine.release_live()
+        except Exception:
+            pass
+        for stream in set(self._streams.values()):
+            stream._fail(exc)
+        self._streams.clear()

@@ -1,6 +1,7 @@
-"""Serving observability: counters + reservoir histograms exported as
-JSON for the bench harness (PERF.md convention: one JSON artifact per
-measurement, banked the moment it lands).
+"""Serving observability: counters, gauges and reservoir histograms,
+exported as JSON for the bench harness (PERF.md convention: one JSON
+artifact per measurement, banked the moment it lands) and as Prometheus
+text exposition for the HTTP front-end's ``/metrics`` endpoint.
 
 Host-side and allocation-light by design — metrics must never add a
 device sync; the engine records values it already fetched.
@@ -11,7 +12,7 @@ import json
 
 import numpy as np
 
-__all__ = ["Counter", "Histogram", "ServingMetrics"]
+__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics"]
 
 
 class Counter:
@@ -20,6 +21,21 @@ class Counter:
 
     def inc(self, n=1):
         self.value += n
+
+    def export(self):
+        return self.value
+
+
+class Gauge:
+    """A point-in-time value (queue depth, occupancy, batch size) —
+    ``set()`` overwrites; the exposition shows the LAST value, unlike a
+    Histogram which keeps the distribution."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = float(v)
 
     def export(self):
         return self.value
@@ -34,14 +50,18 @@ class Histogram:
         self.cap = int(cap)
         self._samples: list[float] = []
         self.count = 0
+        self.total = 0.0  # running sum over ALL samples (summary _sum)
 
     def record(self, v):
         self.count += 1
+        self.total += float(v)
         self._samples.append(float(v))
         if len(self._samples) > self.cap:
             del self._samples[: len(self._samples) - self.cap]
 
     def percentile(self, p):
+        """Percentile over the reservoir; None (never a raise) while no
+        sample has been recorded — scrapes happen before traffic."""
         if not self._samples:
             return None
         return float(np.percentile(np.asarray(self._samples), p))
@@ -59,7 +79,8 @@ class Histogram:
 
 
 class ServingMetrics:
-    """The engine's counter/histogram set (names are the export keys)."""
+    """The engine's counter/gauge/histogram set (names are the export
+    keys and, prefixed, the Prometheus metric family names)."""
 
     def __init__(self):
         self.ttft_s = Histogram()             # arrival -> first token
@@ -74,9 +95,40 @@ class ServingMetrics:
         self.preemptions = Counter()
         self.deadline_evictions = Counter()
         self.cow_copies = Counter()
+        # front-end lifecycle (round 9)
+        self.cancellations = Counter()        # cancel() calls that landed
+        self.rejections = Counter()           # load-shed admissions (429)
+        self.faults_injected = Counter()      # injected step faults
+        # point-in-time gauges, refreshed per step and at /metrics scrape
+        self.queue_depth_gauge = Gauge()
+        self.page_occupancy_gauge = Gauge()
+        self.running_gauge = Gauge()          # running decode batch size
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}
 
     def to_json(self, **extra):
         return json.dumps({**self.export(), **extra})
+
+    def to_prometheus(self, prefix="paddle_tpu_serving"):
+        """Prometheus text exposition (format 0.0.4): counters and
+        gauges as single samples, histograms as summaries with p50/p99
+        quantiles plus _count/_sum. Empty histograms expose only
+        _count/_sum (a quantile of no data is omitted, not NaN, so the
+        text stays trivially parseable)."""
+        lines = []
+        for name, m in vars(self).items():
+            full = f"{prefix}_{name}"
+            if isinstance(m, Counter):
+                lines += [f"# TYPE {full} counter", f"{full} {m.value}"]
+            elif isinstance(m, Gauge):
+                lines += [f"# TYPE {full} gauge", f"{full} {m.value}"]
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {full} summary")
+                for q, p in ((0.5, 50), (0.99, 99)):
+                    v = m.percentile(p)
+                    if v is not None:
+                        lines.append(f'{full}{{quantile="{q}"}} {v}')
+                lines += [f"{full}_count {m.count}",
+                          f"{full}_sum {m.total}"]
+        return "\n".join(lines) + "\n"

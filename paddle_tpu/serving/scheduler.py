@@ -198,6 +198,19 @@ class Scheduler:
             self._admit_order.append(req)
             committed += need
 
+    def remove(self, req: Request):
+        """Purge a request from EVERY queue (cancellation path) without
+        touching its state — the engine owns the state transition and
+        the page release, mirroring the deadline-eviction split."""
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req in self.prefill_queue:
+            self.prefill_queue.remove(req)
+        if req in self.running:
+            self.running.remove(req)
+        if req in self._admit_order:
+            self._admit_order.remove(req)
+
     # -- state transitions driven by the engine ----------------------------
     def prefill_advanced(self, req: Request, new_pos: int):
         req.prefill_pos = new_pos

@@ -1,0 +1,350 @@
+"""OpenAI-compatible HTTP front-end for the serving engine — stdlib
+only (``http.server`` + ``socketserver`` threading mixin), no new
+dependencies (reference capability: the FastDeploy / Paddle Serving
+HTTP layer; protocol shape: the OpenAI completions API that vLLM-class
+servers expose).
+
+Endpoints
+---------
+- ``POST /v1/completions`` — ``{"prompt": [token ids], "max_tokens",
+  "stream", "temperature", "top_k", "seed", "n", "deadline_s"}``.
+  The repo has no tokenizer, so prompts are TOKEN ID LISTS by default;
+  pass ``tokenizer=`` (str → ids) to accept strings.
+- ``POST /v1/chat/completions`` — ``{"messages": [{"role", "content"}]}``
+  with the same generation fields; message contents are id lists (or
+  strings via ``tokenizer``), concatenated in order.
+- ``GET /healthz`` — ``{"status": "ok"|"draining"|"failed", ...}``
+  (200 while serving or draining, 503 once failed).
+- ``GET /metrics`` — Prometheus text exposition (format 0.0.4).
+
+Streaming: ``"stream": true`` responds as Server-Sent Events, one
+OpenAI-shaped chunk per token (plus a ``token_id`` extension field so
+clients that brought their own tokenizer stay bit-exact), a final
+finish-reason chunk per sample, then ``data: [DONE]``. The connection
+is close-delimited (HTTP/1.0 semantics) — no chunked framing needed.
+
+Overload semantics: an admission the front-end sheds (queue full or
+page reservation would dip into the scheduler watermark — see
+``frontend.py``) returns **429** with ``Retry-After: 1``; a draining or
+failed server returns **503**; malformed requests 400. A client that
+disconnects mid-stream gets its request **cancelled** — the engine
+frees its KV pages and purges the scheduler queues on the spot.
+
+Shutdown: ``drain()`` flips /healthz to "draining", 503s new work,
+finishes every in-flight request; ``close()`` then stops the listener.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .engine import EngineDraining
+from .frontend import Rejected, ServingFrontend, Unavailable
+
+__all__ = ["ServingServer"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner = None  # set by ServingServer.start
+
+
+class ServingServer:
+    """Owns a :class:`ServingFrontend` (engine loop thread) and a
+    threaded HTTP listener. ``start()`` binds and returns
+    ``(host, port)`` (port 0 → ephemeral)."""
+
+    def __init__(self, engine, *, host="127.0.0.1", port=0,
+                 model_name="paddle-tpu", tokenizer=None,
+                 detokenizer=None, max_queued=64, stream_timeout_s=120.0,
+                 poll_interval_s=0.001):
+        self.frontend = ServingFrontend(
+            engine, max_queued=max_queued,
+            poll_interval_s=poll_interval_s)
+        self.host = host
+        self.port = int(port)
+        self.model_name = model_name
+        self.tokenizer = tokenizer        # str -> list[int]
+        self.detokenizer = detokenizer    # int -> str
+        self.stream_timeout_s = float(stream_timeout_s)
+        self._httpd = None
+        self._serve_thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            raise RuntimeError("server already started")
+        self.frontend.start()
+        self._httpd = _HTTPServer((self.host, self.port), _Handler)
+        self._httpd.owner = self
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="serving-http", daemon=True)
+        self._serve_thread.start()
+        _log.info(json.dumps({"event": "server_started",
+                              "host": self.host, "port": self.port}))
+        return self.host, self.port
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def drain(self, timeout=120.0):
+        """Graceful drain: reject new admissions (503), finish all
+        in-flight requests. The listener stays up for /healthz and
+        /metrics until close(). True when fully drained in time."""
+        return self.frontend.drain(timeout)
+
+    def cancel(self, req_id):
+        return self.frontend.cancel(req_id)
+
+    def close(self, timeout=120.0):
+        """drain() then stop the HTTP listener."""
+        drained = self.frontend.drain(timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        return drained
+
+    # -- request translation ----------------------------------------------
+    def _encode(self, body, chat):
+        def ids_of(content, what):
+            if isinstance(content, list) and all(
+                    isinstance(t, int) for t in content):
+                return content
+            if isinstance(content, str):
+                if self.tokenizer is None:
+                    raise _BadRequest(
+                        f"{what} is a string but the server has no "
+                        "tokenizer; send a token id list")
+                return list(self.tokenizer(content))
+            raise _BadRequest(
+                f"{what} must be a list of token ids"
+                + (" or a string" if self.tokenizer else ""))
+
+        if chat:
+            msgs = body.get("messages")
+            if not isinstance(msgs, list) or not msgs:
+                raise _BadRequest("messages must be a non-empty list")
+            ids = []
+            for i, m in enumerate(msgs):
+                if not isinstance(m, dict) or "content" not in m:
+                    raise _BadRequest(
+                        f"messages[{i}] needs a content field")
+                ids += ids_of(m["content"], f"messages[{i}].content")
+            return ids
+        if "prompt" not in body:
+            raise _BadRequest("prompt is required")
+        return ids_of(body["prompt"], "prompt")
+
+    def _gen_kwargs(self, body):
+        kw = {"max_new_tokens": body.get("max_tokens", 16)}
+        if not isinstance(kw["max_new_tokens"], int):
+            raise _BadRequest("max_tokens must be an integer")
+        temp = body.get("temperature")
+        if temp is not None and float(temp) > 0:
+            kw.update(do_sample=True, temperature=float(temp))
+        if body.get("n") is not None:
+            kw["n"] = int(body["n"])
+        if body.get("top_k") is not None:
+            kw["top_k"] = int(body["top_k"])
+        if body.get("seed") is not None:
+            kw["seed"] = int(body["seed"])
+        if body.get("deadline_s") is not None:
+            kw["deadline_s"] = float(body["deadline_s"])
+        return kw
+
+    def _piece(self, tok):
+        if self.detokenizer is not None:
+            return self.detokenizer(tok)
+        return f"{tok} "  # no tokenizer: token ids as text
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0: responses are close-delimited, which is exactly what the
+    # SSE stream needs (no chunked framing, no keep-alive bookkeeping)
+    protocol_version = "HTTP/1.0"
+    server_version = "paddle-tpu-serving/1.0"
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        _log.debug("%s %s", self.address_string(), fmt % args)
+
+    @property
+    def owner(self) -> ServingServer:
+        return self.server.owner
+
+    # -- plumbing ----------------------------------------------------------
+    def _json(self, code, obj, extra_headers=()):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _error(self, code, message, err_type, retry=False):
+        self._json(code, {"error": {"message": message,
+                                    "type": err_type, "code": code}},
+                   extra_headers=(("Retry-After", "1"),) if retry else ())
+
+    def _read_json(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, f"invalid JSON body: {e}",
+                        "invalid_request_error")
+            return None
+
+    def _sse(self, obj):
+        self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+        self.wfile.flush()
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/healthz":
+            h = self.owner.frontend.health()
+            self._json(503 if h["status"] == "failed" else 200, h)
+        elif self.path == "/metrics":
+            text = self.owner.frontend.prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+        else:
+            self._error(404, f"no route {self.path}",
+                        "invalid_request_error")
+
+    def do_POST(self):
+        if self.path == "/v1/completions":
+            self._completions(chat=False)
+        elif self.path == "/v1/chat/completions":
+            self._completions(chat=True)
+        else:
+            self._error(404, f"no route {self.path}",
+                        "invalid_request_error")
+
+    # -- completion flow ---------------------------------------------------
+    def _completions(self, chat):
+        srv = self.owner
+        body = self._read_json()
+        if body is None:
+            return
+        try:
+            prompt = srv._encode(body, chat)
+            kw = srv._gen_kwargs(body)
+            stream = srv.frontend.submit(prompt, **kw)
+        except Rejected as e:
+            self._error(429, str(e), "overloaded", retry=True)
+            return
+        except (Unavailable, EngineDraining) as e:
+            self._error(503, str(e), "unavailable")
+            return
+        except (_BadRequest, ValueError) as e:
+            self._error(400, str(e), "invalid_request_error")
+            return
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{stream.req_id}"
+        if body.get("stream"):
+            self._stream_sse(stream, chat, rid)
+        else:
+            self._respond_full(stream, chat, rid, len(prompt))
+
+    def _chunk(self, chat, rid, index, *, piece=None, token=None,
+               finish=None):
+        if chat:
+            choice = {"index": index,
+                      "delta": ({"content": piece}
+                                if piece is not None else {})}
+            obj = "chat.completion.chunk"
+        else:
+            choice = {"index": index, "text": piece or ""}
+            obj = "text_completion"
+        if token is not None:
+            choice["token_id"] = token
+        choice["finish_reason"] = finish
+        return {"id": rid, "object": obj,
+                "model": self.owner.model_name, "choices": [choice]}
+
+    def _stream_sse(self, stream, chat, rid):
+        srv = self.owner
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for ev in stream.events(timeout=srv.stream_timeout_s):
+                if ev["type"] == "token":
+                    self._sse(self._chunk(
+                        chat, rid, ev["index"],
+                        piece=srv._piece(ev["token"]),
+                        token=ev["token"]))
+                else:
+                    self._sse(self._chunk(chat, rid, ev["index"],
+                                          finish=ev["reason"]))
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, TimeoutError,
+                OSError) as e:
+            # client went away (or stalled out): give the pages back
+            srv.frontend.cancel(stream.req_id)
+            _log.info(json.dumps({"event": "stream_aborted",
+                                  "req_id": stream.req_id,
+                                  "cause": type(e).__name__}))
+        except RuntimeError as e:  # engine loop died mid-stream
+            _log.warning(json.dumps({"event": "stream_failed",
+                                     "req_id": stream.req_id,
+                                     "cause": str(e)}))
+
+    def _respond_full(self, stream, chat, rid, prompt_tokens):
+        srv = self.owner
+        try:
+            results = stream.result(timeout=srv.stream_timeout_s)
+        except TimeoutError as e:
+            srv.frontend.cancel(stream.req_id)
+            self._error(504, str(e), "timeout")
+            return
+        except RuntimeError as e:
+            self._error(503, f"engine failed: {e}", "unavailable")
+            return
+        choices = []
+        for i, r in enumerate(results):
+            text = "".join(srv._piece(t) for t in r["tokens"])
+            if chat:
+                choices.append({"index": i,
+                                "message": {"role": "assistant",
+                                            "content": text},
+                                "token_ids": r["tokens"],
+                                "finish_reason": r["finish_reason"]})
+            else:
+                choices.append({"index": i, "text": text,
+                                "token_ids": r["tokens"],
+                                "finish_reason": r["finish_reason"]})
+        completion = sum(len(r["tokens"]) for r in results)
+        self._json(200, {
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "model": srv.model_name,
+            "choices": choices,
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": completion,
+                      "total_tokens": prompt_tokens + completion}})

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 
 import paddle_tpu as P
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.serving import (OutOfPages, PagedKVCache, Request,
+from paddle_tpu.serving import (EngineDraining, FaultInjected,
+                                OutOfPages, PagedKVCache, Request,
                                 RequestState, Scheduler, ServingEngine,
                                 ServingMetrics, paged_attention,
                                 paged_attention_ref)
@@ -484,6 +485,111 @@ class TestEngineE2E:
                                      max_new_tokens=4)._data)[0]
         np.testing.assert_array_equal(res[r2]["tokens"], want)
 
+    def test_run_failure_releases_pages_and_engine_is_reusable(self):
+        """Regression (round 9): a run() that raises used to leave the
+        live requests' pages committed — the failure path must release
+        them (requeue for recompute) so the engine survives the error
+        and a retry reproduces the uninterrupted stream."""
+        m = tiny_model(seed=9)
+        prompt = np.random.default_rng(9).integers(0, 97, 9).astype(
+            np.int32)
+        eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=2,
+                            prefill_chunk=4)
+        rid = eng.add_request(prompt, max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="did not drain"):
+            eng.run(max_steps=2)
+        # pages released, request requeued — allocator is clean
+        assert eng.cache.free_pages == eng.cache.allocatable_pages
+        assert not eng.cache.live_seqs()
+        # reusable: the retry recomputes and matches the oracle exactly
+        res = eng.run()
+        want = np.asarray(m.generate(P.to_tensor(prompt[None]),
+                                     max_new_tokens=8)._data)[0]
+        np.testing.assert_array_equal(res[rid]["tokens"], want)
+        assert res[rid]["preemptions"] >= 1
+
+    def test_cancel_mid_decode_frees_pages_and_purges_queues(self):
+        m = tiny_model(seed=10)
+        rng = np.random.default_rng(10)
+        eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=4,
+                            prefill_chunk=8)
+        keep = eng.add_request(rng.integers(0, 97, 5).astype(np.int32),
+                               max_new_tokens=6)
+        kill = eng.add_request(rng.integers(0, 97, 5).astype(np.int32),
+                               max_new_tokens=20)
+        events = []
+        while not any(e["type"] == "token" and e["req_id"] == kill
+                      for e in events):
+            events += eng.step()
+        kill_req = eng.request(kill)
+        assert eng.cancel(kill) is True
+        assert eng.cancel(kill) is False       # already finished
+        assert eng.cancel(987654) is False     # unknown id
+        assert not eng.cache.has_seq(kill)     # pages returned
+        assert kill_req not in eng.scheduler.running
+        assert kill_req not in eng.scheduler._admit_order
+        res = eng.run()                        # the other request rides on
+        assert res[kill]["finish_reason"] == "cancelled"
+        assert 0 < len(res[kill]["tokens"]) < 20
+        want = np.asarray(m.generate(
+            P.to_tensor(eng.request(keep).prompt[None]),
+            max_new_tokens=6)._data)[0]
+        np.testing.assert_array_equal(res[keep]["tokens"], want)
+        assert eng.metrics.cancellations.value == 1
+        assert eng.cache.free_pages == eng.cache.allocatable_pages
+
+    def test_drain_rejects_admissions_finishes_inflight(self):
+        m = tiny_model(seed=11)
+        rng = np.random.default_rng(11)
+        eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=4,
+                            prefill_chunk=8)
+        r1 = eng.add_request(rng.integers(0, 97, 4).astype(np.int32),
+                             max_new_tokens=5)
+        assert not eng.draining
+        eng.start_drain()
+        assert eng.draining
+        with pytest.raises(EngineDraining):
+            eng.add_request(rng.integers(0, 97, 4).astype(np.int32))
+        res = eng.run()
+        assert res[r1]["finish_reason"] == "length"
+        assert len(res[r1]["tokens"]) == 5
+        assert eng.scheduler.all_done()
+
+    def test_fault_injection_env_knobs(self, monkeypatch):
+        m = tiny_model(seed=12)
+        prompt = np.random.default_rng(12).integers(0, 97, 5).astype(
+            np.int32)
+        eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=2,
+                            prefill_chunk=8)
+        rid = eng.add_request(prompt, max_new_tokens=4)
+        monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_ERROR_RATE", "1.0")
+        with pytest.raises(FaultInjected):
+            eng.step()
+        assert eng.metrics.faults_injected.value == 1
+        monkeypatch.delenv("PADDLE_TPU_SERVING_FAULT_ERROR_RATE")
+        # the fault fired at the boundary: nothing was mutated, the
+        # retried run matches the oracle exactly
+        res = eng.run()
+        want = np.asarray(m.generate(P.to_tensor(prompt[None]),
+                                     max_new_tokens=4)._data)[0]
+        np.testing.assert_array_equal(res[rid]["tokens"], want)
+
+    def test_on_event_streams_every_event(self):
+        m = tiny_model(seed=13)
+        prompt = np.random.default_rng(13).integers(0, 97, 5).astype(
+            np.int32)
+        streamed = []
+        eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=2,
+                            prefill_chunk=8,
+                            on_event=streamed.append)
+        eng.add_request(prompt, max_new_tokens=4)
+        collected = []
+        while not eng.scheduler.all_done():
+            collected += eng.step()
+        assert streamed == collected  # callback sees the same events
+        assert [e["type"] for e in streamed] == \
+            ["token"] * 4 + ["finish"]
+
     def test_guards(self):
         m = tiny_model(seed=7)
         eng = ServingEngine(m, page_size=4, num_pages=64, max_batch=2,
@@ -514,20 +620,39 @@ class TestServingSweep:
         assert paddle_tpu.serving is sv
         for name in sv.__all__:
             assert getattr(sv, name) is not None, name
-        # the four layers + bench driver exist as modules
+        # the subsystem layers + bench driver exist as modules
         import paddle_tpu.serving.attention  # noqa: F401
         import paddle_tpu.serving.engine  # noqa: F401
+        import paddle_tpu.serving.frontend  # noqa: F401
         import paddle_tpu.serving.kv_cache  # noqa: F401
         import paddle_tpu.serving.metrics  # noqa: F401
         import paddle_tpu.serving.scheduler  # noqa: F401
+        import paddle_tpu.serving.server  # noqa: F401
+        for name in ("ServingFrontend", "ServingServer", "RequestStream",
+                     "Rejected", "Unavailable", "EngineDraining",
+                     "FaultInjected", "Gauge"):
+            assert name in sv.__all__, name
 
     def test_engine_surface(self):
         m = tiny_model(seed=8)
         eng = ServingEngine(m, page_size=4, num_pages=32, max_batch=2,
                             prefill_chunk=8)
         for attr in ("add_request", "step", "run", "results", "metrics",
-                     "cache", "scheduler"):
+                     "cache", "scheduler", "cancel", "drain",
+                     "start_drain", "draining", "release_live",
+                     "on_event", "request"):
             assert hasattr(eng, attr), attr
+
+    def test_frontend_server_surface(self):
+        from paddle_tpu.serving import ServingFrontend, ServingServer
+        for attr in ("start", "submit", "cancel", "drain", "close",
+                     "health", "prometheus", "state"):
+            assert hasattr(ServingFrontend, attr), attr
+        for attr in ("start", "drain", "close", "cancel", "url"):
+            assert hasattr(ServingServer, attr), attr
+        from paddle_tpu.serving import RequestStream
+        for attr in ("events", "result", "all_ids", "done"):
+            assert hasattr(RequestStream, attr), attr
 
     def test_metrics_export_schema(self):
         mt = ServingMetrics()
@@ -538,27 +663,53 @@ class TestServingSweep:
                     "batch_size", "page_occupancy", "prefill_chunks",
                     "decode_steps", "tokens_generated",
                     "requests_finished", "preemptions",
-                    "deadline_evictions", "cow_copies"):
+                    "deadline_evictions", "cow_copies",
+                    "cancellations", "rejections", "faults_injected",
+                    "queue_depth_gauge", "page_occupancy_gauge",
+                    "running_gauge"):
             assert key in ex, key
         assert ex["ttft_s"]["p50"] == pytest.approx(0.1)
         import json
         json.loads(mt.to_json(extra=1))
 
+    def test_metrics_prometheus_exposition(self):
+        mt = ServingMetrics()
+        text = mt.to_prometheus()  # EMPTY metrics must still render
+        assert "# TYPE paddle_tpu_serving_tokens_generated counter" \
+            in text
+        assert "# TYPE paddle_tpu_serving_running_gauge gauge" in text
+        assert "paddle_tpu_serving_ttft_s_count 0" in text
+        assert "quantile" not in text  # no samples -> no quantile rows
+        mt.ttft_s.record(0.25)
+        mt.queue_depth_gauge.set(3)
+        text = mt.to_prometheus()
+        assert 'paddle_tpu_serving_ttft_s{quantile="0.5"} 0.25' in text
+        assert "paddle_tpu_serving_queue_depth_gauge 3.0" in text
+        assert "paddle_tpu_serving_ttft_s_sum 0.25" in text
+
     def test_histogram_percentiles(self):
         from paddle_tpu.serving import Histogram
+        # regression (round 9): empty histogram percentile is None, not
+        # a numpy raise — /metrics scrapes happen before traffic
         h = Histogram()
+        assert h.percentile(50) is None
+        assert h.export()["p99"] is None
         for v in range(100):
             h.record(v)
         assert h.percentile(50) == pytest.approx(49.5)
         ex = h.export()
         assert ex["count"] == 100 and ex["max"] == 99
+        assert h.total == pytest.approx(sum(range(100)))
 
     def test_env_knobs_documented(self):
-        """PADDLE_TPU_PAGED_KERNEL is the one serving env knob; keep the
-        docs honest."""
+        """Every serving env knob stays documented in docs/SERVING.md."""
         doc = open(os.path.join(os.path.dirname(__file__), "..",
                                 "docs", "SERVING.md")).read()
-        assert "PADDLE_TPU_PAGED_KERNEL" in doc
+        for knob in ("PADDLE_TPU_PAGED_KERNEL",
+                     "PADDLE_TPU_SERVING_FAULT_LATENCY_S",
+                     "PADDLE_TPU_SERVING_FAULT_ERROR_RATE",
+                     "PADDLE_TPU_SERVING_FAULT_SEED"):
+            assert knob in doc, knob
 
 
 @pytest.mark.slow

@@ -60,6 +60,12 @@ fi
 #     here; safe to run before the risk tier).
 run bash tools/serving_smoke.sh
 
+# 5c. HTTP front-end smoke (round 9): the same replay over real sockets
+#     (ServingServer + SSE load generator). CPU-mesh by construction
+#     (--smoke skips the device probe), bounded socket timeouts, zero
+#     chip touch — safe tier.
+run bash tools/serving_server_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,
