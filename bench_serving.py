@@ -3,7 +3,16 @@ trace through `paddle_tpu.serving.ServingEngine` on a small LLaMA-family
 model and report throughput + latency.
 
 Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
-                               [--smoke] [--server]
+                               [--smoke] [--server] [--shared-prefix]
+
+`--shared-prefix` replays a shared-system-prompt workload (every request
+carries the same long prefix + a short unique tail) TWICE — radix-tree
+prefix cache off, then on — and banks BENCH_serving_prefix.json with
+both TTFT distributions and both two-point-marginal decode rates. This
+is the workload the prefix cache exists for: with the cache on, every
+request after the first skips the shared prefix's prefill chunks
+entirely (admission maps the cached pages and chunk-prefills only the
+tail), so TTFT drops and the decode loop sees fewer prefill bubbles.
 
 `--server` replays the SAME trace over real sockets: a ServingServer is
 bound on an ephemeral localhost port and a thread-per-request load
@@ -42,6 +51,9 @@ if smoke:
 server_mode = "--server" in sys.argv
 if server_mode:
     sys.argv.remove("--server")
+prefix_mode = "--shared-prefix" in sys.argv
+if prefix_mode:
+    sys.argv.remove("--shared-prefix")
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -57,14 +69,32 @@ def make_trace(n, rate, vocab, seed=0):
     return arrivals, prompts
 
 
-def replay(model, arrivals, prompts, new_tokens, **engine_kw):
+def make_shared_prefix_trace(n, rate, vocab, prefix_len, seed=0):
+    """Poisson arrivals; every prompt = one shared system prefix + a
+    short unique tail (the agent/chat serving shape the prefix cache
+    targets)."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    shared = rng.integers(0, vocab, prefix_len).astype(np.int32)
+    prompts = [np.concatenate(
+        [shared, rng.integers(0, vocab, int(rng.integers(8, 17)))
+         .astype(np.int32)]) for _ in range(n)]
+    return arrivals, prompts
+
+
+def replay(model, arrivals, prompts, new_tokens, engine=None,
+           **engine_kw):
     """Wall-clock replay: requests join the engine when their arrival
-    time passes; steps run continuously (idle steps are cheap)."""
+    time passes; steps run continuously (idle steps are cheap). Pass
+    ``engine=`` to reuse one across replays (jit caches stay warm —
+    the shared-prefix bench measures steady state, not compiles)."""
     from paddle_tpu.serving import ServingEngine
-    eng = ServingEngine(model, **engine_kw)
+    eng = engine if engine is not None else ServingEngine(model,
+                                                          **engine_kw)
     t0 = time.perf_counter()
     pending = list(zip(arrivals, prompts))
     n_total = len(pending)
+    done = 0
     done_tokens = 0
     while True:
         now = time.perf_counter() - t0
@@ -78,10 +108,10 @@ def replay(model, arrivals, prompts, new_tokens, **engine_kw):
             continue
         for ev in eng.step():
             if ev["type"] == "finish":
+                done += 1
                 done_tokens += ev["n_tokens"]
     wall = time.perf_counter() - t0
-    res = eng.results()
-    assert len(res) == n_total, (len(res), n_total)
+    assert done == n_total, (done, n_total)
     return wall, done_tokens, eng.metrics
 
 
@@ -145,7 +175,8 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    maxlen = 64 + max_new + 1
+    prefix_len = 96  # shared-prefix mode: 6 pages of 16
+    maxlen = (prefix_len + 16 if prefix_mode else 64) + max_new + 1
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
@@ -166,6 +197,10 @@ def main():
     model.eval()
     engine_kw = dict(page_size=16, num_pages=num_pages, max_batch=8,
                      prefill_chunk=32, max_seq_len=maxlen)
+
+    if prefix_mode:
+        _bench_shared_prefix(model, cfg, engine_kw, on_tpu)
+        return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
     new_q = max(1, max_new // 4)
@@ -216,6 +251,82 @@ def main():
     artifact = ("BENCH_serving_http.json" if server_mode
                 else "BENCH_serving.json")
     with open(artifact, "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_shared_prefix(model, cfg, engine_kw, on_tpu):
+    """Cache-off vs cache-on replays of the shared-prefix trace, each a
+    two-point marginal (PERF.md hygiene: quarter vs full decode budget
+    cancels fixed per-replay overhead); TTFT percentiles come from the
+    full-budget replays. One JSON line -> BENCH_serving_prefix.json."""
+    prefix_len = 96
+    arrivals, prompts = make_shared_prefix_trace(
+        n_requests, rate, cfg.vocab_size, prefix_len)
+    new_q = max(1, max_new // 4)
+
+    def measure(prefix_cache):
+        from paddle_tpu.serving import ServingEngine, ServingMetrics
+        # ONE engine per config: warmup compiles every bucketed program
+        # (and, cache-on, seeds the radix tree) so the measured replays
+        # see steady state; metrics reset between replays
+        eng = ServingEngine(model,
+                            **dict(engine_kw, prefix_cache=prefix_cache))
+        warm_n = min(8, n_requests)
+        replay(model, np.zeros(warm_n), prompts[:warm_n], new_q,
+               engine=eng)
+        replay(model, np.zeros(warm_n), prompts[:warm_n], max_new,
+               engine=eng)
+        eng.metrics = ServingMetrics()
+        wall_q, toks_q, _ = replay(model, arrivals, prompts, new_q,
+                                   engine=eng)
+        eng.metrics = ServingMetrics()
+        c = eng.cache  # prefix counters are cumulative: delta the
+        base = (c.prefix_hit_pages, c.prefix_miss_pages,  # full replay
+                c.prefix_evictions)
+        wall, toks, metrics = replay(model, arrivals, prompts, max_new,
+                                     engine=eng)
+        hit = c.prefix_hit_pages - base[0]
+        miss = c.prefix_miss_pages - base[1]
+        m = metrics.export()
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        return {
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p50_s": m["ttft_s"]["p50"],
+            "ttft_p99_s": m["ttft_s"]["p99"],
+            "prefill_chunks": m["prefill_chunks"],
+            "prefix_hit_pages": hit,
+            "prefix_miss_pages": miss,
+            "prefix_evictions": c.prefix_evictions - base[2],
+            "prefix_hit_rate": (round(hit / (hit + miss), 3)
+                                if hit + miss else 0.0),
+            "fetch_bytes": m["fetch_bytes"],
+            "preemptions": m["preemptions"],
+        }
+
+    off = measure(False)
+    on = measure(True)
+    out = {
+        "metric": "serving_prefix_ttft_p50_s"
+                  + ("" if on_tpu else "_cpu"),
+        "value": on["ttft_p50_s"],
+        "unit": "s (shared-prefix workload, radix prefix cache ON; "
+                "compare cache_off.ttft_p50_s)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new, "shared_prefix_tokens": prefix_len,
+        "page_size": engine_kw["page_size"],
+        "cache_on": on, "cache_off": off,
+        "ttft_p50_speedup": (round(off["ttft_p50_s"]
+                                   / on["ttft_p50_s"], 2)
+                             if on["ttft_p50_s"] else None),
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_prefix.json", "w") as f:
         f.write(line + "\n")
 
 

@@ -5,7 +5,13 @@ ragged-paged-attention design, PAPERS.md).
 
 Layers:
 - :mod:`kv_cache`   — paged K/V pool: free-list allocator, per-sequence
-  page tables, refcounted copy-on-fork (n>1 sampling), budget sizing.
+  page tables, refcounted copy-on-fork (n>1 sampling), budget sizing;
+  round 10: radix-tree prefix cache (full-prompt-page reuse, LRU leaf
+  eviction, uncached-only accounting) behind ``prefix_cache=True``.
+- :mod:`sampling`   — fused on-device sampler (round 10): greedy/
+  temperature/top-k/top-p with per-lane counter RNG inside the compiled
+  step; the per-step host fetch is [B] ids + [B] logprobs, not [B, V]
+  logits (host numpy oracle behind PADDLE_TPU_SERVING_HOST_SAMPLE=1).
 - :mod:`attention`  — paged attention: jax gather reference path
   (oracle-parity with the contiguous static cache) + a Pallas stub
   gated behind ``PADDLE_TPU_PAGED_KERNEL`` (interpret-mode only).
@@ -36,13 +42,14 @@ from .frontend import (Rejected, RequestStream,  # noqa: F401
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       ServingMetrics)
+from .sampling import fused_sample  # noqa: F401
 from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
                         SchedulerOutput)
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
-    "paged_attention", "paged_attention_ref",
+    "paged_attention", "paged_attention_ref", "fused_sample",
     "Scheduler", "SchedulerOutput", "Request", "RequestState",
     "ServingEngine", "EngineDraining", "FaultInjected",
     "ServingMetrics", "Counter", "Gauge", "Histogram",

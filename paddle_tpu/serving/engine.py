@@ -23,10 +23,30 @@ TPU-natively (SURVEY.md §7 static-shape stance):
   run past the context limit without a table clamp-gather hazard.
 
 The engine is host-driven: ``step()`` runs one scheduler iteration
-(decode-priority batch + at most one prefill chunk), fetches logits,
-samples on the host, and advances request state. ``run()`` loops until
-drained. All device work is CPU-mesh testable; nothing here compiles a
-first-time Mosaic kernel (the paged Pallas stub stays interpret-gated).
+(decode-priority batch + at most one prefill chunk), advances request
+state, and ``run()`` loops until drained. All device work is CPU-mesh
+testable; nothing here compiles a first-time Mosaic kernel (the paged
+Pallas stub stays interpret-gated).
+
+Decode hot path (round 10):
+
+- **Sampling runs INSIDE the compiled step program**
+  (:mod:`.sampling`): greedy/temperature/top-k/top-p with per-lane
+  counter-based RNG driven by per-request ``(seed, token_index)`` int32
+  ARGUMENTS, so the per-step host fetch is ``[B]`` int32 token ids plus
+  ``[B]`` float32 logprobs (``fetch_bytes`` metric: <= B*8, down from
+  B*V*4) and streams stay reproducible across preemption + recompute.
+  The host numpy sampler remains the oracle path behind
+  ``PADDLE_TPU_SERVING_HOST_SAMPLE=1`` (greedy is token-exact against
+  it; sampled modes are distributionally checked).
+- **Radix-tree prefix caching** (``prefix_cache=True`` or
+  ``PADDLE_TPU_SERVING_PREFIX_CACHE=1``): ``add_request`` pins the
+  longest cached prompt prefix, the scheduler admits on UNCACHED page
+  need, and ``_prefill_chunk`` starts past the cached tokens and
+  registers fresh full prompt pages back into the tree.
+- Decode batches are staged through PERSISTENT per-bucket host buffers
+  (``_build_decode_batch``) — no per-step np.zeros garbage on the hot
+  path.
 """
 from __future__ import annotations
 
@@ -39,7 +59,7 @@ import time
 
 import numpy as np
 
-from .kv_cache import OutOfPages, PagedKVCache
+from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
 
@@ -64,7 +84,7 @@ class ServingEngine:
     def __init__(self, model, *, page_size=16, num_pages=None,
                  hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
-                 cache_dtype=None, on_event=None):
+                 cache_dtype=None, on_event=None, prefix_cache=None):
         cfg = getattr(model, "cfg", None)
         core = getattr(model, "llama", model)
         for attr in ("embed_tokens", "layers", "norm"):
@@ -93,12 +113,15 @@ class ServingEngine:
             cache_dtype = ("bfloat16"
                            if getattr(cfg, "dtype", "float32")
                            == "bfloat16" else "float32")
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PADDLE_TPU_SERVING_PREFIX_CACHE") == "1"
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, nkv, hd, page_size=page_size,
             num_pages=num_pages,
             hbm_budget_bytes=(int(hbm_budget_mb * 2 ** 20)
                               if hbm_budget_mb is not None else None),
-            dtype=cache_dtype)
+            dtype=cache_dtype, prefix_cache=bool(prefix_cache))
         self.max_pages_per_seq = math.ceil(
             self.max_seq_len / self.cache.page_size)
         self.scheduler = Scheduler(self.cache, max_batch=max_batch,
@@ -108,7 +131,9 @@ class ServingEngine:
         self.eos = eos_token_id
         self.window = getattr(cfg, "sliding_window", None) or None
         self._step_fn = None          # one jit fn; traces per bucket
-        self._last_logits_probe = None  # row-0 logits of the last step
+        self._logits_dev = None       # last step's on-device [B,V] logits
+        self._decode_bufs = {}        # per-bucket persistent host buffers
+        self._seed_rng = np.random.default_rng()  # seed=None fallback
         self._requests: dict[int, Request] = {}
         self._finished: dict[int, Request] = {}
         self._rngs: dict[int, np.random.Generator] = {}
@@ -124,9 +149,12 @@ class ServingEngine:
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
                     do_sample=False, temperature=1.0, top_k=0,
-                    seed=None, n=1):
+                    top_p=1.0, seed=None, n=1, logprobs=False):
         """Queue a request; returns its req_id (n>1 returns the PARENT id
-        — forked children surface as their own req_ids in events)."""
+        — forked children surface as their own req_ids in events). With
+        the prefix cache on, the longest cached prompt prefix is PINNED
+        here (so the front-end's reservation math, run under the same
+        lock, can count only uncached pages without an eviction race)."""
         if self._draining:
             raise EngineDraining(
                 "engine is draining: in-flight requests finish, new "
@@ -145,6 +173,8 @@ class ServingEngine:
         if n > 1 and not do_sample:
             raise ValueError("n>1 needs do_sample=True (greedy forks "
                              "would be identical streams)")
+        if not 0.0 <= float(top_p) <= 1.0:
+            raise ValueError(f"top_p={top_p} outside [0, 1]")
         now = self._now()
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       arrival=now,
@@ -152,9 +182,16 @@ class ServingEngine:
                                 if deadline_s is not None else None),
                       do_sample=bool(do_sample),
                       temperature=float(temperature), top_k=int(top_k),
-                      seed=seed, n=int(n))
+                      top_p=float(top_p), seed=seed, n=int(n),
+                      logprobs=bool(logprobs))
+        req.device_seed = (int(seed) & 0x7FFFFFFF if seed is not None
+                           else int(self._seed_rng.integers(
+                               1, 2 ** 31 - 1)))
         self._requests[req.req_id] = req
         self._rngs[req.req_id] = np.random.default_rng(seed)
+        if self.cache.prefix_cache_enabled:
+            req.cached_pages = self.cache.acquire_prefix(
+                req.seq_id, prompt, prompt.size)
         self.scheduler.add(req)
         return req.req_id
 
@@ -190,23 +227,29 @@ class ServingEngine:
         if not out.decode and out.prefill is None and not out.expired \
                 and self.scheduler.waiting \
                 and not self.scheduler.live_requests():
-            # idle engine + blocked admission head: loud, not a silent
-            # spin — the request can never fit
+            # idle engine + blocked admission head: first give back any
+            # prefix pins held by OTHER waiting requests (they re-match
+            # at admission), then loud, not a silent spin — the request
+            # can never fit
             req = self.scheduler.waiting[0]
-            need = self.cache.pages_for(len(req.token_history()) + 1)
-            if need + self.scheduler.watermark_pages \
-                    > self.cache.allocatable_pages:
-                raise RuntimeError(
-                    f"request {req.req_id} can never be admitted: needs "
-                    f"{need} pages + {self.scheduler.watermark_pages} "
-                    f"watermark > {self.cache.allocatable_pages} "
-                    "allocatable; grow the cache budget or shrink the "
-                    "prompt")
+            if not self._release_waiting_pins(exclude=req):
+                need = self.cache.pages_for(
+                    len(req.token_history()) + 1) \
+                    - self.cache.pages_held(req.seq_id)
+                if need + self.scheduler.watermark_pages \
+                        > self.cache.available_pages:
+                    raise RuntimeError(
+                        f"request {req.req_id} can never be admitted: "
+                        f"needs {need} pages + "
+                        f"{self.scheduler.watermark_pages} watermark > "
+                        f"{self.cache.available_pages} available; grow "
+                        "the cache budget or shrink the prompt")
         self.metrics.queue_depth.record(self.scheduler.queue_depth())
         self.metrics.page_occupancy.record(self.cache.occupancy())
         self.metrics.queue_depth_gauge.set(self.scheduler.queue_depth())
         self.metrics.page_occupancy_gauge.set(self.cache.occupancy())
         self.metrics.running_gauge.set(len(self.scheduler.running))
+        self._sync_prefix_metrics()
         return events
 
     def run(self, max_steps=100000):
@@ -314,7 +357,10 @@ class ServingEngine:
 
     def _alloc_with_preemption(self, req, n_tokens):
         """Allocate slots for req, preempting by page pressure (newest
-        victim first) until it fits or no victim remains."""
+        victim first) until it fits or no victim remains. Prefix pins
+        held by WAITING requests are released before giving up — their
+        cached pages become reclaimable and the requests simply
+        re-match at admission."""
         while True:
             try:
                 slots, copies = self.cache.append_slots(req.seq_id,
@@ -322,6 +368,8 @@ class ServingEngine:
             except OutOfPages:
                 victim = self.scheduler.pick_victim(exclude=(req,))
                 if victim is None:
+                    if self._release_waiting_pins():
+                        continue
                     raise RuntimeError(
                         f"KV cache too small: request {req.req_id} "
                         f"cannot fit even alone "
@@ -333,6 +381,21 @@ class ServingEngine:
                 self.cache.apply_copies(copies)
                 self.metrics.cow_copies.inc(len(copies))
             return slots
+
+    def _release_waiting_pins(self, exclude=None):
+        """Free the prefix-cache pins of WAITING (not-yet-admitted)
+        requests so their cached pages become reclaimable under page
+        pressure; the requests re-run the longest-prefix match when the
+        scheduler admits them. Returns the number of pins released."""
+        released = 0
+        for r in self.scheduler.waiting:
+            if r is exclude:
+                continue
+            if self.cache.has_seq(r.seq_id):
+                self.cache.free_seq(r.seq_id)
+                r.cached_pages = 0
+                released += 1
+        return released
 
     def _preempt(self, victim):
         if self.cache.has_seq(victim.seq_id):
@@ -351,27 +414,80 @@ class ServingEngine:
                   if r.state == RequestState.RUNNING]
         if not active:
             return
-        bb = self._bucket(len(active))
-        ids = np.zeros((bb, 1), np.int32)
-        positions = np.zeros((bb, 1), np.int32)
-        pt = np.zeros((bb, self.max_pages_per_seq), np.int32)
-        cl = np.ones(bb, np.int32)       # 1, not 0: keeps padded-lane
-        slot_map = np.zeros((bb, 1), np.int32)  # softmax NaN-free
-        last_idx = np.zeros(bb, np.int32)
-        for i, (r, slot) in enumerate(active):
-            hist_len = r.prompt.size + len(r.out_tokens)
-            ids[i, 0] = r.out_tokens[-1]
-            positions[i, 0] = hist_len - 1
-            pt[i] = self.cache.page_table(r.seq_id,
-                                          self.max_pages_per_seq)
-            cl[i] = hist_len
-            slot_map[i, 0] = slot
-        logits = self._run_step(ids, positions, pt, cl, slot_map,
-                                last_idx)
+        host = self._host_sampling()
+        b = self._build_decode_batch(active)
+        sample_capable = (not host) and any(r.do_sample
+                                            for r, _ in active)
+        tok_d, lp_d = self._run_step(
+            b["ids"], b["positions"], b["pt"], b["cl"], b["slot_map"],
+            b["last_idx"],
+            (b["do_sample"], b["temperature"], b["top_k"], b["top_p"],
+             b["seeds"], b["steps"]), sample_capable)
         self.metrics.decode_steps.inc()
         self.metrics.batch_size.record(len(active))
-        for i, (r, _) in enumerate(active):
-            self._emit_token(r, logits[i], events)
+        if host:
+            logits = self._fetch_logits()
+            for i, (r, _) in enumerate(active):
+                self._emit_token(r, self._sample(r, logits[i]), events)
+        else:
+            toks = np.asarray(tok_d, np.int32)
+            lps = np.asarray(lp_d, np.float32)
+            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            for i, (r, _) in enumerate(active):
+                self._emit_token(r, int(toks[i]), events,
+                                 logprob=float(lps[i]))
+
+    def _build_decode_batch(self, active):
+        """Stage the decode batch into PERSISTENT per-bucket host
+        buffers (allocated once per bucket, reused every step — no
+        per-step np.zeros on the hot path). Padded lanes are explicitly
+        reset each step: context 1, slots at the scratch page, neutral
+        sampling params."""
+        bb = self._bucket(len(active))
+        b = self._decode_bufs.get(bb)
+        if b is None:
+            mp = self.max_pages_per_seq
+            b = self._decode_bufs[bb] = {
+                "ids": np.zeros((bb, 1), np.int32),
+                "positions": np.zeros((bb, 1), np.int32),
+                "pt": np.full((bb, mp), SCRATCH_PAGE, np.int32),
+                "cl": np.ones(bb, np.int32),     # 1, not 0: keeps
+                "slot_map": np.zeros((bb, 1), np.int32),  # softmax
+                "last_idx": np.zeros(bb, np.int32),       # NaN-free
+                "do_sample": np.zeros(bb, np.bool_),
+                "temperature": np.ones(bb, np.float32),
+                "top_k": np.zeros(bb, np.int32),
+                "top_p": np.ones(bb, np.float32),
+                "seeds": np.zeros(bb, np.int32),
+                "steps": np.zeros(bb, np.int32),
+            }
+        n = len(active)
+        b["ids"][n:] = 0
+        b["positions"][n:] = 0
+        b["pt"][n:] = SCRATCH_PAGE
+        b["cl"][n:] = 1
+        b["slot_map"][n:] = 0
+        b["do_sample"][n:] = False
+        b["temperature"][n:] = 1.0
+        b["top_k"][n:] = 0
+        b["top_p"][n:] = 1.0
+        b["seeds"][n:] = 0
+        b["steps"][n:] = 0
+        for i, (r, slot) in enumerate(active):
+            hist_len = r.prompt.size + len(r.out_tokens)
+            b["ids"][i, 0] = r.out_tokens[-1]
+            b["positions"][i, 0] = hist_len - 1
+            b["pt"][i] = self.cache.page_table(r.seq_id,
+                                              self.max_pages_per_seq)
+            b["cl"][i] = hist_len
+            b["slot_map"][i, 0] = slot
+            b["do_sample"][i] = r.do_sample
+            b["temperature"][i] = r.temperature
+            b["top_k"][i] = r.top_k
+            b["top_p"][i] = r.top_p
+            b["seeds"][i] = r.device_seed
+            b["steps"][i] = len(r.out_tokens)
+        return b
 
     def _prefill_chunk(self, req, start, end, events):
         if not self.cache.has_seq(req.seq_id):
@@ -391,20 +507,52 @@ class ServingEngine:
         slot_map = np.zeros((1, c), np.int32)  # padding -> scratch slots
         slot_map[0, :n] = slots
         last_idx = np.asarray([n - 1], np.int32)
-        logits = self._run_step(ids, positions, pt, cl, slot_map,
-                                last_idx)
+        host = self._host_sampling()
+        samp = (np.asarray([req.do_sample], np.bool_),
+                np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_k], np.int32),
+                np.asarray([req.top_p], np.float32),
+                np.asarray([req.device_seed], np.int32),
+                np.asarray([len(req.out_tokens)], np.int32))
+        tok_d, lp_d = self._run_step(
+            ids, positions, pt, cl, slot_map, last_idx, samp,
+            (not host) and req.do_sample)
         self.metrics.prefill_chunks.inc()
+        if self.cache.prefix_cache_enabled:
+            # fresh full PROMPT pages now hold K/V: register them
+            self.cache.commit_prefix(req.seq_id, req.prompt, end)
         self.scheduler.prefill_advanced(req, end)
         if req.state != RequestState.RUNNING:
             return  # more chunks to go
         # prefill complete: fork BEFORE sampling (children share the
-        # prefix pages; the parent may finish — and free — immediately)
+        # prefix pages; the parent may finish — and free — immediately).
+        # A RECOMPUTE prefill (out_tokens non-empty after preemption)
+        # must NOT fork again: the children already exist.
         children = []
-        for i in range(1, req.n):
-            children.append(self._fork(req, i))
-        self._emit_token(req, logits[0], events)
-        for child in children:
-            self._emit_token(child, logits[0], events)
+        if req.n > 1 and not req.out_tokens:
+            for i in range(1, req.n):
+                children.append(self._fork(req, i))
+        if host:
+            row = self._fetch_logits()[0]
+            self._emit_token(req, self._sample(req, row), events)
+            for child in children:
+                self._emit_token(child, self._sample(child, row),
+                                 events)
+        else:
+            toks = np.asarray(tok_d, np.int32)
+            lps = np.asarray(lp_d, np.float32)
+            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            self._emit_token(req, int(toks[0]), events,
+                             logprob=float(lps[0]))
+            if children:
+                # one fetched row, several seeds: children sample
+                # eagerly with the SAME counter-RNG function; a child's
+                # later recompute (token index >= 1) goes through the
+                # compiled path with the same (seed, step) arguments
+                row = self._fetch_logits()[0]
+                for child in children:
+                    ctok, clp = _counter_sample_row(row, child)
+                    self._emit_token(child, ctok, events, logprob=clp)
 
     def _fork(self, parent, i):
         child = Request(prompt=parent.prompt,
@@ -412,8 +560,10 @@ class ServingEngine:
                         arrival=parent.arrival, deadline=parent.deadline,
                         do_sample=parent.do_sample,
                         temperature=parent.temperature,
-                        top_k=parent.top_k,
-                        seed=(parent.seed or 0) + i, n=1)
+                        top_k=parent.top_k, top_p=parent.top_p,
+                        seed=(parent.seed or 0) + i, n=1,
+                        logprobs=parent.logprobs)
+        child.device_seed = (parent.device_seed + i) & 0x7FFFFFFF
         child.parent_id = parent.req_id
         child.first_token_at = None
         self.cache.fork(parent.seq_id, child.seq_id)
@@ -422,8 +572,7 @@ class ServingEngine:
         self.scheduler.register_fork(child)
         return child
 
-    def _emit_token(self, req, logits_row, events):
-        tok = self._sample(req, logits_row)
+    def _emit_token(self, req, tok, events, logprob=None):
         req.out_tokens.append(tok)
         now = self._now()
         if req.first_token_at is None:
@@ -433,8 +582,10 @@ class ServingEngine:
             self.metrics.inter_token_s.record(now - req.last_token_at)
         req.last_token_at = now
         self.metrics.tokens_generated.inc()
-        self._event({"type": "token", "req_id": req.req_id,
-                     "token": tok}, events)
+        ev = {"type": "token", "req_id": req.req_id, "token": tok}
+        if req.logprobs and logprob is not None:
+            ev["logprob"] = logprob
+        self._event(ev, events)
         if self.eos is not None and tok == self.eos:
             self._finish(req, "stop", events)
         elif len(req.out_tokens) >= req.max_new_tokens:
@@ -464,6 +615,7 @@ class ServingEngine:
                 "prompt_tokens": int(req.prompt.size),
                 "ttft_s": ttft, "tpot_s": tpot,
                 "preemptions": req.preemptions,
+                "cached_prompt_pages": req.cached_pages,
                 "parent_id": req.parent_id}))
 
     def _event(self, ev, events):
@@ -476,7 +628,17 @@ class ServingEngine:
         uses this to map forked children onto their parent's stream."""
         return self._requests.get(req_id)
 
+    @staticmethod
+    def _host_sampling():
+        """Oracle escape hatch: PADDLE_TPU_SERVING_HOST_SAMPLE=1 keeps
+        sampling on the host from fully-fetched logits (numpy RNG).
+        Read per step so tests can flip it with monkeypatch."""
+        return os.environ.get("PADDLE_TPU_SERVING_HOST_SAMPLE") == "1"
+
     def _sample(self, req, logits_row):
+        """Host numpy sampling — the oracle path. Max-subtraction
+        BEFORE exp is load-bearing: logits of ~1e3 otherwise overflow
+        to inf/NaN (regression-tested)."""
         lg = np.asarray(logits_row, np.float32)
         if not req.do_sample:
             return int(lg.argmax())
@@ -485,50 +647,108 @@ class ServingEngine:
         if req.top_k and req.top_k < lg.size:
             kth = np.partition(lg, -req.top_k)[-req.top_k]
             lg = np.where(lg < kth, -np.inf, lg)
+        if 0.0 < req.top_p < 1.0:
+            shifted = lg - lg.max()
+            srt = np.sort(shifted)[::-1]
+            p = np.exp(srt)
+            p /= p.sum()
+            keep = (np.cumsum(p) - p) < req.top_p  # keeps the crosser
+            thr = srt[keep][-1]                    # smallest kept logit
+            lg = np.where(shifted < thr, -np.inf, lg)
         lg = lg - lg.max()
         p = np.exp(lg)
         p /= p.sum()
         return int(self._rngs[req.req_id].choice(lg.size, p=p))
 
-    def _run_step(self, ids, positions, pt, cl, slot_map, last_idx):
+    @property
+    def _last_logits_probe(self):
+        """Row-0 logits of the last step, fetched on demand —
+        parity-test observability (the hot path no longer fetches
+        logits at all)."""
+        if self._logits_dev is None:
+            return None
+        return np.asarray(self._logits_dev, np.float32)[0]
+
+    def _fetch_logits(self):
+        """Pull the last step's full [B, V] logits to the host (oracle
+        sampling / fork seeding) and account the fetch."""
+        out = np.asarray(self._logits_dev, np.float32)
+        self.metrics.fetch_bytes.inc(out.nbytes)
+        return out
+
+    def _sync_prefix_metrics(self):
+        c, m = self.cache, self.metrics
+        m.prefix_hit_pages.value = c.prefix_hit_pages
+        m.prefix_miss_pages.value = c.prefix_miss_pages
+        m.prefix_evictions.value = c.prefix_evictions
+        total = c.prefix_hit_pages + c.prefix_miss_pages
+        m.prefix_hit_rate.set(c.prefix_hit_pages / total if total
+                              else 0.0)
+        m.cached_pages_gauge.set(c.cached_pages)
+
+    def _run_step(self, ids, positions, pt, cl, slot_map, last_idx,
+                  samp, sample_capable):
         import jax
         import jax.numpy as jnp
         if self._step_fn is None:
             # bucketed shapes bound this single fn's trace cache to
-            # log2(max_batch)+2 entries; weights ride as arguments
-            self._step_fn = jax.jit(functools.partial(
-                _paged_step_pure, self.model, self._core, self.window))
+            # 2*(log2(max_batch)+2) entries (the static sample_capable
+            # flag at most doubles it); weights ride as arguments
+            self._step_fn = jax.jit(
+                functools.partial(_paged_step_pure, self.model,
+                                  self._core, self.window),
+                static_argnums=(0,))
         warrs = [t._data for t in self.model._gen_state_tensors()]
-        logits, k_pages, v_pages = self._step_fn(
-            warrs, jnp.asarray(ids), jnp.asarray(positions),
-            jnp.asarray(pt), jnp.asarray(cl), jnp.asarray(slot_map),
-            jnp.asarray(last_idx), self.cache.k_pages,
-            self.cache.v_pages)
+        tok, lp, logits, k_pages, v_pages = self._step_fn(
+            bool(sample_capable), warrs, jnp.asarray(ids),
+            jnp.asarray(positions), jnp.asarray(pt), jnp.asarray(cl),
+            jnp.asarray(slot_map), jnp.asarray(last_idx),
+            tuple(jnp.asarray(a) for a in samp),
+            self.cache.k_pages, self.cache.v_pages)
         self.cache.k_pages = list(k_pages)
         self.cache.v_pages = list(v_pages)
-        out = np.asarray(logits, np.float32)
-        self._last_logits_probe = out[0]  # parity-test observability
-        return out
+        self._logits_dev = logits  # NOT fetched on the decode hot path
+        return tok, lp
 
 
 # -- the compiled step (weights as arguments; generation.py idiom) ---------
 
-def _paged_step_pure(model, core, window, warrs, ids, positions, pt, cl,
-                     slot_map, last_idx, k_pages, v_pages):
+def _counter_sample_row(logits_row, req):
+    """Eagerly sample ONE token from a fetched logits row with the same
+    counter-RNG fused sampler the compiled program runs — fork children
+    at prefill completion (one row, several seeds)."""
+    import jax.numpy as jnp
+
+    from .sampling import fused_sample
+    tok, lp = fused_sample(
+        jnp.asarray(logits_row, jnp.float32)[None],
+        jnp.asarray([True]),
+        jnp.asarray([req.temperature], jnp.float32),
+        jnp.asarray([req.top_k], jnp.int32),
+        jnp.asarray([req.top_p], jnp.float32),
+        jnp.asarray([req.device_seed], jnp.int32),
+        jnp.asarray([len(req.out_tokens)], jnp.int32))
+    return int(np.asarray(tok)[0]), float(np.asarray(lp)[0])
+
+
+def _paged_step_pure(model, core, window, sample_capable, warrs, ids,
+                     positions, pt, cl, slot_map, last_idx, samp,
+                     k_pages, v_pages):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
-        return _paged_step_body(model, core, window, ids, positions, pt,
-                                cl, slot_map, last_idx, k_pages, v_pages)
+        return _paged_step_body(model, core, window, sample_capable,
+                                ids, positions, pt, cl, slot_map,
+                                last_idx, samp, k_pages, v_pages)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
-def _paged_step_body(model, core, window, ids, positions, pt, cl,
-                     slot_map, last_idx, k_pages, v_pages):
+def _paged_step_body(model, core, window, sample_capable, ids, positions,
+                     pt, cl, slot_map, last_idx, samp, k_pages, v_pages):
     import jax.numpy as jnp
 
     from ..core.autograd import no_grad
@@ -569,4 +789,13 @@ def _paged_step_body(model, core, window, ids, positions, pt, cl,
         x = core.norm(x)
         h_last = x._data[jnp.arange(b), last_idx]        # [B, D]
         logits = model.lm_head(Tensor(h_last[:, None, :]))._data[:, 0]
-    return logits.astype(jnp.float32), new_k, new_v
+    logits = logits.astype(jnp.float32)
+    # fused on-device sampling: the host fetches [B] ids (+logprobs),
+    # not [B, V] logits; sample_capable is STATIC (greedy-only batches
+    # compile without the top-k/top-p sort)
+    from .sampling import fused_sample
+    do_sample, temperature, top_k, top_p, seeds, steps = samp
+    tokens, logprobs = fused_sample(
+        logits, do_sample, temperature, top_k, top_p, seeds, steps,
+        sample_capable=sample_capable)
+    return tokens, logprobs, logits, new_k, new_v

@@ -183,7 +183,7 @@ class ServingFrontend:
         with self.lock:
             if self._state != "ok":
                 raise Unavailable(f"front-end is {self._state}")
-            self._check_capacity(prompt.size, int(max_new_tokens), n)
+            self._check_capacity(prompt, int(max_new_tokens), n)
             rid = self.engine.add_request(
                 prompt, max_new_tokens=int(max_new_tokens), **kw)
             stream = RequestStream(rid, n)
@@ -223,31 +223,44 @@ class ServingFrontend:
             return m.to_prometheus()
 
     # -- internals ---------------------------------------------------------
-    def _check_capacity(self, prompt_len, max_new, n):
+    def _check_capacity(self, prompt, max_new, n):
         """Reservation admission (no-preemption envelope): reject when
         the waiting queue is full or the worst-case page need cannot be
-        covered on top of all outstanding reservations + watermark."""
+        covered on top of all outstanding reservations + watermark.
+
+        Prefix-cache accounting: the need counts only UNCACHED pages
+        (``probe_prefix`` lookup — the matched pages are pinned by
+        ``add_request`` under this same lock, so they cannot be evicted
+        between this check and admission), and every queued request's
+        reservation is likewise net of the pages it already holds
+        pinned. Cached-but-unpinned pages count as capacity
+        (``available_pages``) because eviction turns them into free
+        pages on demand."""
         eng = self.engine
         sched, cache = eng.scheduler, eng.cache
+        prompt_len = int(prompt.size)
         if sched.queue_depth() >= self.max_queued:
             eng.metrics.rejections.inc()
             raise Rejected(
                 f"intake queue full ({self.max_queued} waiting)")
         need = cache.pages_for(prompt_len + max_new) * n
+        need -= cache.probe_prefix(prompt)  # shared across the n forks
         promised = 0
         for r in sched.live_requests():
             promised += max(
                 0, cache.pages_for(r.prompt.size + r.max_new_tokens)
                 * r.n - cache.pages_held(r.seq_id))
         for r in sched.waiting:
-            promised += cache.pages_for(
-                r.prompt.size + r.max_new_tokens) * r.n
-        if need + promised + sched.watermark_pages > cache.free_pages:
+            promised += max(
+                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
+                * r.n - cache.pages_held(r.seq_id))
+        if need + promised + sched.watermark_pages \
+                > cache.available_pages:
             eng.metrics.rejections.inc()
             raise Rejected(
                 f"over capacity: need {need} page(s), "
-                f"{cache.free_pages} free - {promised} reserved - "
-                f"{sched.watermark_pages} watermark")
+                f"{cache.available_pages} available - {promised} "
+                f"reserved - {sched.watermark_pages} watermark")
 
     def _on_event(self, ev):
         # runs in whichever thread holds the lock and drives the engine

@@ -19,6 +19,27 @@ Design (SURVEY.md §7 static-shape stance):
   the first append into a SHARED partial tail page triggers a
   copy-on-write (the allocator returns the page copies for the engine to
   apply on device before scattering new K/V).
+- Radix-tree prefix caching (``prefix_cache=True``; vLLM automatic
+  prefix caching / SGLang RadixAttention capability): FULL pages of
+  PROMPT tokens are registered in a hash-keyed radix tree
+  (``commit_prefix``) when their K/V lands on device, and a later
+  sequence with the same token prefix shares them
+  (``acquire_prefix`` — refcount bump, zero device work). Page
+  refcounts count the SEQUENCES mapping a page; a cached page whose
+  refcount drops to 0 stays resident (CACHED, reclaimable) instead of
+  returning to the free list, and is LRU-evicted leaf-first only when
+  the allocator actually needs the page. The last prompt token is never
+  served from cache (its logits must come out of a real prefill step),
+  so a lookup is capped at ``(hist_len - 1)`` tokens.
+
+Page lifecycle with the prefix cache on::
+
+    FREE ──append_slots──► ACTIVE (rc>0) ──commit_prefix──► ACTIVE+cached
+      ▲                      │ free_seq                        │ free_seq
+      │                      ▼                                 ▼ (rc→0)
+      └────────── rc==0, not cached                CACHED (rc==0, in tree)
+      ▲                                                        │
+      └───────────── LRU leaf eviction (append_slots pressure)─┘
 
 Sizing: pass ``num_pages`` directly or an ``hbm_budget_bytes`` — the
 constructor derives the page count from the per-page byte cost across
@@ -50,6 +71,21 @@ class OutOfPages(RuntimeError):
         self.free = free
 
 
+class _RadixNode:
+    """One FULL page of prompt tokens in the prefix tree. ``key`` is the
+    page's token tuple (dict-hashed under the parent — the radix edge),
+    so chains of nodes spell out token prefixes page by page."""
+
+    __slots__ = ("key", "page", "parent", "children", "last_used")
+
+    def __init__(self, key, page, parent, last_used):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.last_used = last_used
+
+
 class PagedKVCache:
     """Fixed-size-page KV pool with a free-list allocator, per-sequence
     page tables, and refcounted copy-on-fork sharing.
@@ -60,7 +96,8 @@ class PagedKVCache:
     """
 
     def __init__(self, n_layers, n_kv_heads, head_dim, *, page_size=16,
-                 num_pages=None, hbm_budget_bytes=None, dtype="float32"):
+                 num_pages=None, hbm_budget_bytes=None, dtype="float32",
+                 prefix_cache=False):
         import jax.numpy as jnp
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -96,6 +133,14 @@ class PagedKVCache:
         self._rc = np.zeros(num_pages, np.int32)
         self._tables: dict[object, list[int]] = {}
         self._lens: dict[object, int] = {}
+        # prefix cache (radix tree over full prompt-token pages)
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._prefix_root = _RadixNode(None, None, None, 0)
+        self._cached: dict[int, _RadixNode] = {}  # page -> tree node
+        self._clock = 0
+        self.prefix_hit_pages = 0
+        self.prefix_miss_pages = 0
+        self.prefix_evictions = 0
 
     # -- sizing helpers ---------------------------------------------------
     @staticmethod
@@ -118,6 +163,24 @@ class PagedKVCache:
     @property
     def free_pages(self):
         return len(self._free)
+
+    @property
+    def cached_pages(self):
+        """Pages registered in the prefix tree (shared or reclaimable)."""
+        return len(self._cached)
+
+    @property
+    def reclaimable_pages(self):
+        """Cached pages no live sequence maps (rc==0) — evictable
+        leaf-first, so all of them can be turned into free pages."""
+        return sum(1 for p in self._cached if self._rc[p] == 0)
+
+    @property
+    def available_pages(self):
+        """Pages an allocation can actually obtain: the free list plus
+        LRU-evictable cached pages. Equals ``free_pages`` with the
+        prefix cache off — admission/watermark math uses this."""
+        return len(self._free) + self.reclaimable_pages
 
     @property
     def used_pages(self):
@@ -157,7 +220,10 @@ class PagedKVCache:
 
     def free_seq(self, seq_id):
         """Release a sequence's pages (refcounted). Unknown ids raise —
-        the double-free guard the allocator invariants tests pin."""
+        the double-free guard the allocator invariants tests pin. Pages
+        registered in the prefix tree stay resident (CACHED) at rc==0
+        instead of returning to the free list; eviction reclaims them
+        under pressure."""
         if seq_id not in self._tables:
             raise KeyError(
                 f"free_seq: unknown (or already freed) sequence "
@@ -166,7 +232,7 @@ class PagedKVCache:
             self._rc[p] -= 1
             if self._rc[p] < 0:  # pragma: no cover - internal invariant
                 raise AssertionError(f"page {p} refcount underflow")
-            if self._rc[p] == 0:
+            if self._rc[p] == 0 and p not in self._cached:
                 self._free.append(p)
         del self._lens[seq_id]
 
@@ -180,8 +246,12 @@ class PagedKVCache:
         copy-on-written — the engine MUST ``apply_copies(copies)`` on the
         device buffers before scattering the new K/V.
 
-        Transactional: raises :class:`OutOfPages` (no state touched) when
-        the free list cannot cover the pages needed.
+        Transactional for SEQUENCE state: raises :class:`OutOfPages`
+        (no sequence state touched) when free + reclaimable-cached pages
+        cannot cover the need. When the free list alone falls short but
+        reclaimable cached pages exist, the LRU cached leaves are
+        evicted here — a cache-internal mutation, invisible to every
+        live sequence.
         """
         if n_tokens <= 0:
             raise ValueError(f"append_slots: n_tokens={n_tokens}")
@@ -191,8 +261,11 @@ class PagedKVCache:
         cow = (off != 0 and table and self._rc[table[-1]] > 1)
         new_pages = self.pages_for(ln + n_tokens) - self.pages_for(ln)
         need = new_pages + (1 if cow else 0)
-        if need > len(self._free):
-            raise OutOfPages(need, len(self._free))
+        if need > self.available_pages:
+            raise OutOfPages(need, self.available_pages)
+        while need > len(self._free):
+            if not self._evict_lru_leaf():  # pragma: no cover - guarded
+                raise OutOfPages(need, self.available_pages)
         copies = []
         if cow:
             fresh = self._free.popleft()
@@ -242,3 +315,120 @@ class PagedKVCache:
         """Pages currently mapped by seq_id (0 for unknown sequences) —
         admission accounting for admitted-but-unallocated requests."""
         return len(self._tables.get(seq_id, ()))
+
+    # -- prefix cache (radix tree over full prompt-token pages) ------------
+    def _prefix_cap_pages(self, prompt_len, hist_len):
+        """Pages of ``prompt`` a lookup may serve from cache. The last
+        HISTORY token is never cached-over (its logits must come from a
+        real prefill step), and only prompt tokens are ever in the
+        tree."""
+        return max(0, min(int(prompt_len), int(hist_len) - 1)) \
+            // self.page_size
+
+    def _walk(self, tokens, cap_pages):
+        """Longest-prefix match: the chain of tree nodes whose pages
+        spell out ``tokens``'s leading full pages (up to cap_pages)."""
+        node = self._prefix_root
+        chain = []
+        ps = self.page_size
+        for i in range(cap_pages):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        return chain
+
+    def probe_prefix(self, prompt, hist_len=None):
+        """Lookup-only longest-prefix match: how many of ``prompt``'s
+        pages the cache could serve right now. No refcount or LRU
+        mutation — safe for reservation math (the front-end's
+        uncached-page accounting)."""
+        if not self.prefix_cache_enabled:
+            return 0
+        if hist_len is None:
+            hist_len = len(prompt)
+        return len(self._walk(
+            prompt, self._prefix_cap_pages(len(prompt), hist_len)))
+
+    def acquire_prefix(self, seq_id, prompt, hist_len):
+        """Register ``seq_id`` with its longest cached prompt prefix
+        PINNED (refcount bump per matched page — eviction cannot touch
+        them while the sequence lives). Creates the sequence, so call it
+        INSTEAD of :meth:`alloc_seq`; with the cache disabled it is
+        exactly alloc_seq. Returns the number of cached pages mapped;
+        the sequence's length starts at ``matched * page_size`` and the
+        prefill path must skip those tokens."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        if not self.prefix_cache_enabled:
+            self._tables[seq_id] = []
+            self._lens[seq_id] = 0
+            return 0
+        cap = self._prefix_cap_pages(len(prompt), hist_len)
+        chain = self._walk(prompt, cap)
+        self._clock += 1
+        for node in chain:
+            node.last_used = self._clock
+            self._rc[node.page] += 1
+        self._tables[seq_id] = [n.page for n in chain]
+        self._lens[seq_id] = len(chain) * self.page_size
+        return len(chain)
+
+    def record_prefix_stats(self, prompt, hist_len, hit_pages):
+        """Account one request's hit/miss page counts — called by the
+        scheduler ONCE per prefill, when the request actually starts
+        (pins made at submit/admission may be refreshed before then, so
+        counting at acquire time would double-count)."""
+        cap = self._prefix_cap_pages(len(prompt), hist_len)
+        self.prefix_hit_pages += hit_pages
+        self.prefix_miss_pages += max(0, cap - hit_pages)
+
+    def commit_prefix(self, seq_id, prompt, upto):
+        """Insert ``seq_id``'s now-prefilled FULL prompt pages into the
+        tree (tokens ``[0, min(upto, len(prompt)))``). Pages whose token
+        chunk already has a canonical node keep that node (duplicate
+        content under a different page is simply not registered — the
+        K/V bytes are equivalent, so mixed chains stay exact). Returns
+        the number of nodes added."""
+        if not self.prefix_cache_enabled or seq_id not in self._tables:
+            return 0
+        ps = self.page_size
+        n_full = min(int(upto), len(prompt)) // ps
+        table = self._tables[seq_id]
+        node = self._prefix_root
+        self._clock += 1
+        added = 0
+        for i in range(n_full):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                page = table[i]
+                if page in self._cached:  # pragma: no cover - invariant
+                    raise AssertionError(
+                        f"page {page} already registered in the tree")
+                child = _RadixNode(key, page, node, self._clock)
+                node.children[key] = child
+                self._cached[page] = child
+                added += 1
+            child.last_used = self._clock
+            node = child
+        return added
+
+    def _evict_lru_leaf(self):
+        """Reclaim the least-recently-used cached LEAF page no sequence
+        maps (rc==0). Leaf-first keeps every remaining chain matchable
+        from the root. Returns False when nothing is evictable."""
+        victim = None
+        for page, node in self._cached.items():
+            if self._rc[page] == 0 and not node.children:
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        del self._cached[victim.page]
+        self._free.append(victim.page)
+        self.prefix_evictions += 1
+        return True

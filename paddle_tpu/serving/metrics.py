@@ -99,10 +99,17 @@ class ServingMetrics:
         self.cancellations = Counter()        # cancel() calls that landed
         self.rejections = Counter()           # load-shed admissions (429)
         self.faults_injected = Counter()      # injected step faults
+        # decode hot path (round 10)
+        self.fetch_bytes = Counter()          # host<-device bytes/steps
+        self.prefix_hit_pages = Counter()     # prompt pages served from
+        self.prefix_miss_pages = Counter()    # the radix tree vs prefilled
+        self.prefix_evictions = Counter()     # cached pages LRU-reclaimed
         # point-in-time gauges, refreshed per step and at /metrics scrape
         self.queue_depth_gauge = Gauge()
         self.page_occupancy_gauge = Gauge()
         self.running_gauge = Gauge()          # running decode batch size
+        self.prefix_hit_rate = Gauge()        # hit/(hit+miss), cumulative
+        self.cached_pages_gauge = Gauge()     # pages resident in the tree
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}

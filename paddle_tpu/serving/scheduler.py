@@ -14,9 +14,14 @@ Policy, per iteration (``schedule(now)``):
    head of the admitted-but-unprefilled queue) rides along, so admission
    never starves decode latency and compile shapes stay at two classes.
 4. **Admission by free-page watermark** — a waiting request is admitted
-   only when the free list covers its FULL token history plus a reserved
-   watermark (head-room that keeps running decodes from thrashing the
-   preemption path on every page boundary).
+   only when the available pages (free list + reclaimable cached pages)
+   cover its FULL token history plus a reserved watermark (head-room
+   that keeps running decodes from thrashing the preemption path on
+   every page boundary). With the prefix cache on, admission first runs
+   a longest-prefix match (``cache.acquire_prefix``) so the page need —
+   and the committed-page accounting — counts only UNCACHED pages, and
+   ``prefill_pos`` starts past the cached tokens (the engine
+   chunk-prefills only the tail).
 
 Preemption by page pressure is engine-initiated (the allocator raises
 OutOfPages mid-step): ``pick_victim`` chooses the NEWEST live request
@@ -55,8 +60,13 @@ class Request:        # field-wise __eq__ broadcast inside `in` checks
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 0
+    top_p: float = 1.0
     seed: int | None = None
     n: int = 1                         # parallel samples (copy-on-fork)
+    logprobs: bool = False             # emit per-token logprob in events
+    device_seed: int = 0               # counter-RNG seed (device sampling)
+    cached_pages: int = 0              # prefix-cache pages at last acquire
+    prefix_counted: bool = False       # hit/miss stats recorded this pass
     req_id: int = field(default_factory=lambda: next(_req_ids))
     state: str = RequestState.WAITING
     out_tokens: list = field(default_factory=list)
@@ -88,6 +98,8 @@ class Request:        # field-wise __eq__ broadcast inside `in` checks
         self.prefill_pos = 0
         self.state = RequestState.WAITING
         self.preemptions += 1
+        self.prefix_counted = False    # the recompute prefill is a new
+        self.cached_pages = 0          # cache pass; stats count it too
 
     def remaining_new_tokens(self):
         return self.max_new_tokens - len(self.out_tokens)
@@ -146,11 +158,42 @@ class Scheduler:
         prefill = None
         if self.prefill_queue:
             req = self.prefill_queue[0]
+            self._refresh_prefix(req)
             hist = req.token_history()
+            if self.cache.prefix_cache_enabled \
+                    and not req.prefix_counted:
+                # this request's prefill starts now: its hit/miss
+                # split is final (one count per prefill pass)
+                self.cache.record_prefix_stats(
+                    req.prompt, len(hist), req.cached_pages)
+                req.prefix_counted = True
             end = min(req.prefill_pos + self.prefill_chunk, len(hist))
             prefill = (req, req.prefill_pos, end)
         return SchedulerOutput(decode=decode, prefill=prefill,
                                expired=expired)
+
+    def _refresh_prefix(self, req):
+        """Re-run the longest-prefix match the moment ``req`` reaches
+        the head of the prefill queue, while it has written no K/V of
+        its own (every held page is still a pinned cache page). The
+        tree may have grown since the request was pinned — in a burst
+        of shared-prefix requests, the FIRST one commits the prefix
+        while the rest sit queued; without this refresh they would all
+        redundantly prefill it (thundering herd)."""
+        if not self.cache.prefix_cache_enabled:
+            return
+        sid = req.seq_id
+        if not self.cache.has_seq(sid) \
+                or self.cache.pages_held(sid) != req.cached_pages:
+            return  # already prefilling its own pages: too late
+        hist = req.token_history()
+        if self.cache.probe_prefix(req.prompt, len(hist)) \
+                <= req.cached_pages:
+            return
+        self.cache.free_seq(sid)
+        req.cached_pages = self.cache.acquire_prefix(
+            sid, req.prompt, len(hist))
+        req.prefill_pos = self.cache.seq_len(sid)
 
     def _sweep_deadlines(self, now):
         expired = []
@@ -188,12 +231,26 @@ class Scheduler:
             slots = len(self.prefill_queue) + len(self.running)
             if slots + req.n > self.max_batch:
                 break
-            need = self.cache.pages_for(len(req.token_history()) + 1)
-            if self.cache.free_pages - committed \
+            hist = req.token_history()
+            if self.cache.prefix_cache_enabled \
+                    and not self.cache.has_seq(req.seq_id):
+                # longest-prefix match (recompute path re-matches here;
+                # fresh submissions were pinned at add_request)
+                req.cached_pages = self.cache.acquire_prefix(
+                    req.seq_id, req.prompt, len(hist))
+            # count only UNCACHED pages: the matched prefix is already
+            # held by the sequence (pages_held), so it neither gates
+            # admission nor inflates the committed-page reservation
+            need = self.cache.pages_for(len(hist) + 1) \
+                - self.cache.pages_held(req.seq_id)
+            if self.cache.available_pages - committed \
                     < need + self.watermark_pages:
                 break  # FIFO head-of-line: younger requests must wait too
             self.waiting.popleft()
             req.state = RequestState.PREFILLING
+            if self.cache.has_seq(req.seq_id):
+                # skip cached tokens: chunk-prefill only the tail
+                req.prefill_pos = self.cache.seq_len(req.seq_id)
             self.prefill_queue.append(req)
             self._admit_order.append(req)
             committed += need

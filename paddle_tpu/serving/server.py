@@ -160,8 +160,12 @@ class ServingServer:
             kw["n"] = int(body["n"])
         if body.get("top_k") is not None:
             kw["top_k"] = int(body["top_k"])
+        if body.get("top_p") is not None:
+            kw["top_p"] = float(body["top_p"])
         if body.get("seed") is not None:
             kw["seed"] = int(body["seed"])
+        if body.get("logprobs"):
+            kw["logprobs"] = True
         if body.get("deadline_s") is not None:
             kw["deadline_s"] = float(body["deadline_s"])
         return kw
@@ -269,7 +273,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond_full(stream, chat, rid, len(prompt))
 
     def _chunk(self, chat, rid, index, *, piece=None, token=None,
-               finish=None):
+               finish=None, logprob=None):
         if chat:
             choice = {"index": index,
                       "delta": ({"content": piece}
@@ -280,6 +284,8 @@ class _Handler(BaseHTTPRequestHandler):
             obj = "text_completion"
         if token is not None:
             choice["token_id"] = token
+        if logprob is not None:
+            choice["logprob"] = logprob
         choice["finish_reason"] = finish
         return {"id": rid, "object": obj,
                 "model": self.owner.model_name, "choices": [choice]}
@@ -297,7 +303,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._sse(self._chunk(
                         chat, rid, ev["index"],
                         piece=srv._piece(ev["token"]),
-                        token=ev["token"]))
+                        token=ev["token"],
+                        logprob=ev.get("logprob")))
                 else:
                     self._sse(self._chunk(chat, rid, ev["index"],
                                           finish=ev["reason"]))

@@ -665,8 +665,11 @@ class TestServingSweep:
                     "requests_finished", "preemptions",
                     "deadline_evictions", "cow_copies",
                     "cancellations", "rejections", "faults_injected",
+                    "fetch_bytes", "prefix_hit_pages",
+                    "prefix_miss_pages", "prefix_evictions",
                     "queue_depth_gauge", "page_occupancy_gauge",
-                    "running_gauge"):
+                    "running_gauge", "prefix_hit_rate",
+                    "cached_pages_gauge"):
             assert key in ex, key
         assert ex["ttft_s"]["p50"] == pytest.approx(0.1)
         import json
@@ -708,7 +711,9 @@ class TestServingSweep:
         for knob in ("PADDLE_TPU_PAGED_KERNEL",
                      "PADDLE_TPU_SERVING_FAULT_LATENCY_S",
                      "PADDLE_TPU_SERVING_FAULT_ERROR_RATE",
-                     "PADDLE_TPU_SERVING_FAULT_SEED"):
+                     "PADDLE_TPU_SERVING_FAULT_SEED",
+                     "PADDLE_TPU_SERVING_HOST_SAMPLE",
+                     "PADDLE_TPU_SERVING_PREFIX_CACHE"):
             assert knob in doc, knob
 
 

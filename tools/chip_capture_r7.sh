@@ -66,6 +66,12 @@ run bash tools/serving_smoke.sh
 #     chip touch — safe tier.
 run bash tools/serving_server_smoke.sh
 
+# 5d. prefix-cache + on-device-sampling smoke (round 10): shared-prefix
+#     replay cache-off vs cache-on, fused-sampling decode path. CPU-mesh
+#     by construction (--smoke), plain XLA step program (no first-time
+#     Mosaic constructs) — safe tier.
+run bash tools/serving_prefix_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Prefix-cache + on-device-sampling smoke for the chip-capture list
+# (round 10) — SAFE tier: `--smoke` forces the CPU mesh (no device
+# probe, zero chip touch) and the serving step program is plain XLA
+# (the paged Pallas stub stays interpret-gated), so NO first-time
+# Mosaic construct can reach the chip from this script.
+#
+# Replays the shared-prefix Poisson trace cache-off vs cache-on and
+# banks BENCH_serving_prefix.json; the cache-on TTFT p50 must sit
+# strictly below cache-off (the radix-tree reuse property).
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_prefix_smoke.sh > .bench_r4/serving_prefix_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --shared-prefix \
+  | tee .bench_r4/serving_prefix_smoke.json

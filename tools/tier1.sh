@@ -1,0 +1,23 @@
+#!/bin/bash
+# Tier-1 verify wrapper — the EXACT ROADMAP.md tier-1 command, plus the
+# known env-drift deselect (CLAUDE.md round-9 addenda: the test_text_crf
+# BiGRU-CRF test segfaults the worker mid-suite under the current jax
+# wheel, truncating the failure summary; deselecting it yields a
+# complete run. The segfault is environmental — seed == HEAD — and is
+# tracked in CHANGES.md PR-1 notes).
+#
+# Usage: bash tools/tier1.sh
+# Exit code is pytest's; DOTS_PASSED echoes the progress-dot count the
+# driver compares against the seed.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' \
+  --deselect "tests/test_text_crf.py::TestBiGruCrfTagger::test_learns_synthetic_bio_pattern" \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+  | tr -cd . | wc -c)
+exit $rc
