@@ -4,6 +4,16 @@ model and report throughput + latency.
 
 Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
+                               [--router]
+
+`--router` replays the shared-prefix workload through a ServingRouter
+over TWO in-process replicas (each its own engine + prefix cache),
+round-robin vs cache-aware, and banks BENCH_serving_router.json: the
+cache-aware policy must show a strictly higher aggregate prefix hit
+rate and lower TTFT p50 (requests stick to the replica that holds the
+cached pages). A third AVAILABILITY replay (3 replicas, cache-aware)
+kills one replica mid-replay and records that every stream completed
+via token-exact mid-stream failover (failovers/spliced counters).
 
 `--shared-prefix` replays a shared-system-prompt workload (every request
 carries the same long prefix + a short unique tail) TWICE — radix-tree
@@ -54,6 +64,9 @@ if server_mode:
 prefix_mode = "--shared-prefix" in sys.argv
 if prefix_mode:
     sys.argv.remove("--shared-prefix")
+router_mode = "--router" in sys.argv
+if router_mode:
+    sys.argv.remove("--router")
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -176,7 +189,8 @@ def main():
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     prefix_len = 96  # shared-prefix mode: 6 pages of 16
-    maxlen = (prefix_len + 16 if prefix_mode else 64) + max_new + 1
+    maxlen = (prefix_len + 16 if prefix_mode or router_mode
+              else 64) + max_new + 1
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
@@ -200,6 +214,9 @@ def main():
 
     if prefix_mode:
         _bench_shared_prefix(model, cfg, engine_kw, on_tpu)
+        return
+    if router_mode:
+        _bench_router(cfg, engine_kw, on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -327,6 +344,194 @@ def _bench_shared_prefix(model, cfg, engine_kw, on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_prefix.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_router(cfg, engine_kw, on_tpu):
+    """Router tier bench: shared-prefix workload across 2 in-process
+    replicas, round-robin vs cache-aware (two-point marginal each,
+    client-side TTFT), plus a kill-one-replica availability replay on
+    3 replicas. One JSON line -> BENCH_serving_router.json."""
+    import threading
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serving import (InProcessReplica, ServingEngine,
+                                    ServingRouter)
+
+    prefix_len = 96
+    arrivals, prompts = make_shared_prefix_trace(
+        n_requests, rate, cfg.vocab_size, prefix_len)
+    new_q = max(1, max_new // 4)
+
+    def make_router(n, policy):
+        # one model instance PER replica (identical weights via the
+        # same seed): concurrent engine loops must never share a
+        # module tree — first-call traces swap weight tensors in place
+        replicas = []
+        for _ in range(n):
+            P.seed(0)
+            m = LlamaForCausalLM(cfg)
+            if on_tpu:
+                m.to(dtype="bfloat16")
+            m.eval()
+            eng = ServingEngine(m, **dict(engine_kw, prefix_cache=True))
+            replicas.append(InProcessReplica(
+                eng, max_queued=len(prompts) + 8))
+        # NOT started yet: warmup drives the engines directly (single
+        # thread); router.start() spins the loop threads up afterwards
+        return ServingRouter(replicas, policy=policy,
+                             page_size=engine_kw["page_size"])
+
+    def warm(router):
+        # warm every bucketed program class per replica with NON-shared
+        # prompts (same length mix), then flush the prefix caches: the
+        # measured replay must see a COLD radix tree, else warmup seeds
+        # the shared prefix on every replica and both policies trivially
+        # hit 1.0 (the policy comparison would measure nothing)
+        warm_rng = np.random.default_rng(1234)
+        warm_prompts = [warm_rng.integers(
+            0, cfg.vocab_size, int(p.size)).astype(np.int32)
+            for p in prompts[:8]]
+        for rep in router.replicas:
+            for budget in (new_q, max_new):
+                for p in warm_prompts:
+                    rep.engine.add_request(p, max_new_tokens=budget)
+                rep.engine.run()
+            rep.engine.cache.clear_prefix()
+        return router.start()
+
+    def flush_prefix(router):
+        for rep in router.replicas:
+            rep.engine.cache.clear_prefix()
+
+    def replay_router(router, arrivals, prompts, new_tokens,
+                      kill=None):
+        """Thread-per-request Poisson replay through the router;
+        returns (wall, tokens, client-side ttft list). ``kill``:
+        (replica_idx, after_seconds) availability drill."""
+        ttfts = [None] * len(prompts)
+        counts = [0] * len(prompts)
+        errors = []
+        killed = []
+        t0 = time.perf_counter()
+
+        def fire(i, due, prompt):
+            time.sleep(max(0.0, due - (time.perf_counter() - t0)))
+            try:
+                sub = time.perf_counter()
+                stream = router.submit(prompt,
+                                       max_new_tokens=new_tokens)
+                for ev in stream.events(timeout=600):
+                    if ev["type"] == "token":
+                        if ttfts[i] is None:
+                            ttfts[i] = time.perf_counter() - sub
+                        counts[i] += 1
+            except Exception as e:
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=fire, args=(i, a, p),
+                                    daemon=True)
+                   for i, (a, p) in enumerate(zip(arrivals, prompts))]
+        for t in threads:
+            t.start()
+        if kill is not None:
+            time.sleep(kill)
+            # kill the BUSIEST replica — the one whose death actually
+            # exercises mid-stream failover
+            idx = max(range(len(router.replicas)),
+                      key=lambda i: router.replicas[i].load())
+            router.kill_replica(idx)
+            killed.append(idx)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:4]
+        # zero-loss property: every stream completed despite the kill
+        assert all(c == new_tokens for c in counts), counts
+        return wall, sum(counts), ttfts, killed
+
+    def measure(policy):
+        router = warm(make_router(2, policy))
+        wall_q, toks_q, _, _ = replay_router(router, arrivals, prompts,
+                                             new_q)
+        # each replay starts prefix-COLD (the policy difference is how
+        # many replicas must re-prefill the shared prefix per replay)
+        flush_prefix(router)
+        base = [(rep.engine.cache.prefix_hit_pages,
+                 rep.engine.cache.prefix_miss_pages)
+                for rep in router.replicas]
+        wall, toks, ttfts, _ = replay_router(router, arrivals, prompts,
+                                             max_new)
+        hit = sum(rep.engine.cache.prefix_hit_pages - b[0]
+                  for rep, b in zip(router.replicas, base))
+        miss = sum(rep.engine.cache.prefix_miss_pages - b[1]
+                   for rep, b in zip(router.replicas, base))
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        routed = router.metrics.routed_total.export()
+        router.close()
+        tt = sorted(t for t in ttfts if t is not None)
+        return {
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p50_s": round(tt[len(tt) // 2], 4) if tt else None,
+            "ttft_p99_s": (round(tt[min(len(tt) - 1,
+                                        int(len(tt) * 0.99))], 4)
+                           if tt else None),
+            "prefix_hit_pages": hit,
+            "prefix_miss_pages": miss,
+            "prefix_hit_rate": (round(hit / (hit + miss), 3)
+                                if hit + miss else 0.0),
+            "routed_total": routed,
+        }
+
+    rr = measure("round_robin")
+    ca = measure("cache_aware")
+
+    # availability drill: 3 replicas, kill the busiest ~30% into the
+    # replay; a small injected step latency keeps streams long-lived
+    # enough that the kill lands MID-stream (the drill measures
+    # completion under failover, not throughput)
+    import os
+    router = warm(make_router(3, "cache_aware"))
+    span = float(arrivals[-1]) if len(arrivals) else 0.0
+    os.environ["PADDLE_TPU_SERVING_FAULT_LATENCY_S"] = "0.01"
+    try:
+        wall_k, toks_k, _, killed = replay_router(
+            router, arrivals, prompts, max_new, kill=0.3 * span + 0.1)
+    finally:
+        del os.environ["PADDLE_TPU_SERVING_FAULT_LATENCY_S"]
+    avail = {
+        "replicas": 3, "killed_replica": killed[0] if killed else None,
+        "completed_tokens": toks_k,
+        "expected_tokens": len(prompts) * max_new,
+        "wall_s": round(wall_k, 3),
+        "failovers": router.metrics.failovers_total.export(),
+        "spliced_tokens": router.metrics.spliced_tokens_total.value,
+    }
+    router.close()
+
+    out = {
+        "metric": "serving_router_ttft_p50_s"
+                  + ("" if on_tpu else "_cpu"),
+        "value": ca["ttft_p50_s"],
+        "unit": "s (shared-prefix workload, 2 replicas, cache-aware "
+                "routing; compare round_robin.ttft_p50_s)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "shared_prefix_tokens": prefix_len,
+        "round_robin": rr, "cache_aware": ca,
+        "hit_rate_gain": round(ca["prefix_hit_rate"]
+                               - rr["prefix_hit_rate"], 3),
+        "availability": avail,
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_router.json", "w") as f:
         f.write(line + "\n")
 
 

@@ -17,31 +17,37 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import threading
 import weakref
 
 import jax
 import jax.numpy as jnp
 
 # ---------------------------------------------------------------------------
-# grad-enabled state
+# grad-enabled state — THREAD-LOCAL (round 11). The serving tier runs
+# several engine loop threads concurrently, each wrapping its step in
+# no_grad; with a process-global flag, interleaved __enter__/__exit__
+# across threads could restore a False saved by ANOTHER thread and
+# leave grad mode off for the whole process (the round-11 tier-1
+# incident: every later backward() raised "does not require grad").
+# Each thread now owns its mode, defaulting to enabled.
 
-_grad_enabled = True
+_grad_state = threading.local()
 
 
 def is_grad_enabled() -> bool:
-    return _grad_enabled
+    return getattr(_grad_state, "enabled", True)
 
 
 def set_grad_enabled(mode: bool):
-    global _grad_enabled
-    _grad_enabled = bool(mode)
+    _grad_state.enabled = bool(mode)
 
 
 class no_grad(contextlib.ContextDecorator):
     """paddle.no_grad — usable as context manager or decorator."""
 
     def __enter__(self):
-        self._prev = _grad_enabled
+        self._prev = is_grad_enabled()
         set_grad_enabled(False)
         return self
 
@@ -52,7 +58,7 @@ class no_grad(contextlib.ContextDecorator):
 
 class enable_grad(contextlib.ContextDecorator):
     def __enter__(self):
-        self._prev = _grad_enabled
+        self._prev = is_grad_enabled()
         set_grad_enabled(True)
         return self
 
@@ -224,7 +230,7 @@ def apply(fn, *tensors, name: str = ""):
     arrs = tuple(t._data for t in tensors)
     traced = any(isinstance(a, jax.core.Tracer) for a in arrs)
     microjit = _MICROJIT and _is_stable(fn) and not traced
-    needs_grad = _grad_enabled and any(not t.stop_gradient for t in tensors)
+    needs_grad = is_grad_enabled() and any(not t.stop_gradient for t in tensors)
     if needs_grad and traced:
         # An OUTER jax transform owns differentiation here — either an
         # enclosing AD transform (the compiled steppers' value_and_grad,
@@ -594,7 +600,7 @@ class PyLayer(metaclass=PyLayerMeta):
         out_list = list(outs) if multi else [outs]
 
         tensor_inputs = [a for a in args if isinstance(a, Tensor)]
-        needs_grad = _grad_enabled and any(
+        needs_grad = is_grad_enabled() and any(
             not t.stop_gradient for t in tensor_inputs)
         if not needs_grad:
             return outs

@@ -28,7 +28,15 @@ Layers:
   load shedding (429) and graceful drain (503).
 - :mod:`server`     — stdlib OpenAI-compatible HTTP front-end:
   /v1/completions + /v1/chat/completions (SSE streaming), /healthz,
-  /metrics; disconnect-driven cancellation.
+  /metrics; disconnect-driven cancellation; round 11: SSE keepalive
+  pings (bounded disconnect detection) + X-Request-Id propagation.
+- :mod:`replica` / :mod:`router` — the multi-replica tier (round 11):
+  ``ServingRouter`` fronts N replicas (in-process frontends or remote
+  HTTP servers) behind the same front-end surface, with round-robin /
+  least-loaded / prefix-cache-aware routing, token-exact mid-stream
+  failover (determinism-backed stream splicing), aggregated 429
+  admission, rolling drain + weight-reload re-admit, and a merged
+  ``replica``-labelled /metrics.
 
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
@@ -41,7 +49,10 @@ from .frontend import (Rejected, RequestStream,  # noqa: F401
                        ServingFrontend, Unavailable)
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
-                      ServingMetrics)
+                      LabeledCounter, ServingMetrics)
+from .replica import (HTTPReplica, InProcessReplica,  # noqa: F401
+                      ReplicaFailed)
+from .router import RouterStream, ServingRouter  # noqa: F401
 from .sampling import fused_sample  # noqa: F401
 from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
                         SchedulerOutput)
@@ -52,7 +63,9 @@ __all__ = [
     "paged_attention", "paged_attention_ref", "fused_sample",
     "Scheduler", "SchedulerOutput", "Request", "RequestState",
     "ServingEngine", "EngineDraining", "FaultInjected",
-    "ServingMetrics", "Counter", "Gauge", "Histogram",
+    "ServingMetrics", "Counter", "Gauge", "Histogram", "LabeledCounter",
     "ServingFrontend", "RequestStream", "Rejected", "Unavailable",
     "ServingServer",
+    "ServingRouter", "RouterStream", "InProcessReplica", "HTTPReplica",
+    "ReplicaFailed",
 ]

@@ -149,7 +149,8 @@ class ServingEngine:
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
                     do_sample=False, temperature=1.0, top_k=0,
-                    top_p=1.0, seed=None, n=1, logprobs=False):
+                    top_p=1.0, seed=None, n=1, logprobs=False,
+                    request_id=None):
         """Queue a request; returns its req_id (n>1 returns the PARENT id
         — forked children surface as their own req_ids in events). With
         the prefix cache on, the longest cached prompt prefix is PINNED
@@ -183,7 +184,9 @@ class ServingEngine:
                       do_sample=bool(do_sample),
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), seed=seed, n=int(n),
-                      logprobs=bool(logprobs))
+                      logprobs=bool(logprobs),
+                      request_id=(str(request_id)
+                                  if request_id is not None else None))
         req.device_seed = (int(seed) & 0x7FFFFFFF if seed is not None
                            else int(self._seed_rng.integers(
                                1, 2 ** 31 - 1)))
@@ -305,6 +308,14 @@ class ServingEngine:
         """Refuse new admissions; everything already queued (waiting/
         prefilling/running) keeps going to completion."""
         self._draining = True
+
+    def resume_admissions(self):
+        """Lift drain mode (the rolling-drain re-admit path): a drained
+        engine accepts new requests again. Weight reloads happen while
+        drained — weights are ARGUMENTS of the compiled step, so the
+        update flows through with no recompile; the prefix cache must
+        be flushed by the caller (stale K/V of the OLD weights)."""
+        self._draining = False
 
     def drain(self, max_steps=100000):
         """start_drain() + run(): finish all in-flight work while
@@ -562,7 +573,8 @@ class ServingEngine:
                         temperature=parent.temperature,
                         top_k=parent.top_k, top_p=parent.top_p,
                         seed=(parent.seed or 0) + i, n=1,
-                        logprobs=parent.logprobs)
+                        logprobs=parent.logprobs,
+                        request_id=parent.request_id)
         child.device_seed = (parent.device_seed + i) & 0x7FFFFFFF
         child.parent_id = parent.req_id
         child.first_token_at = None
@@ -616,7 +628,8 @@ class ServingEngine:
                 "ttft_s": ttft, "tpot_s": tpot,
                 "preemptions": req.preemptions,
                 "cached_prompt_pages": req.cached_pages,
-                "parent_id": req.parent_id}))
+                "parent_id": req.parent_id,
+                "request_id": req.request_id}))
 
     def _event(self, ev, events):
         events.append(ev)

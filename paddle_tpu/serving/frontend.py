@@ -37,6 +37,7 @@ backpressure.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -94,22 +95,33 @@ class RequestStream:
         return list(self._ids)
 
     # -- client side -------------------------------------------------------
-    def events(self, timeout=120.0):
+    def events(self, timeout=120.0, idle_s=None):
         """Yield event dicts ({"type": "token"|"finish", "index", ...})
         until all n samples finished. Raises TimeoutError when no event
         lands within ``timeout`` seconds, RuntimeError when the engine
-        loop died."""
+        loop died. With ``idle_s`` set, a ``{"type": "idle"}`` event is
+        yielded whenever no real event arrived for that long (the SSE
+        keepalive hook: the server turns idles into ``: ping`` comment
+        frames, which is ALSO how client disconnects are detected in
+        bounded time while decode or prefill stalls)."""
         finishes = 0
+        last = time.monotonic()
         while finishes < self.n:
+            wait = timeout if idle_s is None else min(idle_s, timeout)
             try:
-                ev = self._q.get(timeout=timeout)
+                ev = self._q.get(timeout=wait)
             except queue.Empty:
+                if idle_s is not None \
+                        and time.monotonic() - last < timeout:
+                    yield {"type": "idle"}
+                    continue
                 raise TimeoutError(
                     f"request {self.req_id}: no event within "
                     f"{timeout}s") from None
             if ev["type"] == "error":
                 raise RuntimeError(
                     f"engine loop failed: {ev['message']}")
+            last = time.monotonic()
             yield ev
             if ev["type"] == "finish":
                 finishes += 1
@@ -143,6 +155,7 @@ class ServingFrontend:
         self._thread = None
         self._stop = threading.Event()
         self._drained = threading.Event()
+        self._fault_streak = 0  # consecutive FaultInjected (escalation)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -169,6 +182,30 @@ class ServingFrontend:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         return ok and self._state != "failed"
+
+    def resume(self):
+        """Rolling-drain re-admit: restart a DRAINED front-end (weight
+        reloads happen in the drained window — weights are arguments of
+        the compiled step, so the update flows through live). Raises
+        unless the loop thread is parked and the state is recoverable."""
+        if self._state == "failed":
+            raise RuntimeError("cannot resume a failed front-end")
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("front-end not drained: loop still live")
+        self._thread = None
+        self._stop.clear()
+        self._drained.clear()
+        self.engine.resume_admissions()
+        self._state = "ok"
+        return self.start()
+
+    def fail(self, exc):
+        """External failure injection (the router's replica-kill hook
+        and the fault-escalation path): release live pages, error every
+        open stream, flip to "failed", park the loop."""
+        with self.lock:
+            self._fail_locked(exc)
+        self._stop.set()
 
     def close(self, timeout=120.0):
         return self.drain(timeout)
@@ -209,8 +246,18 @@ class ServingFrontend:
                     "waiting": eng.scheduler.queue_depth(),
                     "live": len(eng.scheduler.live_requests()),
                     "free_pages": eng.cache.free_pages,
+                    "reserved_pages": self._reserved_pages(),
                     "requests_finished":
                         eng.metrics.requests_finished.value}
+
+    def load(self):
+        """Routing load signal: outstanding worst-case page
+        reservations (the same math the shed gate charges admissions
+        against). 0 = idle; the router's least-loaded policy sorts on
+        this, and /healthz exposes it as ``reserved_pages`` so HTTP
+        replicas report the identical number."""
+        with self.lock:
+            return self._reserved_pages()
 
     def prometheus(self):
         """Refresh the point-in-time gauges and render the exposition."""
@@ -245,15 +292,7 @@ class ServingFrontend:
                 f"intake queue full ({self.max_queued} waiting)")
         need = cache.pages_for(prompt_len + max_new) * n
         need -= cache.probe_prefix(prompt)  # shared across the n forks
-        promised = 0
-        for r in sched.live_requests():
-            promised += max(
-                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
-                * r.n - cache.pages_held(r.seq_id))
-        for r in sched.waiting:
-            promised += max(
-                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
-                * r.n - cache.pages_held(r.seq_id))
+        promised = self._reserved_pages()
         if need + promised + sched.watermark_pages \
                 > cache.available_pages:
             eng.metrics.rejections.inc()
@@ -261,6 +300,19 @@ class ServingFrontend:
                 f"over capacity: need {need} page(s), "
                 f"{cache.available_pages} available - {promised} "
                 f"reserved - {sched.watermark_pages} watermark")
+
+    def _reserved_pages(self):
+        """Sum of every accepted request's outstanding worst-case page
+        reservation (full prompt+max_new ×n, net of pages already
+        held). Call under the lock."""
+        eng = self.engine
+        cache, sched = eng.cache, eng.scheduler
+        promised = 0
+        for r in list(sched.live_requests()) + list(sched.waiting):
+            promised += max(
+                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
+                * r.n - cache.pages_held(r.seq_id))
+        return promised
 
     def _on_event(self, ev):
         # runs in whichever thread holds the lock and drives the engine
@@ -284,12 +336,29 @@ class ServingFrontend:
         try:
             while not self._stop.is_set():
                 with self.lock:
+                    if self._state == "failed":
+                        return  # externally killed (fail()); stop cold
                     idle = eng.scheduler.all_done()
                     if not idle:
                         try:
                             eng.step()
-                        except FaultInjected:
-                            pass  # counted; boundary fault — retry next
+                            self._fault_streak = 0
+                        except FaultInjected as exc:
+                            # counted; boundary fault — retry next. But
+                            # a fault STREAK means the replica is sick,
+                            # not unlucky: escalate to a loop failure
+                            # (streams error out, the router fails the
+                            # requests over to a healthy replica)
+                            self._fault_streak += 1
+                            esc = int(os.environ.get(
+                                "PADDLE_TPU_SERVING_FAULT_ESCALATE_N",
+                                "0") or 0)
+                            if esc and self._fault_streak >= esc:
+                                self._fail_locked(RuntimeError(
+                                    f"fault escalation after "
+                                    f"{self._fault_streak} consecutive "
+                                    f"faults: {exc}"))
+                                return
                         except Exception as exc:  # fatal: clean + report
                             self._fail_locked(exc)
                             return

@@ -416,6 +416,17 @@ class PagedKVCache:
             node = child
         return added
 
+    def clear_prefix(self):
+        """Flush every reclaimable (rc==0) cached page back to the free
+        list — the weight-reload path: cached K/V computed under OLD
+        weights must never be served to post-reload requests. On an
+        idle (drained) engine every cached page has rc==0, so this is a
+        full tree flush. Returns the number of pages reclaimed."""
+        n = 0
+        while self._evict_lru_leaf():
+            n += 1
+        return n
+
     def _evict_lru_leaf(self):
         """Reclaim the least-recently-used cached LEAF page no sequence
         maps (rc==0). Leaf-first keeps every remaining chain matchable

@@ -8,11 +8,22 @@ device sync; the engine records values it already fetched.
 """
 from __future__ import annotations
 
+import bisect
 import json
+import re
 
 import numpy as np
 
-__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics"]
+__all__ = ["Counter", "Gauge", "Histogram", "LabeledCounter",
+           "ServingMetrics", "merge_prometheus"]
+
+# Prometheus histogram bucket bounds for serving latencies (seconds).
+# TTFT and TPOT land here; the cumulative _bucket{le=...} exposition is
+# what lets a scraper compute real quantiles across replicas (summary
+# quantiles are NOT aggregatable — the router's merged /metrics needs
+# buckets).
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 class Counter:
@@ -41,21 +52,71 @@ class Gauge:
         return self.value
 
 
+class LabeledCounter:
+    """A counter family with fixed label names — the router's
+    ``routed_total{policy,replica}`` class of metric. Values are kept
+    per label-value tuple; ``inc`` creates series on demand."""
+
+    def __init__(self, *label_names):
+        self.label_names = tuple(label_names)
+        self._values: dict[tuple, int | float] = {}
+
+    def inc(self, n=1, **labels):
+        key = tuple(str(labels[k]) for k in self.label_names)
+        self._values[key] = self._values.get(key, 0) + n
+
+    def value(self, **labels):
+        key = tuple(str(labels[k]) for k in self.label_names)
+        return self._values.get(key, 0)
+
+    @property
+    def total(self):
+        return sum(self._values.values())
+
+    def export(self):
+        return {",".join(k): v for k, v in sorted(self._values.items())}
+
+    def prom_lines(self, full):
+        out = []
+        for key, v in sorted(self._values.items()):
+            labels = ",".join(f'{n}="{x}"'
+                              for n, x in zip(self.label_names, key))
+            out.append(f"{full}{{{labels}}} {v}")
+        return out
+
+
 class Histogram:
     """Bounded reservoir of samples; percentiles computed at export.
     Keeps the LAST `cap` samples (serving metrics care about recent
-    behavior; a trace replay fits entirely)."""
+    behavior; a trace replay fits entirely).
 
-    def __init__(self, cap=65536):
+    With ``buckets=`` (ascending upper bounds, seconds for latencies)
+    the Prometheus exposition switches from a summary to a REAL
+    histogram: cumulative ``_bucket{le=...}`` lines per the 0.0.4 text
+    format, aggregatable across replicas. Bucket counts run over ALL
+    samples (like ``count``/``total``), not just the reservoir."""
+
+    def __init__(self, cap=65536, buckets=None):
         self.cap = int(cap)
         self._samples: list[float] = []
         self.count = 0
         self.total = 0.0  # running sum over ALL samples (summary _sum)
+        self.buckets = tuple(buckets) if buckets else None
+        if self.buckets and list(self.buckets) != sorted(self.buckets):
+            raise ValueError("histogram buckets must be ascending")
+        # per-bucket (non-cumulative) counts; the +Inf bucket is `count`
+        self.bucket_counts = ([0] * len(self.buckets)
+                              if self.buckets else None)
 
     def record(self, v):
+        v = float(v)
         self.count += 1
-        self.total += float(v)
-        self._samples.append(float(v))
+        self.total += v
+        if self.buckets is not None:
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(self.bucket_counts):
+                self.bucket_counts[i] += 1
+        self._samples.append(v)
         if len(self._samples) > self.cap:
             del self._samples[: len(self._samples) - self.cap]
 
@@ -83,8 +144,10 @@ class ServingMetrics:
     keys and, prefixed, the Prometheus metric family names)."""
 
     def __init__(self):
-        self.ttft_s = Histogram()             # arrival -> first token
-        self.inter_token_s = Histogram()      # gap between tokens
+        # TTFT/TPOT carry REAL Prometheus buckets (the router-merged
+        # /metrics must stay aggregatable; summary quantiles are not)
+        self.ttft_s = Histogram(buckets=LATENCY_BUCKETS)
+        self.inter_token_s = Histogram(buckets=LATENCY_BUCKETS)
         self.queue_depth = Histogram()        # waiting queue, per step
         self.batch_size = Histogram()         # decode lanes, per step
         self.page_occupancy = Histogram()     # used/allocatable, per step
@@ -119,17 +182,32 @@ class ServingMetrics:
 
     def to_prometheus(self, prefix="paddle_tpu_serving"):
         """Prometheus text exposition (format 0.0.4): counters and
-        gauges as single samples, histograms as summaries with p50/p99
-        quantiles plus _count/_sum. Empty histograms expose only
-        _count/_sum (a quantile of no data is omitted, not NaN, so the
-        text stays trivially parseable)."""
+        gauges as single samples; bucketed histograms (TTFT/TPOT) as
+        REAL histograms with cumulative ``_bucket{le=...}`` lines plus
+        ``le="+Inf"``; bucket-less histograms as summaries with p50/p99
+        quantiles. Empty summaries expose only _count/_sum (a quantile
+        of no data is omitted, not NaN, so the text stays trivially
+        parseable)."""
         lines = []
         for name, m in vars(self).items():
             full = f"{prefix}_{name}"
             if isinstance(m, Counter):
                 lines += [f"# TYPE {full} counter", f"{full} {m.value}"]
+            elif isinstance(m, LabeledCounter):
+                lines.append(f"# TYPE {full} counter")
+                lines += m.prom_lines(full)
             elif isinstance(m, Gauge):
                 lines += [f"# TYPE {full} gauge", f"{full} {m.value}"]
+            elif isinstance(m, Histogram) and m.buckets:
+                lines.append(f"# TYPE {full} histogram")
+                acc = 0
+                for bound, c in zip(m.buckets, m.bucket_counts):
+                    acc += c
+                    lines.append(
+                        f'{full}_bucket{{le="{bound:g}"}} {acc}')
+                lines += [f'{full}_bucket{{le="+Inf"}} {m.count}',
+                          f"{full}_count {m.count}",
+                          f"{full}_sum {m.total}"]
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {full} summary")
                 for q, p in ((0.5, 50), (0.99, 99)):
@@ -139,3 +217,57 @@ class ServingMetrics:
                 lines += [f"{full}_count {m.count}",
                           f"{full}_sum {m.total}"]
         return "\n".join(lines) + "\n"
+
+
+# -- multi-replica merge (router /metrics) ----------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (.*)$")
+
+
+def _label_sample(line, key, value):
+    """Inject ``key="value"`` into one exposition sample line."""
+    m = _SAMPLE_RE.match(line)
+    if m is None:  # pragma: no cover - we only feed our own output
+        return line
+    name, labels, val = m.groups()
+    tag = f'{key}="{value}"'
+    if labels:
+        return f"{name}{{{tag},{labels[1:-1]}}} {val}"
+    return f"{name}{{{tag}}} {val}"
+
+
+def merge_prometheus(parts, label="replica"):
+    """Merge several Prometheus expositions into one, tagging every
+    sample with ``label="<value>"`` and grouping families (one # TYPE
+    line per family, all its samples together — the 0.0.4 grouping
+    rule). ``parts`` is an iterable of ``(label_value, text)``; a
+    ``label_value`` of None passes the part through UNLABELLED (the
+    router's own families carry their labels already). Texts must be
+    TYPE-then-samples shaped, which is what
+    :meth:`ServingMetrics.to_prometheus` emits."""
+    families: dict[str, tuple[str, list]] = {}
+    order = []
+    for value, text in parts:
+        fam = None
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split()
+                if name not in families:
+                    families[name] = (kind, [])
+                    order.append(name)
+                fam = families[name]
+                continue
+            if line.startswith("#"):
+                continue
+            if fam is not None:
+                fam[1].append(line if value is None
+                              else _label_sample(line, label, value))
+    lines = []
+    for name in order:
+        kind, samples = families[name]
+        lines.append(f"# TYPE {name} {kind}")
+        lines += samples
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,329 @@
+"""Replica abstraction for the multi-replica serving tier.
+
+A *replica* is one serving engine behind a uniform surface the
+:class:`~paddle_tpu.serving.router.ServingRouter` can route to, health-
+check, drain, and fail over from. Two implementations:
+
+- :class:`InProcessReplica` — a :class:`ServingFrontend` wrapped
+  directly (engine loop thread in this process). The default; fully
+  testable on the CPU mesh, and the shape a TPU pod-slice deployment
+  uses when one process owns several per-chip engines.
+- :class:`HTTPReplica` — a client to a REMOTE ``ServingServer``
+  (``/v1/completions`` SSE + ``/healthz`` + ``/metrics``), for the
+  one-server-per-host topology. Stream parsing mirrors
+  ``bench_serving.py --server``'s load generator; keepalive comment
+  frames are consumed transparently.
+
+Uniform surface::
+
+    start()                      # idempotent
+    submit(prompt, **kw) -> stream   (stream.events(timeout, idle_s))
+    cancel_stream(stream)        # give the pages back
+    health() -> dict             # {"status": ok|draining|failed|...}
+    load() -> float              # outstanding page reservations
+    prometheus() -> str          # text exposition (router merges)
+    drain(timeout) / resume()    # rolling-drain primitive
+    fail(exc)                    # fault hook (in-process only)
+
+Failure signalling: a replica whose stream dies raises
+:class:`ReplicaFailed` (HTTP transport errors, SSE truncation) or
+``RuntimeError`` (the in-process engine loop died) from the stream
+iterator — the router catches both and fails the request over.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+
+import numpy as np
+
+from .frontend import Rejected, ServingFrontend, Unavailable
+
+__all__ = ["HTTPReplica", "InProcessReplica", "ReplicaFailed"]
+
+
+class ReplicaFailed(RuntimeError):
+    """The replica died mid-request (transport error, loop crash,
+    truncated stream) — the router's signal to fail over."""
+
+
+class InProcessReplica:
+    """A ServingFrontend-wrapped engine living in this process."""
+
+    kind = "inproc"
+
+    def __init__(self, engine, *, max_queued=64, poll_interval_s=0.001,
+                 name=None):
+        self.frontend = ServingFrontend(
+            engine, max_queued=max_queued,
+            poll_interval_s=poll_interval_s)
+        self.engine = engine
+        self.name = name
+        self._started = False
+
+    def start(self):
+        if not self._started:
+            self.frontend.start()
+            self._started = True
+        return self
+
+    def submit(self, prompt, **kw):
+        return self.frontend.submit(prompt, **kw)
+
+    def cancel_stream(self, stream):
+        return self.frontend.cancel(stream.req_id)
+
+    def health(self):
+        return self.frontend.health()
+
+    def load(self):
+        return float(self.frontend.load())
+
+    def prometheus(self):
+        return self.frontend.prometheus()
+
+    @property
+    def state(self):
+        return self.frontend.state
+
+    def drain(self, timeout=120.0):
+        return self.frontend.drain(timeout)
+
+    def resume(self):
+        self.frontend.resume()
+        return self
+
+    def reload(self, update_fn=None):
+        """Weight-reload re-admit (call after :meth:`drain`): apply
+        ``update_fn(model)`` if given — weights are ARGUMENTS of the
+        compiled step, so the new values flow through with no recompile
+        — flush the prefix cache (its K/V was computed under the OLD
+        weights), and restart the loop."""
+        if update_fn is not None:
+            update_fn(self.engine.model)
+        self.engine.cache.clear_prefix()
+        return self.resume()
+
+    def fail(self, exc=None):
+        """Kill hook (router fault injection / tests): fail the loop
+        as if it crashed — live pages released, open streams erred."""
+        self.frontend.fail(exc or ReplicaFailed("replica killed"))
+
+    def close(self, timeout=120.0):
+        return self.frontend.close(timeout)
+
+
+class _HTTPStream:
+    """SSE consumer over one in-flight ``/v1/completions`` request —
+    presents the same ``events(timeout, idle_s)`` surface as
+    :class:`~paddle_tpu.serving.frontend.RequestStream`."""
+
+    def __init__(self, conn, resp, req_id, n):
+        self._conn = conn
+        self._resp = resp
+        self.req_id = req_id
+        self.n = int(n)
+        self._closed = False
+
+    def events(self, timeout=120.0, idle_s=None):
+        finishes = 0
+        last = time.monotonic()
+        sock_wait = idle_s if idle_s is not None else timeout
+        try:
+            self._conn.sock.settimeout(min(sock_wait, timeout))
+        except (AttributeError, OSError):
+            pass
+        while finishes < self.n:
+            try:
+                raw = self._resp.fp.readline()
+            except (socket.timeout, TimeoutError):
+                if idle_s is not None \
+                        and time.monotonic() - last < timeout:
+                    yield {"type": "idle"}
+                    continue
+                raise TimeoutError(
+                    f"replica stream {self.req_id}: no event within "
+                    f"{timeout}s") from None
+            except OSError as e:
+                raise ReplicaFailed(
+                    f"replica stream broke: {e!r}") from e
+            if not raw:  # EOF before [DONE]: replica went away
+                raise ReplicaFailed(
+                    "replica stream ended without [DONE]")
+            line = raw.strip()
+            if not line or line.startswith(b":"):  # SSE keepalive
+                continue
+            if not line.startswith(b"data: "):
+                continue
+            if line == b"data: [DONE]":
+                if finishes < self.n:
+                    raise ReplicaFailed(
+                        f"[DONE] after {finishes}/{self.n} finishes")
+                break
+            last = time.monotonic()
+            ch = json.loads(line[6:])["choices"][0]
+            if "token_id" in ch:
+                ev = {"type": "token", "index": ch["index"],
+                      "token": int(ch["token_id"])}
+                if ch.get("logprob") is not None:
+                    ev["logprob"] = float(ch["logprob"])
+                yield ev
+            if ch.get("finish_reason"):
+                finishes += 1
+                yield {"type": "finish", "index": ch["index"],
+                       "reason": ch["finish_reason"]}
+        self.close()
+
+    def result(self, timeout=120.0):
+        out = [{"tokens": [], "finish_reason": None}
+               for _ in range(self.n)]
+        for ev in self.events(timeout=timeout):
+            slot = out[ev["index"]]
+            if ev["type"] == "token":
+                slot["tokens"].append(ev["token"])
+            elif ev["type"] == "finish":
+                slot["finish_reason"] = ev["reason"]
+        return out
+
+    def close(self):
+        """Hang up. On an unfinished stream the remote server detects
+        the disconnect (keepalive/next write) and cancels the request,
+        freeing its pages. Both the response object and the connection
+        must close — the response keeps the socket fd alive otherwise
+        (CLAUDE.md round-9: ``sock.makefile`` refcount)."""
+        if self._closed:
+            return
+        self._closed = True
+        for obj in (self._resp, self._conn):
+            try:
+                obj.close()
+            except OSError:
+                pass
+
+
+class HTTPReplica:
+    """Client to a remote ``ServingServer``."""
+
+    kind = "http"
+
+    def __init__(self, host, port, *, timeout_s=120.0, name=None):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.name = name or f"{host}:{port}"
+
+    def start(self):
+        return self  # remote lifecycle is the remote operator's
+
+    # -- requests ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, **kw):
+        body = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+                "max_tokens": int(max_new_tokens), "stream": True}
+        if kw.get("do_sample"):
+            body["temperature"] = float(kw.get("temperature", 1.0))
+        for key in ("top_k", "top_p", "seed", "n", "deadline_s"):
+            if kw.get(key) is not None:
+                body[key] = kw[key]
+        if kw.get("logprobs"):
+            body["logprobs"] = True
+        headers = {"Content-Type": "application/json"}
+        if kw.get("request_id"):
+            headers["X-Request-Id"] = str(kw["request_id"])
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+            conn.request("POST", "/v1/completions", json.dumps(body),
+                         headers)
+            resp = conn.getresponse()
+        except OSError as e:
+            raise ReplicaFailed(
+                f"replica {self.name} unreachable: {e!r}") from e
+        if resp.status == 200:
+            return _HTTPStream(conn, resp,
+                               req_id=f"{self.name}/{id(resp):x}",
+                               n=int(kw.get("n", 1)))
+        payload = resp.read()
+        retry_after = resp.getheader("Retry-After")
+        conn.close()
+        try:
+            msg = json.loads(payload)["error"]["message"]
+        except (ValueError, KeyError):
+            msg = payload.decode(errors="replace")
+        if resp.status == 429:
+            exc = Rejected(f"replica {self.name}: {msg}")
+            exc.retry_after = float(retry_after or 1)
+            raise exc
+        if resp.status == 503:
+            raise Unavailable(f"replica {self.name}: {msg}")
+        if resp.status == 400:
+            raise ValueError(msg)
+        raise ReplicaFailed(
+            f"replica {self.name}: HTTP {resp.status}: {msg}")
+
+    def cancel_stream(self, stream):
+        stream.close()
+        return True
+
+    # -- observability -----------------------------------------------------
+    def _get(self, path):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=10.0)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def health(self):
+        try:
+            status, data = self._get("/healthz")
+        except OSError as e:
+            return {"status": "unreachable", "error": repr(e)}
+        try:
+            out = json.loads(data)
+        except ValueError:
+            out = {"status": "failed"}
+        if status != 200 and out.get("status") not in ("draining",):
+            out.setdefault("status", "failed")
+        return out
+
+    @property
+    def state(self):
+        return self.health().get("status", "failed")
+
+    def load(self):
+        h = self.health()
+        if "reserved_pages" in h:
+            return float(h["reserved_pages"])
+        return float(h.get("waiting", 0) + h.get("live", 0))
+
+    def prometheus(self):
+        try:
+            status, data = self._get("/metrics")
+        except OSError:
+            return ""
+        return data.decode() if status == 200 else ""
+
+    # -- lifecycle (router-side only for remote replicas) ------------------
+    def drain(self, timeout=120.0):
+        """Remote drain is the remote operator's call; the router-side
+        drain only stops routing here. Returns True when the remote
+        reports idle (nothing waiting/live) within the timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            h = self.health()
+            if h.get("status") == "unreachable":
+                return False
+            if not (h.get("waiting", 0) or h.get("live", 0)):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def resume(self):
+        return self
+
+    def close(self, timeout=0.0):
+        return True

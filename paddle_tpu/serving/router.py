@@ -1,0 +1,571 @@
+"""Multi-replica serving tier: cache-aware routing, mid-stream
+failover, rolling drain.
+
+``ServingRouter`` fronts N replicas (:mod:`.replica`) behind the SAME
+surface a :class:`~paddle_tpu.serving.frontend.ServingFrontend`
+presents (``submit``/``cancel``/``health``/``prometheus``/``drain``),
+so a :class:`~paddle_tpu.serving.server.ServingServer` can serve a
+whole fleet through one OpenAI-shaped endpoint — the production TPU
+topology (one engine per chip/slice, PAPERS.md Gemma-on-TPU) with the
+paged KV cache as per-replica state that routing exploits.
+
+**Routing policies** (``policy=`` / ``PADDLE_TPU_SERVING_ROUTER_POLICY``):
+
+- ``round_robin`` — rotate over routable replicas.
+- ``least_loaded`` — ascending outstanding page reservations
+  (``frontend.load()`` in-process, ``/healthz reserved_pages`` remote).
+- ``cache_aware`` — a router-side APPROXIMATE radix tree of recently
+  routed prompt prefixes (page-granularity token chains, like the
+  engine's tree but host-only and lossy): a request whose prefix was
+  recently routed to replica R goes back to R, where the engine-level
+  prefix cache holds the pages hot. A LOAD CAP keeps a hot prefix from
+  starving the fleet: when the sticky replica's load exceeds
+  ``cache_load_cap`` pages AND someone else is lighter, the request
+  spills to the least-loaded replica (which then also learns the
+  prefix). Unmatched prompts fall back to least-loaded.
+
+**Mid-stream failover** — the design centerpiece: PR 3 made token ``t``
+of a request a pure function of ``(weights, history, seed, t)``, so a
+request resubmitted on a surviving replica reproduces the identical
+stream and the router can SPLICE: skip the ``k`` tokens the client
+already received and forward the rest, one seamless SSE stream.
+Failure signals: an ``error`` event from the in-process loop
+(``RuntimeError``), :class:`~paddle_tpu.serving.replica.ReplicaFailed`
+from an HTTP replica (transport break / truncated SSE), or a
+health-check flip at submit time. The router assigns an explicit seed
+to sampled requests that arrived without one, so the retried stream is
+token-exact in BOTH greedy and sampled modes.
+
+**Aggregated admission** — a submission is tried on every routable
+replica in policy order; only when EVERY healthy replica sheds does the
+router raise ``Rejected`` (429), with ``retry_after`` = max over the
+replicas' own Retry-After hints.
+
+**Rolling drain** — ``drain_replica(i)`` routes new work away, finishes
+in-flight requests via the frontend's ``start_drain()``/``drain()``,
+and ``readmit_replica(i, reload=fn)`` re-admits after a weight reload
+(prefix caches flushed; the router forgets the replica's prefix
+affinity) — the zero-downtime model-update primitive.
+
+Env knobs: ``PADDLE_TPU_SERVING_ROUTER_POLICY``,
+``PADDLE_TPU_SERVING_ROUTER_LOAD_CAP`` (pages),
+``PADDLE_TPU_SERVING_ROUTER_KILL="<replica>:<after_tokens>"`` (fault
+injection: kill replica *i* once it has delivered that many tokens
+through the router — the failover drill used by bench/tests).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+
+import numpy as np
+
+from .frontend import Rejected, Unavailable
+from .metrics import (Counter, Gauge, LabeledCounter, merge_prometheus)
+from .replica import ReplicaFailed
+
+__all__ = ["RouterStream", "ServingRouter"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+POLICIES = ("round_robin", "least_loaded", "cache_aware")
+
+
+class _Node:
+    """One page of prompt tokens in the router's affinity tree.
+    ``owners`` maps replica index -> last-routed clock."""
+
+    __slots__ = ("key", "parent", "children", "owners", "clock")
+
+    def __init__(self, key, parent):
+        self.key = key
+        self.parent = parent
+        self.children = {}
+        self.owners = {}
+        self.clock = 0
+
+
+class RouterMetrics:
+    """Router-level counters/gauges; replica-labelled where the fleet
+    dimension matters. Families render under
+    ``paddle_tpu_serving_router_*`` and merge into the fleet /metrics."""
+
+    def __init__(self):
+        self.routed_total = LabeledCounter("policy", "replica")
+        self.failovers_total = LabeledCounter("replica")
+        self.spliced_tokens_total = Counter()
+        self.router_shed_total = Counter()
+        self.replica_healthy = LabeledCounter("replica")   # gauge-ish
+        self.replica_draining = LabeledCounter("replica")
+
+    def export(self):
+        return {name: m.export() if hasattr(m, "export") else m
+                for name, m in vars(self).items()}
+
+    def to_prometheus(self, prefix="paddle_tpu_serving_router"):
+        lines = []
+        for name, m in vars(self).items():
+            full = f"{prefix}_{name}"
+            kind = ("gauge" if name.startswith("replica_") else "counter")
+            if isinstance(m, LabeledCounter):
+                lines.append(f"# TYPE {full} {kind}")
+                lines += m.prom_lines(full)
+            elif isinstance(m, (Counter, Gauge)):
+                lines += [f"# TYPE {full} {kind}", f"{full} {m.value}"]
+        return "\n".join(lines) + "\n"
+
+
+class RouterStream:
+    """One client-facing stream spanning (possibly) several replica
+    streams. Consumed from ONE client thread; failover happens inline
+    when that thread observes the failure, so no extra router threads
+    exist. ``events()``/``result()`` mirror ``RequestStream``."""
+
+    def __init__(self, router, req_id, prompt, kwargs, n):
+        self.router = router
+        self.req_id = req_id
+        self.request_id = kwargs.get("request_id")
+        self.prompt = prompt
+        self.kwargs = kwargs
+        self.n = int(n)
+        self.replica_idx = None
+        self._inner = None
+        self._delivered = [0] * self.n
+        self._finished = [False] * self.n
+        self._skip = [0] * self.n
+        self.failovers = 0
+
+    @property
+    def done(self):
+        return all(self._finished)
+
+    def events(self, timeout=120.0, idle_s=None):
+        """Yield token/finish (and idle) events until every sample
+        finished, transparently failing over and splicing when the
+        serving replica dies mid-stream."""
+        while not self.done:
+            try:
+                for ev in self._inner.events(timeout=timeout,
+                                             idle_s=idle_s):
+                    if ev["type"] == "idle":
+                        yield ev
+                        continue
+                    idx = ev.get("index", 0)
+                    if self._finished[idx]:
+                        continue  # replayed sample already delivered
+                    if ev["type"] == "token":
+                        if self._skip[idx] > 0:
+                            self._skip[idx] -= 1   # splice: drop replay
+                            continue
+                        self._delivered[idx] += 1
+                        self.router._token_delivered(self.replica_idx)
+                        yield ev
+                    elif ev["type"] == "finish":
+                        self._finished[idx] = True
+                        yield ev
+                break
+            except TimeoutError:
+                raise
+            except RuntimeError as exc:  # loop death / ReplicaFailed
+                self.router._failover(self, exc)
+        self.router._stream_done(self)
+
+    def result(self, timeout=120.0):
+        out = [{"tokens": [], "finish_reason": None}
+               for _ in range(self.n)]
+        for ev in self.events(timeout=timeout):
+            if ev["type"] == "token":
+                out[ev["index"]]["tokens"].append(ev["token"])
+            elif ev["type"] == "finish":
+                out[ev["index"]]["finish_reason"] = ev["reason"]
+        return out
+
+
+class ServingRouter:
+    def __init__(self, replicas, *, policy=None, page_size=16,
+                 cache_load_cap=None, max_tree_pages=8,
+                 max_tree_nodes=4096, seed=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        policy = policy or os.environ.get(
+            "PADDLE_TPU_SERVING_ROUTER_POLICY") or "cache_aware"
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; one of "
+                             f"{POLICIES}")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self.page_size = int(page_size)
+        cap = os.environ.get("PADDLE_TPU_SERVING_ROUTER_LOAD_CAP")
+        self.cache_load_cap = float(
+            cap if cap is not None else
+            (cache_load_cap if cache_load_cap is not None else 32))
+        self.max_tree_pages = int(max_tree_pages)
+        self.max_tree_nodes = int(max_tree_nodes)
+        self.metrics = RouterMetrics()
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._ids = itertools.count()
+        self._root = _Node(None, None)
+        self._nodes = 0
+        self._clock = 0
+        self._down: set[int] = set()
+        self._draining: set[int] = set()
+        self._streams: dict[int, RouterStream] = {}
+        self._seed_rng = np.random.default_rng(seed)
+        self._started = False
+        # env-gated fault injection: "<replica>:<after_tokens>"
+        kill = os.environ.get("PADDLE_TPU_SERVING_ROUTER_KILL")
+        self._kill = None
+        if kill:
+            idx, after = kill.split(":")
+            self._kill = [int(idx), int(after), False]
+        self._replica_tokens = [0] * len(self.replicas)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if not self._started:
+            for r in self.replicas:
+                r.start()
+            self._started = True
+        return self
+
+    @property
+    def state(self):
+        """Front-end-compatible aggregate state: "ok" while ANY replica
+        is routable, else "draining" if any is draining, else
+        "failed"."""
+        if self._routable():
+            return "ok"
+        return "draining" if self._draining else "failed"
+
+    def drain(self, timeout=120.0):
+        """Fleet drain (ServingServer.close path): drain every replica
+        in parallel-ish sequence; True when all drained."""
+        ok = True
+        for i in range(len(self.replicas)):
+            if i in self._down:
+                continue
+            self._draining.add(i)
+            ok = self.replicas[i].drain(timeout) and ok
+        return ok
+
+    def close(self, timeout=120.0):
+        ok = self.drain(timeout)
+        for r in self.replicas:
+            r.close()
+        return ok
+
+    # -- client API (ServingFrontend-shaped) -------------------------------
+    def submit(self, prompt, max_new_tokens=16, **kw):
+        """Route a request; returns a RouterStream. Raises Rejected
+        only when EVERY routable replica sheds (aggregated 429,
+        ``retry_after`` = max over replica hints), Unavailable when no
+        replica is routable at all."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if kw.get("do_sample") and kw.get("seed") is None:
+            # failover determinism needs an explicit seed: token t is
+            # pure in (weights, history, seed, t), so the retried
+            # stream is exact only if the seed rides along
+            kw["seed"] = int(self._seed_rng.integers(1, 2 ** 31 - 1))
+        kw["max_new_tokens"] = int(max_new_tokens)
+        stream = RouterStream(self, next(self._ids), prompt, kw,
+                              n=int(kw.get("n", 1)))
+        self._place(stream, exclude=())
+        with self._lock:
+            self._streams[stream.req_id] = stream
+        return stream
+
+    def cancel(self, req_id):
+        """Cancel a routed request on whichever replica currently
+        serves it."""
+        with self._lock:
+            stream = self._streams.pop(req_id, None)
+        if stream is None or stream._inner is None:
+            return False
+        return bool(self.replicas[stream.replica_idx]
+                    .cancel_stream(stream._inner))
+
+    def health(self):
+        per = []
+        for i, r in enumerate(self.replicas):
+            if i in self._down:
+                per.append({"status": "down"})
+            else:
+                try:
+                    h = dict(r.health())
+                except Exception as e:  # remote probe blew up
+                    h = {"status": "unreachable", "error": repr(e)}
+                if i in self._draining:
+                    h["status"] = "draining"
+                per.append(h)
+        agg = self.state
+        return {"status": agg,
+                "policy": self.policy,
+                "replicas": per,
+                "waiting": sum(h.get("waiting", 0) for h in per),
+                "live": sum(h.get("live", 0) for h in per),
+                "free_pages": sum(h.get("free_pages", 0) for h in per),
+                "requests_finished": sum(h.get("requests_finished", 0)
+                                         for h in per)}
+
+    def prometheus(self):
+        """Merged fleet exposition: every replica's families tagged
+        ``replica="<i>"``, plus the router's own counters."""
+        for i in range(len(self.replicas)):
+            healthy = int(i not in self._down and i not in self._draining
+                          and self._replica_state(i) == "ok")
+            self.metrics.replica_healthy._values[(str(i),)] = healthy
+            self.metrics.replica_draining._values[(str(i),)] = int(
+                i in self._draining)
+        parts = [(None, self.metrics.to_prometheus())]
+        for i, r in enumerate(self.replicas):
+            if i in self._down:
+                continue
+            try:
+                parts.append((str(i), r.prometheus()))
+            except Exception:  # pragma: no cover - remote flake
+                pass
+        return merge_prometheus(parts)
+
+    # -- rolling drain -----------------------------------------------------
+    def drain_replica(self, i, timeout=120.0):
+        """Route new work away from replica ``i`` and finish its
+        in-flight requests (zero lost work). Returns True when fully
+        drained in time."""
+        with self._lock:
+            self._draining.add(i)
+        ok = self.replicas[i].drain(timeout)
+        _log.info(json.dumps({"event": "router_drain_replica",
+                              "replica": i, "drained": ok}))
+        return ok
+
+    def readmit_replica(self, i, reload=None):
+        """Re-admit a drained replica, optionally applying a weight
+        reload first (``reload(model)`` for in-process replicas). The
+        router forgets the replica's prefix affinity — its engine cache
+        was flushed with the old weights."""
+        rep = self.replicas[i]
+        if hasattr(rep, "reload"):
+            rep.reload(reload)
+        else:
+            rep.resume()
+        with self._lock:
+            self._draining.discard(i)
+            self._down.discard(i)
+            self._forget_owner(self._root, i)
+        _log.info(json.dumps({"event": "router_readmit_replica",
+                              "replica": i}))
+
+    def kill_replica(self, i, exc=None):
+        """Fault hook (tests/bench): hard-kill an in-process replica;
+        its open streams fail over."""
+        with self._lock:
+            self._down.add(i)
+        self.replicas[i].fail(exc)
+
+    # -- routing internals -------------------------------------------------
+    def _replica_state(self, i):
+        try:
+            return self.replicas[i].state
+        except Exception:
+            return "unreachable"
+
+    def _routable(self, exclude=()):
+        out = []
+        for i in range(len(self.replicas)):
+            if i in self._down or i in self._draining or i in exclude:
+                continue
+            out.append(i)
+        return out
+
+    def _loads(self, idxs):
+        loads = {}
+        for i in idxs:
+            try:
+                loads[i] = self.replicas[i].load()
+            except Exception:
+                loads[i] = float("inf")
+        return loads
+
+    def _order(self, prompt, exclude=()):
+        """Replica indexes to try, best first, per the active policy."""
+        idxs = self._routable(exclude)
+        if not idxs:
+            return []
+        if self.policy == "round_robin":
+            with self._lock:
+                start = self._rr
+                self._rr += 1
+            return [idxs[(start + j) % len(idxs)]
+                    for j in range(len(idxs))]
+        loads = self._loads(idxs)
+        by_load = sorted(idxs, key=lambda i: (loads[i], i))
+        if self.policy == "least_loaded":
+            return by_load
+        # cache_aware: deepest recent owner of the prompt's page chain
+        with self._lock:
+            preferred = self._match(prompt, set(idxs))
+        if preferred is None:
+            return by_load
+        if loads.get(preferred, 0) > self.cache_load_cap \
+                and by_load[0] != preferred \
+                and loads[by_load[0]] < loads[preferred]:
+            # hot-prefix load cap: spill to the lightest replica, which
+            # then learns the prefix too (affinity widens under load)
+            return by_load
+        return [preferred] + [i for i in by_load if i != preferred]
+
+    def _match(self, prompt, alive):
+        """Walk the affinity tree; return the deepest-match replica
+        (ties: most recently routed). Call under the lock."""
+        ps = self.page_size
+        node = self._root
+        best = None
+        pages = min(len(prompt) // ps, self.max_tree_pages)
+        for i in range(pages):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            node = node.children.get(key)
+            if node is None:
+                break
+            owners = [(clk, r) for r, clk in node.owners.items()
+                      if r in alive]
+            if owners:
+                best = max(owners)[1]
+        return best
+
+    def _record(self, prompt, replica_idx):
+        """Teach the affinity tree that this prompt's prefix now lives
+        on ``replica_idx``. Bounded: at most ``max_tree_pages`` nodes
+        per prompt, LRU leaf eviction beyond ``max_tree_nodes``."""
+        ps = self.page_size
+        pages = min(len(prompt) // ps, self.max_tree_pages)
+        if pages == 0:
+            return
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i in range(pages):
+                key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(key, node)
+                    node.children[key] = child
+                    self._nodes += 1
+                child.owners[replica_idx] = self._clock
+                child.clock = self._clock
+                node = child
+            while self._nodes > self.max_tree_nodes:
+                if not self._evict_lru_leaf():
+                    break
+
+    def _evict_lru_leaf(self):
+        victim = None
+
+        def walk(node):
+            nonlocal victim
+            for child in node.children.values():
+                if child.children:
+                    walk(child)
+                elif victim is None or child.clock < victim.clock:
+                    victim = child
+
+        walk(self._root)
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        self._nodes -= 1
+        return True
+
+    def _forget_owner(self, node, idx):
+        node.owners.pop(idx, None)
+        for child in node.children.values():
+            self._forget_owner(child, idx)
+
+    def _place(self, stream, exclude):
+        """Try replicas in policy order until one admits the request.
+        Shared by first placement and failover resubmission."""
+        sheds = []
+        tried = set(exclude)
+        for idx in self._order(stream.prompt, exclude=exclude):
+            if idx in tried:
+                continue
+            tried.add(idx)
+            try:
+                inner = self.replicas[idx].submit(stream.prompt,
+                                                  **stream.kwargs)
+            except Rejected as e:
+                sheds.append(e)
+                continue
+            except Unavailable:
+                continue
+            except ReplicaFailed as e:
+                with self._lock:
+                    self._down.add(idx)
+                _log.warning(json.dumps(
+                    {"event": "router_replica_down", "replica": idx,
+                     "cause": str(e)}))
+                continue
+            stream._inner = inner
+            stream.replica_idx = idx
+            self.metrics.routed_total.inc(policy=self.policy,
+                                          replica=idx)
+            if self.policy == "cache_aware":
+                self._record(stream.prompt, idx)
+            return stream
+        if sheds:
+            self.metrics.router_shed_total.inc()
+            exc = Rejected(
+                "all replicas shed: " + "; ".join(map(str, sheds)))
+            exc.retry_after = max(
+                float(getattr(e, "retry_after", 1)) for e in sheds)
+            raise exc
+        raise Unavailable("no routable replica")
+
+    def _failover(self, stream, exc):
+        """The serving replica died mid-stream: mark it down, resubmit
+        on a survivor, arm the splice (skip already-delivered tokens).
+        Raises RuntimeError when no survivor admits the request."""
+        failed = stream.replica_idx
+        with self._lock:
+            self._down.add(failed)
+        stream.failovers += 1
+        spliced = sum(d for d, f in zip(stream._delivered,
+                                        stream._finished) if not f)
+        self.metrics.failovers_total.inc(replica=failed)
+        self.metrics.spliced_tokens_total.inc(spliced)
+        _log.warning(json.dumps({
+            "event": "router_failover", "replica": failed,
+            "request_id": stream.request_id,
+            "router_req_id": stream.req_id,
+            "delivered_tokens": spliced, "cause": str(exc)}))
+        stream._skip = [d if not f else 0
+                        for d, f in zip(stream._delivered,
+                                        stream._finished)]
+        try:
+            self._place(stream, exclude={failed})
+        except (Rejected, Unavailable) as e:
+            raise RuntimeError(
+                f"failover failed for request "
+                f"{stream.request_id or stream.req_id}: {e}") from e
+
+    # -- fault injection / bookkeeping -------------------------------------
+    def _token_delivered(self, replica_idx):
+        if self._kill is None:
+            return
+        self._replica_tokens[replica_idx] += 1
+        idx, after, fired = self._kill
+        if not fired and replica_idx == idx \
+                and self._replica_tokens[idx] >= after:
+            self._kill[2] = True
+            _log.warning(json.dumps({"event": "router_env_kill",
+                                     "replica": idx,
+                                     "after_tokens": after}))
+            self.kill_replica(idx, ReplicaFailed(
+                f"env-injected kill after {after} tokens"))
+
+    def _stream_done(self, stream):
+        with self._lock:
+            self._streams.pop(stream.req_id, None)

@@ -64,6 +64,7 @@ class Request:        # field-wise __eq__ broadcast inside `in` checks
     seed: int | None = None
     n: int = 1                         # parallel samples (copy-on-fork)
     logprobs: bool = False             # emit per-token logprob in events
+    request_id: str | None = None      # client/router trace id (X-Request-Id)
     device_seed: int = 0               # counter-RNG seed (device sampling)
     cached_pages: int = 0              # prefix-cache pages at last acquire
     prefix_counted: bool = False       # hit/miss stats recorded this pass

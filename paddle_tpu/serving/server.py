@@ -37,11 +37,19 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .engine import EngineDraining
 from .frontend import Rejected, ServingFrontend, Unavailable
+
+# `: ping` SSE comment frames flow at this cadence whenever no token is
+# ready — bounded disconnect detection even while decode/prefill stalls
+_KEEPALIVE_ENV = "PADDLE_TPU_SERVING_KEEPALIVE_S"
+_REQ_ID_SAFE = re.compile(r"[^A-Za-z0-9._:-]")
 
 __all__ = ["ServingServer"]
 
@@ -67,9 +75,16 @@ class ServingServer:
                  model_name="paddle-tpu", tokenizer=None,
                  detokenizer=None, max_queued=64, stream_timeout_s=120.0,
                  poll_interval_s=0.001):
-        self.frontend = ServingFrontend(
-            engine, max_queued=max_queued,
-            poll_interval_s=poll_interval_s)
+        if hasattr(engine, "submit"):
+            # a ready front-end-shaped object (ServingFrontend or a
+            # ServingRouter): serve it as-is — the router speaks the
+            # same submit/cancel/health/prometheus/drain surface, so
+            # one ServingServer can front N replicas
+            self.frontend = engine
+        else:
+            self.frontend = ServingFrontend(
+                engine, max_queued=max_queued,
+                poll_interval_s=poll_interval_s)
         self.host = host
         self.port = int(port)
         self.model_name = model_name
@@ -200,10 +215,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
-    def _error(self, code, message, err_type, retry=False):
+    def _error(self, code, message, err_type, retry=None):
+        """``retry`` (seconds) adds a Retry-After header — the router
+        propagates the max over its replicas' sheds here."""
+        extra = (("Retry-After", str(max(1, int(retry)))),) \
+            if retry else ()
         self._json(code, {"error": {"message": message,
                                     "type": err_type, "code": code}},
-                   extra_headers=(("Retry-After", "1"),) if retry else ())
+                   extra_headers=extra)
 
     def _read_json(self):
         try:
@@ -248,17 +267,29 @@ class _Handler(BaseHTTPRequestHandler):
                         "invalid_request_error")
 
     # -- completion flow ---------------------------------------------------
+    def _request_id(self):
+        """Accept the client's ``X-Request-Id`` (sanitized, bounded) or
+        mint one — threaded through add_request, the structured finish
+        log, the SSE chunks, and the router's failover log, so one id
+        traces a request across replicas."""
+        rid = self.headers.get("X-Request-Id") or ""
+        rid = _REQ_ID_SAFE.sub("", rid)[:64]
+        return rid or f"req-{uuid.uuid4().hex[:16]}"
+
     def _completions(self, chat):
         srv = self.owner
         body = self._read_json()
         if body is None:
             return
+        request_id = self._request_id()
         try:
             prompt = srv._encode(body, chat)
             kw = srv._gen_kwargs(body)
-            stream = srv.frontend.submit(prompt, **kw)
+            stream = srv.frontend.submit(prompt, request_id=request_id,
+                                         **kw)
         except Rejected as e:
-            self._error(429, str(e), "overloaded", retry=True)
+            self._error(429, str(e), "overloaded",
+                        retry=getattr(e, "retry_after", 1))
             return
         except (Unavailable, EngineDraining) as e:
             self._error(503, str(e), "unavailable")
@@ -268,12 +299,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{stream.req_id}"
         if body.get("stream"):
-            self._stream_sse(stream, chat, rid)
+            self._stream_sse(stream, chat, rid, request_id)
         else:
-            self._respond_full(stream, chat, rid, len(prompt))
+            self._respond_full(stream, chat, rid, len(prompt),
+                               request_id)
 
     def _chunk(self, chat, rid, index, *, piece=None, token=None,
-               finish=None, logprob=None):
+               finish=None, logprob=None, request_id=None):
         if chat:
             choice = {"index": index,
                       "delta": ({"content": piece}
@@ -287,27 +319,42 @@ class _Handler(BaseHTTPRequestHandler):
         if logprob is not None:
             choice["logprob"] = logprob
         choice["finish_reason"] = finish
-        return {"id": rid, "object": obj,
-                "model": self.owner.model_name, "choices": [choice]}
+        out = {"id": rid, "object": obj,
+               "model": self.owner.model_name, "choices": [choice]}
+        if request_id is not None:
+            out["request_id"] = request_id
+        return out
 
-    def _stream_sse(self, stream, chat, rid):
+    def _stream_sse(self, stream, chat, rid, request_id=None):
         srv = self.owner
+        keepalive = float(os.environ.get(_KEEPALIVE_ENV, "15") or 15)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
+        if request_id is not None:
+            self.send_header("X-Request-Id", request_id)
         self.end_headers()
         try:
-            for ev in stream.events(timeout=srv.stream_timeout_s):
-                if ev["type"] == "token":
+            for ev in stream.events(timeout=srv.stream_timeout_s,
+                                    idle_s=keepalive):
+                if ev["type"] == "idle":
+                    # SSE comment frame: ignored by clients, but the
+                    # write surfaces a hung-up socket within ~2
+                    # keepalive periods even when no token flows
+                    self.wfile.write(b": ping\n\n")
+                    self.wfile.flush()
+                elif ev["type"] == "token":
                     self._sse(self._chunk(
                         chat, rid, ev["index"],
                         piece=srv._piece(ev["token"]),
                         token=ev["token"],
-                        logprob=ev.get("logprob")))
+                        logprob=ev.get("logprob"),
+                        request_id=request_id))
                 else:
                     self._sse(self._chunk(chat, rid, ev["index"],
-                                          finish=ev["reason"]))
+                                          finish=ev["reason"],
+                                          request_id=request_id))
             self.wfile.write(b"data: [DONE]\n\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, TimeoutError,
@@ -316,13 +363,16 @@ class _Handler(BaseHTTPRequestHandler):
             srv.frontend.cancel(stream.req_id)
             _log.info(json.dumps({"event": "stream_aborted",
                                   "req_id": stream.req_id,
+                                  "request_id": request_id,
                                   "cause": type(e).__name__}))
         except RuntimeError as e:  # engine loop died mid-stream
             _log.warning(json.dumps({"event": "stream_failed",
                                      "req_id": stream.req_id,
+                                     "request_id": request_id,
                                      "cause": str(e)}))
 
-    def _respond_full(self, stream, chat, rid, prompt_tokens):
+    def _respond_full(self, stream, chat, rid, prompt_tokens,
+                      request_id=None):
         srv = self.owner
         try:
             results = stream.result(timeout=srv.stream_timeout_s)
@@ -347,11 +397,16 @@ class _Handler(BaseHTTPRequestHandler):
                                 "token_ids": r["tokens"],
                                 "finish_reason": r["finish_reason"]})
         completion = sum(len(r["tokens"]) for r in results)
-        self._json(200, {
+        out = {
             "id": rid,
             "object": "chat.completion" if chat else "text_completion",
             "model": srv.model_name,
             "choices": choices,
             "usage": {"prompt_tokens": prompt_tokens,
                       "completion_tokens": completion,
-                      "total_tokens": prompt_tokens + completion}})
+                      "total_tokens": prompt_tokens + completion}}
+        extra = ()
+        if request_id is not None:
+            out["request_id"] = request_id
+            extra = (("X-Request-Id", request_id),)
+        self._json(200, out, extra_headers=extra)

@@ -347,3 +347,70 @@ class TestIncubateFunctionalAutograd:
         np.testing.assert_allclose(float(tangent.numpy()),
                                    float(xe.grad.numpy().sum()),
                                    rtol=1e-5)
+
+
+class TestGradModeThreadLocal:
+    """Round-11 regression: grad mode is THREAD-LOCAL. The serving tier
+    runs several engine loop threads whose steps sit inside no_grad; a
+    process-global flag let an unlucky cross-thread __enter__/__exit__
+    interleaving restore another thread's False and disable autograd
+    for the rest of the process (every later backward() raised
+    "does not require grad")."""
+
+    def test_no_grad_in_other_thread_does_not_leak(self):
+        import threading
+
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with P.no_grad():
+                entered.set()
+                release.wait(30)
+
+        th = threading.Thread(target=holder, daemon=True)
+        th.start()
+        assert entered.wait(30)
+        try:
+            # another thread is INSIDE no_grad right now; this thread's
+            # mode must be unaffected and backward must work
+            assert P.is_grad_enabled()
+            x = t([2.0, 3.0])
+            (x * x).sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0],
+                                       rtol=1e-6)
+        finally:
+            release.set()
+            th.join(30)
+        assert P.is_grad_enabled()
+
+    def test_interleaved_exit_cannot_disable_process(self):
+        import threading
+
+        a_entered = threading.Event()
+        b_entered = threading.Event()
+        a_exited = threading.Event()
+
+        def a():
+            with P.no_grad():
+                a_entered.set()
+                b_entered.wait(30)
+            a_exited.set()
+
+        def b():
+            a_entered.wait(30)
+            with P.no_grad():   # pre-fix: saves prev=False from a
+                b_entered.set()
+                a_exited.wait(30)
+            # pre-fix: restores False here, disabling grad globally
+
+        ta = threading.Thread(target=a, daemon=True)
+        tb = threading.Thread(target=b, daemon=True)
+        ta.start()
+        tb.start()
+        ta.join(30)
+        tb.join(30)
+        assert P.is_grad_enabled()
+        x = t([1.5])
+        (x * x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0], rtol=1e-6)

@@ -683,12 +683,26 @@ class TestServingSweep:
         assert "# TYPE paddle_tpu_serving_running_gauge gauge" in text
         assert "paddle_tpu_serving_ttft_s_count 0" in text
         assert "quantile" not in text  # no samples -> no quantile rows
+        # TTFT/TPOT are REAL histograms (round 11): cumulative buckets
+        # render even when empty (all zero)
+        assert "# TYPE paddle_tpu_serving_ttft_s histogram" in text
+        assert 'paddle_tpu_serving_ttft_s_bucket{le="+Inf"} 0' in text
         mt.ttft_s.record(0.25)
+        mt.batch_size.record(4)
         mt.queue_depth_gauge.set(3)
         text = mt.to_prometheus()
-        assert 'paddle_tpu_serving_ttft_s{quantile="0.5"} 0.25' in text
-        assert "paddle_tpu_serving_queue_depth_gauge 3.0" in text
+        # cumulative _bucket lines: 0.25 lands in le=0.25 (inclusive)
+        # and every wider bucket
+        assert 'paddle_tpu_serving_ttft_s_bucket{le="0.1"} 0' in text
+        assert 'paddle_tpu_serving_ttft_s_bucket{le="0.25"} 1' in text
+        assert 'paddle_tpu_serving_ttft_s_bucket{le="0.5"} 1' in text
+        assert 'paddle_tpu_serving_ttft_s_bucket{le="+Inf"} 1' in text
         assert "paddle_tpu_serving_ttft_s_sum 0.25" in text
+        # bucket-less histograms stay summaries with quantile rows
+        assert "# TYPE paddle_tpu_serving_batch_size summary" in text
+        assert 'paddle_tpu_serving_batch_size{quantile="0.5"} 4.0' \
+            in text
+        assert "paddle_tpu_serving_queue_depth_gauge 3.0" in text
 
     def test_histogram_percentiles(self):
         from paddle_tpu.serving import Histogram

@@ -1,0 +1,188 @@
+"""Round-11 server satellites: SSE keepalive pings (bounded disconnect
+detection while decode/prefill stalls), disconnect-during-PREFILL
+cancellation (pages freed, queues purged before the first token), and
+X-Request-Id propagation (header -> add_request -> finish log -> SSE
+chunks).
+"""
+import contextlib
+import http.client
+import json
+import logging
+import time
+
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingEngine, ServingServer
+
+
+def tiny_model(seed=0, **kw):
+    P.seed(seed)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64, **kw)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@contextlib.contextmanager
+def served(model, *, server_kw=None, **engine_kw):
+    engine_kw.setdefault("page_size", 4)
+    engine_kw.setdefault("num_pages", 200)
+    engine_kw.setdefault("max_batch", 8)
+    engine_kw.setdefault("prefill_chunk", 8)
+    eng = ServingEngine(model, **engine_kw)
+    srv = ServingServer(eng, **(server_kw or {}))
+    host, port = srv.start()
+    try:
+        yield srv, eng, host, port
+    finally:
+        srv.close(timeout=60)
+
+
+class TestKeepalive:
+    def test_pings_flow_while_decode_stalls(self, monkeypatch):
+        """`: ping` comment frames appear between token chunks when the
+        decode stalls past PADDLE_TPU_SERVING_KEEPALIVE_S; the token
+        stream itself stays exact."""
+        monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_LATENCY_S", "0.2")
+        monkeypatch.setenv("PADDLE_TPU_SERVING_KEEPALIVE_S", "0.05")
+        m = tiny_model(seed=50)
+        prompt = np.random.default_rng(50).integers(0, 97, 5).astype(
+            np.int32)
+        want = np.asarray(m.generate(P.to_tensor(prompt[None]),
+                                     max_new_tokens=3)._data)[0]
+        with served(m) as (srv, eng, host, port):
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [int(t) for t in prompt], "max_tokens": 3,
+                 "stream": True}),
+                {"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 200
+            data = r.read()
+            c.close()
+        pings = sum(1 for ln in data.splitlines()
+                    if ln.strip() == b": ping")
+        assert pings >= 1, data[:400]  # stalls produced keepalives
+        toks = [json.loads(ln[6:])["choices"][0]["token_id"]
+                for ln in data.splitlines()
+                if ln.startswith(b"data: ") and b"token_id" in ln]
+        np.testing.assert_array_equal(toks, want)
+
+    def test_disconnect_during_prefill_cancels(self, monkeypatch):
+        """Satellite: the client hangs up BEFORE the first token (slow
+        chunked prefill). The keepalive write surfaces the dead socket
+        in bounded time — pre-round-11 nothing was written until the
+        first token, so a prefill-stage disconnect went unnoticed —
+        and cancellation frees the pages and purges the queues."""
+        monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_LATENCY_S", "0.1")
+        monkeypatch.setenv("PADDLE_TPU_SERVING_KEEPALIVE_S", "0.05")
+        m = tiny_model(seed=51)
+        with served(m, num_pages=64, max_batch=4) as \
+                (srv, eng, host, port):
+            free0 = eng.cache.allocatable_pages
+            # 40-token prompt / 8-token chunks / 0.1 s per step: the
+            # prefill alone takes ~0.5 s
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [3] * 40, "max_tokens": 10,
+                 "stream": True}),
+                {"Content-Type": "application/json"})
+            r = c.getresponse()
+            assert r.status == 200
+            # hang up IMMEDIATELY — no token has been produced yet.
+            # Both closes are load-bearing (round-9 recipe): the
+            # response object holds the socket fd via sock.makefile
+            r.close()
+            c.close()
+            deadline = time.time() + 30
+            while time.time() < deadline and not (
+                    eng.metrics.cancellations.value
+                    and eng.cache.free_pages == free0):
+                time.sleep(0.05)
+            assert eng.metrics.cancellations.value == 1
+            assert eng.cache.free_pages == free0      # pages freed
+            assert eng.scheduler.all_done()           # queues purged
+            (res,) = eng.results().values()
+            assert res["finish_reason"] == "cancelled"
+            assert res["tokens"] == []  # cancelled DURING prefill
+            assert eng.metrics.preemptions.value == 0
+
+
+class TestRequestId:
+    def test_header_roundtrip_and_finish_log(self, monkeypatch):
+        m = tiny_model(seed=52)
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda rec: records.append(rec.getMessage())
+        log = logging.getLogger("paddle_tpu.serving")
+        log.addHandler(handler)
+        old_level = log.level
+        log.setLevel(logging.INFO)
+        try:
+            with served(m) as (srv, eng, host, port):
+                c = http.client.HTTPConnection(host, port, timeout=60)
+                c.request("POST", "/v1/completions", json.dumps(
+                    {"prompt": [1, 2, 3], "max_tokens": 2}),
+                    {"Content-Type": "application/json",
+                     "X-Request-Id": "trace-42"})
+                r = c.getresponse()
+                assert r.status == 200
+                assert r.getheader("X-Request-Id") == "trace-42"
+                body = json.loads(r.read())
+                assert body["request_id"] == "trace-42"
+                c.close()
+            finues = [json.loads(msg) for msg in records
+                      if '"request_finished"' in msg]
+            assert any(f.get("request_id") == "trace-42"
+                       for f in finues), records
+        finally:
+            log.removeHandler(handler)
+            log.setLevel(old_level)
+
+    def test_generated_when_absent_and_sanitized(self):
+        m = tiny_model(seed=53)
+        with served(m) as (srv, eng, host, port):
+            # absent -> server mints one
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [1, 2], "max_tokens": 1}),
+                {"Content-Type": "application/json"})
+            r = c.getresponse()
+            rid = r.getheader("X-Request-Id")
+            assert rid and rid.startswith("req-")
+            r.read()
+            c.close()
+            # hostile header -> sanitized, never echoed verbatim
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [1, 2], "max_tokens": 1}),
+                {"Content-Type": "application/json",
+                 "X-Request-Id": "a b<script>" + "x" * 200})
+            r = c.getresponse()
+            rid = r.getheader("X-Request-Id")
+            assert " " not in rid and "<" not in rid
+            assert len(rid) <= 64
+            r.read()
+            c.close()
+
+    def test_sse_chunks_carry_request_id(self):
+        m = tiny_model(seed=54)
+        with served(m) as (srv, eng, host, port):
+            c = http.client.HTTPConnection(host, port, timeout=60)
+            c.request("POST", "/v1/completions", json.dumps(
+                {"prompt": [5, 6, 7], "max_tokens": 2,
+                 "stream": True}),
+                {"Content-Type": "application/json",
+                 "X-Request-Id": "sse-trace"})
+            r = c.getresponse()
+            assert r.getheader("X-Request-Id") == "sse-trace"
+            chunks = [json.loads(ln[6:]) for ln in r.read().splitlines()
+                      if ln.startswith(b"data: ")
+                      and ln != b"data: [DONE]"]
+            c.close()
+            assert chunks and all(ch["request_id"] == "sse-trace"
+                                  for ch in chunks)

@@ -294,10 +294,16 @@ class TestDrain:
                 target=lambda: drained.setdefault(
                     "ok", srv.drain(timeout=120)))
             td.start()
-            time.sleep(0.05)
-            st, _, data = _get(host, port, "/healthz")
-            assert st == 200
-            assert json.loads(data)["status"] == "draining"
+            # drain must grab the engine lock behind an in-flight step
+            # (50 ms each), so poll instead of racing a fixed sleep
+            deadline = time.time() + 15
+            status = None
+            while time.time() < deadline and status != "draining":
+                st, _, data = _get(host, port, "/healthz")
+                assert st == 200
+                status = json.loads(data)["status"]
+                time.sleep(0.02)
+            assert status == "draining"
             st, _, data = _post(host, port, "/v1/completions",
                                 {"prompt": [9], "max_tokens": 2})
             assert st == 503
@@ -340,7 +346,8 @@ class TestMetricsEndpoint:
                     continue
                 if line.startswith("# TYPE "):
                     name, kind = line.split()[2:4]
-                    assert kind in ("counter", "gauge", "summary"), line
+                    assert kind in ("counter", "gauge", "summary",
+                                    "histogram"), line
                     families.add(name)
                 else:
                     assert _PROM_LINE.match(line), f"invalid: {line!r}"
@@ -351,7 +358,17 @@ class TestMetricsEndpoint:
                          "paddle_tpu_serving_ttft_s",
                          "paddle_tpu_serving_rejections"):
                 assert want in families, want
-            assert 'paddle_tpu_serving_ttft_s{quantile="0.5"}' in text
+            # round 11: TTFT/TPOT expose REAL cumulative buckets (the
+            # 0.0.4 histogram shape — aggregatable across replicas),
+            # and the cumulative-monotone property holds
+            assert "# TYPE paddle_tpu_serving_ttft_s histogram" in text
+            counts = [int(mo.group(1)) for mo in re.finditer(
+                r'paddle_tpu_serving_ttft_s_bucket\{le="[^"]+"\} (\d+)',
+                text)]
+            assert counts and counts == sorted(counts)
+            assert counts[-1] == 1  # one request -> +Inf bucket == 1
+            assert 'paddle_tpu_serving_ttft_s_bucket{le="+Inf"} 1' \
+                in text
 
     def test_healthz_shape(self):
         m = tiny_model(seed=8)

@@ -72,6 +72,12 @@ run bash tools/serving_server_smoke.sh
 #     Mosaic constructs) — safe tier.
 run bash tools/serving_prefix_smoke.sh
 
+# 5e. multi-replica router smoke (round 11): shared-prefix replay
+#     across 2 in-process replicas (round-robin vs cache-aware) plus a
+#     kill-one-replica failover drill. CPU-mesh by construction
+#     (--smoke), plain XLA step programs — safe tier.
+run bash tools/serving_router_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

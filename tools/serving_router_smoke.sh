@@ -1,0 +1,21 @@
+#!/bin/bash
+# Multi-replica router smoke for the chip-capture list (round 11) —
+# SAFE tier: `--smoke` forces the CPU mesh (no device probe, zero chip
+# touch), replicas are in-process engines whose step programs are plain
+# XLA (the paged Pallas stub stays interpret-gated), so NO first-time
+# Mosaic construct can reach the chip from this script.
+#
+# Replays the shared-prefix Poisson trace through a 2-replica
+# ServingRouter round-robin vs cache-aware (the cache-aware policy must
+# show a strictly higher aggregate prefix hit rate and lower TTFT p50),
+# then a 3-replica availability drill that kills the busiest replica
+# mid-replay — every stream must complete via token-exact mid-stream
+# failover. Banks BENCH_serving_router.json.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_router_smoke.sh > .bench_r4/serving_router_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --router \
+  | tee .bench_r4/serving_router_smoke.json
