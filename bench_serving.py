@@ -4,7 +4,19 @@ model and report throughput + latency.
 
 Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
-                               [--router]
+                               [--router] [--spec]
+
+`--spec` measures batched speculative decoding in the engine: a target
+and an h128-class 1-layer draft are quick-trained on a deterministic
+successor task (the acceptance-FAVORABLE workload — the bench measures
+the mechanism's ceiling, the honest distilled-draft acceptance curve
+lives in BENCH_spec_acceptance.json), then the SAME greedy Poisson
+trace is replayed through a non-speculative and a speculative engine
+(one WARM engine per config, two-point marginal each — the PR-3
+recipe). Banks BENCH_serving_spec.json with both marginal decode rates,
+the speedup, and the measured acceptance rate; greedy streams are
+token-exact across the two engines by construction (deterministic-
+sample verification), which the replay asserts.
 
 `--router` replays the shared-prefix workload through a ServingRouter
 over TWO in-process replicas (each its own engine + prefix cache),
@@ -67,6 +79,9 @@ if prefix_mode:
 router_mode = "--router" in sys.argv
 if router_mode:
     sys.argv.remove("--router")
+spec_mode = "--spec" in sys.argv
+if spec_mode:
+    sys.argv.remove("--spec")
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -217,6 +232,9 @@ def main():
         return
     if router_mode:
         _bench_router(cfg, engine_kw, on_tpu)
+        return
+    if spec_mode:
+        _bench_speculative(on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -532,6 +550,154 @@ def _bench_router(cfg, engine_kw, on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_router.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_speculative(on_tpu):
+    """Speculative vs plain decode through the serving engine on an
+    acceptance-favorable workload.
+
+    The task is a deterministic SUCCESSOR pattern (a fixed random
+    permutation cycle over 64 distinct byte tokens): both the target
+    and the narrow 1-layer h128-class draft learn it to ~1.0 argmax
+    agreement in a few hundred CE steps, so the measured speedup
+    reflects the round arithmetic (k+1 fused draft steps + ONE [B, k+1]
+    verify vs one target step per token), not draft quality — the
+    honest distilled-draft acceptance curve is the offline
+    BENCH_spec_acceptance.json artifact. Two-point marginal per config,
+    one WARM engine per config, greedy streams asserted token-exact
+    across the two engines."""
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaPretrainingCriterion
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+    vocab, plen, seq, batch = 256, 64, 96, 8
+    spec_k = 4
+    steps = 60 if smoke else 300
+    new_tokens = max_new
+    maxlen = 32 + new_tokens + 8
+    rng = np.random.default_rng(42)
+    pattern = rng.permutation(vocab)[:plen].astype(np.int32)
+
+    def make_seqs(n, length):
+        offs = rng.integers(0, plen, n)
+        tiled = np.concatenate([pattern] * (length // plen + 2))
+        return np.stack([tiled[o:o + length] for o in offs])
+
+    def build(hidden, inter, layers, seed):
+        P.seed(seed)
+        cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                          intermediate_size=inter,
+                          num_hidden_layers=layers,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=maxlen)
+        return LlamaForCausalLM(cfg)
+
+    def fit(model, steps, lr=3e-3):
+        crit = LlamaPretrainingCriterion(model.cfg)
+        opt = P.optimizer.AdamW(lr, parameters=model.parameters())
+        loss = None
+        for i in range(steps):
+            chunk = make_seqs(batch, seq + 1)
+            logits = model(P.to_tensor(chunk[:, :-1]))
+            loss = crit(logits, P.to_tensor(chunk[:, 1:]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        model.eval()
+        return float(loss.numpy()) if loss is not None else None
+
+    t0 = time.perf_counter()
+    target = build(256, 688, 4, seed=0)
+    draft = build(128, 344, 1, seed=1)
+    t_loss = fit(target, steps)
+    d_loss = fit(draft, steps)
+    train_s = time.perf_counter() - t0
+
+    arrivals, _ = make_trace(n_requests, rate, vocab)
+    prompts = [row[:int(g)] for row, g in zip(
+        make_seqs(n_requests, 32),
+        np.random.default_rng(7).integers(16, 33, n_requests))]
+    new_q = max(1, new_tokens // 4)
+    engine_kw = dict(page_size=16, num_pages=2048, max_batch=8,
+                     prefill_chunk=32, max_seq_len=maxlen)
+
+    def measure(spec):
+        ekw = dict(engine_kw)
+        if spec:
+            ekw.update(draft_model=draft, speculative_k=spec_k)
+        eng = ServingEngine(target, **ekw)
+        warm_n = min(4, n_requests)
+        replay(target, np.zeros(warm_n), prompts[:warm_n], new_q,
+               engine=eng)
+        replay(target, np.zeros(warm_n), prompts[:warm_n], new_tokens,
+               engine=eng)
+        eng.metrics = ServingMetrics()
+        wall_q, toks_q, _ = replay(target, arrivals, prompts, new_q,
+                                   engine=eng)
+        eng.metrics = ServingMetrics()
+        wall, toks, metrics = replay(target, arrivals, prompts,
+                                     new_tokens, engine=eng)
+        m = metrics.export()
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        out = {
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p50_s": m["ttft_s"]["p50"],
+            "decode_steps": m["decode_steps"],
+            "fetch_bytes": m["fetch_bytes"],
+        }
+        if spec:
+            out.update(
+                spec_rounds=m["spec_rounds"],
+                spec_draft_tokens=m["spec_draft_tokens"],
+                spec_accepted_tokens=m["spec_accepted_tokens"],
+                spec_fallbacks=m["spec_fallbacks"],
+                acceptance_rate=(
+                    round(m["spec_accepted_tokens"]
+                          / m["spec_draft_tokens"], 3)
+                    if m["spec_draft_tokens"] else 0.0))
+        results = {rid: r["tokens"]
+                   for rid, r in eng.results().items()}
+        return out, results
+
+    plain, ref = measure(False)
+    spec, got = measure(True)
+    # determinism contract: greedy speculative streams are token-exact
+    # vs the plain engine (same (weights, history, seed, t) function)
+    ref_sorted = sorted(map(tuple, ref.values()))
+    got_sorted = sorted(map(tuple, got.values()))
+    assert ref_sorted == got_sorted, "speculative streams diverged"
+
+    speedup = None
+    if plain["tok_per_s_marginal"] and spec["tok_per_s_marginal"]:
+        speedup = round(spec["tok_per_s_marginal"]
+                        / plain["tok_per_s_marginal"], 2)
+    out = {
+        "metric": "serving_spec_speedup" + ("" if on_tpu else "_cpu"),
+        "value": speedup,
+        "unit": "x marginal decode tok/s vs the non-speculative "
+                f"engine (greedy, k={spec_k}, h128-class 1-layer "
+                "draft, deterministic successor workload)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": new_tokens, "speculative_k": spec_k,
+        "train_steps": steps, "train_s": round(train_s, 1),
+        "target_loss": (round(t_loss, 4)
+                        if t_loss is not None else None),
+        "draft_loss": (round(d_loss, 4)
+                       if d_loss is not None else None),
+        "acceptance_rate": spec.get("acceptance_rate"),
+        "token_exact_vs_plain": True,
+        "speculative": spec, "plain": plain,
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_spec.json", "w") as f:
         f.write(line + "\n")
 
 

@@ -22,7 +22,12 @@ Layers:
   Prometheus exposition). Round 9: per-token ``on_event`` streaming,
   ``cancel()`` (pages freed, queues purged), ``drain()`` mode,
   env-gated fault injection at the step boundary, failure-path page
-  release.
+  release. Round 12: batched speculative decoding
+  (``draft_model=``/``speculative_k=`` — fused k+1-step draft-propose
+  scan + ONE [B, k+1] verify step with deterministic-sample
+  acceptance: greedy AND seeded-sampled streams token-exact vs the
+  plain engine; accounting-only rollback via
+  ``PagedKVCache.free_tail``; admission reserves the verify burst).
 - :mod:`frontend`   — thread-safe request bridge: lock-serialized
   engine loop thread, per-request token streams, reservation-based
   load shedding (429) and graceful drain (503).

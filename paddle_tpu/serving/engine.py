@@ -47,6 +47,34 @@ Decode hot path (round 10):
 - Decode batches are staged through PERSISTENT per-bucket host buffers
   (``_build_decode_batch``) — no per-step np.zeros garbage on the hot
   path.
+
+Batched speculative decoding (round 12):
+
+- ``draft_model=``/``speculative_k=``: per decode round a small draft
+  model proposes up to k tokens per running lane (ONE fused
+  ``lax.scan`` program — k+1 draft steps, one dispatch), then ONE
+  target step over the [B, k+1] extend shape — the chunked-prefill
+  program class in ``multi_pos`` mode — verifies every position.
+- Verification is DETERMINISTIC-SAMPLE MATCHING, not distributional
+  rejection sampling: the verify step recomputes the target's own
+  counter-RNG sample at every position (token ``t`` is pure in
+  ``(weights, history, seed, t)`` — the PR-3 contract), and a draft
+  proposal is accepted iff it EQUALS that sample. Every emitted token
+  is therefore exactly what the non-speculative engine would have
+  emitted — greedy AND seeded-sampled streams are token-exact, so
+  router failover splicing and preemption recompute work unchanged.
+  The draft shares the per-lane counter keys, so its Gumbel noise is
+  correlated with the target's — a well-matched draft accepts at the
+  argmax-agreement rate even for sampled lanes.
+- Rejected positions roll back by ACCOUNTING only
+  (``PagedKVCache.free_tail``): the garbage K/V stays masked by
+  context_len and is overwritten when the lane grows again. The draft
+  keeps its own (cheap, narrow) paged cache, rebuilt lazily after
+  preemption/fork — draft-cache state can be dropped at ANY time
+  without affecting output correctness, only the acceptance rate.
+- Admission reserves each lane's worst-case round growth (k+1 tokens,
+  ``Scheduler.spec_reserve_tokens``) so a verify burst never preempts
+  a running decode; per-request opt-out rides ``speculative=False``.
 """
 from __future__ import annotations
 
@@ -81,10 +109,8 @@ class FaultInjected(RuntimeError):
 
 
 class ServingEngine:
-    def __init__(self, model, *, page_size=16, num_pages=None,
-                 hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
-                 max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
-                 cache_dtype=None, on_event=None, prefix_cache=None):
+    @staticmethod
+    def _validate_causal_lm(model, what="model"):
         cfg = getattr(model, "cfg", None)
         core = getattr(model, "llama", model)
         for attr in ("embed_tokens", "layers", "norm"):
@@ -92,11 +118,20 @@ class ServingEngine:
                 raise TypeError(
                     "ServingEngine needs a LLaMA-family causal LM "
                     "(model.llama or a core module with embed_tokens/"
-                    f"layers/norm); {type(model).__name__} lacks {attr!r}")
+                    f"layers/norm); {what} {type(model).__name__} "
+                    f"lacks {attr!r}")
         if not hasattr(model, "lm_head"):
-            raise TypeError("model must expose lm_head")
+            raise TypeError(f"{what} must expose lm_head")
         if cfg is None:
-            raise TypeError("model must carry a .cfg")
+            raise TypeError(f"{what} must carry a .cfg")
+        return cfg, core
+
+    def __init__(self, model, *, page_size=16, num_pages=None,
+                 hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
+                 max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
+                 cache_dtype=None, on_event=None, prefix_cache=None,
+                 draft_model=None, speculative_k=None):
+        cfg, core = self._validate_causal_lm(model)
         self.model = model
         self._core = core
         nh = cfg.num_attention_heads
@@ -124,13 +159,57 @@ class ServingEngine:
             dtype=cache_dtype, prefix_cache=bool(prefix_cache))
         self.max_pages_per_seq = math.ceil(
             self.max_seq_len / self.cache.page_size)
+        # -- speculative decoding (round 12) -------------------------------
+        self.draft = draft_model
+        if draft_model is not None:
+            dcfg, dcore = self._validate_causal_lm(draft_model,
+                                                   what="draft_model")
+            if getattr(dcfg, "vocab_size", None) != cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocab "
+                    f"({dcfg.vocab_size} vs {cfg.vocab_size})")
+            dmax = getattr(dcfg, "max_position_embeddings", None)
+            if dmax is not None and self.max_seq_len > dmax:
+                raise ValueError(
+                    f"draft max_position_embeddings({dmax}) < "
+                    f"max_seq_len({self.max_seq_len})")
+            k = 4 if speculative_k is None else int(speculative_k)
+            if not 1 <= k <= 16:
+                raise ValueError(
+                    f"speculative_k must be in [1, 16], got {k}")
+            self.spec_k = k
+            self._draft_core = dcore
+            self._draft_window = getattr(dcfg, "sliding_window",
+                                         None) or None
+            dnh = dcfg.num_attention_heads
+            dnkv = getattr(dcfg, "num_key_value_heads", None) or dnh
+            # same page geometry/count as the target (token-capacity
+            # parity), narrow per-page bytes (the draft is the cheap
+            # model); no prefix cache — draft K/V is disposable state
+            self._draft_cache = PagedKVCache(
+                dcfg.num_hidden_layers, dnkv,
+                dcfg.hidden_size // dnh, page_size=page_size,
+                num_pages=self.cache.num_pages,
+                dtype=("bfloat16"
+                       if getattr(dcfg, "dtype", "float32") == "bfloat16"
+                       else "float32"))
+        else:
+            if speculative_k:
+                raise ValueError("speculative_k needs a draft_model")
+            self.spec_k = 0
+            self._draft_cache = None
+            self._draft_core = None
+            self._draft_window = None
         self.scheduler = Scheduler(self.cache, max_batch=max_batch,
                                    prefill_chunk=prefill_chunk,
-                                   watermark_frac=watermark_frac)
+                                   watermark_frac=watermark_frac,
+                                   spec_reserve_tokens=self.spec_k)
         self.metrics = ServingMetrics()
         self.eos = eos_token_id
         self.window = getattr(cfg, "sliding_window", None) or None
         self._step_fn = None          # one jit fn; traces per bucket
+        self._draft_fn = None         # draft catchup/prefill step fn
+        self._propose_fn = None       # fused k+1-step draft scan program
         self._logits_dev = None       # last step's on-device [B,V] logits
         self._decode_bufs = {}        # per-bucket persistent host buffers
         self._seed_rng = np.random.default_rng()  # seed=None fallback
@@ -150,7 +229,7 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
                     do_sample=False, temperature=1.0, top_k=0,
                     top_p=1.0, seed=None, n=1, logprobs=False,
-                    request_id=None):
+                    request_id=None, speculative=None):
         """Queue a request; returns its req_id (n>1 returns the PARENT id
         — forked children surface as their own req_ids in events). With
         the prefix cache on, the longest cached prompt prefix is PINNED
@@ -186,7 +265,9 @@ class ServingEngine:
                       top_p=float(top_p), seed=seed, n=int(n),
                       logprobs=bool(logprobs),
                       request_id=(str(request_id)
-                                  if request_id is not None else None))
+                                  if request_id is not None else None),
+                      speculative=(None if speculative is None
+                                   else bool(speculative)))
         req.device_seed = (int(seed) & 0x7FFFFFFF if seed is not None
                            else int(self._seed_rng.integers(
                                1, 2 ** 31 - 1)))
@@ -202,14 +283,16 @@ class ServingEngine:
         """One scheduler iteration. Returns a list of event dicts
         ({"type": "token"|"finish", "req_id", ...})."""
         self._maybe_inject_fault()
-        was_training = getattr(self.model, "training", False)
-        if was_training:
-            self.model.eval()
+        was_training = [m for m in (self.model, self.draft)
+                        if m is not None
+                        and getattr(m, "training", False)]
+        for m in was_training:
+            m.eval()
         try:
             return self._step_inner()
         finally:
-            if was_training:
-                self.model.train()
+            for m in was_training:
+                m.train()
 
     def _step_inner(self):
         now = self._now()
@@ -218,6 +301,7 @@ class ServingEngine:
         for r in out.expired:  # graceful: pages freed, partial output kept
             if self.cache.has_seq(r.seq_id):
                 self.cache.free_seq(r.seq_id)
+            self._free_draft_seq(r.seq_id)
             self.metrics.deadline_evictions.inc()
             self._record_finish(r, events)
         if out.decode:
@@ -236,9 +320,7 @@ class ServingEngine:
             # can never fit
             req = self.scheduler.waiting[0]
             if not self._release_waiting_pins(exclude=req):
-                need = self.cache.pages_for(
-                    len(req.token_history()) + 1) \
-                    - self.cache.pages_held(req.seq_id)
+                need = self.scheduler.worst_case_need(req)
                 if need + self.scheduler.watermark_pages \
                         > self.cache.available_pages:
                     raise RuntimeError(
@@ -293,6 +375,7 @@ class ServingEngine:
             return False
         if self.cache.has_seq(req.seq_id):
             self.cache.free_seq(req.seq_id)
+        self._free_draft_seq(req.seq_id)
         self.scheduler.remove(req)
         req.state = RequestState.FINISHED
         req.finish_reason = "cancelled"
@@ -331,6 +414,7 @@ class ServingEngine:
         for r in self.scheduler.live_requests():
             if self.cache.has_seq(r.seq_id):
                 self.cache.free_seq(r.seq_id)
+            self._free_draft_seq(r.seq_id)
             self.scheduler.preempt(r)
 
     def _maybe_inject_fault(self):
@@ -411,10 +495,37 @@ class ServingEngine:
     def _preempt(self, victim):
         if self.cache.has_seq(victim.seq_id):
             self.cache.free_seq(victim.seq_id)
+        self._free_draft_seq(victim.seq_id)
         self.scheduler.preempt(victim)
         self.metrics.preemptions.inc()
 
+    def _free_draft_seq(self, seq_id):
+        """Drop a lane's draft-cache state (request finished/cancelled/
+        preempted). Draft K/V is disposable — the next speculative round
+        rebuilds it by catchup prefill; output tokens never depend on
+        it."""
+        if self._draft_cache is not None \
+                and self._draft_cache.has_seq(seq_id):
+            self._draft_cache.free_seq(seq_id)
+
+    def _spec_enabled(self, req):
+        """Does this lane ride the draft-verify rounds? Engine-level
+        config gates it; a request opts out with speculative=False."""
+        return (self.spec_k > 0 and self.draft is not None
+                and req.speculative is not False)
+
     def _decode_batch(self, reqs, events):
+        spec, plain = [], []
+        for r in reqs:
+            (spec if self._spec_enabled(r) else plain).append(r)
+        if spec:
+            # lanes whose draft cache cannot be readied this round fall
+            # back to the plain batch (output-identical, just slower)
+            self._spec_round(spec, plain, events)
+        if plain:
+            self._plain_decode(plain, events)
+
+    def _plain_decode(self, reqs, events):
         alloc = []
         for r in reqs:
             if r.state != RequestState.RUNNING:
@@ -499,6 +610,246 @@ class ServingEngine:
             b["seeds"][i] = r.device_seed
             b["steps"][i] = len(r.out_tokens)
         return b
+
+    # -- speculative decoding (round 12) -----------------------------------
+    def _draft_alloc(self, seq_id, n, protect=()):
+        """Allocate ``n`` draft-cache slots, evicting OTHER lanes' draft
+        state under pressure (their next round pays a catchup prefill;
+        output tokens are unaffected — draft K/V is disposable). Lanes
+        in ``protect`` are never evicted (they are mid-round: their
+        page tables are about to enter a program). Returns None when
+        the draft pool cannot serve."""
+        dc = self._draft_cache
+        while True:
+            try:
+                slots, copies = dc.append_slots(seq_id, n)
+                if copies:  # pragma: no cover - draft seqs never fork
+                    raise AssertionError("draft cache saw a CoW copy")
+                return slots
+            except OutOfPages:
+                victims = [s for s in dc.live_seqs()
+                           if s != seq_id and s not in protect]
+                if not victims:
+                    return None
+                dc.free_seq(victims[0])
+
+    def _draft_ready(self, req, protect=()):
+        """Bring the draft cache up to date for ``req``: every history
+        token but the last must have its draft K/V written (catchup
+        runs the draft's chunked-prefill program — a lane's first
+        speculative round after prefill/preemption/fork pays it once).
+        False -> the lane falls back to plain decode this round."""
+        dc = self._draft_cache
+        sid = req.seq_id
+        target = req.prompt.size + len(req.out_tokens) - 1
+        if not dc.has_seq(sid):
+            dc.alloc_seq(sid)
+        have = dc.seq_len(sid)
+        if have > target:  # pragma: no cover - defensive resync
+            dc.free_tail(sid, target)
+            have = target
+        if have == target:
+            return True
+        hist = req.token_history()
+        c = self.scheduler.prefill_chunk
+        neutral = (np.zeros(1, np.bool_), np.ones(1, np.float32),
+                   np.zeros(1, np.int32), np.ones(1, np.float32),
+                   np.zeros(1, np.int32), np.zeros(1, np.int32))
+        while have < target:
+            n = min(c, target - have)
+            slots = self._draft_alloc(sid, n, protect)
+            if slots is None:
+                return False
+            ids = np.zeros((1, c), np.int32)
+            ids[0, :n] = hist[have:have + n]
+            positions = (have + np.arange(c, dtype=np.int32))[None, :]
+            pt = dc.page_table(sid, self.max_pages_per_seq)[None, :]
+            cl = np.asarray([have + n], np.int32)
+            slot_map = np.zeros((1, c), np.int32)
+            slot_map[0, :n] = slots
+            self._run_draft_step(ids, positions, pt, cl, slot_map,
+                                 np.asarray([n - 1], np.int32), neutral)
+            have += n
+        return True
+
+    def _spec_round(self, lanes, plain, events):
+        """One draft-propose / target-verify round over the speculative
+        lanes: k+1 fused draft steps (ONE dispatch), ONE [B, k+1]
+        target extend step, deterministic-sample acceptance, rollback
+        of rejected slots. Lanes the draft cannot serve are demoted to
+        ``plain`` (token-identical output, just one-token decode)."""
+        k = self.spec_k
+        k1 = k + 1
+        protect = {r.seq_id for r in lanes}
+        staged = []
+        for r in lanes:
+            if r.state != RequestState.RUNNING:
+                continue  # preempted by an earlier member's catchup
+            if not self._draft_ready(r, protect):
+                self.metrics.spec_fallbacks.inc()
+                plain.append(r)
+                continue
+            staged.append(r)
+        alloc = []
+        for r in staged:
+            if r.state != RequestState.RUNNING:
+                continue  # preempted by an earlier member's allocation
+            hist0 = r.prompt.size + len(r.out_tokens)
+            rem = r.max_new_tokens - len(r.out_tokens)
+            # slots past the request's final fed position go to scratch
+            # (they are never attended), keeping the round inside the
+            # front-end's prompt+max_new page reservation envelope
+            n_slots = min(k1, rem)
+            tslots = self._alloc_with_preemption(r, n_slots)
+            if r.state != RequestState.RUNNING:  # pragma: no cover
+                continue
+            dslots = self._draft_alloc(r.seq_id, n_slots, protect)
+            if dslots is None:
+                self.cache.free_tail(r.seq_id, hist0 - 1)
+                self.metrics.spec_fallbacks.inc()
+                plain.append(r)
+                continue
+            alloc.append((r, hist0, n_slots, tslots, dslots))
+        active = [a for a in alloc
+                  if a[0].state == RequestState.RUNNING]
+        if not active:
+            return
+        bb = self._bucket(len(active))
+        mp = self.max_pages_per_seq
+        dids = np.zeros((bb, 1), np.int32)
+        dpos = np.zeros(bb, np.int32)
+        dpt = np.full((bb, mp), SCRATCH_PAGE, np.int32)
+        dcl = np.ones(bb, np.int32)
+        dslot = np.zeros((bb, k1), np.int32)
+        do_sample = np.zeros(bb, np.bool_)
+        temperature = np.ones(bb, np.float32)
+        top_k = np.zeros(bb, np.int32)
+        top_p = np.ones(bb, np.float32)
+        seeds = np.zeros(bb, np.int32)
+        steps0 = np.zeros(bb, np.int32)
+        for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
+            dids[i, 0] = r.out_tokens[-1]
+            dpos[i] = hist0 - 1
+            dpt[i] = self._draft_cache.page_table(r.seq_id, mp)
+            dcl[i] = hist0
+            dslot[i, :n_slots] = dslots
+            do_sample[i] = r.do_sample
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = r.device_seed
+            steps0[i] = len(r.out_tokens)
+        samp = (do_sample, temperature, top_k, top_p, seeds, steps0)
+        sample_capable = any(r.do_sample for r, *_ in active)
+        props = np.asarray(self._run_draft_propose(
+            dids, dpos, dpt, dcl, dslot, samp, sample_capable),
+            np.int32)                                  # [bb, k+1]
+        self.metrics.fetch_bytes.inc(props.nbytes)
+        ids = np.zeros((bb, k1), np.int32)
+        positions = np.zeros((bb, k1), np.int32)
+        pt = np.full((bb, mp), SCRATCH_PAGE, np.int32)
+        cl = np.ones(bb, np.int32)
+        slot_map = np.zeros((bb, k1), np.int32)
+        for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
+            ids[i, 0] = r.out_tokens[-1]
+            ids[i, 1:] = props[i, :k]
+            positions[i] = hist0 - 1 + np.arange(k1, dtype=np.int32)
+            pt[i] = self.cache.page_table(r.seq_id, mp)
+            cl[i] = hist0 - 1 + n_slots
+            slot_map[i, :n_slots] = tslots
+        host = self._host_sampling()
+        toks, lps = self._run_step(
+            ids, positions, pt, cl, slot_map, np.zeros(bb, np.int32),
+            samp, (not host) and sample_capable, multi_pos=True)
+        self.metrics.spec_rounds.inc()
+        self.metrics.decode_steps.inc()
+        self.metrics.batch_size.record(len(active))
+        # count only proposals that COULD be accepted (a lane about to
+        # hit max_new can use at most its remaining budget) so the
+        # acceptance rate measures the draft, not the budget clip
+        self.metrics.spec_draft_tokens.inc(
+            sum(min(k, a[2]) for a in active))
+        if host:
+            logits = self._fetch_logits()              # [bb, k+1, V]
+        else:
+            toks = np.asarray(toks, np.int32)
+            lps = np.asarray(lps, np.float32)
+            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+        accepted = 0
+        for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
+            emitted = 0
+            for j in range(k1):
+                if host:
+                    # host oracle: numpy RNG draws happen one per
+                    # EMITTED token, in stream order — identical
+                    # consumption to the non-speculative loop
+                    v = self._sample(r, logits[i, j])
+                    lp = None
+                else:
+                    v = int(toks[i, j])
+                    lp = float(lps[i, j])
+                is_draft = j < k and v == int(props[i, j])
+                self._emit_token(r, v, events, logprob=lp)
+                emitted += 1
+                if is_draft:
+                    accepted += 1
+                if r.state == RequestState.FINISHED or not is_draft:
+                    break  # mismatch emits the correction; j==k = bonus
+            if r.state != RequestState.FINISHED:
+                # rollback: accounting only — rejected slots' K/V stays
+                # masked by context_len until overwritten
+                new_len = hist0 + emitted - 1
+                self.cache.free_tail(r.seq_id, new_len)
+                self._draft_cache.free_tail(r.seq_id, new_len)
+        self.metrics.spec_accepted_tokens.inc(accepted)
+
+    def _run_draft_step(self, ids, positions, pt, cl, slot_map,
+                        last_idx, samp):
+        """Draft catchup prefill: same compiled step class as the
+        target, on the draft model/cache (sampling output unused)."""
+        import jax
+        import jax.numpy as jnp
+        if self._draft_fn is None:
+            self._draft_fn = jax.jit(
+                functools.partial(_paged_step_pure, self.draft,
+                                  self._draft_core, self._draft_window),
+                static_argnums=(0, 1))
+        dc = self._draft_cache
+        dwarrs = [t._data for t in self.draft._gen_state_tensors()]
+        _, _, _, k_pages, v_pages = self._draft_fn(
+            False, False, dwarrs, jnp.asarray(ids),
+            jnp.asarray(positions), jnp.asarray(pt), jnp.asarray(cl),
+            jnp.asarray(slot_map), jnp.asarray(last_idx),
+            tuple(jnp.asarray(a) for a in samp),
+            dc.k_pages, dc.v_pages)
+        dc.k_pages = list(k_pages)
+        dc.v_pages = list(v_pages)
+
+    def _run_draft_propose(self, ids0, pos0, pt, cl0, slot_mat, samp,
+                           sample_capable):
+        """The fused k+1-step draft proposal scan: one dispatch per
+        round, K/V written in place, proposals fetched as [B, k+1]
+        int32 (the k+1-th output is the generation.py 'extra step'
+        trick — it lands d_k's K/V so a full-accept round leaves no
+        hole; the token itself is discarded)."""
+        import jax
+        import jax.numpy as jnp
+        if self._propose_fn is None:
+            self._propose_fn = jax.jit(
+                functools.partial(_spec_draft_pure, self.draft,
+                                  self._draft_core, self._draft_window),
+                static_argnums=(0,))
+        dc = self._draft_cache
+        dwarrs = [t._data for t in self.draft._gen_state_tensors()]
+        props, k_pages, v_pages = self._propose_fn(
+            bool(sample_capable), dwarrs, jnp.asarray(ids0),
+            jnp.asarray(pos0), jnp.asarray(pt), jnp.asarray(cl0),
+            jnp.asarray(slot_mat),
+            tuple(jnp.asarray(a) for a in samp),
+            dc.k_pages, dc.v_pages)
+        dc.k_pages = list(k_pages)
+        dc.v_pages = list(v_pages)
+        return props
 
     def _prefill_chunk(self, req, start, end, events):
         if not self.cache.has_seq(req.seq_id):
@@ -606,6 +957,7 @@ class ServingEngine:
     def _finish(self, req, reason, events):
         if self.cache.has_seq(req.seq_id):
             self.cache.free_seq(req.seq_id)
+        self._free_draft_seq(req.seq_id)
         self.scheduler.finish(req, reason)
         self._record_finish(req, events)
 
@@ -698,24 +1050,29 @@ class ServingEngine:
         m.prefix_hit_rate.set(c.prefix_hit_pages / total if total
                               else 0.0)
         m.cached_pages_gauge.set(c.cached_pages)
+        if m.spec_draft_tokens.value:
+            m.spec_acceptance_rate.set(m.spec_accepted_tokens.value
+                                       / m.spec_draft_tokens.value)
 
     def _run_step(self, ids, positions, pt, cl, slot_map, last_idx,
-                  samp, sample_capable):
+                  samp, sample_capable, multi_pos=False):
         import jax
         import jax.numpy as jnp
         if self._step_fn is None:
             # bucketed shapes bound this single fn's trace cache to
             # 2*(log2(max_batch)+2) entries (the static sample_capable
-            # flag at most doubles it); weights ride as arguments
+            # and multi_pos flags at most double it each); weights ride
+            # as arguments
             self._step_fn = jax.jit(
                 functools.partial(_paged_step_pure, self.model,
                                   self._core, self.window),
-                static_argnums=(0,))
+                static_argnums=(0, 1))
         warrs = [t._data for t in self.model._gen_state_tensors()]
         tok, lp, logits, k_pages, v_pages = self._step_fn(
-            bool(sample_capable), warrs, jnp.asarray(ids),
-            jnp.asarray(positions), jnp.asarray(pt), jnp.asarray(cl),
-            jnp.asarray(slot_map), jnp.asarray(last_idx),
+            bool(sample_capable), bool(multi_pos), warrs,
+            jnp.asarray(ids), jnp.asarray(positions), jnp.asarray(pt),
+            jnp.asarray(cl), jnp.asarray(slot_map),
+            jnp.asarray(last_idx),
             tuple(jnp.asarray(a) for a in samp),
             self.cache.k_pages, self.cache.v_pages)
         self.cache.k_pages = list(k_pages)
@@ -744,26 +1101,29 @@ def _counter_sample_row(logits_row, req):
     return int(np.asarray(tok)[0]), float(np.asarray(lp)[0])
 
 
-def _paged_step_pure(model, core, window, sample_capable, warrs, ids,
-                     positions, pt, cl, slot_map, last_idx, samp,
-                     k_pages, v_pages):
+def _paged_step_pure(model, core, window, sample_capable, multi_pos,
+                     warrs, ids, positions, pt, cl, slot_map, last_idx,
+                     samp, k_pages, v_pages):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
         return _paged_step_body(model, core, window, sample_capable,
-                                ids, positions, pt, cl, slot_map,
-                                last_idx, samp, k_pages, v_pages)
+                                multi_pos, ids, positions, pt, cl,
+                                slot_map, last_idx, samp, k_pages,
+                                v_pages)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
-def _paged_step_body(model, core, window, sample_capable, ids, positions,
-                     pt, cl, slot_map, last_idx, samp, k_pages, v_pages):
-    import jax.numpy as jnp
-
+def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
+                   k_pages, v_pages):
+    """The transformer trunk over the paged cache: embed, attend (K/V
+    scattered into the page pool), final norm. Shared by the target
+    step program, the draft catchup step, and the draft proposal scan.
+    Returns ``(hidden [B, S, D] jnp array, new_k, new_v)``."""
     from ..core.autograd import no_grad
     from ..core.tensor import Tensor
     from ..incubate.nn.functional import fused_rotary_position_embedding
@@ -800,15 +1160,99 @@ def _paged_step_body(model, core, window, sample_capable, ids, positions,
             h = x + at.o_proj(Tensor(out).reshape([b, s, nh * hd]))
             x = h + layer.mlp(layer.post_attention_layernorm(h))
         x = core.norm(x)
-        h_last = x._data[jnp.arange(b), last_idx]        # [B, D]
+    return x._data, new_k, new_v
+
+
+def _paged_step_body(model, core, window, sample_capable, multi_pos,
+                     ids, positions, pt, cl, slot_map, last_idx, samp,
+                     k_pages, v_pages):
+    import jax.numpy as jnp
+
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+
+    x, new_k, new_v = _paged_forward(core, window, ids, positions, pt,
+                                     cl, slot_map, k_pages, v_pages)
+    from .sampling import fused_sample, fused_sample_multi
+    do_sample, temperature, top_k, top_p, seeds, steps = samp
+    if multi_pos:
+        # speculative verify: logits + the target's own deterministic
+        # sample at EVERY position of the extend (one [B, S] fetch);
+        # the non-speculative path never takes this branch, keeping its
+        # fetch at <= B*8 bytes
+        with no_grad():
+            logits = model.lm_head(Tensor(x))._data
+        logits = logits.astype(jnp.float32)              # [B, S, V]
+        tokens, logprobs = fused_sample_multi(
+            logits, do_sample, temperature, top_k, top_p, seeds, steps,
+            sample_capable=sample_capable)
+        return tokens, logprobs, logits, new_k, new_v
+    b = ids.shape[0]
+    h_last = x[jnp.arange(b), last_idx]                  # [B, D]
+    with no_grad():
         logits = model.lm_head(Tensor(h_last[:, None, :]))._data[:, 0]
     logits = logits.astype(jnp.float32)
     # fused on-device sampling: the host fetches [B] ids (+logprobs),
     # not [B, V] logits; sample_capable is STATIC (greedy-only batches
     # compile without the top-k/top-p sort)
-    from .sampling import fused_sample
-    do_sample, temperature, top_k, top_p, seeds, steps = samp
     tokens, logprobs = fused_sample(
         logits, do_sample, temperature, top_k, top_p, seeds, steps,
         sample_capable=sample_capable)
     return tokens, logprobs, logits, new_k, new_v
+
+
+# -- the fused draft-proposal scan (speculative decoding, round 12) --------
+
+def _spec_draft_pure(draft, core, window, sample_capable, dwarrs, ids0,
+                     pos0, pt, cl0, slot_mat, samp, k_pages, v_pages):
+    tensors = draft._gen_state_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, dwarrs):
+        t._data = arr
+    try:
+        return _spec_draft_body(draft, core, window, sample_capable,
+                                ids0, pos0, pt, cl0, slot_mat, samp,
+                                k_pages, v_pages)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _spec_draft_body(draft, core, window, sample_capable, ids0, pos0,
+                     pt, cl0, slot_mat, samp, k_pages, v_pages):
+    """k+1 chained draft steps inside ONE compiled program
+    (``lax.scan``): step j feeds the previous token at position
+    ``pos0 + j`` (slot ``slot_mat[:, j]``, context ``cl0 + j``) and
+    samples the next proposal with the SAME counter key the target's
+    verify step will use for that position — correlated Gumbel noise
+    is what lets a well-matched draft accept at the argmax-agreement
+    rate even on sampled lanes. Returns ``(proposals [B, k+1] int32,
+    new_k, new_v)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+    from .sampling import fused_sample
+
+    do_sample, temperature, top_k, top_p, seeds, steps0 = samp
+    n_steps = slot_mat.shape[1]
+
+    def step(carry, xs):
+        j, slots = xs
+        kps, vps, tok = carry
+        x, nk, nv = _paged_forward(core, window, tok,
+                                   (pos0 + j)[:, None], pt, cl0 + j,
+                                   slots[:, None], kps, vps)
+        with no_grad():
+            logits = draft.lm_head(Tensor(x[:, -1:]))._data[:, 0]
+        nxt, _ = fused_sample(
+            logits.astype(jnp.float32), do_sample, temperature, top_k,
+            top_p, seeds, steps0 + j, sample_capable=sample_capable)
+        return (nk, nv, nxt[:, None]), nxt
+
+    (new_k, new_v, _), toks = jax.lax.scan(
+        step, (list(k_pages), list(v_pages), ids0),
+        (jnp.arange(n_steps, dtype=jnp.int32),
+         jnp.swapaxes(slot_mat, 0, 1)))
+    return jnp.swapaxes(toks, 0, 1), new_k, new_v

@@ -247,6 +247,7 @@ class ServingFrontend:
                     "live": len(eng.scheduler.live_requests()),
                     "free_pages": eng.cache.free_pages,
                     "reserved_pages": self._reserved_pages(),
+                    "speculative_k": getattr(eng, "spec_k", 0),
                     "requests_finished":
                         eng.metrics.requests_finished.value}
 

@@ -285,6 +285,36 @@ class PagedKVCache:
         self._lens[seq_id] = ln + n_tokens
         return slots, copies
 
+    def free_tail(self, seq_id, new_len):
+        """Roll a sequence BACK to ``new_len`` tokens — the speculative-
+        decoding rejection path: slots written for rejected draft tokens
+        are released by accounting alone (the K/V bytes stay in place,
+        masked by context_len, and are overwritten when the sequence
+        grows again). Pages that fall entirely beyond the new length are
+        refcount-released; refcount-safe under prefix-cache sharing
+        (cached pages stay RESIDENT at rc==0, exactly like free_seq) and
+        n>1 forks (shared pages are only decref'd — the co-owner keeps
+        them; spec writes CoW the shared tail first, so a rolled-back
+        page is never one the sibling still reads through this table).
+        """
+        if seq_id not in self._tables:
+            raise KeyError(f"free_tail: unknown sequence {seq_id!r}")
+        new_len = int(new_len)
+        ln = self._lens[seq_id]
+        if new_len < 0 or new_len > ln:
+            raise ValueError(
+                f"free_tail: new_len={new_len} outside [0, {ln}]")
+        table = self._tables[seq_id]
+        keep = self.pages_for(new_len)
+        for p in table[keep:]:
+            self._rc[p] -= 1
+            if self._rc[p] < 0:  # pragma: no cover - internal invariant
+                raise AssertionError(f"page {p} refcount underflow")
+            if self._rc[p] == 0 and p not in self._cached:
+                self._free.append(p)
+        del table[keep:]
+        self._lens[seq_id] = new_len
+
     def apply_copies(self, copies):
         """Perform pending copy-on-write page copies on the device
         buffers (one batched gather-scatter per layer)."""

@@ -162,6 +162,11 @@ class ServingMetrics:
         self.cancellations = Counter()        # cancel() calls that landed
         self.rejections = Counter()           # load-shed admissions (429)
         self.faults_injected = Counter()      # injected step faults
+        # speculative decoding (round 12)
+        self.spec_rounds = Counter()          # draft-propose/verify rounds
+        self.spec_draft_tokens = Counter()    # tokens the draft proposed
+        self.spec_accepted_tokens = Counter()  # proposals verified+emitted
+        self.spec_fallbacks = Counter()       # lanes demoted to plain
         # decode hot path (round 10)
         self.fetch_bytes = Counter()          # host<-device bytes/steps
         self.prefix_hit_pages = Counter()     # prompt pages served from
@@ -173,6 +178,7 @@ class ServingMetrics:
         self.running_gauge = Gauge()          # running decode batch size
         self.prefix_hit_rate = Gauge()        # hit/(hit+miss), cumulative
         self.cached_pages_gauge = Gauge()     # pages resident in the tree
+        self.spec_acceptance_rate = Gauge()   # accepted/proposed, cumul.
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}

@@ -223,7 +223,8 @@ class HTTPReplica:
                 "max_tokens": int(max_new_tokens), "stream": True}
         if kw.get("do_sample"):
             body["temperature"] = float(kw.get("temperature", 1.0))
-        for key in ("top_k", "top_p", "seed", "n", "deadline_s"):
+        for key in ("top_k", "top_p", "seed", "n", "deadline_s",
+                    "speculative"):
             if kw.get(key) is not None:
                 body[key] = kw[key]
         if kw.get("logprobs"):

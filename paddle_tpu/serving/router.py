@@ -47,8 +47,20 @@ and ``readmit_replica(i, reload=fn)`` re-admits after a weight reload
 (prefix caches flushed; the router forgets the replica's prefix
 affinity) — the zero-downtime model-update primitive.
 
+**Background health prober** (round 12) — ``probe_interval_s=`` /
+``PADDLE_TPU_SERVING_PROBE_S``: a daemon thread periodically re-probes
+DOWN replicas (bounded interval) and auto-readmits any that report
+``"ok"`` again — a restarted remote ``ServingServer`` behind an
+``HTTPReplica`` rejoins the fleet without a manual ``readmit_replica``
+call. The recovered replica's prefix affinity is forgotten (its cache
+is cold after a restart); in-process replicas whose loop FAILED report
+``"failed"`` and are never auto-readmitted (they need an operator
+``readmit_replica(reload=...)``). ``probe_now()`` runs one probe pass
+synchronously (tests/operators).
+
 Env knobs: ``PADDLE_TPU_SERVING_ROUTER_POLICY``,
 ``PADDLE_TPU_SERVING_ROUTER_LOAD_CAP`` (pages),
+``PADDLE_TPU_SERVING_PROBE_S`` (seconds; 0/unset disables the prober),
 ``PADDLE_TPU_SERVING_ROUTER_KILL="<replica>:<after_tokens>"`` (fault
 injection: kill replica *i* once it has delivered that many tokens
 through the router — the failover drill used by bench/tests).
@@ -98,6 +110,7 @@ class RouterMetrics:
         self.failovers_total = LabeledCounter("replica")
         self.spliced_tokens_total = Counter()
         self.router_shed_total = Counter()
+        self.readmissions_total = LabeledCounter("replica")  # prober
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
 
@@ -187,7 +200,8 @@ class RouterStream:
 class ServingRouter:
     def __init__(self, replicas, *, policy=None, page_size=16,
                  cache_load_cap=None, max_tree_pages=8,
-                 max_tree_nodes=4096, seed=None):
+                 max_tree_nodes=4096, seed=None,
+                 probe_interval_s=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         policy = policy or os.environ.get(
@@ -223,6 +237,14 @@ class ServingRouter:
             idx, after = kill.split(":")
             self._kill = [int(idx), int(after), False]
         self._replica_tokens = [0] * len(self.replicas)
+        # background health prober (round 12): bounded re-probe of DOWN
+        # replicas, auto-readmit on recovery
+        if probe_interval_s is None:
+            probe_interval_s = float(
+                os.environ.get("PADDLE_TPU_SERVING_PROBE_S", "0") or 0)
+        self.probe_interval_s = max(0.0, float(probe_interval_s))
+        self._probe_stop = threading.Event()
+        self._probe_thread = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -230,6 +252,12 @@ class ServingRouter:
             for r in self.replicas:
                 r.start()
             self._started = True
+            if self.probe_interval_s > 0 \
+                    and self._probe_thread is None:
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop,
+                    name="serving-router-prober", daemon=True)
+                self._probe_thread.start()
         return self
 
     @property
@@ -253,10 +281,47 @@ class ServingRouter:
         return ok
 
     def close(self, timeout=120.0):
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
         ok = self.drain(timeout)
         for r in self.replicas:
             r.close()
         return ok
+
+    # -- background health prober (round 12) -------------------------------
+    def _probe_loop(self):
+        while not self._probe_stop.wait(self.probe_interval_s):
+            try:
+                self.probe_now()
+            except Exception:  # pragma: no cover - probe must not die
+                pass
+
+    def probe_now(self):
+        """One synchronous probe pass over the DOWN replicas: any that
+        reports ``"ok"`` again is auto-readmitted (its prefix affinity
+        forgotten — a restarted server's cache is cold). Replicas whose
+        in-process loop FAILED report "failed" and stay down (they need
+        ``readmit_replica`` with a reload). Returns the list of replica
+        indexes readmitted."""
+        with self._lock:
+            down = [i for i in self._down if i not in self._draining]
+        readmitted = []
+        for i in down:
+            try:
+                status = self.replicas[i].health().get("status")
+            except Exception:
+                continue
+            if status != "ok":
+                continue
+            with self._lock:
+                self._down.discard(i)
+                self._forget_owner(self._root, i)
+            self.metrics.readmissions_total.inc(replica=i)
+            readmitted.append(i)
+            _log.info(json.dumps({"event": "router_replica_readmitted",
+                                  "replica": i, "by": "health_prober"}))
+        return readmitted
 
     # -- client API (ServingFrontend-shaped) -------------------------------
     def submit(self, prompt, max_new_tokens=16, **kw):

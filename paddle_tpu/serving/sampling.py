@@ -42,7 +42,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fused_sample"]
+__all__ = ["fused_sample", "fused_sample_multi"]
 
 
 def _lane_keys(seeds, steps):
@@ -108,3 +108,33 @@ def fused_sample(logits, do_sample, temperature, top_k, top_p, seeds,
     dist = jnp.where(do_sample[:, None], final, lg)
     lp = jax.nn.log_softmax(dist, axis=-1)
     return tok, jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
+
+
+def fused_sample_multi(logits, do_sample, temperature, top_k, top_p,
+                       seeds, steps0, *, sample_capable=True):
+    """Per-POSITION fused sampling for the speculative verify step.
+
+    ``logits`` is [B, S, V]; the per-lane sampling params are [B] and
+    broadcast over the S positions; position j of lane i draws with the
+    counter key ``fold_in(PRNGKey(seeds[i]), steps0[i] + j)`` — exactly
+    the key the non-speculative engine would use when sampling that
+    request's token ``steps0[i] + j``. That identity is what makes
+    deterministic-sample verification token-exact vs the plain decode
+    loop: the verify step recomputes the SAME samples the one-token-at-
+    a-time engine would have emitted, and acceptance is a pure prefix
+    match against the draft's proposals.
+
+    Returns ``(tokens int32 [B, S], logprobs float32 [B, S])``.
+    """
+    b, s, _ = logits.shape
+    flat = logits.reshape(b * s, logits.shape[-1])
+
+    def rep(a):
+        return jnp.repeat(a, s, axis=0)
+
+    steps = (steps0[:, None]
+             + jnp.arange(s, dtype=jnp.int32)[None, :]).reshape(-1)
+    tok, lp = fused_sample(flat, rep(do_sample), rep(temperature),
+                           rep(top_k), rep(top_p), rep(seeds), steps,
+                           sample_capable=sample_capable)
+    return tok.reshape(b, s), lp.reshape(b, s)

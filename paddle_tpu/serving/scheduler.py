@@ -65,6 +65,7 @@ class Request:        # field-wise __eq__ broadcast inside `in` checks
     n: int = 1                         # parallel samples (copy-on-fork)
     logprobs: bool = False             # emit per-token logprob in events
     request_id: str | None = None      # client/router trace id (X-Request-Id)
+    speculative: bool | None = None    # None=engine default, False=opt out
     device_seed: int = 0               # counter-RNG seed (device sampling)
     cached_pages: int = 0              # prefix-cache pages at last acquire
     prefix_counted: bool = False       # hit/miss stats recorded this pass
@@ -115,10 +116,15 @@ class SchedulerOutput:
 
 class Scheduler:
     def __init__(self, cache, *, max_batch=8, prefill_chunk=32,
-                 watermark_frac=0.05):
+                 watermark_frac=0.05, spec_reserve_tokens=0):
         self.cache = cache
         self.max_batch = int(max_batch)
         self.prefill_chunk = int(prefill_chunk)
+        # speculative decoding: one verify burst appends up to
+        # spec_reserve_tokens+1 slots per running lane, so admission
+        # charges every request's worst-case ROUND growth (not just +1)
+        # — a verify burst must never preempt a running decode
+        self.spec_reserve_tokens = int(spec_reserve_tokens)
         self.watermark_pages = max(
             1, math.ceil(watermark_frac * cache.allocatable_pages))
         self.waiting: deque[Request] = deque()
@@ -214,15 +220,28 @@ class Scheduler:
             r.finish_reason = "deadline"
         return expired
 
+    def worst_case_need(self, req):
+        """Uncached pages ``req`` needs to cover its history plus one
+        full decode round (1 token, or 1+spec_reserve_tokens with
+        speculative decoding on) — the admission unit."""
+        need = self.cache.pages_for(len(req.token_history()) + 1
+                                    + self.spec_reserve_tokens)
+        return max(0, need - self.cache.pages_held(req.seq_id))
+
     def _committed_pages(self):
         """Pages PROMISED to admitted requests but not yet pulled from
         the free list (their prefill chunks haven't run) — without this,
         back-to-back admissions in one iteration would all see the same
-        free count and oversubscribe the pool."""
+        free count and oversubscribe the pool. With speculative decoding
+        on, RUNNING lanes also reserve their next verify burst's
+        worst-case growth, so an admission can never eat the pages a
+        running decode is about to append into."""
         total = 0
         for r in self.prefill_queue:
-            need = self.cache.pages_for(len(r.token_history()) + 1)
-            total += max(0, need - self.cache.pages_held(r.seq_id))
+            total += self.worst_case_need(r)
+        if self.spec_reserve_tokens:
+            for r in self.running:
+                total += self.worst_case_need(r)
         return total
 
     def _admit(self, now):
@@ -242,8 +261,7 @@ class Scheduler:
             # count only UNCACHED pages: the matched prefix is already
             # held by the sequence (pages_held), so it neither gates
             # admission nor inflates the committed-page reservation
-            need = self.cache.pages_for(len(hist) + 1) \
-                - self.cache.pages_held(req.seq_id)
+            need = self.worst_case_need(req)
             if self.cache.available_pages - committed \
                     < need + self.watermark_pages:
                 break  # FIFO head-of-line: younger requests must wait too

@@ -183,6 +183,10 @@ class ServingServer:
             kw["logprobs"] = True
         if body.get("deadline_s") is not None:
             kw["deadline_s"] = float(body["deadline_s"])
+        if body.get("speculative") is not None:
+            # per-request speculative-decoding opt-out (False forces
+            # plain decode; True/absent = engine default)
+            kw["speculative"] = bool(body["speculative"])
         return kw
 
     def _piece(self, tok):

@@ -640,7 +640,7 @@ class TestServingSweep:
         for attr in ("add_request", "step", "run", "results", "metrics",
                      "cache", "scheduler", "cancel", "drain",
                      "start_drain", "draining", "release_live",
-                     "on_event", "request"):
+                     "on_event", "request", "draft", "spec_k"):
             assert hasattr(eng, attr), attr
 
     def test_frontend_server_surface(self):
@@ -669,7 +669,9 @@ class TestServingSweep:
                     "prefix_miss_pages", "prefix_evictions",
                     "queue_depth_gauge", "page_occupancy_gauge",
                     "running_gauge", "prefix_hit_rate",
-                    "cached_pages_gauge"):
+                    "cached_pages_gauge", "spec_rounds",
+                    "spec_draft_tokens", "spec_accepted_tokens",
+                    "spec_fallbacks", "spec_acceptance_rate"):
             assert key in ex, key
         assert ex["ttft_s"]["p50"] == pytest.approx(0.1)
         import json
@@ -727,7 +729,8 @@ class TestServingSweep:
                      "PADDLE_TPU_SERVING_FAULT_ERROR_RATE",
                      "PADDLE_TPU_SERVING_FAULT_SEED",
                      "PADDLE_TPU_SERVING_HOST_SAMPLE",
-                     "PADDLE_TPU_SERVING_PREFIX_CACHE"):
+                     "PADDLE_TPU_SERVING_PREFIX_CACHE",
+                     "PADDLE_TPU_SERVING_PROBE_S"):
             assert knob in doc, knob
 
 

@@ -562,3 +562,143 @@ class TestRouterBehindServer:
         finally:
             router.close()
             remote_srv.close(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# background health prober (round 12): down replicas auto-readmit
+
+
+class _ScriptedReplica:
+    """Minimal replica stub whose health status the test flips."""
+
+    def __init__(self):
+        self.status = "ok"
+        self.health_calls = 0
+
+    def start(self):
+        return self
+
+    def health(self):
+        self.health_calls += 1
+        return {"status": self.status}
+
+    @property
+    def state(self):
+        return self.status
+
+    def load(self):
+        return 0.0
+
+    def submit(self, prompt, **kw):
+        raise Unavailable("stub never admits")
+
+    def prometheus(self):
+        return ""
+
+    def drain(self, timeout=120.0):
+        return True
+
+    def resume(self):
+        return self
+
+    def fail(self, exc=None):
+        self.status = "failed"
+
+    def close(self, timeout=0.0):
+        return True
+
+
+class TestHealthProber:
+    def test_probe_now_readmits_only_recovered(self):
+        stub = _ScriptedReplica()
+        local = InProcessReplica(make_engine())
+        router = ServingRouter([stub, local], policy="round_robin",
+                               page_size=4)
+        router._down.add(0)
+        stub.status = "failed"
+        assert router.probe_now() == []           # still sick: stays down
+        assert 0 in router._down
+        stub.status = "ok"
+        assert router.probe_now() == [0]          # recovered: readmitted
+        assert 0 not in router._down
+        assert router.metrics.readmissions_total.value(replica=0) == 1
+        # draining replicas are never auto-readmitted
+        router._down.add(0)
+        router._draining.add(0)
+        assert router.probe_now() == []
+        assert 0 in router._down
+
+    def test_failed_inprocess_replica_stays_down(self):
+        """A killed in-process replica reports "failed" — the prober
+        must NOT readmit it (it needs readmit_replica with a reload)."""
+        router = make_router(2, policy="round_robin")
+        try:
+            router.kill_replica(0)
+            assert router.probe_now() == []
+            assert 0 in router._down
+        finally:
+            router.close()
+
+    def test_probe_readmits_restarted_http_replica(self):
+        """The ROADMAP round-11 item: an HTTPReplica whose remote
+        server died stays down today until manual readmission — the
+        prober re-probes it on a bounded interval and readmits once a
+        restarted server answers /healthz ok."""
+        remote_eng = make_engine()
+        remote_srv = ServingServer(remote_eng)
+        host, port = remote_srv.start()
+        local = InProcessReplica(make_engine())
+        remote = HTTPReplica(host, port)
+        router = ServingRouter([remote, local], policy="round_robin",
+                               page_size=4,
+                               probe_interval_s=0.05).start()
+        try:
+            prompts = rng_prompts(1, seed=77)
+            # kill the remote server entirely: submits to it fail over,
+            # the router marks it down
+            remote_srv.frontend.fail(ReplicaFailed("boom"))
+            remote_srv.close(timeout=10)
+            deadline = time.monotonic() + 10
+            while 0 not in router._down \
+                    and time.monotonic() < deadline:
+                got = router.submit(prompts[0],
+                                    max_new_tokens=4).result(60)
+                assert got[0]["finish_reason"] == "length"
+            assert 0 in router._down
+            # restart a fresh server on the SAME port; the prober
+            # thread readmits within its interval (poll w/ deadline)
+            remote_srv2 = ServingServer(make_engine(), port=port)
+            remote_srv2.start()
+            try:
+                deadline = time.monotonic() + 10
+                while 0 in router._down \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert 0 not in router._down, "prober never readmitted"
+                assert router.metrics.readmissions_total.value(
+                    replica=0) == 1
+                # and the readmitted replica serves traffic again
+                want = oracle_tokens(prompts, 6)
+                for _ in range(4):
+                    s = router.submit(prompts[0], max_new_tokens=6)
+                    got = [ev["token"] for ev in s.events(timeout=60)
+                           if ev["type"] == "token"]
+                    assert got == want[0]
+                assert router.metrics.routed_total.value(
+                    policy="round_robin", replica=0) > 0
+            finally:
+                remote_srv2.close(timeout=30)
+        finally:
+            router.close(timeout=30)
+
+    def test_env_knob_and_disabled_default(self, monkeypatch):
+        router = make_router(1)
+        try:
+            assert router.probe_interval_s == 0.0
+            assert router._probe_thread is None
+        finally:
+            router.close()
+        monkeypatch.setenv("PADDLE_TPU_SERVING_PROBE_S", "7.5")
+        router = ServingRouter(
+            [InProcessReplica(make_engine())], page_size=4)
+        assert router.probe_interval_s == 7.5

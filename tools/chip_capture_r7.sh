@@ -78,6 +78,13 @@ run bash tools/serving_prefix_smoke.sh
 #     (--smoke), plain XLA step programs — safe tier.
 run bash tools/serving_router_smoke.sh
 
+# 5f. batched speculative-decoding smoke (round 12): quick-trained
+#     target + h128-class draft, spec vs plain two-point marginal,
+#     greedy streams asserted token-exact. CPU-mesh by construction
+#     (--smoke), plain XLA programs (the draft-propose scan and the
+#     [B, k+1] verify step compile no Pallas) — safe tier.
+run bash tools/serving_spec_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

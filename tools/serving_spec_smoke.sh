@@ -1,0 +1,23 @@
+#!/bin/bash
+# Batched speculative-decoding smoke for the chip-capture list
+# (round 12) — SAFE tier: `--smoke` forces the CPU mesh (no device
+# probe, zero chip touch); the draft-propose scan and the [B, k+1]
+# verify step are plain XLA programs (the paged Pallas stub stays
+# interpret-gated), so NO first-time Mosaic construct can reach the
+# chip from this script.
+#
+# Quick-trains a target + h128-class 1-layer draft on the
+# deterministic successor task, replays the SAME greedy Poisson trace
+# through a non-speculative and a speculative engine (one warm engine
+# per config, two-point marginal each), asserts the greedy streams
+# token-exact across the two engines, and banks
+# BENCH_serving_spec.json with both marginal rates + the measured
+# acceptance rate.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_spec_smoke.sh > .bench_r4/serving_spec_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --spec \
+  | tee .bench_r4/serving_spec_smoke.json
