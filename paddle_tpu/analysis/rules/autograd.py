@@ -1,0 +1,182 @@
+"""Autograd invariants: the single-chokepoint rule and the round-11
+thread-local grad-mode rule (CLAUDE.md "Architecture invariants" +
+"Round-11 addenda")."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+# Modules that ARE differentiation engines: they legitimately call the
+# raw jax AD API (everything else must route through autograd.apply).
+_AD_ENGINE_FILES = {
+    "paddle_tpu/core/autograd.py",       # the chokepoint itself
+    "paddle_tpu/incubate/autograd.py",   # paddle.incubate.autograd jvp/vjp
+    "paddle_tpu/static/program.py",      # static-graph append_backward
+}
+
+_FLAGGED = {"jax.vjp", "jax.grad", "jax.custom_vjp"}
+
+
+class AutogradBypass(Rule):
+    """`jax.vjp`/`jax.grad`/`jax.custom_vjp` invoked outside the
+    autograd chokepoint in differentiable-op code.
+
+    Every differentiable op flows through ``core/autograd.py::apply``;
+    eagerly calling ``jax.vjp`` at tracers strips custom_vjp rules
+    (Pallas kernels silently fall back / remat breaks).  Allowed:
+    the AD-engine modules, ``jax.custom_vjp`` used as a decorator
+    (defining a custom rule is the blessed pattern anywhere), and
+    ``jax.vjp`` inside functions registered via ``*.defvjp(...)``
+    (a custom rule's fwd/bwd may re-trace the core)."""
+
+    id = "autograd-bypass"
+    description = ("raw jax AD API outside core.autograd.apply strips "
+                   "custom_vjp under tracing (single-chokepoint invariant)")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/")
+                and ctx.relpath not in _AD_ENGINE_FILES)
+
+    def _import_aliases(self, ctx):
+        """Names bound by `from jax import vjp/grad/custom_vjp`."""
+        aliases = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name in ("vjp", "grad", "custom_vjp"):
+                        aliases[a.asname or a.name] = f"jax.{a.name}"
+        return aliases
+
+    def _defvjp_registered(self, ctx):
+        names = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "defvjp":
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        names.add(a.id)
+        return names
+
+    def check(self, ctx):
+        aliases = self._import_aliases(ctx)
+        registered = self._defvjp_registered(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            full = aliases.get(name, name)
+            if full not in _FLAGGED:
+                continue
+            if full == "jax.custom_vjp" and ctx.in_decorator(node):
+                continue  # @functools.partial(jax.custom_vjp, ...) etc.
+            if full == "jax.vjp":
+                fn = ctx.enclosing_function(node)
+                if fn is not None and fn.name in registered:
+                    continue  # fwd/bwd of a registered custom rule
+            yield ctx.finding(
+                self.id, node,
+                f"direct `{full}` call outside the autograd chokepoint — "
+                "differentiable ops must route through "
+                "core.autograd.apply (eager vjp at tracers strips "
+                "custom_vjp rules; Pallas kernels silently fall back)")
+
+
+_GRAD_STATE_CALLS = {"set_grad_enabled"}
+_GRAD_CTX_CALLS = {"no_grad", "enable_grad"}
+
+
+class ThreadGradState(Rule):
+    """Thread/executor targets that toggle grad mode manually instead of
+    via a scoped ``with no_grad():`` block.
+
+    Round-11 incident: concurrent engine loop threads interleaving
+    save/restore of a (then process-global) grad flag disabled autograd
+    for the whole process — 23 later test files failed in-suite.  Grad
+    mode is thread-local now, but manual save/restore across statements
+    in a thread target re-creates the hazard the moment the state is
+    shared again (and relies on ambient mode that thread-locals do NOT
+    inherit from the spawning thread).  Scoped context-manager use is
+    the per-thread-safe pattern and passes."""
+
+    id = "thread-grad-state"
+    description = ("manual grad-mode toggling in a thread target "
+                   "(round-11 interleaving bug class) — use a scoped "
+                   "`with no_grad():` instead")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/")
+                or ctx.relpath.startswith("tools/"))
+
+    def _thread_targets(self, ctx):
+        """Function names used as Thread targets / executor submits."""
+        targets = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            tgt = None
+            if name.split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = kw.value
+                if tgt is None and len(node.args) >= 2:
+                    tgt = node.args[1]  # Thread(group, target, ...)
+            elif name.split(".")[-1] == "submit" and node.args:
+                tgt = node.args[0]
+            if tgt is None:
+                continue
+            if isinstance(tgt, ast.Name):
+                targets.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                targets.add(tgt.attr)  # self._loop -> "_loop"
+        return targets
+
+    def _called_names(self, fn):
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    out.add(name.split(".")[-1])
+        return out
+
+    def _violations(self, ctx, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (dotted_name(node.func) or "").split(".")[-1]
+            if name in _GRAD_STATE_CALLS:
+                yield node, name
+            elif name in _GRAD_CTX_CALLS:
+                parent = ctx.parent(node)
+                if isinstance(parent, ast.withitem) or \
+                        ctx.in_decorator(node):
+                    continue  # `with no_grad():` / decorator — scoped, safe
+                yield node, name
+
+    def check(self, ctx):
+        targets = self._thread_targets(ctx)
+        if not targets:
+            return
+        fns = ctx.functions_by_name()
+        for tname in sorted(targets):
+            fn = fns.get(tname)
+            if fn is None:
+                continue
+            # the target body plus one level of same-module callees —
+            # the round-11 loop called a helper that did the toggling
+            bodies = [(tname, fn)]
+            for callee in sorted(self._called_names(fn)):
+                if callee in fns and callee != tname:
+                    bodies.append((f"{tname} -> {callee}", fns[callee]))
+            for label, body in bodies:
+                for node, api in self._violations(ctx, body):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"thread target `{label}` calls `{api}` outside "
+                        "a scoped `with` block — manual grad-mode "
+                        "save/restore across threads is the round-11 "
+                        "interleaving bug; keep grad-mode handling "
+                        "per-thread and scoped")

@@ -1,0 +1,115 @@
+"""dist-spec-passthrough: sharding specs COMPOSE (CLAUDE.md
+architecture invariants; the round-3 7B TP4 feasibility run caught
+params at total/mp instead of total/(mp·sharding))."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+_COMPOSERS = {"_add_sharding", "_pp_param_spec"}
+
+
+def _reads_dist_spec(node):
+    """True for `<x>.dist_spec` or getattr(<x>, "dist_spec"[, d])."""
+    if isinstance(node, ast.Attribute) and node.attr == "dist_spec":
+        return True
+    if isinstance(node, ast.Call) and dotted_name(node.func) == "getattr":
+        return any(isinstance(a, ast.Constant) and a.value == "dist_spec"
+                   for a in node.args)
+    return False
+
+
+class DistSpecPassthrough(Rule):
+    """Spec functions returning a TP ``dist_spec`` verbatim.
+
+    An explicit TP ``dist_spec`` must never be returned as-is by a spec
+    function: ZeRO adds 'sharding' on the largest free divisible dim on
+    top (``spmd.py::_add_sharding`` / ``pipeline.py::_pp_param_spec``).
+    Returning it directly silently replicates TP weights across the
+    whole sharding group.  A function that calls one of the composers
+    anywhere is exempt (returning the uncomposed spec is its documented
+    no-free-dim fallback)."""
+
+    id = "dist-spec-passthrough"
+    description = ("spec function returns dist_spec verbatim instead of "
+                   "composing via _add_sharding/_pp_param_spec — TP "
+                   "weights silently replicate across the sharding group")
+
+    def applies(self, ctx):
+        return ctx.relpath.startswith("paddle_tpu/")
+
+    def _tainted_names(self, fn):
+        """Names holding (a derivative of) the raw dist_spec: the
+        literal `dist_spec` parameter plus assignments whose RHS reads
+        `.dist_spec` or an already-tainted name."""
+        tainted = {a.arg for a in fn.args.args if a.arg == "dist_spec"}
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                rhs_tainted = False
+                for sub in ast.walk(node.value):
+                    if _reads_dist_spec(sub) or (
+                            isinstance(sub, ast.Name)
+                            and isinstance(sub.ctx, ast.Load)
+                            and sub.id in tainted):
+                        rhs_tainted = True
+                        break
+                if rhs_tainted:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) \
+                                and tgt.id not in tainted:
+                            tainted.add(tgt.id)
+                            changed = True
+        return tainted
+
+    def _verbatim_return(self, ret, tainted):
+        """return <tainted> | return <x>.dist_spec |
+        return P(*<tainted>) with no other args."""
+        v = ret.value
+        if v is None:
+            return False
+        if isinstance(v, ast.Name) and v.id in tainted:
+            return True
+        if _reads_dist_spec(v):
+            return True
+        if isinstance(v, ast.Call) and len(v.args) == 1 \
+                and not v.keywords \
+                and isinstance(v.args[0], ast.Starred):
+            inner = v.args[0].value
+            if isinstance(inner, ast.Name) and inner.id in tainted:
+                return True
+            if _reads_dist_spec(inner):
+                return True
+        return False
+
+    def check(self, ctx):
+        for fn in [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and "spec" in n.name.lower()]:
+            uses_dist_spec = any(_reads_dist_spec(n)
+                                 for n in ast.walk(fn)) or \
+                any(a.arg == "dist_spec" for a in fn.args.args)
+            if not uses_dist_spec:
+                continue
+            composes = any(
+                isinstance(n, ast.Call)
+                and (dotted_name(n.func) or "").split(".")[-1]
+                in _COMPOSERS
+                for n in ast.walk(fn))
+            if composes:
+                continue
+            tainted = self._tainted_names(fn)
+            for ret in ast.walk(fn):
+                if isinstance(ret, ast.Return) \
+                        and self._verbatim_return(ret, tainted):
+                    yield ctx.finding(
+                        self.id, ret,
+                        f"spec function `{fn.name}` returns the TP "
+                        "dist_spec verbatim — compose the ZeRO/pp axis "
+                        "on top via `_add_sharding`/`_pp_param_spec`, "
+                        "or TP weights replicate across the whole "
+                        "sharding group (round-3 TP4 incident)")

@@ -1,0 +1,33 @@
+"""env-knob-registry: every PADDLE_TPU_* knob referenced in code must
+appear in the generated docs/ENV_KNOBS.md registry (knobs documented
+only in commit messages and scattered docstrings kept getting lost —
+the registry is the one greppable catalog)."""
+from __future__ import annotations
+
+from ..core import Rule
+from ..knobs import knob_literals
+
+
+class EnvKnobRegistry(Rule):
+    """Flags PADDLE_TPU_* string constants not listed in the registry.
+
+    Any full-string ``PADDLE_TPU_[A-Z0-9_]+`` constant counts as a
+    reference (environ reads, helper wrappers, env writes in tests) —
+    the same extraction drives ``tools/lint.py --gen-knobs``, so a
+    regenerated registry always satisfies this rule."""
+
+    id = "env-knob-registry"
+    description = ("PADDLE_TPU_* knob referenced in code but missing "
+                   "from the generated docs/ENV_KNOBS.md registry")
+
+    def check(self, ctx):
+        registry = ctx.project.knob_registry()
+        seen = set()
+        for knob, line in knob_literals(ctx.tree):
+            if knob in registry or (knob, line) in seen:
+                continue
+            seen.add((knob, line))
+            yield ctx.finding(
+                self.id, line,
+                f"`{knob}` is not in docs/ENV_KNOBS.md — run "
+                "`python tools/lint.py --gen-knobs` and document it")

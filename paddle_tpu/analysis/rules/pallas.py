@@ -1,0 +1,103 @@
+"""Pallas/Mosaic compile-hazard rules (CLAUDE.md round-5/round-6
+addenda: constructs with no interpret-mode lowering or O(seq) VMEM)."""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, dotted_name
+
+# Bare names that read as a sequence length when used as a BlockSpec
+# block-shape element: a block sized by one of these scales VMEM with
+# the sequence instead of staying O(block) (the 16 MB scoped-VMEM
+# invariant; stream via grid axes with output accumulation instead).
+_SEQ_NAME = re.compile(
+    r"(?i)^(s|sk|sq|skv|seq\w*|\w*seq|\w*_len|\w*len|n_ctx|ctx\w*)$")
+# short names that merely END in "len"/"s" but are clearly not lengths
+_SEQ_NAME_EXCLUDES = {"lanes", "len"}
+
+
+class PallasHazards(Rule):
+    """Three Mosaic/interpret-mode hazards in one rule:
+
+    1. ``pl.program_id`` inside a ``fori_loop``/``while_loop``/``scan``
+       body — interpret mode fails with "MLIR translation rule not
+       found"; read it at kernel top level and close over the value.
+    2. ``pltpu.prng_seed``/``pltpu.prng_random_bits`` — no
+       interpret-mode lowering; use the counter-hash (plain i32 vector
+       ops) for in-kernel RNG.
+    3. BlockSpec block shapes scaling with a sequence axis — per-
+       instance VMEM must stay O(block), never O(sequence)."""
+
+    id = "pallas-hazards"
+    description = ("program_id in loop bodies, pltpu.prng_*, and "
+                   "seq-scaled BlockSpec shapes hang or fail Mosaic/"
+                   "interpret mode")
+
+    # -- helpers -----------------------------------------------------------
+    def _loop_bodies(self, ctx):
+        """(lambda | FunctionDef) nodes passed as loop bodies."""
+        fns = ctx.functions_by_name()
+        bodies = []
+
+        def _resolve(arg):
+            if isinstance(arg, ast.Lambda):
+                bodies.append(arg)
+            elif isinstance(arg, ast.Name) and arg.id in fns:
+                bodies.append(fns[arg.id])
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (dotted_name(node.func) or "").split(".")[-1]
+            if tail == "fori_loop" and len(node.args) >= 3:
+                _resolve(node.args[2])
+            elif tail == "while_loop" and len(node.args) >= 2:
+                _resolve(node.args[1])
+            elif tail == "scan" and node.args:
+                _resolve(node.args[0])
+        return bodies
+
+    def check(self, ctx):
+        # 1. program_id inside loop bodies
+        for body in self._loop_bodies(ctx):
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call) and \
+                        (dotted_name(node.func) or "").endswith(
+                            "program_id"):
+                    yield ctx.finding(
+                        self.id, node,
+                        "`program_id` read inside a loop body — "
+                        "interpret mode has no MLIR rule for it there; "
+                        "hoist the read to kernel top level and close "
+                        "over the value")
+        # 2. pltpu.prng_*
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name.split(".")[-1] in ("prng_seed",
+                                           "prng_random_bits"):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"`{name}` has no interpret-mode lowering — "
+                        "kernels using it cannot be validated off-chip; "
+                        "use the i32 counter-hash pattern instead")
+        # 3. seq-scaled BlockSpec block shapes
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and (dotted_name(node.func) or "").endswith(
+                        "BlockSpec")
+                    and node.args
+                    and isinstance(node.args[0], ast.Tuple)):
+                continue
+            for elt in node.args[0].elts:
+                if isinstance(elt, ast.Name) \
+                        and elt.id.lower() not in _SEQ_NAME_EXCLUDES \
+                        and _SEQ_NAME.match(elt.id):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"BlockSpec block shape uses `{elt.id}` — a "
+                        "sequence-sized block makes per-instance VMEM "
+                        "O(seq), not O(block); stream via a grid axis "
+                        "with output accumulation (16 MB scoped-VMEM "
+                        "limit)")

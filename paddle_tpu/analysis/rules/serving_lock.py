@@ -1,0 +1,53 @@
+"""engine-lock-discipline: the serving engine is single-threaded behind
+ONE lock (CLAUDE.md round-9 addenda) — engine.step()/engine.cancel()
+must never run concurrently; all multi-threaded use goes through
+ServingFrontend."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+# the blessed homes of direct engine driving
+_ALLOWED_FILES = {
+    "paddle_tpu/serving/engine.py",    # the engine itself
+    "paddle_tpu/serving/frontend.py",  # owns the lock + loop thread
+}
+_ENGINE_METHODS = {"step", "cancel"}
+
+
+class EngineLockDiscipline(Rule):
+    """Direct ``engine.step()``/``engine.cancel()`` calls outside
+    ServingFrontend/engine internals.
+
+    Any new call site that drives an engine from library code races the
+    loop thread unless it holds the front-end lock; route through
+    ``ServingFrontend`` (tests and single-threaded drivers construct
+    engines directly and are out of scope — the lint CLI's tests/ scope
+    skips this rule)."""
+
+    id = "engine-lock-discipline"
+    description = ("direct engine.step()/cancel() outside "
+                   "ServingFrontend races the single engine lock")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/")
+                and ctx.relpath not in _ALLOWED_FILES)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ENGINE_METHODS):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            parts = recv.split(".")
+            if not any(p in ("engine", "eng", "_engine") for p in parts):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"direct `{recv}.{node.func.attr}()` outside "
+                "ServingFrontend — the engine is single-threaded "
+                "behind ONE lock; step()/cancel() must not run "
+                "concurrently (round-9 invariant), go through the "
+                "front-end")

@@ -1,0 +1,86 @@
+"""chip-kill-on-timeout: never kill a mid-Mosaic-compile child
+(PERF.md incident #3: a subprocess.run(timeout=600) kill of the
+monolithic on-chip test wedged the grant ~50 min and then took the
+tunnel down)."""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, dotted_name
+
+# kill-on-expiry subprocess entry points (subprocess.run & friends
+# SIGKILL the child when the timeout fires)
+_KILLING_CALLS = {"run", "check_output", "check_call", "call"}
+# the ONE killable class of chip work: bounded device-open probes
+# (CLAUDE.md round-6 addenda) — match on the enclosing function name
+_PROBE_FN = re.compile(r"(?i)(probe|usable|watch|alive|health)")
+# a file is "chip-touching" when it talks about the chip/compiler as a
+# word (paddle_tpu / PADDLE_TPU_* have no word boundary and don't match)
+_CHIP_MARKER = re.compile(r"(?i)\b(tpu|chip|mosaic|axon)\b")
+
+
+class ChipKillOnTimeout(Rule):
+    """``subprocess.run(..., timeout=)``/``check_output`` kill semantics
+    and explicit SIGKILLs in chip-touching tools/tests.
+
+    The blessed pattern is Popen + ``communicate(timeout=)`` +
+    SIGTERM-with-grace, leaving an unresponsive child to finish
+    detached (``test_tpu_chip.py::_run_on_chip``); budget 30-90 s per
+    first-time Mosaic compile when sizing timeouts.  Probe functions
+    (name matching probe/usable/watch/alive/health) are exempt — bare
+    device-open attempts are the one killable class."""
+
+    id = "chip-kill-on-timeout"
+    description = ("kill-on-timeout subprocess semantics in chip-"
+                   "touching code wedges the grant mid-Mosaic-compile "
+                   "(incident #3)")
+
+    def applies(self, ctx):
+        in_scope = (ctx.relpath.startswith(("tools/", "tests/"))
+                    or "/" not in ctx.relpath)  # repo-root drivers
+        return in_scope and bool(_CHIP_MARKER.search(ctx.source))
+
+    def _exempt(self, ctx, node):
+        fn = ctx.enclosing_function(node)
+        return fn is not None and _PROBE_FN.search(fn.name)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            tail = name.split(".")[-1]
+            has_timeout = any(kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None) for kw in node.keywords)
+            if tail in _KILLING_CALLS and "subprocess" in name \
+                    and has_timeout:
+                if self._exempt(ctx, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"`{name}(..., timeout=)` SIGKILLs the child on "
+                    "expiry — killing a mid-Mosaic-compile chip process "
+                    "wedges the grant (incident #3); use Popen + "
+                    "communicate(timeout=) + SIGTERM-with-grace, and "
+                    "leave an unresponsive child to finish detached")
+            elif tail == "kill" and isinstance(node.func, ast.Attribute) \
+                    and not node.args:
+                # p.kill() == SIGKILL; p.terminate()/SIGTERM is blessed
+                if self._exempt(ctx, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"`{name}()` sends SIGKILL — never SIGKILL a "
+                    "chip-touching child (wedges the grant); SIGTERM "
+                    "with grace, then leave it to exit on its own")
+            elif tail == "killpg":
+                if self._exempt(ctx, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    "`os.killpg` on a chip-touching process group — "
+                    "the harness-style group kill is exactly what "
+                    "wedges the grant; run chip work detached "
+                    "(setsid) and poll its log instead")

@@ -85,7 +85,7 @@ class _JitStepper:
                         t._data = arr
                     for (n, t), arr in zip(bufs, buffers):
                         t._data = arr
-                    if self.amp_level:
+                    if self.amp_level:  # graftlint: disable=jit-constant-capture (static scalar config selecting the traced branch, not arrays; weights are jit arguments)
                         # AMP inside the trace: the auto_cast op hooks
                         # emit traced casts, so the compiled program IS
                         # the mixed-precision program

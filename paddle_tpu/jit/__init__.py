@@ -106,7 +106,7 @@ def memory_analysis(fn, *example_inputs, **example_kwargs):
             # the trace binds tracers onto live params/buffers (incl.
             # in-place buffer updates like batch_norm's running stats);
             # restore so nothing leaks out of the closed trace
-            for t, arr in zip(state, saved):
+            for t, arr in zip(state, saved):  # graftlint: disable=jit-constant-capture (trace-time restore idiom: traced values arrive as jit arguments; this only restores host state after the closed trace)
                 t._data = arr
         out_tensors, _, _ = _tree_flatten_tensors(out)
         return [t._data for t in out_tensors]

@@ -55,7 +55,7 @@ def save(layer, path, input_spec=None, **config):
 
             def pure(params, buffers, *inputs):
                 saved = []
-                for (n, t), arr in zip(named,
+                for (n, t), arr in zip(named,  # graftlint: disable=jit-constant-capture (trace-time substitute/restore idiom: the arrays traced into the program are the params/buffers ARGUMENTS)
                                        list(params) + list(buffers)):
                     saved.append((t, t._data))
                 for (n, t), arr in zip(named, params + buffers):

@@ -253,12 +253,12 @@ class StaticFunction:
 
         def pure(key, *flat):
             buf_arrays = flat[:n_buf]
-            par_arrays = flat[n_buf:n_buf + n_par]
+            par_arrays = flat[n_buf:n_buf + n_par]  # graftlint: disable=jit-constant-capture (n_par is an int count; the param arrays themselves are the *flat jit arguments)
             in_arrays = flat[n_buf + n_par:]
             # snapshot live state, substitute tracers
             saved = []
             for (li, n, t), arr in zip(
-                    list(named_buffers) + list(named_params),
+                    list(named_buffers) + list(named_params),  # graftlint: disable=jit-constant-capture (trace-time substitute/restore idiom: the traced arrays are the *flat jit arguments)
                     list(buf_arrays) + list(par_arrays)):
                 saved.append((t, t._data))
                 t._data = arr

@@ -186,7 +186,7 @@ class Program:
 
             def pure(feed_arrays, leaf_arrays):
                 env = dict(zip(ordered_keys, feed_arrays))
-                env.update(zip(self._leaves.keys(), leaf_arrays))
+                env.update(zip(self._leaves.keys(), leaf_arrays))  # graftlint: disable=jit-constant-capture (keys only — the leaf ARRAYS arrive as the leaf_arrays jit argument)
                 for rec in active:
                     try:
                         args = [env[k] for k in rec.in_keys]

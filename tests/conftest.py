@@ -8,6 +8,7 @@ the reliable override, and it also keeps tests independent of the TPU
 tunnel's availability. XLA_FLAGS is still read at (lazy) backend init, so
 setting it here works.
 """
+import glob
 import importlib.util
 import os
 
@@ -46,6 +47,38 @@ def pytest_configure(config):
                 f"missing: {', '.join(missing)}. The transplant-parity "
                 "suites would silently degrade to skips — aborting the "
                 "certification run instead.")
+
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _bench_artifact_guard(request):
+    """Round-12 hazard fix (ISSUE 6 satellite): the slow
+    TestServingReplay tests run bench_serving.py in a SUBPROCESS, which
+    OVERWRITES the banked BENCH_serving*.json artifacts with numbers
+    measured under suite load (http marginal collapsed 30.9→20.0 in one
+    round-12 run).  Snapshot the artifacts around those tests and
+    restore them afterwards, deleting any the subprocess created anew —
+    re-banking a bench number must be a deliberate quiet-VM act, never a
+    suite side effect."""
+    if "TestServingReplay" not in request.node.nodeid:
+        yield
+        return
+    pattern = os.path.join(_REPO_ROOT, "BENCH_serving*.json")
+    snap = {}
+    for p in glob.glob(pattern):
+        with open(p, "rb") as f:
+            snap[p] = f.read()
+    try:
+        yield
+    finally:
+        for p, data in snap.items():
+            with open(p, "wb") as f:
+                f.write(data)
+        for p in glob.glob(pattern):
+            if p not in snap:
+                os.unlink(p)
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -1,0 +1,614 @@
+"""graftlint (paddle_tpu.analysis, ISSUE 6): every rule gets a
+bad/good fixture pair — the bad snippet reproduces the ORIGINAL bug
+shape the rule encodes (round-11 grad-mode interleaving, verbatim
+dist_spec return, incident-#3 timeout kill, ...) — plus suppression/
+baseline mechanics, the env-knob registry sync check, and a whole-tree
+self-check asserting the repo is clean modulo the checked-in baseline
+(the same invariant tools/lint.sh gates ahead of tier-1 pytest).
+
+Fast and CPU-only: pure AST work, no device touch, no jax tracing."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (ALL_RULES, BAD_BASELINE,
+                                 BAD_SUPPRESSION, Project, RULES_BY_ID,
+                                 apply_baseline, knobs, load_baseline,
+                                 run_paths, run_source, save_baseline)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_PROJECT = Project(ROOT)
+
+
+def lint(src, relpath, rule_id=None):
+    rules = [RULES_BY_ID[rule_id]] if rule_id else ALL_RULES
+    return run_source(textwrap.dedent(src), relpath, rules,
+                      project=_PROJECT)
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule registry sanity
+
+class TestRegistry:
+    def test_eight_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 8
+        for r in ALL_RULES:
+            assert r.id and r.description
+        assert set(RULES_BY_ID) == {
+            "autograd-bypass", "thread-grad-state", "pallas-hazards",
+            "jit-constant-capture", "dist-spec-passthrough",
+            "chip-kill-on-timeout", "engine-lock-discipline",
+            "env-knob-registry"}
+
+
+# ---------------------------------------------------------------------------
+# 1. autograd-bypass
+
+_AUTOGRAD_BAD = """
+    import jax
+
+    def my_op(x):
+        out, vjp_fn = jax.vjp(lambda a: a * 2, x)
+        return out
+
+    def my_grad(f, x):
+        return jax.grad(f)(x)
+"""
+
+_AUTOGRAD_GOOD = """
+    from ..core.autograd import apply
+
+    def my_op(x):
+        return apply(lambda a: a * 2, x)
+"""
+
+_AUTOGRAD_DEFVJP_GOOD = """
+    import functools
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def op(x, flag):
+        return x * 2
+
+    def _op_fwd(x, flag):
+        out, vjp_fn = jax.vjp(lambda a: a * 2, x)
+        return out, vjp_fn
+
+    def _op_bwd(flag, res, g):
+        return (res(g)[0],)
+
+    op.defvjp(_op_fwd, _op_bwd)
+"""
+
+
+class TestAutogradBypass:
+    def test_bad_flags_both_calls(self):
+        fs = lint(_AUTOGRAD_BAD, "paddle_tpu/nn/badop.py",
+                  "autograd-bypass")
+        assert len(fs) == 2
+        assert all(f.rule == "autograd-bypass" for f in fs)
+
+    def test_good_routes_through_apply(self):
+        assert lint(_AUTOGRAD_GOOD, "paddle_tpu/nn/goodop.py",
+                    "autograd-bypass") == []
+
+    def test_defvjp_registered_fwd_allowed(self):
+        # the flash-attention pattern: custom_vjp decorator + jax.vjp
+        # inside the registered fwd is the blessed kernel-rule shape
+        assert lint(_AUTOGRAD_DEFVJP_GOOD, "paddle_tpu/ops/kern.py",
+                    "autograd-bypass") == []
+
+    def test_ad_engine_files_exempt(self):
+        assert lint(_AUTOGRAD_BAD, "paddle_tpu/core/autograd.py",
+                    "autograd-bypass") == []
+
+    def test_inline_disable_suppresses(self):
+        src = _AUTOGRAD_BAD.replace(
+            "out, vjp_fn = jax.vjp(lambda a: a * 2, x)",
+            "out, vjp_fn = jax.vjp(lambda a: a * 2, x)  "
+            "# graftlint: disable=autograd-bypass (fixture: intended)")
+        fs = lint(src, "paddle_tpu/nn/badop.py", "autograd-bypass")
+        assert len(fs) == 1  # only the jax.grad one remains
+
+
+# ---------------------------------------------------------------------------
+# 2. thread-grad-state — the round-11 interleaving pattern must flag
+
+_THREAD_BAD = """
+    import threading
+    from ..core.autograd import is_grad_enabled, set_grad_enabled
+
+    def loop(engine):
+        prev = is_grad_enabled()
+        set_grad_enabled(False)   # manual save/restore across threads:
+        engine.do_step()          # the round-11 interleaving bug shape
+        set_grad_enabled(prev)
+
+    t = threading.Thread(target=loop)
+"""
+
+_THREAD_BAD_HELPER = """
+    import threading
+    from ..core.autograd import no_grad
+
+    def helper():
+        ctx = no_grad()
+        ctx.__enter__()
+
+    def loop(engine):
+        helper()
+
+    t = threading.Thread(target=loop)
+"""
+
+_THREAD_GOOD = """
+    import threading
+    from ..core.autograd import no_grad
+
+    def loop(engine):
+        with no_grad():
+            engine.do_step()
+
+    t = threading.Thread(target=loop)
+"""
+
+
+class TestThreadGradState:
+    def test_round11_interleaving_pattern_flags(self):
+        fs = lint(_THREAD_BAD, "paddle_tpu/serving/custom.py",
+                  "thread-grad-state")
+        assert len(fs) == 2  # both set_grad_enabled calls
+        assert "round-11" in fs[0].message
+
+    def test_unscoped_no_grad_in_callee_flags(self):
+        fs = lint(_THREAD_BAD_HELPER, "paddle_tpu/serving/custom.py",
+                  "thread-grad-state")
+        assert rule_ids(fs) == {"thread-grad-state"}
+
+    def test_scoped_with_block_passes(self):
+        assert lint(_THREAD_GOOD, "paddle_tpu/serving/custom.py",
+                    "thread-grad-state") == []
+
+    def test_non_thread_manual_toggle_passes(self):
+        # outside a thread target, manual toggling is main-thread code
+        src = """
+            from ..core.autograd import set_grad_enabled
+            def eval_mode():
+                set_grad_enabled(False)
+        """
+        assert lint(src, "paddle_tpu/hapi/thing.py",
+                    "thread-grad-state") == []
+
+
+# ---------------------------------------------------------------------------
+# 3. pallas-hazards
+
+_PALLAS_LOOP_BAD = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        def body(i, acc):
+            j = pl.program_id(0)
+            return acc + j
+        o_ref[...] = jax.lax.fori_loop(0, 4, body, 0)
+"""
+
+_PALLAS_LOOP_GOOD = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        j = pl.program_id(0)   # hoisted to kernel top level
+        def body(i, acc):
+            return acc + j
+        o_ref[...] = jax.lax.fori_loop(0, 4, body, 0)
+"""
+
+_PALLAS_PRNG_BAD = """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(seed_ref, o_ref):
+        pltpu.prng_seed(seed_ref[0])
+        o_ref[...] = pltpu.prng_random_bits(o_ref.shape)
+"""
+
+_PALLAS_BLOCKSPEC_BAD = """
+    from jax.experimental import pallas as pl
+
+    def build(seq_len, d, block_q):
+        return pl.BlockSpec((1, seq_len, d), lambda i, j: (i, 0, 0))
+"""
+
+_PALLAS_BLOCKSPEC_GOOD = """
+    from jax.experimental import pallas as pl
+
+    def build(seq_len, d, block_q):
+        return pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
+"""
+
+
+class TestPallasHazards:
+    def test_program_id_in_fori_loop_body_flags(self):
+        fs = lint(_PALLAS_LOOP_BAD, "paddle_tpu/ops/pallas/k.py",
+                  "pallas-hazards")
+        assert len(fs) == 1 and "program_id" in fs[0].message
+
+    def test_program_id_hoisted_passes(self):
+        assert lint(_PALLAS_LOOP_GOOD, "paddle_tpu/ops/pallas/k.py",
+                    "pallas-hazards") == []
+
+    def test_pltpu_prng_flags(self):
+        fs = lint(_PALLAS_PRNG_BAD, "paddle_tpu/ops/pallas/k.py",
+                  "pallas-hazards")
+        assert len(fs) == 2
+        assert all("interpret" in f.message for f in fs)
+
+    def test_seq_scaled_blockspec_flags(self):
+        fs = lint(_PALLAS_BLOCKSPEC_BAD, "paddle_tpu/ops/pallas/k.py",
+                  "pallas-hazards")
+        assert len(fs) == 1 and "VMEM" in fs[0].message
+
+    def test_block_sized_blockspec_passes(self):
+        assert lint(_PALLAS_BLOCKSPEC_GOOD,
+                    "paddle_tpu/ops/pallas/k.py",
+                    "pallas-hazards") == []
+
+
+# ---------------------------------------------------------------------------
+# 4. jit-constant-capture
+
+_JIT_METHOD_BAD = """
+    import jax
+
+    class Model:
+        @jax.jit
+        def step(self, x):
+            return x * self.scale
+"""
+
+_JIT_CLOSURE_SELF_BAD = """
+    import jax
+
+    class Model:
+        def compile(self):
+            def fn(x):
+                return x @ self.weight
+            return jax.jit(fn)
+"""
+
+_JIT_CLOSURE_PARAMS_BAD = """
+    import jax
+
+    def build(layer):
+        params = layer.parameters()
+        def fn(x):
+            return x + params[0]
+        return jax.jit(fn)
+"""
+
+_JIT_GOOD = """
+    import jax
+
+    def build():
+        def fn(params, x):   # weights are ARGUMENTS
+            return x + params[0]
+        return jax.jit(fn)
+"""
+
+
+class TestJitConstantCapture:
+    def test_jit_on_method_flags(self):
+        fs = lint(_JIT_METHOD_BAD, "paddle_tpu/models/m.py",
+                  "jit-constant-capture")
+        assert len(fs) == 1 and "self" in fs[0].message
+
+    def test_closure_over_self_flags(self):
+        fs = lint(_JIT_CLOSURE_SELF_BAD, "paddle_tpu/models/m.py",
+                  "jit-constant-capture")
+        assert len(fs) == 1 and "self.weight" in fs[0].message
+
+    def test_closure_over_params_flags(self):
+        fs = lint(_JIT_CLOSURE_PARAMS_BAD, "paddle_tpu/models/m.py",
+                  "jit-constant-capture")
+        assert len(fs) == 1 and "`params`" in fs[0].message
+
+    def test_weights_as_arguments_pass(self):
+        assert lint(_JIT_GOOD, "paddle_tpu/models/m.py",
+                    "jit-constant-capture") == []
+
+    def test_out_of_scope_paths_skipped(self):
+        # the rule is scoped to paddle_tpu/ — test helpers jit freely
+        assert lint(_JIT_METHOD_BAD, "tests/helper.py",
+                    "jit-constant-capture") == []
+
+
+# ---------------------------------------------------------------------------
+# 5. dist-spec-passthrough — the round-3 verbatim return must flag
+
+_DIST_BAD_ATTR = """
+    from jax.sharding import PartitionSpec as P
+
+    def param_spec(param, shape, degree):
+        return P(*param.dist_spec)
+"""
+
+_DIST_BAD_PARAM = """
+    def my_spec(dist_spec, shape):
+        return dist_spec
+"""
+
+_DIST_GOOD = """
+    from jax.sharding import PartitionSpec as P
+
+    def param_spec(param, shape, degree):
+        spec = P(*param.dist_spec)
+        composed = _add_sharding(spec, shape, degree)
+        if composed is not None:
+            return composed
+        return spec
+"""
+
+
+class TestDistSpecPassthrough:
+    def test_verbatim_attr_return_flags(self):
+        fs = lint(_DIST_BAD_ATTR, "paddle_tpu/distributed/foo.py",
+                  "dist-spec-passthrough")
+        assert len(fs) == 1 and "replicate" in fs[0].message
+
+    def test_verbatim_param_return_flags(self):
+        fs = lint(_DIST_BAD_PARAM, "paddle_tpu/distributed/foo.py",
+                  "dist-spec-passthrough")
+        assert len(fs) == 1
+
+    def test_composed_spec_passes(self):
+        assert lint(_DIST_GOOD, "paddle_tpu/distributed/foo.py",
+                    "dist-spec-passthrough") == []
+
+
+# ---------------------------------------------------------------------------
+# 6. chip-kill-on-timeout — the incident-#3 shape must flag
+
+_CHIP_BAD = '''
+    """Drives on-chip TPU snippets from subprocesses."""
+    import subprocess
+
+    def run_snippet(code):
+        return subprocess.run(["python", "-c", code], timeout=600)
+'''
+
+_CHIP_KILL_BAD = '''
+    """Chip smoke harness."""
+    import subprocess
+
+    def run_snippet(p):
+        p.kill()
+'''
+
+_CHIP_GOOD = '''
+    """Drives on-chip TPU snippets from subprocesses."""
+    import subprocess
+
+    def run_snippet(code):
+        p = subprocess.Popen(["python", "-c", code])
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.terminate()   # SIGTERM with grace, never SIGKILL
+        return p
+'''
+
+
+class TestChipKillOnTimeout:
+    def test_incident3_run_timeout_flags(self):
+        fs = lint(_CHIP_BAD, "tools/chip_thing.py",
+                  "chip-kill-on-timeout")
+        assert len(fs) == 1 and "incident #3" in fs[0].message
+
+    def test_sigkill_flags(self):
+        fs = lint(_CHIP_KILL_BAD, "tools/chip_thing.py",
+                  "chip-kill-on-timeout")
+        assert len(fs) == 1 and "SIGKILL" in fs[0].message
+
+    def test_sigterm_grace_pattern_passes(self):
+        assert lint(_CHIP_GOOD, "tools/chip_thing.py",
+                    "chip-kill-on-timeout") == []
+
+    def test_probe_functions_exempt(self):
+        src = _CHIP_BAD.replace("def run_snippet", "def probe_chip")
+        assert lint(src, "tools/chip_thing.py",
+                    "chip-kill-on-timeout") == []
+
+    def test_non_chip_file_out_of_scope(self):
+        src = '''
+            """Runs documentation helpers."""
+            import subprocess
+
+            def run_helper(code):
+                return subprocess.run(["python", "-c", code], timeout=9)
+        '''
+        assert lint(src, "tools/docs_helper.py",
+                    "chip-kill-on-timeout") == []
+
+
+# ---------------------------------------------------------------------------
+# 7. engine-lock-discipline
+
+_LOCK_BAD = """
+    class Policy:
+        def act(self, rid):
+            self.engine.cancel(rid)
+            self.engine.step()
+"""
+
+_LOCK_GOOD = """
+    class Policy:
+        def act(self, rid):
+            self.frontend.cancel(rid)
+"""
+
+
+class TestEngineLockDiscipline:
+    def test_direct_engine_calls_flag(self):
+        fs = lint(_LOCK_BAD, "paddle_tpu/serving/newpolicy.py",
+                  "engine-lock-discipline")
+        assert len(fs) == 2
+        assert all("ServingFrontend" in f.message for f in fs)
+
+    def test_frontend_calls_pass(self):
+        assert lint(_LOCK_GOOD, "paddle_tpu/serving/newpolicy.py",
+                    "engine-lock-discipline") == []
+
+    def test_frontend_file_exempt(self):
+        assert lint(_LOCK_BAD, "paddle_tpu/serving/frontend.py",
+                    "engine-lock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# 8. env-knob-registry
+
+class TestEnvKnobRegistry:
+    def test_unregistered_knob_flags(self):
+        knob = "PADDLE_TPU_" + "NOT_A_REAL_KNOB_XYZ"
+        src = f"""
+            import os
+            v = os.environ.get({knob!r})
+        """
+        fs = lint(src, "paddle_tpu/newmod.py", "env-knob-registry")
+        assert len(fs) == 1 and "ENV_KNOBS.md" in fs[0].message
+
+    def test_registered_knob_passes(self):
+        src = """
+            import os
+            v = os.environ.get("PADDLE_TPU_PAGED_KERNEL")
+        """
+        assert lint(src, "paddle_tpu/newmod.py",
+                    "env-knob-registry") == []
+
+    def test_registry_parses_nonempty(self):
+        reg = _PROJECT.knob_registry()
+        assert "PADDLE_TPU_PAGED_KERNEL" in reg
+        assert len(reg) > 25
+
+    def test_registry_in_sync_with_tree(self):
+        """Satellite: regenerating the registry (descriptions
+        preserved) must reproduce docs/ENV_KNOBS.md byte-exactly."""
+        ok, msg = knobs.check_sync(ROOT)
+        assert ok, msg
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+
+class TestSuppressions:
+    def test_disable_with_reason_suppresses(self):
+        src = _PALLAS_PRNG_BAD.replace(
+            "pltpu.prng_seed(seed_ref[0])",
+            "pltpu.prng_seed(seed_ref[0])  "
+            "# graftlint: disable=pallas-hazards (fixture reason)")
+        fs = lint(src, "paddle_tpu/ops/pallas/k.py", "pallas-hazards")
+        assert len(fs) == 1  # prng_random_bits still flagged
+
+    def test_standalone_comment_covers_next_line(self):
+        src = _PALLAS_PRNG_BAD.replace(
+            "pltpu.prng_seed(seed_ref[0])",
+            "# graftlint: disable=pallas-hazards (fixture reason)\n"
+            "        pltpu.prng_seed(seed_ref[0])")
+        fs = lint(src, "paddle_tpu/ops/pallas/k.py", "pallas-hazards")
+        assert len(fs) == 1
+
+    def test_empty_reason_is_a_finding(self):
+        src = _PALLAS_PRNG_BAD.replace(
+            "pltpu.prng_seed(seed_ref[0])",
+            "pltpu.prng_seed(seed_ref[0])  "
+            "# graftlint: disable=pallas-hazards")
+        fs = lint(src, "paddle_tpu/ops/pallas/k.py", "pallas-hazards")
+        assert BAD_SUPPRESSION in rule_ids(fs)
+
+    def test_unknown_rule_id_is_a_finding(self):
+        src = """
+            x = 1  # graftlint: disable=no-such-rule (typo fixture)
+        """
+        fs = lint(src, "paddle_tpu/newmod.py")
+        assert rule_ids(fs) == {BAD_SUPPRESSION}
+        assert "unknown rule" in fs[0].message
+
+    def test_disable_file_suppresses_whole_file(self):
+        src = ('"""Doc."""\n'
+               "# graftlint: disable-file=pallas-hazards (fixture "
+               "reason)\n" + textwrap.dedent(_PALLAS_PRNG_BAD))
+        fs = run_source(src, "paddle_tpu/ops/pallas/k.py",
+                        [RULES_BY_ID["pallas-hazards"]],
+                        project=_PROJECT)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+
+class TestBaseline:
+    def test_roundtrip_and_matching(self, tmp_path):
+        fs = lint(_DIST_BAD_PARAM, "paddle_tpu/distributed/foo.py",
+                  "dist-spec-passthrough")
+        assert len(fs) == 1
+        bpath = str(tmp_path / "baseline.json")
+        save_baseline(bpath, fs, "pre-existing debt (fixture)")
+        baseline, bad = load_baseline(bpath)
+        assert bad == []
+        new, old = apply_baseline(fs, baseline)
+        assert new == [] and len(old) == 1
+
+    def test_entry_without_reason_is_a_finding(self, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        bpath.write_text(json.dumps({"entries": [
+            {"rule": "pallas-hazards", "path": "x.py",
+             "snippet": "y", "reason": ""}]}))
+        baseline, bad = load_baseline(str(bpath))
+        assert baseline == {}
+        assert len(bad) == 1 and bad[0].rule == BAD_BASELINE
+
+    def test_checked_in_baseline_entries_valid(self):
+        """Acceptance: every baseline entry carries a rule id and a
+        non-empty reason (empty baseline trivially satisfies)."""
+        _, bad = load_baseline(
+            os.path.join(ROOT, "tools", "graftlint_baseline.json"))
+        assert bad == []
+
+
+# ---------------------------------------------------------------------------
+# whole-tree self-check + CLI
+
+class TestWholeTree:
+    def test_repo_clean_modulo_baseline(self):
+        """The tools/lint.sh gate as a test: the repo at HEAD has no
+        new findings over paddle_tpu + tools + tests."""
+        findings, stats = run_paths(["paddle_tpu", "tools", "tests"],
+                                    ROOT, ALL_RULES)
+        baseline, bad = load_baseline(
+            os.path.join(ROOT, "tools", "graftlint_baseline.json"))
+        findings.extend(bad)
+        new, _old = apply_baseline(findings, baseline)
+        assert new == [], "new graftlint findings:\n" + "\n".join(
+            str(f) for f in new)
+        assert stats["files"] > 250
+
+    def test_cli_json_smoke(self):
+        """tools/lint.py end-to-end (stub-parent import path — must
+        work in a fresh interpreter WITHOUT importing jax)."""
+        p = subprocess.run(
+            [sys.executable, os.path.join("tools", "lint.py"),
+             "--json", "paddle_tpu/analysis"],
+            cwd=ROOT, capture_output=True, text=True)
+        assert p.returncode == 0, p.stderr[-2000:]
+        out = json.loads(p.stdout)
+        assert out["findings"] == []
+        assert out["stats"]["files"] >= 10

@@ -13,15 +13,32 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_example(name, args=(), timeout=420, extra_env=None):
+    # NOT subprocess.run(timeout=): that SIGKILLs on expiry, and the
+    # sitecustomize ignores the JAX_PLATFORMS env override, so a
+    # misbehaving example may be touching the default (chip) platform
+    # when the timeout fires — killing it mid-compile wedges the grant
+    # (graftlint chip-kill-on-timeout; PERF.md incident #3). SIGTERM
+    # with grace, then leave the child to exit on its own.
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra_env or {})
-    p = subprocess.run(
+    p = subprocess.Popen(
         [sys.executable, os.path.join(_REPO, "examples", name), *args],
-        capture_output=True, text=True, timeout=timeout, cwd=_REPO,
-        env=env)
-    assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
-    return p.stdout
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO, env=env)
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.terminate()  # SIGTERM, never SIGKILL (chip hygiene)
+        try:
+            out, err = p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        pytest.fail(f"example {name} exceeded {timeout}s "
+                    "(SIGTERMed with grace; never SIGKILL a possibly "
+                    "chip-touching child)")
+    assert p.returncode == 0, (out[-1500:], err[-1500:])
+    return out
 
 
 class TestExamples:

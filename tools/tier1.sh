@@ -11,6 +11,9 @@
 # driver compares against the seed.
 set -o pipefail
 cd "$(dirname "$0")/.."
+# graftlint gate (ISSUE 6): invariant lint + env-knob registry sync
+# run ahead of the suite — a new finding fails tier-1 before pytest.
+bash tools/lint.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
