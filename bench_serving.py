@@ -4,7 +4,19 @@ model and report throughput + latency.
 
 Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
-                               [--router] [--spec]
+                               [--router] [--spec] [--disagg]
+
+`--disagg` replays a MIXED workload — TTFT-heavy requests (long
+prompt, 4-token decode) interleaved with TPOT-heavy ones (short
+prompt, full decode budget) on one Poisson arrival process — through
+TWO fleet topologies of identical size: 1 prefill + 2 decode replicas
+behind a DisaggRouter (prefill-only admission, KV page migration,
+spliced streams) vs 3 mixed replicas behind the round-11 least-loaded
+router. Two-point marginal per topology (quarter vs full decode
+budget on the SAME trace); client-side TTFT percentiles are reported
+PER CLASS — the disagg claim is that the TTFT-heavy burst stops
+queueing behind running decodes. Streams are asserted complete and
+migration/fallback counters are banked. BENCH_serving_disagg.json.
 
 `--spec` measures batched speculative decoding in the engine: a target
 and an h128-class 1-layer draft are quick-trained on a deterministic
@@ -82,6 +94,9 @@ if router_mode:
 spec_mode = "--spec" in sys.argv
 if spec_mode:
     sys.argv.remove("--spec")
+disagg_mode = "--disagg" in sys.argv
+if disagg_mode:
+    sys.argv.remove("--disagg")
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -205,7 +220,7 @@ def main():
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     prefix_len = 96  # shared-prefix mode: 6 pages of 16
     maxlen = (prefix_len + 16 if prefix_mode or router_mode
-              else 64) + max_new + 1
+              or disagg_mode else 64) + max_new + 1
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
@@ -235,6 +250,9 @@ def main():
         return
     if spec_mode:
         _bench_speculative(on_tpu)
+        return
+    if disagg_mode:
+        _bench_disagg(cfg, engine_kw, on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -550,6 +568,177 @@ def _bench_router(cfg, engine_kw, on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_router.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_disagg(cfg, engine_kw, on_tpu):
+    """Disaggregated (1 prefill + 2 decode) vs symmetric (3 mixed)
+    fleet on a mixed TTFT-heavy + TPOT-heavy Poisson workload.
+
+    TTFT-heavy class: 96-token prompt, 4 decode tokens (the
+    agent-burst shape that stalls a symmetric fleet's decode loop).
+    TPOT-heavy class: 8-16 token prompt, the full decode budget (the
+    steady streams whose TPOT the bursts degrade).  Same trace, same
+    models, same total replica count; two-point marginal per topology
+    (quarter vs full decode budget); TTFT percentiles client-side and
+    per class.  One JSON line -> BENCH_serving_disagg.json."""
+    import threading
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serving import (DisaggRouter, InProcessReplica,
+                                    ServingEngine, ServingRouter)
+
+    ttft_prompt_len = 96
+    ttft_decode = 4
+    rng = np.random.default_rng(7)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    kinds = rng.random(n_requests) < 0.5      # half each class
+    prompts = [
+        (rng.integers(0, cfg.vocab_size, ttft_prompt_len)
+         if heavy else
+         rng.integers(0, cfg.vocab_size, int(rng.integers(8, 17))))
+        .astype(np.int32)
+        for heavy in kinds]
+    new_q = max(1, max_new // 4)
+
+    def budgets(decode_budget):
+        return [ttft_decode if heavy else decode_budget
+                for heavy in kinds]
+
+    def make_fleet(disagg):
+        replicas, roles = [], (("prefill", "decode", "decode")
+                               if disagg else ("mixed",) * 3)
+        for role in roles:
+            P.seed(0)
+            m = LlamaForCausalLM(cfg)
+            if on_tpu:
+                m.to(dtype="bfloat16")
+            m.eval()
+            eng = ServingEngine(m, **dict(engine_kw,
+                                          prefix_cache=True))
+            replicas.append(InProcessReplica(
+                eng, max_queued=len(prompts) + 8, role=role))
+        if disagg:
+            return DisaggRouter(replicas,
+                                page_size=engine_kw["page_size"])
+        return ServingRouter(replicas, policy="least_loaded",
+                             page_size=engine_kw["page_size"])
+
+    def warm(router):
+        # every replica compiles its bucketed program classes OFF the
+        # clock (single-threaded, router unstarted), then the prefix
+        # trees are flushed so the measured replay starts cold
+        warm_rng = np.random.default_rng(1234)
+        for rep in router.replicas:
+            for budget in (ttft_decode, new_q, max_new):
+                # 8 concurrent requests per budget: every decode batch
+                # bucket (1..max_batch) compiles off the clock — the
+                # quarter replay must never eat a first-call trace
+                for _ in range(8):
+                    p = warm_rng.integers(
+                        0, cfg.vocab_size,
+                        int(warm_rng.integers(8, 97))).astype(np.int32)
+                    rep.engine.add_request(p, max_new_tokens=budget)
+                rep.engine.run()
+            rep.engine.cache.clear_prefix()
+        return router.start()
+
+    def replay_fleet(router, decode_budget):
+        """Thread-per-request replay; returns (wall, tokens, per-class
+        client TTFT lists)."""
+        buds = budgets(decode_budget)
+        ttfts = [None] * len(prompts)
+        counts = [0] * len(prompts)
+        errors = []
+        t0 = time.perf_counter()
+
+        def fire(i, due, prompt):
+            time.sleep(max(0.0, due - (time.perf_counter() - t0)))
+            try:
+                sub = time.perf_counter()
+                stream = router.submit(prompt,
+                                       max_new_tokens=buds[i])
+                for ev in stream.events(timeout=600):
+                    if ev["type"] == "token":
+                        if ttfts[i] is None:
+                            ttfts[i] = time.perf_counter() - sub
+                        counts[i] += 1
+            except Exception as e:
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=fire, args=(i, a, p),
+                                    daemon=True)
+                   for i, (a, p) in enumerate(zip(arrivals, prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:4]
+        assert all(c == b for c, b in zip(counts, buds)), \
+            list(zip(counts, buds))[:8]
+        return wall, sum(counts), ttfts
+
+    def pct(values, p):
+        vals = sorted(v for v in values if v is not None)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1,
+                              int(len(vals) * p / 100))], 4)
+
+    def measure(disagg):
+        router = warm(make_fleet(disagg))
+        wall_q, toks_q, _ = replay_fleet(router, new_q)
+        for rep in router.replicas:
+            rep.engine.cache.clear_prefix()
+        wall, toks, ttfts = replay_fleet(router, max_new)
+        heavy_ttft = [t for t, h in zip(ttfts, kinds) if h]
+        steady_ttft = [t for t, h in zip(ttfts, kinds) if not h]
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        out = {
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_heavy_p50_s": pct(heavy_ttft, 50),
+            "ttft_heavy_p99_s": pct(heavy_ttft, 99),
+            "ttft_steady_p50_s": pct(steady_ttft, 50),
+        }
+        if disagg:
+            out.update(
+                migrations=router.metrics.migrations_total.value,
+                migrated_pages=router.metrics
+                .migrated_pages_total.value,
+                migration_fallbacks=router.metrics
+                .migration_fallbacks_total.value)
+        router.close()
+        return out
+
+    mixed = measure(False)
+    dis = measure(True)
+    out = {
+        "metric": "serving_disagg_ttft_heavy_p50_s"
+                  + ("" if on_tpu else "_cpu"),
+        "value": dis["ttft_heavy_p50_s"],
+        "unit": "s (mixed TTFT/TPOT workload, 1 prefill + 2 decode "
+                "replicas w/ KV page migration; compare "
+                "mixed_fleet.ttft_heavy_p50_s on 3 mixed replicas)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "ttft_prompt_tokens": ttft_prompt_len,
+        "ttft_decode_tokens": ttft_decode,
+        "disagg_fleet": dis, "mixed_fleet": mixed,
+        "ttft_p50_speedup": (
+            round(mixed["ttft_heavy_p50_s"]
+                  / dis["ttft_heavy_p50_s"], 2)
+            if dis["ttft_heavy_p50_s"] else None),
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_disagg.json", "w") as f:
         f.write(line + "\n")
 
 

@@ -12,7 +12,7 @@ from .dist_spec import DistSpecPassthrough
 from .env_knobs import EnvKnobRegistry
 from .jit_capture import JitConstantCapture
 from .pallas import PallasHazards
-from .serving_lock import EngineLockDiscipline
+from .serving_lock import EngineLockDiscipline, PageMigrationLock
 from .subprocess_chip import ChipKillOnTimeout
 
 ALL_RULES = [
@@ -23,6 +23,7 @@ ALL_RULES = [
     DistSpecPassthrough(),
     ChipKillOnTimeout(),
     EngineLockDiscipline(),
+    PageMigrationLock(),
     EnvKnobRegistry(),
 ]
 
@@ -31,4 +32,5 @@ RULES_BY_ID = {r.id: r for r in ALL_RULES}
 __all__ = ["ALL_RULES", "RULES_BY_ID", "AutogradBypass",
            "ThreadGradState", "PallasHazards", "JitConstantCapture",
            "DistSpecPassthrough", "ChipKillOnTimeout",
-           "EngineLockDiscipline", "EnvKnobRegistry"]
+           "EngineLockDiscipline", "PageMigrationLock",
+           "EnvKnobRegistry"]

@@ -1,7 +1,16 @@
 """engine-lock-discipline: the serving engine is single-threaded behind
 ONE lock (CLAUDE.md round-9 addenda) — engine.step()/engine.cancel()
 must never run concurrently; all multi-threaded use goes through
-ServingFrontend."""
+ServingFrontend.
+
+page-migration-lock (round 14): the same lock also guards KV page
+migration — import_pages/export_pages scatter into (and fetch from)
+the SAME device buffers the compiled step program is about to swap, so
+an import racing a step silently loses whole pages of K/V.  Direct
+engine/cache-level migration calls belong in kv_cache.py (the
+allocator), engine.py (the driver) and frontend.py (the lock owner);
+everything else — router, disagg tier, server handlers, autoscaler —
+must go through the ServingFrontend methods."""
 from __future__ import annotations
 
 import ast
@@ -14,6 +23,17 @@ _ALLOWED_FILES = {
     "paddle_tpu/serving/frontend.py",  # owns the lock + loop thread
 }
 _ENGINE_METHODS = {"step", "cancel"}
+
+# direct page-migration mutators (cache/engine level); replica- and
+# frontend-level wrappers of the same names are lock-taking and fine —
+# the receiver filter below tells them apart
+_MIGRATION_FILES = _ALLOWED_FILES | {
+    "paddle_tpu/serving/kv_cache.py",  # the allocator itself
+}
+_MIGRATION_METHODS = {"import_pages", "export_pages", "adopt_request",
+                      "export_request", "release_request"}
+_ENGINE_RECEIVERS = ("engine", "eng", "_engine", "cache", "_cache",
+                     "kv_cache", "_draft_cache")
 
 
 class EngineLockDiscipline(Rule):
@@ -51,3 +71,40 @@ class EngineLockDiscipline(Rule):
                 "behind ONE lock; step()/cancel() must not run "
                 "concurrently (round-9 invariant), go through the "
                 "front-end")
+
+
+class PageMigrationLock(Rule):
+    """Engine/cache-level KV page migration calls outside the
+    allocator, the engine, and the lock-owning front-end.
+
+    A page import/export mutates the cache's device buffers and host
+    bookkeeping; racing the step loop silently corrupts K/V.  Library
+    code must call the ``ServingFrontend`` migration methods (which
+    hold the engine lock) — never ``cache.import_pages`` /
+    ``engine.adopt_request`` directly."""
+
+    id = "page-migration-lock"
+    description = ("direct cache/engine page-migration calls outside "
+                   "the frontend lock corrupt in-flight step buffers")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/")
+                and ctx.relpath not in _MIGRATION_FILES)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MIGRATION_METHODS):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            parts = recv.split(".")
+            if not any(p in _ENGINE_RECEIVERS for p in parts):
+                continue  # replica/frontend wrapper: lock-taking
+            yield ctx.finding(
+                self.id, node,
+                f"direct `{recv}.{node.func.attr}()` outside the "
+                "front-end lock — page migration shares the engine "
+                "lock with the step loop (round-14 invariant); go "
+                "through ServingFrontend.probe_prefix/export_request/"
+                "release_request/adopt")

@@ -43,18 +43,33 @@ Layers:
   admission, rolling drain + weight-reload re-admit, and a merged
   ``replica``-labelled /metrics.
 
+- :mod:`disagg` / :mod:`pagewire` / :mod:`autoscale` — the
+  disaggregated tier (round 14): ``DisaggRouter`` routes admissions to
+  prefill-role replicas (``prefill_only`` requests hold their pages at
+  the first token), migrates the KV page chain to a decode-role
+  replica (radix tree as transfer index — only the uncached suffix
+  moves; in-process array handoff or the ``/v1/_pages`` wire format),
+  and splices the streams token-exactly; ``FleetAutoscaler`` grows the
+  fleet from a replica factory and shrinks it through the rolling
+  drain, driven by reserved-page load + TTFT histogram windows.
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
 """
 from .attention import paged_attention, paged_attention_ref  # noqa: F401
+from .autoscale import FleetAutoscaler  # noqa: F401
+from .disagg import DisaggRouter, DisaggStream  # noqa: F401
 from .engine import (EngineDraining, FaultInjected,  # noqa: F401
                      ServingEngine)
 from .frontend import (Rejected, RequestStream,  # noqa: F401
                        ServingFrontend, Unavailable)
-from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache  # noqa: F401
+from .kv_cache import (SCRATCH_PAGE, GeometryMismatch,  # noqa: F401
+                       OutOfPages, PagedKVCache, PrefixDrift)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       LabeledCounter, ServingMetrics)
+from .pagewire import (WireFormatError, deserialize_pages,  # noqa: F401
+                       serialize_pages)
 from .replica import (HTTPReplica, InProcessReplica,  # noqa: F401
                       ReplicaFailed)
 from .router import RouterStream, ServingRouter  # noqa: F401
@@ -73,4 +88,7 @@ __all__ = [
     "ServingServer",
     "ServingRouter", "RouterStream", "InProcessReplica", "HTTPReplica",
     "ReplicaFailed",
+    "DisaggRouter", "DisaggStream", "FleetAutoscaler",
+    "GeometryMismatch", "PrefixDrift", "WireFormatError",
+    "serialize_pages", "deserialize_pages",
 ]

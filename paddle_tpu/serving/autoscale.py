@@ -1,0 +1,275 @@
+"""Metrics-driven fleet autoscaling for the (disaggregated) router.
+
+A policy loop over the observability the serving tier already exports —
+per-replica outstanding page reservations (``replica.load()``, the same
+number ``/healthz`` shows as ``reserved_pages``) and the cumulative
+Prometheus TTFT histograms in the router's merged ``/metrics`` — that
+grows the fleet through a replica-factory callback and shrinks it
+through the existing rolling-drain path
+(:meth:`ServingRouter.retire_replica`: drain → zero lost requests →
+close), per role and with hysteresis:
+
+- **Scale up** a role when its mean reserved pages per routable replica
+  stays above ``up_pages`` for ``up_window_s`` seconds, or when the
+  fraction of requests whose TTFT exceeded ``ttft_slo_s`` in the last
+  window stays above ``slo_breach_frac`` — sustained pressure, not a
+  blip.  A role below its ``min`` floor is repaired immediately (no
+  hysteresis: a dead-fleet window is an outage, not noise).
+- **Scale down** when the role's mean load stays below ``down_pages``
+  for ``down_window_s`` seconds and it sits above its ``min``; the
+  least-loaded replica is retired through the rolling drain, so no
+  in-flight request is lost and no admission 5xxs.
+
+Everything is deterministic and unit-testable: the loop never reads
+wall time directly — ``clock=`` injects the time source (tests use a
+fake clock plus scripted replica loads), ``tick()`` runs one
+evaluation synchronously, and ``start()`` merely calls ``tick()`` on
+``interval_s`` in a daemon thread.
+
+Env knobs (constructor args win; see docs/ENV_KNOBS.md):
+``PADDLE_TPU_SERVING_AUTOSCALE_S`` (loop interval, 0/unset = manual
+ticks only), ``PADDLE_TPU_SERVING_AUTOSCALE_UP_PAGES``,
+``PADDLE_TPU_SERVING_AUTOSCALE_DOWN_PAGES``,
+``PADDLE_TPU_SERVING_AUTOSCALE_UP_S``,
+``PADDLE_TPU_SERVING_AUTOSCALE_DOWN_S``,
+``PADDLE_TPU_SERVING_AUTOSCALE_TTFT_SLO_S`` (unset disables the TTFT
+signal), ``PADDLE_TPU_SERVING_AUTOSCALE_MIN`` /
+``PADDLE_TPU_SERVING_AUTOSCALE_MAX`` (an integer for every role, or
+``"prefill:1,decode:2"``).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+
+__all__ = ["FleetAutoscaler", "parse_role_spec"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+_TTFT_BUCKET_RE = re.compile(
+    r'^paddle_tpu_serving_ttft_s_bucket\{[^}]*le="([^"]+)"[^}]*\} '
+    r'(\d+)$', re.M)
+
+
+def parse_role_spec(spec, default):
+    """``"3"`` → every role 3; ``"prefill:1,decode:2"`` → per-role
+    with ``default`` for unnamed roles."""
+    if spec is None or spec == "":
+        return {"__default__": int(default)}
+    if isinstance(spec, int):
+        return {"__default__": int(spec)}
+    if isinstance(spec, dict):
+        out = {str(k): int(v) for k, v in spec.items()}
+        out.setdefault("__default__", int(default))
+        return out
+    spec = str(spec)
+    if ":" not in spec:
+        return {"__default__": int(spec)}
+    out = {"__default__": int(default)}
+    for part in spec.split(","):
+        role, _, n = part.partition(":")
+        role, n = role.strip(), n.strip()
+        if not role or not n:
+            raise ValueError(f"bad role spec segment {part!r}")
+        out[role] = int(n)
+    return out
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else float(default)
+
+
+class FleetAutoscaler:
+    """Grows/shrinks a router's fleet per role from scripted-testable
+    signals.  ``factory(role)`` must return an UNSTARTED replica
+    (``router.add_replica`` starts it when the router is live)."""
+
+    def __init__(self, router, factory, *, clock=None, interval_s=None,
+                 min_per_role=None, max_per_role=None, up_pages=None,
+                 down_pages=None, up_window_s=None, down_window_s=None,
+                 ttft_slo_s=None, slo_breach_frac=0.1):
+        self.router = router
+        self.factory = factory
+        self.clock = clock if clock is not None else time.monotonic
+        self.interval_s = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_S", 0.0)
+            if interval_s is None else float(interval_s))
+        self.min_per_role = parse_role_spec(
+            min_per_role
+            if min_per_role is not None
+            else os.environ.get("PADDLE_TPU_SERVING_AUTOSCALE_MIN"), 0)
+        self.max_per_role = parse_role_spec(
+            max_per_role
+            if max_per_role is not None
+            else os.environ.get("PADDLE_TPU_SERVING_AUTOSCALE_MAX"), 8)
+        self.up_pages = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_UP_PAGES", 48.0)
+            if up_pages is None else float(up_pages))
+        self.down_pages = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_DOWN_PAGES", 8.0)
+            if down_pages is None else float(down_pages))
+        self.up_window_s = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_UP_S", 10.0)
+            if up_window_s is None else float(up_window_s))
+        self.down_window_s = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_DOWN_S", 60.0)
+            if down_window_s is None else float(down_window_s))
+        if ttft_slo_s is None:
+            env = os.environ.get(
+                "PADDLE_TPU_SERVING_AUTOSCALE_TTFT_SLO_S")
+            ttft_slo_s = float(env) if env not in (None, "") else None
+        self.ttft_slo_s = ttft_slo_s
+        self.slo_breach_frac = float(slo_breach_frac)
+        self._since: dict[tuple, float] = {}  # (role, dir) -> held since
+        self._ttft_prev: dict[str, int] = {}  # le -> cumulative count
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- limits ------------------------------------------------------------
+    def _limit(self, table, role):
+        return int(table.get(role, table["__default__"]))
+
+    def managed_roles(self):
+        roles = {r for r in self.router.roles}
+        roles |= {r for r in self.min_per_role if r != "__default__"}
+        roles |= {r for r in self.max_per_role if r != "__default__"}
+        return sorted(roles)
+
+    # -- signals -----------------------------------------------------------
+    def _role_state(self, role):
+        """(routable indexes, mean reserved pages) for a role."""
+        router = self.router
+        idxs = [i for i in router._routable()
+                if router.roles[i] == role]
+        loads = []
+        for i in idxs:
+            try:
+                loads.append(float(router.replicas[i].load()))
+            except Exception:
+                loads.append(0.0)
+        mean = sum(loads) / len(loads) if loads else 0.0
+        return idxs, loads, mean
+
+    def ttft_breach_frac(self):
+        """Fraction of requests finishing prefill ABOVE the TTFT SLO in
+        the window since the last call, from the cumulative
+        ``ttft_s_bucket`` histogram lines of the router's merged
+        /metrics (summed across replicas — cumulative buckets are the
+        aggregatable form, which is why round 11 switched to them).
+        None when the signal is disabled or the window saw no
+        traffic."""
+        if self.ttft_slo_s is None:
+            return None
+        try:
+            text = self.router.prometheus()
+        except Exception:
+            return None
+        totals: dict[str, int] = {}
+        for le, count in _TTFT_BUCKET_RE.findall(text):
+            totals[le] = totals.get(le, 0) + int(count)
+        prev, self._ttft_prev = self._ttft_prev, totals
+        d_inf = totals.get("+Inf", 0) - prev.get("+Inf", 0)
+        if d_inf <= 0:
+            return None
+        # the tightest bucket bound covering the SLO (conservative:
+        # requests inside it count as within-SLO)
+        bounds = sorted((float(le), le) for le in totals
+                        if le != "+Inf")
+        le_slo = None
+        for bound, le in bounds:
+            if bound >= self.ttft_slo_s:
+                le_slo = le
+                break
+        if le_slo is None:
+            return 0.0  # SLO beyond the largest bucket: nothing breaches
+        d_ok = totals.get(le_slo, 0) - prev.get(le_slo, 0)
+        return max(0.0, 1.0 - d_ok / d_inf)
+
+    # -- policy ------------------------------------------------------------
+    def _held_for(self, key, condition, now, window):
+        """Hysteresis: True once ``condition`` has held continuously
+        for ``window`` seconds (tracked via first-seen timestamps)."""
+        if not condition:
+            self._since.pop(key, None)
+            return False
+        since = self._since.setdefault(key, now)
+        return (now - since) >= window
+
+    def tick(self):
+        """One policy evaluation.  Returns the scale events applied:
+        ``[("up"|"down", role, replica_idx), ...]``."""
+        now = self.clock()
+        breach = self.ttft_breach_frac()
+        events = []
+        for role in self.managed_roles():
+            idxs, loads, mean = self._role_state(role)
+            n = len(idxs)
+            lo = self._limit(self.min_per_role, role)
+            hi = self._limit(self.max_per_role, role)
+            if n < lo:
+                # below the floor: repair immediately, no hysteresis
+                events.append(("up", role, self._scale_up(role)))
+                self._since.pop((role, "up"), None)
+                continue
+            pressured = mean > self.up_pages or (
+                breach is not None and breach > self.slo_breach_frac)
+            if n < hi and self._held_for((role, "up"), pressured, now,
+                                         self.up_window_s):
+                events.append(("up", role, self._scale_up(role)))
+                self._since.pop((role, "up"), None)
+                continue
+            idle = mean < self.down_pages and not pressured
+            if n > lo and self._held_for((role, "down"), idle, now,
+                                         self.down_window_s):
+                victim = min(zip(loads, idxs))[1]
+                self._scale_down(role, victim)
+                events.append(("down", role, victim))
+                self._since.pop((role, "down"), None)
+        return events
+
+    def _scale_up(self, role):
+        replica = self.factory(role)
+        i = self.router.add_replica(replica, role=role)
+        self.router.metrics.autoscale_events.inc(direction="up",
+                                                 role=role)
+        _log.info(json.dumps({"event": "autoscale_up", "role": role,
+                              "replica": i}))
+        return i
+
+    def _scale_down(self, role, i):
+        # rolling drain: zero lost requests, zero 5xx — retire blocks
+        # this tick until the replica finished its in-flight work
+        self.router.retire_replica(i)
+        self.router.metrics.autoscale_events.inc(direction="down",
+                                                 role=role)
+        _log.info(json.dumps({"event": "autoscale_down", "role": role,
+                              "replica": i}))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Spin the policy loop (daemon) at ``interval_s``; a
+        non-positive interval means manual ``tick()`` only."""
+        if self.interval_s <= 0 or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - loop must not die
+                _log.exception("autoscaler tick failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,314 @@
+"""Disaggregated prefill/decode serving — split-phase routing with KV
+page migration.
+
+Production TPU serving separates the two generation phases because
+their compute profiles differ (PAPERS.md Gemma-on-TPU): prefill is a
+throughput-shaped batch matmul burst that sets TTFT, decode is a
+latency-shaped steady stream that sets TPOT — on a symmetric fleet
+they contend for the same step loop, so a TTFT-heavy burst stalls
+every running stream.  :class:`DisaggRouter` splits them across
+replica ROLES (advertised in ``/healthz``):
+
+1. **Prefill** — admissions route to the least-loaded ``prefill``
+   replica as ``prefill_only`` requests: chunked prefill runs to
+   completion, the FIRST token is sampled (TTFT is the prefill
+   replica's number) and the request is HELD — finish reason
+   ``"prefilled"``, pages kept resident for export.  A prefill-only
+   reservation is ``prompt+1`` pages, never ``prompt+max_new``, so a
+   dedicated prefill replica admits bursts a mixed replica would shed.
+2. **Migration** — the held sequence's KV page chain moves to the
+   least-loaded ``decode`` replica
+   (:meth:`PagedKVCache.export_pages` / ``import_pages``; in-process:
+   array handoff, HTTP: the ``/v1/_pages`` endpoint).  The radix
+   prefix tree is the TRANSFER INDEX: the destination is probed first
+   and already-resident shared prefix pages are skipped — only the
+   uncached suffix crosses the wire.  ``PrefixDrift`` (the
+   destination's tree changed between probe and import) re-exports
+   with the corrected skip and retries, bounded by
+   ``PADDLE_TPU_SERVING_MIGRATE_RETRIES``.
+3. **Decode** — the destination adopts the sequence
+   (``adopt_request``: import + enter RUNNING, no prefill) and the
+   router splices the two streams token-exactly: token ``t`` is pure
+   in ``(weights, history, seed, t)`` (the PR-3 contract), the
+   ``device_seed`` rides in the export meta, so the handoff point is
+   invisible in the token stream — testable against a single-engine
+   ``engine.run`` oracle in greedy AND seeded-sampled modes.
+
+Failure at ANY point falls back to re-prefill on a survivor through
+the existing failover path (delivered tokens spliced out); a
+degenerate fleet — no routable prefill or no routable decode replica,
+or an ``n>1`` fork request — routes mixed-mode through the base
+:class:`ServingRouter` placement, so the disagg tier degrades to the
+round-11 symmetric fleet, never to an outage.
+
+Env knobs: ``PADDLE_TPU_SERVING_MIGRATE_RETRIES`` (PrefixDrift
+re-export attempts per destination, default 2);
+``PADDLE_TPU_SERVING_ROLE`` (a front-end's advertised role).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from .frontend import ROLES, Rejected, Unavailable
+from .kv_cache import GeometryMismatch, PrefixDrift
+from .replica import ReplicaFailed
+from .router import RouterStream, ServingRouter
+
+__all__ = ["DisaggRouter", "DisaggStream"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+# kwargs that continue a migrated request on the decode replica
+# (everything else — n, prefill_only — is placement-time only)
+_ADOPT_KEYS = ("do_sample", "temperature", "top_k", "top_p", "seed",
+               "logprobs", "request_id", "deadline_s", "speculative")
+
+
+class DisaggStream(RouterStream):
+    """One client stream spanning the prefill replica, the migration,
+    and the decode replica.  The ``"prefilled"`` finish event is the
+    handoff trigger, never a client event; everything else behaves
+    like :class:`RouterStream` (splice bookkeeping carries across
+    phases, so failover-replayed tokens are dropped exactly once)."""
+
+    def __init__(self, router, req_id, prompt, kwargs, n):
+        super().__init__(router, req_id, prompt, kwargs, n)
+        self.phase = None        # prefill | decode | mixed
+        self.migrations = 0
+
+    def events(self, timeout=120.0, idle_s=None):
+        while not self.done:
+            try:
+                migrate = False
+                for ev in self._inner.events(timeout=timeout,
+                                             idle_s=idle_s):
+                    if ev["type"] == "idle":
+                        yield ev
+                        continue
+                    idx = ev.get("index", 0)
+                    if self._finished[idx]:
+                        continue
+                    if ev["type"] == "token":
+                        if self._skip[idx] > 0:
+                            self._skip[idx] -= 1   # splice: drop replay
+                            continue
+                        self._delivered[idx] += 1
+                        self.router._token_delivered(self.replica_idx)
+                        yield ev
+                    elif ev["type"] == "finish":
+                        if ev.get("reason") == "prefilled":
+                            # handoff boundary — the decode stream
+                            # continues this sample, the client never
+                            # sees a finish here
+                            migrate = True
+                            break
+                        self._finished[idx] = True
+                        yield ev
+                if migrate:
+                    self.router._migrate(self)
+                    continue
+                break
+            except TimeoutError:
+                raise
+            except RuntimeError as exc:  # replica death, either phase
+                self.router._failover(self, exc)
+        self.router._stream_done(self)
+
+
+class DisaggRouter(ServingRouter):
+    """A :class:`ServingRouter` that routes by replica role and splices
+    prefill → decode via KV page migration.  Same client surface
+    (``submit``/``cancel``/``health``/``prometheus``/``drain``), so a
+    ``ServingServer`` fronts a disaggregated fleet unchanged."""
+
+    stream_cls = DisaggStream
+
+    def __init__(self, replicas, *, roles=None, migrate_retries=None,
+                 **kw):
+        super().__init__(replicas, **kw)
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(self.replicas):
+                raise ValueError(
+                    f"{len(roles)} role(s) for {len(self.replicas)} "
+                    "replica(s)")
+            for r in roles:
+                if r not in ROLES:
+                    raise ValueError(
+                        f"unknown role {r!r}; one of {ROLES}")
+            self.roles = roles
+        if migrate_retries is None:
+            migrate_retries = int(os.environ.get(
+                "PADDLE_TPU_SERVING_MIGRATE_RETRIES", "2") or 2)
+        self.migrate_retries = max(1, int(migrate_retries))
+
+    # -- role-aware placement ----------------------------------------------
+    def _role_idxs(self, roles, exclude=()):
+        return [i for i in self._routable(exclude)
+                if self.roles[i] in roles]
+
+    def _by_load(self, idxs):
+        loads = self._loads(idxs)
+        return sorted(idxs, key=lambda i: (loads[i], i))
+
+    def _place(self, stream, exclude):
+        """Disagg placement: least-loaded PREFILL replica, prefill-only
+        admission.  Falls back to the base (mixed) placement on a
+        degenerate fleet — no routable prefill or decode replica — and
+        for n>1 fork requests (forks are created at prefill completion,
+        which disagg moves across replicas)."""
+        prefills = self._role_idxs(("prefill",), exclude)
+        decodes = self._role_idxs(("decode",), exclude)
+        if not prefills or not decodes \
+                or int(stream.kwargs.get("n", 1)) > 1:
+            stream.phase = "mixed"
+            return super()._place(stream, exclude)
+        stream.phase = "prefill"
+        sheds = []
+        for idx in self._by_load(prefills):
+            try:
+                inner = self.replicas[idx].submit(
+                    stream.prompt, prefill_only=True, **stream.kwargs)
+            except Rejected as e:
+                sheds.append(e)
+                continue
+            except Unavailable:
+                continue
+            except ReplicaFailed as e:
+                with self._lock:
+                    self._down.add(idx)
+                _log.warning(json.dumps(
+                    {"event": "router_replica_down", "replica": idx,
+                     "cause": str(e)}))
+                continue
+            stream._inner = inner
+            stream.replica_idx = idx
+            self.metrics.routed_total.inc(policy="disagg_prefill",
+                                          replica=idx)
+            if self.policy == "cache_aware":
+                self._record(stream.prompt, idx)
+            return stream
+        # every prefill replica shed or died: serve the request
+        # mixed-mode on the rest of the fleet rather than 429ing work
+        # the decode side could absorb
+        stream.phase = "mixed"
+        try:
+            return super()._place(
+                stream, exclude=set(exclude) | set(prefills))
+        except (Rejected, Unavailable) as exc:
+            if sheds:
+                self.metrics.router_shed_total.inc()
+                agg = Rejected(
+                    "all replicas shed: " + "; ".join(
+                        map(str, sheds + (
+                            [exc] if isinstance(exc, Rejected) else []))))
+                agg.retry_after = max(
+                    float(getattr(e, "retry_after", 1))
+                    for e in sheds + [exc])
+                raise agg from exc
+            raise
+
+    # -- the migration (prefill -> decode handoff) -------------------------
+    def _adopt_kwargs(self, stream):
+        kw = {"max_new_tokens": stream.kwargs["max_new_tokens"]}
+        for key in _ADOPT_KEYS:
+            if stream.kwargs.get(key) is not None:
+                kw[key] = stream.kwargs[key]
+        return kw
+
+    def _migrate(self, stream):
+        """Move the held sequence to a decode replica and swap the
+        stream's inner phase.  Destination failures try the next
+        decode replica; exhausting them falls back to a full
+        re-prefill on any survivor (delivered tokens spliced); SOURCE
+        failures raise so the caller's failover path re-prefills with
+        the source marked down."""
+        src_idx = stream.replica_idx
+        src = self.replicas[src_idx]
+        kwargs = self._adopt_kwargs(stream)
+        # decode replicas first, mixed as migration-capable spill
+        order = self._by_load(
+            self._role_idxs(("decode",), exclude={src_idx})) \
+            + self._by_load(
+                self._role_idxs(("mixed",), exclude={src_idx}))
+        for dst_idx in order:
+            dst = self.replicas[dst_idx]
+            try:
+                skip = dst.probe_pages(stream.prompt)
+            except Exception:
+                continue
+            inner = None
+            meta = None
+            for _ in range(self.migrate_retries):
+                # export MUST work: failures here are source failures
+                # and escalate to the caller's failover path
+                try:
+                    meta, k, v = src.export_pages(stream._inner, skip)
+                except KeyError as e:
+                    raise RuntimeError(
+                        f"source replica {src_idx} lost the held "
+                        f"pages: {e}") from e
+                try:
+                    inner = dst.adopt(meta, k, v, **kwargs)
+                    break
+                except PrefixDrift as e:
+                    skip = e.cached_pages  # re-export the right suffix
+                except (Rejected, Unavailable, GeometryMismatch):
+                    break
+                except ReplicaFailed as e:
+                    with self._lock:
+                        self._down.add(dst_idx)
+                    _log.warning(json.dumps(
+                        {"event": "router_replica_down",
+                         "replica": dst_idx, "cause": str(e)}))
+                    break
+            if inner is None:
+                continue
+            try:
+                src.release_pages(stream._inner)
+            except Exception:  # pragma: no cover - source died after
+                pass           # export; its pages die with it
+            if hasattr(stream._inner, "close"):
+                stream._inner.close()
+            stream._inner = inner
+            stream.replica_idx = dst_idx
+            stream.phase = "decode"
+            stream.migrations += 1
+            n_pages = int(meta["n_pages"])
+            self.metrics.migrations_total.inc()
+            self.metrics.migrated_pages_total.inc(n_pages)
+            self.metrics.routed_total.inc(policy="disagg_decode",
+                                          replica=dst_idx)
+            _log.info(json.dumps({
+                "event": "router_migrate", "from": src_idx,
+                "to": dst_idx, "pages": n_pages,
+                "skipped_cached_pages": int(meta["skip_pages"]),
+                "request_id": stream.request_id,
+                "router_req_id": stream.req_id}))
+            return
+        # no decode replica could adopt: re-prefill the whole request
+        # on any survivor (zero lost tokens — splice covers the replay)
+        try:
+            src.release_pages(stream._inner)
+        except Exception:
+            pass
+        self.metrics.migration_fallbacks_total.inc()
+        _log.warning(json.dumps({
+            "event": "router_migrate_fallback", "from": src_idx,
+            "request_id": stream.request_id,
+            "router_req_id": stream.req_id}))
+        stream._skip = [d if not f else 0
+                        for d, f in zip(stream._delivered,
+                                        stream._finished)]
+        stream.phase = "mixed"
+        try:
+            # base placement, NOT self._place: a second disagg attempt
+            # would hold-and-migrate again and could loop forever on a
+            # fleet whose decode side keeps refusing
+            super()._place(stream, exclude=())
+        except (Rejected, Unavailable) as e:
+            raise RuntimeError(
+                f"migration fallback failed for request "
+                f"{stream.request_id or stream.req_id}: {e}") from e

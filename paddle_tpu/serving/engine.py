@@ -215,6 +215,7 @@ class ServingEngine:
         self._seed_rng = np.random.default_rng()  # seed=None fallback
         self._requests: dict[int, Request] = {}
         self._finished: dict[int, Request] = {}
+        self._held: dict[int, Request] = {}   # "prefilled", pages kept
         self._rngs: dict[int, np.random.Generator] = {}
         # streaming callback: called synchronously with every event dict
         # the moment it is emitted (token/finish), from the thread that
@@ -229,7 +230,8 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
                     do_sample=False, temperature=1.0, top_k=0,
                     top_p=1.0, seed=None, n=1, logprobs=False,
-                    request_id=None, speculative=None):
+                    request_id=None, speculative=None,
+                    prefill_only=False):
         """Queue a request; returns its req_id (n>1 returns the PARENT id
         — forked children surface as their own req_ids in events). With
         the prefix cache on, the longest cached prompt prefix is PINNED
@@ -253,6 +255,11 @@ class ServingEngine:
         if n > 1 and not do_sample:
             raise ValueError("n>1 needs do_sample=True (greedy forks "
                              "would be identical streams)")
+        if prefill_only and n > 1:
+            raise ValueError(
+                "prefill_only is incompatible with n>1: forks are "
+                "created at prefill completion on the DECODE side of a "
+                "migration, not the prefill side")
         if not 0.0 <= float(top_p) <= 1.0:
             raise ValueError(f"top_p={top_p} outside [0, 1]")
         now = self._now()
@@ -267,7 +274,8 @@ class ServingEngine:
                       request_id=(str(request_id)
                                   if request_id is not None else None),
                       speculative=(None if speculative is None
-                                   else bool(speculative)))
+                                   else bool(speculative)),
+                      prefill_only=bool(prefill_only))
         req.device_seed = (int(seed) & 0x7FFFFFFF if seed is not None
                            else int(self._seed_rng.integers(
                                1, 2 ** 31 - 1)))
@@ -371,8 +379,12 @@ class ServingEngine:
         steps.
         """
         req = self._requests.get(req_id)
-        if req is None or req.state == RequestState.FINISHED:
+        if req is None:
             return False
+        if req.state == RequestState.FINISHED:
+            # a held ("prefilled") request is finished but still owns
+            # pages awaiting export — cancellation must release them
+            return self.release_request(req_id)
         if self.cache.has_seq(req.seq_id):
             self.cache.free_seq(req.seq_id)
         self._free_draft_seq(req.seq_id)
@@ -416,6 +428,8 @@ class ServingEngine:
                 self.cache.free_seq(r.seq_id)
             self._free_draft_seq(r.seq_id)
             self.scheduler.preempt(r)
+        for rid in list(self._held):
+            self.release_request(rid)
 
     def _maybe_inject_fault(self):
         """Env-gated fault hook, evaluated at the step BOUNDARY (before
@@ -915,6 +929,117 @@ class ServingEngine:
                 for child in children:
                     ctok, clp = _counter_sample_row(row, child)
                     self._emit_token(child, ctok, events, logprob=clp)
+        if req.prefill_only and req.state == RequestState.RUNNING:
+            # disagg handoff point: the first token is emitted (TTFT is
+            # the prefill replica's to measure) and the request stops
+            # BEFORE the first decode step — pages stay resident for
+            # export_request until release_request/cancel frees them
+            self._hold_prefilled(req, events)
+
+    def _hold_prefilled(self, req, events):
+        self.scheduler.finish(req, "prefilled")
+        req.held = True
+        self._held[req.req_id] = req
+        self.metrics.prefills_held.inc()
+        self._record_finish(req, events)
+
+    # -- KV page migration (disaggregated serving, round 14) ---------------
+    def export_request(self, req_id, skip_pages=0):
+        """Export a HELD request's KV page chain for migration.
+        Returns ``(meta, k_arrays, v_arrays)`` — the allocator payload
+        plus the continuation fields (prompt/out_tokens/device_seed)
+        the adopting engine needs for a token-exact splice.  Read-only:
+        the request stays held until :meth:`release_request`."""
+        req = self._held.get(req_id)
+        if req is None:
+            raise KeyError(
+                f"export_request: request {req_id!r} is not held "
+                "(not prefill_only, already released, or unknown)")
+        meta, k, v = self.cache.export_pages(req.seq_id, skip_pages)
+        meta.update(
+            prompt=[int(t) for t in req.prompt],
+            out_tokens=[int(t) for t in req.out_tokens],
+            device_seed=int(req.device_seed))
+        self.metrics.pages_exported.inc(int(meta["n_pages"]))
+        return meta, k, v
+
+    def release_request(self, req_id):
+        """Free a held request's pages (migration committed on the
+        destination, or abandoned). Idempotent: False when nothing was
+        held under this id."""
+        req = self._held.pop(req_id, None)
+        if req is None:
+            return False
+        req.held = False
+        if self.cache.has_seq(req.seq_id):
+            self.cache.free_seq(req.seq_id)
+        return True
+
+    def adopt_request(self, meta, k_arrays, v_arrays, *,
+                      max_new_tokens, deadline_s=None, do_sample=False,
+                      temperature=1.0, top_k=0, top_p=1.0, seed=None,
+                      logprobs=False, request_id=None, speculative=None):
+        """Register a migrated-in request: import its KV page chain
+        (geometry-checked, shared prefix resolved against THIS
+        allocator's radix tree) and enter it RUNNING — the next decode
+        step continues the stream exactly where the prefill replica
+        stopped (token t is pure in (weights, history, seed, t), and
+        ``device_seed`` rides in ``meta``).  Raises GeometryMismatch /
+        PrefixDrift / OutOfPages with no state left behind."""
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining: in-flight requests finish, new "
+                "admissions are refused")
+        prompt = np.asarray(meta["prompt"], np.int32).reshape(-1)
+        out_tokens = [int(t) for t in meta["out_tokens"]]
+        if prompt.size == 0 or not out_tokens:
+            raise ValueError(
+                "adopt_request needs a non-empty prompt and at least "
+                "the prefill replica's first sampled token")
+        if int(meta["seq_len"]) != prompt.size + len(out_tokens) - 1:
+            raise ValueError(
+                f"adopt_request: payload seq_len={meta['seq_len']} != "
+                f"history-1 ({prompt.size}+{len(out_tokens)}-1) — the "
+                "last sampled token must not have been fed yet")
+        if len(out_tokens) >= int(max_new_tokens):
+            raise ValueError(
+                f"adopt_request: {len(out_tokens)} token(s) already "
+                f"emitted >= max_new_tokens({max_new_tokens}) — "
+                "nothing left to decode")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt({prompt.size}) + max_new_tokens"
+                f"({max_new_tokens}) exceeds max_seq_len"
+                f"({self.max_seq_len})")
+        now = self._now()
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      arrival=now,
+                      deadline=(now + deadline_s
+                                if deadline_s is not None else None),
+                      do_sample=bool(do_sample),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), seed=seed, n=1,
+                      logprobs=bool(logprobs),
+                      request_id=(str(request_id)
+                                  if request_id is not None else None),
+                      speculative=(None if speculative is None
+                                   else bool(speculative)),
+                      adopted=True)
+        req.out_tokens = out_tokens
+        req.device_seed = int(meta["device_seed"]) & 0x7FFFFFFF
+        # TTFT belongs to the prefill replica; tokens here are TPOT
+        req.first_token_at = now
+        req.last_token_at = now
+        self.cache.import_pages(req.seq_id, meta, k_arrays, v_arrays,
+                                prompt=prompt,
+                                hist_len=prompt.size + len(out_tokens))
+        self._requests[req.req_id] = req
+        self._rngs[req.req_id] = np.random.default_rng(seed)
+        self.scheduler.register_adopted(req)
+        self.metrics.pages_imported.inc(int(meta["n_pages"]))
+        self.metrics.adoptions.inc()
+        return req.req_id
 
     def _fork(self, parent, i):
         child = Request(prompt=parent.prompt,

@@ -140,12 +140,25 @@ class RequestStream:
         return out
 
 
+ROLES = ("mixed", "prefill", "decode")
+
+
 class ServingFrontend:
-    def __init__(self, engine, *, max_queued=64, poll_interval_s=0.001):
+    def __init__(self, engine, *, max_queued=64, poll_interval_s=0.001,
+                 role=None):
         if engine.on_event is not None:
             raise ValueError("engine already has an on_event consumer")
         engine.on_event = self._on_event
         self.engine = engine
+        role = role or os.environ.get("PADDLE_TPU_SERVING_ROLE") \
+            or "mixed"
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; one of {ROLES}")
+        # advertised in /healthz; a ROUTING intent, not a capability
+        # limit — any engine can serve either phase, the disagg router
+        # just routes prefill_only work to "prefill" replicas and page
+        # adoptions to "decode" ones
+        self.role = role
         self.max_queued = int(max_queued)
         self.poll_interval_s = float(poll_interval_s)
         self.lock = threading.Lock()
@@ -220,7 +233,9 @@ class ServingFrontend:
         with self.lock:
             if self._state != "ok":
                 raise Unavailable(f"front-end is {self._state}")
-            self._check_capacity(prompt, int(max_new_tokens), n)
+            self._check_capacity(prompt, int(max_new_tokens), n,
+                                 prefill_only=bool(
+                                     kw.get("prefill_only")))
             rid = self.engine.add_request(
                 prompt, max_new_tokens=int(max_new_tokens), **kw)
             stream = RequestStream(rid, n)
@@ -243,8 +258,10 @@ class ServingFrontend:
         with self.lock:
             eng = self.engine
             return {"status": self._state,
+                    "role": self.role,
                     "waiting": eng.scheduler.queue_depth(),
                     "live": len(eng.scheduler.live_requests()),
+                    "held": len(eng._held),
                     "free_pages": eng.cache.free_pages,
                     "reserved_pages": self._reserved_pages(),
                     "speculative_k": getattr(eng, "spec_k", 0),
@@ -270,8 +287,64 @@ class ServingFrontend:
             m.running_gauge.set(len(eng.scheduler.running))
             return m.to_prometheus()
 
+    # -- KV page migration (disaggregated serving, round 14) ---------------
+    # Export/import touch the cache's device buffers and host
+    # bookkeeping, so every path below holds the SAME lock as the step
+    # loop — a page import racing a step would scatter into buffers the
+    # in-flight program is about to replace (enforced by graftlint
+    # `page-migration-lock`).
+    def probe_prefix(self, prompt, hist_len=None):
+        """Radix-tree transfer index: how many leading prompt pages are
+        already resident HERE (the exporter skips exactly these)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if hist_len is None:
+            hist_len = prompt.size + 1
+        with self.lock:
+            return self.engine.cache.probe_prefix(prompt, hist_len)
+
+    def export_request(self, req_id, skip_pages=0):
+        """Export a held request's page chain (meta, k, v)."""
+        with self.lock:
+            return self.engine.export_request(req_id, skip_pages)
+
+    def release_request(self, req_id):
+        """Drop a held request's pages once the migration committed."""
+        with self.lock:
+            return self.engine.release_request(req_id)
+
+    def adopt(self, meta, k_arrays, v_arrays, *, max_new_tokens, **kw):
+        """Import a migrated page chain and continue decoding it here;
+        returns a RequestStream that emits only NEW tokens (the prefill
+        replica's tokens ride in ``meta["out_tokens"]``).  Sheds with
+        Rejected when the imported chain plus its remaining decode
+        growth cannot be reserved — the router then tries another
+        decode replica."""
+        with self.lock:
+            if self._state != "ok":
+                raise Unavailable(f"front-end is {self._state}")
+            eng = self.engine
+            cache = eng.cache
+            prompt = np.asarray(meta["prompt"], np.int32).reshape(-1)
+            need = cache.pages_for(prompt.size + int(max_new_tokens))
+            need -= int(meta.get("skip_pages", 0))
+            promised = self._reserved_pages()
+            if need + promised + eng.scheduler.watermark_pages \
+                    > cache.available_pages:
+                eng.metrics.rejections.inc()
+                raise Rejected(
+                    f"over capacity: adoption needs {need} page(s), "
+                    f"{cache.available_pages} available - {promised} "
+                    f"reserved - {eng.scheduler.watermark_pages} "
+                    "watermark")
+            rid = eng.adopt_request(meta, k_arrays, v_arrays,
+                                    max_new_tokens=int(max_new_tokens),
+                                    **kw)
+            stream = RequestStream(rid, 1)
+            self._streams[rid] = stream
+        return stream
+
     # -- internals ---------------------------------------------------------
-    def _check_capacity(self, prompt, max_new, n):
+    def _check_capacity(self, prompt, max_new, n, prefill_only=False):
         """Reservation admission (no-preemption envelope): reject when
         the waiting queue is full or the worst-case page need cannot be
         covered on top of all outstanding reservations + watermark.
@@ -291,7 +364,12 @@ class ServingFrontend:
             eng.metrics.rejections.inc()
             raise Rejected(
                 f"intake queue full ({self.max_queued} waiting)")
-        need = cache.pages_for(prompt_len + max_new) * n
+        # a prefill-only request stops after its first sampled token:
+        # its worst case is prompt+1, never prompt+max_new — the
+        # reservation asymmetry that makes a dedicated prefill replica
+        # admit deep bursts a mixed replica would shed
+        worst_new = 1 if prefill_only else max_new
+        need = cache.pages_for(prompt_len + worst_new) * n
         need -= cache.probe_prefix(prompt)  # shared across the n forks
         promised = self._reserved_pages()
         if need + promised + sched.watermark_pages \
@@ -310,8 +388,9 @@ class ServingFrontend:
         cache, sched = eng.cache, eng.scheduler
         promised = 0
         for r in list(sched.live_requests()) + list(sched.waiting):
+            worst_new = 1 if r.prefill_only else r.max_new_tokens
             promised += max(
-                0, cache.pages_for(r.prompt.size + r.max_new_tokens)
+                0, cache.pages_for(r.prompt.size + worst_new)
                 * r.n - cache.pages_held(r.seq_id))
         return promised
 

@@ -53,7 +53,8 @@ from collections import deque
 
 import numpy as np
 
-__all__ = ["PagedKVCache", "OutOfPages", "SCRATCH_PAGE"]
+__all__ = ["PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
+           "GeometryMismatch", "PrefixDrift"]
 
 # page 0 is never handed to a sequence: padded lanes scatter/gather there
 SCRATCH_PAGE = 0
@@ -69,6 +70,28 @@ class OutOfPages(RuntimeError):
             f"{free} free")
         self.needed = needed
         self.free = free
+
+
+class GeometryMismatch(ValueError):
+    """A page-migration payload does not match this allocator's cache
+    geometry (layers / kv heads / head dim / page size / dtype) — K/V
+    bytes from a differently-shaped cache can never be spliced in."""
+
+
+class PrefixDrift(RuntimeError):
+    """The importing allocator's radix tree no longer matches the page
+    count the exporter skipped: the shared prefix grew (another request
+    committed more pages) or shrank (LRU eviction) between the probe
+    and the import.  Carries ``cached_pages`` — the pages the importer
+    ACTUALLY holds — so the migration driver can re-export the right
+    suffix and retry."""
+
+    def __init__(self, skip_pages, cached_pages):
+        super().__init__(
+            f"prefix drift: exporter skipped {skip_pages} cached "
+            f"page(s) but the importer matched {cached_pages}")
+        self.skip_pages = skip_pages
+        self.cached_pages = cached_pages
 
 
 class _RadixNode:
@@ -456,6 +479,141 @@ class PagedKVCache:
         while self._evict_lru_leaf():
             n += 1
         return n
+
+    # -- page migration (disaggregated prefill/decode, round 14) -----------
+    def geometry(self):
+        """The shape contract a migration payload must satisfy."""
+        return {"n_layers": self.n_layers, "n_kv_heads": self.n_kv_heads,
+                "head_dim": self.head_dim, "page_size": self.page_size,
+                "dtype": str(self.dtype)}
+
+    def check_geometry(self, meta):
+        mine = self.geometry()
+        theirs = {k: meta.get(k) for k in mine}
+        if mine != theirs:
+            raise GeometryMismatch(
+                f"page payload geometry {theirs} does not match this "
+                f"cache ({mine})")
+
+    def export_pages(self, seq_id, skip_pages=0):
+        """Fetch a sequence's page chain — K/V bytes plus layout meta —
+        for migration to another allocator (the disaggregated
+        prefill→decode handoff).  ``skip_pages`` leading pages are
+        omitted: the radix tree is the transfer index, and prefix pages
+        the importer already holds resident are never re-transferred.
+
+        Read-only (refcounts untouched): migration is copy-then-release,
+        so a failed transfer leaves the source sequence intact.  Returns
+        ``(meta, k_arrays, v_arrays)`` — per-layer numpy arrays of shape
+        ``[n_pages, page_size, n_kv_heads, head_dim]``.
+        """
+        if seq_id not in self._tables:
+            raise KeyError(f"export_pages: unknown sequence {seq_id!r}")
+        table = self._tables[seq_id]
+        skip_pages = int(skip_pages)
+        if not 0 <= skip_pages <= len(table):
+            raise ValueError(
+                f"export_pages: skip_pages={skip_pages} outside "
+                f"[0, {len(table)}]")
+        pages = table[skip_pages:]
+        meta = dict(self.geometry(), seq_len=self._lens[seq_id],
+                    skip_pages=skip_pages, n_pages=len(pages))
+        if not pages:
+            empty = [np.empty((0, self.page_size, self.n_kv_heads,
+                               self.head_dim), self.dtype)
+                     for _ in range(self.n_layers)]
+            return meta, empty, [a.copy() for a in empty]
+        import jax.numpy as jnp
+        idx = jnp.asarray(pages, jnp.int32)
+        k = [np.asarray(kp[idx]) for kp in self.k_pages]
+        v = [np.asarray(vp[idx]) for vp in self.v_pages]
+        return meta, k, v
+
+    def import_pages(self, seq_id, meta, k_arrays, v_arrays,
+                     prompt=None, hist_len=None):
+        """Splice an exported page chain into THIS allocator as a new
+        sequence: acquire the locally-cached shared prefix (the pages
+        the exporter skipped), allocate fresh pages for the transferred
+        suffix, scatter the K/V bytes into the device buffers, and —
+        with the prefix cache on — register the now-resident full
+        prompt pages back into the radix tree.
+
+        Raises :class:`GeometryMismatch` when the payload's cache shape
+        differs, :class:`PrefixDrift` when the local radix match no
+        longer equals ``meta["skip_pages"]`` (pages committed or
+        evicted since the exporter probed — the caller re-exports with
+        the carried ``cached_pages`` and retries), :class:`OutOfPages`
+        when free + reclaimable pages cannot host the suffix.  All
+        failures roll back fully (no sequence state left behind).
+        """
+        self.check_geometry(meta)
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        skip = int(meta["skip_pages"])
+        n_pages = int(meta["n_pages"])
+        seq_len = int(meta["seq_len"])
+        if self.pages_for(seq_len) != skip + n_pages:
+            raise ValueError(
+                f"import_pages: seq_len={seq_len} spans "
+                f"{self.pages_for(seq_len)} page(s), payload covers "
+                f"{skip}+{n_pages}")
+        shape = (n_pages, self.page_size, self.n_kv_heads, self.head_dim)
+        for arrs, what in ((k_arrays, "k"), (v_arrays, "v")):
+            if len(arrs) != self.n_layers:
+                raise GeometryMismatch(
+                    f"{what} payload has {len(arrs)} layer(s), cache "
+                    f"has {self.n_layers}")
+            for a in arrs:
+                if tuple(a.shape) != shape:
+                    raise GeometryMismatch(
+                        f"{what} page array shape {tuple(a.shape)} != "
+                        f"{shape}")
+        # pin the locally-resident prefix; must match what the exporter
+        # skipped or the page/token alignment breaks (PrefixDrift)
+        if self.prefix_cache_enabled and prompt is not None:
+            matched = self.acquire_prefix(
+                seq_id, prompt,
+                len(prompt) + 1 if hist_len is None else hist_len)
+        else:
+            self._tables[seq_id] = []
+            self._lens[seq_id] = 0
+            matched = 0
+        if matched != skip:
+            self.free_seq(seq_id)
+            raise PrefixDrift(skip, matched)
+        try:
+            if n_pages > self.available_pages:
+                raise OutOfPages(n_pages, self.available_pages)
+            while n_pages > len(self._free):
+                if not self._evict_lru_leaf():  # pragma: no cover
+                    raise OutOfPages(n_pages, self.available_pages)
+        except OutOfPages:
+            self.free_seq(seq_id)
+            raise
+        table = self._tables[seq_id]
+        fresh = [self._free.popleft() for _ in range(n_pages)]
+        for p in fresh:
+            self._rc[p] = 1
+        table.extend(fresh)
+        self._lens[seq_id] = seq_len
+        if n_pages:
+            import jax.numpy as jnp
+            dsts = jnp.asarray(fresh, jnp.int32)
+            self.k_pages = [
+                kp.at[dsts].set(jnp.asarray(a, kp.dtype))
+                for kp, a in zip(self.k_pages, k_arrays)]
+            self.v_pages = [
+                vp.at[dsts].set(jnp.asarray(a, vp.dtype))
+                for vp, a in zip(self.v_pages, v_arrays)]
+        if self.prefix_cache_enabled and prompt is not None:
+            # the imported prompt pages are canonical K/V: later
+            # shared-prefix requests on THIS replica hit them.  Bounded
+            # by seq_len: a sequence imported SHORTER than its prompt
+            # (rolled back below it) holds fewer pages than the prompt
+            # spans, and commit must never index past its table.
+            self.commit_prefix(seq_id, prompt, min(len(prompt),
+                                                   seq_len))
+        return len(table)
 
     def _evict_lru_leaf(self):
         """Reclaim the least-recently-used cached LEAF page no sequence

@@ -167,6 +167,11 @@ class ServingMetrics:
         self.spec_draft_tokens = Counter()    # tokens the draft proposed
         self.spec_accepted_tokens = Counter()  # proposals verified+emitted
         self.spec_fallbacks = Counter()       # lanes demoted to plain
+        # disaggregated prefill/decode (round 14)
+        self.prefills_held = Counter()        # requests held "prefilled"
+        self.pages_exported = Counter()       # KV pages shipped out
+        self.pages_imported = Counter()       # KV pages spliced in
+        self.adoptions = Counter()            # migrated-in requests
         # decode hot path (round 10)
         self.fetch_bytes = Counter()          # host<-device bytes/steps
         self.prefix_hit_pages = Counter()     # prompt pages served from

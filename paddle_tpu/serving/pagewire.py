@@ -1,0 +1,109 @@
+"""Wire format for KV page migration — the ``/v1/_pages`` payload.
+
+The disaggregated serving tier moves a sequence's K/V page chain
+between replicas (prefill → decode handoff).  In-process replicas hand
+the numpy arrays over directly; HTTP replicas ship this format: a
+fixed magic, a length-prefixed JSON header (cache geometry + sequence
+meta + the generation-continuation request), then the raw page bytes
+of every layer's K then V arrays, concatenated in header order.
+
+Deserialization is strict: magic, header shape, declared dtype/shape
+versus the actual byte count are all checked here, and the allocator
+re-checks geometry against itself at import
+(:meth:`PagedKVCache.check_geometry`) — a malformed or mis-shaped
+payload can never scatter into the device buffers.
+
+The format is host-order binary (little-endian length prefix); both
+ends of a migration run the same stack, and the JSON header carries
+the dtype string so an endianness or dtype skew is caught, not
+mis-read.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+__all__ = ["MAGIC", "serialize_pages", "deserialize_pages",
+           "WireFormatError"]
+
+MAGIC = b"PTKV1\n"
+_LEN = struct.Struct("<Q")
+# a page payload is bounded by the source cache size; anything past
+# this is a protocol error, not a transfer (guards the HTTP handler
+# against unbounded reads)
+MAX_PAYLOAD_BYTES = 1 << 31
+
+
+class WireFormatError(ValueError):
+    """The byte stream is not a valid page-migration payload."""
+
+
+def serialize_pages(meta, k_arrays, v_arrays, request=None):
+    """Pack ``(meta, k, v)`` — the :meth:`PagedKVCache.export_pages`
+    result — plus an optional ``request`` continuation dict into one
+    ``bytes`` payload."""
+    arrays = list(k_arrays) + list(v_arrays)
+    header = {
+        "meta": dict(meta),
+        "request": dict(request) if request is not None else None,
+        "arrays": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in arrays],
+        "n_layers_k": len(k_arrays),
+    }
+    hdr = json.dumps(header).encode()
+    parts = [MAGIC, _LEN.pack(len(hdr)), hdr]
+    for a in arrays:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def deserialize_pages(buf):
+    """Unpack a payload into ``(meta, k_arrays, v_arrays, request)``.
+    Raises :class:`WireFormatError` on any structural mismatch."""
+    if not buf.startswith(MAGIC):
+        raise WireFormatError("bad magic: not a KV page payload")
+    off = len(MAGIC)
+    if len(buf) < off + _LEN.size:
+        raise WireFormatError("truncated header length")
+    (hlen,) = _LEN.unpack_from(buf, off)
+    off += _LEN.size
+    if hlen > MAX_PAYLOAD_BYTES or len(buf) < off + hlen:
+        raise WireFormatError("truncated header")
+    try:
+        header = json.loads(buf[off:off + hlen])
+    except ValueError as e:
+        raise WireFormatError(f"header is not JSON: {e}") from e
+    off += hlen
+    try:
+        meta = dict(header["meta"])
+        specs = header["arrays"]
+        n_k = int(header["n_layers_k"])
+        request = header.get("request")
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireFormatError(f"malformed header: {e}") from e
+    if not 0 <= n_k <= len(specs):
+        raise WireFormatError(
+            f"n_layers_k={n_k} outside the {len(specs)} declared arrays")
+    arrays = []
+    for spec in specs:
+        try:
+            shape = tuple(int(d) for d in spec["shape"])
+            dtype = np.dtype(spec["dtype"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireFormatError(f"malformed array spec: {e}") from e
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes < 0 or len(buf) < off + nbytes:
+            raise WireFormatError(
+                f"truncated array payload: declared {shape} {dtype} "
+                f"needs {nbytes} byte(s), {len(buf) - off} left")
+        arrays.append(np.frombuffer(
+            buf, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+            offset=off).reshape(shape))
+        off += nbytes
+    if off != len(buf):
+        raise WireFormatError(
+            f"{len(buf) - off} trailing byte(s) after the declared "
+            "arrays")
+    return meta, arrays[:n_k], arrays[n_k:], request

@@ -55,13 +55,17 @@ class InProcessReplica:
     kind = "inproc"
 
     def __init__(self, engine, *, max_queued=64, poll_interval_s=0.001,
-                 name=None):
+                 name=None, role=None):
         self.frontend = ServingFrontend(
             engine, max_queued=max_queued,
-            poll_interval_s=poll_interval_s)
+            poll_interval_s=poll_interval_s, role=role)
         self.engine = engine
         self.name = name
         self._started = False
+
+    @property
+    def role(self):
+        return self.frontend.role
 
     def start(self):
         if not self._started:
@@ -114,6 +118,20 @@ class InProcessReplica:
     def close(self, timeout=120.0):
         return self.frontend.close(timeout)
 
+    # -- KV page migration (disagg tier) -----------------------------------
+    def probe_pages(self, prompt):
+        return self.frontend.probe_prefix(prompt)
+
+    def export_pages(self, stream, skip_pages=0):
+        return self.frontend.export_request(stream.req_id, skip_pages)
+
+    def release_pages(self, stream):
+        return self.frontend.release_request(stream.req_id)
+
+    def adopt(self, meta, k_arrays, v_arrays, *, max_new_tokens, **kw):
+        return self.frontend.adopt(meta, k_arrays, v_arrays,
+                                   max_new_tokens=max_new_tokens, **kw)
+
 
 class _HTTPStream:
     """SSE consumer over one in-flight ``/v1/completions`` request —
@@ -126,6 +144,17 @@ class _HTTPStream:
         self.req_id = req_id
         self.n = int(n)
         self._closed = False
+        self.remote_id = None  # "cmpl-<engine req_id>" from the chunks
+
+    @property
+    def remote_req_id(self):
+        """The REMOTE engine's integer request id (parsed from the SSE
+        chunk ids) — what /v1/_pages/export needs to find the held
+        pages on the remote server."""
+        if self.remote_id is None:
+            return None
+        tail = self.remote_id.rsplit("-", 1)[-1]
+        return int(tail) if tail.isdigit() else None
 
     def events(self, timeout=120.0, idle_s=None):
         finishes = 0
@@ -163,7 +192,10 @@ class _HTTPStream:
                         f"[DONE] after {finishes}/{self.n} finishes")
                 break
             last = time.monotonic()
-            ch = json.loads(line[6:])["choices"][0]
+            obj = json.loads(line[6:])
+            if obj.get("id"):
+                self.remote_id = obj["id"]
+            ch = obj["choices"][0]
             if "token_id" in ch:
                 ev = {"type": "token", "index": ch["index"],
                       "token": int(ch["token_id"])}
@@ -208,11 +240,21 @@ class HTTPReplica:
 
     kind = "http"
 
-    def __init__(self, host, port, *, timeout_s=120.0, name=None):
+    def __init__(self, host, port, *, timeout_s=120.0, name=None,
+                 role=None):
         self.host = host
         self.port = int(port)
         self.timeout_s = float(timeout_s)
         self.name = name or f"{host}:{port}"
+        self._role = role  # None -> lazily read from /healthz
+
+    @property
+    def role(self):
+        """The remote front-end's advertised role (cached; the remote
+        sets it at start-up and it never changes mid-life)."""
+        if self._role is None:
+            self._role = self.health().get("role", "mixed")
+        return self._role
 
     def start(self):
         return self  # remote lifecycle is the remote operator's
@@ -224,7 +266,7 @@ class HTTPReplica:
         if kw.get("do_sample"):
             body["temperature"] = float(kw.get("temperature", 1.0))
         for key in ("top_k", "top_p", "seed", "n", "deadline_s",
-                    "speculative"):
+                    "speculative", "prefill_only"):
             if kw.get(key) is not None:
                 body[key] = kw[key]
         if kw.get("logprobs"):
@@ -266,6 +308,115 @@ class HTTPReplica:
     def cancel_stream(self, stream):
         stream.close()
         return True
+
+    # -- KV page migration (disagg tier, /v1/_pages) -----------------------
+    def _post_json(self, path, obj, timeout=None):
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port,
+                timeout=timeout or self.timeout_s)
+            conn.request("POST", path, json.dumps(obj),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+        except OSError as e:
+            raise ReplicaFailed(
+                f"replica {self.name} unreachable: {e!r}") from e
+        finally:
+            try:
+                conn.close()
+            except (OSError, UnboundLocalError):
+                pass
+        return resp.status, data
+
+    def probe_pages(self, prompt):
+        status, data = self._post_json(
+            "/v1/_pages/probe",
+            {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)]})
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: probe HTTP {status}")
+        return int(json.loads(data)["cached_pages"])
+
+    def export_pages(self, stream, skip_pages=0):
+        rid = stream.remote_req_id
+        if rid is None:
+            raise ReplicaFailed(
+                f"replica {self.name}: stream carried no chunk id — "
+                "cannot address its held pages")
+        status, data = self._post_json(
+            "/v1/_pages/export",
+            {"req_id": rid, "skip_pages": int(skip_pages)})
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: export HTTP {status}: "
+                f"{data[:200]!r}")
+        from .pagewire import deserialize_pages
+        meta, k, v, _ = deserialize_pages(data)
+        return meta, k, v
+
+    def release_pages(self, stream):
+        rid = stream.remote_req_id
+        if rid is None:
+            return False
+        status, data = self._post_json("/v1/_pages/release",
+                                       {"req_id": rid})
+        return status == 200 and bool(json.loads(data).get("released"))
+
+    def adopt(self, meta, k_arrays, v_arrays, *, max_new_tokens, **kw):
+        """POST the page payload to the remote ``/v1/_pages`` endpoint;
+        the response IS the SSE continuation stream."""
+        from .kv_cache import GeometryMismatch, PrefixDrift
+        from .pagewire import serialize_pages
+        request = {"max_tokens": int(max_new_tokens)}
+        if kw.get("do_sample"):
+            request["temperature"] = float(kw.get("temperature", 1.0))
+        for key in ("top_k", "top_p", "seed", "deadline_s",
+                    "speculative"):
+            if kw.get(key) is not None:
+                request[key] = kw[key]
+        if kw.get("logprobs"):
+            request["logprobs"] = True
+        if kw.get("request_id"):
+            request["request_id"] = str(kw["request_id"])
+        payload = serialize_pages(meta, k_arrays, v_arrays,
+                                  request=request)
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+            conn.request("POST", "/v1/_pages", payload,
+                         {"Content-Type":
+                          "application/x-paddle-tpu-kv-pages"})
+            resp = conn.getresponse()
+        except OSError as e:
+            raise ReplicaFailed(
+                f"replica {self.name} unreachable: {e!r}") from e
+        if resp.status == 200:
+            return _HTTPStream(conn, resp,
+                               req_id=f"{self.name}/{id(resp):x}", n=1)
+        data = resp.read()
+        conn.close()
+        try:
+            err = json.loads(data)["error"]
+        except (ValueError, KeyError):
+            err = {"message": data.decode(errors="replace")}
+        msg = err.get("message", "")
+        if resp.status == 409:
+            if "cached_pages" in err:
+                raise PrefixDrift(int(meta.get("skip_pages", 0)),
+                                  int(err["cached_pages"]))
+            raise GeometryMismatch(f"replica {self.name}: {msg}")
+        if resp.status == 429:
+            exc = Rejected(f"replica {self.name}: {msg}")
+            exc.retry_after = float(
+                resp.getheader("Retry-After") or 1)
+            raise exc
+        if resp.status == 503:
+            raise Unavailable(f"replica {self.name}: {msg}")
+        if resp.status == 400:
+            raise ValueError(msg)
+        raise ReplicaFailed(
+            f"replica {self.name}: adopt HTTP {resp.status}: {msg}")
 
     # -- observability -----------------------------------------------------
     def _get(self, path):

@@ -111,6 +111,11 @@ class RouterMetrics:
         self.spliced_tokens_total = Counter()
         self.router_shed_total = Counter()
         self.readmissions_total = LabeledCounter("replica")  # prober
+        # disaggregated tier (round 14)
+        self.migrations_total = Counter()        # prefill->decode splices
+        self.migrated_pages_total = Counter()    # KV pages transferred
+        self.migration_fallbacks_total = Counter()  # re-prefilled instead
+        self.autoscale_events = LabeledCounter("direction", "role")
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
 
@@ -198,6 +203,8 @@ class RouterStream:
 
 
 class ServingRouter:
+    stream_cls = RouterStream  # DisaggRouter swaps in DisaggStream
+
     def __init__(self, replicas, *, policy=None, page_size=16,
                  cache_load_cap=None, max_tree_pages=8,
                  max_tree_nodes=4096, seed=None,
@@ -210,6 +217,9 @@ class ServingRouter:
             raise ValueError(f"unknown policy {policy!r}; one of "
                              f"{POLICIES}")
         self.replicas = list(replicas)
+        # advertised routing roles (disagg tier reads these; the base
+        # policies ignore them — every replica is routable)
+        self.roles = [getattr(r, "role", "mixed") for r in self.replicas]
         self.policy = policy
         self.page_size = int(page_size)
         cap = os.environ.get("PADDLE_TPU_SERVING_ROUTER_LOAD_CAP")
@@ -227,6 +237,7 @@ class ServingRouter:
         self._clock = 0
         self._down: set[int] = set()
         self._draining: set[int] = set()
+        self._retired: set[int] = set()   # autoscaler scale-downs
         self._streams: dict[int, RouterStream] = {}
         self._seed_rng = np.random.default_rng(seed)
         self._started = False
@@ -274,7 +285,7 @@ class ServingRouter:
         in parallel-ish sequence; True when all drained."""
         ok = True
         for i in range(len(self.replicas)):
-            if i in self._down:
+            if i in self._down or i in self._retired:
                 continue
             self._draining.add(i)
             ok = self.replicas[i].drain(timeout) and ok
@@ -305,7 +316,8 @@ class ServingRouter:
         ``readmit_replica`` with a reload). Returns the list of replica
         indexes readmitted."""
         with self._lock:
-            down = [i for i in self._down if i not in self._draining]
+            down = [i for i in self._down if i not in self._draining
+                    and i not in self._retired]
         readmitted = []
         for i in down:
             try:
@@ -336,8 +348,8 @@ class ServingRouter:
             # stream is exact only if the seed rides along
             kw["seed"] = int(self._seed_rng.integers(1, 2 ** 31 - 1))
         kw["max_new_tokens"] = int(max_new_tokens)
-        stream = RouterStream(self, next(self._ids), prompt, kw,
-                              n=int(kw.get("n", 1)))
+        stream = self.stream_cls(self, next(self._ids), prompt, kw,
+                                 n=int(kw.get("n", 1)))
         self._place(stream, exclude=())
         with self._lock:
             self._streams[stream.req_id] = stream
@@ -356,8 +368,11 @@ class ServingRouter:
     def health(self):
         per = []
         for i, r in enumerate(self.replicas):
-            if i in self._down:
-                per.append({"status": "down"})
+            if i in self._retired:
+                per.append({"status": "retired",
+                            "role": self.roles[i]})
+            elif i in self._down:
+                per.append({"status": "down", "role": self.roles[i]})
             else:
                 try:
                     h = dict(r.health())
@@ -365,6 +380,7 @@ class ServingRouter:
                     h = {"status": "unreachable", "error": repr(e)}
                 if i in self._draining:
                     h["status"] = "draining"
+                h.setdefault("role", self.roles[i])
                 per.append(h)
         agg = self.state
         return {"status": agg,
@@ -387,7 +403,7 @@ class ServingRouter:
                 i in self._draining)
         parts = [(None, self.metrics.to_prometheus())]
         for i, r in enumerate(self.replicas):
-            if i in self._down:
+            if i in self._down or i in self._retired:
                 continue
             try:
                 parts.append((str(i), r.prometheus()))
@@ -424,6 +440,44 @@ class ServingRouter:
         _log.info(json.dumps({"event": "router_readmit_replica",
                               "replica": i}))
 
+    # -- fleet mutation (autoscaler, round 14) -----------------------------
+    def add_replica(self, replica, role=None):
+        """Grow the fleet: append a replica (started if the router is
+        live) and make it routable immediately. Returns its index."""
+        with self._lock:
+            self.replicas.append(replica)
+            self.roles.append(role or getattr(replica, "role", "mixed"))
+            self._replica_tokens.append(0)
+            i = len(self.replicas) - 1
+        if self._started:
+            replica.start()
+        _log.info(json.dumps({"event": "router_add_replica",
+                              "replica": i, "role": self.roles[i]}))
+        return i
+
+    def retire_replica(self, i, timeout=120.0):
+        """Shrink the fleet: route new work away, finish replica
+        ``i``'s in-flight requests through the rolling-drain path
+        (zero lost requests), then close it and mark it retired —
+        indexes stay stable, the slot just stops being routable.
+        Returns True when the drain completed in time."""
+        with self._lock:
+            if i in self._retired:
+                return True
+            self._draining.add(i)
+        ok = self.replicas[i].drain(timeout)
+        try:
+            self.replicas[i].close(timeout)
+        except Exception:  # pragma: no cover - best-effort teardown
+            pass
+        with self._lock:
+            self._retired.add(i)
+            self._draining.discard(i)
+            self._forget_owner(self._root, i)
+        _log.info(json.dumps({"event": "router_retire_replica",
+                              "replica": i, "drained": ok}))
+        return ok
+
     def kill_replica(self, i, exc=None):
         """Fault hook (tests/bench): hard-kill an in-process replica;
         its open streams fail over."""
@@ -441,7 +495,8 @@ class ServingRouter:
     def _routable(self, exclude=()):
         out = []
         for i in range(len(self.replicas)):
-            if i in self._down or i in self._draining or i in exclude:
+            if i in self._down or i in self._draining \
+                    or i in self._retired or i in exclude:
                 continue
             out.append(i)
         return out

@@ -66,6 +66,9 @@ class Request:        # field-wise __eq__ broadcast inside `in` checks
     logprobs: bool = False             # emit per-token logprob in events
     request_id: str | None = None      # client/router trace id (X-Request-Id)
     speculative: bool | None = None    # None=engine default, False=opt out
+    prefill_only: bool = False         # disagg: stop before decode step 1
+    held: bool = False                 # finished "prefilled", pages kept
+    adopted: bool = False              # entered via KV page migration
     device_seed: int = 0               # counter-RNG seed (device sampling)
     cached_pages: int = 0              # prefix-cache pages at last acquire
     prefix_counted: bool = False       # hit/miss stats recorded this pass
@@ -149,6 +152,17 @@ class Scheduler:
         child.state = RequestState.RUNNING
         self.running.append(child)
         self._admit_order.append(child)
+
+    def register_adopted(self, req: Request):
+        """A migrated-in request (KV pages imported from a prefill
+        replica) enters RUNNING directly: its history's K/V is already
+        resident, so it never queues for prefill. Preemption treats it
+        like any running request — recompute-prefill from the full
+        token history reproduces the stream exactly."""
+        req.state = RequestState.RUNNING
+        req.prefill_pos = len(req.token_history())
+        self.running.append(req)
+        self._admit_order.append(req)
 
     def live_requests(self):
         return list(self.prefill_queue) + list(self.running)

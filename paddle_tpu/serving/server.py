@@ -74,7 +74,7 @@ class ServingServer:
     def __init__(self, engine, *, host="127.0.0.1", port=0,
                  model_name="paddle-tpu", tokenizer=None,
                  detokenizer=None, max_queued=64, stream_timeout_s=120.0,
-                 poll_interval_s=0.001):
+                 poll_interval_s=0.001, role=None):
         if hasattr(engine, "submit"):
             # a ready front-end-shaped object (ServingFrontend or a
             # ServingRouter): serve it as-is — the router speaks the
@@ -84,7 +84,7 @@ class ServingServer:
         else:
             self.frontend = ServingFrontend(
                 engine, max_queued=max_queued,
-                poll_interval_s=poll_interval_s)
+                poll_interval_s=poll_interval_s, role=role)
         self.host = host
         self.port = int(port)
         self.model_name = model_name
@@ -187,6 +187,10 @@ class ServingServer:
             # per-request speculative-decoding opt-out (False forces
             # plain decode; True/absent = engine default)
             kw["speculative"] = bool(body["speculative"])
+        if body.get("prefill_only"):
+            # disagg tier: run chunked prefill + the first token, then
+            # hold the pages for /v1/_pages export (finish "prefilled")
+            kw["prefill_only"] = True
         return kw
 
     def _piece(self, tok):
@@ -266,9 +270,158 @@ class _Handler(BaseHTTPRequestHandler):
             self._completions(chat=False)
         elif self.path == "/v1/chat/completions":
             self._completions(chat=True)
+        elif self.path == "/v1/_pages":
+            self._pages_import()
+        elif self.path == "/v1/_pages/probe":
+            self._pages_probe()
+        elif self.path == "/v1/_pages/export":
+            self._pages_export()
+        elif self.path == "/v1/_pages/release":
+            self._pages_release()
         else:
             self._error(404, f"no route {self.path}",
                         "invalid_request_error")
+
+    # -- KV page migration (/v1/_pages, disagg tier) -----------------------
+    def _migration_frontend(self):
+        """The single-engine front-end behind this server, or None —
+        routers/aggregators do not hold pages themselves."""
+        fe = self.owner.frontend
+        return fe if hasattr(fe, "export_request") else None
+
+    def _pages_probe(self):
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None:
+            self._error(404, "this endpoint serves an aggregator, not "
+                        "an engine — probe its replicas directly",
+                        "invalid_request_error")
+            return
+        try:
+            prompt = body["prompt"]
+            self._json(200, {"cached_pages": fe.probe_prefix(prompt)})
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad probe request: {e}",
+                        "invalid_request_error")
+
+    def _pages_export(self):
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        from .pagewire import serialize_pages
+        try:
+            meta, k, v = fe.export_request(
+                int(body["req_id"]), int(body.get("skip_pages", 0)))
+        except KeyError as e:
+            self._error(404, f"no held pages: {e}",
+                        "invalid_request_error")
+            return
+        except (TypeError, ValueError) as e:
+            self._error(400, f"bad export request: {e}",
+                        "invalid_request_error")
+            return
+        payload = serialize_pages(meta, k, v)
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/x-paddle-tpu-kv-pages")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _pages_release(self):
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            released = fe.release_request(int(body["req_id"]))
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad release request: {e}",
+                        "invalid_request_error")
+            return
+        self._json(200, {"released": bool(released)})
+
+    def _pages_import(self):
+        """Adopt a migrated sequence: the request body is the pagewire
+        payload (geometry-checked twice — wire shape here, allocator
+        shape at import) and the response is the SSE continuation
+        stream.  409 carries ``cached_pages`` on prefix drift so the
+        migration driver can re-export the right suffix."""
+        from .kv_cache import GeometryMismatch, OutOfPages, PrefixDrift
+        from .pagewire import (MAX_PAYLOAD_BYTES, WireFormatError,
+                               deserialize_pages)
+        fe = self._migration_frontend()
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if not 0 < length <= MAX_PAYLOAD_BYTES:
+            self._error(400, f"bad Content-Length {length}",
+                        "invalid_request_error")
+            return
+        request_id = self._request_id()
+        try:
+            meta, k, v, req = deserialize_pages(self.rfile.read(length))
+            if not isinstance(req, dict):
+                raise WireFormatError(
+                    "payload carries no continuation request")
+            kw = {}
+            temp = req.get("temperature")
+            if temp is not None and float(temp) > 0:
+                kw.update(do_sample=True, temperature=float(temp))
+            if req.get("top_k") is not None:
+                kw["top_k"] = int(req["top_k"])
+            if req.get("top_p") is not None:
+                kw["top_p"] = float(req["top_p"])
+            if req.get("seed") is not None:
+                kw["seed"] = int(req["seed"])
+            if req.get("deadline_s") is not None:
+                kw["deadline_s"] = float(req["deadline_s"])
+            if req.get("speculative") is not None:
+                kw["speculative"] = bool(req["speculative"])
+            if req.get("logprobs"):
+                kw["logprobs"] = True
+            stream = fe.adopt(
+                meta, k, v, max_new_tokens=int(req["max_tokens"]),
+                request_id=req.get("request_id") or request_id, **kw)
+        except PrefixDrift as e:
+            self._json(409, {"error": {
+                "message": str(e), "type": "prefix_drift", "code": 409,
+                "cached_pages": e.cached_pages}})
+            return
+        except GeometryMismatch as e:
+            self._json(409, {"error": {"message": str(e),
+                                       "type": "geometry_mismatch",
+                                       "code": 409}})
+            return
+        except (Rejected, OutOfPages) as e:
+            self._error(429, str(e), "overloaded",
+                        retry=getattr(e, "retry_after", 1))
+            return
+        except (Unavailable, EngineDraining) as e:
+            self._error(503, str(e), "unavailable")
+            return
+        except (WireFormatError, KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad page payload: {e}",
+                        "invalid_request_error")
+            return
+        self._stream_sse(stream, False, f"cmpl-{stream.req_id}",
+                         request_id)
 
     # -- completion flow ---------------------------------------------------
     def _request_id(self):

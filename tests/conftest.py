@@ -61,8 +61,17 @@ def _bench_artifact_guard(request):
     round-12 run).  Snapshot the artifacts around those tests and
     restore them afterwards, deleting any the subprocess created anew —
     re-banking a bench number must be a deliberate quiet-VM act, never a
-    suite side effect."""
-    if "TestServingReplay" not in request.node.nodeid:
+    suite side effect.  The guard keys on every replay-class name that
+    shells out to bench_serving.py: round 14 added the disagg replay
+    (subprocess writes BENCH_serving_disagg.json — covered by the same
+    glob) AND closed a hole — the HTTP replay class is named
+    `TestServerReplay`, which the original "TestServingReplay"
+    substring never matched, so BENCH_serving_http.json was still
+    being overwritten by in-suite runs (caught by the round-14 tier-1
+    run: 30.9 -> 20.1 under suite load, the exact round-12 symptom)."""
+    _replay_classes = ("TestServingReplay", "TestServerReplay",
+                       "TestServingDisaggReplay")
+    if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return
     pattern = os.path.join(_REPO_ROOT, "BENCH_serving*.json")

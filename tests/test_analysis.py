@@ -38,15 +38,15 @@ def rule_ids(findings):
 # rule registry sanity
 
 class TestRegistry:
-    def test_eight_rules_with_ids_and_docs(self):
-        assert len(ALL_RULES) == 8
+    def test_nine_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 9
         for r in ALL_RULES:
             assert r.id and r.description
         assert set(RULES_BY_ID) == {
             "autograd-bypass", "thread-grad-state", "pallas-hazards",
             "jit-constant-capture", "dist-spec-passthrough",
             "chip-kill-on-timeout", "engine-lock-discipline",
-            "env-knob-registry"}
+            "page-migration-lock", "env-knob-registry"}
 
 
 # ---------------------------------------------------------------------------
@@ -471,6 +471,50 @@ class TestEngineLockDiscipline:
     def test_frontend_file_exempt(self):
         assert lint(_LOCK_BAD, "paddle_tpu/serving/frontend.py",
                     "engine-lock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# 7b. page-migration-lock (round 14)
+
+_MIGRATE_BAD = """
+    class Mover:
+        def steal(self, payload, prompt):
+            # racing the step loop: scatter into buffers mid-step
+            meta, k, v = self.engine.cache.export_pages("seq")
+            self.engine.cache.import_pages("dst", meta, k, v)
+            rid = self.engine.adopt_request(meta, k, v,
+                                            max_new_tokens=8)
+"""
+
+_MIGRATE_GOOD = """
+    class Mover:
+        def move(self, src, dst, stream, prompt):
+            # replica/frontend wrappers hold the engine lock
+            have = dst.probe_pages(prompt)
+            meta, k, v = src.export_pages(stream, have)
+            inner = dst.adopt(meta, k, v, max_new_tokens=8)
+            src.release_pages(stream)
+"""
+
+
+class TestPageMigrationLock:
+    def test_direct_cache_engine_migration_flags(self):
+        fs = lint(_MIGRATE_BAD, "paddle_tpu/serving/newmover.py",
+                  "page-migration-lock")
+        assert len(fs) == 3
+        assert all("front-end lock" in f.message for f in fs)
+
+    def test_replica_wrappers_pass(self):
+        # the disagg router's own shape: replica-level calls only
+        assert lint(_MIGRATE_GOOD, "paddle_tpu/serving/newmover.py",
+                    "page-migration-lock") == []
+
+    def test_allocator_engine_frontend_exempt(self):
+        for path in ("paddle_tpu/serving/kv_cache.py",
+                     "paddle_tpu/serving/engine.py",
+                     "paddle_tpu/serving/frontend.py"):
+            assert lint(_MIGRATE_BAD, path,
+                        "page-migration-lock") == []
 
 
 # ---------------------------------------------------------------------------

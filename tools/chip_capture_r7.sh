@@ -85,6 +85,13 @@ run bash tools/serving_router_smoke.sh
 #     [B, k+1] verify step compile no Pallas) — safe tier.
 run bash tools/serving_spec_smoke.sh
 
+# 5g. disaggregated prefill/decode smoke (round 14): mixed TTFT/TPOT
+#     workload through 1 prefill + 2 decode replicas (prefill-only
+#     hold, KV page migration, token-exact splice) vs 3 mixed
+#     replicas. CPU-mesh by construction (--smoke), host-orchestrated
+#     page transfer, plain XLA step programs — safe tier.
+run bash tools/serving_disagg_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

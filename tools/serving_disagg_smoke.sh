@@ -1,0 +1,21 @@
+#!/bin/bash
+# Disaggregated prefill/decode smoke for the chip-capture list
+# (round 14) — SAFE tier: `--smoke` forces the CPU mesh (no device
+# probe, zero chip touch); replicas are in-process engines whose step
+# programs are plain XLA (the paged Pallas stub stays interpret-gated)
+# and page migration is host-orchestrated gather/scatter, so NO
+# first-time Mosaic construct can reach the chip from this script.
+#
+# Replays the mixed TTFT-heavy + TPOT-heavy Poisson workload through
+# 1 prefill + 2 decode replicas (DisaggRouter: prefill-only hold, KV
+# page migration with the radix tree as transfer index, token-exact
+# stream splice) vs 3 mixed replicas; every stream must complete with
+# its full token budget. Banks BENCH_serving_disagg.json.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_disagg_smoke.sh > .bench_r4/serving_disagg_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --disagg \
+  | tee .bench_r4/serving_disagg_smoke.json
