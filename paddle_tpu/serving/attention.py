@@ -19,6 +19,15 @@ Two paths, selected by ``PADDLE_TPU_PAGED_KERNEL``:
 
 Both paths accept GQA natively (query heads grouped over KV heads, no
 materialized head repeat) and a Mistral-style sliding ``window``.
+
+int8 quantized cache (round 15): ``k_pages``/``v_pages`` may each be a
+``(codes int8 [NP, PS, KV, D], scales f32 [NP, PS, KV])`` tuple — the
+:class:`~.kv_cache.PagedKVCache` ``dtype="int8"`` layout. Dequant is
+inline, the generation-path recipe (``cached_attention``): the score
+einsum reads the CODES and the per-slot scales fold in post-dot
+(``s_t·(codes_t·q) == (s_t·codes_t)·q``), V scales fold into the
+softmax probabilities — no dequantized f32 copy of the pool is ever
+materialized, so the per-step HBM stream is the code bytes.
 """
 from __future__ import annotations
 
@@ -27,7 +36,22 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_attention", "paged_attention_ref"]
+__all__ = ["paged_attention", "paged_attention_ref", "quantize_q8"]
+
+
+def quantize_q8(x):
+    """Per-(slot, kv-head) absmax int8 quantization for the paged
+    cache's append path: ``[..., KV, D]`` → ``(codes int8 [..., KV, D],
+    scales f32 [..., KV])``. Deterministic (pure rounding), so
+    preemption recompute and failover re-prefill regenerate
+    bit-identical pages — the same recipe generation.py proved at
+    delta-NLL ~1e-3 (BENCH_kv8_quality.json)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    codes = jnp.clip(jnp.round(xf / s[..., None]), -127,
+                     127).astype(jnp.int8)
+    return codes, s
 
 
 def paged_attention(q, k_pages, v_pages, page_table, context_lens,
@@ -52,16 +76,31 @@ def paged_attention_ref(q, k_pages, v_pages, page_table, context_lens,
                         q_offsets, *, scale, window=None):
     """Gather-based reference path (see module docstring)."""
     b, s, nh, d = q.shape
-    _, ps, nkv, _ = k_pages.shape
+    k_quant = isinstance(k_pages, tuple)
+    kp = k_pages[0] if k_quant else k_pages
+    _, ps, nkv, _ = kp.shape
     p = page_table.shape[1]
     t = p * ps
-    # [B,P] pages -> contiguous [B,T,KV,D] logical view
-    kg = k_pages[page_table].reshape(b, t, nkv, d)
-    vg = v_pages[page_table].reshape(b, t, nkv, d)
     g = nh // nkv
     qg = q.reshape(b, s, nkv, g, d).astype(jnp.float32)
-    sc = jnp.einsum("bskgd,btkd->bkgst", qg,
-                    kg.astype(jnp.float32)) * scale
+    if k_quant:
+        # int8 pages: gather the codes, score in int8-as-f32, fold the
+        # K scales in post-dot on the [T] axis and the V scales into
+        # the probabilities — cached_attention's algebra over a page
+        # table
+        kq, ks = k_pages
+        vq, vs = v_pages
+        kg = kq[page_table].reshape(b, t, nkv, d)
+        ksg = ks[page_table].reshape(b, t, nkv)            # [B,T,KV]
+        sc = jnp.einsum("bskgd,btkd->bkgst", qg,
+                        kg.astype(jnp.float32)) * scale
+        sc = sc * jnp.transpose(ksg, (0, 2, 1))[:, :, None, None, :]
+    else:
+        # [B,P] pages -> contiguous [B,T,KV,D] logical view
+        kg = k_pages[page_table].reshape(b, t, nkv, d)
+        vg = v_pages[page_table].reshape(b, t, nkv, d)
+        sc = jnp.einsum("bskgd,btkd->bkgst", qg,
+                        kg.astype(jnp.float32)) * scale
     qpos = q_offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     kpos = jnp.arange(t, dtype=jnp.int32)
     mask = kpos[None, None, :] <= qpos[:, :, None]            # [B,S,T]
@@ -71,7 +110,15 @@ def paged_attention_ref(q, k_pages, v_pages, page_table, context_lens,
                        - int(window))
     sc = jnp.where(mask[:, None, None], sc, -jnp.inf)
     pr = jax.nn.softmax(sc, axis=-1)
-    out = jnp.einsum("bkgst,btkd->bskgd", pr, vg.astype(jnp.float32))
+    if k_quant:
+        vsg = vs[page_table].reshape(b, t, nkv)
+        pr = pr * jnp.transpose(vsg, (0, 2, 1))[:, :, None, None, :]
+        out = jnp.einsum("bkgst,btkd->bskgd", pr,
+                         vq[page_table].reshape(b, t, nkv, d)
+                         .astype(jnp.float32))
+    else:
+        out = jnp.einsum("bkgst,btkd->bskgd", pr,
+                         vg.astype(jnp.float32))
     return out.reshape(b, s, nh, d).astype(q.dtype)
 
 
@@ -80,17 +127,27 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table,
                             window=None):
     """Decode-shape (S=1) Pallas stub, interpret mode only (see module
     docstring). Grid over batch; one online-softmax pass over the page
-    list per cell."""
+    list per cell. int8 caches add the scale pools as two extra
+    operands; dequant happens per page inside the streaming loop (the
+    codes and the scale row of ONE page at a time — O(page) VMEM, the
+    shape a Mosaic build keeps)."""
     from jax.experimental import pallas as pl
 
     b, s, nh, d = q.shape
     assert s == 1, "kernel stub covers the decode (S=1) shape only"
+    quant = isinstance(k_pages, tuple)
+    if quant:
+        (k_pages, k_scales), (v_pages, v_scales) = k_pages, v_pages
     np_, ps, nkv, _ = k_pages.shape
     p = page_table.shape[1]
     g = nh // nkv
     win = int(window) if window else 0
 
-    def kernel(pt_ref, cl_ref, qo_ref, q_ref, k_ref, v_ref, o_ref):
+    def kernel(pt_ref, cl_ref, qo_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref = rest
+        else:
+            (o_ref,) = rest
         pt = pt_ref[...][0]                       # [P]
         cl = cl_ref[...][0]
         qpos = qo_ref[...][0]
@@ -106,6 +163,13 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table,
                 k_all, page, 0, keepdims=False).astype(jnp.float32)
             vb = jax.lax.dynamic_index_in_dim(
                 v_all, page, 0, keepdims=False).astype(jnp.float32)
+            if quant:
+                ksb = jax.lax.dynamic_index_in_dim(
+                    ks_ref[...], page, 0, keepdims=False)    # [PS,KV]
+                vsb = jax.lax.dynamic_index_in_dim(
+                    vs_ref[...], page, 0, keepdims=False)
+                kb = kb * ksb[..., None]
+                vb = vb * vsb[..., None]
             sc = jnp.einsum("kgd,tkd->kgt", qh, kb) * scale  # [KV,g,PS]
             tpos = i * ps + jnp.arange(ps, dtype=jnp.int32)
             ok = (tpos <= qpos) & (tpos < cl)
@@ -132,16 +196,23 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table,
         o_ref[...] = out.reshape(1, nh, d).astype(o_ref.dtype)
 
     full_k = pl.BlockSpec(k_pages.shape, lambda i: (0, 0, 0, 0))
+    in_specs = [pl.BlockSpec((1, p), lambda i: (i, 0)),
+                pl.BlockSpec((1,), lambda i: (i,)),
+                pl.BlockSpec((1,), lambda i: (i,)),
+                pl.BlockSpec((1, 1, nh, d), lambda i: (i, 0, 0, 0)),
+                full_k, full_k]
+    operands = [page_table, context_lens, q_offsets, q, k_pages,
+                v_pages]
+    if quant:
+        full_s = pl.BlockSpec(k_scales.shape, lambda i: (0, 0, 0))
+        in_specs += [full_s, full_s]
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid=(b,),
-        in_specs=[pl.BlockSpec((1, p), lambda i: (i, 0)),
-                  pl.BlockSpec((1,), lambda i: (i,)),
-                  pl.BlockSpec((1,), lambda i: (i,)),
-                  pl.BlockSpec((1, 1, nh, d), lambda i: (i, 0, 0, 0)),
-                  full_k, full_k],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nh, d), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nh, d), q.dtype),
         interpret=True,
-    )(page_table, context_lens, q_offsets, q, k_pages, v_pages)
+    )(*operands)
     return out[:, None]

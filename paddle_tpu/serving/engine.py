@@ -75,6 +75,20 @@ Batched speculative decoding (round 12):
 - Admission reserves each lane's worst-case round growth (k+1 tokens,
   ``Scheduler.spec_reserve_tokens``) so a verify burst never preempts
   a running decode; per-request opt-out rides ``speculative=False``.
+
+Quantized serving (round 15):
+
+- ``cache_dtype="int8"`` (or ``PADDLE_TPU_SERVING_KV_DTYPE``) selects
+  the quantized paged cache: codes + per-(slot, head) f32 scales,
+  quantized on append INSIDE the compiled step (deterministic — all
+  recompute/failover/migration exactness contracts hold within the
+  config), dequantized inline by ``paged_attention``; ~2x the bf16
+  page capacity at an equal ``hbm_budget_mb``. The draft cache follows
+  the SAME resolved dtype.
+- ``weight_quant="int8"|"int4"`` (or
+  ``PADDLE_TPU_SERVING_WEIGHT_QUANT``) swaps nn.Linear layers for
+  weight-only-quantized storage (lm_head exempt); the quantized
+  buffers ride every step as ARGUMENTS like all other weights.
 """
 from __future__ import annotations
 
@@ -126,12 +140,58 @@ class ServingEngine:
             raise TypeError(f"{what} must carry a .cfg")
         return cfg, core
 
+    @staticmethod
+    def _resolve_cache_dtype(cache_dtype, cfg):
+        """Resolve the KV cache dtype: explicit arg, else the
+        PADDLE_TPU_SERVING_KV_DTYPE knob, else bfloat16-or-float32 from
+        the model config. "int8" selects the quantized codes+scales
+        layout (generation.py's proven recipe); other integer dtypes
+        would astype-truncate K/V to garbage and are rejected."""
+        import jax.numpy as jnp
+        if cache_dtype is None:
+            cache_dtype = os.environ.get(
+                "PADDLE_TPU_SERVING_KV_DTYPE") or None
+        if cache_dtype is None:
+            return ("bfloat16" if getattr(cfg, "dtype", "float32")
+                    == "bfloat16" else "float32")
+        try:
+            name = str(jnp.dtype(cache_dtype))
+        except TypeError:
+            name = str(cache_dtype)
+        if name not in ("int8", "bfloat16", "float16", "float32"):
+            raise ValueError(
+                f"unsupported cache_dtype {cache_dtype!r}: use "
+                "'int8' (quantized codes+scales) or a float dtype")
+        return name
+
     def __init__(self, model, *, page_size=16, num_pages=None,
                  hbm_budget_mb=None, max_batch=8, prefill_chunk=32,
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
                  cache_dtype=None, on_event=None, prefix_cache=None,
-                 draft_model=None, speculative_k=None):
+                 draft_model=None, speculative_k=None,
+                 weight_quant=None):
         cfg, core = self._validate_causal_lm(model)
+        if weight_quant is None:
+            weight_quant = os.environ.get(
+                "PADDLE_TPU_SERVING_WEIGHT_QUANT") or None
+        if weight_quant not in (None, "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be 'int8', 'int4' or None, got "
+                f"{weight_quant!r}")
+        self.weight_quant = weight_quant
+        if weight_quant:
+            # decode is HBM-bound: int8/int4 weight storage halves/
+            # quarters the bytes every step streams. lm_head stays full
+            # precision (the usual LLM recipe, as in bench_generate).
+            # The swapped-in qweight/scale are BUFFERS, so they ride
+            # the compiled step as ARGUMENTS like every other weight
+            # (never baked constants — the HTTP-413/stale-cache
+            # contract holds). Converting an already-converted model is
+            # a no-op (only exact nn.Linear instances are swapped).
+            from ..nn.quant import convert_to_weight_only
+            convert_to_weight_only(model,
+                                   algo=f"weight_only_{weight_quant}",
+                                   exclude=("lm_head",))
         self.model = model
         self._core = core
         nh = cfg.num_attention_heads
@@ -144,10 +204,8 @@ class ServingEngine:
             raise ValueError(
                 f"max_seq_len({self.max_seq_len}) exceeds "
                 f"max_position_embeddings({maxpos})")
-        if cache_dtype is None:
-            cache_dtype = ("bfloat16"
-                           if getattr(cfg, "dtype", "float32")
-                           == "bfloat16" else "float32")
+        cache_dtype = self._resolve_cache_dtype(cache_dtype, cfg)
+        self.cache_dtype = cache_dtype
         if prefix_cache is None:
             prefix_cache = os.environ.get(
                 "PADDLE_TPU_SERVING_PREFIX_CACHE") == "1"
@@ -185,14 +243,15 @@ class ServingEngine:
             dnkv = getattr(dcfg, "num_key_value_heads", None) or dnh
             # same page geometry/count as the target (token-capacity
             # parity), narrow per-page bytes (the draft is the cheap
-            # model); no prefix cache — draft K/V is disposable state
+            # model); no prefix cache — draft K/V is disposable state.
+            # The dtype FOLLOWS the resolved cache_dtype (incl. int8):
+            # a duplicated bf16-or-f32 decision here once let draft and
+            # target caches silently diverge (regression-tested).
             self._draft_cache = PagedKVCache(
                 dcfg.num_hidden_layers, dnkv,
                 dcfg.hidden_size // dnh, page_size=page_size,
                 num_pages=self.cache.num_pages,
-                dtype=("bfloat16"
-                       if getattr(dcfg, "dtype", "float32") == "bfloat16"
-                       else "float32"))
+                dtype=self.cache_dtype)
         else:
             if speculative_k:
                 raise ValueError("speculative_k needs a draft_model")
@@ -205,6 +264,11 @@ class ServingEngine:
                                    watermark_frac=watermark_frac,
                                    spec_reserve_tokens=self.spec_k)
         self.metrics = ServingMetrics()
+        # capacity observability: with dtype="int8" the same HBM budget
+        # yields ~2*D/(D+4) x the bf16 page count — surface the honest
+        # per-page cost so a scrape can verify the sizing
+        self.metrics.kv_page_bytes.set(self.cache.bytes_total
+                                       / self.cache.num_pages)
         self.eos = eos_token_id
         self.window = getattr(cfg, "sliding_window", None) or None
         self._step_fn = None          # one jit fn; traces per bucket
@@ -830,14 +894,14 @@ class ServingEngine:
                 static_argnums=(0, 1))
         dc = self._draft_cache
         dwarrs = [t._data for t in self.draft._gen_state_tensors()]
+        k_ops, v_ops = dc.program_operands()
         _, _, _, k_pages, v_pages = self._draft_fn(
             False, False, dwarrs, jnp.asarray(ids),
             jnp.asarray(positions), jnp.asarray(pt), jnp.asarray(cl),
             jnp.asarray(slot_map), jnp.asarray(last_idx),
             tuple(jnp.asarray(a) for a in samp),
-            dc.k_pages, dc.v_pages)
-        dc.k_pages = list(k_pages)
-        dc.v_pages = list(v_pages)
+            k_ops, v_ops)
+        dc.store_operands(k_pages, v_pages)
 
     def _run_draft_propose(self, ids0, pos0, pt, cl0, slot_mat, samp,
                            sample_capable):
@@ -855,14 +919,14 @@ class ServingEngine:
                 static_argnums=(0,))
         dc = self._draft_cache
         dwarrs = [t._data for t in self.draft._gen_state_tensors()]
+        k_ops, v_ops = dc.program_operands()
         props, k_pages, v_pages = self._propose_fn(
             bool(sample_capable), dwarrs, jnp.asarray(ids0),
             jnp.asarray(pos0), jnp.asarray(pt), jnp.asarray(cl0),
             jnp.asarray(slot_mat),
             tuple(jnp.asarray(a) for a in samp),
-            dc.k_pages, dc.v_pages)
-        dc.k_pages = list(k_pages)
-        dc.v_pages = list(v_pages)
+            k_ops, v_ops)
+        dc.store_operands(k_pages, v_pages)
         return props
 
     def _prefill_chunk(self, req, start, end, events):
@@ -1193,15 +1257,15 @@ class ServingEngine:
                                   self._core, self.window),
                 static_argnums=(0, 1))
         warrs = [t._data for t in self.model._gen_state_tensors()]
+        k_ops, v_ops = self.cache.program_operands()
         tok, lp, logits, k_pages, v_pages = self._step_fn(
             bool(sample_capable), bool(multi_pos), warrs,
             jnp.asarray(ids), jnp.asarray(positions), jnp.asarray(pt),
             jnp.asarray(cl), jnp.asarray(slot_map),
             jnp.asarray(last_idx),
             tuple(jnp.asarray(a) for a in samp),
-            self.cache.k_pages, self.cache.v_pages)
-        self.cache.k_pages = list(k_pages)
-        self.cache.v_pages = list(v_pages)
+            k_ops, v_ops)
+        self.cache.store_operands(k_pages, v_pages)
         self._logits_dev = logits  # NOT fetched on the decode hot path
         return tok, lp
 
@@ -1252,7 +1316,7 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
     from ..core.autograd import no_grad
     from ..core.tensor import Tensor
     from ..incubate.nn.functional import fused_rotary_position_embedding
-    from .attention import paged_attention
+    from .attention import paged_attention, quantize_q8
 
     b, s = ids.shape
     flat_slots = slot_map.reshape(-1)
@@ -1270,13 +1334,34 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
             q, k, _ = fused_rotary_position_embedding(
                 q, k, None, position_ids=pos_t,
                 rotary_emb_base=at.cfg.rope_theta)
-            npg, ps, _, _ = kp.shape
-            kp = kp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
-                k._data.reshape(b * s, nkv, hd).astype(kp.dtype)
-            ).reshape(npg, ps, nkv, hd)
-            vp = vp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
-                v._data.reshape(b * s, nkv, hd).astype(vp.dtype)
-            ).reshape(npg, ps, nkv, hd)
+            if isinstance(kp, tuple):
+                # int8 cache: quantize-on-append (deterministic
+                # rounding — recompute regenerates identical pages),
+                # codes and per-(slot, head) scales scattered side by
+                # side; padded lanes land on the scratch page
+                kq, ksc = kp
+                vq, vsc = vp
+                npg, ps, _, _ = kq.shape
+                knq, kns = quantize_q8(k._data.reshape(b * s, nkv, hd))
+                vnq, vns = quantize_q8(v._data.reshape(b * s, nkv, hd))
+                kq = kq.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                    knq).reshape(npg, ps, nkv, hd)
+                ksc = ksc.reshape(npg * ps, nkv).at[flat_slots].set(
+                    kns).reshape(npg, ps, nkv)
+                vq = vq.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                    vnq).reshape(npg, ps, nkv, hd)
+                vsc = vsc.reshape(npg * ps, nkv).at[flat_slots].set(
+                    vns).reshape(npg, ps, nkv)
+                kp = (kq, ksc)
+                vp = (vq, vsc)
+            else:
+                npg, ps, _, _ = kp.shape
+                kp = kp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                    k._data.reshape(b * s, nkv, hd).astype(kp.dtype)
+                ).reshape(npg, ps, nkv, hd)
+                vp = vp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
+                    v._data.reshape(b * s, nkv, hd).astype(vp.dtype)
+                ).reshape(npg, ps, nkv, hd)
             new_k.append(kp)
             new_v.append(vp)
             out = paged_attention(

@@ -265,6 +265,13 @@ class ServingFrontend:
                     "free_pages": eng.cache.free_pages,
                     "reserved_pages": self._reserved_pages(),
                     "speculative_k": getattr(eng, "spec_k", 0),
+                    # quantized serving (round 15): the cache dtype is
+                    # part of the migration geometry contract, so a
+                    # disagg router can see dtype skew before a page
+                    # transfer bounces on GeometryMismatch
+                    "cache_dtype": getattr(eng, "cache_dtype",
+                                           str(eng.cache.dtype)),
+                    "weight_quant": getattr(eng, "weight_quant", None),
                     "requests_finished":
                         eng.metrics.requests_finished.value}
 

@@ -45,6 +45,21 @@ Sizing: pass ``num_pages`` directly or an ``hbm_budget_bytes`` — the
 constructor derives the page count from the per-page byte cost across
 all layers (both K and V), the way an engine start-up would budget VMEM/
 HBM headroom left over after weights.
+
+int8 quantized pages (``dtype="int8"``, round 15): each page stores
+int8 CODES plus a float32 per-(slot, kv-head) absmax scale — the same
+recipe the generation path proved at delta-NLL ~1e-3
+(``generation._quantize_q8`` / BENCH_kv8_quality.json). Scales live in
+separate ``k_scales``/``v_scales`` buffers of shape
+``[num_pages, page_size, n_kv_heads]`` so the attention einsums can
+stream the codes and fold the scales in post-dot; sizing accounts for
+them (``page_bytes_per_page`` adds 4 bytes per slot per head), so an
+``hbm_budget_bytes`` cache honestly yields ``2*D/(D+4)``× the bf16 page
+count.  Quantization happens ON APPEND inside the compiled step
+(deterministic rounding — preemption recompute and failover re-prefill
+regenerate bit-identical pages) and export/import/migration carry the
+scale arrays alongside the codes (each of the k/v array lists holds the
+``n_layers`` code arrays followed by the ``n_layers`` scale arrays).
 """
 from __future__ import annotations
 
@@ -129,6 +144,13 @@ class PagedKVCache:
         self.head_dim = int(head_dim)
         self.page_size = int(page_size)
         self.dtype = jnp.dtype(dtype)
+        # int8 = quantized codes + per-(slot, head) f32 scales; any other
+        # integer dtype would silently astype-truncate K/V to garbage
+        if self.dtype.kind in "iu" and str(self.dtype) != "int8":
+            raise ValueError(
+                f"unsupported cache dtype {dtype!r}: use a float dtype "
+                "or 'int8' (quantized codes + scales)")
+        self.quantized = str(self.dtype) == "int8"
         per_page = self.page_bytes_per_page(
             n_layers, n_kv_heads, head_dim, page_size, self.dtype)
         if num_pages is None:
@@ -151,6 +173,15 @@ class PagedKVCache:
                         for _ in range(self.n_layers)]
         self.v_pages = [jnp.zeros(shape, self.dtype)
                         for _ in range(self.n_layers)]
+        if self.quantized:
+            sshape = (num_pages, self.page_size, self.n_kv_heads)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(self.n_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(self.n_layers)]
+        else:
+            self.k_scales = None
+            self.v_scales = None
         # host bookkeeping
         self._free = deque(range(1, num_pages))  # page 0 = scratch
         self._rc = np.zeros(num_pages, np.int32)
@@ -169,10 +200,17 @@ class PagedKVCache:
     @staticmethod
     def page_bytes_per_page(n_layers, n_kv_heads, head_dim, page_size,
                             dtype):
-        """Bytes one page costs across every layer's K and V buffers."""
+        """Bytes one page costs across every layer's K and V buffers.
+        int8 pages carry their f32 scale rows (4 bytes per slot per kv
+        head, K and V each) so ``hbm_budget_bytes`` sizing honestly
+        reflects the quantized capacity."""
         import jax.numpy as jnp
+        dt = jnp.dtype(dtype)
+        per_slot_head = int(head_dim) * dt.itemsize
+        if str(dt) == "int8":
+            per_slot_head += 4  # the float32 absmax scale
         return (2 * int(n_layers) * int(page_size) * int(n_kv_heads)
-                * int(head_dim) * jnp.dtype(dtype).itemsize)
+                * per_slot_head)
 
     def pages_for(self, n_tokens):
         """Pages a sequence of n_tokens occupies."""
@@ -340,7 +378,8 @@ class PagedKVCache:
 
     def apply_copies(self, copies):
         """Perform pending copy-on-write page copies on the device
-        buffers (one batched gather-scatter per layer)."""
+        buffers (one batched gather-scatter per layer; quantized caches
+        copy the scale rows along with the codes)."""
         if not copies:
             return
         import jax.numpy as jnp
@@ -348,6 +387,33 @@ class PagedKVCache:
         dsts = jnp.asarray([d for _, d in copies], jnp.int32)
         self.k_pages = [kp.at[dsts].set(kp[srcs]) for kp in self.k_pages]
         self.v_pages = [vp.at[dsts].set(vp[srcs]) for vp in self.v_pages]
+        if self.quantized:
+            self.k_scales = [ks.at[dsts].set(ks[srcs])
+                             for ks in self.k_scales]
+            self.v_scales = [vs.at[dsts].set(vs[srcs])
+                             for vs in self.v_scales]
+
+    def program_operands(self):
+        """The per-layer K/V operands a compiled step program consumes:
+        plain arrays for float caches, ``(codes, scales)`` tuples for
+        int8 — the shape :func:`~.attention.paged_attention` and the
+        engine's scatter path branch on. Returns ``(k_ops, v_ops)``."""
+        if not self.quantized:
+            return self.k_pages, self.v_pages
+        return ([tuple(p) for p in zip(self.k_pages, self.k_scales)],
+                [tuple(p) for p in zip(self.v_pages, self.v_scales)])
+
+    def store_operands(self, new_k, new_v):
+        """Write a step program's updated K/V operands back (the inverse
+        of :meth:`program_operands`)."""
+        if not self.quantized:
+            self.k_pages = list(new_k)
+            self.v_pages = list(new_v)
+            return
+        self.k_pages = [p for p, _ in new_k]
+        self.k_scales = [s for _, s in new_k]
+        self.v_pages = [p for p, _ in new_v]
+        self.v_scales = [s for _, s in new_v]
 
     def page_table(self, seq_id, max_pages):
         """Padded int32 page-table row for the fixed-shape step program
@@ -505,7 +571,11 @@ class PagedKVCache:
         Read-only (refcounts untouched): migration is copy-then-release,
         so a failed transfer leaves the source sequence intact.  Returns
         ``(meta, k_arrays, v_arrays)`` — per-layer numpy arrays of shape
-        ``[n_pages, page_size, n_kv_heads, head_dim]``.
+        ``[n_pages, page_size, n_kv_heads, head_dim]``.  Quantized
+        (int8) caches append the per-layer float32 scale arrays
+        (``[n_pages, page_size, n_kv_heads]``) AFTER the code arrays in
+        each list — the wire format records every array's own shape and
+        dtype, so the scale geometry rides the same payload.
         """
         if seq_id not in self._tables:
             raise KeyError(f"export_pages: unknown sequence {seq_id!r}")
@@ -522,11 +592,18 @@ class PagedKVCache:
             empty = [np.empty((0, self.page_size, self.n_kv_heads,
                                self.head_dim), self.dtype)
                      for _ in range(self.n_layers)]
+            if self.quantized:
+                empty += [np.empty((0, self.page_size, self.n_kv_heads),
+                                   np.float32)
+                          for _ in range(self.n_layers)]
             return meta, empty, [a.copy() for a in empty]
         import jax.numpy as jnp
         idx = jnp.asarray(pages, jnp.int32)
         k = [np.asarray(kp[idx]) for kp in self.k_pages]
         v = [np.asarray(vp[idx]) for vp in self.v_pages]
+        if self.quantized:
+            k += [np.asarray(ks[idx]) for ks in self.k_scales]
+            v += [np.asarray(vs[idx]) for vs in self.v_scales]
         return meta, k, v
 
     def import_pages(self, seq_id, meta, k_arrays, v_arrays,
@@ -558,16 +635,25 @@ class PagedKVCache:
                 f"{self.pages_for(seq_len)} page(s), payload covers "
                 f"{skip}+{n_pages}")
         shape = (n_pages, self.page_size, self.n_kv_heads, self.head_dim)
+        sshape = (n_pages, self.page_size, self.n_kv_heads)
+        per_list = self.n_layers * (2 if self.quantized else 1)
         for arrs, what in ((k_arrays, "k"), (v_arrays, "v")):
-            if len(arrs) != self.n_layers:
+            if len(arrs) != per_list:
                 raise GeometryMismatch(
-                    f"{what} payload has {len(arrs)} layer(s), cache "
-                    f"has {self.n_layers}")
-            for a in arrs:
+                    f"{what} payload has {len(arrs)} array(s), this "
+                    f"cache expects {per_list} ({self.n_layers} "
+                    "layer(s)" + (" of codes + scales)" if self.quantized
+                                  else ")"))
+            for a in arrs[:self.n_layers]:
                 if tuple(a.shape) != shape:
                     raise GeometryMismatch(
                         f"{what} page array shape {tuple(a.shape)} != "
                         f"{shape}")
+            for a in arrs[self.n_layers:]:
+                if tuple(a.shape) != sshape:
+                    raise GeometryMismatch(
+                        f"{what} scale array shape {tuple(a.shape)} != "
+                        f"{sshape}")
         # pin the locally-resident prefix; must match what the exporter
         # skipped or the page/token alignment breaks (PrefixDrift)
         if self.prefix_cache_enabled and prompt is not None:
@@ -605,6 +691,15 @@ class PagedKVCache:
             self.v_pages = [
                 vp.at[dsts].set(jnp.asarray(a, vp.dtype))
                 for vp, a in zip(self.v_pages, v_arrays)]
+            if self.quantized:
+                self.k_scales = [
+                    ks.at[dsts].set(jnp.asarray(a, ks.dtype))
+                    for ks, a in zip(self.k_scales,
+                                     k_arrays[self.n_layers:])]
+                self.v_scales = [
+                    vs.at[dsts].set(jnp.asarray(a, vs.dtype))
+                    for vs, a in zip(self.v_scales,
+                                     v_arrays[self.n_layers:])]
         if self.prefix_cache_enabled and prompt is not None:
             # the imported prompt pages are canonical K/V: later
             # shared-prefix requests on THIS replica hit them.  Bounded

@@ -184,6 +184,9 @@ class ServingMetrics:
         self.prefix_hit_rate = Gauge()        # hit/(hit+miss), cumulative
         self.cached_pages_gauge = Gauge()     # pages resident in the tree
         self.spec_acceptance_rate = Gauge()   # accepted/proposed, cumul.
+        # quantized serving (round 15): honest per-page byte cost incl.
+        # int8 scale rows — what the hbm_budget sizing divides by
+        self.kv_page_bytes = Gauge()
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}

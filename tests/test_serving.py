@@ -671,7 +671,8 @@ class TestServingSweep:
                     "running_gauge", "prefix_hit_rate",
                     "cached_pages_gauge", "spec_rounds",
                     "spec_draft_tokens", "spec_accepted_tokens",
-                    "spec_fallbacks", "spec_acceptance_rate"):
+                    "spec_fallbacks", "spec_acceptance_rate",
+                    "kv_page_bytes"):
             assert key in ex, key
         assert ex["ttft_s"]["p50"] == pytest.approx(0.1)
         import json

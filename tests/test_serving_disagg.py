@@ -228,13 +228,19 @@ class TestMigrationAllocator:
         assert dst.seq_len("d") == 16
         check_conservation(dst)
 
-    def test_conservation_fuzz_with_migration(self):
+    @pytest.mark.parametrize("dtype", ["float32", "int8"])
+    def test_conservation_fuzz_with_migration(self, dtype):
         """2500 random ops over TWO allocators — append/fork/free/
         free_tail/prefix acquire+commit/export+import/release/clear —
-        no leaked or double-freed page on either side, ever."""
+        no leaked or double-freed page on either side, ever.  The int8
+        geometry routes every migration through the WIRE FORMAT
+        (serialize/deserialize) so the scale arrays must migrate,
+        conserve, and roundtrip byte-exactly alongside the codes."""
         rng = np.random.default_rng(42)
         caches = [PagedKVCache(1, 2, 4, page_size=4, num_pages=48,
-                               prefix_cache=True) for _ in range(2)]
+                               prefix_cache=True, dtype=dtype)
+                  for _ in range(2)]
+        quant = dtype == "int8"
         live = [dict(), dict()]  # per-cache: sid -> prompt
         next_id = [0]
 
@@ -299,14 +305,30 @@ class TestMigrationAllocator:
                 skip = other.probe_prefix(prompt, hist)
                 skip = min(skip, len(c._tables[sid]))
                 dst_id = fresh(1 - side)
+
+                def ship(skip_pages):
+                    meta, k, v = c.export_pages(sid,
+                                                skip_pages=skip_pages)
+                    if quant:
+                        # int8 fuzz shape: every transfer crosses the
+                        # wire — codes AND scales must come back
+                        # byte-identical before they scatter
+                        buf = serialize_pages(meta, k, v)
+                        m2, k2, v2, _ = deserialize_pages(buf)
+                        assert m2 == meta
+                        for a, b in zip(k + v, k2 + v2):
+                            assert a.dtype == b.dtype
+                            assert (np.asarray(a) == b).all()
+                        meta, k, v = m2, k2, v2
+                    return meta, k, v
+
                 try:
-                    meta, k, v = c.export_pages(sid, skip_pages=skip)
+                    meta, k, v = ship(skip)
                     other.import_pages(dst_id, meta, k, v,
                                        prompt=prompt, hist_len=hist)
                 except PrefixDrift as e:
-                    meta, k, v = c.export_pages(
-                        sid, skip_pages=min(e.cached_pages,
-                                            len(c._tables[sid])))
+                    meta, k, v = ship(min(e.cached_pages,
+                                          len(c._tables[sid])))
                     try:
                         other.import_pages(dst_id, meta, k, v,
                                            prompt=prompt,

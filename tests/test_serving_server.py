@@ -378,7 +378,8 @@ class TestMetricsEndpoint:
             h = json.loads(data)
             assert h["status"] == "ok"
             for key in ("waiting", "live", "free_pages",
-                        "requests_finished"):
+                        "requests_finished", "cache_dtype",
+                        "weight_quant"):
                 assert key in h, key
 
 

@@ -92,6 +92,14 @@ run bash tools/serving_spec_smoke.sh
 #     page transfer, plain XLA step programs — safe tier.
 run bash tools/serving_disagg_smoke.sh
 
+# 5h. quantized-serving smoke (round 15): int8 paged KV (codes+scales,
+#     quantize-on-append) vs bf16 at an equal fixed hbm_budget_mb
+#     through a shedding front-end, plus the serving-path held-out-NLL
+#     quality gate (|delta| < 0.01 asserted). CPU-mesh by construction
+#     (--smoke); the SAME plain-XLA step program class as 5b-5g, no
+#     new Pallas shapes — safe tier, zero chip debt.
+run bash tools/serving_kv8_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

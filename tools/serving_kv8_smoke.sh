@@ -1,0 +1,23 @@
+#!/bin/bash
+# Quantized-serving smoke for the chip-capture list (round 15) — SAFE
+# tier: `--smoke` forces the CPU mesh (no device probe, zero chip
+# touch); the int8 paged cache's quantize-on-append and dequant run
+# inside the SAME plain-XLA step program class every other serving
+# smoke compiles (the paged Pallas stub stays interpret-gated), so NO
+# first-time Mosaic construct can reach the chip from this script —
+# zero chip debt added.
+#
+# Replays the memory-pressure Poisson trace through a shedding
+# front-end at an equal fixed hbm_budget_mb, bf16 cache vs int8
+# codes+scales (expect ~1.88x allocatable pages at head_dim 64), then
+# runs the serving-path held-out-NLL quality gate (bf16 vs int8 vs
+# int8+weight-only-int8; asserts |delta-NLL| < 0.01). Banks
+# BENCH_serving_kv8.json.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_kv8_smoke.sh > .bench_r4/serving_kv8_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --kv8 \
+  | tee .bench_r4/serving_kv8_smoke.json
