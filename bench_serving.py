@@ -5,6 +5,22 @@ model and report throughput + latency.
 Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
                                [--router] [--spec] [--disagg] [--kv8]
+                               [--trace] [--trace-out FILE]
+
+`--trace` is the round-16 observability OVERHEAD GUARD: the same
+Poisson trace replays through two warm engines — tracing on (the
+always-on default) and tracing off (PADDLE_TPU_SERVING_TRACE=0 at
+engine construction) — two-point marginal each, and the artifact
+records the on/off marginal ratio. The acceptance contract is that
+span emission stays within noise (<3% of the trace-off marginal),
+asserted on quiet-VM (non-smoke) runs; a chrome trace of the traced
+replay is exported and round-tripped through
+paddle_tpu.profiler.load_profiler_result. Banks
+BENCH_serving_trace.json.
+
+`--trace-out FILE` (offline mode) drops a chrome://tracing JSON of the
+whole replay — one pid for the engine, one tid per request lane — that
+chrome://tracing / Perfetto opens directly.
 
 `--kv8` measures quantized serving (round 15) two ways. (1) MEMORY
 PRESSURE: the same Poisson trace replays through a front-end whose
@@ -117,6 +133,14 @@ if disagg_mode:
 kv8_mode = "--kv8" in sys.argv
 if kv8_mode:
     sys.argv.remove("--kv8")
+trace_mode = "--trace" in sys.argv
+if trace_mode:
+    sys.argv.remove("--trace")
+trace_out = None
+if "--trace-out" in sys.argv:
+    i = sys.argv.index("--trace-out")
+    trace_out = sys.argv[i + 1]
+    del sys.argv[i:i + 2]
 n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else (8 if smoke else 32)
 rate = float(sys.argv[2]) if len(sys.argv) > 2 else 16.0
 max_new = int(sys.argv[3]) if len(sys.argv) > 3 else (8 if smoke else 64)
@@ -277,6 +301,9 @@ def main():
     if kv8_mode:
         _bench_kv8(on_tpu)
         return
+    if trace_mode:
+        _bench_trace_overhead(model, cfg, engine_kw, on_tpu)
+        return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
     new_q = max(1, max_new // 4)
@@ -290,8 +317,21 @@ def main():
 
     wall_q, toks_q, _ = run(model, arrivals, prompts, new_q,
                             **engine_kw)
-    wall, toks, metrics = run(model, arrivals, prompts, max_new,
-                              **engine_kw)
+    if trace_out and not server_mode:
+        # --trace-out: drive the full-budget replay through an explicit
+        # engine so its span store survives the replay, then drop a
+        # chrome://tracing JSON (one pid, one tid per request lane)
+        from paddle_tpu.serving import ServingEngine, export_chrome_trace
+        eng = ServingEngine(model, **engine_kw)
+        wall, toks, metrics = run(model, arrivals, prompts, max_new,
+                                  engine=eng)
+        export_chrome_trace(
+            trace_out, [(0, "serving-engine", eng.trace.timelines())])
+        print(json.dumps({"event": "trace_exported", "path": trace_out,
+                          "timelines": len(eng.trace.timelines())}))
+    else:
+        wall, toks, metrics = run(model, arrivals, prompts, max_new,
+                                  **engine_kw)
 
     marginal = None
     if wall > wall_q and toks > toks_q:
@@ -986,6 +1026,152 @@ def _bench_kv8(on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_kv8.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_trace_overhead(model, cfg, engine_kw, on_tpu):
+    """Tracing overhead guard (round 16): the SAME Poisson trace
+    replays through one warm engine per config — span tracing ON (the
+    always-on default) and OFF (PADDLE_TPU_SERVING_TRACE=0 at engine
+    construction) — with a two-point marginal each (quarter vs full
+    decode budget, the PERF.md discipline that cancels fixed per-replay
+    overhead).  The acceptance contract: the trace-on marginal stays
+    within 3% of trace-off.  Asserted on non-smoke runs only — under
+    suite/CPU load marginal ratios are noise (CLAUDE.md round-4), and
+    the in-suite smoke replay must not flake on them; the BANKED
+    quiet-VM artifact is the gate.  Also exports the traced replay as
+    chrome JSON and round-trips it through
+    paddle_tpu.profiler.load_profiler_result.  One JSON line ->
+    BENCH_serving_trace.json."""
+    import os
+    import statistics
+    import tempfile
+
+    from paddle_tpu.profiler import load_profiler_result
+    from paddle_tpu.serving import (ServingEngine, ServingMetrics,
+                                    export_chrome_trace)
+
+    _, prompts = make_trace(n_requests, rate, cfg.vocab_size)
+    new_q = max(1, max_new // 4)
+    reps = 1 if smoke else 5
+
+    # Measurement discipline, tuned on this VM (all two failure modes
+    # below make the ratio measure the HARNESS, not tracing):
+    # - SYNCHRONOUS submission, not the Poisson arrival replay: the
+    #   3% contract is about per-step span-emission cost, and arrival-
+    #   gap/step-boundary interaction swings the Poisson marginal
+    #   ~30% run to run — far above the signal.  Batch-submit drains
+    #   are reproducible to ~2% here.
+    # - Engines are built fresh per repetition and DROPPED before the
+    #   next one: keeping measured engines (device page pools, jit
+    #   caches) alive inflates later configs' step time up to ~2x.
+    # - Configs ALTERNATE (off/on per repetition, after a throwaway
+    #   process-warmup engine — the first engine in a process runs
+    #   ~25% slow) and the banked ratio is median(on)/median(off).
+    def marginal_once(trace_on):
+        env_before = os.environ.get("PADDLE_TPU_SERVING_TRACE")
+        os.environ["PADDLE_TPU_SERVING_TRACE"] = \
+            "1" if trace_on else "0"
+        try:
+            eng = ServingEngine(model, **engine_kw)
+        finally:
+            if env_before is None:
+                os.environ.pop("PADDLE_TPU_SERVING_TRACE", None)
+            else:
+                os.environ["PADDLE_TPU_SERVING_TRACE"] = env_before
+        assert eng.trace.enabled is trace_on
+
+        def drain(budget):
+            for p in prompts:
+                eng.add_request(p, max_new_tokens=budget)
+            t0 = time.perf_counter()
+            eng.run()
+            return time.perf_counter() - t0
+
+        drain(new_q)   # warm every bucketed program class
+        drain(max_new)
+        eng.metrics = ServingMetrics()
+        wall_q = drain(new_q)
+        wall_f = drain(max_new)
+        m = eng.metrics.export()
+        marginal = (len(prompts) * (max_new - new_q)
+                    / (wall_f - wall_q))
+        timelines = eng.trace.timelines() if trace_on else None
+        if not trace_on:
+            assert not eng.trace.timelines(), \
+                "trace-off engine recorded spans"
+        return {"marginal": marginal, "wall_full_s": wall_f,
+                "step_duration_p50_s": m["step_duration_s"]["p50"],
+                "timelines": timelines}
+
+    # throwaway process warmup (neither config measured)
+    marginal_once(False)
+    runs_off, runs_on = [], []
+    timelines = None
+    for _ in range(reps):
+        runs_off.append(marginal_once(False))
+        r_on = marginal_once(True)
+        timelines = r_on.pop("timelines")
+        runs_on.append(r_on)
+    for r in runs_off:
+        r.pop("timelines")
+    assert timelines, "trace-on engine recorded nothing"
+
+    # chrome export of the traced replay: valid trace JSON end to end
+    with tempfile.NamedTemporaryFile(suffix=".json",
+                                     delete=False) as f:
+        trace_path = f.name
+    export_chrome_trace(trace_path,
+                        [(0, "serving-engine", timelines)])
+    loaded = load_profiler_result(trace_path)
+    spans = [e for e in loaded["traceEvents"] if e.get("ph") == "X"]
+    assert spans, "chrome export is empty"
+    os.unlink(trace_path)
+
+    med_on = statistics.median(r["marginal"] for r in runs_on)
+    med_off = statistics.median(r["marginal"] for r in runs_off)
+    # per-PAIR ratios, then the median: adjacent off/on runs share the
+    # VM weather, so pairing cancels slow drift the two config-level
+    # medians would keep
+    pair_ratios = [on_r["marginal"] / off_r["marginal"]
+                   for off_r, on_r in zip(runs_off, runs_on)]
+    ratio = round(statistics.median(pair_ratios), 4)
+    overhead_ok = abs(1.0 - ratio) < 0.03
+    if not smoke:
+        # asserted only on quiet-VM (non-smoke) runs: under suite/CPU
+        # load marginals are noise (CLAUDE.md round-4) and the in-suite
+        # smoke replay must not flake on them
+        assert overhead_ok, (
+            f"tracing overhead outside the 3% contract: on/off "
+            f"marginal ratio {ratio} (on={runs_on}, off={runs_off})")
+    out = {
+        "metric": "serving_trace_marginal_ratio"
+                  + ("" if on_tpu else "_cpu"),
+        "value": ratio,
+        "unit": "trace-on / trace-off marginal decode tok/s (median of "
+                f"{reps} alternating two-point marginals, synchronous "
+                "drain; contract: within 3% of 1.0)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "repetitions": reps,
+        "trace_on": {
+            "tok_per_s_marginal": round(med_on, 1),
+            "step_duration_p50_s": statistics.median(
+                r["step_duration_p50_s"] for r in runs_on),
+            "runs": [round(r["marginal"], 1) for r in runs_on]},
+        "trace_off": {
+            "tok_per_s_marginal": round(med_off, 1),
+            "step_duration_p50_s": statistics.median(
+                r["step_duration_p50_s"] for r in runs_off),
+            "runs": [round(r["marginal"], 1) for r in runs_off]},
+        "overhead_within_3pct": overhead_ok,
+        "traced_requests": len(timelines),
+        "chrome_events": len(spans),
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_trace.json", "w") as f:
         f.write(line + "\n")
 
 

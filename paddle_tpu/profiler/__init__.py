@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from collections import defaultdict
 from enum import Enum
@@ -22,7 +23,8 @@ from enum import Enum
 import jax
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "events_dropped"]
 
 
 class ProfilerTarget(Enum):
@@ -60,12 +62,44 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
     return scheduler
 
 
+# The host event table is shared across threads (the serving engine's
+# concurrent loop threads all emit spans): appends take _events_lock,
+# the nesting stack is THREAD-LOCAL (a span begun on thread A must
+# never be popped by thread B), and each event carries its emitting
+# thread's ident as the chrome `tid` so concurrent timelines render as
+# separate lanes instead of colliding on tid 0.  The table is bounded
+# (PADDLE_TPU_PROFILE_MAX_EVENTS, default 1e6): overflow is counted,
+# not stored — a runaway span loop degrades the profile, never memory.
 _events: list[dict] = []
-_event_stack: list = []
+_events_lock = threading.Lock()
+_events_dropped = 0
+_tls = threading.local()
+
+_MAX_EVENTS_ENV = "PADDLE_TPU_PROFILE_MAX_EVENTS"
+
+
+def _max_events():
+    try:
+        return max(1, int(os.environ.get(_MAX_EVENTS_ENV, "1000000")))
+    except ValueError:
+        return 1000000
+
+
+def _thread_stack():
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def events_dropped():
+    """Spans shed by the event-table cap since the last start()."""
+    return _events_dropped
 
 
 class RecordEvent:
-    """Host-side span; nests; feeds summary() and chrome export."""
+    """Host-side span; nests (per thread); feeds summary() and chrome
+    export.  Safe to begin/end concurrently from several threads."""
 
     def __init__(self, name, event_type=None):
         self.name = name
@@ -79,17 +113,24 @@ class RecordEvent:
             self._ann.__enter__()
         except Exception:
             self._ann = None
-        _event_stack.append(self)
+        _thread_stack().append(self)
 
     def end(self):
+        global _events_dropped
         t1 = time.perf_counter_ns()
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
-        if _event_stack and _event_stack[-1] is self:
-            _event_stack.pop()
-        _events.append({"name": self.name, "ts": self._t0 / 1e3,
-                        "dur": (t1 - self._t0) / 1e3, "ph": "X",
-                        "pid": os.getpid(), "tid": 0})
+        stack = _thread_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        ev = {"name": self.name, "ts": self._t0 / 1e3,
+              "dur": (t1 - self._t0) / 1e3, "ph": "X",
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        with _events_lock:
+            if len(_events) >= _max_events():
+                _events_dropped += 1
+            else:
+                _events.append(ev)
 
     def __enter__(self):
         self.begin()
@@ -119,7 +160,10 @@ class Profiler:
         self._t_last = None
 
     def start(self):
-        _events.clear()
+        global _events_dropped
+        with _events_lock:
+            _events.clear()
+            _events_dropped = 0
         self._state = self._scheduler(self._step)
         self._maybe_toggle()
         self._t_last = time.perf_counter()
@@ -167,14 +211,18 @@ class Profiler:
         os.makedirs(dir_name, exist_ok=True)
         path = os.path.join(dir_name,
                             (worker_name or "worker") + ".json")
+        with _events_lock:
+            snapshot = list(_events)
         with open(path, "w") as f:
-            json.dump({"traceEvents": _events}, f)
+            json.dump({"traceEvents": snapshot}, f)
         return path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         agg = defaultdict(lambda: [0.0, 0])
-        for e in _events:
+        with _events_lock:
+            snapshot = list(_events)
+        for e in snapshot:
             agg[e["name"]][0] += e["dur"] / 1e3
             agg[e["name"]][1] += 1
         lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}",

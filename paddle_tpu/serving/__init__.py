@@ -53,6 +53,18 @@ Layers:
   fleet from a replica factory and shrinks it through the rolling
   drain, driven by reserved-page load + TTFT histogram windows.
 
+- :mod:`trace` — serving-wide observability (round 16): an always-on
+  capped span timeline per request (queued/prefill/decode/spec/
+  preempt/recompute/prefix-hit/migration/failover-splice/held, emitted
+  under the existing locks) + a per-engine flight recorder ring
+  (step composition/wall, admissions, sheds, preemptions, faults,
+  drain, loop errors — dumped to the structured log on loop failure);
+  ``/debug/trace?request_id=`` and ``/debug/flight`` expose both as
+  JSON, router-merged across replicas like /metrics; completed
+  timelines export as chrome://tracing JSON in the
+  ``paddle_tpu.profiler`` event format (``bench_serving.py
+  --trace-out``).
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
@@ -77,6 +89,9 @@ from .sampling import fused_sample  # noqa: F401
 from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
                         SchedulerOutput)
 from .server import ServingServer  # noqa: F401
+from .trace import (FlightRecorder, RequestTrace,  # noqa: F401
+                    ServingTrace, chrome_trace_events,
+                    export_chrome_trace)
 
 __all__ = [
     "PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
@@ -91,4 +106,6 @@ __all__ = [
     "DisaggRouter", "DisaggStream", "FleetAutoscaler",
     "GeometryMismatch", "PrefixDrift", "WireFormatError",
     "serialize_pages", "deserialize_pages",
+    "ServingTrace", "RequestTrace", "FlightRecorder",
+    "chrome_trace_events", "export_chrome_trace",
 ]

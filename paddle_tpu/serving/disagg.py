@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 
 from .frontend import ROLES, Rejected, Unavailable
 from .kv_cache import GeometryMismatch, PrefixDrift
@@ -187,6 +188,10 @@ class DisaggRouter(ServingRouter):
             stream.replica_idx = idx
             self.metrics.routed_total.inc(policy="disagg_prefill",
                                           replica=idx)
+            if self.trace.enabled:
+                self.trace.span(stream.req_id, "routed",
+                                time.perf_counter(), replica=idx,
+                                policy="disagg_prefill")
             if self.policy == "cache_aware":
                 self._record(stream.prompt, idx)
             return stream
@@ -228,6 +233,7 @@ class DisaggRouter(ServingRouter):
         src_idx = stream.replica_idx
         src = self.replicas[src_idx]
         kwargs = self._adopt_kwargs(stream)
+        mig_t0 = time.perf_counter()
         # decode replicas first, mixed as migration-capable spill
         order = self._by_load(
             self._role_idxs(("decode",), exclude={src_idx})) \
@@ -281,6 +287,16 @@ class DisaggRouter(ServingRouter):
             self.metrics.migrated_pages_total.inc(n_pages)
             self.metrics.routed_total.inc(policy="disagg_decode",
                                           replica=dst_idx)
+            if self.trace.enabled:
+                self.trace.span(
+                    stream.req_id, "migration", mig_t0,
+                    time.perf_counter() - mig_t0, pages=n_pages,
+                    skip_pages=int(meta["skip_pages"]),
+                    from_replica=src_idx, to_replica=dst_idx)
+                self.trace.flight.record(
+                    "migrate", from_replica=src_idx,
+                    to_replica=dst_idx, pages=n_pages,
+                    request_id=stream.request_id)
             _log.info(json.dumps({
                 "event": "router_migrate", "from": src_idx,
                 "to": dst_idx, "pages": n_pages,
@@ -295,6 +311,13 @@ class DisaggRouter(ServingRouter):
         except Exception:
             pass
         self.metrics.migration_fallbacks_total.inc()
+        if self.trace.enabled:
+            self.trace.span(stream.req_id, "migration", mig_t0,
+                            time.perf_counter() - mig_t0,
+                            fallback=True, from_replica=src_idx)
+            self.trace.flight.record("migrate_fallback",
+                                     from_replica=src_idx,
+                                     request_id=stream.request_id)
         _log.warning(json.dumps({
             "event": "router_migrate_fallback", "from": src_idx,
             "request_id": stream.request_id,

@@ -104,6 +104,7 @@ import numpy as np
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
+from .trace import ServingTrace
 
 __all__ = ["EngineDraining", "FaultInjected", "ServingEngine"]
 
@@ -264,6 +265,10 @@ class ServingEngine:
                                    watermark_frac=watermark_frac,
                                    spec_reserve_tokens=self.spec_k)
         self.metrics = ServingMetrics()
+        # always-on span timeline + flight recorder (round 16): every
+        # mutation happens from the thread that drives the engine —
+        # i.e. under the front-end lock — so no new locking appears
+        self.trace = ServingTrace()
         # capacity observability: with dtype="int8" the same HBM budget
         # yields ~2*D/(D+4) x the bf16 page count — surface the honest
         # per-page cost so a scrape can verify the sizing
@@ -349,6 +354,17 @@ class ServingEngine:
             req.cached_pages = self.cache.acquire_prefix(
                 req.seq_id, prompt, prompt.size)
         self.scheduler.add(req)
+        if self.trace.enabled:
+            self.trace.begin(req.req_id, req.request_id)
+            self.trace.mark(req.req_id, "queued_t0", now)
+            if req.cached_pages:
+                self.trace.span(req.req_id, "prefix_hit", now,
+                                pages=req.cached_pages)
+            self.trace.flight.record(
+                "admit", req_id=req.req_id,
+                request_id=req.request_id,
+                prompt_tokens=int(prompt.size),
+                max_new_tokens=int(max_new_tokens))
         return req.req_id
 
     def step(self):
@@ -369,6 +385,17 @@ class ServingEngine:
     def _step_inner(self):
         now = self._now()
         out = self.scheduler.schedule(now)
+        if self.trace.enabled:
+            # composition FIRST, duration at the end: a loop failure
+            # mid-step leaves the failing step's batch shape in the
+            # ring for the post-mortem dump
+            self.trace.flight.record(
+                "step_begin",
+                decode=len(out.decode),
+                prefill=(out.prefill[0].req_id
+                         if out.prefill is not None else None),
+                expired=len(out.expired),
+                waiting=self.scheduler.queue_depth())
         events = []
         for r in out.expired:  # graceful: pages freed, partial output kept
             if self.cache.has_seq(r.seq_id):
@@ -407,6 +434,12 @@ class ServingEngine:
         self.metrics.page_occupancy_gauge.set(self.cache.occupancy())
         self.metrics.running_gauge.set(len(self.scheduler.running))
         self._sync_prefix_metrics()
+        step_wall = self._now() - now
+        self.metrics.step_duration_s.record(step_wall)
+        if self.trace.enabled:
+            self.trace.flight.record("step_end",
+                                     wall_s=round(step_wall, 6),
+                                     events=len(events))
         return events
 
     def run(self, max_steps=100000):
@@ -456,6 +489,8 @@ class ServingEngine:
         req.state = RequestState.FINISHED
         req.finish_reason = "cancelled"
         self.metrics.cancellations.inc()
+        if self.trace.enabled:
+            self.trace.flight.record("cancel", req_id=req_id)
         self._record_finish(req, [])
         return True
 
@@ -467,6 +502,10 @@ class ServingEngine:
         """Refuse new admissions; everything already queued (waiting/
         prefilling/running) keeps going to completion."""
         self._draining = True
+        if self.trace.enabled:
+            self.trace.flight.record(
+                "drain", live=len(self.scheduler.live_requests()),
+                waiting=self.scheduler.queue_depth())
 
     def resume_admissions(self):
         """Lift drain mode (the rolling-drain re-admit path): a drained
@@ -507,6 +546,8 @@ class ServingEngine:
         rate = os.environ.get("PADDLE_TPU_SERVING_FAULT_ERROR_RATE")
         if rate and self._fault_rng.random() < float(rate):
             self.metrics.faults_injected.inc()
+            if self.trace.enabled:
+                self.trace.flight.record("fault", rate=float(rate))
             raise FaultInjected(
                 "injected step fault "
                 f"(PADDLE_TPU_SERVING_FAULT_ERROR_RATE={rate})")
@@ -576,6 +617,12 @@ class ServingEngine:
         self._free_draft_seq(victim.seq_id)
         self.scheduler.preempt(victim)
         self.metrics.preemptions.inc()
+        if self.trace.enabled:
+            now = self._now()
+            self.trace.span(victim.req_id, "preempted", now,
+                            tokens_kept=len(victim.out_tokens))
+            self.trace.mark(victim.req_id, "queued_t0", now)
+            self.trace.flight.record("preempt", req_id=victim.req_id)
 
     def _free_draft_seq(self, seq_id):
         """Drop a lane's draft-cache state (request finished/cancelled/
@@ -604,6 +651,7 @@ class ServingEngine:
             self._plain_decode(plain, events)
 
     def _plain_decode(self, reqs, events):
+        t0 = self._now()
         alloc = []
         for r in reqs:
             if r.state != RequestState.RUNNING:
@@ -636,6 +684,11 @@ class ServingEngine:
             for i, (r, _) in enumerate(active):
                 self._emit_token(r, int(toks[i]), events,
                                  logprob=float(lps[i]))
+        if self.trace.enabled:
+            dur = self._now() - t0
+            for r, _ in active:
+                self.trace.run_span(r.req_id, "decode_round", t0, dur,
+                                    batch=len(active))
 
     def _build_decode_batch(self, active):
         """Stage the decode batch into PERSISTENT per-bucket host
@@ -758,6 +811,7 @@ class ServingEngine:
         ``plain`` (token-identical output, just one-token decode)."""
         k = self.spec_k
         k1 = k + 1
+        t0 = self._now()
         protect = {r.seq_id for r in lanes}
         staged = []
         for r in lanes:
@@ -856,6 +910,7 @@ class ServingEngine:
         accepted = 0
         for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
             emitted = 0
+            lane_accepted = 0
             for j in range(k1):
                 if host:
                     # host oracle: numpy RNG draws happen one per
@@ -871,6 +926,7 @@ class ServingEngine:
                 emitted += 1
                 if is_draft:
                     accepted += 1
+                    lane_accepted += 1
                 if r.state == RequestState.FINISHED or not is_draft:
                     break  # mismatch emits the correction; j==k = bonus
             if r.state != RequestState.FINISHED:
@@ -879,6 +935,13 @@ class ServingEngine:
                 new_len = hist0 + emitted - 1
                 self.cache.free_tail(r.seq_id, new_len)
                 self._draft_cache.free_tail(r.seq_id, new_len)
+            if self.trace.enabled:
+                self.trace.run_span(r.req_id, "spec_round", t0,
+                                    self._now() - t0,
+                                    batch=len(active),
+                                    proposed=min(k, n_slots),
+                                    accepted=lane_accepted,
+                                    emitted=emitted)
         self.metrics.spec_accepted_tokens.inc(accepted)
 
     def _run_draft_step(self, ids, positions, pt, cl, slot_map,
@@ -930,6 +993,13 @@ class ServingEngine:
         return props
 
     def _prefill_chunk(self, req, start, end, events):
+        t0 = self._now()
+        if self.trace.enabled:
+            # first chunk of this prefill pass: close the queued span
+            # (arrival -> admission, or requeue -> re-admission)
+            q0 = self.trace.pop_mark(req.req_id, "queued_t0")
+            if q0 is not None:
+                self.trace.span(req.req_id, "queued", q0, t0 - q0)
         if not self.cache.has_seq(req.seq_id):
             self.cache.alloc_seq(req.seq_id)
         hist = req.token_history()
@@ -958,6 +1028,16 @@ class ServingEngine:
             ids, positions, pt, cl, slot_map, last_idx, samp,
             (not host) and req.do_sample)
         self.metrics.prefill_chunks.inc()
+        if self.trace.enabled:
+            # a chunk that replays already-sampled tokens is recompute
+            # work paid to preemption, not first-pass prefill — the
+            # finish log's stall_s bucket
+            self.trace.span(
+                req.req_id,
+                ("recompute" if (req.out_tokens or req.preemptions)
+                 else "prefill_chunk"),
+                t0, self._now() - t0, start=int(start), end=int(end),
+                tokens=n)
         if self.cache.prefix_cache_enabled:
             # fresh full PROMPT pages now hold K/V: register them
             self.cache.commit_prefix(req.seq_id, req.prompt, end)
@@ -1005,6 +1085,8 @@ class ServingEngine:
         req.held = True
         self._held[req.req_id] = req
         self.metrics.prefills_held.inc()
+        if self.trace.enabled:
+            self.trace.mark(req.req_id, "held_t0", self._now())
         self._record_finish(req, events)
 
     # -- KV page migration (disaggregated serving, round 14) ---------------
@@ -1019,12 +1101,22 @@ class ServingEngine:
             raise KeyError(
                 f"export_request: request {req_id!r} is not held "
                 "(not prefill_only, already released, or unknown)")
+        t0 = self._now()
         meta, k, v = self.cache.export_pages(req.seq_id, skip_pages)
         meta.update(
             prompt=[int(t) for t in req.prompt],
             out_tokens=[int(t) for t in req.out_tokens],
-            device_seed=int(req.device_seed))
+            device_seed=int(req.device_seed),
+            # trace context rides the export meta: the adopting engine
+            # keys its timeline on the same X-Request-Id, so the router
+            # can stitch both phases into one timeline
+            request_id=req.request_id)
         self.metrics.pages_exported.inc(int(meta["n_pages"]))
+        if self.trace.enabled:
+            self.trace.span(req.req_id, "migration", t0,
+                            self._now() - t0, direction="export",
+                            pages=int(meta["n_pages"]),
+                            skip_pages=int(skip_pages))
         return meta, k, v
 
     def release_request(self, req_id):
@@ -1037,6 +1129,11 @@ class ServingEngine:
         req.held = False
         if self.cache.has_seq(req.seq_id):
             self.cache.free_seq(req.seq_id)
+        if self.trace.enabled:
+            h0 = self.trace.pop_mark(req.req_id, "held_t0")
+            if h0 is not None:
+                self.trace.span(req.req_id, "held", h0,
+                                self._now() - h0)
         return True
 
     def adopt_request(self, meta, k_arrays, v_arrays, *,
@@ -1070,6 +1167,11 @@ class ServingEngine:
                 f"adopt_request: {len(out_tokens)} token(s) already "
                 f"emitted >= max_new_tokens({max_new_tokens}) — "
                 "nothing left to decode")
+        if request_id is None:
+            # trace context rides the export meta (round 16): the
+            # adopted timeline keys on the SOURCE request's id so the
+            # router stitches both phases
+            request_id = meta.get("request_id")
         total = prompt.size + int(max_new_tokens)
         if total > self.max_seq_len:
             raise ValueError(
@@ -1103,6 +1205,14 @@ class ServingEngine:
         self.scheduler.register_adopted(req)
         self.metrics.pages_imported.inc(int(meta["n_pages"]))
         self.metrics.adoptions.inc()
+        if self.trace.enabled:
+            self.trace.begin(req.req_id, req.request_id)
+            self.trace.span(req.req_id, "migration", now,
+                            self._now() - now, direction="import",
+                            pages=int(meta["n_pages"]))
+            self.trace.flight.record("adopt", req_id=req.req_id,
+                                     request_id=req.request_id,
+                                     pages=int(meta["n_pages"]))
         return req.req_id
 
     def _fork(self, parent, i):
@@ -1118,6 +1228,10 @@ class ServingEngine:
         child.device_seed = (parent.device_seed + i) & 0x7FFFFFFF
         child.parent_id = parent.req_id
         child.first_token_at = None
+        if self.trace.enabled:
+            self.trace.begin(child.req_id, child.request_id)
+            self.trace.span(child.req_id, "forked", self._now(),
+                            parent=parent.req_id, index=i)
         self.cache.fork(parent.seq_id, child.seq_id)
         self._requests[child.req_id] = child
         self._rngs[child.req_id] = np.random.default_rng(child.seed)
@@ -1153,6 +1267,7 @@ class ServingEngine:
     def _record_finish(self, req, events):
         self.metrics.requests_finished.inc()
         self._finished[req.req_id] = req
+        tr = self.trace.finish(req.req_id)
         self._event({"type": "finish", "req_id": req.req_id,
                      "reason": req.finish_reason,
                      "n_tokens": len(req.out_tokens)}, events)
@@ -1162,7 +1277,7 @@ class ServingEngine:
                     if req.first_token_at is not None else None)
             tpot = ((req.last_token_at - req.first_token_at) / (n - 1)
                     if n > 1 else None)
-            _log.info(json.dumps({
+            line = {
                 "event": "request_finished", "req_id": req.req_id,
                 "reason": req.finish_reason, "n_tokens": n,
                 "prompt_tokens": int(req.prompt.size),
@@ -1170,7 +1285,14 @@ class ServingEngine:
                 "preemptions": req.preemptions,
                 "cached_prompt_pages": req.cached_pages,
                 "parent_id": req.parent_id,
-                "request_id": req.request_id}))
+                "request_id": req.request_id}
+            if tr is not None:
+                # span-derived phase decomposition: log scrapers get
+                # queue/prefill/decode/stall without /debug/trace
+                line["phases"] = tr.phase_breakdown()
+                if tr.dropped:
+                    line["trace_spans_dropped"] = tr.dropped
+            _log.info(json.dumps(line))
 
     def _event(self, ev, events):
         events.append(ev)

@@ -37,6 +37,8 @@ backpressure.
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
 import queue
 import threading
@@ -45,6 +47,8 @@ import time
 import numpy as np
 
 from .engine import FaultInjected
+
+_log = logging.getLogger("paddle_tpu.serving")
 
 __all__ = ["Rejected", "RequestStream", "ServingFrontend", "Unavailable"]
 
@@ -294,6 +298,24 @@ class ServingFrontend:
             m.running_gauge.set(len(eng.scheduler.running))
             return m.to_prometheus()
 
+    # -- observability (round 16): /debug/trace + /debug/flight ------------
+    def debug_trace(self, request_id=None, req_id=None):
+        """Serialized span timelines for one request (by X-Request-Id
+        string or engine req_id) or, with neither, every retained
+        timeline.  Reads under the engine lock — a scrape never races
+        the step loop's appends."""
+        with self.lock:
+            return {"timelines": self.engine.trace.timelines(
+                request_id=request_id, req_id=req_id)}
+
+    def debug_flight(self):
+        """The engine flight ring, oldest-first, plus counters."""
+        with self.lock:
+            flight = self.engine.trace.flight
+            return {"events": flight.dump(),
+                    "recorded": flight.recorded,
+                    "cap": flight.cap}
+
     # -- KV page migration (disaggregated serving, round 14) ---------------
     # Export/import touch the cache's device buffers and host
     # bookkeeping, so every path below holds the SAME lock as the step
@@ -369,6 +391,9 @@ class ServingFrontend:
         prompt_len = int(prompt.size)
         if sched.queue_depth() >= self.max_queued:
             eng.metrics.rejections.inc()
+            if eng.trace.enabled:
+                eng.trace.flight.record("shed", cause="queue_full",
+                                        waiting=sched.queue_depth())
             raise Rejected(
                 f"intake queue full ({self.max_queued} waiting)")
         # a prefill-only request stops after its first sampled token:
@@ -382,6 +407,9 @@ class ServingFrontend:
         if need + promised + sched.watermark_pages \
                 > cache.available_pages:
             eng.metrics.rejections.inc()
+            if eng.trace.enabled:
+                eng.trace.flight.record("shed", cause="over_capacity",
+                                        need=need, reserved=promised)
             raise Rejected(
                 f"over capacity: need {need} page(s), "
                 f"{cache.available_pages} available - {promised} "
@@ -460,6 +488,18 @@ class ServingFrontend:
     def _fail_locked(self, exc):
         self._state = "failed"
         self.error = exc
+        trace = self.engine.trace
+        if trace.enabled:
+            # the flight-recorder dump: the ring holds the failing
+            # step's batch composition (step_begin precedes the device
+            # work), so the round-9/11 loop-failure classes are
+            # post-mortem-able from the structured log alone
+            trace.flight.record("loop_error", error=repr(exc))
+            _log.error(json.dumps({
+                "event": "flight_recorder_dump",
+                "error": repr(exc),
+                "recorded": trace.flight.recorded,
+                "events": trace.flight.dump()}))
         try:
             self.engine.release_live()
         except Exception:

@@ -25,6 +25,11 @@ __all__ = ["Counter", "Gauge", "Histogram", "LabeledCounter",
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Count-shaped buckets for the queue-depth histogram (requests, not
+# seconds): powers of two so a scraper can see where admission backs up
+# across replicas (round 16 — the tracing/observability PR)
+DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
 
 class Counter:
     def __init__(self):
@@ -148,7 +153,13 @@ class ServingMetrics:
         # /metrics must stay aggregatable; summary quantiles are not)
         self.ttft_s = Histogram(buckets=LATENCY_BUCKETS)
         self.inter_token_s = Histogram(buckets=LATENCY_BUCKETS)
-        self.queue_depth = Histogram()        # waiting queue, per step
+        # engine step wall time (round 16): the flight recorder keeps
+        # the recent per-step detail; this keeps the aggregatable
+        # distribution on /metrics
+        self.step_duration_s = Histogram(buckets=LATENCY_BUCKETS)
+        # bucketed (round 16) so the router-merged /metrics can show
+        # WHERE admission backs up, not just the last gauge value
+        self.queue_depth = Histogram(buckets=DEPTH_BUCKETS)
         self.batch_size = Histogram()         # decode lanes, per step
         self.page_occupancy = Histogram()     # used/allocatable, per step
         self.prefill_chunks = Counter()

@@ -118,6 +118,13 @@ class InProcessReplica:
     def close(self, timeout=120.0):
         return self.frontend.close(timeout)
 
+    # -- observability (round 16) ------------------------------------------
+    def debug_trace(self, request_id=None):
+        return self.frontend.debug_trace(request_id=request_id)
+
+    def debug_flight(self):
+        return self.frontend.debug_flight()
+
     # -- KV page migration (disagg tier) -----------------------------------
     def probe_pages(self, prompt):
         return self.frontend.probe_prefix(prompt)
@@ -458,6 +465,26 @@ class HTTPReplica:
         except OSError:
             return ""
         return data.decode() if status == 200 else ""
+
+    def debug_trace(self, request_id=None):
+        """The remote /debug/trace timelines (the X-Request-Id string
+        is the cross-replica stitch key)."""
+        from urllib.parse import quote
+        path = "/debug/trace"
+        if request_id is not None:
+            path += f"?request_id={quote(str(request_id), safe='')}"
+        status, data = self._get(path)
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: trace HTTP {status}")
+        return json.loads(data)
+
+    def debug_flight(self):
+        status, data = self._get("/debug/flight")
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: flight HTTP {status}")
+        return json.loads(data)
 
     # -- lifecycle (router-side only for remote replicas) ------------------
     def drain(self, timeout=120.0):

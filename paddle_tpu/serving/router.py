@@ -72,12 +72,14 @@ import json
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 
 from .frontend import Rejected, Unavailable
 from .metrics import (Counter, Gauge, LabeledCounter, merge_prometheus)
 from .replica import ReplicaFailed
+from .trace import ServingTrace
 
 __all__ = ["RouterStream", "ServingRouter"]
 
@@ -229,6 +231,10 @@ class ServingRouter:
         self.max_tree_pages = int(max_tree_pages)
         self.max_tree_nodes = int(max_tree_nodes)
         self.metrics = RouterMetrics()
+        # router-side spans (routed/failover_splice/migration) keyed by
+        # the router stream id; X-Request-Id is the cross-replica
+        # stitch key /debug/trace merges on (round 16)
+        self.trace = ServingTrace()
         self._lock = threading.Lock()
         self._rr = 0
         self._ids = itertools.count()
@@ -350,6 +356,9 @@ class ServingRouter:
         kw["max_new_tokens"] = int(max_new_tokens)
         stream = self.stream_cls(self, next(self._ids), prompt, kw,
                                  n=int(kw.get("n", 1)))
+        if self.trace.enabled:
+            with self._lock:
+                self.trace.begin(stream.req_id, kw.get("request_id"))
         self._place(stream, exclude=())
         with self._lock:
             self._streams[stream.req_id] = stream
@@ -410,6 +419,55 @@ class ServingRouter:
             except Exception:  # pragma: no cover - remote flake
                 pass
         return merge_prometheus(parts)
+
+    # -- observability (round 16): fleet-merged trace + flight -------------
+    def debug_trace(self, request_id=None, req_id=None):
+        """Cross-replica trace merge, /metrics-style: every replica's
+        timelines for ``request_id`` (the X-Request-Id stitch key —
+        engine ``req_id`` values are replica-local, so ``req_id`` only
+        filters the router's own spans) tagged with their replica
+        index, plus the router's own routed/failover/migration spans,
+        and ONE ``stitched`` span list ordered on the shared wall
+        clock."""
+        timelines = []
+        for i in range(len(self.replicas)):
+            if i in self._retired:
+                continue
+            try:
+                # DOWN in-process replicas still answer (their trace
+                # store is the post-mortem); unreachable HTTP ones skip
+                d = self.replicas[i].debug_trace(request_id=request_id)
+            except Exception:
+                continue
+            for tl in d.get("timelines", []):
+                timelines.append(dict(tl, replica=i))
+        own = self.trace.timelines(request_id=request_id,
+                                   req_id=req_id)
+        timelines.extend(dict(tl, replica="router") for tl in own)
+        stitched = []
+        for tl in timelines:
+            for s in tl["spans"]:
+                stitched.append(dict(s, req_id=tl["req_id"],
+                                     replica=tl["replica"]))
+        stitched.sort(key=lambda s: s.get("t0_unix", 0.0))
+        return {"request_id": request_id, "timelines": timelines,
+                "stitched": stitched}
+
+    def debug_flight(self):
+        """Every replica's flight ring plus the router's own, keyed by
+        replica index (the /metrics merge shape)."""
+        out = {"router": {"events": self.trace.flight.dump(),
+                          "recorded": self.trace.flight.recorded,
+                          "cap": self.trace.flight.cap},
+               "replicas": {}}
+        for i in range(len(self.replicas)):
+            if i in self._retired:
+                continue
+            try:
+                out["replicas"][str(i)] = self.replicas[i].debug_flight()
+            except Exception:
+                continue
+        return out
 
     # -- rolling drain -----------------------------------------------------
     def drain_replica(self, i, timeout=120.0):
@@ -483,6 +541,9 @@ class ServingRouter:
         its open streams fail over."""
         with self._lock:
             self._down.add(i)
+        if self.trace.enabled:
+            self.trace.flight.record("kill_replica", replica=i,
+                                     cause=repr(exc) if exc else None)
         self.replicas[i].fail(exc)
 
     # -- routing internals -------------------------------------------------
@@ -632,6 +693,10 @@ class ServingRouter:
             stream.replica_idx = idx
             self.metrics.routed_total.inc(policy=self.policy,
                                           replica=idx)
+            if self.trace.enabled:
+                self.trace.span(stream.req_id, "routed",
+                                time.perf_counter(), replica=idx,
+                                policy=self.policy)
             if self.policy == "cache_aware":
                 self._record(stream.prompt, idx)
             return stream
@@ -664,12 +729,24 @@ class ServingRouter:
         stream._skip = [d if not f else 0
                         for d, f in zip(stream._delivered,
                                         stream._finished)]
+        t0 = time.perf_counter()
         try:
             self._place(stream, exclude={failed})
         except (Rejected, Unavailable) as e:
             raise RuntimeError(
                 f"failover failed for request "
                 f"{stream.request_id or stream.req_id}: {e}") from e
+        if self.trace.enabled:
+            self.trace.span(stream.req_id, "failover_splice", t0,
+                            time.perf_counter() - t0,
+                            from_replica=failed,
+                            to_replica=stream.replica_idx,
+                            spliced_tokens=spliced, cause=str(exc))
+            self.trace.flight.record(
+                "failover", replica=failed,
+                to_replica=stream.replica_idx,
+                request_id=stream.request_id,
+                spliced_tokens=spliced)
 
     # -- fault injection / bookkeeping -------------------------------------
     def _token_delivered(self, replica_idx):
@@ -689,3 +766,5 @@ class ServingRouter:
     def _stream_done(self, stream):
         with self._lock:
             self._streams.pop(stream.req_id, None)
+            if self.trace.enabled:
+                self.trace.finish(stream.req_id)

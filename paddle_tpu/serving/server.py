@@ -253,6 +253,10 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             h = self.owner.frontend.health()
             self._json(503 if h["status"] == "failed" else 200, h)
+        elif self.path.split("?", 1)[0] == "/debug/trace":
+            self._debug_trace()
+        elif self.path == "/debug/flight":
+            self._debug_flight()
         elif self.path == "/metrics":
             text = self.owner.frontend.prometheus().encode()
             self.send_response(200)
@@ -264,6 +268,40 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(404, f"no route {self.path}",
                         "invalid_request_error")
+
+    # -- observability (round 16): /debug/trace + /debug/flight ------------
+    def _debug_trace(self):
+        """Span timelines as JSON — ``?request_id=`` (the X-Request-Id
+        string, the cross-replica stitch key) or ``?req_id=`` (engine-
+        local integer); a router front-end merges its replicas."""
+        from urllib.parse import parse_qs, urlparse
+        fe = self.owner.frontend
+        if not hasattr(fe, "debug_trace"):
+            self._error(404, "no trace store here",
+                        "invalid_request_error")
+            return
+        q = parse_qs(urlparse(self.path).query)
+        kw = {}
+        rid = (q.get("request_id") or [None])[0]
+        if rid is not None:
+            kw["request_id"] = rid
+        req_id = (q.get("req_id") or [None])[0]
+        if req_id is not None:
+            try:
+                kw["req_id"] = int(req_id)
+            except ValueError:
+                self._error(400, f"req_id must be an integer, got "
+                            f"{req_id!r}", "invalid_request_error")
+                return
+        self._json(200, fe.debug_trace(**kw))
+
+    def _debug_flight(self):
+        fe = self.owner.frontend
+        if not hasattr(fe, "debug_flight"):
+            self._error(404, "no flight recorder here",
+                        "invalid_request_error")
+            return
+        self._json(200, fe.debug_flight())
 
     def do_POST(self):
         if self.path == "/v1/completions":

@@ -70,7 +70,8 @@ def _bench_artifact_guard(request):
     being overwritten by in-suite runs (caught by the round-14 tier-1
     run: 30.9 -> 20.1 under suite load, the exact round-12 symptom)."""
     _replay_classes = ("TestServingReplay", "TestServerReplay",
-                       "TestServingDisaggReplay", "TestServingKv8Replay")
+                       "TestServingDisaggReplay", "TestServingKv8Replay",
+                       "TestServingTraceReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

@@ -659,7 +659,8 @@ class TestServingSweep:
         mt.ttft_s.record(0.1)
         mt.preemptions.inc()
         ex = mt.export()
-        for key in ("ttft_s", "inter_token_s", "queue_depth",
+        for key in ("ttft_s", "inter_token_s", "step_duration_s",
+                    "queue_depth",
                     "batch_size", "page_occupancy", "prefill_chunks",
                     "decode_steps", "tokens_generated",
                     "requests_finished", "preemptions",
@@ -706,6 +707,19 @@ class TestServingSweep:
         assert 'paddle_tpu_serving_batch_size{quantile="0.5"} 4.0' \
             in text
         assert "paddle_tpu_serving_queue_depth_gauge 3.0" in text
+        # round-16 observability families: step duration is a REAL
+        # latency histogram, queue depth a count-bucketed one (both
+        # must stay aggregatable across the router's merged /metrics)
+        assert "# TYPE paddle_tpu_serving_step_duration_s histogram" \
+            in text
+        assert "# TYPE paddle_tpu_serving_queue_depth histogram" in text
+        mt.step_duration_s.record(0.004)
+        mt.queue_depth.record(3)
+        text = mt.to_prometheus()
+        assert ('paddle_tpu_serving_step_duration_s_bucket'
+                '{le="0.005"} 1') in text
+        assert 'paddle_tpu_serving_queue_depth_bucket{le="4"} 1' in text
+        assert 'paddle_tpu_serving_queue_depth_bucket{le="2"} 0' in text
 
     def test_histogram_percentiles(self):
         from paddle_tpu.serving import Histogram

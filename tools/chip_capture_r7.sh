@@ -100,6 +100,13 @@ run bash tools/serving_disagg_smoke.sh
 #     new Pallas shapes — safe tier, zero chip debt.
 run bash tools/serving_kv8_smoke.sh
 
+# 5i. serving-trace observability smoke (round 16): tracing overhead
+#     guard (on/off marginal ratio, smoke mode measures but never
+#     asserts the 3% contract) + chrome-export roundtrip through
+#     paddle_tpu.profiler. CPU-mesh by construction (--smoke never
+#     probes the chip) — safe tier, zero chip debt.
+run bash tools/serving_trace_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,
