@@ -8,6 +8,7 @@ sweep rule for new API surfaces)."""
 from __future__ import annotations
 
 from .autograd import AutogradBypass, ThreadGradState
+from .chaos_clock import ServingRawSleep
 from .dist_spec import DistSpecPassthrough
 from .env_knobs import EnvKnobRegistry
 from .jit_capture import JitConstantCapture
@@ -25,6 +26,7 @@ ALL_RULES = [
     EngineLockDiscipline(),
     PageMigrationLock(),
     EnvKnobRegistry(),
+    ServingRawSleep(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
@@ -33,4 +35,4 @@ __all__ = ["ALL_RULES", "RULES_BY_ID", "AutogradBypass",
            "ThreadGradState", "PallasHazards", "JitConstantCapture",
            "DistSpecPassthrough", "ChipKillOnTimeout",
            "EngineLockDiscipline", "PageMigrationLock",
-           "EnvKnobRegistry"]
+           "EnvKnobRegistry", "ServingRawSleep"]

@@ -1,0 +1,48 @@
+"""serving-raw-sleep: every latency/backoff/poll sleep in the serving
+tier must route through the chaos layer's injected sleeper
+(``ChaosInjector.sleep`` — ``paddle_tpu/serving/chaos.py``), never raw
+``time.sleep``.
+
+Round-17 invariant: the chaos harness drives deterministic, seeded
+fault schedules against the whole fleet.  A raw ``time.sleep`` in an
+engine/router/replica loop path (a) makes those schedules
+nondeterministic — wall-clock sleeps interleave fault firings
+differently per run — and (b) makes the chaos fuzz and every retry
+test wall-clock slow, because a fake sleeper cannot collapse the wait.
+The round-11 addenda's fixed-sleep test flakes are the same bug class
+on the test side."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+# the injected sleeper's home — the ONE place a real time.sleep belongs
+_SLEEPER_HOME = "paddle_tpu/serving/chaos.py"
+
+
+class ServingRawSleep(Rule):
+    """Raw ``time.sleep`` calls inside ``paddle_tpu/serving/``."""
+
+    id = "serving-raw-sleep"
+    description = ("raw time.sleep in serving code defeats the chaos "
+                   "layer's injected sleeper (nondeterministic fault "
+                   "schedules, wall-clock-slow tests)")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/serving/")
+                and ctx.relpath != _SLEEPER_HOME)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "time.sleep":
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "raw `time.sleep` in serving code — route the wait "
+                "through the chaos sleeper (`chaos.sleep(...)` / "
+                "`ChaosInjector.sleep`) so fault schedules stay "
+                "deterministic and tests can collapse time "
+                "(round-17 invariant)")

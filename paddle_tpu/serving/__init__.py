@@ -65,12 +65,27 @@ Layers:
   ``paddle_tpu.profiler`` event format (``bench_serving.py
   --trace-out``).
 
+- :mod:`chaos` — the robustness layer (round 17): ONE seeded
+  deterministic fault schedule (``ChaosConfig`` — the legacy FAULT_*
+  knobs alias in) over 12 registered fault points (engine step
+  fault/latency, allocator-pressure spikes, migration export/import/
+  transfer failures, HTTP connect/EOF/slow-read, replica crash during
+  drain/readmit/shrink), the injected sleeper every serving sleep
+  routes through (graftlint ``serving-raw-sleep``), bounded
+  exponential-backoff retries (migration + idempotent HTTP hops),
+  per-replica circuit breakers (``/healthz``-advertised, /metrics
+  counted, flight-dumped on open), held-page release on deadline
+  expiry, and the global recovery invariants the chaos fuzz
+  (``tools/chaos_fuzz.py``) asserts after every convulsion.
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
 """
 from .attention import paged_attention, paged_attention_ref  # noqa: F401
 from .autoscale import FleetAutoscaler  # noqa: F401
+from .chaos import (FAULT_POINTS, Backoff, ChaosConfig,  # noqa: F401
+                    ChaosInjector, CircuitBreaker)
 from .disagg import DisaggRouter, DisaggStream  # noqa: F401
 from .engine import (EngineDraining, FaultInjected,  # noqa: F401
                      ServingEngine)
@@ -108,4 +123,6 @@ __all__ = [
     "serialize_pages", "deserialize_pages",
     "ServingTrace", "RequestTrace", "FlightRecorder",
     "chrome_trace_events", "export_chrome_trace",
+    "ChaosConfig", "ChaosInjector", "Backoff", "CircuitBreaker",
+    "FAULT_POINTS",
 ]

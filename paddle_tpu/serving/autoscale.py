@@ -213,14 +213,18 @@ class FleetAutoscaler:
             hi = self._limit(self.max_per_role, role)
             if n < lo:
                 # below the floor: repair immediately, no hysteresis
-                events.append(("up", role, self._scale_up(role)))
+                idx = self._try_scale_up(role)
+                if idx is not None:
+                    events.append(("up", role, idx))
                 self._since.pop((role, "up"), None)
                 continue
             pressured = mean > self.up_pages or (
                 breach is not None and breach > self.slo_breach_frac)
             if n < hi and self._held_for((role, "up"), pressured, now,
                                          self.up_window_s):
-                events.append(("up", role, self._scale_up(role)))
+                idx = self._try_scale_up(role)
+                if idx is not None:
+                    events.append(("up", role, idx))
                 self._since.pop((role, "up"), None)
                 continue
             idle = mean < self.down_pages and not pressured
@@ -231,6 +235,18 @@ class FleetAutoscaler:
                 events.append(("down", role, victim))
                 self._since.pop((role, "down"), None)
         return events
+
+    def _try_scale_up(self, role):
+        """Chaos-hardened scale-up: a crashing replica factory (bad
+        weights path, OOM, chaos test double) must not kill the policy
+        loop or block the OTHER roles' evaluations this tick — log it
+        and let the hysteresis retry next tick."""
+        try:
+            return self._scale_up(role)
+        except Exception:
+            _log.exception("autoscale replica factory failed for "
+                           "role %r", role)
+            return None
 
     def _scale_up(self, role):
         replica = self.factory(role)

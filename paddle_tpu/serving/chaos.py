@@ -1,0 +1,478 @@
+"""Fleet-wide chaos harness + retry/backoff/circuit-breaker layer.
+
+The serving stack accumulated *piecemeal* fault machinery one PR at a
+time — env-gated step faults in the engine, the front-end's
+``_fault_streak`` escalation, the router's ``ROUTER_KILL`` drill, the
+failover splice, the health prober, the flight recorder.  Each point
+was hand-tested once in its own PR; nothing drove *combinations* of
+faults against the whole fleet or checked the global recovery
+invariants.  This module is that missing layer (reference capability:
+Paddle Fleet's elastic fault tolerance — replicas die, requests
+survive, capacity degrades gracefully; the Gemma-on-TPU serving paper
+evaluates exactly this replica-churn regime):
+
+- :class:`ChaosConfig` — ONE seeded, deterministic fault schedule that
+  unifies the legacy knobs (``PADDLE_TPU_SERVING_FAULT_LATENCY_S``,
+  ``_FAULT_ERROR_RATE``, ``_FAULT_SEED``, ``_FAULT_ESCALATE_N``,
+  ``_ROUTER_KILL`` — all still honored as aliases) with the new fault
+  points: pagewire migration failures (export fail / import bounce /
+  mid-transfer kill), HTTPReplica network faults (connect refused,
+  mid-stream EOF, slow reads), allocator pressure spikes, and replica
+  crashes during drain/readmit/autoscaler shrink.  Injected via
+  constructor (``chaos=``) or env (``PADDLE_TPU_SERVING_CHAOS``).
+- :class:`ChaosInjector` — the per-component firing engine: one
+  persistent RNG stream per fault point (schedules are reproducible
+  per seed regardless of which OTHER points are enabled), per-point
+  fired counters (the fuzz harness's coverage report), recording into
+  the component's flight ring, and the **injected sleeper** every
+  retry/backoff/latency sleep in the serving tier must route through
+  (graftlint ``serving-raw-sleep``) so chaos schedules stay
+  deterministic and tests can collapse time.
+- :class:`Backoff` — bounded exponential backoff with deterministic
+  jitter for page-migration and HTTP replica hops.  Retrying those is
+  safe by the existing idempotency contracts: a bounced import leaves
+  no state behind (GeometryMismatch/PrefixDrift re-export), and an
+  exhausted retry budget falls back to the re-prefill path.
+- :class:`CircuitBreaker` — per-replica closed → open → half-open →
+  closed state machine with an injectable clock; the router excludes
+  open replicas from routing, feeds the health prober with the
+  cooldown gate, advertises the state in ``/healthz`` and counts
+  opens/retries in ``/metrics``.
+- Invariant checks (:func:`verify_page_conservation`,
+  :func:`verify_engine_quiescent`, :func:`fleet_invariants`) — the
+  global recovery conditions the chaos fuzz asserts after every
+  convulsion: two-allocator page conservation, zero leaked
+  reservations/held pages, allocator-clean idle engines.
+
+Nothing here imports jax and nothing touches a device: the whole layer
+is host bookkeeping, CPU-mesh-verifiable by construction.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import zlib
+from collections import Counter as _Tally
+
+import numpy as np
+
+__all__ = ["Backoff", "ChaosConfig", "ChaosInjector", "CircuitBreaker",
+           "FAULT_POINTS", "fleet_invariants",
+           "verify_engine_quiescent", "verify_page_conservation"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+# The registered fault points.  The fuzz harness (tools/chaos_fuzz.py)
+# reports per-point fired counts over a run and FAILS on a never-fired
+# point, so a new fault hook must be added here in the same commit.
+FAULT_POINTS = (
+    "step_fault",            # engine: FaultInjected at the step boundary
+    "step_latency",          # engine: added per-step latency
+    "alloc_pressure",        # engine: chaos seq grabs free pages N steps
+    "migrate_export_fail",   # disagg: source export dies (partial export)
+    "migrate_import_bounce",  # disagg: destination bounces the import
+    "migrate_transfer_kill",  # disagg: destination dies mid-transfer
+    "http_connect",          # HTTPReplica: connection refused
+    "http_midstream_eof",    # HTTPReplica: SSE stream EOF mid-decode
+    "http_slow_read",        # HTTPReplica: slow response read
+    "crash_drain",           # router: replica crash during drain
+    "crash_readmit",         # router: replica crash during readmit
+    "crash_shrink",          # router: replica crash during autoscaler
+)                            #         shrink (retire_replica)
+
+# legacy aliases (round 9/11 knobs) folded into the unified config
+_ENV_LATENCY = "PADDLE_TPU_SERVING_FAULT_LATENCY_S"
+_ENV_RATE = "PADDLE_TPU_SERVING_FAULT_ERROR_RATE"
+_ENV_SEED = "PADDLE_TPU_SERVING_FAULT_SEED"
+_ENV_ESCALATE = "PADDLE_TPU_SERVING_FAULT_ESCALATE_N"
+_ENV_ROUTER_KILL = "PADDLE_TPU_SERVING_ROUTER_KILL"
+# the unified schedule knobs
+_ENV_CHAOS = "PADDLE_TPU_SERVING_CHAOS"
+_ENV_CHAOS_SEED = "PADDLE_TPU_SERVING_CHAOS_SEED"
+_ENV_SLOW_READ = "PADDLE_TPU_SERVING_CHAOS_SLOW_READ_S"
+# retry/backoff + circuit-breaker production knobs
+_ENV_RETRY_MAX = "PADDLE_TPU_SERVING_RETRY_MAX"
+_ENV_RETRY_BASE = "PADDLE_TPU_SERVING_RETRY_BASE_S"
+_ENV_RETRY_CAP = "PADDLE_TPU_SERVING_RETRY_MAX_S"
+_ENV_BREAKER_N = "PADDLE_TPU_SERVING_BREAKER_N"
+_ENV_BREAKER_COOLDOWN = "PADDLE_TPU_SERVING_BREAKER_COOLDOWN_S"
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    try:
+        return float(v) if v not in (None, "") else float(default)
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    try:
+        return int(v) if v not in (None, "") else int(default)
+    except ValueError:
+        return int(default)
+
+
+def parse_rates(spec):
+    """``"step_fault:0.05,http_midstream_eof:0.2"`` → rate dict.
+    Unknown point names raise — a typo'd schedule must not silently
+    disable the fault it meant to enable."""
+    rates = {}
+    if not spec:
+        return rates
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, rate = part.partition(":")
+        point = point.strip()
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown chaos fault point {point!r}; one of "
+                f"{FAULT_POINTS}")
+        rates[point] = float(rate or 1.0)
+    return rates
+
+
+class ChaosConfig:
+    """One deterministic fault schedule for a serving component.
+
+    ``rates`` maps fault-point name → per-evaluation firing
+    probability; latency-shaped points also carry a duration
+    (``step_latency_s``, ``slow_read_s``).  ``from_env()`` folds the
+    legacy scattered knobs in as aliases, so every pre-existing fault
+    drill keeps working unchanged while new code configures ONE
+    object."""
+
+    def __init__(self, *, seed=0, rates=None, step_latency_s=0.0,
+                 slow_read_s=0.0, escalate_n=0, router_kill=None,
+                 alloc_pressure_frac=0.5, alloc_pressure_steps=4,
+                 retry_max=3, retry_base_s=0.05, retry_max_s=2.0,
+                 breaker_n=3, breaker_cooldown_s=5.0):
+        self.seed = int(seed)
+        self.rates = dict(rates or {})
+        for point in self.rates:
+            if point not in FAULT_POINTS:
+                raise ValueError(
+                    f"unknown chaos fault point {point!r}; one of "
+                    f"{FAULT_POINTS}")
+        self.step_latency_s = float(step_latency_s)
+        self.slow_read_s = float(slow_read_s)
+        self.escalate_n = int(escalate_n)
+        self.router_kill = router_kill  # (replica_idx, after_tokens)
+        self.alloc_pressure_frac = float(alloc_pressure_frac)
+        self.alloc_pressure_steps = int(alloc_pressure_steps)
+        self.retry_max = int(retry_max)
+        self.retry_base_s = float(retry_base_s)
+        self.retry_max_s = float(retry_max_s)
+        self.breaker_n = int(breaker_n)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+
+    @classmethod
+    def from_env(cls):
+        """Resolve the unified schedule from the environment.  Legacy
+        knobs are ALIASES: ``FAULT_ERROR_RATE`` feeds the
+        ``step_fault`` rate, ``FAULT_LATENCY_S`` enables
+        ``step_latency`` at rate 1 with that duration, ``FAULT_SEED``
+        seeds the injector (``CHAOS_SEED`` wins when both are set),
+        ``FAULT_ESCALATE_N`` is the front-end escalation streak and
+        ``ROUTER_KILL`` the router availability drill."""
+        rates = parse_rates(os.environ.get(_ENV_CHAOS))
+        rate = os.environ.get(_ENV_RATE)
+        if rate:
+            rates.setdefault("step_fault", float(rate))
+        latency = _env_float(_ENV_LATENCY, 0.0)
+        if latency > 0:
+            rates.setdefault("step_latency", 1.0)
+        kill = os.environ.get(_ENV_ROUTER_KILL)
+        router_kill = None
+        if kill:
+            idx, after = kill.split(":")
+            router_kill = (int(idx), int(after))
+        seed = _env_int(_ENV_CHAOS_SEED, _env_int(_ENV_SEED, 0))
+        return cls(
+            seed=seed, rates=rates, step_latency_s=latency,
+            slow_read_s=_env_float(_ENV_SLOW_READ, 0.0),
+            escalate_n=_env_int(_ENV_ESCALATE, 0),
+            router_kill=router_kill,
+            retry_max=_env_int(_ENV_RETRY_MAX, 3),
+            retry_base_s=_env_float(_ENV_RETRY_BASE, 0.05),
+            retry_max_s=_env_float(_ENV_RETRY_CAP, 2.0),
+            breaker_n=_env_int(_ENV_BREAKER_N, 3),
+            breaker_cooldown_s=_env_float(_ENV_BREAKER_COOLDOWN, 5.0))
+
+    def rate(self, point):
+        return float(self.rates.get(point, 0.0))
+
+    @property
+    def any_enabled(self):
+        return bool(self.rates) or self.step_latency_s > 0
+
+
+class ChaosInjector:
+    """Deterministic fault firing for one serving component.
+
+    ``config=None`` (the default) runs in ENV MODE: the schedule is
+    re-resolved from the environment at every evaluation, which is
+    what keeps the legacy monkeypatch-mid-test workflow working (tests
+    flip ``PADDLE_TPU_SERVING_FAULT_ERROR_RATE`` on a live engine).
+    An explicit :class:`ChaosConfig` freezes the schedule.
+
+    Each fault point draws from its OWN persistent RNG stream (seeded
+    from ``seed`` + the point name), so enabling one point never
+    perturbs another point's schedule — the property that makes a
+    multi-seed fuzz shrinkable to a single failing point.
+
+    ``sleep`` is the injected sleeper: every latency/backoff sleep in
+    the serving tier routes through here (graftlint
+    ``serving-raw-sleep``), so a fake sleeper collapses chaos time in
+    tests and the fuzz harness.
+    """
+
+    def __init__(self, config=None, *, name="engine", sleep=None,
+                 trace=None):
+        self._config = config
+        self.name = name
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._trace = trace      # ServingTrace; bound late by owners
+        self.counts = _Tally()   # fault point -> times fired
+        self.evaluated = _Tally()
+        self._rngs = {}
+        self._seed = (config.seed if config is not None
+                      else _env_int(_ENV_CHAOS_SEED,
+                                    _env_int(_ENV_SEED, 0)))
+
+    # -- configuration -----------------------------------------------------
+    @property
+    def cfg(self):
+        return (self._config if self._config is not None
+                else ChaosConfig.from_env())
+
+    def bind(self, trace):
+        """Late-bind the owning component's trace store (the engine
+        builds its trace after its injector)."""
+        self._trace = trace
+        return self
+
+    def _rng(self, point):
+        rng = self._rngs.get(point)
+        if rng is None:
+            rng = self._rngs[point] = np.random.default_rng(
+                (self._seed & 0xFFFFFFFF) ^ zlib.crc32(point.encode()))
+        return rng
+
+    # -- firing ------------------------------------------------------------
+    def fire(self, point, cfg=None, **info):
+        """Evaluate one fault point; True when it fires (counted and
+        recorded to the flight ring).  The RNG draw happens on every
+        evaluation with a nonzero rate, so a given seed produces the
+        same fire/no-fire sequence per point regardless of outcome
+        handling.  ``cfg`` reuses an already-resolved config (the
+        engine's per-step hot path resolves once for three points);
+        ``info`` must stay JSON-serializable — it lands in the flight
+        ring verbatim."""
+        rate = (cfg if cfg is not None else self.cfg).rate(point)
+        if rate <= 0.0:
+            return False
+        self.evaluated[point] += 1
+        if self._rng(point).random() >= rate:
+            return False
+        self.counts[point] += 1
+        if self._trace is not None and self._trace.enabled:
+            self._trace.flight.record("chaos", point=point,
+                                      injector=self.name, **info)
+        _log.debug(json.dumps({"event": "chaos_injected", "point": point,
+                               "injector": self.name}))
+        return True
+
+    def sleep(self, seconds):
+        """The blessed sleeper for serving loop paths (see
+        graftlint ``serving-raw-sleep``)."""
+        if seconds > 0:
+            self._sleep(seconds)
+        else:
+            self._sleep(0)
+
+    def backoff(self):
+        """A fresh deterministic Backoff from the config's retry knobs
+        (one per retried operation, so jitter streams don't couple)."""
+        cfg = self.cfg
+        return Backoff(base_s=cfg.retry_base_s, max_s=cfg.retry_max_s,
+                       retries=cfg.retry_max,
+                       seed=self._seed ^ 0x5EED)
+
+
+class Backoff:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``delay(attempt)`` for attempt 0, 1, 2… is
+    ``min(base * 2**attempt, max) * (1 + jitter)`` with jitter drawn
+    uniformly from ``[-jitter_frac, +jitter_frac]`` by a seeded RNG —
+    the schedule is a pure function of the seed, pinned by unit test.
+    ``retries`` bounds the attempt count (``delays()`` lists the whole
+    schedule)."""
+
+    def __init__(self, *, base_s=0.05, factor=2.0, max_s=2.0,
+                 jitter_frac=0.1, retries=3, seed=0):
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.max_s = float(max_s)
+        self.jitter_frac = float(jitter_frac)
+        self.retries = int(retries)
+        self._rng = np.random.default_rng(int(seed))
+
+    def delay(self, attempt):
+        d = min(self.base_s * self.factor ** int(attempt), self.max_s)
+        if self.jitter_frac > 0:
+            d *= 1.0 + float(self._rng.uniform(-self.jitter_frac,
+                                               self.jitter_frac))
+        return max(0.0, d)
+
+    def delays(self):
+        return [self.delay(i) for i in range(self.retries)]
+
+
+class CircuitBreaker:
+    """Per-replica failure breaker: closed → open after ``threshold``
+    consecutive failures, half-open after ``cooldown_s``, closed again
+    after a success (a half-open failure re-opens and restarts the
+    cooldown).  ``clock=`` injects the time source so the
+    open→half-open→close transitions are pinned deterministically.
+    ``threshold=0`` disables the breaker (always closed)."""
+
+    def __init__(self, threshold=3, cooldown_s=5.0, clock=None):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock if clock is not None else time.monotonic
+        self.failures = 0
+        self.opens = 0
+        self._opened_at = None
+        self._half_open = False
+
+    @property
+    def state(self):
+        if self._opened_at is None:
+            return "closed"
+        if self._half_open or self.cooldown_elapsed():
+            return "half_open"
+        return "open"
+
+    def cooldown_elapsed(self):
+        return (self._opened_at is not None
+                and self.clock() - self._opened_at >= self.cooldown_s)
+
+    def allow(self):
+        """May traffic be routed here?  Open blocks until the cooldown
+        elapses, then half-open admits trial traffic."""
+        if self._opened_at is None:
+            return True
+        if self.cooldown_elapsed():
+            self._half_open = True
+            return True
+        return False
+
+    def record_failure(self):
+        """Count a failure; returns True on the closed→open (or
+        half-open→open) transition."""
+        if self.threshold <= 0:
+            return False
+        self.failures += 1
+        if self._opened_at is not None:
+            if self._half_open:
+                # the half-open trial failed: re-open, fresh cooldown
+                self._half_open = False
+                self._opened_at = self.clock()
+                self.opens += 1
+                return True
+            return False
+        if self.failures >= self.threshold:
+            self._opened_at = self.clock()
+            self._half_open = False
+            self.opens += 1
+            return True
+        return False
+
+    def record_success(self):
+        self.failures = 0
+        self._opened_at = None
+        self._half_open = False
+
+
+# ---------------------------------------------------------------------------
+# Global recovery invariants (the chaos fuzz checks these after every
+# convulsion; they are also importable by tests directly)
+
+
+def verify_page_conservation(cache, what="cache"):
+    """Free + (distinct mapped or cached) pages == allocatable; every
+    refcount equals the number of sequences mapping the page; the free
+    list never overlaps live/cached pages.  Raises AssertionError with
+    a labelled message on any violation."""
+    mapped = set()
+    rc = _Tally()
+    for sid in cache.live_seqs():
+        mapped.update(cache._tables[sid])
+        rc.update(cache._tables[sid])
+    resident = mapped | set(cache._cached)
+    assert cache.free_pages + len(resident) == cache.allocatable_pages, (
+        f"{what}: page leak — free={cache.free_pages} "
+        f"resident={len(resident)} allocatable={cache.allocatable_pages}")
+    free = set(cache._free)
+    assert not (free & resident), (
+        f"{what}: free list overlaps resident pages "
+        f"{sorted(free & resident)[:8]}")
+    for p in range(1, cache.num_pages):
+        assert cache.refcount(p) == rc.get(p, 0), (
+            f"{what}: page {p} refcount {cache.refcount(p)} != "
+            f"{rc.get(p, 0)} mapping sequences")
+
+
+def verify_engine_quiescent(engine, what="engine",
+                            require_drained=True):
+    """An idle engine holds NOTHING: no live scheduler work, no held
+    (prefilled) requests, no chaos alloc-pressure residue, and every
+    page back on the free list (cached prefix pages are reclaimable
+    capacity and count as available).  ``require_drained=False``
+    relaxes the empty-queue check for a CRASHED replica — its failure
+    path requeues live requests as waiting (recompute semantics, pages
+    freed), which is correct state, not a leak."""
+    if require_drained:
+        assert engine.scheduler.all_done(), (
+            f"{what}: scheduler not drained — "
+            f"waiting={engine.scheduler.queue_depth()} "
+            f"live={len(engine.scheduler.live_requests())}")
+    assert not engine.scheduler.live_requests(), (
+        f"{what}: {len(engine.scheduler.live_requests())} request(s) "
+        "still live")
+    assert not engine._held, (
+        f"{what}: {len(engine._held)} held request(s) leaked pages "
+        f"(ids {sorted(engine._held)[:8]})")
+    verify_page_conservation(engine.cache, what=what)
+    if engine._draft_cache is not None:
+        verify_page_conservation(engine._draft_cache, f"{what}.draft")
+    assert engine.cache.available_pages == engine.cache.allocatable_pages, (
+        f"{what}: {engine.cache.allocatable_pages - engine.cache.available_pages}"
+        " page(s) neither free nor reclaimable after drain")
+
+
+def fleet_invariants(router):
+    """Run the quiescence + conservation checks over every in-process
+    replica of a drained fleet (down/retired replicas included — a
+    crashed replica must still have released its pages) and the router
+    bookkeeping: no leaked router streams.  Returns the number of
+    engines checked."""
+    checked = 0
+    for i, rep in enumerate(router.replicas):
+        engine = getattr(rep, "engine", None)
+        if engine is None:  # HTTPReplica: remote state, not inspectable
+            continue
+        failed = getattr(rep, "state", "ok") == "failed"
+        verify_engine_quiescent(engine, what=f"replica[{i}]",
+                                require_drained=not failed)
+        checked += 1
+    assert not router._streams, (
+        f"router leaked {len(router._streams)} open stream(s)")
+    return checked

@@ -54,6 +54,7 @@ import time
 
 from .frontend import ROLES, Rejected, Unavailable
 from .kv_cache import GeometryMismatch, PrefixDrift
+from .pagewire import WireFormatError
 from .replica import ReplicaFailed
 from .router import RouterStream, ServingRouter
 
@@ -180,12 +181,14 @@ class DisaggRouter(ServingRouter):
             except ReplicaFailed as e:
                 with self._lock:
                     self._down.add(idx)
+                self._record_replica_failure(idx, e)
                 _log.warning(json.dumps(
                     {"event": "router_replica_down", "replica": idx,
                      "cause": str(e)}))
                 continue
             stream._inner = inner
             stream.replica_idx = idx
+            self._breakers[idx].record_success()
             self.metrics.routed_total.inc(policy="disagg_prefill",
                                           replica=idx)
             if self.trace.enabled:
@@ -223,6 +226,19 @@ class DisaggRouter(ServingRouter):
                 kw[key] = stream.kwargs[key]
         return kw
 
+    def _chaos_migration_fault(self, stream, dst_idx, point):
+        """Evaluate one migration fault point; a firing is visible as
+        a ``chaos`` span on the request's router timeline (plus the
+        flight-ring record the injector makes)."""
+        if not self.chaos.fire(point, to_replica=dst_idx,
+                               request_id=stream.request_id):
+            return False
+        if self.trace.enabled:
+            self.trace.span(stream.req_id, "chaos",
+                            time.perf_counter(), point=point,
+                            to_replica=dst_idx)
+        return True
+
     def _migrate(self, stream):
         """Move the held sequence to a decode replica and swap the
         stream's inner phase.  Destination failures try the next
@@ -239,6 +255,7 @@ class DisaggRouter(ServingRouter):
             self._role_idxs(("decode",), exclude={src_idx})) \
             + self._by_load(
                 self._role_idxs(("mixed",), exclude={src_idx}))
+        backoff = self.chaos.backoff()
         for dst_idx in order:
             dst = self.replicas[dst_idx]
             try:
@@ -247,25 +264,73 @@ class DisaggRouter(ServingRouter):
                 continue
             inner = None
             meta = None
-            for _ in range(self.migrate_retries):
+            drift_left = self.migrate_retries
+            transient = 0  # ReplicaFailed retries (bounded backoff)
+            while True:
                 # export MUST work: failures here are source failures
-                # and escalate to the caller's failover path
+                # and escalate to the caller's failover path (the
+                # chaos migrate_export_fail point models a partial
+                # export — the source is treated as sick)
                 try:
                     meta, k, v = src.export_pages(stream._inner, skip)
-                except KeyError as e:
+                except (KeyError, WireFormatError) as e:
+                    # KeyError: nothing held; WireFormatError: the
+                    # export was garbage but the source still holds
+                    # pages — release before abandoning it (round-14)
+                    try:
+                        src.release_pages(stream._inner)
+                    except Exception:  # pragma: no cover - src dying
+                        pass
                     raise RuntimeError(
                         f"source replica {src_idx} lost the held "
                         f"pages: {e}") from e
+                if self._chaos_migration_fault(stream, dst_idx,
+                                               "migrate_export_fail"):
+                    # the stream abandons the source: release its held
+                    # pages NOW (best effort — the round-14 rule:
+                    # anything that drops a request releases its
+                    # pages; the held-deadline sweep is the backstop)
+                    try:
+                        src.release_pages(stream._inner)
+                    except Exception:  # pragma: no cover - src dying
+                        pass
+                    raise RuntimeError(
+                        "chaos: partial export from source replica "
+                        f"{src_idx}")
                 try:
+                    if self._chaos_migration_fault(
+                            stream, dst_idx, "migrate_import_bounce"):
+                        raise GeometryMismatch(
+                            "chaos: destination bounced the import")
+                    if self._chaos_migration_fault(
+                            stream, dst_idx, "migrate_transfer_kill"):
+                        raise ReplicaFailed(
+                            "chaos: destination died mid-transfer")
                     inner = dst.adopt(meta, k, v, **kwargs)
                     break
                 except PrefixDrift as e:
+                    drift_left -= 1
+                    if drift_left <= 0:
+                        break
                     skip = e.cached_pages  # re-export the right suffix
                 except (Rejected, Unavailable, GeometryMismatch):
                     break
                 except ReplicaFailed as e:
+                    # transient destination failure: bounded retry with
+                    # exponential backoff + jitter.  Retrying is safe —
+                    # a failed adopt leaves no destination state (the
+                    # import is transactional: GeometryMismatch/
+                    # PrefixDrift/OutOfPages roll back) and the export
+                    # is read-only.  Exhausting the budget marks the
+                    # destination down and tries the next one.
+                    if transient < backoff.retries:
+                        self.metrics.retries_total.inc(op="migrate")
+                        self.chaos.sleep(backoff.delay(transient))
+                        transient += 1
+                        continue
                     with self._lock:
                         self._down.add(dst_idx)
+                    self._record_replica_failure(dst_idx, e)
                     _log.warning(json.dumps(
                         {"event": "router_replica_down",
                          "replica": dst_idx, "cause": str(e)}))
@@ -282,6 +347,7 @@ class DisaggRouter(ServingRouter):
             stream.replica_idx = dst_idx
             stream.phase = "decode"
             stream.migrations += 1
+            self._breakers[dst_idx].record_success()
             n_pages = int(meta["n_pages"])
             self.metrics.migrations_total.inc()
             self.metrics.migrated_pages_total.inc(n_pages)

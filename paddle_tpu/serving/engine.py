@@ -101,6 +101,7 @@ import time
 
 import numpy as np
 
+from .chaos import ChaosConfig, ChaosInjector
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
@@ -170,7 +171,7 @@ class ServingEngine:
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
                  cache_dtype=None, on_event=None, prefix_cache=None,
                  draft_model=None, speculative_k=None,
-                 weight_quant=None):
+                 weight_quant=None, chaos=None):
         cfg, core = self._validate_causal_lm(model)
         if weight_quant is None:
             weight_quant = os.environ.get(
@@ -292,8 +293,17 @@ class ServingEngine:
         # uses it to route tokens into per-request stream queues.
         self.on_event = on_event
         self._draining = False
-        self._fault_rng = np.random.default_rng(
-            int(os.environ.get("PADDLE_TPU_SERVING_FAULT_SEED", "0")))
+        # unified chaos layer (round 17): ONE injector per engine —
+        # accepts a ChaosInjector, a ChaosConfig, or None (env mode:
+        # the legacy FAULT_* knobs keep working as aliases, re-read
+        # per evaluation so monkeypatch-mid-test workflows still work)
+        if isinstance(chaos, ChaosInjector):
+            self.chaos = chaos
+        else:
+            assert chaos is None or isinstance(chaos, ChaosConfig)
+            self.chaos = ChaosInjector(chaos, name="engine")
+        self.chaos.bind(self.trace)
+        self._chaos_spike = None  # (seq_id, steps_left) alloc pressure
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
@@ -403,6 +413,7 @@ class ServingEngine:
             self._free_draft_seq(r.seq_id)
             self.metrics.deadline_evictions.inc()
             self._record_finish(r, events)
+        self.sweep_held_deadlines(now)
         if out.decode:
             self._decode_batch(out.decode, events)
         if out.prefill is not None:
@@ -418,7 +429,8 @@ class ServingEngine:
             # at admission), then loud, not a silent spin — the request
             # can never fit
             req = self.scheduler.waiting[0]
-            if not self._release_waiting_pins(exclude=req):
+            if not self._release_waiting_pins(exclude=req) \
+                    and not self._release_chaos_spike():
                 need = self.scheduler.worst_case_need(req)
                 if need + self.scheduler.watermark_pages \
                         > self.cache.available_pages:
@@ -463,6 +475,7 @@ class ServingEngine:
         except Exception:
             self.release_live()
             raise
+        self._release_chaos_spike()  # chaos residue dies with the run
         return self.results()
 
     def cancel(self, req_id):
@@ -533,24 +546,112 @@ class ServingEngine:
             self.scheduler.preempt(r)
         for rid in list(self._held):
             self.release_request(rid)
+        self._release_chaos_spike()
 
     def _maybe_inject_fault(self):
-        """Env-gated fault hook, evaluated at the step BOUNDARY (before
-        any device work or state mutation, so a raised step is safely
-        retryable): PADDLE_TPU_SERVING_FAULT_LATENCY_S sleeps,
-        PADDLE_TPU_SERVING_FAULT_ERROR_RATE raises FaultInjected with
-        that probability (PADDLE_TPU_SERVING_FAULT_SEED seeds it)."""
-        lat = os.environ.get("PADDLE_TPU_SERVING_FAULT_LATENCY_S")
-        if lat:
-            time.sleep(float(lat))
-        rate = os.environ.get("PADDLE_TPU_SERVING_FAULT_ERROR_RATE")
-        if rate and self._fault_rng.random() < float(rate):
+        """Chaos fault hook, evaluated at the step BOUNDARY (before any
+        device work or state mutation, so a raised step is safely
+        retryable).  Three engine-level fault points ride it:
+        ``step_latency`` (added per-step latency, via the injected
+        sleeper), ``alloc_pressure`` (a chaos sequence grabs a fraction
+        of the free pages for a few steps — exercising preemption and
+        load shedding), and ``step_fault`` (raises FaultInjected).  The
+        legacy PADDLE_TPU_SERVING_FAULT_* knobs alias into the same
+        schedule (ChaosConfig.from_env)."""
+        chaos = self.chaos
+        cfg = chaos.cfg
+        if not cfg.any_enabled and self._chaos_spike is None:
+            return
+        if chaos.fire("step_latency", cfg=cfg):
+            chaos.sleep(cfg.step_latency_s)
+        self._chaos_pressure_tick(chaos, cfg)
+        if chaos.fire("step_fault", cfg=cfg):
             self.metrics.faults_injected.inc()
             if self.trace.enabled:
-                self.trace.flight.record("fault", rate=float(rate))
+                self.trace.flight.record("fault",
+                                         rate=cfg.rate("step_fault"))
             raise FaultInjected(
                 "injected step fault "
-                f"(PADDLE_TPU_SERVING_FAULT_ERROR_RATE={rate})")
+                f"(chaos step_fault rate={cfg.rate('step_fault')})")
+
+    _CHAOS_SEQ = "__chaos_pressure__"
+
+    def _chaos_pressure_tick(self, chaos, cfg):
+        """Allocator pressure spike: on fire, a chaos-owned sequence
+        swallows ``alloc_pressure_frac`` of the current free pages for
+        ``alloc_pressure_steps`` steps, then releases them.  The spike
+        is accounted like any live sequence (conservation holds) and is
+        itself the LAST thing released under terminal page pressure
+        (``_release_chaos_spike``), so it degrades service — sheds,
+        preemptions — without ever deadlocking it."""
+        if self._chaos_spike is not None:
+            sid, left = self._chaos_spike
+            if left <= 1:
+                self._release_chaos_spike()
+            else:
+                self._chaos_spike = (sid, left - 1)
+            return
+        if not chaos.fire("alloc_pressure", cfg=cfg):
+            return
+        pages = int(self.cache.free_pages * cfg.alloc_pressure_frac)
+        if pages <= 0:
+            return
+        sid = self._CHAOS_SEQ
+        self.cache.alloc_seq(sid)
+        try:
+            self.cache.append_slots(sid, pages * self.cache.page_size)
+        except OutOfPages:  # pragma: no cover - sized from free_pages
+            self.cache.free_seq(sid)
+            return
+        self._chaos_spike = (sid, max(1, cfg.alloc_pressure_steps))
+
+    def _release_chaos_spike(self):
+        """Give back the alloc-pressure spike's pages.  Returns True
+        when pages were actually released."""
+        if self._chaos_spike is None:
+            return False
+        sid, _ = self._chaos_spike
+        self._chaos_spike = None
+        if self.cache.has_seq(sid):
+            self.cache.free_seq(sid)
+            return True
+        return False
+
+    def chaos_idle_tick(self):
+        """Idle-loop chaos upkeep (called by the front-end between
+        steps when the scheduler is drained): the held-deadline sweep
+        plus the alloc-pressure spike countdown — a spike must expire
+        even when no step runs, or an idle engine would shed every new
+        admission until traffic somehow restarted it."""
+        released = self.sweep_held_deadlines()
+        if self._chaos_spike is not None:
+            sid, left = self._chaos_spike
+            if left <= 1:
+                self._release_chaos_spike()
+            else:
+                self._chaos_spike = (sid, left - 1)
+        return released
+
+    def sweep_held_deadlines(self, now=None):
+        """Release HELD ("prefilled") requests whose deadline passed —
+        the round-14 rule (anything that can drop a request must
+        release held pages) enforced for timeouts: a migration that
+        never came back must not pin pages forever.  Called per step
+        and from the front-end's idle loop (a pure prefill replica
+        idles between handoffs).  Returns the number released."""
+        if not self._held:
+            return 0
+        now = self._now() if now is None else now
+        expired = [rid for rid, r in self._held.items()
+                   if r.deadline is not None and now >= r.deadline]
+        for rid in expired:
+            self.release_request(rid)
+            self.metrics.held_expired.inc()
+            if self.trace.enabled:
+                self.trace.flight.record("held_expired", req_id=rid)
+            _log.info(json.dumps({"event": "held_deadline_expired",
+                                  "req_id": rid}))
+        return len(expired)
 
     def results(self):
         return {rid: {"tokens": list(r.out_tokens),
@@ -583,6 +684,8 @@ class ServingEngine:
                 victim = self.scheduler.pick_victim(exclude=(req,))
                 if victim is None:
                     if self._release_waiting_pins():
+                        continue
+                    if self._release_chaos_spike():
                         continue
                     raise RuntimeError(
                         f"KV cache too small: request {req.req_id} "

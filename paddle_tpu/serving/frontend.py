@@ -173,6 +173,11 @@ class ServingFrontend:
         self._stop = threading.Event()
         self._drained = threading.Event()
         self._fault_streak = 0  # consecutive FaultInjected (escalation)
+        # loop naps route through the engine's chaos sleeper so fault
+        # schedules stay deterministic under a fake clock (graftlint
+        # serving-raw-sleep); engines always carry one since round 17
+        chaos = getattr(engine, "chaos", None)
+        self._sleep = chaos.sleep if chaos is not None else time.sleep
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -463,11 +468,11 @@ class ServingFrontend:
                             # a fault STREAK means the replica is sick,
                             # not unlucky: escalate to a loop failure
                             # (streams error out, the router fails the
-                            # requests over to a healthy replica)
+                            # requests over to a healthy replica). The
+                            # threshold rides ChaosConfig (the legacy
+                            # FAULT_ESCALATE_N env knob aliases in)
                             self._fault_streak += 1
-                            esc = int(os.environ.get(
-                                "PADDLE_TPU_SERVING_FAULT_ESCALATE_N",
-                                "0") or 0)
+                            esc = self._escalate_n()
                             if esc and self._fault_streak >= esc:
                                 self._fail_locked(RuntimeError(
                                     f"fault escalation after "
@@ -478,12 +483,28 @@ class ServingFrontend:
                             self._fail_locked(exc)
                             return
                     elif self._state == "draining":
+                        # quiesce: a live chaos alloc-pressure spike
+                        # must not outlive the drained loop
+                        eng._release_chaos_spike()
                         return
+                    else:
+                        # idle upkeep: held-deadline sweep + chaos
+                        # alloc-spike countdown — a pure prefill
+                        # replica idles between handoffs, and its held
+                        # pages must still expire on deadline
+                        eng.chaos_idle_tick()
                 # idle: nap off-lock; busy: yield so submitters can
                 # grab the lock between steps
-                time.sleep(self.poll_interval_s if idle else 0)
+                self._sleep(self.poll_interval_s if idle else 0)
         finally:
             self._drained.set()
+
+    def _escalate_n(self):
+        chaos = getattr(self.engine, "chaos", None)
+        if chaos is None:
+            return int(os.environ.get(
+                "PADDLE_TPU_SERVING_FAULT_ESCALATE_N", "0") or 0)
+        return int(chaos.cfg.escalate_n)
 
     def _fail_locked(self, exc):
         self._state = "failed"

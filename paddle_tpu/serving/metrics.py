@@ -173,6 +173,9 @@ class ServingMetrics:
         self.cancellations = Counter()        # cancel() calls that landed
         self.rejections = Counter()           # load-shed admissions (429)
         self.faults_injected = Counter()      # injected step faults
+        # chaos/robustness layer (round 17)
+        self.held_expired = Counter()         # held pages released on
+        #                                       deadline expiry
         # speculative decoding (round 12)
         self.spec_rounds = Counter()          # draft-propose/verify rounds
         self.spec_draft_tokens = Counter()    # tokens the draft proposed

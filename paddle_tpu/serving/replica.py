@@ -39,6 +39,7 @@ import time
 
 import numpy as np
 
+from .chaos import ChaosConfig, ChaosInjector
 from .frontend import Rejected, ServingFrontend, Unavailable
 
 __all__ = ["HTTPReplica", "InProcessReplica", "ReplicaFailed"]
@@ -145,12 +146,13 @@ class _HTTPStream:
     presents the same ``events(timeout, idle_s)`` surface as
     :class:`~paddle_tpu.serving.frontend.RequestStream`."""
 
-    def __init__(self, conn, resp, req_id, n):
+    def __init__(self, conn, resp, req_id, n, chaos=None):
         self._conn = conn
         self._resp = resp
         self.req_id = req_id
         self.n = int(n)
         self._closed = False
+        self._chaos = chaos
         self.remote_id = None  # "cmpl-<engine req_id>" from the chunks
 
     @property
@@ -172,6 +174,15 @@ class _HTTPStream:
         except (AttributeError, OSError):
             pass
         while finishes < self.n:
+            if self._chaos is not None \
+                    and self._chaos.fire("http_midstream_eof",
+                                         stream=self.req_id):
+                # the transport died mid-decode: hang up for real so
+                # the remote cancels the request (pages freed), then
+                # signal the router's failover path
+                self.close()
+                raise ReplicaFailed(
+                    "chaos: replica stream EOF mid-decode")
             try:
                 raw = self._resp.fp.readline()
             except (socket.timeout, TimeoutError):
@@ -248,12 +259,32 @@ class HTTPReplica:
     kind = "http"
 
     def __init__(self, host, port, *, timeout_s=120.0, name=None,
-                 role=None):
+                 role=None, chaos=None):
         self.host = host
         self.port = int(port)
         self.timeout_s = float(timeout_s)
         self.name = name or f"{host}:{port}"
         self._role = role  # None -> lazily read from /healthz
+        # chaos layer (round 17): network fault injection (connect
+        # refused / mid-stream EOF / slow reads) + the retry knobs for
+        # the idempotent hops below
+        if isinstance(chaos, ChaosInjector):
+            self.chaos = chaos
+        else:
+            assert chaos is None or isinstance(chaos, ChaosConfig)
+            self.chaos = ChaosInjector(chaos, name=f"http:{self.name}")
+        self.retry_count = 0  # transport retries (router /metrics)
+
+    def _chaos_connect(self):
+        """The connect-refused fault point, evaluated before any real
+        socket work (the raise matches a dead listener's errno path)."""
+        if self.chaos.fire("http_connect", replica=self.name):
+            raise ConnectionRefusedError(
+                f"chaos: connection to {self.name} refused")
+
+    def _chaos_slow_read(self):
+        if self.chaos.fire("http_slow_read", replica=self.name):
+            self.chaos.sleep(self.chaos.cfg.slow_read_s)
 
     @property
     def role(self):
@@ -282,10 +313,12 @@ class HTTPReplica:
         if kw.get("request_id"):
             headers["X-Request-Id"] = str(kw["request_id"])
         try:
+            self._chaos_connect()
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout_s)
             conn.request("POST", "/v1/completions", json.dumps(body),
                          headers)
+            self._chaos_slow_read()
             resp = conn.getresponse()
         except OSError as e:
             raise ReplicaFailed(
@@ -293,7 +326,8 @@ class HTTPReplica:
         if resp.status == 200:
             return _HTTPStream(conn, resp,
                                req_id=f"{self.name}/{id(resp):x}",
-                               n=int(kw.get("n", 1)))
+                               n=int(kw.get("n", 1)),
+                               chaos=self.chaos)
         payload = resp.read()
         retry_after = resp.getheader("Retry-After")
         conn.close()
@@ -317,24 +351,47 @@ class HTTPReplica:
         return True
 
     # -- KV page migration (disagg tier, /v1/_pages) -----------------------
+    def _retrying(self, fn, what):
+        """Bounded retry with exponential backoff + jitter for the
+        IDEMPOTENT hops (probe/export/release/healthz/metrics — reads
+        and at-most-once releases; ``submit``/``adopt`` are NOT routed
+        here, the router's failover/re-prefill contract covers those).
+        Transport errors only; HTTP status handling stays with the
+        caller.  Sleeps go through the chaos sleeper."""
+        backoff = self.chaos.backoff()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except OSError as e:
+                if attempt >= backoff.retries:
+                    raise ReplicaFailed(
+                        f"replica {self.name} unreachable after "
+                        f"{attempt} retr"
+                        f"{'y' if attempt == 1 else 'ies'} "
+                        f"({what}): {e!r}") from e
+                self.retry_count += 1
+                self.chaos.sleep(backoff.delay(attempt))
+                attempt += 1
+
     def _post_json(self, path, obj, timeout=None):
-        try:
+        def once():
+            self._chaos_connect()
             conn = http.client.HTTPConnection(
                 self.host, self.port,
                 timeout=timeout or self.timeout_s)
-            conn.request("POST", path, json.dumps(obj),
-                         {"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            data = resp.read()
-        except OSError as e:
-            raise ReplicaFailed(
-                f"replica {self.name} unreachable: {e!r}") from e
-        finally:
             try:
-                conn.close()
-            except (OSError, UnboundLocalError):
-                pass
-        return resp.status, data
+                conn.request("POST", path, json.dumps(obj),
+                             {"Content-Type": "application/json"})
+                self._chaos_slow_read()
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        return self._retrying(once, f"POST {path}")
 
     def probe_pages(self, prompt):
         status, data = self._post_json(
@@ -389,18 +446,21 @@ class HTTPReplica:
         payload = serialize_pages(meta, k_arrays, v_arrays,
                                   request=request)
         try:
+            self._chaos_connect()
             conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout_s)
             conn.request("POST", "/v1/_pages", payload,
                          {"Content-Type":
                           "application/x-paddle-tpu-kv-pages"})
+            self._chaos_slow_read()
             resp = conn.getresponse()
         except OSError as e:
             raise ReplicaFailed(
                 f"replica {self.name} unreachable: {e!r}") from e
         if resp.status == 200:
             return _HTTPStream(conn, resp,
-                               req_id=f"{self.name}/{id(resp):x}", n=1)
+                               req_id=f"{self.name}/{id(resp):x}", n=1,
+                               chaos=self.chaos)
         data = resp.read()
         conn.close()
         try:
@@ -427,19 +487,23 @@ class HTTPReplica:
 
     # -- observability -----------------------------------------------------
     def _get(self, path):
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=10.0)
-        try:
-            conn.request("GET", path)
-            resp = conn.getresponse()
-            return resp.status, resp.read()
-        finally:
-            conn.close()
+        def once():
+            self._chaos_connect()
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=10.0)
+            try:
+                conn.request("GET", path)
+                self._chaos_slow_read()
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+        return self._retrying(once, f"GET {path}")
 
     def health(self):
         try:
             status, data = self._get("/healthz")
-        except OSError as e:
+        except (OSError, ReplicaFailed) as e:
             return {"status": "unreachable", "error": repr(e)}
         try:
             out = json.loads(data)
@@ -462,7 +526,7 @@ class HTTPReplica:
     def prometheus(self):
         try:
             status, data = self._get("/metrics")
-        except OSError:
+        except (OSError, ReplicaFailed):
             return ""
         return data.decode() if status == 200 else ""
 
@@ -498,7 +562,7 @@ class HTTPReplica:
                 return False
             if not (h.get("waiting", 0) or h.get("live", 0)):
                 return True
-            time.sleep(0.05)
+            self.chaos.sleep(0.05)
         return False
 
     def resume(self):

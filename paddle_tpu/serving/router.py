@@ -58,12 +58,25 @@ is cold after a restart); in-process replicas whose loop FAILED report
 ``readmit_replica(reload=...)``). ``probe_now()`` runs one probe pass
 synchronously (tests/operators).
 
+**Circuit breaker + chaos (round 17)** — each replica carries a
+:class:`~paddle_tpu.serving.chaos.CircuitBreaker`: repeated failures
+(placement, failover, probe) open it and the replica drops out of
+routing until the cooldown's half-open trial; the state rides
+``/healthz`` (``breaker``) and ``/metrics`` (``breaker_opens_total``,
+``replica_breaker_open``), and an OPEN dumps the router flight ring to
+the structured log.  Router-side chaos fault points (``crash_drain``/
+``crash_readmit``/``crash_shrink``, plus the migration points in the
+disagg subclass) ride the ``chaos=`` config.
+
 Env knobs: ``PADDLE_TPU_SERVING_ROUTER_POLICY``,
 ``PADDLE_TPU_SERVING_ROUTER_LOAD_CAP`` (pages),
 ``PADDLE_TPU_SERVING_PROBE_S`` (seconds; 0/unset disables the prober),
 ``PADDLE_TPU_SERVING_ROUTER_KILL="<replica>:<after_tokens>"`` (fault
 injection: kill replica *i* once it has delivered that many tokens
-through the router — the failover drill used by bench/tests).
+through the router — the failover drill used by bench/tests; aliases
+into ``ChaosConfig``), ``PADDLE_TPU_SERVING_BREAKER_N`` /
+``_BREAKER_COOLDOWN_S``, ``PADDLE_TPU_SERVING_RETRY_*`` (backoff),
+``PADDLE_TPU_SERVING_CHAOS`` (the unified fault schedule).
 """
 from __future__ import annotations
 
@@ -76,6 +89,7 @@ import time
 
 import numpy as np
 
+from .chaos import ChaosConfig, ChaosInjector, CircuitBreaker
 from .frontend import Rejected, Unavailable
 from .metrics import (Counter, Gauge, LabeledCounter, merge_prometheus)
 from .replica import ReplicaFailed
@@ -113,6 +127,10 @@ class RouterMetrics:
         self.spliced_tokens_total = Counter()
         self.router_shed_total = Counter()
         self.readmissions_total = LabeledCounter("replica")  # prober
+        # robustness layer (round 17): retry/backoff + circuit breaker
+        self.retries_total = LabeledCounter("op")     # migrate/http hops
+        self.breaker_opens_total = LabeledCounter("replica")
+        self.chaos_injected_total = LabeledCounter("point")  # router-side
         # disaggregated tier (round 14)
         self.migrations_total = Counter()        # prefill->decode splices
         self.migrated_pages_total = Counter()    # KV pages transferred
@@ -120,6 +138,7 @@ class RouterMetrics:
         self.autoscale_events = LabeledCounter("direction", "role")
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
+        self.replica_breaker_open = LabeledCounter("replica")  # gauge-ish
 
     def export(self):
         return {name: m.export() if hasattr(m, "export") else m
@@ -210,7 +229,8 @@ class ServingRouter:
     def __init__(self, replicas, *, policy=None, page_size=16,
                  cache_load_cap=None, max_tree_pages=8,
                  max_tree_nodes=4096, seed=None,
-                 probe_interval_s=None):
+                 probe_interval_s=None, chaos=None,
+                 breaker_clock=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         policy = policy or os.environ.get(
@@ -247,12 +267,25 @@ class ServingRouter:
         self._streams: dict[int, RouterStream] = {}
         self._seed_rng = np.random.default_rng(seed)
         self._started = False
-        # env-gated fault injection: "<replica>:<after_tokens>"
-        kill = os.environ.get("PADDLE_TPU_SERVING_ROUTER_KILL")
-        self._kill = None
-        if kill:
-            idx, after = kill.split(":")
-            self._kill = [int(idx), int(after), False]
+        # unified chaos layer (round 17): router-side fault points
+        # (replica crash during drain/readmit/shrink, migration faults
+        # in the disagg subclass) + the retry/backoff knobs; the legacy
+        # ROUTER_KILL drill aliases into the same config
+        if isinstance(chaos, ChaosInjector):
+            self.chaos = chaos
+        else:
+            assert chaos is None or isinstance(chaos, ChaosConfig)
+            self.chaos = ChaosInjector(chaos, name="router")
+        self.chaos.bind(self.trace)
+        # per-replica circuit breakers: repeated failures open the
+        # breaker (replica excluded from routing), the cooldown admits
+        # a half-open trial, a success closes it again.  breaker_clock
+        # injects the time source for deterministic tests.
+        self._breaker_clock = breaker_clock
+        self._breakers = [self._new_breaker()
+                          for _ in range(len(self.replicas))]
+        kill = self.chaos.cfg.router_kill
+        self._kill = [kill[0], kill[1], False] if kill else None
         self._replica_tokens = [0] * len(self.replicas)
         # background health prober (round 12): bounded re-probe of DOWN
         # replicas, auto-readmit on recovery
@@ -306,6 +339,37 @@ class ServingRouter:
             r.close()
         return ok
 
+    # -- circuit breaker (round 17) ----------------------------------------
+    def _new_breaker(self):
+        cfg = self.chaos.cfg
+        return CircuitBreaker(cfg.breaker_n, cfg.breaker_cooldown_s,
+                              clock=self._breaker_clock)
+
+    def breaker_state(self, i):
+        return self._breakers[i].state
+
+    def _record_replica_failure(self, idx, cause):
+        """Feed the replica's circuit breaker; on the closed→open (or
+        half-open→open) transition, count it, and dump the router's
+        flight ring to the structured log — the breaker opening means
+        the fleet lost capacity to a FLAKY (not hard-dead) replica,
+        which is exactly the post-mortem the ring exists for."""
+        if idx is None or idx >= len(self._breakers):
+            return
+        if not self._breakers[idx].record_failure():
+            return
+        self.metrics.breaker_opens_total.inc(replica=idx)
+        _log.warning(json.dumps({"event": "router_breaker_open",
+                                 "replica": idx, "cause": str(cause)}))
+        if self.trace.enabled:
+            self.trace.flight.record("breaker_open", replica=idx,
+                                     cause=str(cause))
+            _log.error(json.dumps({
+                "event": "flight_recorder_dump",
+                "cause": "breaker_open", "replica": idx,
+                "recorded": self.trace.flight.recorded,
+                "events": self.trace.flight.dump()}))
+
     # -- background health prober (round 12) -------------------------------
     def _probe_loop(self):
         while not self._probe_stop.wait(self.probe_interval_s):
@@ -326,15 +390,22 @@ class ServingRouter:
                     and i not in self._retired]
         readmitted = []
         for i in down:
+            # the breaker feeds the prober: an open breaker's cooldown
+            # gates the re-probe (no point hammering a flaky replica),
+            # and a failed probe re-opens a half-open breaker
+            if not self._breakers[i].allow():
+                continue
             try:
                 status = self.replicas[i].health().get("status")
-            except Exception:
+            except Exception as e:
+                self._record_replica_failure(i, e)
                 continue
             if status != "ok":
                 continue
             with self._lock:
                 self._down.discard(i)
                 self._forget_owner(self._root, i)
+            self._breakers[i].record_success()
             self.metrics.readmissions_total.inc(replica=i)
             readmitted.append(i)
             _log.info(json.dumps({"event": "router_replica_readmitted",
@@ -391,6 +462,9 @@ class ServingRouter:
                     h["status"] = "draining"
                 h.setdefault("role", self.roles[i])
                 per.append(h)
+            # breaker state is advertised for EVERY slot: routers and
+            # operators see flaky-but-alive replicas before they 5xx
+            per[-1]["breaker"] = self._breakers[i].state
         agg = self.state
         return {"status": agg,
                 "policy": self.policy,
@@ -410,6 +484,16 @@ class ServingRouter:
             self.metrics.replica_healthy._values[(str(i),)] = healthy
             self.metrics.replica_draining._values[(str(i),)] = int(
                 i in self._draining)
+            self.metrics.replica_breaker_open._values[(str(i),)] = int(
+                self._breakers[i].state == "open")
+            # HTTP replicas count their own transport retries; surface
+            # them in the fleet exposition next to the migrate retries
+            hops = getattr(self.replicas[i], "retry_count", 0)
+            if hops:
+                self.metrics.retries_total._values[
+                    (f"http:{i}",)] = hops
+        for point, n in self.chaos.counts.items():
+            self.metrics.chaos_injected_total._values[(point,)] = n
         parts = [(None, self.metrics.to_prometheus())]
         for i, r in enumerate(self.replicas):
             if i in self._down or i in self._retired:
@@ -473,10 +557,20 @@ class ServingRouter:
     def drain_replica(self, i, timeout=120.0):
         """Route new work away from replica ``i`` and finish its
         in-flight requests (zero lost work). Returns True when fully
-        drained in time."""
+        drained in time.  A replica that CRASHES mid-drain (the chaos
+        ``crash_drain`` point, or any drain exception) reports False —
+        its live pages were released on the failure path and its open
+        streams failed over; the drain never deadlocks on it."""
         with self._lock:
             self._draining.add(i)
-        ok = self.replicas[i].drain(timeout)
+        if self.chaos.fire("crash_drain", replica=i):
+            self.kill_replica(i, ReplicaFailed(
+                "chaos: replica crashed during drain"))
+        try:
+            ok = self.replicas[i].drain(timeout)
+        except Exception as e:  # a crashed replica must not stall drain
+            self._record_replica_failure(i, e)
+            ok = False
         _log.info(json.dumps({"event": "router_drain_replica",
                               "replica": i, "drained": ok}))
         return ok
@@ -491,10 +585,17 @@ class ServingRouter:
             rep.reload(reload)
         else:
             rep.resume()
+        if self.chaos.fire("crash_readmit", replica=i):
+            # crash between resume and routability: the slot stays
+            # down, its (empty — just resumed) state is released
+            self.kill_replica(i, ReplicaFailed(
+                "chaos: replica crashed during readmit"))
+            return
         with self._lock:
             self._draining.discard(i)
             self._down.discard(i)
             self._forget_owner(self._root, i)
+        self._breakers[i].record_success()  # operator readmit: clean slate
         _log.info(json.dumps({"event": "router_readmit_replica",
                               "replica": i}))
 
@@ -506,6 +607,7 @@ class ServingRouter:
             self.replicas.append(replica)
             self.roles.append(role or getattr(replica, "role", "mixed"))
             self._replica_tokens.append(0)
+            self._breakers.append(self._new_breaker())
             i = len(self.replicas) - 1
         if self._started:
             replica.start()
@@ -523,7 +625,14 @@ class ServingRouter:
             if i in self._retired:
                 return True
             self._draining.add(i)
-        ok = self.replicas[i].drain(timeout)
+        if self.chaos.fire("crash_shrink", replica=i):
+            self.kill_replica(i, ReplicaFailed(
+                "chaos: replica crashed during autoscaler shrink"))
+        try:
+            ok = self.replicas[i].drain(timeout)
+        except Exception as e:  # crashed mid-shrink: retire anyway —
+            self._record_replica_failure(i, e)  # pages were released
+            ok = False                          # on the failure path
         try:
             self.replicas[i].close(timeout)
         except Exception:  # pragma: no cover - best-effort teardown
@@ -558,6 +667,10 @@ class ServingRouter:
         for i in range(len(self.replicas)):
             if i in self._down or i in self._draining \
                     or i in self._retired or i in exclude:
+                continue
+            # open breaker: the replica is alive but flaky — keep
+            # traffic away until the cooldown admits a half-open trial
+            if not self._breakers[i].allow():
                 continue
             out.append(i)
         return out
@@ -685,12 +798,14 @@ class ServingRouter:
             except ReplicaFailed as e:
                 with self._lock:
                     self._down.add(idx)
+                self._record_replica_failure(idx, e)
                 _log.warning(json.dumps(
                     {"event": "router_replica_down", "replica": idx,
                      "cause": str(e)}))
                 continue
             stream._inner = inner
             stream.replica_idx = idx
+            self._breakers[idx].record_success()
             self.metrics.routed_total.inc(policy=self.policy,
                                           replica=idx)
             if self.trace.enabled:
@@ -716,6 +831,7 @@ class ServingRouter:
         failed = stream.replica_idx
         with self._lock:
             self._down.add(failed)
+        self._record_replica_failure(failed, exc)
         stream.failovers += 1
         spliced = sum(d for d, f in zip(stream._delivered,
                                         stream._finished) if not f)

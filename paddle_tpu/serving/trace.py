@@ -38,9 +38,12 @@ Three pieces:
 - **Flight recorder** (:class:`FlightRecorder`): a fixed-size ring of
   recent engine events (``PADDLE_TPU_SERVING_TRACE_FLIGHT``, default
   256) — step begin (batch composition) / step end (wall time),
-  admission, shed, preemption, fault injection, drain, loop error.  On
-  loop failure the front-end dumps the ring to the structured log, so
-  the round-9/11 failure classes are post-mortem-able without a rerun.
+  admission, shed, preemption, fault injection, drain, loop error;
+  round 17 adds ``chaos`` (injected fault firings), ``held_expired``
+  (deadline-released held pages) and, on the router ring,
+  ``breaker_open``.  The ring is dumped to the structured log on loop
+  failure, on fault ESCALATION, and on a circuit-breaker open, so the
+  round-9/11 failure classes are post-mortem-able without a rerun.
 
 - **Chrome export**: completed timelines convert to chrome://tracing
   JSON via the same event dict shape ``paddle_tpu.profiler`` emits

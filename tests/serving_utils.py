@@ -1,0 +1,40 @@
+"""Shared serving-test helpers (round 17, chaos PR).
+
+The round-11 addenda's lesson, promoted to a utility: fixed-sleep
+assertions against a live engine loop RACE the lock (the loop may hold
+it across a whole step, so "sleep 50 ms then assert" fails under suite
+CPU load) — poll with a deadline instead.  The chaos fuzz shakes out
+exactly this flake class, so every converted call site routes through
+here."""
+import time
+
+
+def wait_until(cond, timeout=30.0, interval=0.01, msg=None):
+    """Poll ``cond()`` until truthy; returns its value.  Raises
+    AssertionError (with ``msg`` or the condition's repr) when the
+    deadline passes — never a silent False, so a racing assertion
+    becomes a labelled failure, not a flake."""
+    deadline = time.monotonic() + timeout
+    while True:
+        value = cond()
+        if value:
+            return value
+        if time.monotonic() >= deadline:
+            raise AssertionError(
+                msg or f"condition {cond!r} not met within {timeout}s")
+        time.sleep(interval)
+
+
+def wait_until_live(replica, n=1, timeout=30.0):
+    """Deadline-poll until a replica reports >= n live requests (its
+    engine loop actually picked the work up)."""
+    return wait_until(
+        lambda: replica.health().get("live", 0) >= n, timeout=timeout,
+        msg=f"replica never reached {n} live request(s)")
+
+
+def wait_until_reserved(replica, timeout=30.0):
+    """Deadline-poll until a replica holds a nonzero page reservation
+    (admission landed; the load signal other submits route on)."""
+    return wait_until(lambda: replica.load() > 0, timeout=timeout,
+                      msg="replica never reported a reservation")

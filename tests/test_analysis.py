@@ -38,15 +38,16 @@ def rule_ids(findings):
 # rule registry sanity
 
 class TestRegistry:
-    def test_nine_rules_with_ids_and_docs(self):
-        assert len(ALL_RULES) == 9
+    def test_ten_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 10
         for r in ALL_RULES:
             assert r.id and r.description
         assert set(RULES_BY_ID) == {
             "autograd-bypass", "thread-grad-state", "pallas-hazards",
             "jit-constant-capture", "dist-spec-passthrough",
             "chip-kill-on-timeout", "engine-lock-discipline",
-            "page-migration-lock", "env-knob-registry"}
+            "page-migration-lock", "env-knob-registry",
+            "serving-raw-sleep"}
 
 
 # ---------------------------------------------------------------------------
@@ -515,6 +516,58 @@ class TestPageMigrationLock:
                      "paddle_tpu/serving/frontend.py"):
             assert lint(_MIGRATE_BAD, path,
                         "page-migration-lock") == []
+
+
+# ---------------------------------------------------------------------------
+# 7c. serving-raw-sleep (round 17, chaos layer)
+
+_SLEEP_BAD = """
+    import time
+
+    class Loop:
+        def run(self, engine):
+            while True:
+                engine_step_somehow()
+                time.sleep(0.001)   # nondeterministic under chaos
+"""
+
+_SLEEP_GOOD = """
+    class Loop:
+        def run(self, engine):
+            while True:
+                engine_step_somehow()
+                engine.chaos.sleep(0.001)   # injected sleeper
+"""
+
+_SLEEP_SUPPRESSED = """
+    import time
+
+    class Loop:
+        def run(self):
+            time.sleep(1)  # graftlint: disable=serving-raw-sleep (operator CLI wait, not a loop path)
+"""
+
+
+class TestServingRawSleep:
+    def test_raw_sleep_in_serving_flags(self):
+        fs = lint(_SLEEP_BAD, "paddle_tpu/serving/newloop.py",
+                  "serving-raw-sleep")
+        assert len(fs) == 1
+        assert "chaos sleeper" in fs[0].message
+
+    def test_injected_sleeper_passes(self):
+        assert lint(_SLEEP_GOOD, "paddle_tpu/serving/newloop.py",
+                    "serving-raw-sleep") == []
+
+    def test_chaos_module_and_outside_serving_exempt(self):
+        assert lint(_SLEEP_BAD, "paddle_tpu/serving/chaos.py",
+                    "serving-raw-sleep") == []
+        assert lint(_SLEEP_BAD, "paddle_tpu/hapi/model.py",
+                    "serving-raw-sleep") == []
+
+    def test_reasoned_suppression_holds(self):
+        assert lint(_SLEEP_SUPPRESSED, "paddle_tpu/serving/newloop.py",
+                    "serving-raw-sleep") == []
 
 
 # ---------------------------------------------------------------------------

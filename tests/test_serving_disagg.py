@@ -28,6 +28,7 @@ from paddle_tpu.serving import (DisaggRouter, FleetAutoscaler,
                                 ServingServer, WireFormatError,
                                 deserialize_pages, serialize_pages)
 from paddle_tpu.serving.autoscale import parse_role_spec
+from serving_utils import wait_until
 
 
 def tiny_model(seed=0, **kw):
@@ -596,8 +597,15 @@ class TestDisaggHandoff:
         try:
             streams = [router.submit(p, max_new_tokens=6)
                        for p in prompts]
-            # kill a prefill replica while its chunked prefill runs
-            time.sleep(0.06)
+            # kill a prefill replica once its chunked prefill is in
+            # flight (or already held — the 50 ms/step fault latency
+            # makes mid-prefill the common case; deadline-poll, never
+            # a fixed sleep)
+            victim = router.replicas[streams[0].replica_idx]
+            wait_until(
+                lambda: (lambda h: h.get("live", 0) or h.get("held", 0))
+                (victim.health()),
+                msg="prefill never started on the victim replica")
             router.kill_replica(streams[0].replica_idx)
             got = [consume(s) for s in streams]
             assert got == want

@@ -20,6 +20,7 @@ from paddle_tpu.serving import (HTTPReplica, InProcessReplica, Rejected,
                                 ReplicaFailed, ServingEngine,
                                 ServingRouter, ServingServer,
                                 Unavailable)
+from serving_utils import wait_until, wait_until_reserved
 
 
 def tiny_model(seed=0, **kw):
@@ -94,7 +95,7 @@ class TestPolicies:
             # park a long request on whichever replica takes it
             busy = router.submit(np.asarray([1, 2, 3], np.int32),
                                  max_new_tokens=30)
-            time.sleep(0.1)  # it holds a reservation now
+            wait_until_reserved(router.replicas[busy.replica_idx])
             other = router.submit(np.asarray([4, 5], np.int32),
                                   max_new_tokens=2)
             assert other.replica_idx != busy.replica_idx
@@ -142,7 +143,7 @@ class TestPolicies:
                 return router.submit(p, max_new_tokens=max_new)
 
             first = req(1, 30)  # sticky replica now exceeds the cap
-            time.sleep(0.1)
+            wait_until_reserved(router.replicas[first.replica_idx])
             second = req(2, 2)  # hot prefix must SPILL, not queue
             assert second.replica_idx != first.replica_idx
             second.result(timeout=60)
@@ -358,13 +359,21 @@ class TestRollingDrain:
             prompts = rng_prompts(4, seed=20)
             streams = [router.submit(p, max_new_tokens=12)
                        for p in prompts]
-            time.sleep(0.05)  # both replicas have in-flight work
+            # both replicas picked their work up (live mid-decode, or
+            # already finished — either way the drain drains real
+            # state; deadline-poll, never a fixed sleep)
+            for i in range(2):
+                wait_until(
+                    lambda i=i: (lambda h: h.get("live", 0)
+                                 or h.get("requests_finished", 0))
+                    (router.replicas[i].health()),
+                    msg=f"replica {i} never picked up work")
             target = streams[0].replica_idx
             done = {}
             td = threading.Thread(target=lambda: done.setdefault(
                 "ok", router.drain_replica(target, timeout=120)))
             td.start()
-            time.sleep(0.02)
+            wait_until(lambda: target in router._draining)
             # new work while draining: routed AWAY, never 5xx
             extra = [router.submit(p, max_new_tokens=4)
                      for p in rng_prompts(3, seed=21)]
@@ -670,11 +679,9 @@ class TestHealthProber:
             remote_srv2 = ServingServer(make_engine(), port=port)
             remote_srv2.start()
             try:
-                deadline = time.monotonic() + 10
-                while 0 in router._down \
-                        and time.monotonic() < deadline:
-                    time.sleep(0.05)
-                assert 0 not in router._down, "prober never readmitted"
+                wait_until(lambda: 0 not in router._down, timeout=10,
+                           interval=0.05,
+                           msg="prober never readmitted")
                 assert router.metrics.readmissions_total.value(
                     replica=0) == 1
                 # and the readmitted replica serves traffic again

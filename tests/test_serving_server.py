@@ -18,6 +18,7 @@ import pytest
 import paddle_tpu as P
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import ServingEngine, ServingServer
+from serving_utils import wait_until
 
 
 def tiny_model(seed=0, **kw):
@@ -288,7 +289,9 @@ class TestDrain:
 
             t = threading.Thread(target=request)
             t.start()
-            time.sleep(0.3)  # admitted and decoding (50ms/step)
+            # deadline-poll, not a fixed sleep: admitted and decoding
+            wait_until(lambda: eng.metrics.tokens_generated.value > 0,
+                       msg="request never started decoding")
             drained = {}
             td = threading.Thread(
                 target=lambda: drained.setdefault(
@@ -389,8 +392,11 @@ class TestMetricsEndpoint:
 
 class TestFaultInjection:
     def test_injected_errors_do_not_lose_requests(self, monkeypatch):
+        # seed 3's step_fault stream fires on the FIRST draw (the
+        # round-17 chaos layer derives one RNG stream per fault point,
+        # so the old seed-7 schedule no longer applies)
         monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_ERROR_RATE", "0.3")
-        monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_SEED", "7")
+        monkeypatch.setenv("PADDLE_TPU_SERVING_FAULT_SEED", "3")
         m = tiny_model(seed=9)
         prompt = np.random.default_rng(9).integers(0, 97, 6).astype(
             np.int32)

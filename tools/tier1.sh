@@ -14,6 +14,10 @@ cd "$(dirname "$0")/.."
 # graftlint gate (ISSUE 6): invariant lint + env-knob registry sync
 # run ahead of the suite — a new finding fails tier-1 before pytest.
 bash tools/lint.sh || exit 1
+# chaos smoke (ISSUE 10): one fixed-seed fault schedule through a
+# mixed fleet, global recovery invariants asserted — runtime-bounded
+# so the pytest window stays intact.
+bash tools/chaos_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
