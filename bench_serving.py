@@ -6,6 +6,17 @@ Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
                                [--router] [--spec] [--disagg] [--kv8]
                                [--trace] [--trace-out FILE]
+                               [--prefix-fleet]
+
+`--prefix-fleet` measures the round-18 fleet-wide prefix cache: the
+shared-prefix workload through a 2-replica fleet in three configs —
+cache-aware local hits (ships off), least-loaded recompute (ships
+off), least-loaded with prefix SHIPS on (the router moves the cached
+prefix pages over the pagewire path to the replica it places each
+request on, so only the unique tail is prefilled). Client-side TTFT
+per config + two-point marginals; greedy AND seeded-sampled streams
+are asserted token-exact vs a single-engine oracle through the ships.
+Banks BENCH_serving_prefix_fleet.json.
 
 `--trace` is the round-16 observability OVERHEAD GUARD: the same
 Poisson trace replays through two warm engines — tracing on (the
@@ -136,6 +147,9 @@ if kv8_mode:
 trace_mode = "--trace" in sys.argv
 if trace_mode:
     sys.argv.remove("--trace")
+prefix_fleet_mode = "--prefix-fleet" in sys.argv
+if prefix_fleet_mode:
+    sys.argv.remove("--prefix-fleet")
 trace_out = None
 if "--trace-out" in sys.argv:
     i = sys.argv.index("--trace-out")
@@ -263,8 +277,10 @@ def main():
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     prefix_len = 96  # shared-prefix mode: 6 pages of 16
+    if prefix_fleet_mode and not smoke:
+        prefix_len = 224  # 14 pages: the probe ships vs re-prefills it
     maxlen = (prefix_len + 16 if prefix_mode or router_mode
-              or disagg_mode else 64) + max_new + 1
+              or disagg_mode or prefix_fleet_mode else 64) + max_new + 1
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
@@ -303,6 +319,9 @@ def main():
         return
     if trace_mode:
         _bench_trace_overhead(model, cfg, engine_kw, on_tpu)
+        return
+    if prefix_fleet_mode:
+        _bench_prefix_fleet(cfg, engine_kw, on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -631,6 +650,268 @@ def _bench_router(cfg, engine_kw, on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_router.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_prefix_fleet(cfg, engine_kw, on_tpu):
+    """Fleet-wide prefix cache bench (round 18), two parts.
+
+    (1) TTFT PROBES — the acceptance comparison, measured serially on
+    an idle 2-replica fleet so the three placement classes are pure
+    step cost, not queueing noise: ``local`` (request lands on the
+    replica already holding the shared prefix — radix hit, tail-only
+    prefill), ``cross`` (request lands on a COLD replica with fleet
+    ships ON: the pages move over the pagewire path, then tail-only
+    prefill), ``recompute`` (same cold placement, ships OFF: the full
+    shared prefix re-prefills).  The claim: cross beats recompute and
+    sits within ~2x of local.
+
+    (2) FLEET REPLAY — the shared-prefix Poisson workload through the
+    same fleet under least_loaded routing, ships off vs on, each a
+    TWO-POINT MARGINAL (quarter vs full decode budget, PERF.md
+    hygiene); greedy AND seeded-sampled streams are asserted
+    token-exact vs a single-engine oracle through the ships.
+
+    One JSON line -> BENCH_serving_prefix_fleet.json."""
+    import threading
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.serving import (InProcessReplica, ServingEngine,
+                                    ServingRouter)
+
+    # a LONG shared prefix (14 pages non-smoke): the probe compares
+    # re-prefilling it against shipping it, so it must dominate the
+    # tail
+    prefix_len = 96 if smoke else 224
+    ps = engine_kw["page_size"]
+    arrivals, prompts = make_shared_prefix_trace(
+        n_requests, rate, cfg.vocab_size, prefix_len)
+    new_q = max(1, max_new // 4)
+    seeds = [1000 + i for i in range(len(prompts))]
+    rng = np.random.default_rng(99)
+    shared = prompts[0][:prefix_len]
+
+    def fresh_probe_prompt():
+        return np.concatenate(
+            [shared, rng.integers(0, cfg.vocab_size, 12)
+             .astype(np.int32)])
+
+    # The probes compare RE-PREFILLING the shared prefix against
+    # SHIPPING its pages, so the probe model must have real prefill
+    # cost per page — the replay's 2-layer CPU config is so small that
+    # a chunk step costs about the same as a host page copy, which is
+    # not the serving regime this cache targets.  On TPU the main
+    # config is already prefill-heavy.
+    if on_tpu:
+        probe_cfg = cfg
+    else:
+        from paddle_tpu.models import LlamaConfig
+        probe_cfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=256,
+            intermediate_size=512, num_hidden_layers=4,
+            num_attention_heads=8,
+            max_position_embeddings=cfg.max_position_embeddings)
+
+    def make_router(policy, fleet, num_pages=None, model_cfg=None):
+        replicas = []
+        kw = dict(engine_kw, prefix_cache=True)
+        if num_pages is not None:
+            # the import's functional scatter copies the whole pool,
+            # so its cost scales with num_pages — the serial probes
+            # use a pool sized for their actual residency instead of
+            # the replay's burst pool (no admission pressure either
+            # way; the replay keeps the big pool)
+            kw["num_pages"] = num_pages
+        for _ in range(2):
+            P.seed(0)
+            m = LlamaForCausalLM(model_cfg or cfg)
+            if on_tpu:
+                m.to(dtype="bfloat16")
+            m.eval()
+            eng = ServingEngine(m, **kw)
+            replicas.append(InProcessReplica(
+                eng, max_queued=len(prompts) + 8))
+        return ServingRouter(replicas, policy=policy,
+                             page_size=ps, prefix_fleet=fleet)
+
+    def warm(router):
+        # compile every program class per replica off the clock with
+        # NON-shared prompts, then flush: the measurement starts
+        # prefix-cold
+        warm_rng = np.random.default_rng(1234)
+        warm_prompts = [warm_rng.integers(
+            0, cfg.vocab_size, int(p.size)).astype(np.int32)
+            for p in prompts[:8]]
+        for rep in router.replicas:
+            for budget in (new_q, max_new):
+                for p in warm_prompts:
+                    rep.engine.add_request(p, max_new_tokens=budget)
+                rep.engine.run()
+            rep.engine.cache.clear_prefix()
+        return router.start()
+
+    def flush_prefix(router):
+        for rep in router.replicas:
+            rep.engine.cache.clear_prefix()
+
+    # -- part 1: serial TTFT probes on an idle fleet -----------------------
+    def probe_once(router, target, fleet):
+        """One probed submission steered to ``target`` (round_robin
+        pointer reset — bench-only steering); returns client TTFT."""
+        router.prefix_fleet = fleet
+        router._rr = target
+        sub = time.perf_counter()
+        stream = router.submit(fresh_probe_prompt(), max_new_tokens=4)
+        ttft = None
+        for ev in stream.events(timeout=600):
+            if ev["type"] == "token" and ttft is None:
+                ttft = time.perf_counter() - sub
+        assert stream.replica_idx == target, (
+            "probe steering broke", stream.replica_idx, target)
+        return ttft
+
+    router = warm(make_router("round_robin", False, num_pages=128,
+                              model_cfg=probe_cfg))
+    donor, cold = router.replicas
+    # seed the donor (replica 0) with the shared prefix, off the
+    # clock — fleet=True so the placement teaches the transfer index
+    # (under a non-cache-aware policy only fleet placements record)
+    probe_once(router, 0, True)
+    reps_n = 4 if smoke else 12
+    probes = {"local": [], "cross": [], "recompute": []}
+    ships0 = router.metrics.prefix_ships_total.value
+    for _ in range(reps_n):
+        probes["local"].append(probe_once(router, 0, False))
+        cold.engine.cache.drop_prefix(shared)
+        probes["cross"].append(probe_once(router, 1, True))
+        cold.engine.cache.drop_prefix(shared)
+        probes["recompute"].append(probe_once(router, 1, False))
+        cold.engine.cache.drop_prefix(shared)
+    ships = router.metrics.prefix_ships_total.value - ships0
+    shipped = router.metrics.prefix_shipped_pages_total.value
+    assert ships == reps_n, (ships, reps_n)
+    router.close()
+
+    def med(xs):
+        return round(sorted(xs)[len(xs) // 2], 4)
+
+    probe_out = {
+        "reps": reps_n,
+        "local_ttft_p50_s": med(probes["local"]),
+        "cross_ttft_p50_s": med(probes["cross"]),
+        "recompute_ttft_p50_s": med(probes["recompute"]),
+        "prefix_ships": ships,
+        "prefix_shipped_pages": shipped,
+        "pages_per_ship": round(shipped / max(ships, 1), 1),
+    }
+
+    # -- part 2: fleet replay, two-point marginal, exactness ---------------
+    def oracle(do_sample):
+        P.seed(0)
+        m = LlamaForCausalLM(cfg)
+        if on_tpu:
+            m.to(dtype="bfloat16")
+        m.eval()
+        eng = ServingEngine(m, **dict(engine_kw, prefix_cache=True))
+        rids = []
+        for i, p in enumerate(prompts):
+            kw = ({"do_sample": True, "temperature": 0.8,
+                   "seed": seeds[i]} if do_sample else {})
+            rids.append(eng.add_request(p, max_new_tokens=max_new,
+                                        **kw))
+        res = eng.run()
+        return [res[r]["tokens"] for r in rids]
+
+    want_greedy = oracle(False)
+    want_sampled = oracle(True)
+
+    def replay_fleet(router, new_tokens, do_sample=False):
+        outs = [[] for _ in prompts]
+        errors = []
+        t0 = time.perf_counter()
+
+        def fire(i, due, prompt):
+            time.sleep(max(0.0, due - (time.perf_counter() - t0)))
+            kw = ({"do_sample": True, "temperature": 0.8,
+                   "seed": seeds[i]} if do_sample else {})
+            try:
+                stream = router.submit(prompt,
+                                       max_new_tokens=new_tokens, **kw)
+                for ev in stream.events(timeout=600):
+                    if ev["type"] == "token":
+                        outs[i].append(ev["token"])
+            except Exception as e:
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=fire, args=(i, a, p),
+                                    daemon=True)
+                   for i, (a, p) in enumerate(zip(arrivals, prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:4]
+        return wall, sum(len(o) for o in outs), outs
+
+    def measure(fleet):
+        router = warm(make_router("least_loaded", fleet))
+        wall_q, toks_q, _ = replay_fleet(router, new_q)
+        flush_prefix(router)
+        wall, toks, outs = replay_fleet(router, max_new)
+        assert outs == want_greedy, "greedy streams diverged from " \
+            "the single-engine oracle"
+        flush_prefix(router)
+        _, _, souts = replay_fleet(router, max_new, do_sample=True)
+        assert souts == want_sampled, "seeded-sampled streams " \
+            "diverged from the single-engine oracle"
+        m = router.metrics
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        out = {
+            "prefix_fleet": fleet,
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "prefix_ships": m.prefix_ships_total.value,
+            "prefix_shipped_pages": m.prefix_shipped_pages_total.value,
+            "prefix_ship_fallbacks":
+                m.prefix_ship_fallbacks_total.value,
+            "exact_greedy": True, "exact_sampled": True,
+        }
+        router.close()
+        return out
+
+    fleet_off = measure(False)
+    fleet_on = measure(True)
+
+    out = {
+        "metric": "serving_prefix_fleet_cross_ttft_p50_s"
+                  + ("" if on_tpu else "_cpu"),
+        "value": probe_out["cross_ttft_p50_s"],
+        "unit": "s (cross-replica prefix hit: cached pages shipped "
+                "over pagewire, tail-only prefill; compare "
+                "probes.recompute_ttft_p50_s and "
+                "probes.local_ttft_p50_s)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "shared_prefix_tokens": prefix_len,
+        "page_size": ps,
+        "probes": probe_out,
+        "fleet_replay": {"ships_off": fleet_off, "ships_on": fleet_on},
+        "cross_vs_recompute_ttft_speedup": round(
+            probe_out["recompute_ttft_p50_s"]
+            / probe_out["cross_ttft_p50_s"], 2),
+        "cross_vs_local_ttft_ratio": round(
+            probe_out["cross_ttft_p50_s"]
+            / probe_out["local_ttft_p50_s"], 2),
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_prefix_fleet.json", "w") as f:
         f.write(line + "\n")
 
 

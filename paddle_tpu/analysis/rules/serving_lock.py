@@ -26,12 +26,16 @@ _ENGINE_METHODS = {"step", "cancel"}
 
 # direct page-migration mutators (cache/engine level); replica- and
 # frontend-level wrappers of the same names are lock-taking and fine —
-# the receiver filter below tells them apart
+# the receiver filter below tells them apart.  Round 18 adds the fleet
+# prefix-transfer family: prefix export/import/drop touch the same
+# device buffers and radix tree, so they ride the same lock contract.
 _MIGRATION_FILES = _ALLOWED_FILES | {
     "paddle_tpu/serving/kv_cache.py",  # the allocator itself
 }
 _MIGRATION_METHODS = {"import_pages", "export_pages", "adopt_request",
-                      "export_request", "release_request"}
+                      "export_request", "release_request",
+                      "export_prefix_pages", "import_prefix_pages",
+                      "export_prefix", "import_prefix", "drop_prefix"}
 _ENGINE_RECEIVERS = ("engine", "eng", "_engine", "cache", "_cache",
                      "kv_cache", "_draft_cache")
 
@@ -107,4 +111,5 @@ class PageMigrationLock(Rule):
                 "front-end lock — page migration shares the engine "
                 "lock with the step loop (round-14 invariant); go "
                 "through ServingFrontend.probe_prefix/export_request/"
-                "release_request/adopt")
+                "release_request/adopt (or, for fleet prefix ships, "
+                "export_prefix/import_prefix/drop_prefix)")

@@ -65,12 +65,27 @@ Layers:
   ``paddle_tpu.profiler`` event format (``bench_serving.py
   --trace-out``).
 
+- Fleet-wide prefix cache (round 18): the router's affinity radix
+  tree doubles as a KV-page TRANSFER INDEX (``prefix_fleet=True`` /
+  ``PADDLE_TPU_SERVING_PREFIX_FLEET=1``) — on a prefix miss at the
+  routed replica but a hit anywhere in the fleet, the cached prefix
+  pages ship over the pagewire path (in-process array handoff or
+  ``/v1/_pages/prefix``) instead of being recomputed; the target
+  chunk-prefills only the uncovered suffix.  Donor liveness and
+  eviction races resolve through the PrefixDrift/GeometryMismatch
+  bounce into a recompute fallback (never a failed request), the
+  router consults the ``/healthz``-advertised ``cache_dtype`` so
+  dtype-skewed fleets skip doomed ships up front, and
+  ``prefix_max_owners`` dedups hot prefixes across replicas
+  (router-driven ``drop_prefix`` eviction pressure).
+
 - :mod:`chaos` — the robustness layer (round 17): ONE seeded
   deterministic fault schedule (``ChaosConfig`` — the legacy FAULT_*
-  knobs alias in) over 12 registered fault points (engine step
+  knobs alias in) over 15 registered fault points (engine step
   fault/latency, allocator-pressure spikes, migration export/import/
   transfer failures, HTTP connect/EOF/slow-read, replica crash during
-  drain/readmit/shrink), the injected sleeper every serving sleep
+  drain/readmit/shrink, prefix-ship donor-gone/eviction-race/
+  torn-payload), the injected sleeper every serving sleep
   routes through (graftlint ``serving-raw-sleep``), bounded
   exponential-backoff retries (migration + idempotent HTTP hops),
   per-replica circuit breakers (``/healthz``-advertised, /metrics

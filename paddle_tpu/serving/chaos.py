@@ -80,7 +80,15 @@ FAULT_POINTS = (
     "crash_drain",           # router: replica crash during drain
     "crash_readmit",         # router: replica crash during readmit
     "crash_shrink",          # router: replica crash during autoscaler
-)                            #         shrink (retire_replica)
+    #                                  shrink (retire_replica)
+    # fleet prefix transfer (round 18): faults on the router-driven
+    # prefix ship path — every one must degrade to recompute, never to
+    # a failed request
+    "prefix_export_gone",    # router: donor lost the pages mid-export
+    "prefix_import_drift",   # router: recipient tree changed (eviction
+    #                                  race) -> PrefixDrift bounce
+    "prefix_wire_truncate",  # HTTPReplica: torn prefix payload
+)
 
 # legacy aliases (round 9/11 knobs) folded into the unified config
 _ENV_LATENCY = "PADDLE_TPU_SERVING_FAULT_LATENCY_S"

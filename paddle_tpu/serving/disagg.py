@@ -169,7 +169,16 @@ class DisaggRouter(ServingRouter):
             return super()._place(stream, exclude)
         stream.phase = "prefill"
         sheds = []
+        ship_tried = False
         for idx in self._by_load(prefills):
+            if not ship_tried:
+                # fleet prefix cache (round 18): prefill replicas are
+                # prefix-cache servers — a prefill placed on a cold
+                # replica pulls the cached prefix from wherever the
+                # fleet (prefill, decode or mixed) holds it and
+                # chunk-prefills only the uncovered suffix
+                ship_tried = True
+                self._maybe_ship_prefix(stream, idx)
             try:
                 inner = self.replicas[idx].submit(
                     stream.prompt, prefill_only=True, **stream.kwargs)
@@ -195,7 +204,7 @@ class DisaggRouter(ServingRouter):
                 self.trace.span(stream.req_id, "routed",
                                 time.perf_counter(), replica=idx,
                                 policy="disagg_prefill")
-            if self.policy == "cache_aware":
+            if self.policy == "cache_aware" or self.prefix_fleet:
                 self._record(stream.prompt, idx)
             return stream
         # every prefill replica shed or died: serve the request
@@ -353,6 +362,10 @@ class DisaggRouter(ServingRouter):
             self.metrics.migrated_pages_total.inc(n_pages)
             self.metrics.routed_total.inc(policy="disagg_decode",
                                           replica=dst_idx)
+            if self.prefix_fleet:
+                # the adopted prompt pages committed into the decode
+                # replica's tree: it is a prefix donor now
+                self._record(stream.prompt, dst_idx)
             if self.trace.enabled:
                 self.trace.span(
                     stream.req_id, "migration", mig_t0,

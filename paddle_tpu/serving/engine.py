@@ -1318,6 +1318,46 @@ class ServingEngine:
                                      pages=int(meta["n_pages"]))
         return req.req_id
 
+    # -- fleet prefix transfer (round 18) ----------------------------------
+    def export_prefix(self, prompt, skip_pages=0):
+        """Serve this engine's cached prefix of ``prompt`` for a fleet
+        prefix ship (the router moves it to the replica it is about to
+        place a matching request on).  Read-only on refcounts; raises
+        PrefixDrift when the local chain is shorter than the skip the
+        router probed."""
+        t0 = self._now()
+        meta, k, v = self.cache.export_prefix_pages(prompt, skip_pages)
+        self.metrics.prefix_pages_exported.inc(int(meta["n_pages"]))
+        if self.trace.enabled:
+            self.trace.flight.record(
+                "prefix_export", pages=int(meta["n_pages"]),
+                skip_pages=int(skip_pages),
+                wall_s=round(self._now() - t0, 6))
+        return meta, k, v
+
+    def import_prefix(self, meta, k_arrays, v_arrays):
+        """Land a shipped prefix payload in this engine's radix tree
+        (pages enter CACHED at rc==0 — reclaimable capacity, exactly
+        like a locally-prefilled prefix).  Returns the page count."""
+        t0 = self._now()
+        n = self.cache.import_prefix_pages(meta, k_arrays, v_arrays)
+        self.metrics.prefix_pages_imported.inc(n)
+        if self.trace.enabled:
+            self.trace.flight.record(
+                "prefix_import", pages=n,
+                skip_pages=int(meta["skip_pages"]),
+                wall_s=round(self._now() - t0, 6))
+        return n
+
+    def drop_prefix(self, prompt):
+        """Router-driven dedup: evict this engine's unpinned cached
+        chain for ``prompt`` (deepest-first).  Returns pages freed."""
+        n = self.cache.drop_prefix(prompt)
+        self.metrics.prefix_drops.inc(n)
+        if self.trace.enabled and n:
+            self.trace.flight.record("prefix_drop", pages=n)
+        return n
+
     def _fork(self, parent, i):
         child = Request(prompt=parent.prompt,
                         max_new_tokens=parent.max_new_tokens,

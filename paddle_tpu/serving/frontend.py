@@ -281,6 +281,12 @@ class ServingFrontend:
                     "cache_dtype": getattr(eng, "cache_dtype",
                                            str(eng.cache.dtype)),
                     "weight_quant": getattr(eng, "weight_quant", None),
+                    # fleet prefix cache (round 18): how much reusable
+                    # prefix this replica holds — the router's transfer
+                    # index consults these before scheduling a ship
+                    "cached_pages": eng.cache.cached_pages,
+                    "reclaimable_pages": eng.cache.reclaimable_pages,
+                    "prefix_tree_depth": eng.cache.prefix_tree_depth,
                     "requests_finished":
                         eng.metrics.requests_finished.value}
 
@@ -376,6 +382,45 @@ class ServingFrontend:
             stream = RequestStream(rid, 1)
             self._streams[rid] = stream
         return stream
+
+    # -- fleet prefix transfer (round 18) ----------------------------------
+    # Same locking contract as migration: prefix export/import touch
+    # the cache's device buffers and radix tree, so they hold the
+    # engine lock (graftlint `page-migration-lock` polices the cache/
+    # engine-level calls; these wrappers are the blessed call shape).
+    def export_prefix(self, prompt, skip_pages=0):
+        """Export this replica's cached prefix of ``prompt`` (minus
+        ``skip_pages`` leading pages the recipient already holds)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self.lock:
+            return self.engine.export_prefix(prompt, skip_pages)
+
+    def import_prefix(self, meta, k_arrays, v_arrays):
+        """Land a shipped prefix payload here.  Sheds with Rejected
+        when hosting the pages would dip into outstanding reservations
+        + watermark — a prefix ship is an optimization and must never
+        evict capacity live traffic has been promised."""
+        with self.lock:
+            if self._state != "ok":
+                raise Unavailable(f"front-end is {self._state}")
+            eng = self.engine
+            need = int(meta.get("n_pages", 0))
+            promised = self._reserved_pages()
+            if need + promised + eng.scheduler.watermark_pages \
+                    > eng.cache.available_pages:
+                raise Rejected(
+                    f"over capacity: prefix ship needs {need} page(s), "
+                    f"{eng.cache.available_pages} available - "
+                    f"{promised} reserved - "
+                    f"{eng.scheduler.watermark_pages} watermark")
+            return eng.import_prefix(meta, k_arrays, v_arrays)
+
+    def drop_prefix(self, prompt):
+        """Evict the unpinned cached chain for ``prompt`` (router
+        dedup).  Returns the number of pages freed."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self.lock:
+            return self.engine.drop_prefix(prompt)
 
     # -- internals ---------------------------------------------------------
     def _check_capacity(self, prompt, max_new, n, prefill_only=False):

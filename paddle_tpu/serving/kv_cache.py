@@ -195,6 +195,13 @@ class PagedKVCache:
         self.prefix_hit_pages = 0
         self.prefix_miss_pages = 0
         self.prefix_evictions = 0
+        # page-transfer fast path (round 18): ONE compiled gather (and
+        # ONE compiled scatter) across every pool per export/import,
+        # instead of 2*n_layers(+scales) separate dispatches; indexes
+        # are padded to powers of two onto the scratch page so the jit
+        # trace cache stays bounded at log2(num_pages) entries
+        self._gather_fn = None
+        self._scatter_fn = None
 
     # -- sizing helpers ---------------------------------------------------
     @staticmethod
@@ -235,6 +242,20 @@ class PagedKVCache:
         """Cached pages no live sequence maps (rc==0) — evictable
         leaf-first, so all of them can be turned into free pages."""
         return sum(1 for p in self._cached if self._rc[p] == 0)
+
+    @property
+    def prefix_tree_depth(self):
+        """Deepest chain in the radix tree, in pages — /healthz
+        advertises it next to ``cached_pages`` so a router can see how
+        much reusable prefix a replica actually holds."""
+        best = 0
+        stack = [(self._prefix_root, 0)]
+        while stack:
+            node, d = stack.pop()
+            if d > best:
+                best = d
+            stack.extend((c, d + 1) for c in node.children.values())
+        return best
 
     @property
     def available_pages(self):
@@ -597,13 +618,7 @@ class PagedKVCache:
                                    np.float32)
                           for _ in range(self.n_layers)]
             return meta, empty, [a.copy() for a in empty]
-        import jax.numpy as jnp
-        idx = jnp.asarray(pages, jnp.int32)
-        k = [np.asarray(kp[idx]) for kp in self.k_pages]
-        v = [np.asarray(vp[idx]) for vp in self.v_pages]
-        if self.quantized:
-            k += [np.asarray(ks[idx]) for ks in self.k_scales]
-            v += [np.asarray(vs[idx]) for vs in self.v_scales]
+        k, v = self._fetch_pages(pages)
         return meta, k, v
 
     def import_pages(self, seq_id, meta, k_arrays, v_arrays,
@@ -634,26 +649,7 @@ class PagedKVCache:
                 f"import_pages: seq_len={seq_len} spans "
                 f"{self.pages_for(seq_len)} page(s), payload covers "
                 f"{skip}+{n_pages}")
-        shape = (n_pages, self.page_size, self.n_kv_heads, self.head_dim)
-        sshape = (n_pages, self.page_size, self.n_kv_heads)
-        per_list = self.n_layers * (2 if self.quantized else 1)
-        for arrs, what in ((k_arrays, "k"), (v_arrays, "v")):
-            if len(arrs) != per_list:
-                raise GeometryMismatch(
-                    f"{what} payload has {len(arrs)} array(s), this "
-                    f"cache expects {per_list} ({self.n_layers} "
-                    "layer(s)" + (" of codes + scales)" if self.quantized
-                                  else ")"))
-            for a in arrs[:self.n_layers]:
-                if tuple(a.shape) != shape:
-                    raise GeometryMismatch(
-                        f"{what} page array shape {tuple(a.shape)} != "
-                        f"{shape}")
-            for a in arrs[self.n_layers:]:
-                if tuple(a.shape) != sshape:
-                    raise GeometryMismatch(
-                        f"{what} scale array shape {tuple(a.shape)} != "
-                        f"{sshape}")
+        self._check_payload_shapes(n_pages, k_arrays, v_arrays)
         # pin the locally-resident prefix; must match what the exporter
         # skipped or the page/token alignment breaks (PrefixDrift)
         if self.prefix_cache_enabled and prompt is not None:
@@ -682,24 +678,7 @@ class PagedKVCache:
             self._rc[p] = 1
         table.extend(fresh)
         self._lens[seq_id] = seq_len
-        if n_pages:
-            import jax.numpy as jnp
-            dsts = jnp.asarray(fresh, jnp.int32)
-            self.k_pages = [
-                kp.at[dsts].set(jnp.asarray(a, kp.dtype))
-                for kp, a in zip(self.k_pages, k_arrays)]
-            self.v_pages = [
-                vp.at[dsts].set(jnp.asarray(a, vp.dtype))
-                for vp, a in zip(self.v_pages, v_arrays)]
-            if self.quantized:
-                self.k_scales = [
-                    ks.at[dsts].set(jnp.asarray(a, ks.dtype))
-                    for ks, a in zip(self.k_scales,
-                                     k_arrays[self.n_layers:])]
-                self.v_scales = [
-                    vs.at[dsts].set(jnp.asarray(a, vs.dtype))
-                    for vs, a in zip(self.v_scales,
-                                     v_arrays[self.n_layers:])]
+        self._scatter_pages(fresh, k_arrays, v_arrays)
         if self.prefix_cache_enabled and prompt is not None:
             # the imported prompt pages are canonical K/V: later
             # shared-prefix requests on THIS replica hit them.  Bounded
@@ -709,6 +688,251 @@ class PagedKVCache:
             self.commit_prefix(seq_id, prompt, min(len(prompt),
                                                    seq_len))
         return len(table)
+
+    def _check_payload_shapes(self, n_pages, k_arrays, v_arrays):
+        """Validate an incoming page payload's array count and shapes
+        against this cache's geometry (codes + scales for int8)."""
+        shape = (n_pages, self.page_size, self.n_kv_heads, self.head_dim)
+        sshape = (n_pages, self.page_size, self.n_kv_heads)
+        per_list = self.n_layers * (2 if self.quantized else 1)
+        for arrs, what in ((k_arrays, "k"), (v_arrays, "v")):
+            if len(arrs) != per_list:
+                raise GeometryMismatch(
+                    f"{what} payload has {len(arrs)} array(s), this "
+                    f"cache expects {per_list} ({self.n_layers} "
+                    "layer(s)" + (" of codes + scales)" if self.quantized
+                                  else ")"))
+            for a in arrs[:self.n_layers]:
+                if tuple(a.shape) != shape:
+                    raise GeometryMismatch(
+                        f"{what} page array shape {tuple(a.shape)} != "
+                        f"{shape}")
+            for a in arrs[self.n_layers:]:
+                if tuple(a.shape) != sshape:
+                    raise GeometryMismatch(
+                        f"{what} scale array shape {tuple(a.shape)} != "
+                        f"{sshape}")
+
+    def _all_pools(self):
+        """Every device pool in canonical order (k, v[, k_scales,
+        v_scales]) — the operand list of the fused transfer programs."""
+        pools = list(self.k_pages) + list(self.v_pages)
+        if self.quantized:
+            pools += list(self.k_scales) + list(self.v_scales)
+        return pools
+
+    def _store_pools(self, pools):
+        ln = self.n_layers
+        self.k_pages = list(pools[:ln])
+        self.v_pages = list(pools[ln:2 * ln])
+        if self.quantized:
+            self.k_scales = list(pools[2 * ln:3 * ln])
+            self.v_scales = list(pools[3 * ln:])
+
+    @staticmethod
+    def _pad_pow2(pages):
+        """Pow2-padded int32 index row; padding points at the scratch
+        page (garbage by contract), bounding the transfer programs'
+        trace cache."""
+        pad = 1
+        while pad < len(pages):
+            pad <<= 1
+        idx = np.full(pad, SCRATCH_PAGE, np.int32)
+        idx[:len(pages)] = pages
+        return idx
+
+    def _fetch_pages(self, pages):
+        """Fetch a page chain from every pool — ONE compiled gather +
+        ONE host transfer (the per-layer dispatch overhead otherwise
+        dominates a prefix ship).  Returns ``(k_arrays, v_arrays)`` in
+        the export list shape (codes then scales)."""
+        import jax
+        import jax.numpy as jnp
+        n = len(pages)
+        idx = self._pad_pow2(pages)
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                lambda pools, i: [p[i] for p in pools])
+        out = jax.device_get(
+            self._gather_fn(self._all_pools(), jnp.asarray(idx)))
+        out = [a[:n] for a in out]
+        ln = self.n_layers
+        k = out[:ln]
+        v = out[ln:2 * ln]
+        if self.quantized:
+            k += out[2 * ln:3 * ln]
+            v += out[3 * ln:]
+        return k, v
+
+    def _scatter_pages(self, dsts, k_arrays, v_arrays):
+        """Write an imported payload's K/V (and scales) into freshly
+        allocated device pages — ONE compiled scatter across every
+        pool."""
+        if not dsts:
+            return
+        import jax
+        import jax.numpy as jnp
+        n = len(dsts)
+        idx = self._pad_pow2(dsts)
+        ln = self.n_layers
+        vals = list(k_arrays[:ln]) + list(v_arrays[:ln])
+        if self.quantized:
+            vals += list(k_arrays[ln:]) + list(v_arrays[ln:])
+        if len(idx) != n:
+            vals = [np.concatenate(
+                [np.asarray(a),
+                 np.zeros((len(idx) - n,) + tuple(a.shape[1:]),
+                          np.asarray(a).dtype)]) for a in vals]
+        if self._scatter_fn is None:
+            self._scatter_fn = jax.jit(
+                lambda pools, i, vs: [
+                    p.at[i].set(v.astype(p.dtype))
+                    for p, v in zip(pools, vs)])
+        self._store_pools(self._scatter_fn(
+            self._all_pools(), jnp.asarray(idx),
+            [jnp.asarray(a) for a in vals]))
+
+    # -- fleet prefix transfer (router-driven prefix ships, round 18) ------
+    def export_prefix_pages(self, prompt, skip_pages=0):
+        """Export the CACHED prefix of ``prompt`` — no live sequence
+        involved: the radix tree itself is the source (the fleet prefix
+        ship: a donor replica serves its cached pages to a replica the
+        router is about to place a matching request on).  ``skip_pages``
+        leading pages are omitted (the recipient already holds them).
+
+        Read-only on refcounts; the exported chain's LRU clocks are
+        refreshed (a donated prefix is demonstrably hot).  Raises
+        :class:`PrefixDrift` when the local match is SHORTER than
+        ``skip_pages`` (the tree shrank since the router probed —
+        ``cached_pages`` carries the true count).  Returns
+        ``(meta, k_arrays, v_arrays)`` with ``meta["kind"] ==
+        "prefix"`` and ``meta["prompt"]`` holding the FULL matched
+        token prefix (skipped pages included, so the importer can walk
+        its own tree from the root)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        chain = self._walk(prompt, len(prompt) // self.page_size)
+        matched = len(chain)
+        skip_pages = int(skip_pages)
+        if skip_pages > matched:
+            raise PrefixDrift(skip_pages, matched)
+        self._clock += 1
+        for node in chain:
+            node.last_used = self._clock
+        pages = [n.page for n in chain[skip_pages:]]
+        meta = dict(self.geometry(), kind="prefix",
+                    skip_pages=skip_pages, n_pages=len(pages),
+                    cached_pages=matched,
+                    prompt=[int(t) for t in
+                            prompt[:matched * self.page_size]])
+        if not pages:
+            empty = [np.empty((0, self.page_size, self.n_kv_heads,
+                               self.head_dim), self.dtype)
+                     for _ in range(self.n_layers)]
+            if self.quantized:
+                empty += [np.empty((0, self.page_size, self.n_kv_heads),
+                                   np.float32)
+                          for _ in range(self.n_layers)]
+            return meta, empty, [a.copy() for a in empty]
+        k, v = self._fetch_pages(pages)
+        return meta, k, v
+
+    def import_prefix_pages(self, meta, k_arrays, v_arrays):
+        """Splice a shipped prefix payload into THIS allocator's radix
+        tree: the imported pages enter as CACHED (rc==0, reclaimable)
+        full prompt pages — exactly the state a locally-prefilled-and-
+        freed prefix leaves behind, so every existing accounting rule
+        (LRU eviction, uncached-only admission, conservation) applies
+        unchanged.
+
+        The local tree must match exactly ``meta["skip_pages"]`` pages
+        of the payload's token prefix — :class:`PrefixDrift` otherwise
+        (pages committed or evicted since the router probed; the
+        carried ``cached_pages`` lets the driver re-export the right
+        suffix).  :class:`GeometryMismatch` on any shape/dtype skew,
+        :class:`OutOfPages` when the suffix cannot be hosted.  All
+        failures roll back fully.  Returns the number of pages
+        imported."""
+        if not self.prefix_cache_enabled:
+            raise GeometryMismatch(
+                "prefix ship into a cache with prefix_cache disabled: "
+                "imported pages could never be registered or reused")
+        self.check_geometry(meta)
+        prompt = np.asarray(meta["prompt"], np.int32).reshape(-1)
+        skip = int(meta["skip_pages"])
+        n_pages = int(meta["n_pages"])
+        if prompt.size != (skip + n_pages) * self.page_size:
+            raise ValueError(
+                f"import_prefix_pages: prompt of {prompt.size} token(s)"
+                f" does not span exactly {skip}+{n_pages} full page(s)")
+        self._check_payload_shapes(n_pages, k_arrays, v_arrays)
+        # pin the locally-resident lead (a temp sequence protects both
+        # the matched chain and the fresh pages from the evict loop)
+        sid = ("__prefix_import__", self._clock)
+        matched = self.acquire_prefix(sid, prompt, prompt.size + 1)
+        if matched != skip:
+            self.free_seq(sid)
+            raise PrefixDrift(skip, matched)
+        try:
+            if n_pages > self.available_pages:
+                raise OutOfPages(n_pages, self.available_pages)
+            while n_pages > len(self._free):
+                if not self._evict_lru_leaf():  # pragma: no cover
+                    raise OutOfPages(n_pages, self.available_pages)
+        except OutOfPages:
+            self.free_seq(sid)
+            raise
+        table = self._tables[sid]
+        fresh = [self._free.popleft() for _ in range(n_pages)]
+        for p in fresh:
+            self._rc[p] = 1
+        table.extend(fresh)
+        self._lens[sid] = prompt.size
+        self._scatter_pages(fresh, k_arrays, v_arrays)
+        self.commit_prefix(sid, prompt, prompt.size)
+        # drop the pin: committed pages stay resident (CACHED, rc==0)
+        self.free_seq(sid)
+        return n_pages
+
+    def drop_prefix(self, prompt):
+        """Evict ``prompt``'s cached chain AND its whole unpinned
+        subtree — the router's dedup lever for hot prefixes resident on
+        more replicas than the fleet needs.  A hot system prompt's
+        chain always has tail extensions committed under it, so the
+        subtree must go leaf-first or nothing is ever droppable; a
+        pinned page (rc>0: a live sequence maps it) survives and keeps
+        its ancestors matchable.  Returns the number of pages reclaimed
+        to the free list."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        chain = self._walk(prompt, len(prompt) // self.page_size)
+        if not chain:
+            return 0
+        dropped = 0
+
+        def evict(node):
+            del node.parent.children[node.key]
+            del self._cached[node.page]
+            self._free.append(node.page)
+            self.prefix_evictions += 1
+
+        def prune(node):
+            nonlocal dropped
+            for child in list(node.children.values()):
+                prune(child)
+            if node.children or self._rc[node.page] != 0:
+                return
+            evict(node)
+            dropped += 1
+
+        prune(chain[-1])
+        # ancestors can only go once the deep end is gone (matching
+        # always walks from the root, so an interior hole would leak
+        # unreachable-but-resident pages)
+        for node in reversed(chain[:-1]):
+            if node.children or self._rc[node.page] != 0:
+                break
+            evict(node)
+            dropped += 1
+        return dropped
 
     def _evict_lru_leaf(self):
         """Reclaim the least-recently-used cached LEAF page no sequence

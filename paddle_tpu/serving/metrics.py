@@ -186,6 +186,10 @@ class ServingMetrics:
         self.pages_exported = Counter()       # KV pages shipped out
         self.pages_imported = Counter()       # KV pages spliced in
         self.adoptions = Counter()            # migrated-in requests
+        # fleet prefix cache (round 18): router-driven prefix ships
+        self.prefix_pages_exported = Counter()  # cached pages donated
+        self.prefix_pages_imported = Counter()  # cached pages received
+        self.prefix_drops = Counter()         # dedup drop_prefix pages
         # decode hot path (round 10)
         self.fetch_bytes = Counter()          # host<-device bytes/steps
         self.prefix_hit_pages = Counter()     # prompt pages served from

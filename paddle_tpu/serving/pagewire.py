@@ -7,6 +7,13 @@ fixed magic, a length-prefixed JSON header (cache geometry + sequence
 meta + the generation-continuation request), then the raw page bytes
 of every layer's K then V arrays, concatenated in header order.
 
+The fleet prefix cache (round 18) rides the SAME format: a prefix-ship
+payload carries ``meta["kind"] == "prefix"`` (radix-tree pages with no
+live sequence behind them) and no continuation request — the
+``/v1/_pages/prefix`` endpoints answer with JSON rather than an SSE
+stream.  Everything below is payload-kind agnostic by design; the
+allocator's importers re-validate geometry either way.
+
 Deserialization is strict: magic, header shape, declared dtype/shape
 versus the actual byte count are all checked here, and the allocator
 re-checks geometry against itself at import

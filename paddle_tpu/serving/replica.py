@@ -140,6 +140,22 @@ class InProcessReplica:
         return self.frontend.adopt(meta, k_arrays, v_arrays,
                                    max_new_tokens=max_new_tokens, **kw)
 
+    # -- fleet prefix transfer (round 18) ----------------------------------
+    def cache_dtype(self):
+        """The engine's resolved KV dtype — the router's dtype-skew
+        guard reads it BEFORE scheduling a prefix ship (a mismatched
+        payload would only bounce on GeometryMismatch later)."""
+        return self.engine.cache_dtype
+
+    def export_prefix(self, prompt, skip_pages=0):
+        return self.frontend.export_prefix(prompt, skip_pages)
+
+    def import_prefix(self, meta, k_arrays, v_arrays):
+        return self.frontend.import_prefix(meta, k_arrays, v_arrays)
+
+    def drop_prefix(self, prompt):
+        return self.frontend.drop_prefix(prompt)
+
 
 class _HTTPStream:
     """SSE consumer over one in-flight ``/v1/completions`` request —
@@ -265,6 +281,7 @@ class HTTPReplica:
         self.timeout_s = float(timeout_s)
         self.name = name or f"{host}:{port}"
         self._role = role  # None -> lazily read from /healthz
+        self._cache_dtype = None  # lazily read from /healthz
         # chaos layer (round 17): network fault injection (connect
         # refused / mid-stream EOF / slow reads) + the retry knobs for
         # the idempotent hops below
@@ -293,6 +310,15 @@ class HTTPReplica:
         if self._role is None:
             self._role = self.health().get("role", "mixed")
         return self._role
+
+    def cache_dtype(self):
+        """The remote engine's advertised KV dtype (cached — fixed for
+        the engine's lifetime); None when the advertisement is
+        unreachable, in which case the router falls back to the
+        GeometryMismatch bounce."""
+        if self._cache_dtype is None:
+            self._cache_dtype = self.health().get("cache_dtype")
+        return self._cache_dtype
 
     def start(self):
         return self  # remote lifecycle is the remote operator's
@@ -484,6 +510,92 @@ class HTTPReplica:
             raise ValueError(msg)
         raise ReplicaFailed(
             f"replica {self.name}: adopt HTTP {resp.status}: {msg}")
+
+    # -- fleet prefix transfer (round 18, /v1/_pages/prefix) ---------------
+    def export_prefix(self, prompt, skip_pages=0):
+        """Fetch the remote's cached prefix payload.  The
+        ``prefix_wire_truncate`` chaos point clips the received bytes
+        (a torn transfer), which deserialization rejects — the router's
+        recompute fallback covers it."""
+        from .kv_cache import PrefixDrift
+        from .pagewire import deserialize_pages
+        status, data = self._post_json(
+            "/v1/_pages/prefix/export",
+            {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+             "skip_pages": int(skip_pages)})
+        if status == 409:
+            try:
+                err = json.loads(data)["error"]
+            except (ValueError, KeyError):
+                err = {}
+            raise PrefixDrift(int(skip_pages),
+                              int(err.get("cached_pages", 0)))
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: prefix export HTTP {status}: "
+                f"{data[:200]!r}")
+        if self.chaos.fire("prefix_wire_truncate", replica=self.name):
+            data = data[:max(0, len(data) // 2)]
+        meta, k, v, _ = deserialize_pages(data)
+        return meta, k, v
+
+    def import_prefix(self, meta, k_arrays, v_arrays):
+        """POST a prefix payload to the remote tree; returns the
+        imported page count.  409 maps back to PrefixDrift (with the
+        remote's true cached count) or GeometryMismatch, 429 to
+        Rejected — the same bounce contract as adoption."""
+        from .kv_cache import GeometryMismatch, PrefixDrift
+        from .pagewire import serialize_pages
+        payload = serialize_pages(meta, k_arrays, v_arrays)
+
+        def once():
+            self._chaos_connect()
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+            try:
+                conn.request("POST", "/v1/_pages/prefix", payload,
+                             {"Content-Type":
+                              "application/x-paddle-tpu-kv-pages"})
+                self._chaos_slow_read()
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        status, data = self._retrying(once, "POST /v1/_pages/prefix")
+        if status == 200:
+            return int(json.loads(data).get("imported_pages", 0))
+        try:
+            err = json.loads(data)["error"]
+        except (ValueError, KeyError):
+            err = {"message": data.decode(errors="replace")}
+        msg = err.get("message", "")
+        if status == 409:
+            if "cached_pages" in err:
+                raise PrefixDrift(int(meta.get("skip_pages", 0)),
+                                  int(err["cached_pages"]))
+            raise GeometryMismatch(f"replica {self.name}: {msg}")
+        if status == 429:
+            exc = Rejected(f"replica {self.name}: {msg}")
+            exc.retry_after = 1.0
+            raise exc
+        if status == 503:
+            raise Unavailable(f"replica {self.name}: {msg}")
+        if status == 400:
+            raise ValueError(msg)
+        raise ReplicaFailed(
+            f"replica {self.name}: prefix import HTTP {status}: {msg}")
+
+    def drop_prefix(self, prompt):
+        status, data = self._post_json(
+            "/v1/_pages/prefix/drop",
+            {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)]})
+        if status != 200:
+            raise ReplicaFailed(
+                f"replica {self.name}: prefix drop HTTP {status}")
+        return int(json.loads(data).get("dropped_pages", 0))
 
     # -- observability -----------------------------------------------------
     def _get(self, path):

@@ -68,6 +68,24 @@ the structured log.  Router-side chaos fault points (``crash_drain``/
 ``crash_readmit``/``crash_shrink``, plus the migration points in the
 disagg subclass) ride the ``chaos=`` config.
 
+**Fleet-wide prefix cache (round 18)** — with ``prefix_fleet=True`` /
+``PADDLE_TPU_SERVING_PREFIX_FLEET=1`` the affinity tree is promoted
+from a steering hint to a KV-page TRANSFER INDEX: before a request
+lands on the policy's chosen replica, the router checks whether any
+OTHER replica owns the prompt's cached prefix and, on a worthwhile
+delta (``PADDLE_TPU_SERVING_PREFIX_SHIP_MIN_PAGES``), ships the pages
+over the pagewire path (``export_prefix``/``import_prefix`` — the same
+suffix-only machinery disagg migration uses) so the target
+chunk-prefills only the uncovered suffix.  The ship is strictly
+best-effort: donor death, eviction races (``PrefixDrift`` bounce with
+bounded re-export retries), dtype skew (guarded UP FRONT via the
+``/healthz``-advertised ``cache_dtype``), torn payloads and capacity
+sheds all fall back to the recompute the engine would have done
+anyway.  ``PADDLE_TPU_SERVING_PREFIX_MAX_OWNERS`` adds router-driven
+eviction pressure: surplus owners of a hot prefix are asked to
+``drop_prefix`` their unpinned copy, so the fleet keeps ship-reachable
+coverage without every replica pinning its own pages.
+
 Env knobs: ``PADDLE_TPU_SERVING_ROUTER_POLICY``,
 ``PADDLE_TPU_SERVING_ROUTER_LOAD_CAP`` (pages),
 ``PADDLE_TPU_SERVING_PROBE_S`` (seconds; 0/unset disables the prober),
@@ -76,7 +94,9 @@ injection: kill replica *i* once it has delivered that many tokens
 through the router — the failover drill used by bench/tests; aliases
 into ``ChaosConfig``), ``PADDLE_TPU_SERVING_BREAKER_N`` /
 ``_BREAKER_COOLDOWN_S``, ``PADDLE_TPU_SERVING_RETRY_*`` (backoff),
-``PADDLE_TPU_SERVING_CHAOS`` (the unified fault schedule).
+``PADDLE_TPU_SERVING_CHAOS`` (the unified fault schedule),
+``PADDLE_TPU_SERVING_PREFIX_FLEET`` / ``_PREFIX_SHIP_MIN_PAGES`` /
+``_PREFIX_MAX_OWNERS`` (the fleet prefix cache above).
 """
 from __future__ import annotations
 
@@ -135,6 +155,12 @@ class RouterMetrics:
         self.migrations_total = Counter()        # prefill->decode splices
         self.migrated_pages_total = Counter()    # KV pages transferred
         self.migration_fallbacks_total = Counter()  # re-prefilled instead
+        # fleet prefix cache (round 18): router-driven prefix ships
+        self.prefix_ships_total = Counter()      # completed ships
+        self.prefix_shipped_pages_total = Counter()
+        self.prefix_ship_fallbacks_total = Counter()  # recompute instead
+        self.prefix_ship_skipped_total = LabeledCounter("reason")
+        self.prefix_dedup_drops_total = Counter()  # pages dropped by dedup
         self.autoscale_events = LabeledCounter("direction", "role")
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
@@ -230,7 +256,8 @@ class ServingRouter:
                  cache_load_cap=None, max_tree_pages=8,
                  max_tree_nodes=4096, seed=None,
                  probe_interval_s=None, chaos=None,
-                 breaker_clock=None):
+                 breaker_clock=None, prefix_fleet=None,
+                 prefix_ship_min_pages=None, prefix_max_owners=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         policy = policy or os.environ.get(
@@ -250,6 +277,26 @@ class ServingRouter:
             (cache_load_cap if cache_load_cap is not None else 32))
         self.max_tree_pages = int(max_tree_pages)
         self.max_tree_nodes = int(max_tree_nodes)
+        # fleet-wide prefix cache (round 18): on a prefix miss at the
+        # routed replica but a hit elsewhere in the fleet, ship the
+        # cached pages over the pagewire path instead of recomputing
+        # the prefill; the affinity tree doubles as the transfer index
+        if prefix_fleet is None:
+            prefix_fleet = os.environ.get(
+                "PADDLE_TPU_SERVING_PREFIX_FLEET") == "1"
+        self.prefix_fleet = bool(prefix_fleet)
+        if prefix_ship_min_pages is None:
+            prefix_ship_min_pages = int(os.environ.get(
+                "PADDLE_TPU_SERVING_PREFIX_SHIP_MIN_PAGES", "1") or 1)
+        self.prefix_ship_min_pages = max(1, int(prefix_ship_min_pages))
+        if prefix_max_owners is None:
+            prefix_max_owners = int(os.environ.get(
+                "PADDLE_TPU_SERVING_PREFIX_MAX_OWNERS", "0") or 0)
+        self.prefix_max_owners = int(prefix_max_owners)
+        # PrefixDrift re-export attempts per ship (shares the migration
+        # retry knob: both are the same bounce-and-re-export contract)
+        self.prefix_ship_retries = max(1, int(os.environ.get(
+            "PADDLE_TPU_SERVING_MIGRATE_RETRIES", "2") or 2))
         self.metrics = RouterMetrics()
         # router-side spans (routed/failover_splice/migration) keyed by
         # the router stream id; X-Request-Id is the cross-replica
@@ -264,6 +311,11 @@ class ServingRouter:
         self._down: set[int] = set()
         self._draining: set[int] = set()
         self._retired: set[int] = set()   # autoscaler scale-downs
+        # in-flight prefix ships keyed by (target, prefix bytes): a
+        # shared-prefix burst must not dogpile N identical transfers
+        # onto one cold replica (the engine-side thundering-herd
+        # refresh already makes the losers hit after the winner lands)
+        self._ships_inflight: set[tuple] = set()
         self._streams: dict[int, RouterStream] = {}
         self._seed_rng = np.random.default_rng(seed)
         self._started = False
@@ -778,15 +830,265 @@ class ServingRouter:
         for child in node.children.values():
             self._forget_owner(child, idx)
 
+    # -- fleet prefix transfer (round 18) ----------------------------------
+    def _owner_depths(self, prompt, alive):
+        """Walk the affinity tree: replica index -> deepest page of
+        ``prompt``'s chain it was recorded owning. Call under the
+        lock."""
+        ps = self.page_size
+        node = self._root
+        depths = {}
+        pages = min(len(prompt) // ps, self.max_tree_pages)
+        for i in range(pages):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            node = node.children.get(key)
+            if node is None:
+                break
+            for r in node.owners:
+                if r in alive:
+                    depths[r] = i + 1
+        return depths
+
+    def _forget_prefix_owner(self, prompt, idx):
+        """Drop ``idx``'s recorded ownership along ``prompt``'s chain
+        (a dedup drop made the record stale). Call under the lock."""
+        ps = self.page_size
+        node = self._root
+        pages = min(len(prompt) // ps, self.max_tree_pages)
+        for i in range(pages):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            node = node.children.get(key)
+            if node is None:
+                break
+            node.owners.pop(idx, None)
+
+    def _replica_cache_dtype(self, i):
+        """The replica's advertised KV dtype, or None when unknown —
+        the up-front dtype-skew guard (an int8 payload shipped into a
+        bf16 tree would only bounce on GeometryMismatch later)."""
+        fn = getattr(self.replicas[i], "cache_dtype", None)
+        if fn is None:
+            return None
+        try:
+            return fn() if callable(fn) else fn
+        except Exception:
+            return None
+
+    def _maybe_ship_prefix(self, stream, target_idx):
+        """The fleet prefix ship: if the replica we are about to place
+        ``stream`` on misses its prompt prefix but another replica
+        holds it cached, move the pages over the pagewire path so the
+        target chunk-prefills only the uncovered suffix.  STRICTLY
+        best-effort — every failure mode (donor gone, eviction race,
+        dtype skew, torn payload, capacity shed) degrades to the plain
+        recompute the engine would have done anyway, never to a failed
+        request."""
+        if not self.prefix_fleet:
+            return
+        try:
+            self._ship_prefix(stream, target_idx)
+        except Exception as e:  # the ship must never sink the request
+            self.metrics.prefix_ship_fallbacks_total.inc()
+            _log.warning(json.dumps({
+                "event": "router_prefix_ship_failed",
+                "to": target_idx, "request_id": stream.request_id,
+                "cause": repr(e)}))
+
+    def _ship_prefix(self, stream, target_idx):
+        prompt = stream.prompt
+        total_pages = len(prompt) // self.page_size
+        if total_pages < self.prefix_ship_min_pages:
+            return
+        key = (target_idx,
+               prompt[:self.page_size * self.max_tree_pages].tobytes())
+        with self._lock:
+            alive = set(self._routable()) - {target_idx}
+            owners = self._owner_depths(prompt, alive)
+            if owners and key in self._ships_inflight:
+                # a concurrent submit is already moving this prefix to
+                # this replica; the loser recomputes (or re-matches at
+                # the prefill head once the winner's pages commit)
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="inflight")
+                return
+            self._ships_inflight.add(key)
+        try:
+            self._ship_prefix_inner(stream, target_idx, prompt,
+                                    total_pages, owners)
+        finally:
+            with self._lock:
+                self._ships_inflight.discard(key)
+
+    def _ship_prefix_inner(self, stream, target_idx, prompt,
+                           total_pages, owners):
+        if not owners:
+            return
+        target = self.replicas[target_idx]
+        tgt_dtype = self._replica_cache_dtype(target_idx)
+        try:
+            have = target.probe_pages(prompt)
+        except Exception:
+            return
+        if have >= total_pages:
+            return  # already fully resident: a local hit, not a miss
+        # deepest recorded owner first; recorded depth is approximate,
+        # the donor's probe_pages is the truth
+        for donor_idx in sorted(owners, key=owners.get, reverse=True):
+            if self.chaos.fire("prefix_export_gone",
+                               donor=donor_idx, to_replica=target_idx):
+                # chaos: the donor vanished mid-ship — try the next one
+                continue
+            donor_dtype = self._replica_cache_dtype(donor_idx)
+            if tgt_dtype is not None and donor_dtype is not None \
+                    and donor_dtype != tgt_dtype:
+                # up-front dtype-skew guard: the payload could only
+                # bounce on GeometryMismatch at import — skip the
+                # doomed transfer entirely
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="dtype_skew")
+                continue
+            donor = self.replicas[donor_idx]
+            try:
+                donor_have = donor.probe_pages(prompt)
+            except Exception:
+                continue
+            if donor_have - have < self.prefix_ship_min_pages:
+                continue
+            if self._ship_from(stream, donor_idx, target_idx, prompt,
+                               have):
+                return
+
+    def _ship_from(self, stream, donor_idx, target_idx, prompt, skip):
+        """One donor→target transfer with bounded PrefixDrift
+        re-export retries.  True when pages landed (or the ship became
+        redundant); False to try the next donor."""
+        from .kv_cache import GeometryMismatch, PrefixDrift
+        from .pagewire import WireFormatError
+        donor = self.replicas[donor_idx]
+        target = self.replicas[target_idx]
+        t0 = time.perf_counter()
+        drift_left = self.prefix_ship_retries
+        while True:
+            try:
+                meta, k, v = donor.export_prefix(prompt, skip)
+            except PrefixDrift:
+                return False  # donor's chain shrank below the probe
+            except WireFormatError:
+                # torn wire payload: recompute covers it — re-pulling
+                # from the same donor would re-read the same stream
+                self.metrics.prefix_ship_fallbacks_total.inc()
+                return True
+            except Exception:
+                return False  # donor sick: next donor
+            if self.chaos.fire("prefix_import_drift",
+                               to_replica=target_idx):
+                # chaos models the eviction race for REAL: the
+                # target's matched chain is evicted between probe and
+                # import, so a nonzero skip bounces with PrefixDrift
+                try:
+                    target.drop_prefix(prompt)
+                except Exception:
+                    pass
+            try:
+                imported = target.import_prefix(meta, k, v)
+            except PrefixDrift as e:
+                drift_left -= 1
+                if drift_left <= 0:
+                    self.metrics.prefix_ship_fallbacks_total.inc()
+                    return True  # give up: recompute fallback
+                skip = e.cached_pages  # re-export the right suffix
+                continue
+            except GeometryMismatch:
+                # dtype/geometry skew the advertisement did not catch
+                # (stale or unreadable /healthz): bounced up front at
+                # deserialization — the recompute fallback covers it
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="geometry_bounce")
+                return True
+            except Exception:
+                self.metrics.prefix_ship_fallbacks_total.inc()
+                return True  # target can't host it: recompute
+            if not imported:
+                # drift retries converged on "target already holds the
+                # whole chain" (a concurrent ship or local prefill
+                # landed first) — an owner, but not a ship
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="redundant")
+                self._record(prompt, target_idx)
+                return True
+            self.metrics.prefix_ships_total.inc()
+            self.metrics.prefix_shipped_pages_total.inc(imported)
+            self._record(prompt, target_idx)  # target is an owner now
+            if self.trace.enabled:
+                self.trace.span(stream.req_id, "prefix_ship", t0,
+                                time.perf_counter() - t0,
+                                pages=int(imported),
+                                skip_pages=int(skip),
+                                from_replica=donor_idx,
+                                to_replica=target_idx)
+                self.trace.flight.record(
+                    "prefix_ship", from_replica=donor_idx,
+                    to_replica=target_idx, pages=int(imported),
+                    request_id=stream.request_id)
+            _log.info(json.dumps({
+                "event": "router_prefix_ship", "from": donor_idx,
+                "to": target_idx, "pages": int(imported),
+                "skip_pages": int(skip),
+                "request_id": stream.request_id}))
+            self._dedup_prefix_owners(prompt, target_idx)
+            return True
+
+    def _dedup_prefix_owners(self, prompt, target_idx):
+        """Router-driven eviction pressure: when a hot prefix is now
+        resident on more replicas than ``prefix_max_owners`` allows,
+        ask the most-loaded surplus owners to drop their unpinned copy
+        — the fleet keeps ship-reachable coverage without every
+        replica pinning its own pages."""
+        cap = self.prefix_max_owners
+        if cap <= 0:
+            return
+        with self._lock:
+            owners = self._owner_depths(
+                prompt, set(self._routable()) | {target_idx})
+        all_owners = set(owners) | {target_idx}
+        excess = len(all_owners) - cap
+        if excess <= 0:
+            return
+        cands = [i for i in all_owners if i != target_idx]
+        loads = self._loads(cands)
+        cands.sort(key=lambda i: (-loads[i], i))
+        for idx in cands[:excess]:
+            try:
+                dropped = self.replicas[idx].drop_prefix(prompt)
+            except Exception:
+                continue
+            if dropped:
+                self.metrics.prefix_dedup_drops_total.inc(dropped)
+                _log.info(json.dumps({
+                    "event": "router_prefix_dedup_drop",
+                    "replica": idx, "pages": int(dropped)}))
+            with self._lock:
+                self._forget_prefix_owner(prompt, idx)
+
     def _place(self, stream, exclude):
         """Try replicas in policy order until one admits the request.
         Shared by first placement and failover resubmission."""
         sheds = []
         tried = set(exclude)
+        ship_tried = False
         for idx in self._order(stream.prompt, exclude=exclude):
             if idx in tried:
                 continue
             tried.add(idx)
+            if not ship_tried:
+                # fleet prefix cache: before the prompt lands on the
+                # policy's first choice, pull its cached prefix over
+                # from wherever the fleet holds it (best-effort; the
+                # admission check then counts only uncached pages).
+                # Only the first candidate — shipping to every replica
+                # a shed walks past would spray copies across the fleet
+                ship_tried = True
+                self._maybe_ship_prefix(stream, idx)
             try:
                 inner = self.replicas[idx].submit(stream.prompt,
                                                   **stream.kwargs)
@@ -812,7 +1114,10 @@ class ServingRouter:
                 self.trace.span(stream.req_id, "routed",
                                 time.perf_counter(), replica=idx,
                                 policy=self.policy)
-            if self.policy == "cache_aware":
+            if self.policy == "cache_aware" or self.prefix_fleet:
+                # with the fleet prefix cache on, the tree is a
+                # TRANSFER INDEX under every policy — placements must
+                # teach it ownership or nothing is ever shippable
                 self._record(stream.prompt, idx)
             return stream
         if sheds:

@@ -316,6 +316,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._pages_export()
         elif self.path == "/v1/_pages/release":
             self._pages_release()
+        elif self.path == "/v1/_pages/prefix":
+            self._prefix_import()
+        elif self.path == "/v1/_pages/prefix/export":
+            self._prefix_export()
+        elif self.path == "/v1/_pages/prefix/drop":
+            self._prefix_drop()
         else:
             self._error(404, f"no route {self.path}",
                         "invalid_request_error")
@@ -460,6 +466,107 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._stream_sse(stream, False, f"cmpl-{stream.req_id}",
                          request_id)
+
+    # -- fleet prefix transfer (/v1/_pages/prefix, round 18) ---------------
+    def _prefix_export(self):
+        """Serve this replica's cached prefix of the posted prompt as
+        a pagewire payload (the donor side of a fleet prefix ship).
+        409 carries ``cached_pages`` when the local chain drifted below
+        the requested skip."""
+        from .kv_cache import PrefixDrift
+        from .pagewire import serialize_pages
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            meta, k, v = fe.export_prefix(
+                body["prompt"], int(body.get("skip_pages", 0)))
+        except PrefixDrift as e:
+            self._json(409, {"error": {
+                "message": str(e), "type": "prefix_drift", "code": 409,
+                "cached_pages": e.cached_pages}})
+            return
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad prefix export request: {e}",
+                        "invalid_request_error")
+            return
+        payload = serialize_pages(meta, k, v)
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/x-paddle-tpu-kv-pages")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _prefix_import(self):
+        """Land a shipped prefix payload in this replica's radix tree
+        (no continuation stream — the pages enter CACHED and the
+        follow-up completion request hits them).  The same bounce
+        semantics as adoption: 409 drift (with cached_pages) /
+        geometry, 429 capacity shed."""
+        from .kv_cache import GeometryMismatch, OutOfPages, PrefixDrift
+        from .pagewire import (MAX_PAYLOAD_BYTES, WireFormatError,
+                               deserialize_pages)
+        fe = self._migration_frontend()
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if not 0 < length <= MAX_PAYLOAD_BYTES:
+            self._error(400, f"bad Content-Length {length}",
+                        "invalid_request_error")
+            return
+        try:
+            meta, k, v, _ = deserialize_pages(self.rfile.read(length))
+            imported = fe.import_prefix(meta, k, v)
+        except PrefixDrift as e:
+            self._json(409, {"error": {
+                "message": str(e), "type": "prefix_drift", "code": 409,
+                "cached_pages": e.cached_pages}})
+            return
+        except GeometryMismatch as e:
+            self._json(409, {"error": {"message": str(e),
+                                       "type": "geometry_mismatch",
+                                       "code": 409}})
+            return
+        except (Rejected, OutOfPages) as e:
+            self._error(429, str(e), "overloaded",
+                        retry=getattr(e, "retry_after", 1))
+            return
+        except (Unavailable, EngineDraining) as e:
+            self._error(503, str(e), "unavailable")
+            return
+        except (WireFormatError, KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad prefix payload: {e}",
+                        "invalid_request_error")
+            return
+        self._json(200, {"imported_pages": int(imported)})
+
+    def _prefix_drop(self):
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None:
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            dropped = fe.drop_prefix(body["prompt"])
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad prefix drop request: {e}",
+                        "invalid_request_error")
+            return
+        self._json(200, {"dropped_pages": int(dropped)})
 
     # -- completion flow ---------------------------------------------------
     def _request_id(self):

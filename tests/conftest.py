@@ -71,7 +71,8 @@ def _bench_artifact_guard(request):
     run: 30.9 -> 20.1 under suite load, the exact round-12 symptom)."""
     _replay_classes = ("TestServingReplay", "TestServerReplay",
                        "TestServingDisaggReplay", "TestServingKv8Replay",
-                       "TestServingTraceReplay")
+                       "TestServingTraceReplay",
+                       "TestServingPrefixFleetReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

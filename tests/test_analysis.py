@@ -497,6 +497,24 @@ _MIGRATE_GOOD = """
             src.release_pages(stream)
 """
 
+# round 18: the fleet prefix-transfer family rides the same rule —
+# prefix export/import/drop touch the same device buffers + radix tree
+_PREFIX_BAD = """
+    class Shipper:
+        def ship(self, prompt):
+            meta, k, v = self.engine.cache.export_prefix_pages(prompt)
+            self.engine.cache.import_prefix_pages(meta, k, v)
+            self.engine.drop_prefix(prompt)
+"""
+
+_PREFIX_GOOD = """
+    class Shipper:
+        def ship(self, donor, target, prompt, skip):
+            meta, k, v = donor.export_prefix(prompt, skip)
+            target.import_prefix(meta, k, v)
+            donor.drop_prefix(prompt)
+"""
+
 
 class TestPageMigrationLock:
     def test_direct_cache_engine_migration_flags(self):
@@ -508,6 +526,17 @@ class TestPageMigrationLock:
     def test_replica_wrappers_pass(self):
         # the disagg router's own shape: replica-level calls only
         assert lint(_MIGRATE_GOOD, "paddle_tpu/serving/newmover.py",
+                    "page-migration-lock") == []
+
+    def test_direct_prefix_transfer_flags(self):
+        fs = lint(_PREFIX_BAD, "paddle_tpu/serving/newship.py",
+                  "page-migration-lock")
+        assert len(fs) == 3
+        assert all("front-end lock" in f.message for f in fs)
+
+    def test_prefix_replica_wrappers_pass(self):
+        # the round-18 router's own shape: replica-level calls only
+        assert lint(_PREFIX_GOOD, "paddle_tpu/serving/newship.py",
                     "page-migration-lock") == []
 
     def test_allocator_engine_frontend_exempt(self):

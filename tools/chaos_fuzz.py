@@ -5,7 +5,10 @@ Replays seeded request waves through a mixed disagg/spec/quantized
 fleet while the unified chaos layer (paddle_tpu.serving.chaos) fires a
 random fault schedule — step faults, latency, allocator pressure
 spikes, migration export/import/transfer failures, HTTP connect/EOF/
-slow-read faults — and the harness applies external convulsions
+slow-read faults, and (round 18) fleet prefix-ship faults: donor gone
+mid-export, probe→import eviction races, torn wire payloads (both
+fleets run with ``prefix_fleet=True`` over shared-prefix prompt waves,
+so ships actually happen) — and the harness applies external convulsions
 (replica kill, drain + readmit, fleet grow + crash-y shrink).  After
 every wave the GLOBAL recovery invariants are asserted:
 
@@ -73,9 +76,16 @@ ROUTER_RATES = {"migrate_export_fail": 0.10,
                 "migrate_import_bounce": 0.20,
                 "migrate_transfer_kill": 0.20,
                 "crash_drain": 0.5, "crash_readmit": 0.5,
-                "crash_shrink": 0.5}
+                "crash_shrink": 0.5,
+                # fleet prefix ships (round 18): donor vanishing and
+                # the probe->import eviction race, both of which must
+                # degrade to recompute with conservation intact
+                "prefix_export_gone": 0.30,
+                "prefix_import_drift": 0.50}
 HTTP_RATES = {"http_connect": 0.15, "http_midstream_eof": 0.15,
-              "http_slow_read": 0.30}
+              "http_slow_read": 0.30,
+              # torn prefix payload over the wire (WireFormatError)
+              "prefix_wire_truncate": 0.50}
 
 
 def tiny_model(seed=0, **kw):
@@ -115,9 +125,39 @@ def engine_chaos(seed, i):
                        retry_base_s=0.001, retry_max_s=0.01)
 
 
-def rng_prompts(rng, n, lo=4, hi=14):
-    return [rng.integers(0, VOCAB, int(rng.integers(lo, hi)))
-            .astype(np.int32) for _ in range(n)]
+def rng_prompts(rng, n, lo=4, hi=14, shared_frac=0.5):
+    """Random prompts; a ``shared_frac`` fraction opens with one
+    common 8-token (2-page) prefix, so the fleet prefix-ship path has
+    real cross-replica hits to move (the round-18 fault points only
+    fire on attempted ships)."""
+    shared = rng.integers(0, VOCAB, 8).astype(np.int32)
+    out = []
+    for i in range(n):
+        tail = rng.integers(0, VOCAB, int(rng.integers(lo, hi)))\
+            .astype(np.int32)
+        out.append(np.concatenate([shared, tail])
+                   if i < int(round(n * shared_frac)) else tail)
+    return out
+
+
+def warm_engine(eng, seed=1234):
+    """Compile the engine's bucketed program classes off-wave (one
+    tiny request stepped to completion, FaultInjected retried).  The
+    wave choreography — migrations teaching owners, then a prefix
+    flush, then gated placements that ship — needs real step timings,
+    and a first-call jit compile of several seconds swamps them."""
+    from paddle_tpu.serving import FaultInjected
+    rng = np.random.default_rng(seed)
+    eng.add_request(rng.integers(0, VOCAB, 6).astype(np.int32),
+                    max_new_tokens=2)
+    for _ in range(500):
+        if eng.scheduler.all_done():
+            break
+        try:
+            eng.step()
+        except FaultInjected:
+            continue
+    eng.cache.clear_prefix()  # the wave must start prefix-cold
 
 
 def oracle_tokens(prompts, max_new, engine_kw=None):
@@ -195,7 +235,10 @@ def run_disagg_wave(seed, n_requests, max_new, flavor, smoke=False):
     engine_kw = {}
     if flavor == "int8":
         engine_kw["cache_dtype"] = "int8"
-    prompts = rng_prompts(rng, n_requests)
+    # every prompt shares the 2-page prefix: migrations spread owners
+    # over the decode side, the flush convulsion makes the prefill
+    # replica miss, and every gated placement is a real ship candidate
+    prompts = rng_prompts(rng, n_requests, shared_frac=1.0)
     want = oracle_tokens(prompts, max_new, engine_kw=engine_kw)
 
     def engine(i, **kw):
@@ -208,16 +251,53 @@ def run_disagg_wave(seed, n_requests, max_new, flavor, smoke=False):
     reps = [InProcessReplica(engine(0), role="prefill"),
             InProcessReplica(engine(1, **spec_kw), role="decode"),
             InProcessReplica(engine(2), role="decode")]
+    for rep in reps:
+        warm_engine(rep.engine)
     router_cfg = ChaosConfig(seed=seed * 131, rates=ROUTER_RATES,
                              retry_base_s=0.001, retry_max_s=0.01,
                              breaker_n=3, breaker_cooldown_s=0.2)
-    router = DisaggRouter(reps, chaos=router_cfg, page_size=4)
+    # prefix_max_owners=2 keeps the fleet prefix DEDUPED (prefill +
+    # one decode copy): every surplus landing triggers a router-driven
+    # drop, so later placements miss again and the ship path stays hot
+    # for the round-18 fault points
+    router = DisaggRouter(reps, chaos=router_cfg, page_size=4,
+                          prefix_fleet=True, prefix_max_owners=2)
     router.start()
     results = [None] * n_requests
     errs = []
+    flushed = threading.Event()  # the prefix_flush convulsion landed
+    stop_flush = threading.Event()
+
+    def flusher():
+        """Rolling prefix-flush convulsion: once the first migration
+        taught a decode owner, keep dropping the prefill replica's
+        shared-prefix subtree — every recompute recommits it, so a
+        one-shot flush opens exactly one miss window.  The rolling
+        drop keeps the round-18 ship path (and its eviction-race
+        fault point) hot for every gated placement."""
+        deadline = time.monotonic() + 20.0
+        while router.metrics.migrations_total.value < 1 \
+                and time.monotonic() < deadline \
+                and not stop_flush.is_set():
+            time.sleep(0.05)
+        flushed.set()
+        while not stop_flush.wait(0.1):
+            try:
+                reps[0].drop_prefix(prompts[0][:8])
+            except Exception:
+                pass
 
     def worker(i):
         try:
+            if i >= 2:
+                # gated arrivals (first-call jit compiles make
+                # wall-clock staggers useless): the late placements
+                # must land AFTER the prefill replica's prefix flush,
+                # with decode owners already recorded by the early
+                # requests' migrations — that is the shape where the
+                # fleet prefix-ship path (round 18) runs for real
+                flushed.wait(timeout=30.0)
+                time.sleep((i - 2) * 0.1)
             results[i] = consume_spliced(router, prompts[i], max_new)
         except Exception as e:  # noqa: BLE001 - recorded, re-raised
             errs.append((i, repr(e)))
@@ -229,10 +309,12 @@ def run_disagg_wave(seed, n_requests, max_new, flavor, smoke=False):
             t.start()
         # external convulsions while the wave runs (the chaos crash_*
         # points fire INSIDE these calls per the router config)
-        convulsions = ["drain_readmit"] if smoke else \
-            ["drain_readmit", "grow_shrink"]
+        convulsions = ["prefix_flush", "drain_readmit"] if smoke else \
+            ["prefix_flush", "drain_readmit", "grow_shrink"]
         for conv in convulsions:
-            if conv == "drain_readmit":
+            if conv == "prefix_flush":
+                threading.Thread(target=flusher, daemon=True).start()
+            elif conv == "drain_readmit":
                 victim = int(rng.integers(0, len(reps)))
                 router.drain_replica(victim, timeout=LIVENESS_S)
                 try:
@@ -248,6 +330,7 @@ def run_disagg_wave(seed, n_requests, max_new, flavor, smoke=False):
         for t in threads:
             t.join(timeout=LIVENESS_S)
             assert not t.is_alive(), "liveness: consumer thread stuck"
+        stop_flush.set()
         assert not errs, f"stream failures: {errs}"
         assert results == want, (
             "token exactness violated vs the fault-free oracle: "
@@ -257,6 +340,7 @@ def run_disagg_wave(seed, n_requests, max_new, flavor, smoke=False):
         fleet_invariants(router)
         return collect_counts(router)
     finally:
+        stop_flush.set()
         router.close(timeout=LIVENESS_S)
 
 
@@ -266,24 +350,50 @@ def run_http_wave(seed, n_requests, max_new):
     fallback replica; exactness via failover, then invariants on the
     remote engine too (we own it in-process)."""
     rng = np.random.default_rng(seed + 7)
-    prompts = rng_prompts(rng, n_requests)
+    # every prompt shares the prefix: round-robin placement lands the
+    # shared pages on replica 0 first, so the next placements attempt
+    # real cross-replica ships over the /v1/_pages/prefix wire (the
+    # prefix_wire_truncate point only evaluates on HTTP exports)
+    prompts = rng_prompts(rng, n_requests, shared_frac=1.0)
     want = oracle_tokens(prompts, max_new)
-    remote_eng = make_engine(0)
+    remote_eng = make_engine(0, prefix_cache=True)
+    warm_engine(remote_eng)
     srv = ServingServer(remote_eng, max_queued=n_requests + 2)
     host, port = srv.start()
     http_cfg = ChaosConfig(seed=seed * 17, rates=HTTP_RATES,
                            slow_read_s=0.01, retry_base_s=0.001,
                            retry_max_s=0.01)
+    inproc_eng = make_engine(0, prefix_cache=True)
+    warm_engine(inproc_eng)
     reps = [HTTPReplica(host, port, chaos=http_cfg),
-            InProcessReplica(make_engine(0))]
+            InProcessReplica(inproc_eng)]
+    # the prober re-admits the HTTP replica after chaos EOF kills (the
+    # remote server itself is healthy) — without it the wave collapses
+    # to one replica and the ship path has no donors left; no dedup
+    # cap here, the remote must STAY the warm donor
     router = ServingRouter(
-        reps, policy="round_robin", page_size=4,
-        chaos=ChaosConfig(seed=seed * 19, retry_base_s=0.001,
+        reps, policy="round_robin", page_size=4, prefix_fleet=True,
+        probe_interval_s=0.05,
+        chaos=ChaosConfig(seed=seed * 19,
+                          rates={"prefix_export_gone": 0.25,
+                                 "prefix_import_drift": 0.50},
+                          retry_base_s=0.001,
                           retry_max_s=0.01, breaker_n=3,
                           breaker_cooldown_s=0.2))
     router.start()
     try:
-        got = [consume_spliced(router, p, max_new) for p in prompts]
+        got = []
+        for j, p in enumerate(prompts):
+            got.append(consume_spliced(router, p, max_new))
+            # convulsion: flush the shared prefix on the IN-PROCESS
+            # replica after each request — the remote stays the warm
+            # donor, so every in-process placement re-attempts a ship
+            # whose export crosses the wire (the torn-payload fault
+            # point only evaluates on HTTP exports)
+            try:
+                reps[1].drop_prefix(p[:8])
+            except Exception:
+                pass
         assert got == want, (
             "token exactness violated on the HTTP wave: "
             + json.dumps({"got": got, "want": want}))

@@ -107,6 +107,14 @@ run bash tools/serving_kv8_smoke.sh
 #     probes the chip) — safe tier, zero chip debt.
 run bash tools/serving_trace_smoke.sh
 
+# 5j. fleet prefix-cache smoke (round 18): TTFT probes (local hit vs
+#     cross-replica prefix SHIP vs recompute) + least-loaded fleet
+#     replay with ships on/off, token-exact vs a single-engine oracle.
+#     CPU-mesh by construction (--smoke), host-orchestrated page
+#     transfer over the 5g pagewire machinery, no new program shapes
+#     — safe tier, zero chip debt.
+run bash tools/serving_prefix_fleet_smoke.sh
+
 # ---- RISK TIER: first-time Mosaic compiles (can wedge the grant) ----
 
 # 6. kernel parity on-chip — split per-family tests (streamed fwd,

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Fleet-wide prefix cache smoke (round 18) — SAFE tier: `--smoke`
+# forces the CPU mesh (no device probe, zero chip touch); replicas are
+# in-process engines whose step programs are plain XLA, and a prefix
+# ship is a host-orchestrated gather/scatter over the same pagewire
+# machinery as disagg migration — NO first-time Mosaic construct can
+# reach the chip from this script.
+#
+# Runs the TTFT probes (local hit vs cross-replica ship vs full
+# recompute) and the least-loaded fleet replay with ships off/on;
+# greedy AND seeded-sampled streams are asserted token-exact vs a
+# single-engine oracle. Banks BENCH_serving_prefix_fleet.json.
+#
+# Run detached like every capture step:
+#   setsid bash tools/serving_prefix_fleet_smoke.sh > .bench_r4/serving_prefix_fleet_smoke.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p .bench_r4
+python bench_serving.py --smoke --prefix-fleet \
+  | tee .bench_r4/serving_prefix_fleet_smoke.json
