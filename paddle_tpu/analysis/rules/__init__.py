@@ -11,6 +11,7 @@ from .autograd import AutogradBypass, ThreadGradState
 from .chaos_clock import ServingRawSleep
 from .dist_spec import DistSpecPassthrough
 from .env_knobs import EnvKnobRegistry
+from .fleet_spawn import FleetProcessSpawn
 from .jit_capture import JitConstantCapture
 from .pallas import PallasHazards
 from .serving_lock import EngineLockDiscipline, PageMigrationLock
@@ -27,6 +28,7 @@ ALL_RULES = [
     PageMigrationLock(),
     EnvKnobRegistry(),
     ServingRawSleep(),
+    FleetProcessSpawn(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
@@ -35,4 +37,4 @@ __all__ = ["ALL_RULES", "RULES_BY_ID", "AutogradBypass",
            "ThreadGradState", "PallasHazards", "JitConstantCapture",
            "DistSpecPassthrough", "ChipKillOnTimeout",
            "EngineLockDiscipline", "PageMigrationLock",
-           "EnvKnobRegistry", "ServingRawSleep"]
+           "EnvKnobRegistry", "ServingRawSleep", "FleetProcessSpawn"]

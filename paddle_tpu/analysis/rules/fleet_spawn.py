@@ -1,0 +1,79 @@
+"""fleet-process-spawn: replica server processes are spawned through
+:class:`paddle_tpu.serving.fleet.ProcessReplicaBackend`, never by a
+bare ``subprocess.Popen``.
+
+Round-19 invariant (ISSUE 12): the backend is where the fleet's
+process hygiene lives — bounded ``/healthz`` readiness under the
+startup deadline, restart-with-backoff under a per-replica budget,
+ephemeral-port allocation, and reaping on EVERY exit path (close,
+atexit, the worker's parent-death watchdog).  A hand-rolled spawn
+bypasses all of it and recreates the stale-orphan-process class the
+round-4 addenda documents (leftover suite processes starving the VM
+for hours).  Two shapes are flagged:
+
+- ANY subprocess call inside ``paddle_tpu/serving/`` outside
+  ``fleet.py`` — serving library code has no business forking;
+- a subprocess call anywhere in tools/tests whose arguments name the
+  replica server entry (``fleet_worker`` / ``serving.server``) — the
+  hand-rolled replica spawn itself.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, dotted_name
+
+# the ONE blessed home of serving-process spawns
+_BACKEND_HOME = "paddle_tpu/serving/fleet.py"
+
+_SPAWN_CALLS = {"Popen", "run", "check_output", "check_call", "call"}
+# strings that mark a spawned command as a replica server process
+_SERVER_ENTRY = re.compile(r"fleet_worker|serving\.server")
+
+
+def _call_strings(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+class FleetProcessSpawn(Rule):
+    """Bare subprocess spawns of replica server processes outside
+    ``ProcessReplicaBackend``."""
+
+    id = "fleet-process-spawn"
+    description = ("replica server processes spawned outside "
+                   "ProcessReplicaBackend bypass startup-deadline/"
+                   "restart-budget/port hygiene and reaping (orphan "
+                   "process class, round-4 addenda)")
+
+    def applies(self, ctx):
+        if ctx.relpath == _BACKEND_HOME:
+            return False
+        return ctx.relpath.startswith(("paddle_tpu/serving/",
+                                       "tools/", "tests/"))
+
+    def check(self, ctx):
+        in_serving = ctx.relpath.startswith("paddle_tpu/serving/")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if "subprocess" not in name \
+                    or name.split(".")[-1] not in _SPAWN_CALLS:
+                continue
+            spawns_server = any(_SERVER_ENTRY.search(s)
+                                for s in _call_strings(node))
+            if not (in_serving or spawns_server):
+                continue
+            what = ("serving code must not fork" if in_serving
+                    and not spawns_server
+                    else "a replica server process")
+            yield ctx.finding(
+                self.id, node,
+                f"`{name}` spawning {what} outside "
+                "ProcessReplicaBackend — the backend owns startup "
+                "deadlines, restart budgets, port allocation and "
+                "reap-on-every-exit-path; route the spawn through "
+                "paddle_tpu.serving.fleet (round-19 invariant)")

@@ -19,6 +19,23 @@ close), per role and with hysteresis:
   for ``down_window_s`` seconds and it sits above its ``min``; the
   least-loaded replica is retired through the rolling drain, so no
   in-flight request is lost and no admission 5xxs.
+- **Breaker-fed pressure** (round 19, PR-10 follow-on): the router's
+  per-replica circuit breakers and shed/failover counters feed the
+  pressure signal — a fleet where breakers are opening or admissions
+  are shedding is BROWNING OUT even while its mean reserved pages look
+  fine (capacity exists, it just isn't healthy), so it grows before
+  the SLOs blow.  ``breaker_frac`` (open breakers / non-retired
+  replicas ≥ ``PADDLE_TPU_SERVING_AUTOSCALE_BREAKER_FRAC``) or a
+  shed+failover window delta ≥ ``PADDLE_TPU_SERVING_AUTOSCALE_SHED_N``
+  counts as sustained pressure through the same hysteresis window.
+- **Drain-by-health rotation** (round 19): a FLAPPING replica — its
+  breaker has opened ``PADDLE_TPU_SERVING_AUTOSCALE_FLAP_OPENS`` times
+  — is rotated out rather than retried into: a replacement is
+  provisioned FIRST, then the flapper drains out through
+  ``retire_replica`` (its supervised process is reaped by the
+  backend).  With :class:`~paddle_tpu.serving.fleet
+  .ProcessReplicaBackend` as the factory (``backend=``), scale-ups
+  spawn real replica server processes and retirements reap them.
 
 Everything is deterministic and unit-testable: the loop never reads
 wall time directly — ``clock=`` injects the time source (tests use a
@@ -35,7 +52,12 @@ ticks only), ``PADDLE_TPU_SERVING_AUTOSCALE_UP_PAGES``,
 ``PADDLE_TPU_SERVING_AUTOSCALE_TTFT_SLO_S`` (unset disables the TTFT
 signal), ``PADDLE_TPU_SERVING_AUTOSCALE_MIN`` /
 ``PADDLE_TPU_SERVING_AUTOSCALE_MAX`` (an integer for every role, or
-``"prefill:1,decode:2"``).
+``"prefill:1,decode:2"``), and the round-19 breaker-fed signals:
+``PADDLE_TPU_SERVING_AUTOSCALE_BREAKER_FRAC`` (open-breaker fraction
+counted as pressure; 0 disables), ``PADDLE_TPU_SERVING_AUTOSCALE_SHED_N``
+(shed+failover window delta counted as pressure; 0 disables),
+``PADDLE_TPU_SERVING_AUTOSCALE_FLAP_OPENS`` (breaker opens before a
+replica is rotated out; 0 disables rotation).
 """
 from __future__ import annotations
 
@@ -89,11 +111,21 @@ class FleetAutoscaler:
     signals.  ``factory(role)`` must return an UNSTARTED replica
     (``router.add_replica`` starts it when the router is live)."""
 
-    def __init__(self, router, factory, *, clock=None, interval_s=None,
+    def __init__(self, router, factory=None, *, backend=None,
+                 clock=None, interval_s=None,
                  min_per_role=None, max_per_role=None, up_pages=None,
                  down_pages=None, up_window_s=None, down_window_s=None,
-                 ttft_slo_s=None, slo_breach_frac=0.1):
+                 ttft_slo_s=None, slo_breach_frac=0.1,
+                 breaker_frac=None, shed_window_n=None,
+                 flap_opens=None):
         self.router = router
+        self.backend = backend
+        if factory is None and backend is not None:
+            # real provisioning (round 19): the backend spawns replica
+            # server processes; retire_replica -> replica.close() reaps
+            factory = backend.provision
+        if factory is None:
+            raise ValueError("need a replica factory or a backend")
         self.factory = factory
         self.clock = clock if clock is not None else time.monotonic
         self.interval_s = (
@@ -125,17 +157,37 @@ class FleetAutoscaler:
             ttft_slo_s = float(env) if env not in (None, "") else None
         self.ttft_slo_s = ttft_slo_s
         self.slo_breach_frac = float(slo_breach_frac)
+        # breaker-fed signals (round 19)
+        self.breaker_frac = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_BREAKER_FRAC",
+                       0.34)
+            if breaker_frac is None else float(breaker_frac))
+        self.shed_window_n = (
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_SHED_N", 3.0)
+            if shed_window_n is None else float(shed_window_n))
+        self.flap_opens = int(
+            _env_float("PADDLE_TPU_SERVING_AUTOSCALE_FLAP_OPENS", 3.0)
+            if flap_opens is None else flap_opens)
         self._since: dict[tuple, float] = {}  # (role, dir) -> held since
         self._ttft_prev: dict[str, int] = {}  # le -> cumulative count
+        self._shed_prev = 0.0    # shed+failover counters, last tick
+        self._rotated: dict[int, int] = {}  # replica -> opens baseline
         self._stop = threading.Event()
         self._thread = None
 
     # -- limits ------------------------------------------------------------
+    def _router(self):
+        """The router to police this tick.  A RouterSupervisor's
+        ``active`` may change across takeovers — resolve late so the
+        policy loop follows the promotion instead of scaling a dead
+        router."""
+        return getattr(self.router, "active", None) or self.router
+
     def _limit(self, table, role):
         return int(table.get(role, table["__default__"]))
 
     def managed_roles(self):
-        roles = {r for r in self.router.roles}
+        roles = {r for r in self._router().roles}
         roles |= {r for r in self.min_per_role if r != "__default__"}
         roles |= {r for r in self.max_per_role if r != "__default__"}
         return sorted(roles)
@@ -143,7 +195,7 @@ class FleetAutoscaler:
     # -- signals -----------------------------------------------------------
     def _role_state(self, role):
         """(routable indexes, mean reserved pages) for a role."""
-        router = self.router
+        router = self._router()
         idxs = [i for i in router._routable()
                 if router.roles[i] == role]
         loads = []
@@ -166,7 +218,7 @@ class FleetAutoscaler:
         if self.ttft_slo_s is None:
             return None
         try:
-            text = self.router.prometheus()
+            text = self._router().prometheus()
         except Exception:
             return None
         totals: dict[str, int] = {}
@@ -190,6 +242,30 @@ class FleetAutoscaler:
         d_ok = totals.get(le_slo, 0) - prev.get(le_slo, 0)
         return max(0.0, 1.0 - d_ok / d_inf)
 
+    def fleet_pressure(self):
+        """The breaker-fed health signal (round 19): ``(open-breaker
+        fraction over non-retired replicas, shed+failover delta since
+        the last call)``.  Either crossing its threshold marks the
+        fleet BROWNING OUT — unhealthy capacity is pressure even when
+        mean load is not."""
+        router = self._router()
+        total = opens = 0
+        for i in range(len(router.replicas)):
+            if i in router._retired:
+                continue
+            total += 1
+            try:
+                if router._breakers[i].state == "open":
+                    opens += 1
+            except IndexError:  # pragma: no cover - grow race
+                continue
+        frac = opens / total if total else 0.0
+        now_count = float(router.metrics.router_shed_total.value
+                          + router.metrics.failovers_total.total)
+        delta = max(0.0, now_count - self._shed_prev)
+        self._shed_prev = now_count
+        return frac, delta
+
     # -- policy ------------------------------------------------------------
     def _held_for(self, key, condition, now, window):
         """Hysteresis: True once ``condition`` has held continuously
@@ -205,7 +281,11 @@ class FleetAutoscaler:
         ``[("up"|"down", role, replica_idx), ...]``."""
         now = self.clock()
         breach = self.ttft_breach_frac()
+        brk_frac, shed_delta = self.fleet_pressure()
+        browning = (brk_frac >= self.breaker_frac > 0) or (
+            self.shed_window_n > 0 and shed_delta >= self.shed_window_n)
         events = []
+        self._rotate_flappers(events)
         for role in self.managed_roles():
             idxs, loads, mean = self._role_state(role)
             n = len(idxs)
@@ -218,7 +298,7 @@ class FleetAutoscaler:
                     events.append(("up", role, idx))
                 self._since.pop((role, "up"), None)
                 continue
-            pressured = mean > self.up_pages or (
+            pressured = mean > self.up_pages or browning or (
                 breach is not None and breach > self.slo_breach_frac)
             if n < hi and self._held_for((role, "up"), pressured, now,
                                          self.up_window_s):
@@ -249,10 +329,10 @@ class FleetAutoscaler:
             return None
 
     def _scale_up(self, role):
+        router = self._router()
         replica = self.factory(role)
-        i = self.router.add_replica(replica, role=role)
-        self.router.metrics.autoscale_events.inc(direction="up",
-                                                 role=role)
+        i = router.add_replica(replica, role=role)
+        router.metrics.autoscale_events.inc(direction="up", role=role)
         _log.info(json.dumps({"event": "autoscale_up", "role": role,
                               "replica": i}))
         return i
@@ -260,11 +340,49 @@ class FleetAutoscaler:
     def _scale_down(self, role, i):
         # rolling drain: zero lost requests, zero 5xx — retire blocks
         # this tick until the replica finished its in-flight work
-        self.router.retire_replica(i)
-        self.router.metrics.autoscale_events.inc(direction="down",
-                                                 role=role)
+        router = self._router()
+        router.retire_replica(i)
+        router.metrics.autoscale_events.inc(direction="down",
+                                            role=role)
         _log.info(json.dumps({"event": "autoscale_down", "role": role,
                               "replica": i}))
+
+    def _rotate_flappers(self, events):
+        """Drain-by-health (round 19): a replica whose breaker has
+        opened ``flap_opens`` times is flaky in a way retries make
+        WORSE — rotate it out.  Replacement first (capacity never dips
+        below the pre-rotation level; a failed factory aborts the
+        rotation and the flapper keeps limping), then the flapper
+        drains out through the rolling-retire path and its supervised
+        process is reaped by ``replica.close()``."""
+        if self.flap_opens <= 0:
+            return
+        router = self._router()
+        # NOT _routable(): a flapper with an OPEN breaker is excluded
+        # from routing — which is exactly why it needs rotating out
+        for i in range(len(router.replicas)):
+            if i in router._retired or i in router._down:
+                continue
+            try:
+                opens = router._breakers[i].opens - self._rotated.get(
+                    i, 0)
+            except IndexError:  # pragma: no cover - shrink race
+                continue
+            if opens < self.flap_opens:
+                continue
+            role = router.roles[i]
+            new_idx = self._try_scale_up(role)
+            if new_idx is None:
+                continue  # factory failed: retry next tick
+            self._rotated[i] = router._breakers[i].opens
+            router.retire_replica(i)
+            router.metrics.autoscale_events.inc(direction="rotate",
+                                                role=role)
+            events.append(("rotate", role, i))
+            _log.warning(json.dumps({
+                "event": "autoscale_rotate_flapper", "role": role,
+                "replica": i, "replacement": new_idx,
+                "breaker_opens": opens}))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

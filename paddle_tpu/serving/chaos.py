@@ -88,6 +88,17 @@ FAULT_POINTS = (
     "prefix_import_drift",   # router: recipient tree changed (eviction
     #                                  race) -> PrefixDrift bounce
     "prefix_wire_truncate",  # HTTPReplica: torn prefix payload
+    # fleet control plane (round 19): the crash-survivable tier — every
+    # one must converge back to a correct fleet view, never lose an
+    # accepted stream
+    "router_crash",          # supervisor: primary router dies mid-
+    #                          stream (clients retry on the standby)
+    "standby_takeover_race",  # supervisor: a concurrent promotion races
+    #                           the takeover (must be idempotent)
+    "replica_proc_kill",     # backend: replica server process SIGKILLed
+    #                          (supervision restarts within budget)
+    "journal_torn_write",    # journal: a record is torn mid-write
+    #                          (replay must skip it, not die)
 )
 
 # legacy aliases (round 9/11 knobs) folded into the unified config
@@ -407,6 +418,18 @@ class CircuitBreaker:
         self.failures = 0
         self._opened_at = None
         self._half_open = False
+
+    def force_open(self):
+        """Restore an OPEN state directly (journal replay on router
+        recovery): the cooldown restarts NOW — the recovered router has
+        no memory of how long the original breaker had been open, so it
+        re-earns the half-open trial instead of guessing."""
+        if self.threshold <= 0:
+            return
+        self.failures = max(self.failures, self.threshold)
+        self._opened_at = self.clock()
+        self._half_open = False
+        self.opens += 1
 
 
 # ---------------------------------------------------------------------------

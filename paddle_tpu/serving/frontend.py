@@ -165,6 +165,11 @@ class ServingFrontend:
         self.role = role
         self.max_queued = int(max_queued)
         self.poll_interval_s = float(poll_interval_s)
+        # process identity (round 19, fleet control plane): /healthz
+        # advertises pid + start time so a supervising backend (and a
+        # recovering router's sweep) can tell a RESTARTED replica
+        # process from the one that died — same host:port, new life
+        self.started_unix = time.time()
         self.lock = threading.Lock()
         self.error = None
         self._streams: dict[int, RequestStream] = {}
@@ -263,11 +268,31 @@ class ServingFrontend:
                 hit = self.engine.cancel(rid) or hit
         return hit
 
+    def cancel_stream(self, stream):
+        """Identity-checked cancel (round 19): engine req_ids are
+        PER-ENGINE sequential ints, so a caller holding a stale stream
+        handle — e.g. a router teardown racing a cross-replica
+        failover — can alias a DIFFERENT live request's rid on this
+        engine.  Cancel only if this exact stream object still owns
+        its rid here; the identity check and the cancel share the lock
+        so no new owner can slip in between (the fleet harness's
+        exactness gate caught the unchecked version cancelling an
+        innocent stream)."""
+        with self.lock:
+            if self._streams.get(stream.req_id) is not stream:
+                return False
+            hit = False
+            for rid in stream.all_ids():
+                hit = self.engine.cancel(rid) or hit
+        return hit
+
     def health(self):
         with self.lock:
             eng = self.engine
             return {"status": self._state,
                     "role": self.role,
+                    "pid": os.getpid(),
+                    "started_unix": self.started_unix,
                     "waiting": eng.scheduler.queue_depth(),
                     "live": len(eng.scheduler.live_requests()),
                     "held": len(eng._held),

@@ -78,7 +78,15 @@ class InProcessReplica:
         return self.frontend.submit(prompt, **kw)
 
     def cancel_stream(self, stream):
-        return self.frontend.cancel(stream.req_id)
+        return self.frontend.cancel_stream(stream)
+
+    def cancel_request(self, req_id):
+        """Cancel an engine request by bare id — the recovered
+        router's orphan-release path (round 19): a dead router's
+        in-flight request has no stream object left to hand over, only
+        the journaled id.  Pages (live AND held) free under the
+        front-end lock."""
+        return self.frontend.cancel(req_id)
 
     def health(self):
         return self.frontend.health()
@@ -209,7 +217,11 @@ class _HTTPStream:
                 raise TimeoutError(
                     f"replica stream {self.req_id}: no event within "
                     f"{timeout}s") from None
-            except OSError as e:
+            except (OSError, AttributeError, ValueError) as e:
+                # AttributeError/ValueError: the response was close()d
+                # under us (router-crash teardown closes a dead
+                # router's sockets mid-read) — same signal as a broken
+                # transport: fail over
                 raise ReplicaFailed(
                     f"replica stream broke: {e!r}") from e
             if not raw:  # EOF before [DONE]: replica went away
@@ -375,6 +387,23 @@ class HTTPReplica:
     def cancel_stream(self, stream):
         stream.close()
         return True
+
+    def cancel_request(self, req_id):
+        """Best-effort orphan release by remote request id
+        (``/v1/_pages/release`` frees HELD pages).  A RUNNING remote
+        request cannot be cancelled without its connection — the dead
+        router's sockets closing (disconnect-cancel) and the
+        held-deadline sweep are the backstops."""
+        try:
+            status, data = self._post_json("/v1/_pages/release",
+                                           {"req_id": int(req_id)})
+        except (OSError, ReplicaFailed, ValueError, TypeError):
+            return False
+        try:
+            return status == 200 and bool(
+                json.loads(data).get("released"))
+        except ValueError:
+            return False
 
     # -- KV page migration (disagg tier, /v1/_pages) -----------------------
     def _retrying(self, fn, what):

@@ -257,7 +257,8 @@ class ServingRouter:
                  max_tree_nodes=4096, seed=None,
                  probe_interval_s=None, chaos=None,
                  breaker_clock=None, prefix_fleet=None,
-                 prefix_ship_min_pages=None, prefix_max_owners=None):
+                 prefix_ship_min_pages=None, prefix_max_owners=None,
+                 journal=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         policy = policy or os.environ.get(
@@ -319,6 +320,14 @@ class ServingRouter:
         self._streams: dict[int, RouterStream] = {}
         self._seed_rng = np.random.default_rng(seed)
         self._started = False
+        # crash-rebuildable state (round 19): every routing decision
+        # input is either journaled (affinity/ownership, breaker opens,
+        # stream begin/end) or re-derivable from one /healthz sweep
+        # (liveness, loads, reservations) — a cold router replays the
+        # journal, sweeps once, and converges (see fleet.RouterJournal)
+        self.journal = journal
+        self._crashed = False     # halt(): this router object is dead
+        self._orphans: dict = {}  # replay: begun-but-unfinished streams
         # unified chaos layer (round 17): router-side fault points
         # (replica crash during drain/readmit/shrink, migration faults
         # in the disagg subclass) + the retry/backoff knobs; the legacy
@@ -347,6 +356,136 @@ class ServingRouter:
         self.probe_interval_s = max(0.0, float(probe_interval_s))
         self._probe_stop = threading.Event()
         self._probe_thread = None
+
+    # -- crash-rebuildable state (round 19, fleet control plane) -----------
+    def _journal(self, **rec):
+        """Best-effort append to the routing journal (fleet.py): the
+        journal is a recovery accelerant, never a serving dependency —
+        a full disk or torn writer must not fail a request."""
+        j = self.journal
+        if j is None:
+            return
+        try:
+            j.append(rec)
+        except Exception:  # pragma: no cover - journal is best-effort
+            pass
+
+    def _journal_prefix(self, prompt):
+        """The journaled form of a prompt's affinity chain: exactly the
+        tokens the tree stores (page-aligned, depth-capped)."""
+        ps = self.page_size
+        pages = min(len(prompt) // ps, self.max_tree_pages)
+        return [int(t) for t in prompt[:pages * ps]]
+
+    def sweep_health(self):
+        """ONE full /healthz pass over every non-retired replica — the
+        live half of recovery (the journal is the other half): liveness
+        and breaker-worthiness come from here, not from stale journal
+        hints.  A replica answering ``ok`` becomes routable; one that
+        is unreachable/failed goes down.  Returns ``{idx: health}``."""
+        out = {}
+        for i in range(len(self.replicas)):
+            if i in self._retired:
+                continue
+            try:
+                h = dict(self.replicas[i].health())
+            except Exception as e:
+                h = {"status": "unreachable", "error": repr(e)}
+            out[i] = h
+            status = h.get("status")
+            with self._lock:
+                if status == "ok":
+                    self._down.discard(i)
+                elif status in ("failed", "unreachable"):
+                    self._down.add(i)
+        return out
+
+    def adopt_journal(self, journal):
+        """Rebuild the journaled half of the routing state from
+        ``journal`` and continue appending to it.  Replays placements
+        (affinity/ownership tree, original order = original clocks),
+        ownership drops, breaker opens (restored open with a fresh
+        cooldown), down/up hints, and stream begin/end pairs — begun-
+        but-unfinished streams become ``_orphans`` for
+        :meth:`release_orphans`.  Call :meth:`sweep_health` after: the
+        sweep is the truth for liveness, the journal for affinity."""
+        self.journal = None  # replay must not re-journal itself
+        n = 0
+        for rec in journal.replay():
+            self._apply_journal_record(rec)
+            n += 1
+        self.journal = journal
+        return n
+
+    def _apply_journal_record(self, rec):
+        ev = rec.get("ev")
+        r = rec.get("r")
+        if r is not None and (not isinstance(r, int)
+                              or r >= len(self.replicas)):
+            return  # journal from a larger fleet: ignore unknown slots
+        if ev == "place":
+            self._record(np.asarray(rec.get("p", ()), np.int32), r)
+        elif ev == "drop":
+            with self._lock:
+                self._forget_prefix_owner(
+                    np.asarray(rec.get("p", ()), np.int32), r)
+        elif ev == "begin":
+            self._orphans[rec.get("rid")] = (r, rec.get("inner"),
+                                             rec.get("req"))
+        elif ev == "end":
+            self._orphans.pop(rec.get("rid"), None)
+        elif ev == "down":
+            with self._lock:
+                self._down.add(r)
+        elif ev == "up":
+            with self._lock:
+                self._down.discard(r)
+        elif ev == "breaker_open":
+            self._breakers[r].force_open()
+
+    def release_orphans(self):
+        """Best-effort release of the dead router's in-flight work: a
+        begun-but-unfinished journal entry means SOME replica may still
+        hold that stream's request (running lanes, held prefill pages)
+        with nobody left to consume it.  In-process replicas cancel it
+        outright (pages freed now); remote ones saw the dead router's
+        sockets close (disconnect-cancel) and anything held falls to
+        the deadline-expiry sweep — the existing backstop.  Returns the
+        number of orphans cancelled."""
+        released = 0
+        orphans, self._orphans = self._orphans, {}
+        for rid, (idx, inner, _req) in orphans.items():
+            if inner is None or idx is None or idx in self._down:
+                continue
+            try:
+                if self.replicas[idx].cancel_request(inner):
+                    released += 1
+            except Exception:
+                continue
+        if released:
+            _log.info(json.dumps({"event": "router_orphans_released",
+                                  "count": released}))
+        return released
+
+    @classmethod
+    def recover(cls, replicas, journal, **kw):
+        """Build a router whose state converges to a never-crashed
+        router's view: construct cold, replay the journal (affinity,
+        ownership, breaker opens, orphaned streams), then ONE health
+        sweep (liveness + loads are live state, owned by the fleet).
+        The recovered router keeps journaling to the same file."""
+        router = cls(replicas, **kw)
+        router.adopt_journal(journal)
+        router.sweep_health()
+        router.release_orphans()
+        return router
+
+    def halt(self):
+        """Mark THIS router object dead (supervisor takeover): stop the
+        prober, refuse new submissions.  The replicas are untouched —
+        they belong to the fleet, not to this incarnation."""
+        self._crashed = True
+        self._probe_stop.set()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -410,6 +549,7 @@ class ServingRouter:
             return
         if not self._breakers[idx].record_failure():
             return
+        self._journal(ev="breaker_open", r=idx)
         self.metrics.breaker_opens_total.inc(replica=idx)
         _log.warning(json.dumps({"event": "router_breaker_open",
                                  "replica": idx, "cause": str(cause)}))
@@ -454,6 +594,7 @@ class ServingRouter:
                 continue
             if status != "ok":
                 continue
+            self._journal(ev="up", r=i)
             with self._lock:
                 self._down.discard(i)
                 self._forget_owner(self._root, i)
@@ -470,6 +611,8 @@ class ServingRouter:
         only when EVERY routable replica sheds (aggregated 429,
         ``retry_after`` = max over replica hints), Unavailable when no
         replica is routable at all."""
+        if self._crashed:
+            raise Unavailable("router crashed (superseded by takeover)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if kw.get("do_sample") and kw.get("seed") is None:
             # failover determinism needs an explicit seed: token t is
@@ -485,6 +628,13 @@ class ServingRouter:
         self._place(stream, exclude=())
         with self._lock:
             self._streams[stream.req_id] = stream
+        if self._crashed:
+            # raced a supervisor takeover: the teardown snapshot may
+            # have missed this stream, so nothing would ever kick its
+            # consumer off the dead router — refuse it here (the
+            # supervisor resubmits on the standby; the placed request
+            # falls to the new router's orphan release)
+            raise Unavailable("router crashed (superseded by takeover)")
         return stream
 
     def cancel(self, req_id):
@@ -643,6 +793,7 @@ class ServingRouter:
             self.kill_replica(i, ReplicaFailed(
                 "chaos: replica crashed during readmit"))
             return
+        self._journal(ev="up", r=i)
         with self._lock:
             self._draining.discard(i)
             self._down.discard(i)
@@ -700,6 +851,7 @@ class ServingRouter:
     def kill_replica(self, i, exc=None):
         """Fault hook (tests/bench): hard-kill an in-process replica;
         its open streams fail over."""
+        self._journal(ev="down", r=i)
         with self._lock:
             self._down.add(i)
         if self.trace.enabled:
@@ -790,6 +942,8 @@ class ServingRouter:
         pages = min(len(prompt) // ps, self.max_tree_pages)
         if pages == 0:
             return
+        self._journal(ev="place", r=replica_idx,
+                      p=self._journal_prefix(prompt))
         with self._lock:
             self._clock += 1
             node = self._root
@@ -1067,6 +1221,8 @@ class ServingRouter:
                 _log.info(json.dumps({
                     "event": "router_prefix_dedup_drop",
                     "replica": idx, "pages": int(dropped)}))
+            self._journal(ev="drop", r=idx,
+                          p=self._journal_prefix(prompt))
             with self._lock:
                 self._forget_prefix_owner(prompt, idx)
 
@@ -1098,6 +1254,7 @@ class ServingRouter:
             except Unavailable:
                 continue
             except ReplicaFailed as e:
+                self._journal(ev="down", r=idx)
                 with self._lock:
                     self._down.add(idx)
                 self._record_replica_failure(idx, e)
@@ -1108,6 +1265,11 @@ class ServingRouter:
             stream._inner = inner
             stream.replica_idx = idx
             self._breakers[idx].record_success()
+            inner_rid = getattr(inner, "req_id", None)
+            self._journal(
+                ev="begin", rid=stream.req_id, r=idx,
+                inner=inner_rid if isinstance(inner_rid, int) else None,
+                req=stream.request_id)
             self.metrics.routed_total.inc(policy=self.policy,
                                           replica=idx)
             if self.trace.enabled:
@@ -1133,7 +1295,14 @@ class ServingRouter:
         """The serving replica died mid-stream: mark it down, resubmit
         on a survivor, arm the splice (skip already-delivered tokens).
         Raises RuntimeError when no survivor admits the request."""
+        if self._crashed:
+            # the ROUTER died, not the replica: this incarnation must
+            # not mark fleet members down or resubmit — the supervisor
+            # retries the stream on the promoted standby
+            raise RuntimeError(
+                "router crashed (superseded by takeover)") from exc
         failed = stream.replica_idx
+        self._journal(ev="down", r=failed)
         with self._lock:
             self._down.add(failed)
         self._record_replica_failure(failed, exc)
@@ -1147,9 +1316,16 @@ class ServingRouter:
             "request_id": stream.request_id,
             "router_req_id": stream.req_id,
             "delivered_tokens": spliced, "cause": str(exc)}))
-        stream._skip = [d if not f else 0
-                        for d, f in zip(stream._delivered,
-                                        stream._finished)]
+        # splice arming: the resubmission replays the stream from
+        # token 0, so skip everything this stream already emitted PLUS
+        # any skip remainder still unconsumed from a previous splice —
+        # a failover landing mid-splice (or mid-supervisor-reattach)
+        # otherwise re-delivers the dropped remainder (duplicated
+        # tokens, caught by the fleet harness's exactness gate)
+        stream._skip = [s + d if not f else 0
+                        for s, d, f in zip(stream._skip,
+                                           stream._delivered,
+                                           stream._finished)]
         t0 = time.perf_counter()
         try:
             self._place(stream, exclude={failed})
@@ -1185,6 +1361,7 @@ class ServingRouter:
                 f"env-injected kill after {after} tokens"))
 
     def _stream_done(self, stream):
+        self._journal(ev="end", rid=stream.req_id)
         with self._lock:
             self._streams.pop(stream.req_id, None)
             if self.trace.enabled:

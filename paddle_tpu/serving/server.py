@@ -133,6 +133,22 @@ class ServingServer:
             self._httpd = None
         return drained
 
+    def abort(self, exc=None):
+        """kill -9 semantics without a process (fleet ThreadLauncher /
+        chaos drills): fail the front-end hard — live pages released,
+        open streams erred, NO drain — and stop the listener
+        immediately, so clients see exactly what a SIGKILLed server
+        process looks like: connections reset, /healthz unreachable."""
+        try:
+            if hasattr(self.frontend, "fail"):
+                self.frontend.fail(exc or RuntimeError("server aborted"))
+        except Exception:  # pragma: no cover - teardown is best-effort
+            pass
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
     # -- request translation ----------------------------------------------
     def _encode(self, body, chat):
         def ids_of(content, what):

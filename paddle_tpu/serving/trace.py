@@ -66,11 +66,18 @@ import time
 from collections import deque
 
 __all__ = ["FlightRecorder", "RequestTrace", "ServingTrace",
-           "chrome_trace_events", "export_chrome_trace"]
+           "chrome_trace_events", "export_chrome_trace",
+           "load_trace_export"]
 
 TRACE_ENV = "PADDLE_TPU_SERVING_TRACE"
 TRACE_SPANS_ENV = "PADDLE_TPU_SERVING_TRACE_SPANS"
 TRACE_FLIGHT_ENV = "PADDLE_TPU_SERVING_TRACE_FLIGHT"
+# round 19 (fleet control plane): completed timelines append to a
+# size-capped JSONL file the moment they finish, so a fleet-harness run
+# leaves a post-mortem artifact even after the process that owned the
+# trace store dies (the OTLP follow-on's minimal file-based form)
+TRACE_EXPORT_ENV = "PADDLE_TPU_SERVING_TRACE_EXPORT"
+TRACE_EXPORT_MB_ENV = "PADDLE_TPU_SERVING_TRACE_EXPORT_MB"
 
 # completed request traces retained per engine (oldest evicted): bounds
 # the store under sustained traffic without a knob per dimension
@@ -102,6 +109,14 @@ def flight_cap():
         return max(16, int(os.environ.get(TRACE_FLIGHT_ENV, "256")))
     except ValueError:
         return 256
+
+
+def export_cap_bytes():
+    try:
+        mb = float(os.environ.get(TRACE_EXPORT_MB_ENV, "64") or 64)
+    except ValueError:
+        mb = 64.0
+    return int(mb * 1024 * 1024)
 
 
 class RequestTrace:
@@ -223,7 +238,8 @@ class ServingTrace:
     built per config; the overhead bench builds its control engine
     under PADDLE_TPU_SERVING_TRACE=0)."""
 
-    def __init__(self, span_cap_=None, flight_cap_=None, enabled=None):
+    def __init__(self, span_cap_=None, flight_cap_=None, enabled=None,
+                 export_path=None):
         self.enabled = trace_enabled() if enabled is None else enabled
         self._span_cap = span_cap_
         self.flight = FlightRecorder(flight_cap_)
@@ -233,6 +249,22 @@ class ServingTrace:
         # one anchor per store: every request trace shares it, so spans
         # from the same engine are mutually ordered exactly
         self._anchor = (time.time(), time.perf_counter())
+        # file-based trace export (round 19): each finished timeline
+        # appends its chrome-trace records as JSONL lines, flushed per
+        # line — the artifact survives the owner's death.  Size-capped;
+        # strictly best-effort (an unwritable path never fails serving)
+        if export_path is None:
+            export_path = os.environ.get(TRACE_EXPORT_ENV) or None
+        self.export_path = export_path
+        self._export_file = None
+        self._export_bytes = 0
+        self.export_written = 0     # records written
+        self.export_dropped = 0     # records dropped at the size cap
+        if self.export_path:
+            try:
+                self._export_bytes = os.path.getsize(self.export_path)
+            except OSError:
+                self._export_bytes = 0
 
     # -- request lifecycle -------------------------------------------------
     def begin(self, req_id, request_id=None):
@@ -277,6 +309,8 @@ class ServingTrace:
         tr = self._requests.get(req_id)
         if tr is None:
             return None
+        if self.export_path:
+            self._export(tr)
         self._done.append(req_id)
         while len(self._done) > _KEEP_FINISHED:
             old = self._done.popleft()
@@ -291,6 +325,34 @@ class ServingTrace:
                     if not ids:
                         del self._by_request_id[str(dead.request_id)]
         return tr
+
+    # -- file export (round 19) --------------------------------------------
+    def _export(self, tr):
+        """Append one finished timeline's chrome-trace records as JSONL
+        lines (one ``ph:"X"`` event per line, the
+        :func:`chrome_trace_events` shape, so ``{"traceEvents":
+        load_trace_export(path)}`` opens in chrome://tracing).  Caller
+        holds the engine/frontend lock (finish() runs under it).  Lines
+        are flushed immediately — the file is the post-mortem artifact
+        a dead router leaves behind.  Failures are swallowed: export is
+        an observability tap, never a serving dependency."""
+        try:
+            events = chrome_trace_events([tr.to_json()])
+            payload = "".join(
+                json.dumps(ev, separators=(",", ":")) + "\n"
+                for ev in events)
+            data = payload.encode()
+            if self._export_bytes + len(data) > export_cap_bytes():
+                self.export_dropped += 1
+                return
+            if self._export_file is None:
+                self._export_file = open(self.export_path, "ab")
+            self._export_file.write(data)
+            self._export_file.flush()
+            self._export_bytes += len(data)
+            self.export_written += 1
+        except (OSError, ValueError, TypeError):
+            self.export_dropped += 1
 
     # -- query -------------------------------------------------------------
     def timelines(self, request_id=None, req_id=None):
@@ -335,6 +397,25 @@ def chrome_trace_events(timelines, pid=0, pid_name=None):
     if pid_name is not None:
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "tid": 0, "args": {"name": pid_name}})
+    return events
+
+
+def load_trace_export(path):
+    """Read a ``PADDLE_TPU_SERVING_TRACE_EXPORT`` JSONL artifact back
+    into a chrome event list.  A torn final line (the writer died
+    mid-append) is skipped, not an error — the file exists precisely
+    for post-mortems of processes that did not exit cleanly.  Wrap the
+    result as ``{"traceEvents": events}`` to open it in
+    chrome://tracing."""
+    events = []
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.endswith(b"\n"):
+                break  # torn tail: the writer died mid-line
+            try:
+                events.append(json.loads(raw))
+            except ValueError:
+                continue  # interleaved/garbled line: skip, keep reading
     return events
 
 

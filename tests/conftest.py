@@ -72,7 +72,8 @@ def _bench_artifact_guard(request):
     _replay_classes = ("TestServingReplay", "TestServerReplay",
                        "TestServingDisaggReplay", "TestServingKv8Replay",
                        "TestServingTraceReplay",
-                       "TestServingPrefixFleetReplay")
+                       "TestServingPrefixFleetReplay",
+                       "TestServingFleetReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

@@ -38,8 +38,8 @@ def rule_ids(findings):
 # rule registry sanity
 
 class TestRegistry:
-    def test_ten_rules_with_ids_and_docs(self):
-        assert len(ALL_RULES) == 10
+    def test_eleven_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 11
         for r in ALL_RULES:
             assert r.id and r.description
         assert set(RULES_BY_ID) == {
@@ -47,7 +47,7 @@ class TestRegistry:
             "jit-constant-capture", "dist-spec-passthrough",
             "chip-kill-on-timeout", "engine-lock-discipline",
             "page-migration-lock", "env-knob-registry",
-            "serving-raw-sleep"}
+            "serving-raw-sleep", "fleet-process-spawn"}
 
 
 # ---------------------------------------------------------------------------
@@ -597,6 +597,70 @@ class TestServingRawSleep:
     def test_reasoned_suppression_holds(self):
         assert lint(_SLEEP_SUPPRESSED, "paddle_tpu/serving/newloop.py",
                     "serving-raw-sleep") == []
+
+
+# ---------------------------------------------------------------------------
+# 7d. fleet-process-spawn (round 19)
+
+_SPAWN_BAD_SERVING = """
+    import subprocess
+
+    def grow(cmd):
+        # serving library code forking on its own: no readiness
+        # deadline, no restart budget, nothing reaps it
+        return subprocess.Popen(cmd)
+"""
+
+_SPAWN_BAD_TOOL = """
+    import subprocess, sys
+
+    def spawn_replica(spec):
+        # the original bug shape: a hand-rolled replica server spawn
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.fleet_worker",
+             "--spec", spec])
+"""
+
+_SPAWN_GOOD_TOOL = """
+    from paddle_tpu.serving import ProcessReplicaBackend, ReplicaSpec
+
+    def spawn_replica(role):
+        backend = ProcessReplicaBackend(ReplicaSpec())
+        return backend.provision(role)
+"""
+
+_SPAWN_UNRELATED_TOOL = """
+    import subprocess, sys
+
+    def run_bench():
+        # subprocess use that is NOT a replica server spawn passes
+        return subprocess.Popen([sys.executable, "bench_serving.py"])
+"""
+
+
+class TestFleetProcessSpawn:
+    def test_subprocess_in_serving_flags(self):
+        fs = lint(_SPAWN_BAD_SERVING, "paddle_tpu/serving/newgrow.py",
+                  "fleet-process-spawn")
+        assert len(fs) == 1
+        assert "ProcessReplicaBackend" in fs[0].message
+
+    def test_worker_spawn_in_tools_flags(self):
+        fs = lint(_SPAWN_BAD_TOOL, "tools/new_harness.py",
+                  "fleet-process-spawn")
+        assert len(fs) == 1
+
+    def test_backend_route_passes(self):
+        assert lint(_SPAWN_GOOD_TOOL, "tools/new_harness.py",
+                    "fleet-process-spawn") == []
+
+    def test_unrelated_subprocess_in_tools_passes(self):
+        assert lint(_SPAWN_UNRELATED_TOOL, "tools/new_harness.py",
+                    "fleet-process-spawn") == []
+
+    def test_backend_home_exempt(self):
+        assert lint(_SPAWN_BAD_TOOL, "paddle_tpu/serving/fleet.py",
+                    "fleet-process-spawn") == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,732 @@
+"""paddle_tpu.serving.fleet (ISSUE 12) — the crash-survivable fleet
+control plane: the CRC-framed routing journal (torn writes skipped,
+bounded rotation), crash-rebuildable router state (journal replay +
+one /healthz sweep converges a cold router to a never-crashed router's
+decisions), the RouterSupervisor's primary/standby takeover with
+token-exact client splices (greedy AND seeded-sampled, held pages
+falling to the deadline-expiry path), real process provisioning with
+liveness supervision (restart-with-backoff under a budget, kill -9
+drills, zero orphans), breaker-fed autoscaling (browning-out fleets
+grow, flapping replicas rotate out), and the file-based trace export
+that survives the exporter's death."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (ChaosConfig, FleetAutoscaler,
+                                InProcessReplica,
+                                ProcessReplicaBackend, ReplicaSpec,
+                                RouterJournal, RouterSupervisor,
+                                ServingEngine, ServingRouter,
+                                SubprocessLauncher, ThreadLauncher)
+from paddle_tpu.serving.chaos import (fleet_invariants,
+                                      verify_engine_quiescent)
+from paddle_tpu.serving.trace import (ServingTrace, load_trace_export)
+from serving_utils import wait_until
+
+VOCAB = 97
+
+
+def tiny_model(seed=0):
+    P.seed(seed)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def make_engine(seed=0, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 160)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return ServingEngine(tiny_model(seed), **kw)
+
+
+def oracle_tokens(prompts, max_new, **req_kw):
+    eng = make_engine()
+    rids = [eng.add_request(p, max_new_tokens=max_new, **req_kw)
+            for p in prompts]
+    res = eng.run()
+    return [res[r]["tokens"] for r in rids]
+
+
+def consume(stream, timeout=120):
+    return [ev["token"] for ev in stream.events(timeout=timeout)
+            if ev["type"] == "token"]
+
+
+def rng_prompts(n, seed=0, lo=5, hi=14):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# RouterJournal: CRC framing, torn writes, rotation
+
+
+class TestRouterJournal:
+    def test_append_replay_roundtrip(self, tmp_path):
+        j = RouterJournal(str(tmp_path / "j"))
+        recs = [{"ev": "place", "r": 1, "p": [1, 2, 3]},
+                {"ev": "begin", "rid": 7, "r": 0, "inner": 3},
+                {"ev": "end", "rid": 7}]
+        for r in recs:
+            j.append(r)
+        j.close()
+        assert list(j.replay()) == recs
+        assert j.torn_skipped == 0
+
+    def test_torn_write_chaos_skipped_on_replay(self, tmp_path):
+        # rate 1: EVERY record is torn mid-write; replay must skip
+        # them all (counted), never die
+        j = RouterJournal(str(tmp_path / "j"), chaos=ChaosConfig(
+            rates={"journal_torn_write": 1.0}))
+        for i in range(5):
+            j.append({"ev": "end", "rid": i})
+        j.close()
+        assert j.torn_writes == 5
+        assert list(j.replay()) == []
+        assert j.torn_skipped == 5
+
+    def test_corrupt_and_torn_tail_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = RouterJournal(path)
+        j.append({"ev": "end", "rid": 1})
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b"garbage line, no frame\n")
+        j.append({"ev": "end", "rid": 2})
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b'00000000 {"ev": "torn tail, no newli')
+        assert list(j.replay()) == [{"ev": "end", "rid": 1},
+                                    {"ev": "end", "rid": 2}]
+        assert j.torn_skipped == 2
+
+    def test_rotation_bounds_the_file_and_replays_in_order(
+            self, tmp_path):
+        path = str(tmp_path / "j")
+        j = RouterJournal(path, max_bytes=600)
+        for i in range(40):
+            j.append({"ev": "end", "rid": i})
+        j.close()
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path) <= 600
+        rids = [r["rid"] for r in j.replay()]
+        # a middle chunk fell off the rotation edge; what remains is
+        # ordered and includes the newest records
+        assert rids == sorted(rids)
+        assert rids[-1] == 39
+
+
+# ---------------------------------------------------------------------------
+# Trace export (satellite): JSONL chrome records, size cap, torn tail
+
+
+class TestTraceExport:
+    def _store(self, path, **kw):
+        tr = ServingTrace(enabled=True, export_path=path, **kw)
+        t = tr.begin(1, "req-x")
+        t.add("queued", 0.0, 0.01)
+        t.add("decode_round", 0.01, 0.02, rounds=3)
+        tr.finish(1)
+        return tr
+
+    def test_jsonl_chrome_records(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tr = self._store(path)
+        assert tr.export_written == 1 and tr.export_dropped == 0
+        events = load_trace_export(path)
+        names = {e["name"] for e in events}
+        assert {"queued", "decode_round"} <= names
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert spans and all("ts" in e and "dur" in e for e in spans)
+        # the chrome wrapper shape round-trips
+        assert json.loads(json.dumps({"traceEvents": events}))
+
+    def test_env_knob_resolution(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "env.jsonl")
+        monkeypatch.setenv("PADDLE_TPU_SERVING_TRACE_EXPORT", path)
+        tr = ServingTrace(enabled=True)
+        assert tr.export_path == path
+
+    def test_size_cap_drops_not_grows(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SERVING_TRACE_EXPORT_MB",
+                           "0.00001")  # ~10 bytes
+        path = str(tmp_path / "capped.jsonl")
+        tr = self._store(path)
+        assert tr.export_dropped == 1 and tr.export_written == 0
+        assert not os.path.exists(path) or os.path.getsize(path) == 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "torn.jsonl")
+        self._store(path)
+        before = load_trace_export(path)
+        with open(path, "ab") as f:
+            f.write(b'{"name": "the writer died mid-li')
+        after = load_trace_export(path)
+        assert after == before  # the torn tail is skipped, not fatal
+
+
+# ---------------------------------------------------------------------------
+# Crash-rebuildable router state: scripted replicas, deterministic
+
+
+class _FakeReplica:
+    """Deterministic routing target: scripted load + health, never
+    admits (the rebuild tests compare DECISIONS, not traffic)."""
+
+    def __init__(self, load=0.0, status="ok", role="mixed"):
+        self._load = load
+        self.status = status
+        self.role = role
+
+    def start(self):
+        return self
+
+    def health(self):
+        if self.status == "unreachable":
+            raise ConnectionRefusedError("scripted: unreachable")
+        return {"status": self.status, "role": self.role}
+
+    @property
+    def state(self):
+        return self.status
+
+    def load(self):
+        return self._load
+
+    def prometheus(self):
+        return ""
+
+    def drain(self, timeout=0):
+        return True
+
+    def resume(self):
+        return self
+
+    def close(self, timeout=0):
+        return True
+
+    def fail(self, exc=None):
+        self.status = "failed"
+
+    def cancel_request(self, req_id):
+        return False
+
+
+class TestRouterRebuild:
+    def _teach(self, router, trace):
+        for prompt, idx in trace:
+            router._record(np.asarray(prompt, np.int32), idx)
+
+    def test_recovered_router_matches_never_crashed_decisions(
+            self, tmp_path):
+        """The acceptance pin: after journal replay + one sweep, the
+        cold router's routing decisions equal a never-crashed router's
+        on the same request trace."""
+        def fleet():
+            return [_FakeReplica(load=5), _FakeReplica(load=2),
+                    _FakeReplica(load=9)]
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, VOCAB, 16).astype(np.int32)
+                   for _ in range(12)]
+        trace = [(prompts[i], int(rng.integers(0, 3)))
+                 for i in range(12)]
+        journal = RouterJournal(str(tmp_path / "j"))
+        a = ServingRouter(fleet(), policy="cache_aware", page_size=4,
+                          journal=journal)
+        never_crashed = ServingRouter(fleet(), policy="cache_aware",
+                                      page_size=4)
+        self._teach(a, trace)
+        self._teach(never_crashed, trace)
+        journal.close()
+        b = ServingRouter.recover(fleet(), journal,
+                                  policy="cache_aware", page_size=4)
+        for p in prompts:
+            assert b._order(p) == never_crashed._order(p)
+        # unseen prompts (pure load ordering) agree too
+        for p in rng_prompts(4, seed=9, lo=16, hi=17):
+            assert b._order(p) == never_crashed._order(p)
+
+    def test_breaker_opens_survive_recovery(self, tmp_path):
+        journal = RouterJournal(str(tmp_path / "j"))
+        a = ServingRouter([_FakeReplica(), _FakeReplica()],
+                          page_size=4, journal=journal)
+        for _ in range(3):  # default breaker_n=3 -> open, journaled
+            a._record_replica_failure(1, RuntimeError("x"))
+        assert a.breaker_state(1) in ("open", "half_open")
+        journal.close()
+        b = ServingRouter.recover([_FakeReplica(), _FakeReplica()],
+                                  journal, page_size=4)
+        assert b.breaker_state(1) in ("open", "half_open")
+        assert b.breaker_state(0) == "closed"
+        assert 1 not in b._routable()
+
+    def test_sweep_is_liveness_truth(self, tmp_path):
+        """The journal says down, the sweep says alive -> routable
+        (and vice versa): liveness is LIVE state, owned by the
+        replicas."""
+        journal = RouterJournal(str(tmp_path / "j"))
+        a = ServingRouter([_FakeReplica(), _FakeReplica()],
+                          page_size=4, journal=journal)
+        a.kill_replica(0)          # journals "down"
+        journal.close()
+        # replica 0 is healthy again by recovery time; replica 1 died
+        b = ServingRouter.recover(
+            [_FakeReplica(), _FakeReplica(status="unreachable")],
+            journal, page_size=4)
+        assert 0 in b._routable()
+        assert 1 not in b._routable()
+
+    def test_journal_from_larger_fleet_ignores_unknown_slots(
+            self, tmp_path):
+        journal = RouterJournal(str(tmp_path / "j"))
+        a = ServingRouter([_FakeReplica() for _ in range(3)],
+                          page_size=4, journal=journal)
+        self._teach(a, [(np.arange(8, dtype=np.int32), 2)])
+        a.kill_replica(2)
+        journal.close()
+        b = ServingRouter.recover([_FakeReplica(), _FakeReplica()],
+                                  journal, page_size=4)  # shrank
+        assert set(b._routable()) == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# Orphan release: a dead router's in-flight work is reaped on recovery
+
+
+class TestOrphanRelease:
+    def test_recovery_cancels_begun_unfinished_streams(self, tmp_path):
+        eng = make_engine()
+        rep = InProcessReplica(eng)
+        journal = RouterJournal(str(tmp_path / "j"))
+        a = ServingRouter([rep], policy="round_robin", page_size=4,
+                          journal=journal).start()
+        free0 = eng.cache.free_pages
+        # a prefill_only request HOLDS its pages after the first token
+        # — nothing frees them naturally, so a dead router's held
+        # request is exactly the orphan shape recovery must reap
+        stream = a.submit(np.arange(9, dtype=np.int32),
+                          max_new_tokens=8, prefill_only=True)
+        wait_until(lambda: len(eng._held) == 1, timeout=30,
+                   msg="request never reached held state")
+        assert stream is not None  # (the dead consumer's handle)
+        # the router dies without consuming: begin journaled, no end
+        a.halt()
+        journal.close()
+        b = ServingRouter.recover([rep], journal,
+                                  policy="round_robin", page_size=4)
+        wait_until(lambda: eng.cache.free_pages == free0, timeout=30,
+                   msg="orphan held pages never released")
+        assert not eng._held
+        b.drain(timeout=60)
+        verify_engine_quiescent(eng)
+
+
+# ---------------------------------------------------------------------------
+# RouterSupervisor: takeover semantics
+
+
+class TestRouterSupervisor:
+    def _fleet(self, n=2, **engine_kw):
+        engines = [make_engine(**engine_kw) for _ in range(n)]
+        return engines, [InProcessReplica(e) for e in engines]
+
+    def test_mid_stream_router_kill_is_token_exact(self, tmp_path):
+        prompts = rng_prompts(6, seed=1)
+        want = oracle_tokens(prompts, 6)
+        engines, reps = self._fleet()
+        sup = RouterSupervisor(reps,
+                               journal_path=str(tmp_path / "j"),
+                               policy="round_robin",
+                               page_size=4).start()
+        try:
+            streams = [sup.submit(p, max_new_tokens=6)
+                       for p in prompts]
+            got = [consume(s) for s in streams[:2]]
+            assert sup.kill_active(cause="test")
+            assert not sup.kill_active(cause="twice")  # idempotent
+            got += [consume(s) for s in streams[2:]]
+            assert got == want
+            assert sup.takeovers == 1 and sup.epoch == 1
+            assert sup.health()["takeovers"] == 1
+            assert "supervisor_takeovers_total 1" in sup.prometheus()
+            sup.drain(timeout=60)
+            fleet_invariants(sup.active)
+        finally:
+            sup.close(timeout=60)
+
+    def test_sampled_streams_exact_across_takeover(self, tmp_path):
+        prompts = rng_prompts(4, seed=2)
+        want = oracle_tokens(prompts, 6, do_sample=True,
+                             temperature=0.9, seed=77)
+        engines, reps = self._fleet()
+        sup = RouterSupervisor(reps,
+                               journal_path=str(tmp_path / "j"),
+                               policy="round_robin",
+                               page_size=4).start()
+        try:
+            streams = [sup.submit(p, max_new_tokens=6, do_sample=True,
+                                  temperature=0.9, seed=77)
+                       for p in prompts]
+            got = [consume(streams[0])]
+            sup.kill_active(cause="test")
+            got += [consume(s) for s in streams[1:]]
+            assert got == want
+            sup.drain(timeout=60)
+        finally:
+            sup.close(timeout=60)
+
+    def test_chaos_router_crash_point_fires_and_splices(
+            self, tmp_path):
+        prompts = rng_prompts(6, seed=3)
+        want = oracle_tokens(prompts, 6)
+        engines, reps = self._fleet()
+        # seeded: rate 0.2 over 36 token deliveries fires a.s.; the
+        # takeover-race point exercises the idempotence guard at every
+        # promotion
+        sup = RouterSupervisor(
+            reps, journal_path=str(tmp_path / "j"),
+            policy="round_robin", page_size=4,
+            chaos=ChaosConfig(seed=5, rates={
+                "router_crash": 0.2,
+                "standby_takeover_race": 1.0})).start()
+        try:
+            got = [consume(sup.submit(p, max_new_tokens=6))
+                   for p in prompts]
+            assert got == want
+            assert sup.chaos.counts["router_crash"] >= 1
+            assert sup.takeovers >= 1
+            assert sup.chaos.counts["standby_takeover_race"] \
+                == sup.takeovers
+            sup.drain(timeout=60)
+            fleet_invariants(sup.active)
+        finally:
+            sup.close(timeout=60)
+
+    def test_held_pages_fall_to_deadline_expiry_after_crash(
+            self, tmp_path):
+        engines, reps = self._fleet(n=1)
+        eng = engines[0]
+        sup = RouterSupervisor(reps,
+                               journal_path=str(tmp_path / "j"),
+                               policy="round_robin",
+                               page_size=4).start()
+        try:
+            # warm the compile caches so the deadline budget below is
+            # spent holding pages, not tracing programs
+            consume(sup.submit(np.arange(6, dtype=np.int32),
+                               max_new_tokens=2))
+            free0 = eng.cache.free_pages
+            s = sup.submit(np.arange(9, dtype=np.int32),
+                           max_new_tokens=6, prefill_only=True,
+                           deadline_s=2.0)
+            res = s.result(timeout=60)
+            assert res[0]["finish_reason"] == "prefilled"
+            assert len(eng._held) == 1
+            sup.kill_active(cause="test")
+            # nobody exports the held pages (their router is dead):
+            # the deadline-expiry sweep is the backstop
+            wait_until(lambda: eng.cache.free_pages == free0,
+                       timeout=30,
+                       msg="held pages never expired after crash")
+            assert eng.metrics.held_expired.value >= 1
+            sup.drain(timeout=60)
+            verify_engine_quiescent(eng)
+        finally:
+            sup.close(timeout=60)
+
+    def test_journal_torn_writes_do_not_break_takeover(self, tmp_path):
+        prompts = rng_prompts(4, seed=4)
+        want = oracle_tokens(prompts, 6)
+        engines, reps = self._fleet()
+        sup = RouterSupervisor(
+            reps, journal_path=str(tmp_path / "j"),
+            policy="round_robin", page_size=4,
+            chaos=ChaosConfig(seed=1, rates={
+                "journal_torn_write": 0.5})).start()
+        try:
+            got = [consume(sup.submit(p, max_new_tokens=6))
+                   for p in prompts[:2]]
+            sup.kill_active(cause="test")
+            got += [consume(sup.submit(p, max_new_tokens=6))
+                    for p in prompts[2:]]
+            assert got == want
+            assert sup.journal.torn_writes >= 1
+            sup.drain(timeout=60)
+            fleet_invariants(sup.active)
+        finally:
+            sup.close(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# ProcessReplicaBackend: supervision machinery (ThreadLauncher)
+
+
+class TestProcessBackend:
+    def _backend(self, **kw):
+        kw.setdefault("launcher", ThreadLauncher())
+        kw.setdefault("startup_s", 60)
+        kw.setdefault("supervise_interval_s", 3600)  # manual passes
+        return ProcessReplicaBackend(ReplicaSpec(), **kw)
+
+    def test_provision_ready_and_routable(self):
+        backend = self._backend()
+        try:
+            rep = backend.provision("mixed")
+            assert rep.health()["status"] == "ok"
+            assert rep.role == "mixed"
+            assert backend.stats()["live"] == 1
+        finally:
+            assert backend.close()
+
+    def test_kill_restart_within_budget(self):
+        backend = self._backend(restart_budget=2)
+        try:
+            rep = backend.provision("mixed")
+            port0 = rep.port
+            assert backend.kill_replica_process(rep)
+            assert rep.health()["status"] != "ok"
+            backend.supervise_once()
+            wait_until(lambda: rep.health().get("status") == "ok",
+                       timeout=60, msg="replica never restarted")
+            assert rep.restarts == 1
+            assert rep.port != port0  # a NEW life on a new port
+        finally:
+            assert backend.close()
+
+    def test_restart_budget_exhaustion_marks_permanent(self):
+        backend = self._backend(restart_budget=0)
+        try:
+            rep = backend.provision("mixed")
+            backend.kill_replica_process(rep)
+            backend.supervise_once()
+            assert rep.failed_permanently
+            assert backend.perm_failures == 1
+            backend.supervise_once()  # stays failed, no flapping
+            assert backend.stats()["perm_failures"] == 1
+        finally:
+            assert backend.close()
+
+    def test_chaos_proc_kill_point_drives_restart(self):
+        backend = self._backend(
+            restart_budget=4,
+            chaos=ChaosConfig(seed=0,
+                              rates={"replica_proc_kill": 1.0},
+                              retry_base_s=0.001, retry_max_s=0.01))
+        try:
+            rep = backend.provision("mixed")
+            backend.supervise_once()  # kill fires, restart follows
+            assert backend.chaos.counts["replica_proc_kill"] == 1
+            wait_until(lambda: rep.health().get("status") == "ok",
+                       timeout=60, msg="chaos-killed replica never "
+                       "restarted")
+            assert backend.restarts == 1
+        finally:
+            assert backend.close()
+
+    def test_close_reaps_everything(self):
+        backend = self._backend()
+        reps = [backend.provision("mixed") for _ in range(2)]
+        assert backend.stats()["live"] == 2
+        assert backend.close()
+        assert backend.live_pids() == []
+        for rep in reps:
+            assert rep.health()["status"] != "ok"
+
+
+@pytest.mark.slow
+class TestProcessBackendSubprocess:
+    """The real thing: one actual replica server process (spawn,
+    /healthz readiness, SIGKILL, supervised restart, reap).  The
+    tier-1 real-process path is tools/fleet_smoke.sh; this is the
+    in-suite deep check."""
+
+    def test_spawn_kill_restart_reap(self, tmp_path):
+        backend = ProcessReplicaBackend(
+            ReplicaSpec(model={"seed": 0},
+                        engine={"num_pages": 120}),
+            launcher=SubprocessLauncher(log_dir=str(tmp_path)),
+            startup_s=90, restart_budget=1,
+            supervise_interval_s=0.2)
+        try:
+            rep = backend.provision("mixed")
+            pid0 = rep.pid
+            assert isinstance(pid0, int) and pid0 > 0
+            h = rep.health()
+            assert h["status"] == "ok" and h["pid"] == pid0
+            router = ServingRouter([rep], policy="round_robin",
+                                   page_size=4,
+                                   probe_interval_s=0.1).start()
+            toks = consume(router.submit(np.arange(8, dtype=np.int32),
+                                         max_new_tokens=4))
+            assert len(toks) == 4
+            assert backend.kill_replica_process(rep)
+            wait_until(lambda: rep.health().get("status") == "ok",
+                       timeout=90, msg="process never restarted")
+            assert rep.pid != pid0
+            # the prober readmits the slot; the restarted server is
+            # deterministic (same spec, same weights)
+            wait_until(lambda: 0 in router._routable(), timeout=30,
+                       msg="router never readmitted the slot")
+            toks2 = consume(router.submit(
+                np.arange(8, dtype=np.int32), max_new_tokens=4))
+            assert toks2 == toks
+        finally:
+            assert backend.close()
+            assert backend.live_pids() == []
+
+
+# ---------------------------------------------------------------------------
+# Breaker-fed autoscaling + drain-by-health rotation
+
+
+class TestBreakerFedAutoscale:
+    def _rig(self, n=2, **kw):
+        router = ServingRouter([_FakeReplica(load=1.0)
+                                for _ in range(n)],
+                               policy="round_robin", page_size=4)
+        clock = [0.0]
+        made = []
+
+        def factory(role):
+            made.append(role)
+            return _FakeReplica(load=0.0, role=role)
+
+        kw.setdefault("up_window_s", 4.0)
+        kw.setdefault("down_window_s", 1e9)
+        kw.setdefault("max_per_role", 8)
+        aut = FleetAutoscaler(router, factory,
+                              clock=lambda: clock[0], **kw)
+        return router, aut, clock, made
+
+    def test_open_breakers_are_pressure(self):
+        router, aut, clock, made = self._rig(breaker_frac=0.34,
+                                             shed_window_n=0)
+        for _ in range(3):
+            router._record_replica_failure(1, RuntimeError("x"))
+        assert router.breaker_state(1) in ("open", "half_open")
+        frac, _ = aut.fleet_pressure()
+        assert frac == pytest.approx(0.5)
+        assert aut.tick() == []          # hysteresis holds
+        clock[0] += 5.0
+        events = aut.tick()              # sustained -> grow
+        assert ("up", "mixed", 2) in events
+        assert made == ["mixed"]
+
+    def test_shed_delta_is_pressure(self):
+        router, aut, clock, made = self._rig(breaker_frac=0.0,
+                                             shed_window_n=3)
+        router.metrics.router_shed_total.inc(3)
+        assert aut.tick() == []
+        clock[0] += 5.0
+        router.metrics.router_shed_total.inc(3)  # still shedding
+        assert ("up", "mixed", 2) in aut.tick()
+
+    def test_healthy_idle_fleet_never_grows(self):
+        router, aut, clock, made = self._rig()
+        for _ in range(4):
+            clock[0] += 10.0
+            assert aut.tick() == []
+        assert made == []
+
+    def test_flapper_rotated_out_replacement_first(self):
+        router, aut, clock, made = self._rig(flap_opens=2,
+                                             breaker_frac=0.0,
+                                             shed_window_n=0)
+        breaker = router._breakers[0]
+        for _ in range(2):
+            breaker.force_open()     # two opens: a flapper
+        events = aut.tick()
+        assert ("rotate", "mixed", 0) in events
+        assert made == ["mixed"]         # replacement provisioned
+        assert 0 in router._retired      # flapper drained out
+        assert 2 in router._routable()
+        # the rotation is once-per-flap-budget, not every tick
+        assert all(e[0] != "rotate" for e in aut.tick())
+
+    def test_failed_factory_aborts_rotation(self):
+        router, aut, clock, made = self._rig(flap_opens=1,
+                                             breaker_frac=0.0,
+                                             shed_window_n=0)
+        aut.factory = lambda role: (_ for _ in ()).throw(
+            RuntimeError("no capacity"))
+        router._breakers[0].force_open()
+        assert aut.tick() == []
+        assert 0 not in router._retired  # flapper keeps limping
+
+    def test_backend_as_factory(self):
+        backend = ProcessReplicaBackend(
+            ReplicaSpec(), launcher=ThreadLauncher(), startup_s=60,
+            supervise_interval_s=3600)
+        try:
+            router = ServingRouter([_FakeReplica()], page_size=4)
+            aut = FleetAutoscaler(router, backend=backend,
+                                  min_per_role={"mixed": 2},
+                                  max_per_role=4)
+            events = aut.tick()          # below floor: repair now
+            assert ("up", "mixed", 1) in events
+            assert backend.stats()["live"] == 1
+            assert router.replicas[1].health()["status"] == "ok"
+        finally:
+            assert backend.close()
+
+    def test_needs_factory_or_backend(self):
+        router = ServingRouter([_FakeReplica()], page_size=4)
+        with pytest.raises(ValueError, match="factory or a backend"):
+            FleetAutoscaler(router)
+
+    def test_supervisor_active_resolved_per_tick(self, tmp_path):
+        eng = make_engine()
+        sup = RouterSupervisor([InProcessReplica(eng)],
+                               journal_path=str(tmp_path / "j"),
+                               policy="round_robin", page_size=4)
+        sup.start()
+        try:
+            aut = FleetAutoscaler(sup, lambda role: _FakeReplica())
+            first = aut._router()
+            sup.kill_active(cause="test")
+            sup._ensure_active()
+            assert aut._router() is sup.active
+            assert aut._router() is not first
+            aut.tick()                   # polices the NEW router
+        finally:
+            sup.close(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# The harness replay (slow): SLO gate green end-to-end
+
+
+@pytest.mark.slow
+class TestServingFleetReplay:
+    def test_fleet_harness_smoke_gate_passes(self):
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "tools/fleet_harness.py", "--smoke",
+             "--json"],
+            cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        out, _ = proc.communicate(timeout=420)
+        assert proc.returncode == 0
+        report = json.loads(out)
+        gate = report["slo_gate"]
+        assert gate["pass"], gate
+        assert gate["zero_lost_streams"]
+        assert gate["zero_leaked_processes"]
+        assert report["scale_replay"]["takeovers"] >= 1

@@ -15,6 +15,7 @@ import numpy as np
 import paddle_tpu as P
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import ServingEngine, ServingServer
+from serving_utils import wait_until
 
 
 def tiny_model(seed=0, **kw):
@@ -98,11 +99,9 @@ class TestKeepalive:
             # response object holds the socket fd via sock.makefile
             r.close()
             c.close()
-            deadline = time.time() + 30
-            while time.time() < deadline and not (
-                    eng.metrics.cancellations.value
-                    and eng.cache.free_pages == free0):
-                time.sleep(0.05)
+            wait_until(lambda: eng.metrics.cancellations.value
+                       and eng.cache.free_pages == free0,
+                       msg="disconnect-cancel never landed")
             assert eng.metrics.cancellations.value == 1
             assert eng.cache.free_pages == free0      # pages freed
             assert eng.scheduler.all_done()           # queues purged

@@ -19,6 +19,7 @@ import paddle_tpu as P
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import ServingEngine, ServingServer
 from serving_utils import wait_until
+from serving_utils import wait_until
 
 
 def tiny_model(seed=0, **kw):
@@ -202,11 +203,9 @@ class TestCancellation:
                     seen += 1
             r.close()  # hang up mid-decode (closes the socket fd)
             c.close()
-            deadline = time.time() + 30
-            while time.time() < deadline and not (
-                    eng.metrics.cancellations.value
-                    and eng.cache.free_pages == free0):
-                time.sleep(0.05)
+            wait_until(lambda: eng.metrics.cancellations.value
+                       and eng.cache.free_pages == free0,
+                       msg="disconnect-cancel never landed")
             assert eng.metrics.cancellations.value == 1
             assert eng.cache.free_pages == free0  # allocator restored
             (res,) = eng.results().values()
@@ -299,14 +298,13 @@ class TestDrain:
             td.start()
             # drain must grab the engine lock behind an in-flight step
             # (50 ms each), so poll instead of racing a fixed sleep
-            deadline = time.time() + 15
-            status = None
-            while time.time() < deadline and status != "draining":
+            def _draining():
                 st, _, data = _get(host, port, "/healthz")
                 assert st == 200
-                status = json.loads(data)["status"]
-                time.sleep(0.02)
-            assert status == "draining"
+                return json.loads(data)["status"] == "draining"
+
+            wait_until(_draining, timeout=15,
+                       msg="healthz never reported draining")
             st, _, data = _post(host, port, "/v1/completions",
                                 {"prompt": [9], "max_tokens": 2})
             assert st == 503

@@ -18,6 +18,10 @@ bash tools/lint.sh || exit 1
 # mixed fleet, global recovery invariants asserted — runtime-bounded
 # so the pytest window stays intact.
 bash tools/chaos_smoke.sh || exit 1
+# fleet smoke (ISSUE 12): process-backed fleet + router takeover under
+# kills, SLO-gated (zero lost streams / zero leaked processes) —
+# runtime-bounded, CPU-only.
+bash tools/fleet_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
