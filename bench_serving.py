@@ -6,7 +6,20 @@ Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--smoke] [--server] [--shared-prefix]
                                [--router] [--spec] [--disagg] [--kv8]
                                [--trace] [--trace-out FILE]
-                               [--prefix-fleet]
+                               [--prefix-fleet] [--kvtier]
+
+`--kvtier` measures the round-20 hierarchical KV tier: a round-robin
+revisit schedule over MORE distinct long-prompt chains than the device
+page pool holds (every revisit finds its prefix pages LRU-evicted), on
+a prefill-heavy model (h256/L4 — the round-18 lesson: at h128 a
+prefill chunk costs about a page copy and restore-vs-recompute
+measures nothing). The same trace replays at ≥3 host-pool sizes
+INCLUDING pool=0 (the tierless recompute baseline) plus a
+RAM+disk point; per size the artifact records revisit-TTFT
+percentiles, the tier hit rate, and spill/restore/demotion counters.
+The acceptance gate (asserted on quiet-VM non-smoke runs): the
+full-coverage pool's revisit TTFT p50 beats the pool=0 recompute
+baseline. Banks BENCH_serving_kvtier.json.
 
 `--prefix-fleet` measures the round-18 fleet-wide prefix cache: the
 shared-prefix workload through a 2-replica fleet in three configs —
@@ -150,6 +163,9 @@ if trace_mode:
 prefix_fleet_mode = "--prefix-fleet" in sys.argv
 if prefix_fleet_mode:
     sys.argv.remove("--prefix-fleet")
+kvtier_mode = "--kvtier" in sys.argv
+if kvtier_mode:
+    sys.argv.remove("--kvtier")
 trace_out = None
 if "--trace-out" in sys.argv:
     i = sys.argv.index("--trace-out")
@@ -322,6 +338,9 @@ def main():
         return
     if prefix_fleet_mode:
         _bench_prefix_fleet(cfg, engine_kw, on_tpu)
+        return
+    if kvtier_mode:
+        _bench_kvtier(on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -1307,6 +1326,161 @@ def _bench_kv8(on_tpu):
     line = json.dumps(out)
     print(line)
     with open("BENCH_serving_kv8.json", "w") as f:
+        f.write(line + "\n")
+
+
+def _bench_kvtier(on_tpu):
+    """Hierarchical KV tier (round 20): revisit-TTFT and hit rate vs
+    host-pool size. A round-robin schedule over more long-prompt
+    chains than the device pool holds guarantees every revisit finds
+    its prefix LRU-evicted; the pool=0 engine recomputes the prefill,
+    a tiered engine restores the spilled pages through the fused
+    import path. One JSON line -> BENCH_serving_kvtier.json; on
+    non-smoke runs asserts restore beats recompute on revisit TTFT
+    p50."""
+    import tempfile
+
+    import paddle_tpu as P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (DiskPagePool, HostPagePool,
+                                    ServingEngine)
+
+    # prefill-heavy shape (round-18 lesson: h128 prefill chunks cost
+    # about a page copy, so restore-vs-recompute measures nothing
+    # there); page bytes at h256/L4/page16 fp32 ~= 128 KB
+    page_size = 16
+    if smoke:
+        n_chains, rounds, prompt_pages, new_toks = 4, 2, 6, 4
+        num_pages = 16   # 15 usable: ~2 chains resident, 4 thrash
+        pool_sizes = [0, 1, 8]
+        disk_point = (1, 16)  # (host MB, disk MB)
+    else:
+        n_chains, rounds, prompt_pages, new_toks = 6, 3, 14, 8
+        num_pages = 40   # ~2.5 chains resident, 6 thrash
+        pool_sizes = [0, 4, 24]
+        disk_point = (2, 32)
+    prompt_len = prompt_pages * page_size
+    maxlen = prompt_len + new_toks + 1
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256,
+                      intermediate_size=512, num_hidden_layers=4,
+                      num_attention_heads=4,
+                      max_position_embeddings=maxlen)
+    P.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(7)
+    chains = [rng.integers(0, cfg.vocab_size, prompt_len)
+              .astype(np.int32) for _ in range(n_chains)]
+    engine_kw = dict(page_size=page_size, num_pages=num_pages,
+                     max_batch=2, prefill_chunk=32, max_seq_len=maxlen,
+                     prefix_cache=True)
+
+    def serve_one(eng, prompt):
+        """One sequential request; returns client TTFT (the engine is
+        otherwise idle, so the first token event is ours)."""
+        sub = time.perf_counter()
+        eng.add_request(prompt, max_new_tokens=new_toks)
+        ttft = None
+        while not eng.scheduler.all_done():
+            for ev in eng.step():
+                if ev["type"] == "token" and ttft is None:
+                    ttft = time.perf_counter() - sub
+        return ttft
+
+    def measure(host_mb, disk_mb=0, disk_dir=None):
+        pool = None
+        if host_mb:
+            disk = (DiskPagePool(disk_dir, budget_bytes=disk_mb << 20)
+                    if disk_mb else None)
+            pool = HostPagePool(budget_bytes=host_mb << 20, disk=disk)
+        eng = ServingEngine(model, host_pool=pool, **engine_kw)
+        # compile off the clock: prefill+decode, then (tiered only)
+        # the fused spill-export / restore-import program classes —
+        # force the warm chain through a full evict->restore cycle
+        warm_p = rng.integers(0, cfg.vocab_size, prompt_len) \
+            .astype(np.int32)
+        serve_one(eng, warm_p)
+        if pool is not None:
+            while eng.cache._evict_lru_leaf():
+                pass
+            eng.kvtier.flush()
+            eng.restore_prefix(warm_p)
+            serve_one(eng, warm_p)
+            pool.clear()
+            eng.cache.clear_prefix()
+            serve_one(eng, warm_p)  # re-populate so configs match
+        m = eng.metrics
+        base = {n: getattr(m, n).value for n in
+                ("tier_restore_hits", "tier_restore_misses",
+                 "tier_restore_pages", "tier_spill_pages",
+                 "prefix_hit_pages")}
+        t0 = time.perf_counter()
+        ttfts = []  # revisit rounds only (round 0 populates, cold)
+        for r in range(rounds):
+            for c in chains:
+                ttft = serve_one(eng, c)
+                if r > 0:
+                    ttfts.append(ttft)
+        wall = time.perf_counter() - t0
+        delta = {n: getattr(m, n).value - v for n, v in base.items()}
+        hits = delta["tier_restore_hits"]
+        misses = delta["tier_restore_misses"]
+        tt = sorted(t for t in ttfts if t is not None)
+        rec = {
+            "host_pool_mb": host_mb,
+            "disk_pool_mb": disk_mb,
+            "revisits": len(ttfts),
+            "ttft_revisit_p50_s": (round(tt[len(tt) // 2], 4)
+                                   if tt else None),
+            "ttft_revisit_p90_s": (round(tt[int(len(tt) * 0.9)], 4)
+                                   if tt else None),
+            "wall_s": round(wall, 3),
+            "tier_restore_hits": hits,
+            "tier_restore_misses": misses,
+            "tier_hit_rate": (round(hits / (hits + misses), 3)
+                              if hits + misses else None),
+            "tier_restore_pages": delta["tier_restore_pages"],
+            "tier_spill_pages": delta["tier_spill_pages"],
+            "prefix_hit_pages": delta["prefix_hit_pages"],
+        }
+        if pool:
+            rec["pool"] = pool.stats()
+            pool.clear()
+        return rec
+
+    pools = [measure(mb) for mb in pool_sizes]
+    with tempfile.TemporaryDirectory(prefix="pdtpu_kvtier_") as d:
+        pools.append(measure(disk_point[0], disk_point[1], d))
+
+    base = pools[0]
+    warm = [p for p in pools[1:] if p["tier_restore_pages"] > 0]
+    best = min(warm, key=lambda p: p["ttft_revisit_p50_s"] or 1e9) \
+        if warm else None
+    speedup = (round(base["ttft_revisit_p50_s"]
+                     / best["ttft_revisit_p50_s"], 3)
+               if best and best["ttft_revisit_p50_s"] else None)
+    assert warm, "no pool size ever restored — thrash sizing broken"
+    if not smoke:
+        # the acceptance gate: a host-tier restore must beat the
+        # recompute the engine would otherwise have done (quiet VM)
+        assert speedup and speedup > 1.0, (base, best)
+
+    out = {
+        "metric": "serving_kvtier_ttft_restore_speedup"
+                  + ("" if on_tpu else "_cpu"),
+        "value": speedup,
+        "unit": "x revisit-TTFT p50 vs the pool=0 recompute baseline "
+                f"({n_chains} chains x {prompt_pages} pages thrashing "
+                f"a {num_pages}-page device pool)",
+        "n_chains": n_chains, "rounds": rounds,
+        "prompt_len": prompt_len, "page_size": page_size,
+        "num_pages": num_pages, "max_new_tokens": new_toks,
+        "pools": pools,
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    with open("BENCH_serving_kvtier.json", "w") as f:
         f.write(line + "\n")
 
 

@@ -13,6 +13,7 @@ from .dist_spec import DistSpecPassthrough
 from .env_knobs import EnvKnobRegistry
 from .fleet_spawn import FleetProcessSpawn
 from .jit_capture import JitConstantCapture
+from .kvtier_access import KvtierBlessedAccess
 from .pallas import PallasHazards
 from .serving_lock import EngineLockDiscipline, PageMigrationLock
 from .subprocess_chip import ChipKillOnTimeout
@@ -29,6 +30,7 @@ ALL_RULES = [
     EnvKnobRegistry(),
     ServingRawSleep(),
     FleetProcessSpawn(),
+    KvtierBlessedAccess(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
@@ -37,4 +39,5 @@ __all__ = ["ALL_RULES", "RULES_BY_ID", "AutogradBypass",
            "ThreadGradState", "PallasHazards", "JitConstantCapture",
            "DistSpecPassthrough", "ChipKillOnTimeout",
            "EngineLockDiscipline", "PageMigrationLock",
-           "EnvKnobRegistry", "ServingRawSleep", "FleetProcessSpawn"]
+           "EnvKnobRegistry", "ServingRawSleep", "FleetProcessSpawn",
+           "KvtierBlessedAccess"]

@@ -1,0 +1,84 @@
+"""kvtier-blessed-access (round 20): page-pool payload movement and
+pool internals belong to :mod:`paddle_tpu.serving.kvtier`.
+
+The host/disk page pools store PAGEWIRE payloads keyed by token-chain
+bytes; the geometry metadata, CRC validation, chain-walk semantics and
+the spill dedup all live in ``KVTier`` (spill/flush/restore/prewarm/
+invalidate).  Library code that calls ``pool.put``/``get``/``pop``
+directly bypasses every one of those — a raw put drops the geometry
+meta a restore needs, a raw get skips the corrupt-entry disposal path,
+and both skirt the tier's best-effort error contract.  Reaching into
+``pool._entries``/``pool._lock`` from outside kvtier.py breaks the
+LRU/accounting invariants the cross-tier conservation check audits.
+
+Blessed for everyone: constructing pools, the ``KVTier`` entry points,
+and the read-only/lifecycle surface — ``stats``/``snapshot``/
+``contains``/``hottest``/``clear``/``pages``/``budget_bytes``
+(``snapshot`` is what chaos' ``verify_tier_conservation`` audits
+against).  Tests construct and poke pools directly and are out of
+scope, like the engine-lock rule."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+# the pool implementation itself (internals + put/get/pop are its own)
+_ALLOWED_FILES = {
+    "paddle_tpu/serving/kvtier.py",
+}
+# payload movement: only KVTier's spill/restore/prewarm may call these
+_POOL_MUTATORS = {"put", "get", "pop"}
+# receiver-name heuristic, same shape as the engine-lock rule: a pool
+# object is named after what it is at every real call site
+_POOL_RECEIVERS = ("pool", "host_pool", "_pool", "page_pool", "disk",
+                   "_disk", "kvtier", "_tier", "tier")
+
+
+def _pool_parts(node):
+    recv = dotted_name(node) or ""
+    return [p for p in recv.split(".") if p in _POOL_RECEIVERS], recv
+
+
+class KvtierBlessedAccess(Rule):
+    """Direct pool payload mutation or pool-internals access outside
+    kvtier.py.
+
+    Route spills/restores through ``KVTier`` (or the engine/front-end
+    wrappers above it); read occupancy through ``stats()``/
+    ``snapshot()``/``contains()``."""
+
+    id = "kvtier-blessed-access"
+    description = ("direct HostPagePool/DiskPagePool put/get/pop or "
+                   "_internals outside kvtier.py bypass the tier's "
+                   "geometry/CRC/best-effort contract")
+
+    def applies(self, ctx):
+        return ((ctx.relpath.startswith("paddle_tpu/")
+                 or ctx.relpath.startswith("tools/"))
+                and ctx.relpath not in _ALLOWED_FILES)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr in _POOL_MUTATORS:
+                parts, recv = _pool_parts(node.value)
+                if parts:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"direct `{recv}.{node.attr}()` outside "
+                        "kvtier.py — page payloads must move through "
+                        "KVTier.spill/restore/prewarm (geometry meta, "
+                        "CRC disposal, best-effort contract); read "
+                        "through stats()/snapshot()/contains()")
+            elif node.attr.startswith("_"):
+                parts, recv = _pool_parts(node.value)
+                if parts:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"pool internals access `{recv}.{node.attr}` "
+                        "outside kvtier.py — LRU order and byte "
+                        "accounting are the tier's own (the cross-tier "
+                        "conservation check audits them); use the "
+                        "blessed read-only surface")

@@ -31,6 +31,10 @@ _ENGINE_METHODS = {"step", "cancel"}
 # device buffers and radix tree, so they ride the same lock contract.
 _MIGRATION_FILES = _ALLOWED_FILES | {
     "paddle_tpu/serving/kv_cache.py",  # the allocator itself
+    "paddle_tpu/serving/kvtier.py",    # host-tier restore (round 20):
+    # KVTier.restore re-enters through import_prefix_pages and is only
+    # reachable via engine.restore_prefix / add_request, both under
+    # the engine lock (kvtier-blessed-access guards the pool side)
 }
 _MIGRATION_METHODS = {"import_pages", "export_pages", "adopt_request",
                       "export_request", "release_request",

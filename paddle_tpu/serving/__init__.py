@@ -112,6 +112,23 @@ Layers:
   scale: ``tools/fleet_harness.py`` (bursty/diurnal traffic + seeded
   concurrent chaos, SLO-gated, ``BENCH_serving_fleet.json``).
 
+- :mod:`kvtier` — hierarchical KV-cache tiers (round 20): a
+  byte-budgeted LRU ``HostPagePool`` (``PADDLE_TPU_SERVING_HOST_POOL_
+  MB``) with an optional file-backed ``DiskPagePool`` under it, bound
+  behind ``PagedKVCache`` via ``attach_tier``.  rc-0 cached pages
+  evicted by allocation pressure spill their pagewire payload (int8
+  codes+scales ride intact) to the host tier at step boundaries; a
+  prefix probe that misses device pages but hits the tier restores
+  them through the same fused gather/scatter import path as a remote
+  ship (pages re-enter CACHED at rc==0, so the shed gate's
+  probe-based accounting covers them with no new case).  Probe order:
+  local device → local host tier → remote donor → recompute.
+  Strictly best-effort: spill/restore failures, dtype/geometry skew,
+  CRC-caught bit-rot (the pagewire payload checksum), and capacity
+  sheds all degrade to the recompute the engine would have done
+  anyway.  The autoscaler pre-warms freshly grown replicas from the
+  hottest spilled chains (``prewarm_prefix``).
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
@@ -131,6 +148,8 @@ from .frontend import (Rejected, RequestStream,  # noqa: F401
                        ServingFrontend, Unavailable)
 from .kv_cache import (SCRATCH_PAGE, GeometryMismatch,  # noqa: F401
                        OutOfPages, PagedKVCache, PrefixDrift)
+from .kvtier import (DiskPagePool, HostPagePool,  # noqa: F401
+                     KVTier, chain_key, host_pool_from_env)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       LabeledCounter, ServingMetrics)
 from .pagewire import (WireFormatError, deserialize_pages,  # noqa: F401
@@ -166,4 +185,6 @@ __all__ = [
     "ProcessReplica", "ProcessReplicaBackend", "ReplicaSpec",
     "RouterCrashed", "RouterJournal", "RouterSupervisor",
     "SubprocessLauncher", "ThreadLauncher",
+    "DiskPagePool", "HostPagePool", "KVTier", "chain_key",
+    "host_pool_from_env",
 ]

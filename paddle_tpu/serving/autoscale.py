@@ -335,7 +335,29 @@ class FleetAutoscaler:
         router.metrics.autoscale_events.inc(direction="up", role=role)
         _log.info(json.dumps({"event": "autoscale_up", "role": role,
                               "replica": i}))
+        self._prewarm(replica, i)
         return i
+
+    def _prewarm(self, replica, idx):
+        """Hierarchical KV tier (round 20): a freshly grown replica
+        starts with a cold device tree, but if its engine shares (or
+        inherited) a host pool the hottest spilled chains can be
+        restored BEFORE traffic lands.  Strictly best-effort — a
+        tierless/older replica or any failure is simply a cold start,
+        which is what scale-up meant before tiers existed."""
+        fn = getattr(replica, "prewarm_prefix", None)
+        if fn is None:
+            return
+        try:
+            restored = int(fn())
+        except Exception:
+            return
+        if restored:
+            router = self._router()
+            router.metrics.prewarm_restored_pages_total.inc(restored)
+            _log.info(json.dumps({"event": "autoscale_prewarm",
+                                  "replica": idx,
+                                  "pages": restored}))
 
     def _scale_down(self, role, i):
         # rolling drain: zero lost requests, zero 5xx — retire blocks

@@ -60,7 +60,8 @@ import numpy as np
 
 __all__ = ["Backoff", "ChaosConfig", "ChaosInjector", "CircuitBreaker",
            "FAULT_POINTS", "fleet_invariants",
-           "verify_engine_quiescent", "verify_page_conservation"]
+           "verify_engine_quiescent", "verify_page_conservation",
+           "verify_tier_conservation"]
 
 _log = logging.getLogger("paddle_tpu.serving")
 
@@ -99,6 +100,15 @@ FAULT_POINTS = (
     #                          (supervision restarts within budget)
     "journal_torn_write",    # journal: a record is torn mid-write
     #                          (replay must skip it, not die)
+    # hierarchical KV tiers (round 20): faults on the host/disk spill
+    # and restore paths — strictly best-effort, every one must degrade
+    # to the eviction/recompute the engine would have done anyway
+    "tier_spill_fail",       # kvtier: a deferred spill is dropped
+    #                          (page evicts uncached, as before tiers)
+    "tier_restore_fail",     # kvtier: a restore probe dies -> miss
+    "tier_slow_io",          # kvtier: spill/restore I/O latency
+    "tier_corrupt_payload",  # kvtier: at-rest bit-rot — the pagewire
+    #                          CRC must catch it, entry dropped
 )
 
 # legacy aliases (round 9/11 knobs) folded into the unified config
@@ -167,7 +177,8 @@ class ChaosConfig:
     object."""
 
     def __init__(self, *, seed=0, rates=None, step_latency_s=0.0,
-                 slow_read_s=0.0, escalate_n=0, router_kill=None,
+                 slow_read_s=0.0, tier_slow_io_s=0.0, escalate_n=0,
+                 router_kill=None,
                  alloc_pressure_frac=0.5, alloc_pressure_steps=4,
                  retry_max=3, retry_base_s=0.05, retry_max_s=2.0,
                  breaker_n=3, breaker_cooldown_s=5.0):
@@ -180,6 +191,9 @@ class ChaosConfig:
                     f"{FAULT_POINTS}")
         self.step_latency_s = float(step_latency_s)
         self.slow_read_s = float(slow_read_s)
+        # duration the tier_slow_io point sleeps when it fires (the
+        # spill/restore analogue of slow_read_s)
+        self.tier_slow_io_s = float(tier_slow_io_s)
         self.escalate_n = int(escalate_n)
         self.router_kill = router_kill  # (replica_idx, after_tokens)
         self.alloc_pressure_frac = float(alloc_pressure_frac)
@@ -459,6 +473,51 @@ def verify_page_conservation(cache, what="cache"):
         assert cache.refcount(p) == rc.get(p, 0), (
             f"{what}: page {p} refcount {cache.refcount(p)} != "
             f"{rc.get(p, 0)} mapping sequences")
+    tier = getattr(cache, "_tier", None)
+    if tier is not None:
+        verify_tier_conservation(tier, what=f"{what}.tier")
+
+
+def verify_tier_conservation(tier, what="tier"):
+    """Host/disk tier invariants (round 20): the RAM pool's byte
+    accounting matches its entries and stays under budget, disk files
+    exist on disk at exactly their recorded sizes, and no chain key is
+    double-resident (RAM and disk at once — a restore would be
+    ambiguous and the bytes double-counted).  Spilled pages are COPIES
+    of device pages, so device-side conservation is untouched by the
+    tier; this check covers the tier's own ledger.  Works off the
+    pool's :meth:`snapshot` view so it never reaches into pool
+    internals (graftlint ``kvtier-blessed-access``)."""
+    snap = tier.pool.snapshot()
+    ram_keys = {k for k, _ in snap["entries"]}
+    ram_bytes = sum(n for _, n in snap["entries"])
+    assert ram_bytes == snap["bytes_used"], (
+        f"{what}: host pool bytes_used={snap['bytes_used']} but "
+        f"entries sum to {ram_bytes}")
+    assert snap["bytes_used"] <= snap["budget_bytes"], (
+        f"{what}: host pool over budget — "
+        f"{snap['bytes_used']} > {snap['budget_bytes']}")
+    disk = snap["disk"]
+    if disk is not None:
+        disk_keys = {k for k, _, _ in disk["entries"]}
+        assert not (ram_keys & disk_keys), (
+            f"{what}: {len(ram_keys & disk_keys)} chain(s) resident in "
+            "BOTH the RAM and disk tiers")
+        disk_bytes = 0
+        for _, path, nbytes in disk["entries"]:
+            assert os.path.isfile(path), (
+                f"{what}: disk tier entry file missing: {path}")
+            actual = os.path.getsize(path)
+            assert actual == nbytes, (
+                f"{what}: disk entry {path} is {actual} byte(s), "
+                f"ledger says {nbytes}")
+            disk_bytes += nbytes
+        assert disk_bytes == disk["bytes_used"], (
+            f"{what}: disk pool bytes_used={disk['bytes_used']} but "
+            f"entries sum to {disk_bytes}")
+        assert disk["bytes_used"] <= disk["budget_bytes"], (
+            f"{what}: disk pool over budget — "
+            f"{disk['bytes_used']} > {disk['budget_bytes']}")
 
 
 def verify_engine_quiescent(engine, what="engine",

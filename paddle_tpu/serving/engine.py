@@ -103,6 +103,7 @@ import numpy as np
 
 from .chaos import ChaosConfig, ChaosInjector
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
+from .kvtier import KVTier, host_pool_from_env
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
 from .trace import ServingTrace
@@ -171,7 +172,7 @@ class ServingEngine:
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
                  cache_dtype=None, on_event=None, prefix_cache=None,
                  draft_model=None, speculative_k=None,
-                 weight_quant=None, chaos=None):
+                 weight_quant=None, chaos=None, host_pool=None):
         cfg, core = self._validate_causal_lm(model)
         if weight_quant is None:
             weight_quant = os.environ.get(
@@ -304,6 +305,20 @@ class ServingEngine:
             self.chaos = ChaosInjector(chaos, name="engine")
         self.chaos.bind(self.trace)
         self._chaos_spike = None  # (seq_id, steps_left) alloc pressure
+        # hierarchical KV tier (round 20): host-RAM/disk page pools
+        # behind the prefix cache.  ``host_pool=`` injects a (possibly
+        # engine-shared) kvtier.HostPagePool; None resolves the
+        # PADDLE_TPU_SERVING_HOST_POOL_* knobs.  Meaningless without
+        # the prefix cache — nothing ever spills from a tree that
+        # doesn't exist — so it is quietly absent there.
+        if host_pool is None:
+            host_pool = host_pool_from_env()
+        if host_pool is not None and self.cache.prefix_cache_enabled:
+            self.kvtier = KVTier(host_pool, chaos=self.chaos,
+                                 metrics=self.metrics, trace=self.trace)
+            self.cache.attach_tier(self.kvtier)
+        else:
+            self.kvtier = None
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
@@ -360,7 +375,13 @@ class ServingEngine:
                                1, 2 ** 31 - 1)))
         self._requests[req.req_id] = req
         self._rngs[req.req_id] = np.random.default_rng(seed)
+        tier_restored = 0
         if self.cache.prefix_cache_enabled:
+            # host-tier restore FIRST (round 20), so the pages it lands
+            # are pinned by the acquire below like any shipped prefix;
+            # best-effort — a miss/failure just means recompute
+            if self.kvtier is not None:
+                tier_restored = self.kvtier.restore(self.cache, prompt)
             req.cached_pages = self.cache.acquire_prefix(
                 req.seq_id, prompt, prompt.size)
         self.scheduler.add(req)
@@ -370,6 +391,12 @@ class ServingEngine:
             if req.cached_pages:
                 self.trace.span(req.req_id, "prefix_hit", now,
                                 pages=req.cached_pages)
+            if tier_restored:
+                self.trace.span(req.req_id, "tier_restore_hit", now,
+                                pages=tier_restored)
+            elif (self.kvtier is not None and req.cached_pages
+                  < (prompt.size - 1) // self.cache.page_size):
+                self.trace.span(req.req_id, "tier_restore_miss", now)
             self.trace.flight.record(
                 "admit", req_id=req.req_id,
                 request_id=req.request_id,
@@ -445,6 +472,10 @@ class ServingEngine:
         self.metrics.queue_depth_gauge.set(self.scheduler.queue_depth())
         self.metrics.page_occupancy_gauge.set(self.cache.occupancy())
         self.metrics.running_gauge.set(len(self.scheduler.running))
+        if self.kvtier is not None:
+            # drain deferred spills at the step boundary (the eviction
+            # loop itself never serializes)
+            self.kvtier.flush()
         self._sync_prefix_metrics()
         step_wall = self._now() - now
         self.metrics.step_duration_s.record(step_wall)
@@ -544,6 +575,22 @@ class ServingEngine:
                 self.cache.free_seq(r.seq_id)
             self._free_draft_seq(r.seq_id)
             self.scheduler.preempt(r)
+        # WAITING requests hold pages too: add_request pins the matched
+        # prefix (acquire_prefix) before the request is ever scheduled,
+        # so a loop failure landing between admit and first schedule
+        # would leak those pins forever. Free the seq and leave the
+        # request queued — _admit re-matches the prefix on admission
+        # (the recompute path) whenever the seq is gone.
+        for r in list(self.scheduler.waiting):
+            if self.cache.has_seq(r.seq_id):
+                self.cache.free_seq(r.seq_id)
+            self._free_draft_seq(r.seq_id)
+        # WAITING requests hold pages too: add_request pins the matched
+        # prefix (acquire_prefix) before the request is ever scheduled,
+        # so a loop failure landing between admit and first schedule
+        # would leak those pins forever. Free the seq and leave the
+        # request queued — _admit re-matches the prefix on admission
+        # (the recompute path) whenever the seq is gone.
         for rid in list(self._held):
             self.release_request(rid)
         self._release_chaos_spike()
@@ -1358,6 +1405,30 @@ class ServingEngine:
             self.trace.flight.record("prefix_drop", pages=n)
         return n
 
+    # -- hierarchical KV tier (round 20) -----------------------------------
+    def restore_prefix(self, prompt):
+        """Best-effort host-tier restore of ``prompt``'s missing prefix
+        pages (the router's local-tier probe, between its device probe
+        and the remote-donor loop).  Restored pages enter CACHED at
+        rc==0 — shipped-prefix semantics, so admission accounting needs
+        no new case.  Returns pages restored; 0 with no tier."""
+        if self.kvtier is None:
+            return 0
+        return self.kvtier.restore(self.cache, prompt)
+
+    def prewarm_prefix(self, max_chains=None):
+        """Restore the hottest spilled chains into the device tree —
+        the autoscaler's warm-up for a newly grown replica.  Returns
+        total pages restored; strictly best-effort."""
+        if self.kvtier is None:
+            return 0
+        return self.kvtier.prewarm(self.cache, max_chains)
+
+    def tier_stats(self):
+        """Host/disk tier occupancy + counters (``/healthz`` shape);
+        None when no tier is attached."""
+        return None if self.kvtier is None else self.kvtier.stats()
+
     def _fork(self, parent, i):
         child = Request(prompt=parent.prompt,
                         max_new_tokens=parent.max_new_tokens,
@@ -1504,6 +1575,11 @@ class ServingEngine:
         m.prefix_hit_rate.set(c.prefix_hit_pages / total if total
                               else 0.0)
         m.cached_pages_gauge.set(c.cached_pages)
+        if self.kvtier is not None:
+            st = self.kvtier.pool.stats()
+            m.host_pool_pages.set(st["host_pool_pages"])
+            m.host_pool_bytes.set(st["host_pool_bytes"])
+            m.disk_pool_pages.set(st.get("disk_pool_pages", 0))
         if m.spec_draft_tokens.value:
             m.spec_acceptance_rate.set(m.spec_accepted_tokens.value
                                        / m.spec_draft_tokens.value)

@@ -289,6 +289,7 @@ class ServingFrontend:
     def health(self):
         with self.lock:
             eng = self.engine
+            tier_stats = eng.tier_stats()
             return {"status": self._state,
                     "role": self.role,
                     "pid": os.getpid(),
@@ -312,6 +313,13 @@ class ServingFrontend:
                     "cached_pages": eng.cache.cached_pages,
                     "reclaimable_pages": eng.cache.reclaimable_pages,
                     "prefix_tree_depth": eng.cache.prefix_tree_depth,
+                    # hierarchical KV tier (round 20): host-tier
+                    # occupancy — a router can prefer a warm replica
+                    # (kvtier is None without a tier; the flat page
+                    # count rides top-level for cheap router reads)
+                    "host_pool_pages": (tier_stats or
+                                        {}).get("host_pool_pages", 0),
+                    "kvtier": tier_stats,
                     "requests_finished":
                         eng.metrics.requests_finished.value}
 
@@ -446,6 +454,23 @@ class ServingFrontend:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self.lock:
             return self.engine.drop_prefix(prompt)
+
+    # -- hierarchical KV tier (round 20) -----------------------------------
+    def restore_prefix(self, prompt):
+        """Best-effort host-tier restore of ``prompt``'s missing prefix
+        pages (probe order: local device → local host tier → remote
+        donor → recompute).  Restored pages land CACHED at rc==0, so
+        the shed gate's probe_prefix-based accounting covers them with
+        no new case.  Returns pages restored (0 without a tier)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self.lock:
+            return self.engine.restore_prefix(prompt)
+
+    def prewarm_prefix(self, max_chains=None):
+        """Restore the hottest spilled chains (autoscaler pre-warm of
+        a freshly grown replica).  Returns pages restored."""
+        with self.lock:
+            return self.engine.prewarm_prefix(max_chains)
 
     # -- internals ---------------------------------------------------------
     def _check_capacity(self, prompt, max_new, n, prefill_only=False):

@@ -202,6 +202,15 @@ class PagedKVCache:
         # trace cache stays bounded at log2(num_pages) entries
         self._gather_fn = None
         self._scatter_fn = None
+        # hierarchical KV tier (round 20): when attached, LRU-evicted
+        # rc-0 cached pages spill their wire payload to the host tier
+        # instead of vanishing (kvtier.KVTier; strictly best-effort)
+        self._tier = None
+
+    def attach_tier(self, tier):
+        """Bind a :class:`~.kvtier.KVTier` so prefix-cache evictions
+        spill to the host tier.  ``None`` detaches."""
+        self._tier = tier
 
     # -- sizing helpers ---------------------------------------------------
     @staticmethod
@@ -561,10 +570,22 @@ class PagedKVCache:
         list — the weight-reload path: cached K/V computed under OLD
         weights must never be served to post-reload requests. On an
         idle (drained) engine every cached page has rc==0, so this is a
-        full tree flush. Returns the number of pages reclaimed."""
+        full tree flush. Returns the number of pages reclaimed.
+
+        The attached KV tier (if any) is detached for the loop and
+        INVALIDATED after it: reload-flushed pages hold K/V computed
+        under the OLD weights, so spilling them — or keeping anything
+        already spilled — would serve stale bytes to post-reload
+        requests."""
         n = 0
-        while self._evict_lru_leaf():
-            n += 1
+        tier, self._tier = self._tier, None
+        try:
+            while self._evict_lru_leaf():
+                n += 1
+        finally:
+            self._tier = tier
+        if tier is not None:
+            tier.invalidate()
         return n
 
     # -- page migration (disaggregated prefill/decode, round 14) -----------
@@ -945,6 +966,13 @@ class PagedKVCache:
                     victim = node
         if victim is None:
             return False
+        if self._tier is not None:
+            # spill BEFORE unlinking: the tier walks the victim's
+            # ancestors to rebuild the token chain, and the page bytes
+            # must be captured before the page re-enters the free list.
+            # Best-effort by contract — the eviction proceeds whatever
+            # happens in there.
+            self._tier.spill(self, victim)
         del victim.parent.children[victim.key]
         del self._cached[victim.page]
         self._free.append(victim.page)

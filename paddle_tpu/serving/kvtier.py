@@ -1,0 +1,615 @@
+"""Hierarchical KV-cache tiers (round 20): host-RAM and disk page
+pools behind the pagewire, with prefix restore and replica pre-warm.
+
+At fleet scale the prefix working set dwarfs device HBM: the radix
+tree's LRU eviction (``PagedKVCache._evict_lru_leaf``) used to simply
+discard an rc-0 cached page, and every later miss paid full prompt
+recompute.  This module keeps those pages alive in cheaper tiers:
+
+- :class:`HostPagePool` — a byte-budgeted host-RAM LRU of spilled
+  pages (``PADDLE_TPU_SERVING_HOST_POOL_MB``), SHARED freely across
+  engines in one process (host RAM is a per-machine resource; the
+  payload geometry is validated per-cache at restore, so dtype-skewed
+  engines sharing a pool simply miss each other's entries).
+- :class:`DiskPagePool` — an optional file-backed tier UNDER the host
+  pool (``PADDLE_TPU_SERVING_DISK_POOL_MB`` / ``_DISK_POOL_DIR``):
+  pages evicted from the RAM budget demote to disk instead of
+  vanishing; a disk hit promotes back through RAM.
+- :class:`KVTier` — the per-engine binding (pool + chaos injector +
+  metrics + trace) whose :meth:`spill`/:meth:`restore`/:meth:`prewarm`
+  are the ONLY blessed entry points into the pools (graftlint
+  ``kvtier-blessed-access`` forbids reaching around them).
+
+Spill path: ``_evict_lru_leaf`` hands the victim node over BEFORE
+unlinking it.  The device bytes must be captured synchronously (the
+page re-enters the free list and can be reused within the same
+allocator call), via the SAME fused one-program gather the prefix
+ships use; serialization + CRC + LRU insertion are deferred to
+:meth:`KVTier.flush`, which the engine drains at step boundaries — the
+allocator's eviction loop never serializes or touches the pool lock.
+Each spilled page is stored as a standalone pagewire PREFIX payload
+(``meta["kind"] == "prefix"``, one page, full token chain as the
+prompt) keyed by its token chain, so restore re-enters through
+``import_prefix_pages`` with the exact CACHED-rc==0 semantics of a
+remote-donor ship — router code, admission accounting and drift
+handling need no new cases.
+
+Restore path: a prefix probe that misses device pages walks the host
+tier chain-key by chain-key past the device match, concatenates the
+per-page payloads, and lands them through the fused scatter.  Probe
+order across the stack is local device → local host tier → remote
+donor → recompute (the router consults the tier between its device
+probe and the donor loop).
+
+The contract is STRICTLY best-effort (the round-18 rule): any spill
+or restore failure, geometry/dtype mismatch, CRC-detected corruption,
+or capacity shed degrades to the recompute the engine would have done
+anyway — never a failed or blocked request, never an exception out of
+the blessed entry points.
+
+Weight reloads: spilled K/V was computed under the OLD weights, so
+``PagedKVCache.clear_prefix`` (the reload flush) also invalidates the
+attached tier — stale pages must never restore after a reload.
+
+Nothing here imports jax at module scope; the only device work is the
+cache's own fused gather/scatter.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from .pagewire import WireFormatError, deserialize_pages, serialize_pages
+
+__all__ = ["DiskPagePool", "HostPagePool", "KVTier", "chain_key",
+           "host_pool_from_env"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+# tier sizing/behavior knobs (docs/ENV_KNOBS.md)
+_ENV_HOST_MB = "PADDLE_TPU_SERVING_HOST_POOL_MB"
+_ENV_DISK_MB = "PADDLE_TPU_SERVING_DISK_POOL_MB"
+_ENV_DISK_DIR = "PADDLE_TPU_SERVING_DISK_POOL_DIR"
+_ENV_PREWARM = "PADDLE_TPU_SERVING_HOST_POOL_PREWARM"
+
+# deferred spills buffered before an inline flush (bounds the host RAM
+# the un-serialized numpy payloads can pin if the owner never flushes)
+_MAX_PENDING = 32
+
+
+def chain_key(tokens):
+    """Canonical pool key for a page chain: the raw little-endian int32
+    bytes of the FULL token prefix up to and including the page (the
+    radix path from the root).  Pure function of the tokens, so every
+    engine sharing a pool computes identical keys."""
+    return np.ascontiguousarray(
+        np.asarray(tokens, np.int32).reshape(-1)).tobytes()
+
+
+class DiskPagePool:
+    """File-backed page tier under a :class:`HostPagePool`.
+
+    One file per spilled page (the serialized pagewire payload,
+    verbatim), LRU-evicted to a byte budget.  NOT independently
+    thread-safe: every call happens under the owning HostPagePool's
+    lock — the pool is the single writer/reader of this directory.
+    """
+
+    def __init__(self, dir_path=None, budget_bytes=64 * 2 ** 20):
+        if dir_path is None:
+            dir_path = tempfile.mkdtemp(prefix="pdtpu_kvtier_")
+            self._owns_dir = True
+        else:
+            os.makedirs(dir_path, exist_ok=True)
+            self._owns_dir = False
+        self.dir = dir_path
+        self.budget_bytes = int(budget_bytes)
+        self._entries: OrderedDict[bytes, tuple[str, int]] = OrderedDict()
+        self.bytes_used = 0
+        self.write_errors = 0
+
+    @property
+    def pages(self):
+        return len(self._entries)
+
+    def _path(self, key):
+        return os.path.join(self.dir,
+                            hashlib.sha1(key).hexdigest() + ".ptkv")
+
+    def put(self, key, payload):
+        """Store one payload; evicts LRU files past the budget.  A
+        payload larger than the whole budget is shed (False)."""
+        if len(payload) > self.budget_bytes:
+            return False
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return True
+        path = self._path(key)
+        try:
+            with open(path, "wb") as f:
+                f.write(payload)
+        except OSError:
+            self.write_errors += 1
+            return False
+        self._entries[key] = (path, len(payload))
+        self.bytes_used += len(payload)
+        while self.bytes_used > self.budget_bytes:
+            self.pop(next(iter(self._entries)))
+        return True
+
+    def get(self, key):
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        path, nbytes = ent
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            self.pop(key)
+            return None
+        if len(payload) != nbytes:  # torn write / external truncation
+            self.pop(key)
+            return None
+        self._entries.move_to_end(key)
+        return payload
+
+    def pop(self, key):
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return False
+        path, nbytes = ent
+        self.bytes_used -= nbytes
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return True
+
+    def clear(self):
+        for key in list(self._entries):
+            self.pop(key)
+
+
+class HostPagePool:
+    """Byte-budgeted host-RAM LRU of spilled prefix pages, optionally
+    backed by a :class:`DiskPagePool`.  Thread-safe and shareable
+    across engines; all consistency-relevant state lives behind the
+    lock and is exposed read-only via :meth:`snapshot` (the chaos
+    cross-tier conservation check)."""
+
+    def __init__(self, budget_bytes, disk=None):
+        self.budget_bytes = int(budget_bytes)
+        if self.budget_bytes < 0:
+            raise ValueError(
+                f"host pool budget must be >= 0, got {budget_bytes}")
+        self.disk = disk
+        self._lock = threading.RLock()
+        # key -> payload bytes, LRU order (oldest first)
+        self._entries: OrderedDict[bytes, bytes] = OrderedDict()
+        self.bytes_used = 0
+        # chain heat for pre-warm (hits survive demotion/eviction so a
+        # re-spilled hot chain keeps its rank)
+        self._hits: dict[bytes, int] = {}
+        # counters (exported via snapshot/stats; engines mirror the
+        # ones they care about into their own ServingMetrics)
+        self.spilled_pages = 0
+        self.restored_pages = 0
+        self.demoted_pages = 0
+        self.shed_pages = 0
+        self.dropped_pages = 0
+
+    # -- blessed write path ------------------------------------------------
+    def put(self, key, payload):
+        """Insert one spilled page payload.  Returns True when the
+        payload is resident SOMEWHERE (RAM or disk) afterwards; False
+        when it was shed (over-budget with no disk tier, or larger
+        than every budget)."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            if len(payload) > self.budget_bytes:
+                if self.disk is not None and self.disk.put(key, payload):
+                    self.demoted_pages += 1
+                    return True
+                self.shed_pages += 1
+                return False
+            self._entries[key] = payload
+            self.bytes_used += len(payload)
+            self.spilled_pages += 1
+            while self.bytes_used > self.budget_bytes:
+                old_key, old_payload = self._entries.popitem(last=False)
+                self.bytes_used -= len(old_payload)
+                if self.disk is not None \
+                        and self.disk.put(old_key, old_payload):
+                    self.demoted_pages += 1
+                else:
+                    self.dropped_pages += 1
+            return True
+
+    def get(self, key):
+        """Fetch a payload (RAM first, then disk).  A disk hit promotes
+        back into RAM (which may demote the RAM LRU tail).  Returns
+        None on a miss."""
+        with self._lock:
+            payload = self._entries.get(key)
+            if payload is not None:
+                self._entries.move_to_end(key)
+                self._hits[key] = self._hits.get(key, 0) + 1
+                return payload
+            if self.disk is None:
+                return None
+            payload = self.disk.get(key)
+            if payload is None:
+                return None
+            self._hits[key] = self._hits.get(key, 0) + 1
+            if len(payload) <= self.budget_bytes:
+                self.disk.pop(key)
+                self._entries[key] = payload
+                self.bytes_used += len(payload)
+                while self.bytes_used > self.budget_bytes:
+                    old_key, old_payload = self._entries.popitem(
+                        last=False)
+                    self.bytes_used -= len(old_payload)
+                    if not self.disk.put(old_key, old_payload):
+                        self.dropped_pages += 1
+                    else:
+                        self.demoted_pages += 1
+            return payload
+
+    def contains(self, key):
+        """Residency probe with NO LRU/heat mutation (reservation-math
+        safe, like ``PagedKVCache.probe_prefix``)."""
+        with self._lock:
+            if key in self._entries:
+                return True
+            return (self.disk is not None
+                    and key in self.disk._entries)
+
+    def pop(self, key):
+        """Drop one entry from whichever tier holds it (the restore
+        path's corrupt-payload disposal)."""
+        with self._lock:
+            payload = self._entries.pop(key, None)
+            if payload is not None:
+                self.bytes_used -= len(payload)
+                self.dropped_pages += 1
+                return True
+            if self.disk is not None and self.disk.pop(key):
+                self.dropped_pages += 1
+                return True
+            return False
+
+    def clear(self):
+        """Flush every tier (the weight-reload invalidation: spilled
+        K/V of the OLD weights must never restore)."""
+        with self._lock:
+            self._entries.clear()
+            self.bytes_used = 0
+            self._hits.clear()
+            if self.disk is not None:
+                self.disk.clear()
+
+    # -- blessed read-only views -------------------------------------------
+    @property
+    def pages(self):
+        with self._lock:
+            n = len(self._entries)
+            if self.disk is not None:
+                n += self.disk.pages
+            return n
+
+    def hottest(self, n):
+        """The ``n`` hottest resident chain keys for pre-warm, deepest
+        chains preferred: a key that is a strict prefix of another
+        selected key is redundant (restoring the deeper chain pulls
+        the whole path)."""
+        with self._lock:
+            resident = list(self._entries)
+            if self.disk is not None:
+                resident += list(self.disk._entries)
+        resident.sort(key=lambda k: (self._hits.get(k, 0), len(k)),
+                      reverse=True)
+        picked = []
+        for key in resident:
+            if len(picked) >= int(n):
+                break
+            if any(p.startswith(key) for p in picked):
+                continue
+            picked = [p for p in picked if not key.startswith(p)]
+            picked.append(key)
+        return picked
+
+    def stats(self):
+        """Occupancy + counters (/healthz advertisement shape)."""
+        with self._lock:
+            out = {"host_pool_pages": len(self._entries),
+                   "host_pool_bytes": self.bytes_used,
+                   "host_pool_budget_bytes": self.budget_bytes,
+                   "spilled_pages": self.spilled_pages,
+                   "restored_pages": self.restored_pages,
+                   "demoted_pages": self.demoted_pages,
+                   "shed_pages": self.shed_pages,
+                   "dropped_pages": self.dropped_pages}
+            if self.disk is not None:
+                out["disk_pool_pages"] = self.disk.pages
+                out["disk_pool_bytes"] = self.disk.bytes_used
+                out["disk_pool_budget_bytes"] = self.disk.budget_bytes
+            return out
+
+    def snapshot(self):
+        """Consistency view for :func:`..chaos.verify_tier_conservation`
+        — entry sizes per tier, so the invariant check never reaches
+        into pool internals itself."""
+        with self._lock:
+            snap = {"entries": [(k, len(p))
+                                for k, p in self._entries.items()],
+                    "bytes_used": self.bytes_used,
+                    "budget_bytes": self.budget_bytes,
+                    "disk": None}
+            if self.disk is not None:
+                snap["disk"] = {
+                    "entries": [(k, path, nbytes) for k, (path, nbytes)
+                                in self.disk._entries.items()],
+                    "bytes_used": self.disk.bytes_used,
+                    "budget_bytes": self.disk.budget_bytes}
+            return snap
+
+
+def host_pool_from_env():
+    """Build the host (and optional disk) tier from the env knobs;
+    None when ``PADDLE_TPU_SERVING_HOST_POOL_MB`` is unset or 0."""
+    try:
+        host_mb = float(os.environ.get(_ENV_HOST_MB) or 0)
+    except ValueError:
+        host_mb = 0.0
+    if host_mb <= 0:
+        return None
+    disk = None
+    try:
+        disk_mb = float(os.environ.get(_ENV_DISK_MB) or 0)
+    except ValueError:
+        disk_mb = 0.0
+    if disk_mb > 0:
+        disk = DiskPagePool(os.environ.get(_ENV_DISK_DIR) or None,
+                            budget_bytes=int(disk_mb * 2 ** 20))
+    return HostPagePool(int(host_mb * 2 ** 20), disk=disk)
+
+
+def _prewarm_chains_default():
+    try:
+        return int(os.environ.get(_ENV_PREWARM) or 4)
+    except ValueError:
+        return 4
+
+
+class KVTier:
+    """Per-engine tier binding: one shared :class:`HostPagePool` plus
+    the owning engine's chaos injector / metrics / trace.  The three
+    public methods — :meth:`spill` (allocator hook), :meth:`restore`
+    and :meth:`prewarm` — are the blessed pool entry points and NEVER
+    raise: every failure degrades to the eviction/recompute the engine
+    would have done anyway."""
+
+    def __init__(self, pool, *, chaos=None, metrics=None, trace=None,
+                 max_pending=_MAX_PENDING):
+        self.pool = pool
+        self.chaos = chaos
+        self.metrics = metrics
+        self.trace = trace
+        self.max_pending = int(max_pending)
+        # deferred spills: (key, meta, k_arrays, v_arrays) awaiting
+        # serialization — appended by the allocator's eviction loop,
+        # drained by flush() at step boundaries
+        self._pending = []
+
+    # -- spill (called from PagedKVCache._evict_lru_leaf) ------------------
+    def spill(self, cache, node):
+        """Capture an about-to-be-evicted rc-0 cached page.  Called
+        with the radix tree still intact (the chain walk needs the
+        victim's ancestors); the caller unlinks and frees the page
+        right after, whatever happens here."""
+        try:
+            self._spill_inner(cache, node)
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.tier_spill_dropped.inc()
+
+    def _spill_inner(self, cache, node):
+        # full token chain root -> victim (each node's key is its
+        # page's token tuple)
+        parts = []
+        walk = node
+        while walk is not None and walk.key is not None:
+            parts.append(walk.key)
+            walk = walk.parent
+        parts.reverse()
+        tokens = [int(t) for chunk in parts for t in chunk]
+        key = chain_key(tokens)
+        if self.pool.contains(key):
+            return  # restored earlier and re-evicted: already spilled
+        # the device bytes must be captured NOW — the page re-enters
+        # the free list and can be reused within this allocator call
+        k, v = cache._fetch_pages([node.page])
+        meta = dict(cache.geometry(), kind="prefix",
+                    skip_pages=len(parts) - 1, n_pages=1,
+                    cached_pages=len(parts), prompt=tokens)
+        self._pending.append((key, meta, k, v))
+        if len(self._pending) >= self.max_pending:
+            self.flush()
+
+    def flush(self):
+        """Drain deferred spills: serialize (+CRC) and insert into the
+        pool.  The engine calls this once per step; restore/prewarm
+        call it first so their probes see every spilled page."""
+        if not self._pending:
+            return 0
+        pending, self._pending = self._pending, []
+        chaos, cfg = self.chaos, None
+        if chaos is not None:
+            cfg = chaos.cfg
+        landed = 0
+        for key, meta, k, v in pending:
+            t0 = time.perf_counter()
+            try:
+                if chaos is not None \
+                        and chaos.fire("tier_spill_fail", cfg=cfg):
+                    raise RuntimeError("chaos: tier spill dropped")
+                if chaos is not None \
+                        and chaos.fire("tier_slow_io", cfg=cfg):
+                    chaos.sleep(cfg.tier_slow_io_s)
+                if not self.pool.put(key, serialize_pages(meta, k, v)):
+                    raise RuntimeError("host pool shed the payload")
+            except Exception:
+                if self.metrics is not None:
+                    self.metrics.tier_spill_dropped.inc()
+                continue
+            landed += 1
+            if self.metrics is not None:
+                self.metrics.tier_spill_pages.inc()
+                self.metrics.tier_spill_s.record(
+                    time.perf_counter() - t0)
+        return landed
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, cache, prompt):
+        """Extend ``prompt``'s device-resident prefix chain from the
+        host tier.  Returns the number of pages restored (0 on a miss
+        or ANY failure — the caller's recompute covers it)."""
+        try:
+            return self._restore_inner(cache, prompt)
+        except Exception:
+            self._count_miss()
+            return 0
+
+    def _restore_inner(self, cache, prompt):
+        if not cache.prefix_cache_enabled:
+            return 0
+        self.flush()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ps = cache.page_size
+        cap = prompt.size // ps
+        have = cache.probe_prefix(prompt, prompt.size + 1)
+        if have >= cap:
+            return 0  # fully device-resident: nothing to restore
+        chaos, cfg = self.chaos, None
+        if chaos is not None:
+            cfg = chaos.cfg
+            if chaos.fire("tier_restore_fail", cfg=cfg):
+                self._count_miss()
+                return 0
+            if chaos.fire("tier_slow_io", cfg=cfg):
+                chaos.sleep(cfg.tier_slow_io_s)
+        t0 = time.perf_counter()
+        # walk the tier chain-key by chain-key past the device match
+        k_parts, v_parts = [], []
+        depth = have
+        while depth < cap:
+            key = chain_key(prompt[:(depth + 1) * ps])
+            payload = self.pool.get(key)
+            if payload is None:
+                break
+            if chaos is not None \
+                    and chaos.fire("tier_corrupt_payload", cfg=cfg):
+                # at-rest bit-rot model: flip one byte in the array
+                # region so the wire CRC (not a shape check) catches it
+                payload = bytearray(payload)
+                payload[-1] ^= 0xFF
+                payload = bytes(payload)
+            try:
+                meta, k, v, _ = deserialize_pages(payload)
+                cache.check_geometry(meta)
+            except (WireFormatError, ValueError):
+                # corrupt or mis-shaped at rest: dispose of the entry
+                # and restore what we already have
+                self.pool.pop(key)
+                if self.metrics is not None:
+                    self.metrics.tier_corrupt_dropped.inc()
+                break
+            k_parts.append(k)
+            v_parts.append(v)
+            depth += 1
+        if not k_parts:
+            self._count_miss()
+            return 0
+        # concatenate the single-page payloads into ONE import (one
+        # fused scatter), entering with the same CACHED-rc==0 import
+        # semantics as a remote-donor ship
+        n = len(k_parts)
+        k_cat = [np.concatenate([part[i] for part in k_parts])
+                 for i in range(len(k_parts[0]))]
+        v_cat = [np.concatenate([part[i] for part in v_parts])
+                 for i in range(len(v_parts[0]))]
+        meta = dict(cache.geometry(), kind="prefix", skip_pages=have,
+                    n_pages=n, cached_pages=have,
+                    prompt=[int(t) for t in prompt[:(have + n) * ps]])
+        imported = cache.import_prefix_pages(meta, k_cat, v_cat)
+        dt = time.perf_counter() - t0
+        if self.metrics is not None:
+            m = self.metrics
+            m.tier_restore_pages.inc(imported)
+            m.tier_restore_hits.inc()
+            m.tier_restore_s.record(dt)
+            self._sync_hit_rate()
+        self.pool.restored_pages += imported
+        if self.trace is not None and self.trace.enabled:
+            self.trace.flight.record("tier_restore", pages=int(imported),
+                                     skip_pages=int(have),
+                                     wall_s=round(dt, 6))
+        return imported
+
+    def _count_miss(self):
+        if self.metrics is not None:
+            self.metrics.tier_restore_misses.inc()
+            self._sync_hit_rate()
+
+    def _sync_hit_rate(self):
+        m = self.metrics
+        hits = m.tier_restore_hits.value
+        total = hits + m.tier_restore_misses.value
+        if total:
+            m.tier_restore_hit_rate.set(hits / total)
+
+    # -- pre-warm (autoscaler grow hook) -----------------------------------
+    def prewarm(self, cache, max_chains=None):
+        """Restore the hottest spilled chains into ``cache`` — the
+        newly-grown-replica warm-up.  Returns total pages restored;
+        best-effort per chain."""
+        try:
+            n = (_prewarm_chains_default() if max_chains is None
+                 else int(max_chains))
+            if n <= 0:
+                return 0
+            self.flush()
+            restored = 0
+            for key in self.pool.hottest(n):
+                restored += self.restore(
+                    cache, np.frombuffer(key, np.int32))
+            return restored
+        except Exception:
+            return 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def invalidate(self):
+        """Drop everything (weight reload: spilled K/V of the OLD
+        weights must never restore).  Clears the SHARED pool — every
+        engine on it reloads together in a rolling drain, and a stale
+        entry served to any of them would be silent corruption."""
+        self._pending = []
+        try:
+            self.pool.clear()
+        except Exception:  # pragma: no cover - clear is in-memory
+            pass
+
+    def stats(self):
+        out = self.pool.stats()
+        out["pending_spills"] = len(self._pending)
+        return out

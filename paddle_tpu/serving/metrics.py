@@ -205,6 +205,19 @@ class ServingMetrics:
         # quantized serving (round 15): honest per-page byte cost incl.
         # int8 scale rows — what the hbm_budget sizing divides by
         self.kv_page_bytes = Gauge()
+        # hierarchical KV tiers (round 20): host/disk spill + restore
+        self.tier_spill_pages = Counter()     # pages landed in the tier
+        self.tier_spill_dropped = Counter()   # spills shed/failed
+        self.tier_restore_pages = Counter()   # pages restored to device
+        self.tier_restore_hits = Counter()    # restores that moved pages
+        self.tier_restore_misses = Counter()  # probes the tier missed
+        self.tier_corrupt_dropped = Counter()  # CRC-failed entries purged
+        self.tier_spill_s = Histogram(buckets=LATENCY_BUCKETS)
+        self.tier_restore_s = Histogram(buckets=LATENCY_BUCKETS)
+        self.tier_restore_hit_rate = Gauge()  # hits/(hits+misses), cumul.
+        self.host_pool_pages = Gauge()        # RAM-tier resident pages
+        self.host_pool_bytes = Gauge()
+        self.disk_pool_pages = Gauge()        # disk-tier resident pages
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}

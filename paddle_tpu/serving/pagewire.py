@@ -24,11 +24,21 @@ The format is host-order binary (little-endian length prefix); both
 ends of a migration run the same stack, and the JSON header carries
 the dtype string so an endianness or dtype skew is caught, not
 mis-read.
+
+Round 20 (hierarchical KV tiers): the header carries an OPTIONAL
+``crc32`` field (zlib CRC over the concatenated array bytes).  The
+serializer always writes it; the deserializer verifies it only when
+present, so payloads produced by older writers keep deserializing.
+Spilled pages parked in the host/disk tiers sit at rest far longer
+than a live migration transfer — the CRC is what turns silent
+bit-rot (or a chaos-corrupted payload) into a detected
+:class:`WireFormatError` the tier degrades to a recompute.
 """
 from __future__ import annotations
 
 import json
 import struct
+import zlib
 
 import numpy as np
 
@@ -52,18 +62,20 @@ def serialize_pages(meta, k_arrays, v_arrays, request=None):
     result — plus an optional ``request`` continuation dict into one
     ``bytes`` payload."""
     arrays = list(k_arrays) + list(v_arrays)
+    body = [np.ascontiguousarray(a).tobytes() for a in arrays]
+    crc = 0
+    for b in body:
+        crc = zlib.crc32(b, crc)
     header = {
         "meta": dict(meta),
         "request": dict(request) if request is not None else None,
         "arrays": [{"shape": list(a.shape), "dtype": str(a.dtype)}
                    for a in arrays],
         "n_layers_k": len(k_arrays),
+        "crc32": crc,
     }
     hdr = json.dumps(header).encode()
-    parts = [MAGIC, _LEN.pack(len(hdr)), hdr]
-    for a in arrays:
-        parts.append(np.ascontiguousarray(a).tobytes())
-    return b"".join(parts)
+    return b"".join([MAGIC, _LEN.pack(len(hdr)), hdr] + body)
 
 
 def deserialize_pages(buf):
@@ -88,8 +100,10 @@ def deserialize_pages(buf):
         specs = header["arrays"]
         n_k = int(header["n_layers_k"])
         request = header.get("request")
+        crc = header.get("crc32")
     except (KeyError, TypeError, ValueError) as e:
         raise WireFormatError(f"malformed header: {e}") from e
+    data_start = off
     if not 0 <= n_k <= len(specs):
         raise WireFormatError(
             f"n_layers_k={n_k} outside the {len(specs)} declared arrays")
@@ -113,4 +127,9 @@ def deserialize_pages(buf):
         raise WireFormatError(
             f"{len(buf) - off} trailing byte(s) after the declared "
             "arrays")
+    if crc is not None and zlib.crc32(buf[data_start:]) != int(crc):
+        # at-rest corruption (host/disk tier bit-rot, chaos
+        # tier_corrupt_payload): the arrays parsed shape-wise but the
+        # bytes are not what the writer stored
+        raise WireFormatError("payload CRC mismatch: corrupt page bytes")
     return meta, arrays[:n_k], arrays[n_k:], request

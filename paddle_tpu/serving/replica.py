@@ -164,6 +164,13 @@ class InProcessReplica:
     def drop_prefix(self, prompt):
         return self.frontend.drop_prefix(prompt)
 
+    # -- hierarchical KV tier (round 20) -----------------------------------
+    def restore_prefix(self, prompt):
+        return self.frontend.restore_prefix(prompt)
+
+    def prewarm_prefix(self, max_chains=None):
+        return self.frontend.prewarm_prefix(max_chains)
+
 
 class _HTTPStream:
     """SSE consumer over one in-flight ``/v1/completions`` request —
@@ -625,6 +632,37 @@ class HTTPReplica:
             raise ReplicaFailed(
                 f"replica {self.name}: prefix drop HTTP {status}")
         return int(json.loads(data).get("dropped_pages", 0))
+
+    # -- hierarchical KV tier (round 20) -----------------------------------
+    def restore_prefix(self, prompt):
+        """Ask the remote to restore ``prompt``'s prefix from its OWN
+        host tier.  Strictly best-effort (the tier contract): any
+        transport/HTTP failure is a 0-page miss, never an error."""
+        try:
+            status, data = self._post_json(
+                "/v1/_pages/prefix/restore",
+                {"prompt":
+                 [int(t) for t in np.asarray(prompt).reshape(-1)]})
+            if status != 200:
+                return 0
+            return int(json.loads(data).get("restored_pages", 0))
+        except (OSError, ReplicaFailed, ValueError, TypeError, KeyError):
+            return 0
+
+    def prewarm_prefix(self, max_chains=None):
+        """Ask the remote to pre-warm its hottest spilled chains
+        (autoscaler grow hook).  Best-effort: 0 on any failure."""
+        try:
+            body = {}
+            if max_chains is not None:
+                body["max_chains"] = int(max_chains)
+            status, data = self._post_json("/v1/_pages/prefix/prewarm",
+                                           body)
+            if status != 200:
+                return 0
+            return int(json.loads(data).get("restored_pages", 0))
+        except (OSError, ReplicaFailed, ValueError, TypeError, KeyError):
+            return 0
 
     # -- observability -----------------------------------------------------
     def _get(self, path):

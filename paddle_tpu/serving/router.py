@@ -161,6 +161,12 @@ class RouterMetrics:
         self.prefix_ship_fallbacks_total = Counter()  # recompute instead
         self.prefix_ship_skipped_total = LabeledCounter("reason")
         self.prefix_dedup_drops_total = Counter()  # pages dropped by dedup
+        # hierarchical KV tiers (round 20): local host-tier restores —
+        # probe order is local device -> local host tier -> remote
+        # donor -> recompute, so a restored page never ships
+        self.tier_restores_total = Counter()
+        self.tier_restored_pages_total = Counter()
+        self.prewarm_restored_pages_total = Counter()  # autoscale grow
         self.autoscale_events = LabeledCounter("direction", "role")
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
@@ -1075,16 +1081,37 @@ class ServingRouter:
 
     def _ship_prefix_inner(self, stream, target_idx, prompt,
                            total_pages, owners):
-        if not owners:
-            return
         target = self.replicas[target_idx]
-        tgt_dtype = self._replica_cache_dtype(target_idx)
         try:
             have = target.probe_pages(prompt)
         except Exception:
             return
         if have >= total_pages:
             return  # already fully resident: a local hit, not a miss
+        # hierarchical KV tier (round 20): the target's OWN host tier
+        # sits between the device miss and a remote donor — restoring
+        # locally moves no bytes over the wire.  Best-effort: 0 on a
+        # tierless replica or any failure, and the donor loop (or the
+        # plain recompute) still covers whatever is missing.
+        restored = self._tier_restore(target, prompt)
+        if restored:
+            self.metrics.tier_restores_total.inc()
+            self.metrics.tier_restored_pages_total.inc(restored)
+            self._record(prompt, target_idx)  # target owns pages now
+            if self.trace.enabled:
+                self.trace.flight.record(
+                    "tier_restore", replica=target_idx,
+                    pages=int(restored), request_id=stream.request_id)
+            _log.info(json.dumps({
+                "event": "router_tier_restore", "replica": target_idx,
+                "pages": int(restored),
+                "request_id": stream.request_id}))
+            have += restored
+            if have >= total_pages:
+                return
+        if not owners:
+            return
+        tgt_dtype = self._replica_cache_dtype(target_idx)
         # deepest recorded owner first; recorded depth is approximate,
         # the donor's probe_pages is the truth
         for donor_idx in sorted(owners, key=owners.get, reverse=True):
@@ -1111,6 +1138,19 @@ class ServingRouter:
             if self._ship_from(stream, donor_idx, target_idx, prompt,
                                have):
                 return
+
+    def _tier_restore(self, replica, prompt):
+        """Best-effort host-tier restore on the placement target: 0 on
+        a replica without the surface (older remote), without a tier,
+        or on any failure — the tier contract says a miss costs only
+        the recompute the engine was already going to do."""
+        fn = getattr(replica, "restore_prefix", None)
+        if fn is None:
+            return 0
+        try:
+            return int(fn(prompt))
+        except Exception:
+            return 0
 
     def _ship_from(self, stream, donor_idx, target_idx, prompt, skip):
         """One donor→target transfer with bounded PrefixDrift

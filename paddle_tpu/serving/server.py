@@ -338,6 +338,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._prefix_export()
         elif self.path == "/v1/_pages/prefix/drop":
             self._prefix_drop()
+        elif self.path == "/v1/_pages/prefix/restore":
+            self._prefix_restore()
+        elif self.path == "/v1/_pages/prefix/prewarm":
+            self._prefix_prewarm()
         else:
             self._error(404, f"no route {self.path}",
                         "invalid_request_error")
@@ -583,6 +587,50 @@ class _Handler(BaseHTTPRequestHandler):
                         "invalid_request_error")
             return
         self._json(200, {"dropped_pages": int(dropped)})
+
+    # -- hierarchical KV tier (/v1/_pages/prefix/restore, round 20) --------
+    def _prefix_restore(self):
+        """Restore the posted prompt's prefix from this replica's OWN
+        host tier (the router's local-tier probe before scheduling a
+        remote ship).  The tier is best-effort by contract, so a miss
+        or no-tier engine is 200 with 0 pages, never an error."""
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None or not hasattr(fe, "restore_prefix"):
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            restored = fe.restore_prefix(body["prompt"])
+        except (KeyError, TypeError, ValueError) as e:
+            self._error(400, f"bad prefix restore request: {e}",
+                        "invalid_request_error")
+            return
+        self._json(200, {"restored_pages": int(restored)})
+
+    def _prefix_prewarm(self):
+        """Restore this replica's hottest spilled chains (the
+        autoscaler's grow hook).  Best-effort: 0 pages on a cold or
+        tierless engine."""
+        fe = self._migration_frontend()
+        body = self._read_json()
+        if body is None:
+            return
+        if fe is None or not hasattr(fe, "prewarm_prefix"):
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            mc = body.get("max_chains")
+            restored = fe.prewarm_prefix(
+                None if mc is None else int(mc))
+        except (TypeError, ValueError) as e:
+            self._error(400, f"bad prefix prewarm request: {e}",
+                        "invalid_request_error")
+            return
+        self._json(200, {"restored_pages": int(restored)})
 
     # -- completion flow ---------------------------------------------------
     def _request_id(self):

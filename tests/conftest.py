@@ -73,7 +73,8 @@ def _bench_artifact_guard(request):
                        "TestServingDisaggReplay", "TestServingKv8Replay",
                        "TestServingTraceReplay",
                        "TestServingPrefixFleetReplay",
-                       "TestServingFleetReplay")
+                       "TestServingFleetReplay",
+                       "TestServingKvtierReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

@@ -38,8 +38,8 @@ def rule_ids(findings):
 # rule registry sanity
 
 class TestRegistry:
-    def test_eleven_rules_with_ids_and_docs(self):
-        assert len(ALL_RULES) == 11
+    def test_twelve_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 12
         for r in ALL_RULES:
             assert r.id and r.description
         assert set(RULES_BY_ID) == {
@@ -47,7 +47,8 @@ class TestRegistry:
             "jit-constant-capture", "dist-spec-passthrough",
             "chip-kill-on-timeout", "engine-lock-discipline",
             "page-migration-lock", "env-knob-registry",
-            "serving-raw-sleep", "fleet-process-spawn"}
+            "serving-raw-sleep", "fleet-process-spawn",
+            "kvtier-blessed-access"}
 
 
 # ---------------------------------------------------------------------------
@@ -661,6 +662,66 @@ class TestFleetProcessSpawn:
     def test_backend_home_exempt(self):
         assert lint(_SPAWN_BAD_TOOL, "paddle_tpu/serving/fleet.py",
                     "fleet-process-spawn") == []
+
+
+# ---------------------------------------------------------------------------
+# 7e. kvtier-blessed-access (round 20)
+
+_KVTIER_BAD_PUT = """
+    def stash(pool, key, payload):
+        # raw payload movement: no geometry meta, no CRC disposal path
+        pool.put(key, payload)
+        return pool.get(key)
+"""
+
+_KVTIER_BAD_INTERNALS = """
+    def peek(engine):
+        # reaching into the LRU dict skirts the byte accounting the
+        # cross-tier conservation check audits
+        return list(engine.kvtier.pool._entries)
+"""
+
+_KVTIER_GOOD_BLESSED = """
+    def occupancy(pool, tier, cache, prompt):
+        tier.flush()
+        n = tier.restore(cache, prompt)
+        return n, pool.stats(), pool.snapshot(), pool.contains(b"k")
+"""
+
+_KVTIER_GOOD_UNRELATED = """
+    def lookup(cfg, registry):
+        # dict-style get/pop on non-pool receivers passes
+        registry.pop("stale")
+        return cfg.get("key")
+"""
+
+
+class TestKvtierBlessedAccess:
+    def test_raw_put_get_flags(self):
+        fs = lint(_KVTIER_BAD_PUT, "paddle_tpu/serving/newrouter.py",
+                  "kvtier-blessed-access")
+        assert len(fs) == 2
+        assert "KVTier.spill/restore" in fs[0].message
+
+    def test_pool_internals_flags(self):
+        fs = lint(_KVTIER_BAD_INTERNALS, "tools/new_probe.py",
+                  "kvtier-blessed-access")
+        assert len(fs) == 1
+        assert "conservation" in fs[0].message
+
+    def test_blessed_surface_passes(self):
+        assert lint(_KVTIER_GOOD_BLESSED,
+                    "paddle_tpu/serving/newrouter.py",
+                    "kvtier-blessed-access") == []
+
+    def test_non_pool_receivers_pass(self):
+        assert lint(_KVTIER_GOOD_UNRELATED,
+                    "paddle_tpu/serving/newrouter.py",
+                    "kvtier-blessed-access") == []
+
+    def test_tier_home_exempt(self):
+        assert lint(_KVTIER_BAD_PUT, "paddle_tpu/serving/kvtier.py",
+                    "kvtier-blessed-access") == []
 
 
 # ---------------------------------------------------------------------------

@@ -508,6 +508,31 @@ class TestEngineE2E:
         np.testing.assert_array_equal(res[rid]["tokens"], want)
         assert res[rid]["preemptions"] >= 1
 
+    def test_release_live_frees_waiting_requests_prefix_pins(self):
+        """Regression (round-20 chaos fuzz): a request still in the
+        WAITING queue already pins its matched prefix — add_request
+        acquires before the request is ever scheduled — so a loop
+        failure landing between admit and first schedule used to leak
+        those pins forever (pages neither free nor reclaimable after
+        drain). release_live must free waiting seqs too; _admit
+        re-matches the prefix on admission."""
+        m = tiny_model(seed=11)
+        prompt = np.arange(1, 13, dtype=np.int32)
+        eng = ServingEngine(m, page_size=4, num_pages=32, max_batch=2,
+                            prefill_chunk=8, prefix_cache=True)
+        rid0 = eng.add_request(prompt, max_new_tokens=2)
+        want = eng.run()[rid0]["tokens"]
+        assert eng.cache.cached_pages > 0  # prefix committed rc==0
+        # the second request sits in WAITING with the prefix pinned
+        rid1 = eng.add_request(prompt, max_new_tokens=2)
+        assert eng.cache.available_pages < eng.cache.allocatable_pages
+        eng.release_live()
+        assert eng.cache.available_pages == eng.cache.allocatable_pages
+        # the request survives: admission re-matches and the retry is
+        # token-exact vs the uninterrupted stream
+        res = eng.run()
+        np.testing.assert_array_equal(res[rid1]["tokens"], want)
+
     def test_cancel_mid_decode_frees_pages_and_purges_queues(self):
         m = tiny_model(seed=10)
         rng = np.random.default_rng(10)

@@ -17,8 +17,12 @@ dies mid-stream, clients splice onto the promoted standby),
 ``standby_takeover_race`` (a concurrent promotion races the idempotence
 guard), ``journal_torn_write`` (recovery must skip the torn record),
 ``replica_proc_kill`` (the replica server is killed and supervision
-restarts it within budget).  After every wave the GLOBAL recovery
-invariants are asserted:
+restarts it within budget).  Round 20 adds a hierarchical-KV-tier
+wave: a page-starved engine spilling evicted prefix chains to a tiny
+host/disk pool and restoring them on the second pass, under
+``tier_spill_fail`` / ``tier_restore_fail`` / ``tier_slow_io`` /
+``tier_corrupt_payload`` (the pagewire CRC catches the bit-rot).
+After every wave the GLOBAL recovery invariants are asserted:
 
 - two-allocator page conservation on every engine (target + draft),
 - greedy token-exactness vs a fault-free single-engine oracle
@@ -107,6 +111,11 @@ SUPERVISOR_RATES = {"router_crash": 0.05,
                     "standby_takeover_race": 1.0,
                     "journal_torn_write": 0.2}
 BACKEND_RATES = {"replica_proc_kill": 0.05}
+# hierarchical KV tiers (round 20): faults on the host/disk spill and
+# restore paths — every one must degrade to the eviction/recompute the
+# engine would have done anyway (token exactness holds regardless)
+KVTIER_RATES = {"tier_spill_fail": 0.15, "tier_restore_fail": 0.15,
+                "tier_slow_io": 0.3, "tier_corrupt_payload": 0.3}
 
 
 def tiny_model(seed=0, **kw):
@@ -506,10 +515,63 @@ def run_fleet_wave(seed, n_requests, max_new):
         assert not backend.live_pids(), "fleet wave leaked processes"
 
 
+def run_kvtier_wave(seed, n_requests, max_new, flavor):
+    """One hierarchical-KV-tier wave (round 20): a single small-pool
+    engine whose radix tree THRASHES (num_pages sized below the wave's
+    working set), so allocation pressure spills rc-0 chains to a tiny
+    host pool with a file-backed disk tier under it (demotions and
+    capacity sheds included), and the second pass over the same
+    prompts attempts restores — with the four tier fault points firing
+    on those paths, plus at-rest corruption that the pagewire CRC must
+    catch.  The tier is strictly best-effort: token exactness vs the
+    fault-free oracle must hold whatever fires, and cross-tier
+    conservation (device + host + disk) must close after the wave."""
+    from paddle_tpu.serving import DiskPagePool, HostPagePool
+    from paddle_tpu.serving.chaos import verify_page_conservation
+    rng = np.random.default_rng(seed + 23)
+    engine_kw = {"cache_dtype": "int8"} if flavor == "int8" else {}
+    # 5-6 page prompts against a 15-usable-page pool: even the 3-prompt
+    # smoke working set overflows the device tree, so evictions (and
+    # therefore spills, demotions and second-sweep restores) are
+    # guaranteed, not rate-dependent
+    prompts = rng_prompts(rng, n_requests, lo=20, hi=26,
+                          shared_frac=0.5)
+    want = oracle_tokens(prompts, max_new, engine_kw=engine_kw)
+    cfg = ChaosConfig(seed=seed * 53, rates=KVTIER_RATES,
+                      tier_slow_io_s=0.001,
+                      retry_base_s=0.001, retry_max_s=0.01)
+    pool = HostPagePool(budget_bytes=8 * 1024,
+                        disk=DiskPagePool(budget_bytes=64 * 1024))
+    eng = make_engine(0, chaos=cfg, prefix_cache=True, num_pages=16,
+                      host_pool=pool, **engine_kw)
+    warm_engine(eng)  # note: clear_prefix invalidates the tier too
+    try:
+        for _sweep in range(2):
+            got = []
+            for p in prompts:
+                rid = eng.add_request(p, max_new_tokens=max_new)
+                res = eng.run()
+                got.append(res[rid]["tokens"])
+            assert got == want, (
+                "token exactness violated on the kvtier wave: "
+                + json.dumps({"got": got, "want": want}))
+        eng.prewarm_prefix()  # the autoscaler's grow hook, same path
+        m = eng.metrics
+        assert m.tier_spill_pages.value + m.tier_spill_dropped.value \
+            > 0, "kvtier wave never spilled — pool sizing broken"
+        assert m.tier_restore_hits.value + m.tier_restore_misses.value \
+            > 0, "kvtier wave never attempted a restore"
+        verify_page_conservation(eng.cache, "kvtier-wave")
+        verify_engine_quiescent(eng, what="kvtier-wave")
+        return Tally(eng.chaos.counts)
+    finally:
+        pool.clear()
+
+
 def run_seed(seed, smoke=False):
     """One full fuzz round for one seed: a disagg wave (flavor cycles
     fp32-spec / int8 by seed parity) + an HTTP wave + the round-19
-    control-plane wave."""
+    control-plane wave + the round-20 hierarchical-KV-tier wave."""
     flavor = "spec" if seed % 2 == 0 else "int8"
     n = 3 if smoke else 6
     counts = Tally()
@@ -517,6 +579,8 @@ def run_seed(seed, smoke=False):
                                   smoke=smoke))
     counts.update(run_http_wave(seed, 2 if smoke else 4, max_new=6))
     counts.update(run_fleet_wave(seed, 2 if smoke else 5, max_new=6))
+    counts.update(run_kvtier_wave(seed, 3 if smoke else 6, max_new=6,
+                                  flavor=flavor))
     return flavor, counts
 
 

@@ -22,6 +22,10 @@ bash tools/chaos_smoke.sh || exit 1
 # kills, SLO-gated (zero lost streams / zero leaked processes) —
 # runtime-bounded, CPU-only.
 bash tools/fleet_smoke.sh || exit 1
+# kvtier smoke (ISSUE 16): host/disk page-tier spill→restore replay +
+# fault-point/conservation classes — runtime-bounded, CPU-only; banks
+# nothing (the script snapshots BENCH_serving_kvtier.json itself).
+bash tools/kvtier_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
