@@ -17,6 +17,7 @@ from .kvtier_access import KvtierBlessedAccess
 from .pallas import PallasHazards
 from .serving_lock import EngineLockDiscipline, PageMigrationLock
 from .subprocess_chip import ChipKillOnTimeout
+from .weight_swap import WeightSwapLock
 
 ALL_RULES = [
     AutogradBypass(),
@@ -31,6 +32,7 @@ ALL_RULES = [
     ServingRawSleep(),
     FleetProcessSpawn(),
     KvtierBlessedAccess(),
+    WeightSwapLock(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
@@ -40,4 +42,4 @@ __all__ = ["ALL_RULES", "RULES_BY_ID", "AutogradBypass",
            "DistSpecPassthrough", "ChipKillOnTimeout",
            "EngineLockDiscipline", "PageMigrationLock",
            "EnvKnobRegistry", "ServingRawSleep", "FleetProcessSpawn",
-           "KvtierBlessedAccess"]
+           "KvtierBlessedAccess", "WeightSwapLock"]

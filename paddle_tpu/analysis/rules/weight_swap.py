@@ -1,0 +1,90 @@
+"""weight-swap-lock (round 21): a serving engine's weight pytree only
+changes through the deployer's quiesce path.
+
+Weights are ARGUMENTS of the compiled step program — swapping a
+tensor's ``_data`` between steps IS the hot-swap, which is exactly why
+an unguarded write is dangerous: done off the front-end lock it races
+the step's argument gather (a half-swapped pytree dispatched to the
+device), and done outside ``engine.set_weights`` it skips the
+all-or-nothing payload validation, the stale-K/V prefix flush, and the
+``weight_version`` advertisement the router's per-stream version pin
+depends on.  The blessed chain is::
+
+    RollingDeployer -> replica.swap_weights
+        -> ServingFrontend.swap_weights   (takes the engine lock)
+        -> engine.set_weights             (validates, writes, flushes,
+                                           bumps weight_version)
+
+so serving-layer code never assigns ``<tensor>._data`` directly and
+never calls ``engine.set_weights`` without the lock-owning front-end
+in between."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+# the engine owns its pytree writes: set_weights (the blessed mutation
+# site) plus the pure-step argument restore helpers
+_ALLOWED_FILES = {
+    "paddle_tpu/serving/engine.py",
+}
+# files allowed to call engine.set_weights directly (the lock owner)
+_SET_WEIGHTS_FILES = _ALLOWED_FILES | {
+    "paddle_tpu/serving/frontend.py",
+}
+_ENGINE_RECEIVERS = ("engine", "eng", "_engine")
+
+
+class WeightSwapLock(Rule):
+    """Serving-layer weight-pytree mutation outside the deployer's
+    quiesce path.
+
+    Flags (1) any ``<recv>._data = ...`` assignment in
+    ``paddle_tpu/serving/`` outside the engine — the weight hot-swap
+    write must go through ``engine.set_weights`` so validation, the
+    prefix flush, and the version bump cannot be skipped — and (2)
+    direct ``engine.set_weights(...)`` calls outside the front-end,
+    which alone holds the engine lock across the write."""
+
+    id = "weight-swap-lock"
+    description = ("weight-pytree writes outside the deployer quiesce "
+                   "path race the compiled step's argument gather")
+
+    def applies(self, ctx):
+        return (ctx.relpath.startswith("paddle_tpu/serving/")
+                and ctx.relpath not in _ALLOWED_FILES)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "_data"):
+                        recv = dotted_name(tgt.value) or "<expr>"
+                        yield ctx.finding(
+                            self.id, node,
+                            f"direct `{recv}._data = ...` in serving "
+                            "code — the weight pytree only changes "
+                            "through engine.set_weights under the "
+                            "front-end lock (deployer quiesce path); "
+                            "a raw write races the step's argument "
+                            "gather and skips validation/flush/"
+                            "version-bump")
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "set_weights"
+                  and ctx.relpath not in _SET_WEIGHTS_FILES):
+                recv = dotted_name(node.func.value) or ""
+                parts = recv.split(".")
+                if not any(p in _ENGINE_RECEIVERS for p in parts):
+                    continue  # replica/frontend wrapper: lock-taking
+                yield ctx.finding(
+                    self.id, node,
+                    f"direct `{recv}.set_weights()` outside "
+                    "ServingFrontend — the swap must hold the engine "
+                    "lock for its one-step quiesce; go through "
+                    "frontend.swap_weights (or replica.swap_weights)")

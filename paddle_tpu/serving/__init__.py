@@ -129,6 +129,31 @@ Layers:
   anyway.  The autoscaler pre-warms freshly grown replicas from the
   hottest spilled chains (``prewarm_prefix``).
 
+- :mod:`deploy` / :mod:`distill` — versioned live weight deployment +
+  online draft distillation (round 21): a ``WeightRegistry`` (monotonic
+  version ids across named weight sets — "target"/"draft" — in-memory
+  handles with atomic npz spill-to-disk) and a ``RollingDeployer`` that
+  hot-swaps one replica at a time: router-level drain (in-flight
+  streams FINISH on the version they started on), a one-step quiesce
+  under the engine lock (weights are ARGUMENTS of the compiled step —
+  the swap is a pytree write, zero recompile), stale-weight K/V flush
+  (``clear_prefix`` detaches + invalidates the spilled tiers too),
+  ``/healthz``-advertised ``weight_version``, re-admit.  Routers PIN
+  every stream to the version it started on (failover re-placement
+  skips version-skewed replicas; prefix ships skip version-skewed
+  donors) so no stream ever splices tokens from two versions.  The
+  swap itself (``engine.set_weights`` — the graftlint
+  ``weight-swap-lock`` blessed mutation site) validates the payload
+  all-or-nothing, so a torn push degrades to serving the old version.
+  ``DraftDistiller`` closes the training↔serving loop: the speculative
+  verify step logs (history, target-token) pairs for free, a
+  background trainer distills the draft on them, and refreshed draft
+  weights roll out through the same deployer fully live (the draft
+  only PROPOSES — the target's verify decides every emitted token, so
+  a mid-stream draft refresh moves acceptance rate, never output).
+  Proof: ``tools/deploy_harness.py`` (rolling deploy under SLO-gated
+  traffic + chaos, ``BENCH_serving_deploy.json``).
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
@@ -137,7 +162,11 @@ from .attention import paged_attention, paged_attention_ref  # noqa: F401
 from .autoscale import FleetAutoscaler  # noqa: F401
 from .chaos import (FAULT_POINTS, Backoff, ChaosConfig,  # noqa: F401
                     ChaosInjector, CircuitBreaker)
+from .deploy import (DeployError, RollingDeployer,  # noqa: F401
+                     WeightRegistry, snapshot_weights)
 from .disagg import DisaggRouter, DisaggStream  # noqa: F401
+from .distill import (DistillBuffer, DraftDistiller,  # noqa: F401
+                      distill_buffer_from_env)
 from .engine import (EngineDraining, FaultInjected,  # noqa: F401
                      ServingEngine)
 from .fleet import (ProcessReplica, ProcessReplicaBackend,  # noqa: F401
@@ -187,4 +216,7 @@ __all__ = [
     "SubprocessLauncher", "ThreadLauncher",
     "DiskPagePool", "HostPagePool", "KVTier", "chain_key",
     "host_pool_from_env",
+    "DeployError", "RollingDeployer", "WeightRegistry",
+    "snapshot_weights",
+    "DistillBuffer", "DraftDistiller", "distill_buffer_from_env",
 ]

@@ -117,9 +117,13 @@ class FleetAutoscaler:
                  down_pages=None, up_window_s=None, down_window_s=None,
                  ttft_slo_s=None, slo_breach_frac=0.1,
                  breaker_frac=None, shed_window_n=None,
-                 flap_opens=None):
+                 flap_opens=None, deployer=None):
         self.router = router
         self.backend = backend
+        # versioned deployment (round 21): freshly grown replicas are
+        # built from the ORIGINAL weights — resync them to the
+        # registry's latest published versions before traffic lands
+        self.deployer = deployer
         if factory is None and backend is not None:
             # real provisioning (round 19): the backend spawns replica
             # server processes; retire_replica -> replica.close() reaps
@@ -336,7 +340,27 @@ class FleetAutoscaler:
         _log.info(json.dumps({"event": "autoscale_up", "role": role,
                               "replica": i}))
         self._prewarm(replica, i)
+        self._sync_weights(replica, i)
         return i
+
+    def _sync_weights(self, replica, idx):
+        """Versioned deployment (round 21): bring a freshly grown
+        replica up to the registry's latest published weight versions
+        (its factory built it from the original checkpoint).  Strictly
+        best-effort — no deployer, an unversioned replica, or any
+        failure leaves the replica serving its build-time weights,
+        which is what scale-up meant before the deployer existed."""
+        dep = self.deployer
+        if dep is None:
+            return
+        try:
+            synced = dep.sync_replica(replica)
+        except Exception:  # best-effort: never fail a scale-up
+            return
+        if synced:
+            _log.info(json.dumps({"event": "autoscale_weight_sync",
+                                  "replica": idx,
+                                  "synced": synced}))
 
     def _prewarm(self, replica, idx):
         """Hierarchical KV tier (round 20): a freshly grown replica

@@ -109,6 +109,15 @@ FAULT_POINTS = (
     "tier_slow_io",          # kvtier: spill/restore I/O latency
     "tier_corrupt_payload",  # kvtier: at-rest bit-rot — the pagewire
     #                          CRC must catch it, entry dropped
+    # versioned live deployment (round 21): faults on the rolling
+    # weight-swap and draft-distillation push paths — every one must
+    # degrade to serving the OLD version, never to a failed request
+    "deploy_swap_fail",      # deployer: swap dies pre-apply (replica
+    #                          keeps serving the old version)
+    "deploy_stale_version",  # deployer: post-swap /healthz scrape is
+    #                          stale -> one fresh re-read converges
+    "distill_push_torn",     # distiller: torn weight payload -> the
+    #                          all-or-nothing swap validation bounces
 )
 
 # legacy aliases (round 9/11 knobs) folded into the unified config

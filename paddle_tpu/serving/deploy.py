@@ -1,0 +1,376 @@
+"""Versioned live weight deployment (round 21): the push half of the
+training↔serving loop.
+
+Weights are ARGUMENTS of every compiled step program (no recompile on
+change), and the front-end lock is held across each engine step — so a
+weight swap that takes that lock is, by construction, a one-step
+quiesce: no program can be mid-flight while the argument pytree
+changes.  This module adds the missing coordination layer on top:
+
+- :class:`WeightRegistry` — named weight sets ("target", "draft")
+  under MONOTONIC version ids; handles are in-process array lists or
+  bytes-on-disk (``.npz`` under ``PADDLE_TPU_SERVING_DEPLOY_DIR``).
+- :class:`RollingDeployer` — rolls a fleet one replica at a time:
+  stop placement on the replica (router drain), finish its in-flight
+  streams on the version they started on, quiesce-swap the argument
+  pytree under the engine lock (``ServingFrontend.swap_weights`` —
+  the blessed path, graftlint ``weight-swap-lock``), flush
+  stale-weight K/V (``clear_prefix()`` detaches + invalidates any
+  spilled kvtier chains), and re-admit.  The new version is advertised
+  in ``/healthz`` and ``/metrics``.
+
+The router side pins every in-flight stream to the weight version it
+started on (the ``cache_dtype`` skew-guard pattern, router.py), so a
+failover resubmission mid-rollout can never splice tokens computed
+under two versions into one stream.
+
+Failure contract (the chaos points police it): every swap failure —
+``deploy_swap_fail``, a torn payload, a dead replica — must degrade to
+the replica SERVING THE OLD VERSION, never to a failed request.  The
+swap itself is all-or-nothing: the payload is validated against the
+model's full tensor list (count, shape, dtype-compatibility) before
+the first ``_data`` write, so a torn push (``distill_push_torn``)
+leaves the old weights untouched.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from .chaos import ChaosConfig, ChaosInjector
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["DeployError", "RollingDeployer", "WeightRegistry",
+           "snapshot_weights"]
+
+# registry spill directory (bytes-on-disk handles); unset = in-process
+_ENV_DIR = "PADDLE_TPU_SERVING_DEPLOY_DIR"
+# seconds the deployer waits for a replica's in-flight work to finish
+_ENV_DRAIN_S = "PADDLE_TPU_SERVING_DEPLOY_DRAIN_S"
+
+WEIGHT_SET_NAMES = ("target", "draft")
+
+
+class DeployError(RuntimeError):
+    """A deployment step could not be completed (the replica keeps
+    serving the version it already has — this error never propagates
+    into a request stream)."""
+
+
+def snapshot_weights(model):
+    """Host snapshot of a model's generate-state pytree (parameters +
+    buffers, ``_gen_state_tensors`` order) — the registry's in-process
+    weight handle.  Safe to call on a serving engine's model only
+    under the front-end lock (the deployer does; direct callers own
+    the race)."""
+    return [np.asarray(t._data) for t in model._gen_state_tensors()]
+
+
+class WeightRegistry:
+    """Monotonic-versioned store of named weight sets.
+
+    One version counter spans ALL names, so a version id is globally
+    unique and orders target and draft pushes on one timeline (the
+    rollout journal a post-mortem wants).  ``publish`` accepts a model
+    (snapshotted here) or a ready array list; ``spill`` moves a
+    version's bytes to disk (``.npz``), ``get`` loads it back
+    transparently."""
+
+    def __init__(self, dirpath=None):
+        self.dir = dirpath or os.environ.get(_ENV_DIR) or None
+        self._lock = threading.Lock()
+        self._mem = {}        # (name, version) -> [np.ndarray, ...]
+        self._spilled = {}       # (name, version) -> spilled filepath
+        self._latest = {}     # name -> version
+        self._next = 1
+
+    def publish(self, name, weights, *, spill=False):
+        """Register a new version of ``name``; returns its version id.
+        ``weights`` is a model (snapshotted) or a list of arrays
+        (copied — the registry owns its bytes, a later optimizer step
+        on the source must not mutate a published version)."""
+        name = str(name)
+        if hasattr(weights, "_gen_state_tensors"):
+            arrays = snapshot_weights(weights)
+        else:
+            arrays = [np.array(a, copy=True) for a in weights]
+        if not arrays:
+            raise ValueError("empty weight set")
+        with self._lock:
+            version = self._next
+            self._next += 1
+            self._mem[(name, version)] = arrays
+            self._latest[name] = version
+        if spill:
+            self.spill(name, version)
+        return version
+
+    def latest(self, name):
+        """Newest published version id for ``name`` (None if never
+        published)."""
+        with self._lock:
+            return self._latest.get(str(name))
+
+    def versions(self, name):
+        name = str(name)
+        with self._lock:
+            keys = [v for (n, v) in self._mem if n == name]
+            keys += [v for (n, v) in self._spilled if n == name]
+        return sorted(set(keys))
+
+    def get(self, name, version=None):
+        """The array list for (name, version) — latest when version is
+        None; loads spilled versions back from disk."""
+        name = str(name)
+        if version is None:
+            version = self.latest(name)
+        if version is None:
+            raise KeyError(f"no published version of {name!r}")
+        key = (name, int(version))
+        with self._lock:
+            arrays = self._mem.get(key)
+            path = self._spilled.get(key)
+        if arrays is not None:
+            return arrays
+        if path is None:
+            raise KeyError(f"unknown weight version {name}@{version}")
+        with np.load(path, allow_pickle=False) as z:
+            return [z[f"w{i}"] for i in range(len(z.files))]
+
+    def spill(self, name, version):
+        """Move a version's bytes to disk (requires a registry dir);
+        returns the path.  Idempotent."""
+        if not self.dir:
+            raise DeployError(
+                f"no registry dir: set {_ENV_DIR} or pass dirpath=")
+        key = (str(name), int(version))
+        with self._lock:
+            path = self._spilled.get(key)
+            arrays = self._mem.get(key)
+        if path is not None and arrays is None:
+            return path
+        if arrays is None:
+            raise KeyError(f"unknown weight version {name}@{version}")
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, f"{name}-v{int(version)}.npz")
+        tmp = path + ".tmp.npz"  # np.savez appends .npz to bare names
+        np.savez(tmp, **{f"w{i}": a for i, a in enumerate(arrays)})
+        os.replace(tmp, path)  # atomic: readers see whole files only
+        with self._lock:
+            self._spilled[key] = path
+            self._mem.pop(key, None)
+        return path
+
+    def drop(self, name, version):
+        """Forget one version (rollback targets usually stay; this is
+        the retention hook).  Never drops the latest."""
+        key = (str(name), int(version))
+        with self._lock:
+            if self._latest.get(key[0]) == key[1]:
+                raise DeployError(
+                    f"refusing to drop the latest version {key[1]} of "
+                    f"{key[0]!r}")
+            self._mem.pop(key, None)
+            path = self._spilled.pop(key, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def stats(self):
+        with self._lock:
+            return {"names": dict(self._latest),
+                    "in_memory": len(self._mem),
+                    "on_disk": len(self._spilled),
+                    "next_version": self._next}
+
+
+def _replica_weight_version(rep, which="target"):
+    """Best-effort FRESH read of a replica's advertised weight version
+    (None when unknown/unreachable).  Never cache the result — unlike
+    ``cache_dtype`` (fixed for an engine's lifetime) the weight version
+    is mutable mid-life; HTTPReplica.weight_version re-reads /healthz
+    per call for exactly this reason."""
+    fn = getattr(rep, "weight_version", None)
+    if fn is None:
+        return None
+    try:
+        return fn(which) if callable(fn) else fn
+    except Exception:
+        return None
+
+
+class RollingDeployer:
+    """Roll a weight version across a fleet, one replica at a time.
+
+    ``fleet`` is a ServingRouter, a RouterSupervisor, or a bare list of
+    replicas.  With a router, each replica is drained at the ROUTER
+    level first (placement stops, in-flight streams finish on the
+    version they started on — this is what makes the per-stream version
+    pin structurally true on the happy path) and re-admitted after the
+    swap.  Every failure degrades to the old version serving; the
+    rollout report records per-replica quiesce time for the bench."""
+
+    def __init__(self, fleet, registry, *, chaos=None,
+                 drain_timeout_s=None):
+        self.fleet = fleet
+        self.registry = registry
+        if isinstance(chaos, ChaosInjector):
+            self.chaos = chaos
+        else:
+            assert chaos is None or isinstance(chaos, ChaosConfig)
+            self.chaos = ChaosInjector(chaos, name="deploy")
+        if drain_timeout_s is None:
+            drain_timeout_s = float(os.environ.get(_ENV_DRAIN_S)
+                                    or 120.0)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.history = []       # rollout report dicts, oldest first
+
+    # -- fleet resolution --------------------------------------------------
+    def _router(self):
+        f = self.fleet
+        if isinstance(f, (list, tuple)):
+            return None
+        active = getattr(f, "active", None)     # RouterSupervisor
+        if active is not None and hasattr(active, "replicas"):
+            return active
+        return f if hasattr(f, "replicas") else None
+
+    def replicas(self):
+        router = self._router()
+        if router is not None:
+            return list(router.replicas)
+        return list(self.fleet)
+
+    # -- the rollout -------------------------------------------------------
+    def rollout(self, name="target", version=None):
+        """Deploy ``name``@``version`` (latest when None) to every
+        replica, one at a time.  Returns the report dict (also appended
+        to ``self.history``): per-replica ok/quiesce_s/advertised, plus
+        totals.  Replicas that already advertise the version are
+        skipped (idempotent — re-running a half-applied rollout
+        finishes it)."""
+        if name not in WEIGHT_SET_NAMES:
+            raise ValueError(
+                f"unknown weight set {name!r}; one of "
+                f"{WEIGHT_SET_NAMES}")
+        if version is None:
+            version = self.registry.latest(name)
+        if version is None:
+            raise DeployError(f"no published version of {name!r}")
+        arrays = self.registry.get(name, version)
+        report = {"name": name, "version": int(version), "replicas": [],
+                  "ok": 0, "skipped": 0, "failed": 0}
+        for idx, rep in enumerate(self.replicas()):
+            entry = self._deploy_one(idx, rep, name, int(version),
+                                     arrays)
+            report["replicas"].append(entry)
+            key = ("skipped" if entry.get("skipped")
+                   else "ok" if entry["ok"] else "failed")
+            report[key] += 1
+        report["complete"] = report["failed"] == 0
+        self.history.append(report)
+        _log.info("deploy rollout %s@%d: ok=%d skipped=%d failed=%d",
+                  name, version, report["ok"], report["skipped"],
+                  report["failed"])
+        return report
+
+    def rollback(self, name="target", version=None):
+        """Roll the fleet BACK to ``version`` (default: the newest
+        version older than the current latest).  Same path as rollout —
+        a rollback is just a rollout of an older id (versions stay
+        monotonic; the registry never reuses ids)."""
+        if version is None:
+            vs = self.registry.versions(name)
+            if len(vs) < 2:
+                raise DeployError(
+                    f"nothing to roll back to for {name!r}")
+            version = vs[-2]
+        return self.rollout(name, version)
+
+    def sync_replica(self, rep, names=WEIGHT_SET_NAMES):
+        """Bring ONE replica to the registry's latest versions — the
+        autoscaler's grown-replica hook and the supervisor's
+        restart-resync: a rebuilt process serves the build-time (base)
+        weights until this runs.  Best-effort: any failure leaves the
+        replica serving what it has."""
+        out = {}
+        for name in names:
+            version = self.registry.latest(name)
+            if version is None:
+                continue
+            if _replica_weight_version(rep, name) == version:
+                continue
+            try:
+                arrays = self.registry.get(name, version)
+            except KeyError:
+                continue
+            entry = self._deploy_one(None, rep, name, int(version),
+                                     arrays)
+            out[name] = entry
+        return out
+
+    def _deploy_one(self, idx, rep, name, version, arrays):
+        """One replica's deployment: router drain (when driving a
+        router) → quiesce-swap → readmit → verify the advertisement.
+        All failure paths land on ok=False with the OLD version still
+        serving."""
+        entry = {"replica": idx, "name": name, "version": version,
+                 "ok": False, "skipped": False, "quiesce_s": None,
+                 "advertised": None, "error": None}
+        if _replica_weight_version(rep, name) == version:
+            entry["ok"] = entry["skipped"] = True
+            entry["advertised"] = version
+            return entry
+        router = self._router() if idx is not None else None
+        drained = False
+        try:
+            if router is not None:
+                drained = router.drain_replica(
+                    idx, timeout=self.drain_timeout_s)
+                if not drained:
+                    raise DeployError(
+                        f"replica {idx} did not drain within "
+                        f"{self.drain_timeout_s}s")
+            if self.chaos.fire("deploy_swap_fail"):
+                raise DeployError("chaos: deploy_swap_fail")
+            t0 = time.perf_counter()
+            rep.swap_weights(name, arrays, version)
+            entry["quiesce_s"] = time.perf_counter() - t0
+            entry["ok"] = True
+        except Exception as exc:
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+            _log.warning("deploy: replica %s swap %s@%d failed (%s); "
+                         "old version keeps serving", idx, name,
+                         version, entry["error"])
+        finally:
+            if router is not None and drained:
+                try:
+                    router.readmit_replica(idx)
+                except Exception as exc:  # readmit must not kill a rollout
+                    entry["ok"] = False
+                    entry["error"] = (entry["error"] or
+                                      f"readmit: {exc}")
+        if entry["ok"]:
+            stale = self.chaos.fire("deploy_stale_version")
+            advertised = (None if stale
+                          else _replica_weight_version(rep, name))
+            if advertised != version:
+                # a stale advertisement (the cached-/healthz hazard
+                # HTTPReplica.weight_version exists to avoid, or the
+                # chaos point simulating it): the swap is atomic under
+                # the engine lock, so ONE fresh re-read converges —
+                # never re-roll the replica for a stale scrape
+                advertised = _replica_weight_version(rep, name)
+            entry["advertised"] = advertised
+            if advertised is not None and advertised != version:
+                entry["ok"] = False
+                entry["error"] = (f"advertised {advertised} after "
+                                  f"swap to {version}")
+        return entry

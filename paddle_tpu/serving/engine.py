@@ -102,6 +102,7 @@ import time
 import numpy as np
 
 from .chaos import ChaosConfig, ChaosInjector
+from .distill import distill_buffer_from_env
 from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
 from .kvtier import KVTier, host_pool_from_env
 from .metrics import ServingMetrics
@@ -172,7 +173,8 @@ class ServingEngine:
                  max_seq_len=None, eos_token_id=None, watermark_frac=0.05,
                  cache_dtype=None, on_event=None, prefix_cache=None,
                  draft_model=None, speculative_k=None,
-                 weight_quant=None, chaos=None, host_pool=None):
+                 weight_quant=None, chaos=None, host_pool=None,
+                 distill=None):
         cfg, core = self._validate_causal_lm(model)
         if weight_quant is None:
             weight_quant = os.environ.get(
@@ -319,6 +321,20 @@ class ServingEngine:
             self.cache.attach_tier(self.kvtier)
         else:
             self.kvtier = None
+        # versioned live weight deployment (round 21): the per-set
+        # version this engine is serving — 0 = the build-time weights.
+        # Advertised in /healthz (frontend.health) and /metrics so the
+        # router's version-pin skew guard reads it fresh.  Mutates only
+        # through set_weights (graftlint weight-swap-lock).
+        self.weight_version = {"target": 0, "draft": 0}
+        # online draft distillation (round 21): when a DistillBuffer
+        # rides here, the speculative verify loop logs one (history,
+        # target-token) pair per emitted token — free hard-target
+        # supervision for the draft.  None = logging off, the verify
+        # loop pays nothing (distill= arg, else the knob).
+        if distill is None:
+            distill = distill_buffer_from_env()
+        self.distill = distill
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, *, deadline_s=None,
@@ -564,6 +580,67 @@ class ServingEngine:
         rejecting admissions; returns results()."""
         self.start_drain()
         return self.run(max_steps)
+
+    def set_weights(self, which, arrays, version):
+        """Versioned weight hot-swap (round 21) — the ONE blessed
+        mutation site of a serving pytree (graftlint
+        ``weight-swap-lock``); all multi-threaded use goes through
+        ``ServingFrontend.swap_weights``, whose lock is the one-step
+        quiesce.
+
+        Weights are ARGUMENTS of every compiled step (``warrs`` /
+        ``dwarrs`` are rebuilt from ``_gen_state_tensors`` per
+        dispatch), so swapping ``t._data`` here takes effect on the
+        very next step with NO recompile and no jit-cache
+        invalidation.  All-or-nothing: the full payload is validated
+        (count + shape per tensor) before the first write, so a torn
+        push (``distill_push_torn``) leaves the old version serving.
+
+        Target swaps flush the prefix cache — every cached page holds
+        K/V computed under the OLD weights — which also detaches and
+        invalidates the attached KV tier (spilled chains of the old
+        version must never restore).  Draft swaps skip the flush:
+        draft K/V is disposable state and the draft only PROPOSES;
+        the target's verify step decides every emitted token, so a
+        mid-stream draft refresh changes acceptance rate, never
+        output."""
+        import jax.numpy as jnp
+        if which not in ("target", "draft"):
+            raise ValueError(
+                f"unknown weight set {which!r}; 'target' or 'draft'")
+        model = self.model if which == "target" else self.draft
+        if model is None:
+            raise ValueError("engine has no draft model")
+        tensors = model._gen_state_tensors()
+        if len(arrays) != len(tensors):
+            self.metrics.weight_swap_rejects.inc()
+            raise ValueError(
+                f"torn weight payload: {len(arrays)} array(s) for "
+                f"{len(tensors)} tensors")
+        staged = []
+        for i, (t, a) in enumerate(zip(tensors, arrays)):
+            a = np.asarray(a)
+            if tuple(a.shape) != tuple(np.shape(t._data)):
+                self.metrics.weight_swap_rejects.inc()
+                raise ValueError(
+                    f"weight {i} shape {a.shape} != "
+                    f"{tuple(np.shape(t._data))}")
+            staged.append(jnp.asarray(a, dtype=t._data.dtype))
+        for t, a in zip(tensors, staged):
+            t._data = a
+        flushed = 0
+        if which == "target":
+            flushed = self.cache.clear_prefix()
+        self.weight_version[which] = int(version)
+        m = self.metrics
+        m.weight_swaps.inc()
+        (m.weight_version_target if which == "target"
+         else m.weight_version_draft).set(int(version))
+        if self.trace.enabled:
+            self.trace.flight.record(
+                "weight_swap", which=which, version=int(version),
+                tensors=len(tensors), prefix_flushed=flushed)
+        return flushed
 
     def release_live(self):
         """Error path: free every live request's pages and requeue the
@@ -1072,6 +1149,13 @@ class ServingEngine:
                     v = int(toks[i, j])
                     lp = float(lps[i, j])
                 is_draft = j < k and v == int(props[i, j])
+                if self.distill is not None:
+                    # online distillation (round 21): the verify step
+                    # computed the target's token for this history for
+                    # free — log the hard-target pair BEFORE the emit
+                    # appends v to the history
+                    self.distill.log(r.prompt, r.out_tokens, v)
+                    self.metrics.distill_pairs.inc()
                 self._emit_token(r, v, events, logprob=lp)
                 emitted += 1
                 if is_draft:

@@ -320,6 +320,14 @@ class ServingFrontend:
                     "host_pool_pages": (tier_stats or
                                         {}).get("host_pool_pages", 0),
                     "kvtier": tier_stats,
+                    # versioned live deployment (round 21): the weight
+                    # version each set is serving.  MUTABLE mid-life —
+                    # consumers must read it fresh every time (never
+                    # the cache_dtype cached-once pattern); the
+                    # router's version-pin guard depends on that
+                    "weight_version": dict(
+                        getattr(eng, "weight_version", None) or
+                        {"target": 0, "draft": 0}),
                     "requests_finished":
                         eng.metrics.requests_finished.value}
 
@@ -471,6 +479,31 @@ class ServingFrontend:
         a freshly grown replica).  Returns pages restored."""
         with self.lock:
             return self.engine.prewarm_prefix(max_chains)
+
+    # -- versioned live weight deployment (round 21) -----------------------
+    def swap_weights(self, which, arrays, version):
+        """The deployer's quiesce-swap — the ONE blessed multi-threaded
+        path to ``engine.set_weights`` (graftlint ``weight-swap-lock``).
+        The lock below is held across every engine step, so acquiring
+        it IS the one-step quiesce: no compiled program can be
+        mid-flight while the argument pytree changes, whether the loop
+        is live (a mid-traffic draft refresh) or parked (a drained
+        target rollout).  All-or-nothing and raising on a torn payload
+        — the OLD version keeps serving on any failure.  Returns the
+        number of stale-weight prefix pages flushed."""
+        t0 = time.perf_counter()
+        with self.lock:
+            if self._state == "failed":
+                raise Unavailable("front-end is failed")
+            flushed = self.engine.set_weights(which, arrays, version)
+            self.engine.metrics.weight_swap_s.record(
+                time.perf_counter() - t0)
+        return flushed
+
+    def weight_version(self, which="target"):
+        """Fresh read of the serving weight version (never cached —
+        versions are mutable mid-life, unlike cache_dtype)."""
+        return self.engine.weight_version.get(which)
 
     # -- internals ---------------------------------------------------------
     def _check_capacity(self, prompt, max_new, n, prefill_only=False):

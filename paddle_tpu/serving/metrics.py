@@ -218,6 +218,16 @@ class ServingMetrics:
         self.host_pool_pages = Gauge()        # RAM-tier resident pages
         self.host_pool_bytes = Gauge()
         self.disk_pool_pages = Gauge()        # disk-tier resident pages
+        # versioned live weight deployment (round 21): swap counts +
+        # per-swap quiesce latency (lock-held window), and the version
+        # each weight set is serving (what /healthz advertises — the
+        # router's version-pin skew guard reads the same numbers)
+        self.weight_swaps = Counter()         # set_weights that landed
+        self.weight_swap_rejects = Counter()  # torn/mismatched payloads
+        self.weight_swap_s = Histogram(buckets=LATENCY_BUCKETS)
+        self.weight_version_target = Gauge()
+        self.weight_version_draft = Gauge()
+        self.distill_pairs = Counter()        # verify pairs logged
 
     def export(self):
         return {name: m.export() for name, m in vars(self).items()}

@@ -171,6 +171,16 @@ class InProcessReplica:
     def prewarm_prefix(self, max_chains=None):
         return self.frontend.prewarm_prefix(max_chains)
 
+    # -- versioned live weight deployment (round 21) -----------------------
+    def weight_version(self, which="target"):
+        return self.frontend.weight_version(which)
+
+    def swap_weights(self, which, arrays, version):
+        """The deployer's per-replica hop: quiesce-swap under the
+        front-end lock (the blessed path — graftlint
+        ``weight-swap-lock``)."""
+        return self.frontend.swap_weights(which, arrays, version)
+
 
 class _HTTPStream:
     """SSE consumer over one in-flight ``/v1/completions`` request —
@@ -663,6 +673,44 @@ class HTTPReplica:
             return int(json.loads(data).get("restored_pages", 0))
         except (OSError, ReplicaFailed, ValueError, TypeError, KeyError):
             return 0
+
+    # -- versioned live weight deployment (round 21) -----------------------
+    def weight_version(self, which="target"):
+        """FRESH /healthz read EVERY call, deliberately unlike
+        ``cache_dtype`` (cached forever — fixed for an engine's life):
+        the weight version is mutable mid-life, and a cached value
+        here is exactly the stale-advertisement hazard the
+        ``deploy_stale_version`` chaos point models.  None when
+        unreachable or the remote predates versioning."""
+        wv = self.health().get("weight_version")
+        if not isinstance(wv, dict):
+            return None
+        v = wv.get(which)
+        return int(v) if v is not None else None
+
+    def swap_weights(self, which, arrays, version):
+        """Push a weight payload to the remote's quiesce-swap endpoint
+        (npz-over-JSON — sized for draft-scale sets, the online-distill
+        case; fleet-scale target pushes ride a shared registry dir +
+        in-process deployers).  Raises on any failure: the deployer
+        degrades that replica to the old version."""
+        import base64
+        import io
+        buf = io.BytesIO()
+        np.savez(buf, **{f"w{i}": np.asarray(a)
+                         for i, a in enumerate(arrays)})
+        status, data = self._post_json(
+            "/v1/_deploy/swap",
+            {"which": str(which), "version": int(version),
+             "npz_b64": base64.b64encode(buf.getvalue()).decode()})
+        if status != 200:
+            try:
+                msg = json.loads(data)["error"]["message"]
+            except (ValueError, KeyError, TypeError):
+                msg = data[:200]
+            raise ReplicaFailed(
+                f"replica {self.name}: swap HTTP {status}: {msg}")
+        return int(json.loads(data).get("prefix_flushed", 0))
 
     # -- observability -----------------------------------------------------
     def _get(self, path):

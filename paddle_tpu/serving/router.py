@@ -167,6 +167,10 @@ class RouterMetrics:
         self.tier_restores_total = Counter()
         self.tier_restored_pages_total = Counter()
         self.prewarm_restored_pages_total = Counter()  # autoscale grow
+        # versioned live deployment (round 21): placements skipped by
+        # the per-stream version pin (failover mid-rollout must not
+        # splice two weight versions into one stream)
+        self.version_pin_skips_total = Counter()
         self.autoscale_events = LabeledCounter("direction", "role")
         self.replica_healthy = LabeledCounter("replica")   # gauge-ish
         self.replica_draining = LabeledCounter("replica")
@@ -208,6 +212,12 @@ class RouterStream:
         self._finished = [False] * self.n
         self._skip = [0] * self.n
         self.failovers = 0
+        # versioned live deployment (round 21): the target weight
+        # version this stream started on. Set at first successful
+        # placement; every re-placement (failover resubmission) must
+        # land on a replica advertising the SAME version or the
+        # spliced tail would come from different weights.
+        self.pinned_version = None
 
     @property
     def done(self):
@@ -1034,6 +1044,21 @@ class ServingRouter:
         except Exception:
             return None
 
+    def _replica_weight_version(self, i, which="target"):
+        """The replica's CURRENT target weight version, or None when
+        unknown.  Unlike ``cache_dtype`` (immutable for an engine's
+        lifetime, cached forever by HTTPReplica) the version changes
+        mid-life under a rolling deploy, so this must be a FRESH read
+        every call — replica.weight_version() guarantees that."""
+        fn = getattr(self.replicas[i], "weight_version", None)
+        if fn is None:
+            return None
+        try:
+            v = fn(which) if callable(fn) else fn
+            return None if v is None else int(v)
+        except Exception:
+            return None
+
     def _maybe_ship_prefix(self, stream, target_idx):
         """The fleet prefix ship: if the replica we are about to place
         ``stream`` on misses its prompt prefix but another replica
@@ -1112,6 +1137,7 @@ class ServingRouter:
         if not owners:
             return
         tgt_dtype = self._replica_cache_dtype(target_idx)
+        tgt_ver = self._replica_weight_version(target_idx)
         # deepest recorded owner first; recorded depth is approximate,
         # the donor's probe_pages is the truth
         for donor_idx in sorted(owners, key=owners.get, reverse=True):
@@ -1127,6 +1153,15 @@ class ServingRouter:
                 # doomed transfer entirely
                 self.metrics.prefix_ship_skipped_total.inc(
                     reason="dtype_skew")
+                continue
+            donor_ver = self._replica_weight_version(donor_idx)
+            if tgt_ver is not None and donor_ver is not None \
+                    and donor_ver != tgt_ver:
+                # version-skew guard (round 21): K/V computed under
+                # different target weights is stale numerics — shipping
+                # it would splice two versions into one prefill
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="version_skew")
                 continue
             donor = self.replicas[donor_idx]
             try:
@@ -1285,6 +1320,19 @@ class ServingRouter:
                 # a shed walks past would spray copies across the fleet
                 ship_tried = True
                 self._maybe_ship_prefix(stream, idx)
+            if stream.pinned_version is not None:
+                # version pin (round 21): a re-placement mid-rollout
+                # must land on the weight version the stream started
+                # on — the armed splice drops replayed tokens by
+                # COUNT, so a different version's tail would be
+                # silently grafted onto the old version's head.
+                # Candidates advertising a different version are
+                # skipped; unknown (None) is allowed — best-effort,
+                # like the dtype-skew guard.
+                v = self._replica_weight_version(idx)
+                if v is not None and v != stream.pinned_version:
+                    self.metrics.version_pin_skips_total.inc()
+                    continue
             try:
                 inner = self.replicas[idx].submit(stream.prompt,
                                                   **stream.kwargs)
@@ -1304,6 +1352,12 @@ class ServingRouter:
                 continue
             stream._inner = inner
             stream.replica_idx = idx
+            if stream.pinned_version is None:
+                # pin at FIRST placement. Reading after submit is safe
+                # under the deploy protocol: the deployer drains the
+                # replica (placement stops) before swapping, so an
+                # admitted stream cannot interleave with a swap.
+                stream.pinned_version = self._replica_weight_version(idx)
             self._breakers[idx].record_success()
             inner_rid = getattr(inner, "req_id", None)
             self._journal(

@@ -342,6 +342,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._prefix_restore()
         elif self.path == "/v1/_pages/prefix/prewarm":
             self._prefix_prewarm()
+        elif self.path == "/v1/_deploy/swap":
+            self._deploy_swap()
         else:
             self._error(404, f"no route {self.path}",
                         "invalid_request_error")
@@ -631,6 +633,47 @@ class _Handler(BaseHTTPRequestHandler):
                         "invalid_request_error")
             return
         self._json(200, {"restored_pages": int(restored)})
+
+    # -- versioned live weight deployment (round 21) -----------------------
+    def _deploy_swap(self):
+        """Quiesce-swap this engine's weights to a pushed version
+        (npz-over-JSON payload from HTTPReplica.swap_weights).
+        All-or-nothing: a torn/mismatched payload is a 400 and the old
+        version keeps serving — the deployer's degrade contract."""
+        import base64
+        import io
+
+        import numpy as np
+        fe = self.owner.frontend
+        body = self._read_json()
+        if body is None:
+            return
+        if not hasattr(fe, "swap_weights"):
+            self._error(404, "no engine front-end here",
+                        "invalid_request_error")
+            return
+        try:
+            which = str(body["which"])
+            version = int(body["version"])
+            raw = base64.b64decode(body["npz_b64"])
+            with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+                arrays = [z[f"w{i}"] for i in range(len(z.files))]
+        except Exception as e:  # torn b64/zip payloads raise broadly
+            self._error(400, f"bad swap payload: {e}",
+                        "invalid_request_error")
+            return
+        try:
+            flushed = fe.swap_weights(which, arrays, version)
+        except (TypeError, ValueError) as e:
+            self._error(400, f"swap rejected: {e}",
+                        "invalid_request_error")
+            return
+        except Unavailable as e:
+            self._error(503, str(e), "unavailable_error")
+            return
+        self._json(200, {"prefix_flushed": int(flushed),
+                         "weight_version": dict(
+                             fe.engine.weight_version)})
 
     # -- completion flow ---------------------------------------------------
     def _request_id(self):

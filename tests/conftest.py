@@ -68,13 +68,18 @@ def _bench_artifact_guard(request):
     `TestServerReplay`, which the original "TestServingReplay"
     substring never matched, so BENCH_serving_http.json was still
     being overwritten by in-suite runs (caught by the round-14 tier-1
-    run: 30.9 -> 20.1 under suite load, the exact round-12 symptom)."""
+    run: 30.9 -> 20.1 under suite load, the exact round-12 symptom).
+    Round 21: the deploy replay (BENCH_serving_deploy.json via
+    tools/deploy_harness.py --smoke) rides the same glob — which also
+    keeps covering BENCH_serving_kvtier.json and any future
+    BENCH_serving_*.json with zero new per-artifact code."""
     _replay_classes = ("TestServingReplay", "TestServerReplay",
                        "TestServingDisaggReplay", "TestServingKv8Replay",
                        "TestServingTraceReplay",
                        "TestServingPrefixFleetReplay",
                        "TestServingFleetReplay",
-                       "TestServingKvtierReplay")
+                       "TestServingKvtierReplay",
+                       "TestServingDeployReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

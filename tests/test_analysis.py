@@ -38,8 +38,8 @@ def rule_ids(findings):
 # rule registry sanity
 
 class TestRegistry:
-    def test_twelve_rules_with_ids_and_docs(self):
-        assert len(ALL_RULES) == 12
+    def test_thirteen_rules_with_ids_and_docs(self):
+        assert len(ALL_RULES) == 13
         for r in ALL_RULES:
             assert r.id and r.description
         assert set(RULES_BY_ID) == {
@@ -48,7 +48,7 @@ class TestRegistry:
             "chip-kill-on-timeout", "engine-lock-discipline",
             "page-migration-lock", "env-knob-registry",
             "serving-raw-sleep", "fleet-process-spawn",
-            "kvtier-blessed-access"}
+            "kvtier-blessed-access", "weight-swap-lock"}
 
 
 # ---------------------------------------------------------------------------
@@ -722,6 +722,75 @@ class TestKvtierBlessedAccess:
     def test_tier_home_exempt(self):
         assert lint(_KVTIER_BAD_PUT, "paddle_tpu/serving/kvtier.py",
                     "kvtier-blessed-access") == []
+
+
+# ---------------------------------------------------------------------------
+# 7f. weight-swap-lock (round 21)
+
+_SWAP_BAD_RAW_WRITE = """
+    def hot_patch(engine, arrays):
+        # the original bug shape: swapping the argument pytree off the
+        # front-end lock races the step's argument gather, and skips
+        # validation / prefix flush / the version bump
+        for t, a in zip(engine.model._gen_state_tensors(), arrays):
+            t._data = a
+"""
+
+_SWAP_BAD_DIRECT_SET = """
+    def rollout_one(engine, arrays, version):
+        engine.set_weights("target", arrays, version)
+"""
+
+_SWAP_GOOD_FRONTEND = """
+    def rollout_one(frontend, replica, arrays, version):
+        # the blessed chain: replica/front-end wrappers take the lock
+        frontend.swap_weights("target", arrays, version)
+        replica.swap_weights("draft", arrays, version)
+"""
+
+_SWAP_GOOD_READ = """
+    import numpy as np
+
+    def snapshot(model):
+        # READS of the pytree are fine — only writes are the hazard
+        return [np.asarray(t._data) for t in model._gen_state_tensors()]
+"""
+
+
+class TestWeightSwapLock:
+    def test_raw_data_write_flags(self):
+        fs = lint(_SWAP_BAD_RAW_WRITE, "paddle_tpu/serving/newdep.py",
+                  "weight-swap-lock")
+        assert len(fs) == 1
+        assert "set_weights" in fs[0].message
+
+    def test_direct_set_weights_flags(self):
+        fs = lint(_SWAP_BAD_DIRECT_SET, "paddle_tpu/serving/newdep.py",
+                  "weight-swap-lock")
+        assert len(fs) == 1
+        assert "front-end" in fs[0].message or "lock" in fs[0].message
+
+    def test_wrapper_calls_pass(self):
+        assert lint(_SWAP_GOOD_FRONTEND,
+                    "paddle_tpu/serving/newdep.py",
+                    "weight-swap-lock") == []
+
+    def test_reads_pass(self):
+        assert lint(_SWAP_GOOD_READ, "paddle_tpu/serving/newdep.py",
+                    "weight-swap-lock") == []
+
+    def test_engine_home_exempt(self):
+        assert lint(_SWAP_BAD_RAW_WRITE, "paddle_tpu/serving/engine.py",
+                    "weight-swap-lock") == []
+
+    def test_frontend_may_call_set_weights(self):
+        assert lint(_SWAP_BAD_DIRECT_SET,
+                    "paddle_tpu/serving/frontend.py",
+                    "weight-swap-lock") == []
+
+    def test_outside_serving_out_of_scope(self):
+        assert lint(_SWAP_BAD_RAW_WRITE, "paddle_tpu/optimizer.py",
+                    "weight-swap-lock") == []
 
 
 # ---------------------------------------------------------------------------
