@@ -657,6 +657,34 @@ class TestServingSweep:
                      "Rejected", "Unavailable", "EngineDraining",
                      "FaultInjected", "Gauge"):
             assert name in sv.__all__, name
+        # round-21 deploy/distill subsystem surface
+        import paddle_tpu.serving.deploy  # noqa: F401
+        import paddle_tpu.serving.distill  # noqa: F401
+        for name in ("WeightRegistry", "RollingDeployer", "DeployError",
+                     "snapshot_weights", "DistillBuffer",
+                     "DraftDistiller", "distill_buffer_from_env"):
+            assert name in sv.__all__, name
+
+    def test_deploy_surface(self):
+        from paddle_tpu.serving import (DraftDistiller, DistillBuffer,
+                                        RollingDeployer, WeightRegistry)
+        for attr in ("publish", "latest", "versions", "get", "spill",
+                     "drop", "stats"):
+            assert hasattr(WeightRegistry, attr), attr
+        for attr in ("rollout", "rollback", "sync_replica", "replicas"):
+            assert hasattr(RollingDeployer, attr), attr
+        for attr in ("log", "snapshot", "stats"):
+            assert hasattr(DistillBuffer, attr), attr
+        for attr in ("train_once", "push", "run_background", "stop"):
+            assert hasattr(DraftDistiller, attr), attr
+        # the locked swap chain exists end to end (graftlint
+        # weight-swap-lock polices that these stay the ONLY doors)
+        from paddle_tpu.serving import (InProcessReplica, HTTPReplica,
+                                        ServingFrontend, ServingEngine)
+        for cls in (InProcessReplica, HTTPReplica, ServingFrontend):
+            assert hasattr(cls, "swap_weights"), cls
+            assert hasattr(cls, "weight_version"), cls
+        assert hasattr(ServingEngine, "set_weights")
 
     def test_engine_surface(self):
         m = tiny_model(seed=8)
@@ -698,7 +726,11 @@ class TestServingSweep:
                     "cached_pages_gauge", "spec_rounds",
                     "spec_draft_tokens", "spec_accepted_tokens",
                     "spec_fallbacks", "spec_acceptance_rate",
-                    "kv_page_bytes"):
+                    "kv_page_bytes",
+                    # round-21 deploy/distill families
+                    "weight_swaps", "weight_swap_rejects",
+                    "weight_swap_s", "weight_version_target",
+                    "weight_version_draft", "distill_pairs"):
             assert key in ex, key
         assert ex["ttft_s"]["p50"] == pytest.approx(0.1)
         import json
@@ -770,7 +802,13 @@ class TestServingSweep:
                      "PADDLE_TPU_SERVING_FAULT_SEED",
                      "PADDLE_TPU_SERVING_HOST_SAMPLE",
                      "PADDLE_TPU_SERVING_PREFIX_CACHE",
-                     "PADDLE_TPU_SERVING_PROBE_S"):
+                     "PADDLE_TPU_SERVING_PROBE_S",
+                     # round-21 deploy/distill knobs
+                     "PADDLE_TPU_SERVING_DEPLOY_DIR",
+                     "PADDLE_TPU_SERVING_DEPLOY_DRAIN_S",
+                     "PADDLE_TPU_SERVING_DISTILL",
+                     "PADDLE_TPU_SERVING_DISTILL_BUFFER",
+                     "PADDLE_TPU_SERVING_DISTILL_HIST"):
             assert knob in doc, knob
 
 

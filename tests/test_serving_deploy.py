@@ -735,3 +735,32 @@ class TestSupervisionSerialization:
                 "draws interleaved (round-19 hazard)")
         finally:
             backend.close()
+
+
+@pytest.mark.slow
+class TestServingDeployReplay:
+    """The deploy harness's tier-1 shape in a subprocess (the conftest
+    artifact guard snapshots BENCH_serving*.json around this class —
+    the smoke never banks, but belt and braces)."""
+
+    def test_deploy_harness_smoke_gate_passes(self):
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "tools/deploy_harness.py", "--smoke",
+             "--json"],
+            cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0
+        report = json.loads(out)
+        gate = report["deploy_gate"]
+        assert gate["pass"], gate
+        assert gate["zero_version_splices"]
+        assert gate["all_replicas_on_new_version"]
+        assert gate["acceptance_improved"]
+        assert gate["distill_tokens_identical"]
+        assert report["rolling_deploy"]["quiesce_s"]["max"] is not None

@@ -22,6 +22,15 @@ wave: a page-starved engine spilling evicted prefix chains to a tiny
 host/disk pool and restoring them on the second pass, under
 ``tier_spill_fail`` / ``tier_restore_fail`` / ``tier_slow_io`` /
 ``tier_corrupt_payload`` (the pagewire CRC catches the bit-rot).
+Round 21 adds a VERSIONED-DEPLOYMENT wave: a RollingDeployer rolls new
+target weights across a spec fleet mid-traffic under
+``deploy_swap_fail`` (pre-swap bounce → old version serves, re-rollout
+converges) and ``deploy_stale_version`` (stale advertisement → one
+fresh re-read converges), with version-pinned exactness — every client
+stream matches ONE version's oracle in its entirety, never a
+cross-version splice — then trains a draft on the wave's logged verify
+pairs and pushes it under ``distill_push_torn`` (a torn payload
+bounces whole on the engine's all-or-nothing validation).
 After every wave the GLOBAL recovery invariants are asserted:
 
 - two-allocator page conservation on every engine (target + draft),
@@ -116,6 +125,11 @@ BACKEND_RATES = {"replica_proc_kill": 0.05}
 # engine would have done anyway (token exactness holds regardless)
 KVTIER_RATES = {"tier_spill_fail": 0.15, "tier_restore_fail": 0.15,
                 "tier_slow_io": 0.3, "tier_corrupt_payload": 0.3}
+# versioned live deployment (round 21): the deployer's swap chaos and
+# the distiller's torn-push chaos — every one must degrade to the OLD
+# version serving, never a failed request, never a cross-version splice
+DEPLOY_RATES = {"deploy_swap_fail": 0.35, "deploy_stale_version": 0.5}
+DISTILL_RATES = {"distill_push_torn": 0.5}
 
 
 def tiny_model(seed=0, **kw):
@@ -568,10 +582,160 @@ def run_kvtier_wave(seed, n_requests, max_new, flavor):
         pool.clear()
 
 
+def consume_pinned(router, prompt, max_new, deadline_s=LIVENESS_S):
+    """Version-pinned client for the deploy wave: a stream that dies
+    terminally is resubmitted from SCRATCH (the partial is dropped),
+    never spliced — the resubmission may land on a different weight
+    version, and a splice across versions is exactly the bug class the
+    wave hunts.  Returns the one full stream that completed."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"liveness: request not completed in {deadline_s}s")
+        try:
+            stream = router.submit(prompt, max_new_tokens=max_new)
+        except (Rejected, Unavailable):
+            time.sleep(0.02)  # drained/deploying: client retry-after
+            continue
+        got = []
+        try:
+            for ev in stream.events(timeout=deadline_s):
+                if ev["type"] == "token":
+                    got.append(ev["token"])
+            return got
+        except RuntimeError:
+            continue  # stream died: restart fresh on some version
+
+
+def run_deploy_wave(seed, n_requests, max_new):
+    """One versioned-deployment wave (round 21): a 3-replica spec fleet
+    serves client streams WHILE a RollingDeployer rolls the target
+    weights to a new version under ``deploy_swap_fail`` (pre-swap
+    bounce: the old version keeps serving, a re-rollout converges by
+    idempotence) and ``deploy_stale_version`` (stale advertisement:
+    one fresh re-read converges, never a re-roll).  Exactness is
+    version-pinned: every client stream must match ONE version's
+    fault-free oracle in its entirety — a mixed-oracle stream is a
+    cross-version splice, the structural failure the per-stream pin
+    exists to prevent.  Then the distill leg trains a draft copy on
+    the verify pairs engine 0 logged and pushes it through the same
+    deployer under ``distill_push_torn``: a torn payload must bounce
+    WHOLE on the engine's all-or-nothing validation (no replica ever
+    advertises a torn version) and a later clean push must land."""
+    from paddle_tpu.serving import (DistillBuffer, DraftDistiller,
+                                    RollingDeployer, WeightRegistry,
+                                    snapshot_weights)
+    rng = np.random.default_rng(seed + 29)
+    prompts = rng_prompts(rng, n_requests, shared_frac=0.25)
+    want_old = oracle_tokens(prompts, max_new)
+    want_new = oracle_tokens(prompts, max_new,
+                             engine_kw={"model_seed": 7})
+    assert want_old != want_new, "oracle versions indistinguishable"
+    buf = DistillBuffer(capacity=256, max_history=8)
+    engines = [make_engine(0, chaos=engine_chaos(seed, 20 + i),
+                           draft_model=tiny_draft(1), speculative_k=2,
+                           distill=buf if i == 0 else None)
+               for i in range(3)]
+    for eng in engines:
+        warm_engine(eng)
+    router = ServingRouter([InProcessReplica(e) for e in engines],
+                           page_size=4)
+    reg = WeightRegistry()
+    new_v = reg.publish("target", snapshot_weights(tiny_model(7)))
+    dep = RollingDeployer(
+        router, reg, drain_timeout_s=LIVENESS_S,
+        chaos=ChaosConfig(seed=seed * 59, rates=DEPLOY_RATES,
+                          retry_base_s=0.001, retry_max_s=0.01))
+    router.start()
+    try:
+        results = [None] * n_requests
+        errs = []
+
+        def worker(i):
+            try:
+                results[i] = consume_pinned(router, prompts[i],
+                                            max_new)
+            except Exception as e:  # noqa: BLE001 - recorded, gated
+                errs.append((i, repr(e)))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        # roll mid-traffic; chaos swap failures leave failed entries
+        # with the old version serving — re-running the SAME rollout
+        # finishes it (idempotence is the retry contract)
+        deadline = time.monotonic() + LIVENESS_S
+        while True:
+            report = dep.rollout("target", new_v)
+            if report["complete"]:
+                break
+            assert time.monotonic() < deadline, (
+                "target rollout never completed: "
+                + json.dumps(report["replicas"]))
+        for t in threads:
+            t.join(timeout=LIVENESS_S)
+            assert not t.is_alive(), "liveness: consumer thread stuck"
+        assert not errs, f"deploy-wave stream failures: {errs}"
+        for i, got in enumerate(results):
+            assert got in (want_old[i], want_new[i]), (
+                "cross-version splice on the deploy wave: "
+                + json.dumps({"i": i, "got": got, "old": want_old[i],
+                              "new": want_new[i]}))
+        for rep in router.replicas:
+            assert rep.weight_version("target") == new_v, (
+                "replica not on the rolled version after completion")
+        # post-rollout traffic is exclusively on the new version
+        tail = consume_pinned(router, prompts[0], max_new)
+        assert tail == want_new[0], (
+            "post-rollout stream not on the new version")
+        router.drain(timeout=LIVENESS_S)
+        fleet_invariants(router)
+        check_metrics_consistency(router, n_requests)
+        # distill leg: engine 0's verify step fed the buffer during the
+        # wave; train the draft copy and push under torn-payload chaos
+        assert len(buf) > 0, "spec wave logged no distill pairs"
+        dist = DraftDistiller(
+            tiny_draft(9), buf, lr=1e-2, batch_size=16, min_pairs=1,
+            chaos=ChaosConfig(seed=seed * 61, rates=DISTILL_RATES,
+                              retry_base_s=0.001, retry_max_s=0.01))
+        dist.train_once(max_steps=2)
+        landed = None
+        deadline = time.monotonic() + LIVENESS_S
+        while landed is None and time.monotonic() < deadline:
+            out = dist.push(reg, dep)
+            v, rolled = out["version"], out["rolled"]
+            # a swap-chaos bounce converges by re-rolling the SAME
+            # version; a torn payload never can (the arrays themselves
+            # are short) — the error text tells them apart
+            while (not rolled["complete"]
+                   and any(e["error"] and "deploy_swap_fail"
+                           in e["error"]
+                           for e in rolled["replicas"])
+                   and time.monotonic() < deadline):
+                rolled = dep.rollout("draft", v)
+            if rolled["complete"]:
+                landed = v
+            else:
+                for rep in router.replicas:
+                    assert rep.weight_version("draft") != v, (
+                        "torn draft push half-landed on a replica")
+        assert landed is not None, (
+            "no clean draft push landed within the deadline")
+        for rep in router.replicas:
+            assert rep.weight_version("draft") == landed
+        return collect_counts(router,
+                              extra_injectors=(dep.chaos, dist.chaos))
+    finally:
+        router.close()
+
+
 def run_seed(seed, smoke=False):
     """One full fuzz round for one seed: a disagg wave (flavor cycles
     fp32-spec / int8 by seed parity) + an HTTP wave + the round-19
-    control-plane wave + the round-20 hierarchical-KV-tier wave."""
+    control-plane wave + the round-20 hierarchical-KV-tier wave + the
+    round-21 versioned-deployment wave."""
     flavor = "spec" if seed % 2 == 0 else "int8"
     n = 3 if smoke else 6
     counts = Tally()
@@ -581,6 +745,7 @@ def run_seed(seed, smoke=False):
     counts.update(run_fleet_wave(seed, 2 if smoke else 5, max_new=6))
     counts.update(run_kvtier_wave(seed, 3 if smoke else 6, max_new=6,
                                   flavor=flavor))
+    counts.update(run_deploy_wave(seed, 2 if smoke else 4, max_new=6))
     return flavor, counts
 
 

@@ -26,6 +26,10 @@ bash tools/fleet_smoke.sh || exit 1
 # fault-point/conservation classes — runtime-bounded, CPU-only; banks
 # nothing (the script snapshots BENCH_serving_kvtier.json itself).
 bash tools/kvtier_smoke.sh || exit 1
+# deploy smoke (ISSUE 17): rolling weight swap under traffic + replica
+# kill, version-pinned exactness + distill acceptance gates —
+# runtime-bounded, CPU-only; never banks BENCH_serving_deploy.json.
+bash tools/deploy_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
