@@ -246,6 +246,20 @@ class RandomResizedCrop:
         import random as _r
         arr = np.asarray(img)
         chw = arr.ndim == 3 and not _hwc(arr, self.data_format)
+        # Resolve the layout ONCE and thread it through: the random
+        # crop can land on an ambiguous shape (e.g. width 3 or 4), so
+        # the internal crop/resize must inherit this resolution, never
+        # re-run the heuristic on the cropped array.
+        df = ("CHW" if chw else "HWC") if arr.ndim == 3 else None
+        rs = Resize(self.size, data_format=df)
+
+        def _crop(top, left, ch, cw):
+            ha, wa = _spatial(arr, df)
+            sl = [slice(None)] * arr.ndim
+            sl[ha] = slice(top, top + ch)
+            sl[wa] = slice(left, left + cw)
+            return arr[tuple(sl)]
+
         h, w = (arr.shape[1:] if chw else arr.shape[:2])
         area = h * w
         for _ in range(10):
@@ -256,8 +270,9 @@ class RandomResizedCrop:
             if 0 < cw <= w and 0 < ch <= h:
                 top = _r.randint(0, h - ch)
                 left = _r.randint(0, w - cw)
-                return resize(crop(img, top, left, ch, cw), self.size)
-        return resize(center_crop(img, min(h, w)), self.size)
+                return rs(_crop(top, left, ch, cw))
+        m = min(h, w)
+        return rs(_crop(max((h - m) // 2, 0), max((w - m) // 2, 0), m, m))
 
 
 class ColorJitter:
