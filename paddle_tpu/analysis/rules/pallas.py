@@ -11,8 +11,13 @@ from ..core import Rule, dotted_name
 # block-shape element: a block sized by one of these scales VMEM with
 # the sequence instead of staying O(block) (the 16 MB scoped-VMEM
 # invariant; stream via grid axes with output accumulation instead).
+# Round 22 adds the token-packed names (t/tok*/n_tok*/tcap): the ragged
+# kernel's T axis is batch*seq-scaled, so a T-sized block is the same
+# hazard — the unified kernel streams it as the grid axis, one token
+# cell per instance.
 _SEQ_NAME = re.compile(
-    r"(?i)^(s|sk|sq|skv|seq\w*|\w*seq|\w*_len|\w*len|n_ctx|ctx\w*)$")
+    r"(?i)^(s|sk|sq|skv|seq\w*|\w*seq|\w*_len|\w*len|n_ctx|ctx\w*"
+    r"|t|nt|tcap|tok(en)?s?|n_tok\w*|ntok\w*|\w*_toks?)$")
 # short names that merely END in "len"/"s" but are clearly not lengths
 _SEQ_NAME_EXCLUDES = {"lanes", "len"}
 
@@ -26,8 +31,9 @@ class PallasHazards(Rule):
     2. ``pltpu.prng_seed``/``pltpu.prng_random_bits`` — no
        interpret-mode lowering; use the counter-hash (plain i32 vector
        ops) for in-kernel RNG.
-    3. BlockSpec block shapes scaling with a sequence axis — per-
-       instance VMEM must stay O(block), never O(sequence)."""
+    3. BlockSpec block shapes scaling with a sequence axis (or the
+       ragged kernel's packed-token axis, which is batch*seq-scaled) —
+       per-instance VMEM must stay O(block), never O(sequence)."""
 
     id = "pallas-hazards"
     description = ("program_id in loop bodies, pltpu.prng_*, and "

@@ -13,8 +13,10 @@ Layers:
   step; the per-step host fetch is [B] ids + [B] logprobs, not [B, V]
   logits (host numpy oracle behind PADDLE_TPU_SERVING_HOST_SAMPLE=1).
 - :mod:`attention`  — paged attention: jax gather reference path
-  (oracle-parity with the contiguous static cache) + a Pallas stub
-  gated behind ``PADDLE_TPU_PAGED_KERNEL`` (interpret-mode only).
+  (oracle-parity with the contiguous static cache) + ONE unified
+  ragged Pallas kernel gated behind ``PADDLE_TPU_PAGED_KERNEL``
+  (interpret-mode only; round 22 folded the decode-only stub into it —
+  ``ragged_paged_attention`` is the token-packed mixed-batch entry).
 - :mod:`scheduler`  — continuous batching: watermark admission, chunked
   prefill, decode-priority iteration, deadlines, LIFO preemption.
 - :mod:`engine`     — bucketed fixed-shape compiled step (weights as
@@ -158,7 +160,8 @@ Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
 """
-from .attention import paged_attention, paged_attention_ref  # noqa: F401
+from .attention import (paged_attention, paged_attention_ref,  # noqa: F401
+                        ragged_paged_attention)
 from .autoscale import FleetAutoscaler  # noqa: F401
 from .chaos import (FAULT_POINTS, Backoff, ChaosConfig,  # noqa: F401
                     ChaosInjector, CircuitBreaker)
@@ -196,7 +199,8 @@ from .trace import (FlightRecorder, RequestTrace,  # noqa: F401
 
 __all__ = [
     "PagedKVCache", "OutOfPages", "SCRATCH_PAGE",
-    "paged_attention", "paged_attention_ref", "fused_sample",
+    "paged_attention", "paged_attention_ref", "ragged_paged_attention",
+    "fused_sample",
     "Scheduler", "SchedulerOutput", "Request", "RequestState",
     "ServingEngine", "EngineDraining", "FaultInjected",
     "ServingMetrics", "Counter", "Gauge", "Histogram", "LabeledCounter",

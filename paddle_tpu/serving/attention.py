@@ -9,13 +9,27 @@ Two paths, selected by ``PADDLE_TPU_PAGED_KERNEL``:
   like models/generation.py::cached_attention (same einsums, same f32
   accumulation, same absolute-position mask), so it is CPU-testable and
   oracle-comparable against the contiguous static-cache path to 1e-5.
-- ``PADDLE_TPU_PAGED_KERNEL=1`` — a Pallas kernel STUB for the decode
-  (S=1) shape, validated in INTERPRET MODE ONLY this round (CLAUDE.md:
-  no first-time Mosaic compiles in the bench path while the chip grant
-  is wedged). It streams pages with an online-softmax accumulator — the
+- ``PADDLE_TPU_PAGED_KERNEL=1`` — ONE unified ragged Pallas kernel
+  (round 18, replacing the decode-only S=1 stub): the grid streams over
+  packed query TOKENS, each grid cell resolving its own lane's
+  (page_table row, context_len, absolute position), so decode lanes
+  (q=1), prefill chunks, and speculative-verify bursts (q=k+1) all run
+  through the same program. Validated in INTERPRET MODE ONLY this round
+  (CLAUDE.md: no first-time Mosaic compiles while the chip grant is
+  wedged). It streams pages with an online-softmax accumulator — the
   structure the real kernel needs — but reads the whole page pool per
   grid cell, which a Mosaic build must replace with per-page DMA to
   respect the O(block) VMEM invariant before it can be compile-gated.
+
+:func:`ragged_paged_attention` is the token-packed entry point
+(PAPERS.md "Ragged Paged Attention"): ``q [T, H, D]`` carries the
+concatenated query tokens of L lanes, each lane with its own
+``(query_len, context_len, q_offset)``; padding tokens (beyond
+``sum(query_lens)``) attend position 0 of the last lane — garbage but
+NaN-free, masked out by the caller. :func:`paged_attention` keeps the
+rectangular [B, S] surface and, under the kernel gate, routes through
+the SAME ragged kernel (row b = one lane of query_len S) — one gated
+kernel, not two.
 
 Both paths accept GQA natively (query heads grouped over KV heads, no
 materialized head repeat) and a Mistral-style sliding ``window``.
@@ -36,7 +50,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_attention", "paged_attention_ref", "quantize_q8"]
+__all__ = ["paged_attention", "paged_attention_ref",
+           "ragged_paged_attention", "quantize_q8"]
 
 
 def quantize_q8(x):
@@ -62,14 +77,71 @@ def paged_attention(q, k_pages, v_pages, page_table, context_lens,
     q_offsets [B] int32 — absolute position of each row's first query.
     Returns [B,S,H,D] in q.dtype.
     """
-    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1" \
-            and q.shape[1] == 1:
-        return _paged_attention_kernel(q, k_pages, v_pages, page_table,
-                                       context_lens, q_offsets,
-                                       scale=scale, window=window)
+    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
+        # rectangular [B, S] is the degenerate ragged batch: row b is a
+        # lane of query_len S — expand per token and run the ONE kernel
+        b, s, nh, d = q.shape
+        pt_tok = jnp.repeat(page_table, s, axis=0)
+        cl_tok = jnp.repeat(context_lens, s)
+        pos_tok = (q_offsets[:, None].astype(jnp.int32)
+                   + jnp.arange(s, dtype=jnp.int32)[None, :]).reshape(-1)
+        out = _ragged_attention_kernel(
+            q.reshape(b * s, nh, d), k_pages, v_pages, pt_tok, cl_tok,
+            pos_tok, scale=scale, window=window)
+        return out.reshape(b, s, nh, d)
     return paged_attention_ref(q, k_pages, v_pages, page_table,
                                context_lens, q_offsets, scale=scale,
                                window=window)
+
+
+def _token_lanes(query_lens, q_offsets, t):
+    """Token-packed lane resolution: map packed query index -> (lane,
+    absolute position). Padding tokens (index >= sum(query_lens)) clamp
+    to the last lane at position 0 — their row only needs to be NaN-free
+    (every lane keeps context_len >= 1 by the engine's padding
+    contract), the caller discards the output."""
+    ql = query_lens.astype(jnp.int32)
+    ends = jnp.cumsum(ql)
+    tok = jnp.arange(t, dtype=jnp.int32)
+    lane = jnp.searchsorted(ends, tok, side="right").astype(jnp.int32)
+    lane = jnp.minimum(lane, ql.shape[0] - 1)
+    pos = q_offsets[lane].astype(jnp.int32) + tok - (ends - ql)[lane]
+    pos = jnp.where(tok < ends[-1], pos, 0)
+    return lane, pos
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table,
+                           context_lens, query_lens, q_offsets, *,
+                           scale, window=None):
+    """Token-packed mixed-batch paged attention (one program for
+    decode + prefill + verify lanes).
+
+    q [T, H, D] — lane-major packed query tokens (lane 0's query_lens[0]
+    tokens, then lane 1's, ...; trailing padding up to T);
+    page_table [L, P] int32 per LANE (pad = scratch page 0);
+    context_lens [L] int32 — valid K tokens per lane INCLUDING any just
+    scattered (>= 1 even for padded lanes); query_lens [L] int32 (0 for
+    padded lanes); q_offsets [L] int32 — absolute position of each
+    lane's first query token. Returns [T, H, D] in q.dtype; padding
+    rows are garbage but finite.
+
+    Default path delegates to :func:`paged_attention_ref` with one row
+    per token (the oracle — identical einsums/mask, so GQA, sliding
+    window, and the int8 (codes, scales) tuple layout are inherited);
+    ``PADDLE_TPU_PAGED_KERNEL=1`` runs the unified interpret-mode
+    Pallas kernel on the same per-token expansion.
+    """
+    t = q.shape[0]
+    lane, pos = _token_lanes(query_lens, q_offsets, t)
+    pt_tok = page_table[lane]
+    cl_tok = context_lens[lane].astype(jnp.int32)
+    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
+        return _ragged_attention_kernel(q, k_pages, v_pages, pt_tok,
+                                        cl_tok, pos, scale=scale,
+                                        window=window)
+    return paged_attention_ref(q[:, None], k_pages, v_pages, pt_tok,
+                               cl_tok, pos, scale=scale,
+                               window=window)[:, 0]
 
 
 def paged_attention_ref(q, k_pages, v_pages, page_table, context_lens,
@@ -122,24 +194,26 @@ def paged_attention_ref(q, k_pages, v_pages, page_table, context_lens,
     return out.reshape(b, s, nh, d).astype(q.dtype)
 
 
-def _paged_attention_kernel(q, k_pages, v_pages, page_table,
-                            context_lens, q_offsets, *, scale,
-                            window=None):
-    """Decode-shape (S=1) Pallas stub, interpret mode only (see module
-    docstring). Grid over batch; one online-softmax pass over the page
-    list per cell. int8 caches add the scale pools as two extra
-    operands; dequant happens per page inside the streaming loop (the
-    codes and the scale row of ONE page at a time — O(page) VMEM, the
-    shape a Mosaic build keeps)."""
+def _ragged_attention_kernel(q, k_pages, v_pages, pt_tok, cl_tok,
+                             pos_tok, *, scale, window=None):
+    """Unified ragged Pallas kernel, interpret mode only (see module
+    docstring). q [T, H, D] packed tokens; pt_tok [T, P] / cl_tok [T] /
+    pos_tok [T] are the PER-TOKEN lane rows (gathered by the caller, so
+    the grid cell's BlockSpecs stay O(1)-indexed). Grid over tokens —
+    decode, prefill-chunk, and verify tokens are indistinguishable
+    cells; one online-softmax pass over the page list per cell. int8
+    caches add the scale pools as two extra operands; dequant happens
+    per page inside the streaming loop (the codes and the scale row of
+    ONE page at a time — O(page) VMEM, the shape a Mosaic build
+    keeps)."""
     from jax.experimental import pallas as pl
 
-    b, s, nh, d = q.shape
-    assert s == 1, "kernel stub covers the decode (S=1) shape only"
+    t, nh, d = q.shape
     quant = isinstance(k_pages, tuple)
     if quant:
         (k_pages, k_scales), (v_pages, v_scales) = k_pages, v_pages
     np_, ps, nkv, _ = k_pages.shape
-    p = page_table.shape[1]
+    p = pt_tok.shape[1]
     g = nh // nkv
     win = int(window) if window else 0
 
@@ -151,7 +225,7 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table,
         pt = pt_ref[...][0]                       # [P]
         cl = cl_ref[...][0]
         qpos = qo_ref[...][0]
-        qh = q_ref[...][0, 0].astype(jnp.float32).reshape(nkv, g, d)
+        qh = q_ref[...][0].astype(jnp.float32).reshape(nkv, g, d)
         # interpret-mode full read; a Mosaic build must DMA per page
         k_all = k_ref[...]
         v_all = v_ref[...]
@@ -199,20 +273,19 @@ def _paged_attention_kernel(q, k_pages, v_pages, page_table,
     in_specs = [pl.BlockSpec((1, p), lambda i: (i, 0)),
                 pl.BlockSpec((1,), lambda i: (i,)),
                 pl.BlockSpec((1,), lambda i: (i,)),
-                pl.BlockSpec((1, 1, nh, d), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((1, nh, d), lambda i: (i, 0, 0)),
                 full_k, full_k]
-    operands = [page_table, context_lens, q_offsets, q, k_pages,
-                v_pages]
+    operands = [pt_tok, cl_tok, pos_tok, q, k_pages, v_pages]
     if quant:
         full_s = pl.BlockSpec(k_scales.shape, lambda i: (0, 0, 0))
         in_specs += [full_s, full_s]
         operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
-        grid=(b,),
+        grid=(t,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nh, d), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, nh, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((t, nh, d), q.dtype),
         interpret=True,
     )(*operands)
-    return out[:, None]
+    return out

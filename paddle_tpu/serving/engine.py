@@ -174,7 +174,7 @@ class ServingEngine:
                  cache_dtype=None, on_event=None, prefix_cache=None,
                  draft_model=None, speculative_k=None,
                  weight_quant=None, chaos=None, host_pool=None,
-                 distill=None):
+                 distill=None, ragged=None):
         cfg, core = self._validate_causal_lm(model)
         if weight_quant is None:
             weight_quant = os.environ.get(
@@ -268,6 +268,26 @@ class ServingEngine:
                                    prefill_chunk=prefill_chunk,
                                    watermark_frac=watermark_frac,
                                    spec_reserve_tokens=self.spec_k)
+        # -- unified ragged step (round 22 / PR 18) ------------------------
+        # ONE token-packed program for mixed prefill+decode+verify
+        # steps (attention.py::ragged_paged_attention lane layout):
+        # opt-in via ragged= or PADDLE_TPU_SERVING_RAGGED=1; the
+        # bucketed path stays the default and the exactness oracle.
+        if ragged is None:
+            ragged = os.environ.get("PADDLE_TPU_SERVING_RAGGED") == "1"
+        self.ragged = bool(ragged)
+        self._ragged_fn = None        # one jit fn; <= 2 token shapes
+        self._ragged_bufs = {}        # per-capacity persistent buffers
+        # static geometry: L lanes always (max_batch decode/verify + 1
+        # prefill); token capacity is one of TWO shapes — all-decode
+        # steps pack into max_batch tokens, anything with a prefill
+        # chunk or verify bursts pads to the mixed capacity. That pins
+        # the compiled-program-class count at <= 2.
+        self._ragged_lanes = max_batch + 1
+        self._ragged_tok_small = max_batch
+        self._ragged_tok_mixed = (max_batch * (self.spec_k + 1)
+                                  + prefill_chunk)
+        self._program_classes = set()  # static shape keys dispatched
         self.metrics = ServingMetrics()
         # always-on span timeline + flight recorder (round 16): every
         # mutation happens from the thread that drives the engine —
@@ -457,13 +477,17 @@ class ServingEngine:
             self.metrics.deadline_evictions.inc()
             self._record_finish(r, events)
         self.sweep_held_deadlines(now)
-        if out.decode:
-            self._decode_batch(out.decode, events)
-        if out.prefill is not None:
-            req, start, end = out.prefill
-            # the decode batch may have preempted the prefilling request
-            if req.state == RequestState.PREFILLING:
-                self._prefill_chunk(req, start, end, events)
+        if self.ragged:
+            self._ragged_step(out, events)
+        else:
+            if out.decode:
+                self._decode_batch(out.decode, events)
+            if out.prefill is not None:
+                req, start, end = out.prefill
+                # the decode batch may have preempted the prefilling
+                # request
+                if req.state == RequestState.PREFILLING:
+                    self._prefill_chunk(req, start, end, events)
         if not out.decode and out.prefill is None and not out.expired \
                 and self.scheduler.waiting \
                 and not self.scheduler.live_requests():
@@ -908,6 +932,7 @@ class ServingEngine:
             toks = np.asarray(tok_d, np.int32)
             lps = np.asarray(lp_d, np.float32)
             self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            self.metrics.step_fetches.inc()
             for i, (r, _) in enumerate(active):
                 self._emit_token(r, int(toks[i]), events,
                                  logprob=float(lps[i]))
@@ -1030,6 +1055,49 @@ class ServingEngine:
             have += n
         return True
 
+    def _stage_draft_propose(self, active):
+        """Build the bucketed draft arrays for the surviving verify
+        lanes and run the fused k+1-step proposal scan (shared by the
+        bucketed `_spec_round` and the ragged step — the draft program
+        stays its own dispatch in both: different model, disposable
+        K/V). ``active`` rows are ``(req, hist0, n_slots, tslots,
+        dslots)``. Returns ``(props [bb, k+1] int32, samp,
+        sample_capable)``."""
+        k1 = self.spec_k + 1
+        bb = self._bucket(len(active))
+        mp = self.max_pages_per_seq
+        dids = np.zeros((bb, 1), np.int32)
+        dpos = np.zeros(bb, np.int32)
+        dpt = np.full((bb, mp), SCRATCH_PAGE, np.int32)
+        dcl = np.ones(bb, np.int32)
+        dslot = np.zeros((bb, k1), np.int32)
+        do_sample = np.zeros(bb, np.bool_)
+        temperature = np.ones(bb, np.float32)
+        top_k = np.zeros(bb, np.int32)
+        top_p = np.ones(bb, np.float32)
+        seeds = np.zeros(bb, np.int32)
+        steps0 = np.zeros(bb, np.int32)
+        for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
+            dids[i, 0] = r.out_tokens[-1]
+            dpos[i] = hist0 - 1
+            dpt[i] = self._draft_cache.page_table(r.seq_id, mp)
+            dcl[i] = hist0
+            dslot[i, :n_slots] = dslots
+            do_sample[i] = r.do_sample
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = r.device_seed
+            steps0[i] = len(r.out_tokens)
+        samp = (do_sample, temperature, top_k, top_p, seeds, steps0)
+        sample_capable = any(r.do_sample for r, *_ in active)
+        props = np.asarray(self._run_draft_propose(
+            dids, dpos, dpt, dcl, dslot, samp, sample_capable),
+            np.int32)                                  # [bb, k+1]
+        self.metrics.fetch_bytes.inc(props.nbytes)
+        self.metrics.step_fetches.inc()
+        return props, samp, sample_capable
+
     def _spec_round(self, lanes, plain, events):
         """One draft-propose / target-verify round over the speculative
         lanes: k+1 fused draft steps (ONE dispatch), ONE [B, k+1]
@@ -1075,35 +1143,7 @@ class ServingEngine:
             return
         bb = self._bucket(len(active))
         mp = self.max_pages_per_seq
-        dids = np.zeros((bb, 1), np.int32)
-        dpos = np.zeros(bb, np.int32)
-        dpt = np.full((bb, mp), SCRATCH_PAGE, np.int32)
-        dcl = np.ones(bb, np.int32)
-        dslot = np.zeros((bb, k1), np.int32)
-        do_sample = np.zeros(bb, np.bool_)
-        temperature = np.ones(bb, np.float32)
-        top_k = np.zeros(bb, np.int32)
-        top_p = np.ones(bb, np.float32)
-        seeds = np.zeros(bb, np.int32)
-        steps0 = np.zeros(bb, np.int32)
-        for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
-            dids[i, 0] = r.out_tokens[-1]
-            dpos[i] = hist0 - 1
-            dpt[i] = self._draft_cache.page_table(r.seq_id, mp)
-            dcl[i] = hist0
-            dslot[i, :n_slots] = dslots
-            do_sample[i] = r.do_sample
-            temperature[i] = r.temperature
-            top_k[i] = r.top_k
-            top_p[i] = r.top_p
-            seeds[i] = r.device_seed
-            steps0[i] = len(r.out_tokens)
-        samp = (do_sample, temperature, top_k, top_p, seeds, steps0)
-        sample_capable = any(r.do_sample for r, *_ in active)
-        props = np.asarray(self._run_draft_propose(
-            dids, dpos, dpt, dcl, dslot, samp, sample_capable),
-            np.int32)                                  # [bb, k+1]
-        self.metrics.fetch_bytes.inc(props.nbytes)
+        props, samp, sample_capable = self._stage_draft_propose(active)
         ids = np.zeros((bb, k1), np.int32)
         positions = np.zeros((bb, k1), np.int32)
         pt = np.full((bb, mp), SCRATCH_PAGE, np.int32)
@@ -1134,6 +1174,7 @@ class ServingEngine:
             toks = np.asarray(toks, np.int32)
             lps = np.asarray(lps, np.float32)
             self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            self.metrics.step_fetches.inc()
         accepted = 0
         for i, (r, hist0, n_slots, tslots, dslots) in enumerate(active):
             emitted = 0
@@ -1199,6 +1240,7 @@ class ServingEngine:
             tuple(jnp.asarray(a) for a in samp),
             k_ops, v_ops)
         dc.store_operands(k_pages, v_pages)
+        self._count_dispatch(("draft_step", ids.shape))
 
     def _run_draft_propose(self, ids0, pos0, pt, cl0, slot_mat, samp,
                            sample_capable):
@@ -1224,6 +1266,8 @@ class ServingEngine:
             tuple(jnp.asarray(a) for a in samp),
             k_ops, v_ops)
         dc.store_operands(k_pages, v_pages)
+        self._count_dispatch(("draft_propose", slot_mat.shape,
+                              bool(sample_capable)))
         return props
 
     def _prefill_chunk(self, req, start, end, events):
@@ -1278,32 +1322,43 @@ class ServingEngine:
         self.scheduler.prefill_advanced(req, end)
         if req.state != RequestState.RUNNING:
             return  # more chunks to go
-        # prefill complete: fork BEFORE sampling (children share the
-        # prefix pages; the parent may finish — and free — immediately).
-        # A RECOMPUTE prefill (out_tokens non-empty after preemption)
-        # must NOT fork again: the children already exist.
+        if host:
+            self._prefill_finish(req, events, True, 0, None, None)
+        else:
+            toks = np.asarray(tok_d, np.int32)
+            lps = np.asarray(lp_d, np.float32)
+            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            self.metrics.step_fetches.inc()
+            self._prefill_finish(req, events, False, 0, int(toks[0]),
+                                 float(lps[0]))
+
+    def _prefill_finish(self, req, events, host, row_idx, tok, lp):
+        """Prefill-completion tail, shared by the bucketed chunk and
+        the ragged step (``row_idx`` selects the request's last-token
+        logits row in the step's logits — 0 for the bucketed [1, V]
+        fetch, the packed token offset for the ragged [T, V] one).
+        Fork BEFORE sampling (children share the prefix pages; the
+        parent may finish — and free — immediately). A RECOMPUTE
+        prefill (out_tokens non-empty after preemption) must NOT fork
+        again: the children already exist."""
         children = []
         if req.n > 1 and not req.out_tokens:
             for i in range(1, req.n):
                 children.append(self._fork(req, i))
         if host:
-            row = self._fetch_logits()[0]
+            row = self._fetch_logits()[row_idx]
             self._emit_token(req, self._sample(req, row), events)
             for child in children:
                 self._emit_token(child, self._sample(child, row),
                                  events)
         else:
-            toks = np.asarray(tok_d, np.int32)
-            lps = np.asarray(lp_d, np.float32)
-            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
-            self._emit_token(req, int(toks[0]), events,
-                             logprob=float(lps[0]))
+            self._emit_token(req, tok, events, logprob=lp)
             if children:
                 # one fetched row, several seeds: children sample
                 # eagerly with the SAME counter-RNG function; a child's
                 # later recompute (token index >= 1) goes through the
                 # compiled path with the same (seed, step) arguments
-                row = self._fetch_logits()[0]
+                row = self._fetch_logits()[row_idx]
                 for child in children:
                     ctok, clp = _counter_sample_row(row, child)
                     self._emit_token(child, ctok, events, logprob=clp)
@@ -1322,6 +1377,311 @@ class ServingEngine:
         if self.trace.enabled:
             self.trace.mark(req.req_id, "held_t0", self._now())
         self._record_finish(req, events)
+
+    # -- unified ragged step (round 22 / PR 18) ----------------------------
+    def _ragged_step(self, out, events):
+        """ONE token-packed dispatch for the whole step: plain decode
+        lanes (q=1), speculative-verify lanes (q=k+1), and the prefill
+        chunk ride a single compiled program over the
+        ``ragged_paged_attention`` lane layout — one dispatch + one
+        host fetch per step, the relay fixed-cost win (FEASIBILITY.md:
+        per-dispatch overhead ~0.79 of a small step). Per-token
+        counter-RNG keys are IDENTICAL to the bucketed path's
+        ((seed, token-index) is schedule-independent), so streams are
+        token-exact vs it even though preemption ORDER may differ —
+        any valid schedule replays the same (weights, history, seed, t)
+        function. The draft-proposal scan stays its own dispatch
+        (different model, disposable K/V); draft catchup prefills ride
+        ahead of it exactly as in `_spec_round`."""
+        t0 = self._now()
+        k = self.spec_k
+        k1 = k + 1
+        mp = self.max_pages_per_seq
+        spec, plain = [], []
+        for r in out.decode:
+            (spec if self._spec_enabled(r) else plain).append(r)
+        # 1. draft staging (catchup prefills are draft-model
+        # dispatches); lanes the draft cannot serve demote to plain
+        staged = []
+        protect = {r.seq_id for r in spec}
+        for r in spec:
+            if r.state != RequestState.RUNNING:
+                continue
+            if not self._draft_ready(r, protect):
+                self.metrics.spec_fallbacks.inc()
+                plain.append(r)
+                continue
+            staged.append(r)
+        spec_alloc = []
+        for r in staged:
+            if r.state != RequestState.RUNNING:
+                continue  # preempted by an earlier member's allocation
+            hist0 = r.prompt.size + len(r.out_tokens)
+            rem = r.max_new_tokens - len(r.out_tokens)
+            n_slots = min(k1, rem)
+            tslots = self._alloc_with_preemption(r, n_slots)
+            if r.state != RequestState.RUNNING:  # pragma: no cover
+                continue
+            dslots = self._draft_alloc(r.seq_id, n_slots, protect)
+            if dslots is None:
+                self.cache.free_tail(r.seq_id, hist0 - 1)
+                self.metrics.spec_fallbacks.inc()
+                plain.append(r)
+                continue
+            spec_alloc.append((r, hist0, n_slots, tslots, dslots))
+        # 2. plain decode allocation
+        plain_alloc = []
+        for r in plain:
+            if r.state != RequestState.RUNNING:
+                continue
+            slots = self._alloc_with_preemption(r, 1)
+            plain_alloc.append((r, int(slots[0])))
+        # 3. prefill-chunk allocation (it may preempt a staged decode
+        # lane; the re-filter below drops that lane — its pages are
+        # gone, and the recompute replays an identical stream)
+        pf = None
+        if out.prefill is not None:
+            req, start, end = out.prefill
+            if req.state == RequestState.PREFILLING:
+                if self.trace.enabled:
+                    q0 = self.trace.pop_mark(req.req_id, "queued_t0")
+                    if q0 is not None:
+                        self.trace.span(req.req_id, "queued", q0,
+                                        t0 - q0)
+                if not self.cache.has_seq(req.seq_id):
+                    self.cache.alloc_seq(req.seq_id)
+                chunk = req.token_history()[start:end]
+                n = int(chunk.size)
+                pslots = self._alloc_with_preemption(req, n)
+                if req.state == RequestState.PREFILLING:
+                    pf = (req, start, end, chunk, n, pslots)
+        # 4. re-filter: every lane must still be live AFTER all
+        # allocations — a preempted lane's page-table row is dead
+        spec_active = [a for a in spec_alloc
+                       if a[0].state == RequestState.RUNNING]
+        plain_active = [(r, s) for r, s in plain_alloc
+                        if r.state == RequestState.RUNNING]
+        if not spec_active and not plain_active and pf is None:
+            return
+        # 5. draft proposals for the surviving verify lanes
+        props = None
+        if spec_active:
+            props, _, _ = self._stage_draft_propose(spec_active)
+        # 6. pack the token batch. Two static token capacities only
+        # (see __init__): a step fits the small all-decode shape or
+        # pads to the mixed one.
+        n_tok = (sum(a[2] for a in spec_active) + len(plain_active)
+                 + (pf[4] if pf is not None else 0))
+        tcap = (self._ragged_tok_small
+                if n_tok <= self._ragged_tok_small
+                else self._ragged_tok_mixed)
+        assert n_tok <= tcap, (n_tok, tcap)
+        b = self._ragged_bufs.get(tcap)
+        if b is None:
+            nl = self._ragged_lanes
+            b = self._ragged_bufs[tcap] = {
+                "ids": np.zeros((1, tcap), np.int32),
+                "positions": np.zeros((1, tcap), np.int32),
+                "slot_map": np.zeros((1, tcap), np.int32),
+                "pt": np.full((nl, mp), SCRATCH_PAGE, np.int32),
+                "cl": np.ones(nl, np.int32),
+                "ql": np.zeros(nl, np.int32),
+                "qoff": np.zeros(nl, np.int32),
+                "do_sample": np.zeros(tcap, np.bool_),
+                "temperature": np.ones(tcap, np.float32),
+                "top_k": np.zeros(tcap, np.int32),
+                "top_p": np.ones(tcap, np.float32),
+                "seeds": np.zeros(tcap, np.int32),
+                "steps": np.zeros(tcap, np.int32),
+            }
+        else:
+            # full padding reset: lane composition changes every step
+            # (padded lanes keep context 1 / scratch pages / neutral
+            # sampling — the NaN-free contract)
+            b["ids"][:] = 0
+            b["positions"][:] = 0
+            b["slot_map"][:] = 0
+            b["pt"][:] = SCRATCH_PAGE
+            b["cl"][:] = 1
+            b["ql"][:] = 0
+            b["qoff"][:] = 0
+            b["do_sample"][:] = False
+            b["temperature"][:] = 1.0
+            b["top_k"][:] = 0
+            b["top_p"][:] = 1.0
+            b["seeds"][:] = 0
+            b["steps"][:] = 0
+        lane = 0
+        off = 0
+        emit_spec = []                    # (req, hist0, n_slots, i, off)
+        for i, (r, hist0, n_slots, tslots, dslots) in \
+                enumerate(spec_active):
+            b["pt"][lane] = self.cache.page_table(r.seq_id, mp)
+            b["cl"][lane] = hist0 - 1 + n_slots
+            b["ql"][lane] = n_slots
+            b["qoff"][lane] = hist0 - 1
+            sl = slice(off, off + n_slots)
+            b["ids"][0, off] = r.out_tokens[-1]
+            if n_slots > 1:
+                b["ids"][0, off + 1:off + n_slots] = \
+                    props[i, :n_slots - 1]
+            b["positions"][0, sl] = hist0 - 1 + np.arange(
+                n_slots, dtype=np.int32)
+            b["slot_map"][0, sl] = tslots
+            b["do_sample"][sl] = r.do_sample
+            b["temperature"][sl] = r.temperature
+            b["top_k"][sl] = r.top_k
+            b["top_p"][sl] = r.top_p
+            b["seeds"][sl] = r.device_seed
+            # verify token j samples with counter key steps0+j — the
+            # flattened fused_sample_multi key of the bucketed verify
+            b["steps"][sl] = len(r.out_tokens) + np.arange(
+                n_slots, dtype=np.int32)
+            emit_spec.append((r, hist0, n_slots, i, off))
+            lane += 1
+            off += n_slots
+        emit_plain = []                                  # (req, off)
+        for r, slot in plain_active:
+            hist_len = r.prompt.size + len(r.out_tokens)
+            b["pt"][lane] = self.cache.page_table(r.seq_id, mp)
+            b["cl"][lane] = hist_len
+            b["ql"][lane] = 1
+            b["qoff"][lane] = hist_len - 1
+            b["ids"][0, off] = r.out_tokens[-1]
+            b["positions"][0, off] = hist_len - 1
+            b["slot_map"][0, off] = slot
+            b["do_sample"][off] = r.do_sample
+            b["temperature"][off] = r.temperature
+            b["top_k"][off] = r.top_k
+            b["top_p"][off] = r.top_p
+            b["seeds"][off] = r.device_seed
+            b["steps"][off] = len(r.out_tokens)
+            emit_plain.append((r, off))
+            lane += 1
+            off += 1
+        pf_off = None
+        if pf is not None:
+            req, start, end, chunk, n, pslots = pf
+            b["pt"][lane] = self.cache.page_table(req.seq_id, mp)
+            b["cl"][lane] = start + n
+            b["ql"][lane] = n
+            b["qoff"][lane] = start
+            sl = slice(off, off + n)
+            b["ids"][0, sl] = chunk
+            b["positions"][0, sl] = start + np.arange(n,
+                                                      dtype=np.int32)
+            b["slot_map"][0, sl] = pslots
+            # only the chunk's LAST token's sample is ever consumed
+            # (at prefill completion); earlier tokens keep the neutral
+            # params and their greedy output is discarded
+            pf_off = off + n - 1
+            b["do_sample"][pf_off] = req.do_sample
+            b["temperature"][pf_off] = req.temperature
+            b["top_k"][pf_off] = req.top_k
+            b["top_p"][pf_off] = req.top_p
+            b["seeds"][pf_off] = req.device_seed
+            b["steps"][pf_off] = len(req.out_tokens)
+            lane += 1
+            off += n
+        # 7. ONE dispatch, ONE [T]+[T] host fetch
+        tok_d, lp_d = self._run_ragged_step(
+            b["ids"], b["positions"], b["pt"], b["cl"], b["ql"],
+            b["qoff"], b["slot_map"],
+            (b["do_sample"], b["temperature"], b["top_k"], b["top_p"],
+             b["seeds"], b["steps"]))
+        if spec_active:
+            self.metrics.spec_rounds.inc()
+            self.metrics.spec_draft_tokens.inc(
+                sum(min(k, a[2]) for a in spec_active))
+        if spec_active or plain_active:
+            self.metrics.decode_steps.inc()
+            self.metrics.batch_size.record(
+                len(spec_active) + len(plain_active))
+        if pf is not None:
+            self.metrics.prefill_chunks.inc()
+        host = self._host_sampling()
+        toks = lps = logits = None
+        if host:
+            logits = self._fetch_logits()                     # [T, V]
+        else:
+            toks = np.asarray(tok_d, np.int32)
+            lps = np.asarray(lp_d, np.float32)
+            self.metrics.fetch_bytes.inc(toks.nbytes + lps.nbytes)
+            self.metrics.step_fetches.inc()
+        # 8. host-side per-lane processing, bucketed event order:
+        # verify lanes, plain lanes, then the prefill completion
+        accepted = 0
+        for r, hist0, n_slots, i, toff in emit_spec:
+            emitted = 0
+            lane_accepted = 0
+            for j in range(n_slots):
+                if host:
+                    v = self._sample(r, logits[toff + j])
+                    lp = None
+                else:
+                    v = int(toks[toff + j])
+                    lp = float(lps[toff + j])
+                is_draft = j < k and v == int(props[i, j])
+                if self.distill is not None:
+                    self.distill.log(r.prompt, r.out_tokens, v)
+                    self.metrics.distill_pairs.inc()
+                self._emit_token(r, v, events, logprob=lp)
+                emitted += 1
+                if is_draft:
+                    accepted += 1
+                    lane_accepted += 1
+                if r.state == RequestState.FINISHED or not is_draft:
+                    break  # mismatch emits the correction; j==k bonus
+            if r.state != RequestState.FINISHED:
+                new_len = hist0 + emitted - 1
+                self.cache.free_tail(r.seq_id, new_len)
+                self._draft_cache.free_tail(r.seq_id, new_len)
+            if self.trace.enabled:
+                self.trace.run_span(r.req_id, "spec_round", t0,
+                                    self._now() - t0,
+                                    batch=len(spec_active),
+                                    proposed=min(k, n_slots),
+                                    accepted=lane_accepted,
+                                    emitted=emitted)
+        if spec_active:
+            self.metrics.spec_accepted_tokens.inc(accepted)
+        for r, toff in emit_plain:
+            if host:
+                self._emit_token(r, self._sample(r, logits[toff]),
+                                 events)
+            else:
+                self._emit_token(r, int(toks[toff]), events,
+                                 logprob=float(lps[toff]))
+            if self.trace.enabled:
+                self.trace.run_span(r.req_id, "ragged_round", t0,
+                                    self._now() - t0,
+                                    batch=len(plain_active))
+        if pf is not None:
+            req, start, end, chunk, n, pslots = pf
+            if self.trace.enabled:
+                self.trace.span(
+                    req.req_id,
+                    ("recompute" if (req.out_tokens or req.preemptions)
+                     else "prefill_chunk"),
+                    t0, self._now() - t0, start=int(start),
+                    end=int(end), tokens=n)
+            if self.cache.prefix_cache_enabled:
+                self.cache.commit_prefix(req.seq_id, req.prompt, end)
+            self.scheduler.prefill_advanced(req, end)
+            if req.state == RequestState.RUNNING:
+                if host:
+                    self._prefill_finish(req, events, True, pf_off,
+                                         None, None)
+                else:
+                    self._prefill_finish(req, events, False, pf_off,
+                                         int(toks[pf_off]),
+                                         float(lps[pf_off]))
+        if self.trace.enabled:
+            self.trace.flight.record(
+                "ragged_step", tokens=int(n_tok), cap=int(tcap),
+                lanes=int(lane), spec=len(emit_spec),
+                plain=len(emit_plain),
+                prefill=(pf[0].req_id if pf is not None else None))
 
     # -- KV page migration (disaggregated serving, round 14) ---------------
     def export_request(self, req_id, skip_pages=0):
@@ -1648,6 +2008,7 @@ class ServingEngine:
         sampling / fork seeding) and account the fetch."""
         out = np.asarray(self._logits_dev, np.float32)
         self.metrics.fetch_bytes.inc(out.nbytes)
+        self.metrics.step_fetches.inc()
         return out
 
     def _sync_prefix_metrics(self):
@@ -1667,6 +2028,23 @@ class ServingEngine:
         if m.spec_draft_tokens.value:
             m.spec_acceptance_rate.set(m.spec_accepted_tokens.value
                                        / m.spec_draft_tokens.value)
+
+    def _count_dispatch(self, key):
+        """Account one device dispatch and its compiled program class
+        (``key`` is the static shape signature that keys the jit trace
+        cache). ``step_program_classes`` is the gauge the ragged path
+        bounds at <= 2; the bucketed path grows one class per decode
+        bucket plus the prefill and verify shapes. Draft-model programs
+        (the propose scan is its own dispatch by design — different
+        model, disposable K/V) count as dispatches but not as step
+        classes."""
+        self.metrics.step_dispatches.inc()
+        if key[0].startswith("draft"):
+            return
+        if key not in self._program_classes:
+            self._program_classes.add(key)
+            self.metrics.step_program_classes.set(
+                len(self._program_classes))
 
     def _run_step(self, ids, positions, pt, cl, slot_map, last_idx,
                   samp, sample_capable, multi_pos=False):
@@ -1692,6 +2070,35 @@ class ServingEngine:
             k_ops, v_ops)
         self.cache.store_operands(k_pages, v_pages)
         self._logits_dev = logits  # NOT fetched on the decode hot path
+        self._count_dispatch(("step", ids.shape, bool(multi_pos),
+                              bool(sample_capable)))
+        return tok, lp
+
+    def _run_ragged_step(self, ids, positions, pt, cl, ql, qoff,
+                         slot_map, samp):
+        import jax
+        import jax.numpy as jnp
+        if self._ragged_fn is None:
+            # ONE jit fn; the token capacity in {small, mixed} bounds
+            # its trace cache at two entries — the <= 2-program-class
+            # contract. The sampler is always compiled sample-capable:
+            # greedy lanes take the argmax/raw-logprob branch inside
+            # fused_sample, so pinning the static flag costs an unused
+            # sort, not exactness (and keeps greedy and sampled steps
+            # in the SAME class).
+            self._ragged_fn = jax.jit(
+                functools.partial(_ragged_step_pure, self.model,
+                                  self._core, self.window))
+        warrs = [t._data for t in self.model._gen_state_tensors()]
+        k_ops, v_ops = self.cache.program_operands()
+        tok, lp, logits, k_pages, v_pages = self._ragged_fn(
+            warrs, jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(pt), jnp.asarray(cl), jnp.asarray(ql),
+            jnp.asarray(qoff), jnp.asarray(slot_map),
+            tuple(jnp.asarray(a) for a in samp), k_ops, v_ops)
+        self.cache.store_operands(k_pages, v_pages)
+        self._logits_dev = logits          # [T, V], fetched on demand
+        self._count_dispatch(("ragged", ids.shape[1]))
         return tok, lp
 
 
@@ -1733,15 +2140,20 @@ def _paged_step_pure(model, core, window, sample_capable, multi_pos,
 
 
 def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
-                   k_pages, v_pages):
+                   k_pages, v_pages, ragged=None):
     """The transformer trunk over the paged cache: embed, attend (K/V
     scattered into the page pool), final norm. Shared by the target
-    step program, the draft catchup step, and the draft proposal scan.
-    Returns ``(hidden [B, S, D] jnp array, new_k, new_v)``."""
+    step program, the draft catchup step, the draft proposal scan, and
+    the unified ragged step. ``ragged=(query_lens, q_offsets)`` flips
+    attention to the token-packed lane layout: ids/positions/slot_map
+    are [1, T] (the scatter is shape-agnostic) while pt/cl are the
+    [L, P]/[L] PER-LANE arrays. Returns ``(hidden [B, S, D] jnp array,
+    new_k, new_v)``."""
     from ..core.autograd import no_grad
     from ..core.tensor import Tensor
     from ..incubate.nn.functional import fused_rotary_position_embedding
-    from .attention import paged_attention, quantize_q8
+    from .attention import (paged_attention, quantize_q8,
+                            ragged_paged_attention)
 
     b, s = ids.shape
     flat_slots = slot_map.reshape(-1)
@@ -1789,9 +2201,15 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
                 ).reshape(npg, ps, nkv, hd)
             new_k.append(kp)
             new_v.append(vp)
-            out = paged_attention(
-                q._data, kp, vp, pt, cl, positions[:, 0],
-                scale=1.0 / (hd ** 0.5), window=window)
+            if ragged is None:
+                out = paged_attention(
+                    q._data, kp, vp, pt, cl, positions[:, 0],
+                    scale=1.0 / (hd ** 0.5), window=window)
+            else:
+                ql, qoff = ragged
+                out = ragged_paged_attention(
+                    q._data[0], kp, vp, pt, cl, ql, qoff,
+                    scale=1.0 / (hd ** 0.5), window=window)[None]
             h = x + at.o_proj(Tensor(out).reshape([b, s, nh * hd]))
             x = h + layer.mlp(layer.post_attention_layernorm(h))
         x = core.norm(x)
@@ -1833,6 +2251,53 @@ def _paged_step_body(model, core, window, sample_capable, multi_pos,
     tokens, logprobs = fused_sample(
         logits, do_sample, temperature, top_k, top_p, seeds, steps,
         sample_capable=sample_capable)
+    return tokens, logprobs, logits, new_k, new_v
+
+
+# -- the unified ragged step (round 22 / PR 18) ----------------------------
+
+def _ragged_step_pure(model, core, window, warrs, ids, positions, pt,
+                      cl, ql, qoff, slot_map, samp, k_pages, v_pages):
+    tensors = model._gen_state_tensors()
+    saved = [(t, t._data) for t in tensors]
+    for t, arr in zip(tensors, warrs):
+        t._data = arr
+    try:
+        return _ragged_step_body(model, core, window, ids, positions,
+                                 pt, cl, ql, qoff, slot_map, samp,
+                                 k_pages, v_pages)
+    finally:
+        for t, arr in saved:
+            t._data = arr
+
+
+def _ragged_step_body(model, core, window, ids, positions, pt, cl, ql,
+                      qoff, slot_map, samp, k_pages, v_pages):
+    """Token-packed unified step: the trunk runs at [1, T], lm_head +
+    fused sampling cover EVERY packed token (each with its own
+    per-token counter key — a verify token j carries steps0+j, exactly
+    fused_sample_multi's flattened key; a prefill chunk's non-final
+    tokens carry neutral params and their samples are discarded), and
+    the host fetch is [T] ids + [T] logprobs. Always compiled
+    sample-capable: greedy lanes take fused_sample's argmax/raw-logprob
+    branch, so values match the greedy-compiled bucketed programs
+    bit-for-bit while greedy and sampled steps share ONE class."""
+    import jax.numpy as jnp
+
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+
+    x, new_k, new_v = _paged_forward(core, window, ids, positions, pt,
+                                     cl, slot_map, k_pages, v_pages,
+                                     ragged=(ql, qoff))
+    from .sampling import fused_sample
+    do_sample, temperature, top_k, top_p, seeds, steps = samp
+    with no_grad():
+        logits = model.lm_head(Tensor(x))._data[0]           # [T, V]
+    logits = logits.astype(jnp.float32)
+    tokens, logprobs = fused_sample(
+        logits, do_sample, temperature, top_k, top_p, seeds, steps,
+        sample_capable=True)
     return tokens, logprobs, logits, new_k, new_v
 
 

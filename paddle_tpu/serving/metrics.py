@@ -192,6 +192,14 @@ class ServingMetrics:
         self.prefix_drops = Counter()         # dedup drop_prefix pages
         # decode hot path (round 10)
         self.fetch_bytes = Counter()          # host<-device bytes/steps
+        # round 22 (PR 18, unified ragged step): dispatch accounting —
+        # every device dispatch / host fetch the engine issues, and the
+        # number of distinct compiled program classes behind them. The
+        # ragged path's contract is <= 2 classes and ONE dispatch + ONE
+        # fetch per mixed prefill+decode step.
+        self.step_dispatches = Counter()      # device dispatches issued
+        self.step_fetches = Counter()         # host<-device fetches
+        self.step_program_classes = Gauge()   # distinct compiled classes
         self.prefix_hit_pages = Counter()     # prompt pages served from
         self.prefix_miss_pages = Counter()    # the radix tree vs prefilled
         self.prefix_evictions = Counter()     # cached pages LRU-reclaimed

@@ -79,7 +79,8 @@ def _bench_artifact_guard(request):
                        "TestServingPrefixFleetReplay",
                        "TestServingFleetReplay",
                        "TestServingKvtierReplay",
-                       "TestServingDeployReplay")
+                       "TestServingDeployReplay",
+                       "TestServingRaggedReplay")
     if not any(c in request.node.nodeid for c in _replay_classes):
         yield
         return

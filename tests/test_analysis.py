@@ -238,6 +238,23 @@ _PALLAS_BLOCKSPEC_GOOD = """
         return pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0))
 """
 
+# round 22: the ragged kernel's packed-token axis T is batch*seq-scaled
+# — a T-sized block is the same O(seq) VMEM hazard by another name
+_PALLAS_BLOCKSPEC_TOK_BAD = """
+    from jax.experimental import pallas as pl
+
+    def build(t, nh, d):
+        return pl.BlockSpec((t, nh, d), lambda i: (0, 0, 0))
+"""
+
+_PALLAS_BLOCKSPEC_TOK_GOOD = """
+    from jax.experimental import pallas as pl
+
+    def build(t, nh, d):
+        # one token cell per grid instance: block stays O(1) on T
+        return pl.BlockSpec((1, nh, d), lambda i: (i, 0, 0))
+"""
+
 
 class TestPallasHazards:
     def test_program_id_in_fori_loop_body_flags(self):
@@ -263,6 +280,16 @@ class TestPallasHazards:
     def test_block_sized_blockspec_passes(self):
         assert lint(_PALLAS_BLOCKSPEC_GOOD,
                     "paddle_tpu/ops/pallas/k.py",
+                    "pallas-hazards") == []
+
+    def test_token_scaled_blockspec_flags(self):
+        fs = lint(_PALLAS_BLOCKSPEC_TOK_BAD,
+                  "paddle_tpu/serving/attention.py", "pallas-hazards")
+        assert len(fs) == 1 and "VMEM" in fs[0].message
+
+    def test_token_cell_blockspec_passes(self):
+        assert lint(_PALLAS_BLOCKSPEC_TOK_GOOD,
+                    "paddle_tpu/serving/attention.py",
                     "pallas-hazards") == []
 
 

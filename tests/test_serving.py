@@ -664,6 +664,8 @@ class TestServingSweep:
                      "snapshot_weights", "DistillBuffer",
                      "DraftDistiller", "distill_buffer_from_env"):
             assert name in sv.__all__, name
+        # round-22 ragged step surface
+        assert "ragged_paged_attention" in sv.__all__
 
     def test_deploy_surface(self):
         from paddle_tpu.serving import (DraftDistiller, DistillBuffer,
@@ -693,7 +695,8 @@ class TestServingSweep:
         for attr in ("add_request", "step", "run", "results", "metrics",
                      "cache", "scheduler", "cancel", "drain",
                      "start_drain", "draining", "release_live",
-                     "on_event", "request", "draft", "spec_k"):
+                     "on_event", "request", "draft", "spec_k",
+                     "ragged"):
             assert hasattr(eng, attr), attr
 
     def test_frontend_server_surface(self):
@@ -719,7 +722,8 @@ class TestServingSweep:
                     "requests_finished", "preemptions",
                     "deadline_evictions", "cow_copies",
                     "cancellations", "rejections", "faults_injected",
-                    "fetch_bytes", "prefix_hit_pages",
+                    "fetch_bytes", "step_dispatches", "step_fetches",
+                    "step_program_classes", "prefix_hit_pages",
                     "prefix_miss_pages", "prefix_evictions",
                     "queue_depth_gauge", "page_occupancy_gauge",
                     "running_gauge", "prefix_hit_rate",
@@ -808,7 +812,9 @@ class TestServingSweep:
                      "PADDLE_TPU_SERVING_DEPLOY_DRAIN_S",
                      "PADDLE_TPU_SERVING_DISTILL",
                      "PADDLE_TPU_SERVING_DISTILL_BUFFER",
-                     "PADDLE_TPU_SERVING_DISTILL_HIST"):
+                     "PADDLE_TPU_SERVING_DISTILL_HIST",
+                     # round-22 ragged step knob
+                     "PADDLE_TPU_SERVING_RAGGED"):
             assert knob in doc, knob
 
 

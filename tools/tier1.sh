@@ -30,6 +30,10 @@ bash tools/kvtier_smoke.sh || exit 1
 # kill, version-pinned exactness + distill acceptance gates —
 # runtime-bounded, CPU-only; never banks BENCH_serving_deploy.json.
 bash tools/deploy_smoke.sh || exit 1
+# ragged smoke (ISSUE 18): bucketed-vs-ragged step replay, token-exact
+# + <= 2 step program classes — runtime-bounded, CPU-only; never banks
+# BENCH_serving_ragged.json.
+bash tools/ragged_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
