@@ -93,6 +93,15 @@ class ServingServer:
         self.stream_timeout_s = float(stream_timeout_s)
         self._httpd = None
         self._serve_thread = None
+        # close()/abort() can race (a chaos kill drill aborting while the
+        # fleet supervisor tears the replica down): the listener handoff
+        # must be atomic or the loser dereferences a None _httpd.
+        self._teardown_lock = threading.Lock()
+
+    def _take_httpd(self):
+        with self._teardown_lock:
+            httpd, self._httpd = self._httpd, None
+        return httpd
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -127,10 +136,10 @@ class ServingServer:
     def close(self, timeout=120.0):
         """drain() then stop the HTTP listener."""
         drained = self.frontend.drain(timeout)
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        httpd = self._take_httpd()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
         return drained
 
     def abort(self, exc=None):
@@ -144,10 +153,10 @@ class ServingServer:
                 self.frontend.fail(exc or RuntimeError("server aborted"))
         except Exception:  # pragma: no cover - teardown is best-effort
             pass
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        httpd = self._take_httpd()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
 
     # -- request translation ----------------------------------------------
     def _encode(self, body, chat):

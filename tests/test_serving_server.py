@@ -320,6 +320,41 @@ class TestDrain:
             assert eng.cache.free_pages == eng.cache.allocatable_pages
 
 
+class TestTeardownRace:
+    def test_concurrent_close_and_abort(self):
+        """close() and abort() can run concurrently (a chaos kill drill
+        aborting while the fleet supervisor tears the replica down,
+        round-22 in-suite flake): exactly one caller must win the
+        listener handoff — the loser used to dereference a None
+        _httpd."""
+        m = tiny_model(seed=11)
+        for trial in range(4):
+            eng = ServingEngine(m, page_size=4, num_pages=64,
+                                max_batch=4, prefill_chunk=8)
+            srv = ServingServer(eng)
+            srv.start()
+            errs = []
+            tearers = (lambda: srv.close(timeout=30), srv.abort,
+                       srv.abort, lambda: srv.close(timeout=30))
+            barrier = threading.Barrier(len(tearers))
+
+            def tear(fn):
+                barrier.wait()
+                try:
+                    fn()
+                except Exception as e:  # pragma: no cover - the bug
+                    errs.append(e)
+
+            threads = [threading.Thread(target=tear, args=(f,))
+                       for f in tearers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert not errs, errs
+            assert srv._httpd is None
+
+
 # ---------------------------------------------------------------------------
 # observability
 
