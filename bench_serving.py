@@ -7,6 +7,16 @@ Usage: python bench_serving.py [n_requests] [rate_per_s] [max_new]
                                [--router] [--spec] [--disagg] [--kv8]
                                [--trace] [--trace-out FILE]
                                [--prefix-fleet] [--kvtier] [--ragged]
+                               [--tp]
+
+`--tp` measures tensor-parallel SPMD serving (round 23): the same
+Poisson trace replays through one warm engine per shard degree
+(TP ∈ {1, 2} smoke, {1, 2, 4} full) on the 8-device CPU mesh, a
+two-point marginal each, with the token-exactness gate (every TP
+degree's greedy streams identical to TP=1) riding the bench.  The CPU
+mesh proves exactness and baselines collective overhead — virtual
+host devices share cores, so TP>1 marginals are expected BELOW TP=1
+here.  Banks BENCH_serving_tp.json (non-smoke only).
 
 `--ragged` measures the round-22 unified ragged step: the SAME Poisson
 trace replays through a bucketed engine and a ragged one
@@ -181,6 +191,18 @@ if kvtier_mode:
 ragged_mode = "--ragged" in sys.argv
 if ragged_mode:
     sys.argv.remove("--ragged")
+tp_mode = "--tp" in sys.argv
+if tp_mode:
+    sys.argv.remove("--tp")
+    # the TP bench runs on the 8-device CPU mesh (the exactness
+    # contract's reference geometry); the host-device-count flag is
+    # read at XLA backend init, so it must land before any jax import
+    import os as _os
+    if "--xla_force_host_platform_device_count" not in \
+            _os.environ.get("XLA_FLAGS", ""):
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 trace_out = None
 if "--trace-out" in sys.argv:
     i = sys.argv.index("--trace-out")
@@ -298,8 +320,8 @@ def replay_http(model, arrivals, prompts, new_tokens, **engine_kw):
 
 def main():
     from bench import _tpu_usable, force_cpu  # wedge-safe probe + reroute
-    tpu_ok = False if smoke else _tpu_usable(attempts=2, probe_timeout=90,
-                                             backoff=20)
+    tpu_ok = False if (smoke or tp_mode) else _tpu_usable(
+        attempts=2, probe_timeout=90, backoff=20)
     import jax
     if not tpu_ok:
         force_cpu()
@@ -359,6 +381,9 @@ def main():
         return
     if ragged_mode:
         _bench_ragged(model, cfg, engine_kw, on_tpu)
+        return
+    if tp_mode:
+        _bench_tp(model, cfg, engine_kw, on_tpu)
         return
 
     arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
@@ -1886,6 +1911,94 @@ def _bench_ragged(model, cfg, engine_kw, on_tpu):
     print(line)
     if not smoke:
         with open("BENCH_serving_ragged.json", "w") as f:
+            f.write(line + "\n")
+
+
+def _bench_tp(model, cfg, engine_kw, on_tpu):
+    """Tensor-parallel SPMD serving on the 8-device CPU mesh
+    (round 23).
+
+    The SAME Poisson trace replays through one warm engine per shard
+    degree (TP ∈ {1, 2} smoke, {1, 2, 4} full) — warmup replays
+    compile the SPMD program classes off the clock, then quarter +
+    full replays give the two-point marginal per degree.  The
+    exactness gate rides the bench: greedy streams at every TP degree
+    must be token-identical to TP=1 (the by-construction contract —
+    only non-contracting dims shard, so every matmul keeps its full
+    contraction local and collectives are pure data movement).  NOTE
+    the CPU mesh measures program correctness and collective overhead,
+    not a speedup: 8 virtual host devices share the same cores, so
+    marginal tok/s at TP>1 is expected to be BELOW TP=1 here — the
+    artifact exists as the exactness proof + overhead baseline the
+    real-mesh run can diff against.  Banks BENCH_serving_tp.json
+    (non-smoke only)."""
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+    arrivals, prompts = make_trace(n_requests, rate, cfg.vocab_size)
+    new_q = max(1, max_new // 4)
+
+    def measure(tp):
+        eng = ServingEngine(model, tp_degree=(tp if tp > 1 else None),
+                            **engine_kw)
+        warm_n = min(4, n_requests)
+        replay(model, np.zeros(warm_n), prompts[:warm_n], new_q,
+               engine=eng)
+        replay(model, np.zeros(warm_n), prompts[:warm_n], max_new,
+               engine=eng)
+        eng.metrics = ServingMetrics()
+        wall_q, toks_q, _ = replay(model, arrivals, prompts, new_q,
+                                   engine=eng)
+        eng.metrics = ServingMetrics()
+        wall, toks, metrics = replay(model, arrivals, prompts, max_new,
+                                     engine=eng)
+        m = metrics.export()
+        marginal = ((toks - toks_q) / (wall - wall_q)
+                    if wall > wall_q and toks > toks_q else None)
+        out = {
+            "tp_degree": tp,
+            "tok_per_s_marginal": (round(marginal, 1)
+                                   if marginal else None),
+            "e2e_tok_per_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "wall_quarter_s": round(wall_q, 3),
+            "ttft_p50_s": m["ttft_s"]["p50"],
+            "ttft_p99_s": m["ttft_s"]["p99"],
+            "inter_token_p50_s": m["inter_token_s"]["p50"],
+            "tp_kernel_fallbacks": m["tp_kernel_fallbacks"],
+            "preemptions": m["preemptions"],
+        }
+        results = {rid: tuple(r["tokens"])
+                   for rid, r in eng.results().items()}
+        return out, results
+
+    degrees = (1, 2) if smoke else (1, 2, 4)
+    points, ref = [], None
+    for tp in degrees:
+        out, got = measure(tp)
+        points.append(out)
+        if ref is None:
+            ref = got
+        else:
+            # the tentpole contract: TP=k streams token-exact vs TP=1
+            assert sorted(ref.values()) == sorted(got.values()), \
+                f"tp={tp} streams diverged from tp=1"
+    out = {
+        "metric": "serving_tp_exactness" + ("" if on_tpu else "_cpu"),
+        "value": max(degrees),
+        "unit": "max TP degree streaming token-exact vs TP=1 (greedy, "
+                "same Poisson trace, 8-device CPU mesh two-point "
+                "marginals)",
+        "n_requests": n_requests, "rate_per_s": rate,
+        "max_new_tokens": max_new,
+        "token_exact_vs_tp1": True,
+        "mesh_devices": 8,
+        "points": points,
+        "smoke": smoke,
+    }
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        with open("BENCH_serving_tp.json", "w") as f:
             f.write(line + "\n")
 
 

@@ -23,7 +23,7 @@ _SEQ_NAME_EXCLUDES = {"lanes", "len"}
 
 
 class PallasHazards(Rule):
-    """Three Mosaic/interpret-mode hazards in one rule:
+    """Four Mosaic/interpret-mode/GSPMD hazards in one rule:
 
     1. ``pl.program_id`` inside a ``fori_loop``/``while_loop``/``scan``
        body — interpret mode fails with "MLIR translation rule not
@@ -33,12 +33,22 @@ class PallasHazards(Rule):
        ops) for in-kernel RNG.
     3. BlockSpec block shapes scaling with a sequence axis (or the
        ragged kernel's packed-token axis, which is batch*seq-scaled) —
-       per-instance VMEM must stay O(block), never O(sequence)."""
+       per-instance VMEM must stay O(block), never O(sequence).
+    4. A file that both calls ``pallas_call`` and builds GSPMD sharding
+       machinery (``NamedSharding`` / ``Mesh(...)`` construction /
+       ``with_sharding_constraint``) — ``pallas_call`` has no GSPMD
+       partitioning rule, so a kernel traced into an SPMD program is
+       silent wrongness.  Keep kernels and mesh plumbing in separate
+       modules (serving/tp.py vs serving/attention.py is the blessed
+       split; multi-device programs take ``use_pallas=False``-style
+       flags, round-23 ISSUE-19 satellite)."""
 
     id = "pallas-hazards"
-    description = ("program_id in loop bodies, pltpu.prng_*, and "
-                   "seq-scaled BlockSpec shapes hang or fail Mosaic/"
-                   "interpret mode")
+    description = ("program_id in loop bodies, pltpu.prng_*, "
+                   "seq-scaled BlockSpec shapes, and pallas_call mixed "
+                   "with GSPMD sharding constructs hang, fail, or "
+                   "silently mis-partition Mosaic/interpret/SPMD "
+                   "programs")
 
     # -- helpers -----------------------------------------------------------
     def _loop_bodies(self, ctx):
@@ -107,3 +117,29 @@ class PallasHazards(Rule):
                         "O(seq), not O(block); stream via a grid axis "
                         "with output accumulation (16 MB scoped-VMEM "
                         "limit)")
+        # 4. pallas_call mixed with GSPMD sharding constructs in one
+        # module (pallas_call has no GSPMD partitioning rule)
+        pallas_calls = []
+        sharding_refs = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                tail = name.split(".")[-1]
+                if tail == "pallas_call":
+                    pallas_calls.append(node)
+                elif tail in ("NamedSharding", "Mesh",
+                              "with_sharding_constraint"):
+                    sharding_refs.append((tail, node))
+        if pallas_calls and sharding_refs:
+            tails = sorted({t for t, _ in sharding_refs})
+            for node in pallas_calls:
+                yield ctx.finding(
+                    self.id, node,
+                    "`pallas_call` in a module that also builds GSPMD "
+                    f"sharding machinery ({', '.join(tails)}) — "
+                    "pallas_call has no GSPMD partitioning rule, so a "
+                    "kernel traced into an SPMD program silently "
+                    "mis-partitions; keep kernels and mesh plumbing in "
+                    "separate modules and gate the kernel off under "
+                    "SPMD (serving/tp.py vs attention.py is the "
+                    "blessed split)")

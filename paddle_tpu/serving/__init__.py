@@ -156,6 +156,24 @@ Layers:
   Proof: ``tools/deploy_harness.py`` (rolling deploy under SLO-gated
   traffic + chaos, ``BENCH_serving_deploy.json``).
 
+- :mod:`tp` — tensor-parallel SPMD serving (round 23):
+  ``ServingEngine(mesh=...)`` / ``tp_degree=k`` runs the whole
+  decode/prefill/ragged step as ONE GSPMD program over a device mesh —
+  weights committed to mesh shardings (last-output-dim splits composed
+  on top of fleet dist_specs via ``_add_sharding``, never returned
+  verbatim), KV page pools sharded on the head axis (one allocator,
+  replicated page tables), paged attention pinned to the jnp gather
+  path (``pallas_call`` has no GSPMD rule — the kernel knob demotes
+  loudly: log + ``tp_kernel_fallbacks``), and fused sampling still
+  in-program with the partial (vocab-column-sliced) logits
+  all-gathered only at the sampled lane.  Because only non-contracting
+  dims shard, every matmul keeps its full contraction local — a TP=k
+  replica streams token-exact vs TP=1 (greedy AND seeded, across
+  preemption/recompute).  ``/healthz`` advertises
+  ``tp_degree``/``tp_mesh``, pagewire payloads grow per-shard lists
+  (scales ride every shard), and tp-skewed transfers bounce to the
+  re-prefill fallback exactly like dtype skew.
+
 Drivers: ``bench_serving.py`` (repo root) replays a Poisson trace —
 offline through the engine, or over real sockets with ``--server`` —
 and emits the BENCH_serving artifacts. Docs: ``docs/SERVING.md``.
@@ -193,6 +211,7 @@ from .sampling import fused_sample  # noqa: F401
 from .scheduler import (Request, RequestState, Scheduler,  # noqa: F401
                         SchedulerOutput)
 from .server import ServingServer  # noqa: F401
+from .tp import TP_AXIS, TPContext, resolve_tp  # noqa: F401
 from .trace import (FlightRecorder, RequestTrace,  # noqa: F401
                     ServingTrace, chrome_trace_events,
                     export_chrome_trace)
@@ -223,4 +242,5 @@ __all__ = [
     "DeployError", "RollingDeployer", "WeightRegistry",
     "snapshot_weights",
     "DistillBuffer", "DraftDistiller", "distill_buffer_from_env",
+    "TPContext", "resolve_tp", "TP_AXIS",
 ]

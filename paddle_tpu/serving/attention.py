@@ -70,14 +70,18 @@ def quantize_q8(x):
 
 
 def paged_attention(q, k_pages, v_pages, page_table, context_lens,
-                    q_offsets, *, scale, window=None):
+                    q_offsets, *, scale, window=None, spmd=False):
     """q [B,S,H,D]; k_pages/v_pages [NP, page_size, KV, D];
     page_table [B,P] int32 (pad = scratch page 0); context_lens [B]
     int32 — valid K tokens per row INCLUDING any just scattered;
     q_offsets [B] int32 — absolute position of each row's first query.
-    Returns [B,S,H,D] in q.dtype.
+    Returns [B,S,H,D] in q.dtype.  ``spmd=True`` (the tensor-parallel
+    step) forces the jnp gather path regardless of
+    ``PADDLE_TPU_PAGED_KERNEL`` — ``pallas_call`` has no GSPMD
+    partitioning rule, so tracing the kernel into a mesh program
+    would be silent wrongness; the engine logs + counts the fallback.
     """
-    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
+    if not spmd and os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
         # rectangular [B, S] is the degenerate ragged batch: row b is a
         # lane of query_len S — expand per token and run the ONE kernel
         b, s, nh, d = q.shape
@@ -112,7 +116,7 @@ def _token_lanes(query_lens, q_offsets, t):
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table,
                            context_lens, query_lens, q_offsets, *,
-                           scale, window=None):
+                           scale, window=None, spmd=False):
     """Token-packed mixed-batch paged attention (one program for
     decode + prefill + verify lanes).
 
@@ -129,13 +133,15 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table,
     per token (the oracle — identical einsums/mask, so GQA, sliding
     window, and the int8 (codes, scales) tuple layout are inherited);
     ``PADDLE_TPU_PAGED_KERNEL=1`` runs the unified interpret-mode
-    Pallas kernel on the same per-token expansion.
+    Pallas kernel on the same per-token expansion; ``spmd=True``
+    (tensor-parallel step) overrides the knob and stays on the ref
+    path — no Pallas under GSPMD.
     """
     t = q.shape[0]
     lane, pos = _token_lanes(query_lens, q_offsets, t)
     pt_tok = page_table[lane]
     cl_tok = context_lens[lane].astype(jnp.int32)
-    if os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
+    if not spmd and os.environ.get("PADDLE_TPU_PAGED_KERNEL") == "1":
         return _ragged_attention_kernel(q, k_pages, v_pages, pt_tok,
                                         cl_tok, pos, scale=scale,
                                         window=window)

@@ -118,6 +118,11 @@ FAULT_POINTS = (
     #                          stale -> one fresh re-read converges
     "distill_push_torn",     # distiller: torn weight payload -> the
     #                          all-or-nothing swap validation bounces
+    # tensor-parallel serving (round 23): a tp-skewed page transfer —
+    # adopt/import raises GeometryMismatch, which must bounce to the
+    # existing re-prefill/recompute fallback, never fail the request
+    "shard_geometry_mismatch",  # engine: per-shard payload geometry
+    #                             (tp_degree) skew on adopt/import
 )
 
 # legacy aliases (round 9/11 knobs) folded into the unified config

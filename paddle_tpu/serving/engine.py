@@ -103,10 +103,12 @@ import numpy as np
 
 from .chaos import ChaosConfig, ChaosInjector
 from .distill import distill_buffer_from_env
-from .kv_cache import SCRATCH_PAGE, OutOfPages, PagedKVCache
+from .kv_cache import (SCRATCH_PAGE, GeometryMismatch, OutOfPages,
+                       PagedKVCache)
 from .kvtier import KVTier, host_pool_from_env
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestState, Scheduler
+from .tp import resolve_tp
 from .trace import ServingTrace
 
 __all__ = ["EngineDraining", "FaultInjected", "ServingEngine"]
@@ -174,7 +176,7 @@ class ServingEngine:
                  cache_dtype=None, on_event=None, prefix_cache=None,
                  draft_model=None, speculative_k=None,
                  weight_quant=None, chaos=None, host_pool=None,
-                 distill=None, ragged=None):
+                 distill=None, ragged=None, mesh=None, tp_degree=None):
         cfg, core = self._validate_causal_lm(model)
         if weight_quant is None:
             weight_quant = os.environ.get(
@@ -211,6 +213,21 @@ class ServingEngine:
                 f"max_position_embeddings({maxpos})")
         cache_dtype = self._resolve_cache_dtype(cache_dtype, cfg)
         self.cache_dtype = cache_dtype
+        # -- tensor-parallel SPMD step (round 23 / ISSUE 19) ----------------
+        # resolve_tp returns None at degree <= 1, so the TP=1 hot path
+        # carries zero TP code; heads must split evenly or the
+        # per-shard q/kv slices would be ragged (loud at build time,
+        # never silently at step time)
+        self._tp = resolve_tp(mesh=mesh, tp_degree=tp_degree)
+        if self._tp is not None and (nh % self._tp.degree
+                                     or nkv % self._tp.degree):
+            raise ValueError(
+                f"tp_degree={self._tp.degree} must divide "
+                f"num_attention_heads={nh} and num_key_value_heads="
+                f"{nkv}")
+        self.tp_degree = self._tp.degree if self._tp else 1
+        self.tp_mesh_shape = self._tp.mesh_shape if self._tp else None
+        self._tp_kernel_warned = False
         if prefix_cache is None:
             prefix_cache = os.environ.get(
                 "PADDLE_TPU_SERVING_PREFIX_CACHE") == "1"
@@ -219,7 +236,8 @@ class ServingEngine:
             num_pages=num_pages,
             hbm_budget_bytes=(int(hbm_budget_mb * 2 ** 20)
                               if hbm_budget_mb is not None else None),
-            dtype=cache_dtype, prefix_cache=bool(prefix_cache))
+            dtype=cache_dtype, prefix_cache=bool(prefix_cache),
+            tp_degree=self.tp_degree)
         self.max_pages_per_seq = math.ceil(
             self.max_seq_len / self.cache.page_size)
         # -- speculative decoding (round 12) -------------------------------
@@ -264,6 +282,20 @@ class ServingEngine:
             self._draft_cache = None
             self._draft_core = None
             self._draft_window = None
+        if self._tp is not None:
+            # committed placements: weights last-dim sharded, pools
+            # head-sharded — both ride every compiled step as ARGUMENTS,
+            # so the shardings persist across steps with no per-step
+            # host work.  A DISTINCT draft model replicates instead:
+            # its propose/catchup programs then stay byte-identical to
+            # the TP=1 engine's draft (a self-draft shares the target's
+            # sharded tensors; the verify contract keeps the emitted
+            # stream exact regardless of draft numerics).
+            self._tp.shard_model_weights(self.model)
+            self._tp.shard_cache_pools(self.cache)
+            if self.draft is not None and self.draft is not self.model:
+                self._tp.shard_model_weights(self.draft,
+                                             replicate=True)
         self.scheduler = Scheduler(self.cache, max_batch=max_batch,
                                    prefill_chunk=prefill_chunk,
                                    watermark_frac=watermark_frac,
@@ -652,6 +684,13 @@ class ServingEngine:
             staged.append(jnp.asarray(a, dtype=t._data.dtype))
         for t, a in zip(tensors, staged):
             t._data = a
+        if self._tp is not None:
+            # swapped arrays arrive host-resident: re-commit them to
+            # the mesh placement or the next step compiles against
+            # unsharded operands (a silent program-class change)
+            self._tp.shard_model_weights(
+                model, replicate=(which == "draft"
+                                  and model is not self.model))
         flushed = 0
         if which == "target":
             flushed = self.cache.clear_prefix()
@@ -1226,9 +1265,15 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
         if self._draft_fn is None:
+            # tp=None: the draft program never pins TP layouts — a
+            # distinct draft's weights are replicated (byte-identical
+            # program to TP=1), a self-draft's sharded tensors fall to
+            # GSPMD auto.  Either way the verify step's deterministic-
+            # sample matching keeps the EMITTED stream token-exact.
             self._draft_fn = jax.jit(
                 functools.partial(_paged_step_pure, self.draft,
-                                  self._draft_core, self._draft_window),
+                                  self._draft_core, self._draft_window,
+                                  None),
                 static_argnums=(0, 1))
         dc = self._draft_cache
         dwarrs = [t._data for t in self.draft._gen_state_tensors()]
@@ -1745,6 +1790,9 @@ class ServingEngine:
             raise EngineDraining(
                 "engine is draining: in-flight requests finish, new "
                 "admissions are refused")
+        if self.chaos.fire("shard_geometry_mismatch"):
+            raise GeometryMismatch(
+                "chaos: shard geometry mismatch (tp_degree skew)")
         prompt = np.asarray(meta["prompt"], np.int32).reshape(-1)
         out_tokens = [int(t) for t in meta["out_tokens"]]
         if prompt.size == 0 or not out_tokens:
@@ -1831,6 +1879,9 @@ class ServingEngine:
         (pages enter CACHED at rc==0 — reclaimable capacity, exactly
         like a locally-prefilled prefix).  Returns the page count."""
         t0 = self._now()
+        if self.chaos.fire("shard_geometry_mismatch"):
+            raise GeometryMismatch(
+                "chaos: shard geometry mismatch (tp_degree skew)")
         n = self.cache.import_prefix_pages(meta, k_arrays, v_arrays)
         self.metrics.prefix_pages_imported.inc(n)
         if self.trace.enabled:
@@ -2046,18 +2097,47 @@ class ServingEngine:
             self.metrics.step_program_classes.set(
                 len(self._program_classes))
 
+    def _tp_kernel_guard(self):
+        """The loud Pallas guard (round 23): a TP step must never
+        trace ``pallas_call`` into the SPMD program (no GSPMD
+        partitioning rule — CLAUDE.md invariant), so when the mesh is
+        active and ``PADDLE_TPU_PAGED_KERNEL=1`` asks for the kernel,
+        the step refuses-and-falls-back to the jnp gather path —
+        logged once, counted per step (``tp_kernel_fallbacks``).  The
+        knob is re-read per step like ``_host_sampling`` so
+        monkeypatch-mid-test workflows see honest accounting; the
+        in-program bypass itself rides ``spmd=True`` through
+        ``_paged_forward`` regardless of this metric."""
+        if self._tp is None:
+            return
+        if os.environ.get("PADDLE_TPU_PAGED_KERNEL") != "1":
+            return
+        if not self._tp_kernel_warned:
+            self._tp_kernel_warned = True
+            _log.warning(json.dumps({
+                "event": "tp_pallas_fallback",
+                "tp_degree": self.tp_degree,
+                "detail": "PADDLE_TPU_PAGED_KERNEL=1 ignored under "
+                          "tensor parallelism: pallas_call has no "
+                          "GSPMD partitioning rule; using the jnp "
+                          "gather path"}))
+        self.metrics.tp_kernel_fallbacks.inc()
+
     def _run_step(self, ids, positions, pt, cl, slot_map, last_idx,
                   samp, sample_capable, multi_pos=False):
         import jax
         import jax.numpy as jnp
+        self._tp_kernel_guard()
         if self._step_fn is None:
             # bucketed shapes bound this single fn's trace cache to
             # 2*(log2(max_batch)+2) entries (the static sample_capable
             # and multi_pos flags at most double it each); weights ride
-            # as arguments
+            # as arguments. The TP context rides the partial like
+            # model/core — closed over, never traced — so the jit
+            # signature and static argnums are the TP=1 ones.
             self._step_fn = jax.jit(
                 functools.partial(_paged_step_pure, self.model,
-                                  self._core, self.window),
+                                  self._core, self.window, self._tp),
                 static_argnums=(0, 1))
         warrs = [t._data for t in self.model._gen_state_tensors()]
         k_ops, v_ops = self.cache.program_operands()
@@ -2078,6 +2158,7 @@ class ServingEngine:
                          slot_map, samp):
         import jax
         import jax.numpy as jnp
+        self._tp_kernel_guard()
         if self._ragged_fn is None:
             # ONE jit fn; the token capacity in {small, mixed} bounds
             # its trace cache at two entries — the <= 2-program-class
@@ -2088,7 +2169,7 @@ class ServingEngine:
             # in the SAME class).
             self._ragged_fn = jax.jit(
                 functools.partial(_ragged_step_pure, self.model,
-                                  self._core, self.window))
+                                  self._core, self.window, self._tp))
         warrs = [t._data for t in self.model._gen_state_tensors()]
         k_ops, v_ops = self.cache.program_operands()
         tok, lp, logits, k_pages, v_pages = self._ragged_fn(
@@ -2122,25 +2203,25 @@ def _counter_sample_row(logits_row, req):
     return int(np.asarray(tok)[0]), float(np.asarray(lp)[0])
 
 
-def _paged_step_pure(model, core, window, sample_capable, multi_pos,
-                     warrs, ids, positions, pt, cl, slot_map, last_idx,
-                     samp, k_pages, v_pages):
+def _paged_step_pure(model, core, window, tp, sample_capable,
+                     multi_pos, warrs, ids, positions, pt, cl,
+                     slot_map, last_idx, samp, k_pages, v_pages):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
-        return _paged_step_body(model, core, window, sample_capable,
-                                multi_pos, ids, positions, pt, cl,
-                                slot_map, last_idx, samp, k_pages,
-                                v_pages)
+        return _paged_step_body(model, core, window, tp,
+                                sample_capable, multi_pos, ids,
+                                positions, pt, cl, slot_map, last_idx,
+                                samp, k_pages, v_pages)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
 def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
-                   k_pages, v_pages, ragged=None):
+                   k_pages, v_pages, ragged=None, tp=None):
     """The transformer trunk over the paged cache: embed, attend (K/V
     scattered into the page pool), final norm. Shared by the target
     step program, the draft catchup step, the draft proposal scan, and
@@ -2148,17 +2229,37 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
     attention to the token-packed lane layout: ids/positions/slot_map
     are [1, T] (the scatter is shape-agnostic) while pt/cl are the
     [L, P]/[L] PER-LANE arrays. Returns ``(hidden [B, S, D] jnp array,
-    new_k, new_v)``."""
+    new_k, new_v)``.
+
+    ``tp`` (a :class:`~.tp.TPContext`) makes the trunk ONE SPMD
+    program over the mesh.  The constraints below are the whole
+    exactness argument (tp.py module docstring): activations are
+    pinned REPLICATED wherever a sharded dim would otherwise feed a
+    contraction (GSPMD would partial-sum + all-reduce there — a
+    different f32 summation order than TP=1), and q/k/v plus the page
+    pools are pinned head-sharded so the attention inner loop is
+    shard-local.  The MLP is inlined under TP because
+    ``layer.mlp(...)`` offers no hook to replicate the swiglu output
+    before down_proj's contraction — the inline mirrors
+    ``down_proj(swiglu(gate_proj(x), up_proj(x)))`` exactly."""
     from ..core.autograd import no_grad
     from ..core.tensor import Tensor
-    from ..incubate.nn.functional import fused_rotary_position_embedding
+    from ..incubate.nn.functional import (
+        fused_rotary_position_embedding, swiglu)
     from .attention import (paged_attention, quantize_q8,
                             ragged_paged_attention)
 
+    spmd = tp is not None
     b, s = ids.shape
     flat_slots = slot_map.reshape(-1)
     with no_grad():
         x = core.embed_tokens(Tensor(ids))
+        if spmd:
+            # the embedding table is sharded on its hidden column dim,
+            # so the gathered rows come out hidden-sharded: replicate
+            # before the first layernorm (its reduction runs over the
+            # hidden dim)
+            x = Tensor(tp.replicate(x._data))
         pos_t = Tensor(positions)
         new_k, new_v = [], []
         for layer, kp, vp in zip(core.layers, k_pages, v_pages):
@@ -2168,6 +2269,10 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
             q = at.q_proj(y).reshape([b, s, nh, hd])
             k = at.k_proj(y).reshape([b, s, nkv, hd])
             v = at.v_proj(y).reshape([b, s, nkv, hd])
+            if spmd:
+                q = Tensor(tp.shard_heads(q._data))
+                k = Tensor(tp.shard_heads(k._data))
+                v = Tensor(tp.shard_heads(v._data))
             q, k, _ = fused_rotary_position_embedding(
                 q, k, None, position_ids=pos_t,
                 rotary_emb_base=at.cfg.rope_theta)
@@ -2199,33 +2304,60 @@ def _paged_forward(core, window, ids, positions, pt, cl, slot_map,
                 vp = vp.reshape(npg * ps, nkv, hd).at[flat_slots].set(
                     v._data.reshape(b * s, nkv, hd).astype(vp.dtype)
                 ).reshape(npg, ps, nkv, hd)
+            if spmd:
+                # pin the freshly-scattered pools back to the head
+                # sharding: the scatter is shard-aligned (values and
+                # pools split on the same kv-head axis) and the pinned
+                # outputs carry the layout into the NEXT step's
+                # operands with no host round-trip
+                kp = tp.shard_pool(kp)
+                vp = tp.shard_pool(vp)
             new_k.append(kp)
             new_v.append(vp)
             if ragged is None:
                 out = paged_attention(
                     q._data, kp, vp, pt, cl, positions[:, 0],
-                    scale=1.0 / (hd ** 0.5), window=window)
+                    scale=1.0 / (hd ** 0.5), window=window, spmd=spmd)
             else:
                 ql, qoff = ragged
                 out = ragged_paged_attention(
                     q._data[0], kp, vp, pt, cl, ql, qoff,
-                    scale=1.0 / (hd ** 0.5), window=window)[None]
-            h = x + at.o_proj(Tensor(out).reshape([b, s, nh * hd]))
-            x = h + layer.mlp(layer.post_attention_layernorm(h))
+                    scale=1.0 / (hd ** 0.5), window=window,
+                    spmd=spmd)[None]
+            ao = Tensor(out).reshape([b, s, nh * hd])
+            if spmd:
+                # o_proj contracts over the head dim — gather the
+                # head-sharded attention rows first, then replicate
+                # o_proj's column-sharded output before the residual
+                ao = Tensor(tp.replicate(ao._data))
+                o = at.o_proj(ao)
+                h = x + Tensor(tp.replicate(o._data))
+                h2 = layer.post_attention_layernorm(h)
+                g = layer.mlp.gate_proj(h2)
+                u = layer.mlp.up_proj(h2)
+                a = swiglu(g, u)
+                # down_proj contracts over the ffn dim gate/up sharded
+                a = Tensor(tp.replicate(a._data))
+                mo = layer.mlp.down_proj(a)
+                x = h + Tensor(tp.replicate(mo._data))
+            else:
+                h = x + at.o_proj(ao)
+                x = h + layer.mlp(layer.post_attention_layernorm(h))
         x = core.norm(x)
     return x._data, new_k, new_v
 
 
-def _paged_step_body(model, core, window, sample_capable, multi_pos,
-                     ids, positions, pt, cl, slot_map, last_idx, samp,
-                     k_pages, v_pages):
+def _paged_step_body(model, core, window, tp, sample_capable,
+                     multi_pos, ids, positions, pt, cl, slot_map,
+                     last_idx, samp, k_pages, v_pages):
     import jax.numpy as jnp
 
     from ..core.autograd import no_grad
     from ..core.tensor import Tensor
 
     x, new_k, new_v = _paged_forward(core, window, ids, positions, pt,
-                                     cl, slot_map, k_pages, v_pages)
+                                     cl, slot_map, k_pages, v_pages,
+                                     tp=tp)
     from .sampling import fused_sample, fused_sample_multi
     do_sample, temperature, top_k, top_p, seeds, steps = samp
     if multi_pos:
@@ -2235,6 +2367,11 @@ def _paged_step_body(model, core, window, sample_capable, multi_pos,
         # fetch at <= B*8 bytes
         with no_grad():
             logits = model.lm_head(Tensor(x))._data
+        if tp is not None:
+            # lm_head shards the vocab columns: gather the partial
+            # (column-sliced, never partially-summed) logits so fused
+            # sampling runs replicated — identical to TP=1
+            logits = tp.replicate(logits)
         logits = logits.astype(jnp.float32)              # [B, S, V]
         tokens, logprobs = fused_sample_multi(
             logits, do_sample, temperature, top_k, top_p, seeds, steps,
@@ -2244,6 +2381,10 @@ def _paged_step_body(model, core, window, sample_capable, multi_pos,
     h_last = x[jnp.arange(b), last_idx]                  # [B, D]
     with no_grad():
         logits = model.lm_head(Tensor(h_last[:, None, :]))._data[:, 0]
+    if tp is not None:
+        # the all-gather happens only at the sampled lane: h_last
+        # already dropped the S axis, so this moves [B, V] per step
+        logits = tp.replicate(logits)
     logits = logits.astype(jnp.float32)
     # fused on-device sampling: the host fetches [B] ids (+logprobs),
     # not [B, V] logits; sample_capable is STATIC (greedy-only batches
@@ -2256,23 +2397,24 @@ def _paged_step_body(model, core, window, sample_capable, multi_pos,
 
 # -- the unified ragged step (round 22 / PR 18) ----------------------------
 
-def _ragged_step_pure(model, core, window, warrs, ids, positions, pt,
-                      cl, ql, qoff, slot_map, samp, k_pages, v_pages):
+def _ragged_step_pure(model, core, window, tp, warrs, ids, positions,
+                      pt, cl, ql, qoff, slot_map, samp, k_pages,
+                      v_pages):
     tensors = model._gen_state_tensors()
     saved = [(t, t._data) for t in tensors]
     for t, arr in zip(tensors, warrs):
         t._data = arr
     try:
-        return _ragged_step_body(model, core, window, ids, positions,
-                                 pt, cl, ql, qoff, slot_map, samp,
-                                 k_pages, v_pages)
+        return _ragged_step_body(model, core, window, tp, ids,
+                                 positions, pt, cl, ql, qoff, slot_map,
+                                 samp, k_pages, v_pages)
     finally:
         for t, arr in saved:
             t._data = arr
 
 
-def _ragged_step_body(model, core, window, ids, positions, pt, cl, ql,
-                      qoff, slot_map, samp, k_pages, v_pages):
+def _ragged_step_body(model, core, window, tp, ids, positions, pt, cl,
+                      ql, qoff, slot_map, samp, k_pages, v_pages):
     """Token-packed unified step: the trunk runs at [1, T], lm_head +
     fused sampling cover EVERY packed token (each with its own
     per-token counter key — a verify token j carries steps0+j, exactly
@@ -2289,11 +2431,15 @@ def _ragged_step_body(model, core, window, ids, positions, pt, cl, ql,
 
     x, new_k, new_v = _paged_forward(core, window, ids, positions, pt,
                                      cl, slot_map, k_pages, v_pages,
-                                     ragged=(ql, qoff))
+                                     ragged=(ql, qoff), tp=tp)
     from .sampling import fused_sample
     do_sample, temperature, top_k, top_p, seeds, steps = samp
     with no_grad():
         logits = model.lm_head(Tensor(x))._data[0]           # [T, V]
+    if tp is not None:
+        # partial (vocab-column-sliced) logits -> replicated before the
+        # fused per-token sampling, same as the bucketed step
+        logits = tp.replicate(logits)
     logits = logits.astype(jnp.float32)
     tokens, logprobs = fused_sample(
         logits, do_sample, temperature, top_k, top_p, seeds, steps,

@@ -87,6 +87,17 @@ def main(argv=None):
     with open(args.spec) as f:
         spec = json.load(f)
 
+    engine_spec = dict(spec.get("engine") or {})
+    tp = int(engine_spec.get("tp_degree") or 0)
+    if tp > 1 and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # a tp_degree spec needs a multi-device mesh; on the CPU
+        # platform that means the host-device-count flag, which XLA
+        # reads at backend init — set it BEFORE the first jax touch
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={max(tp, 8)}")
+
     platform = spec.get("platform", "cpu")
     if platform:
         # must land BEFORE the first jax device touch; the env var is

@@ -307,6 +307,13 @@ class ServingFrontend:
                     "cache_dtype": getattr(eng, "cache_dtype",
                                            str(eng.cache.dtype)),
                     "weight_quant": getattr(eng, "weight_quant", None),
+                    # tensor-parallel serving (round 23): the shard
+                    # degree is part of the pagewire geometry contract
+                    # (per-shard payload lists), so a router can bounce
+                    # tp-skewed transfers up front — same shape as the
+                    # dtype-skew guard
+                    "tp_degree": getattr(eng, "tp_degree", 1),
+                    "tp_mesh": getattr(eng, "tp_mesh_shape", None),
                     # fleet prefix cache (round 18): how much reusable
                     # prefix this replica holds — the router's transfer
                     # index consults these before scheduling a ship

@@ -135,7 +135,7 @@ class PagedKVCache:
 
     def __init__(self, n_layers, n_kv_heads, head_dim, *, page_size=16,
                  num_pages=None, hbm_budget_bytes=None, dtype="float32",
-                 prefix_cache=False):
+                 prefix_cache=False, tp_degree=1):
         import jax.numpy as jnp
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -143,6 +143,15 @@ class PagedKVCache:
         self.n_kv_heads = int(n_kv_heads)
         self.head_dim = int(head_dim)
         self.page_size = int(page_size)
+        # tensor-parallel geometry (round 23): drives the migration
+        # contract (geometry dict + per-shard wire payload lists) only
+        # — device placement is the engine's tp.TPContext's job, the
+        # cache stays jax-sharding-agnostic
+        self.tp_degree = int(tp_degree or 1)
+        if self.tp_degree < 1 or self.n_kv_heads % self.tp_degree:
+            raise ValueError(
+                f"tp_degree={tp_degree} must divide n_kv_heads="
+                f"{n_kv_heads}")
         self.dtype = jnp.dtype(dtype)
         # int8 = quantized codes + per-(slot, head) f32 scales; any other
         # integer dtype would silently astype-truncate K/V to garbage
@@ -593,7 +602,7 @@ class PagedKVCache:
         """The shape contract a migration payload must satisfy."""
         return {"n_layers": self.n_layers, "n_kv_heads": self.n_kv_heads,
                 "head_dim": self.head_dim, "page_size": self.page_size,
-                "dtype": str(self.dtype)}
+                "dtype": str(self.dtype), "tp_degree": self.tp_degree}
 
     def check_geometry(self, meta):
         mine = self.geometry()
@@ -618,6 +627,15 @@ class PagedKVCache:
         (``[n_pages, page_size, n_kv_heads]``) AFTER the code arrays in
         each list — the wire format records every array's own shape and
         dtype, so the scale geometry rides the same payload.
+
+        Tensor-parallel caches (``tp_degree=t > 1``) split every array
+        into t per-shard chunks along the kv-head axis, layer-major /
+        shard-minor (``[L0S0, L0S1, ..., L1S0, ...]``; int8 scale
+        arrays after ALL code arrays, split the same way — scales ride
+        every shard, the round-15 rule).  ``tp_degree`` is part of
+        :meth:`geometry`, so a degree-skewed import bounces on
+        :class:`GeometryMismatch` up front — the router/disagg
+        re-prefill fallback covers it.
         """
         if seq_id not in self._tables:
             raise KeyError(f"export_pages: unknown sequence {seq_id!r}")
@@ -631,16 +649,10 @@ class PagedKVCache:
         meta = dict(self.geometry(), seq_len=self._lens[seq_id],
                     skip_pages=skip_pages, n_pages=len(pages))
         if not pages:
-            empty = [np.empty((0, self.page_size, self.n_kv_heads,
-                               self.head_dim), self.dtype)
-                     for _ in range(self.n_layers)]
-            if self.quantized:
-                empty += [np.empty((0, self.page_size, self.n_kv_heads),
-                                   np.float32)
-                          for _ in range(self.n_layers)]
+            empty = self._empty_payload()
             return meta, empty, [a.copy() for a in empty]
         k, v = self._fetch_pages(pages)
-        return meta, k, v
+        return meta, self._split_shards(k), self._split_shards(v)
 
     def import_pages(self, seq_id, meta, k_arrays, v_arrays,
                      prompt=None, hist_len=None):
@@ -699,7 +711,8 @@ class PagedKVCache:
             self._rc[p] = 1
         table.extend(fresh)
         self._lens[seq_id] = seq_len
-        self._scatter_pages(fresh, k_arrays, v_arrays)
+        self._scatter_pages(fresh, self._merge_shards(k_arrays),
+                            self._merge_shards(v_arrays))
         if self.prefix_cache_enabled and prompt is not None:
             # the imported prompt pages are canonical K/V: later
             # shared-prefix requests on THIS replica hit them.  Bounded
@@ -712,27 +725,69 @@ class PagedKVCache:
 
     def _check_payload_shapes(self, n_pages, k_arrays, v_arrays):
         """Validate an incoming page payload's array count and shapes
-        against this cache's geometry (codes + scales for int8)."""
-        shape = (n_pages, self.page_size, self.n_kv_heads, self.head_dim)
-        sshape = (n_pages, self.page_size, self.n_kv_heads)
-        per_list = self.n_layers * (2 if self.quantized else 1)
+        against this cache's geometry (codes + scales for int8).  The
+        wire unit is the per-shard chunk: t = tp_degree chunks per
+        layer, kv-head extent n_kv_heads // t each."""
+        t = self.tp_degree
+        kv = self.n_kv_heads // t
+        shape = (n_pages, self.page_size, kv, self.head_dim)
+        sshape = (n_pages, self.page_size, kv)
+        n_codes = self.n_layers * t
+        per_list = n_codes * (2 if self.quantized else 1)
         for arrs, what in ((k_arrays, "k"), (v_arrays, "v")):
             if len(arrs) != per_list:
                 raise GeometryMismatch(
                     f"{what} payload has {len(arrs)} array(s), this "
                     f"cache expects {per_list} ({self.n_layers} "
-                    "layer(s)" + (" of codes + scales)" if self.quantized
-                                  else ")"))
-            for a in arrs[:self.n_layers]:
+                    f"layer(s) x {t} shard(s)"
+                    + (" of codes + scales)" if self.quantized
+                       else ")"))
+            for a in arrs[:n_codes]:
                 if tuple(a.shape) != shape:
                     raise GeometryMismatch(
                         f"{what} page array shape {tuple(a.shape)} != "
                         f"{shape}")
-            for a in arrs[self.n_layers:]:
+            for a in arrs[n_codes:]:
                 if tuple(a.shape) != sshape:
                     raise GeometryMismatch(
                         f"{what} scale array shape {tuple(a.shape)} != "
                         f"{sshape}")
+
+    def _empty_payload(self):
+        """A zero-page export's array list — the SAME per-shard wire
+        structure as a real payload so shape validation never branches
+        on emptiness."""
+        t = self.tp_degree
+        kv = self.n_kv_heads // t
+        empty = [np.empty((0, self.page_size, kv, self.head_dim),
+                          self.dtype)
+                 for _ in range(self.n_layers * t)]
+        if self.quantized:
+            empty += [np.empty((0, self.page_size, kv), np.float32)
+                      for _ in range(self.n_layers * t)]
+        return empty
+
+    def _split_shards(self, arrays):
+        """Per-layer fetched arrays -> the per-shard wire lists
+        (layer-major / shard-minor; no-op at tp_degree=1).  Works for
+        codes [n, PS, KV, D] and scales [n, PS, KV] alike — the
+        kv-head axis is axis 2 in both."""
+        if self.tp_degree == 1:
+            return list(arrays)
+        out = []
+        for a in arrays:
+            out.extend(np.split(np.asarray(a), self.tp_degree, axis=2))
+        return out
+
+    def _merge_shards(self, arrays):
+        """Inverse of :meth:`_split_shards`: t consecutive per-shard
+        chunks concatenate back into one per-layer array."""
+        if self.tp_degree == 1:
+            return list(arrays)
+        t = self.tp_degree
+        return [np.concatenate([np.asarray(x) for x in
+                                arrays[i:i + t]], axis=2)
+                for i in range(0, len(arrays), t)]
 
     def _all_pools(self):
         """Every device pool in canonical order (k, v[, k_scales,
@@ -846,16 +901,10 @@ class PagedKVCache:
                     prompt=[int(t) for t in
                             prompt[:matched * self.page_size]])
         if not pages:
-            empty = [np.empty((0, self.page_size, self.n_kv_heads,
-                               self.head_dim), self.dtype)
-                     for _ in range(self.n_layers)]
-            if self.quantized:
-                empty += [np.empty((0, self.page_size, self.n_kv_heads),
-                                   np.float32)
-                          for _ in range(self.n_layers)]
+            empty = self._empty_payload()
             return meta, empty, [a.copy() for a in empty]
         k, v = self._fetch_pages(pages)
-        return meta, k, v
+        return meta, self._split_shards(k), self._split_shards(v)
 
     def import_prefix_pages(self, meta, k_arrays, v_arrays):
         """Splice a shipped prefix payload into THIS allocator's radix
@@ -908,7 +957,8 @@ class PagedKVCache:
             self._rc[p] = 1
         table.extend(fresh)
         self._lens[sid] = prompt.size
-        self._scatter_pages(fresh, k_arrays, v_arrays)
+        self._scatter_pages(fresh, self._merge_shards(k_arrays),
+                            self._merge_shards(v_arrays))
         self.commit_prefix(sid, prompt, prompt.size)
         # drop the pin: committed pages stay resident (CACHED, rc==0)
         self.free_seq(sid)

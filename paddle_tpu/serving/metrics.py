@@ -181,6 +181,11 @@ class ServingMetrics:
         self.spec_draft_tokens = Counter()    # tokens the draft proposed
         self.spec_accepted_tokens = Counter()  # proposals verified+emitted
         self.spec_fallbacks = Counter()       # lanes demoted to plain
+        # tensor-parallel SPMD serving (round 23)
+        self.tp_kernel_fallbacks = Counter()  # Pallas kernel requests
+        #                                       demoted to the jnp path
+        #                                       (no GSPMD rule for
+        #                                       pallas_call)
         # disaggregated prefill/decode (round 14)
         self.prefills_held = Counter()        # requests held "prefilled"
         self.pages_exported = Counter()       # KV pages shipped out

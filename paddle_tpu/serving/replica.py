@@ -155,6 +155,13 @@ class InProcessReplica:
         payload would only bounce on GeometryMismatch later)."""
         return self.engine.cache_dtype
 
+    def tp_degree(self):
+        """The engine's tensor-parallel shard degree (round 23) —
+        the router's tp-skew guard reads it before scheduling a
+        transfer; per-shard pagewire payloads only splice between
+        equal degrees."""
+        return getattr(self.engine, "tp_degree", 1)
+
     def export_prefix(self, prompt, skip_pages=0):
         return self.frontend.export_prefix(prompt, skip_pages)
 
@@ -311,6 +318,7 @@ class HTTPReplica:
         self.name = name or f"{host}:{port}"
         self._role = role  # None -> lazily read from /healthz
         self._cache_dtype = None  # lazily read from /healthz
+        self._tp_degree = None  # lazily read from /healthz
         # chaos layer (round 17): network fault injection (connect
         # refused / mid-stream EOF / slow reads) + the retry knobs for
         # the idempotent hops below
@@ -348,6 +356,15 @@ class HTTPReplica:
         if self._cache_dtype is None:
             self._cache_dtype = self.health().get("cache_dtype")
         return self._cache_dtype
+
+    def tp_degree(self):
+        """The remote engine's advertised tensor-parallel degree
+        (cached — fixed for the engine's lifetime); None when the
+        advertisement is unreachable, in which case the router falls
+        back to the GeometryMismatch bounce."""
+        if self._tp_degree is None:
+            self._tp_degree = self.health().get("tp_degree")
+        return self._tp_degree
 
     def start(self):
         return self  # remote lifecycle is the remote operator's

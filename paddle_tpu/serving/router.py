@@ -1044,6 +1044,19 @@ class ServingRouter:
         except Exception:
             return None
 
+    def _replica_tp_degree(self, i):
+        """The replica's advertised tensor-parallel degree, or None
+        when unknown — the up-front tp-skew guard (a per-shard payload
+        shipped across degrees would only bounce on GeometryMismatch
+        later)."""
+        fn = getattr(self.replicas[i], "tp_degree", None)
+        if fn is None:
+            return None
+        try:
+            return fn() if callable(fn) else fn
+        except Exception:
+            return None
+
     def _replica_weight_version(self, i, which="target"):
         """The replica's CURRENT target weight version, or None when
         unknown.  Unlike ``cache_dtype`` (immutable for an engine's
@@ -1138,6 +1151,7 @@ class ServingRouter:
             return
         tgt_dtype = self._replica_cache_dtype(target_idx)
         tgt_ver = self._replica_weight_version(target_idx)
+        tgt_tp = self._replica_tp_degree(target_idx)
         # deepest recorded owner first; recorded depth is approximate,
         # the donor's probe_pages is the truth
         for donor_idx in sorted(owners, key=owners.get, reverse=True):
@@ -1153,6 +1167,15 @@ class ServingRouter:
                 # doomed transfer entirely
                 self.metrics.prefix_ship_skipped_total.inc(
                     reason="dtype_skew")
+                continue
+            donor_tp = self._replica_tp_degree(donor_idx)
+            if tgt_tp is not None and donor_tp is not None \
+                    and donor_tp != tgt_tp:
+                # up-front tp-skew guard (round 23): per-shard payload
+                # lists only splice between equal shard degrees — a
+                # skewed ship could only bounce on GeometryMismatch
+                self.metrics.prefix_ship_skipped_total.inc(
+                    reason="tp_skew")
                 continue
             donor_ver = self._replica_weight_version(donor_idx)
             if tgt_ver is not None and donor_ver is not None \

@@ -1,0 +1,226 @@
+"""Tensor-parallel SPMD serving (round 23 / ISSUE 19).
+
+One :class:`TPContext` per :class:`~paddle_tpu.serving.engine.
+ServingEngine` turns the whole decode/prefill/ragged step into ONE
+GSPMD program over a device mesh: weights and KV page pools are
+committed to mesh shardings at engine build, and the step bodies pin
+activation layouts with ``with_sharding_constraint`` so the compiled
+program's collectives are known by construction.
+
+The exactness contract (TP=k token-exact vs TP=1, greedy AND seeded,
+across preemption/recompute) is what picks the layout:
+
+- Only the LAST (output / non-contracting) dim of an ndim>=2 weight is
+  ever sharded — every matmul keeps its FULL contraction local to each
+  shard, so the per-element f32 summation order is identical to the
+  single-device program and the only collectives the step needs are
+  all-gathers (pure data movement, bit-exact).  A Megatron-style
+  row-parallel split would partial-sum + all-reduce — a DIFFERENT
+  summation order, which is exactly the silent non-exactness this
+  module exists to rule out.
+- 1-D params (norm scales, biases) and non-divisible dims replicate.
+- KV page pools shard on the HEAD axis ([NP, PS, KV, D] ->
+  P(None, None, 'tp', None); int8 scale pools [NP, PS, KV] ->
+  P(None, None, 'tp')): the append scatter and the paged-attention
+  einsums both batch over the kv-head axis, so the whole attention
+  inner loop is shard-local.  One host allocator, replicated page
+  tables — per-shard tables stay in lockstep for free.
+- lm_head shards the VOCAB column dim, so each shard holds partial
+  (column-sliced, never partially-summed) logits; the step replicates
+  them right before fused sampling — the all-gather happens only at
+  the sampled lane (decode fetches [B, D] hidden first, so the
+  gathered tensor is [B, V] per step, not [B, S, V]).
+
+``pallas_call`` has no GSPMD partitioning rule (CLAUDE.md invariant),
+so a TP step must never trace the Pallas paged-attention kernel: the
+engine passes ``spmd=True`` down to ``attention.paged_attention`` /
+``ragged_paged_attention``, which forces the jnp gather path loudly
+(log + ``tp_kernel_fallbacks`` metric) even when
+``PADDLE_TPU_PAGED_KERNEL=1`` asks for the kernel.  The graftlint
+``pallas-hazards`` rule polices the module split structurally (no
+file may both build mesh shardings and call ``pallas_call``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+# the serving TP mesh axis name; distinct from the fleet trainer's
+# 'mp'/'sharding'/'pp' axes so a spec composed on top of a fleet
+# dist_spec can never alias an existing axis
+TP_AXIS = "tp"
+
+_ENV_TP = "PADDLE_TPU_SERVING_TP"
+
+
+def resolve_tp(mesh=None, tp_degree=None):
+    """Resolve the engine's tensor-parallel context.
+
+    ``mesh`` (a ``jax.sharding.Mesh`` with a ``'tp'`` axis) wins;
+    else ``tp_degree=k`` builds a 1-D mesh over the first k local
+    devices; else the ``PADDLE_TPU_SERVING_TP`` knob.  Degree <= 1
+    (or nothing configured) returns None — the engine runs the plain
+    single-device step with zero TP code on the hot path.
+    """
+    if mesh is not None:
+        if TP_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"serving TP mesh must carry a {TP_AXIS!r} axis, got "
+                f"{mesh.axis_names}")
+        degree = mesh.shape[TP_AXIS]
+        if degree <= 1:
+            return None
+        return TPContext(mesh, degree)
+    if tp_degree is None:
+        raw = os.environ.get(_ENV_TP)
+        if not raw:
+            return None
+        tp_degree = int(raw)
+    degree = int(tp_degree)
+    if degree <= 1:
+        return None
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if degree > len(devices):
+        raise ValueError(
+            f"tp_degree={degree} exceeds the {len(devices)} visible "
+            f"device(s); on CPU set --xla_force_host_platform_"
+            f"device_count (the test conftest pins 8)")
+    return TPContext(Mesh(devices[:degree], (TP_AXIS,)), degree)
+
+
+class TPContext:
+    """Resolved TP geometry + the sharding helpers the step bodies use.
+
+    Rides the compiled step the same way ``model``/``core`` do —
+    closed over via ``functools.partial``, never traced — so the jit
+    signature and its static argnums stay exactly the TP=1 ones.
+    """
+
+    def __init__(self, mesh, degree):
+        self.mesh = mesh
+        self.degree = int(degree)
+        self.axis = TP_AXIS
+
+    @property
+    def mesh_shape(self):
+        """JSON-able geometry for /healthz (axis name -> size)."""
+        return {name: int(self.mesh.shape[name])
+                for name in self.mesh.axis_names}
+
+    # -- sharding builders -------------------------------------------------
+    def named(self, *spec):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        return NamedSharding(self.mesh, P(*spec))
+
+    def param_spec(self, shape, dist_spec=None):
+        """Placement spec for one weight (see module docstring).
+
+        A param that already carries a fleet ``dist_spec`` is NEVER
+        returned verbatim (the spmd.py composition invariant): the tp
+        axis is composed ON TOP via ``_add_sharding`` — and kept only
+        when the composition lands on the last dim, because any other
+        dim is (or feeds) a contraction and a sharded contraction
+        partial-sums, breaking token-exactness.  Plain params take the
+        last-dim rule directly.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed.fleet.spmd import _add_sharding
+        shape = tuple(int(s) for s in shape)
+        if len(shape) >= 2 and dist_spec:
+            # fleet axes ('mp'/'sharding'/'pp') don't exist in the
+            # serving mesh — drop them before placing, keep them as
+            # occupied slots for the composition so tp never doubles
+            # onto a dim the trainer already split
+            base = self._known_axes_only(dist_spec)
+            composed = _add_sharding(dist_spec, shape, self.degree,
+                                     axis=self.axis)
+            if composed is not None and len(composed) == len(shape) \
+                    and composed[-1] == self.axis:
+                tail = list(base) + [None] * (len(shape) - len(base))
+                tail[-1] = self.axis
+                return P(*tail)
+            return base  # replicate over tp
+        if len(shape) >= 2 and shape[-1] % self.degree == 0 \
+                and shape[-1] >= self.degree:
+            return P(*([None] * (len(shape) - 1) + [self.axis]))
+        return P()
+
+    def _known_axes_only(self, spec):
+        """A spec with every axis this mesh doesn't know replaced by
+        None (axis elements may be strings or tuples of strings)."""
+        from jax.sharding import PartitionSpec as P
+        known = set(self.mesh.axis_names)
+
+        def keep(el):
+            if el is None:
+                return None
+            if isinstance(el, (tuple, list)):
+                kept = tuple(a for a in el if a in known)
+                return kept if kept else None
+            return el if el in known else None
+
+        return P(*[keep(el) for el in spec])
+
+    # -- in-program layout constraints -------------------------------------
+    def replicate(self, arr):
+        """Pin ``arr`` replicated — the exactness-critical all-gather
+        points (post-embed, post-o_proj, pre-down_proj, logits)."""
+        import jax
+        return jax.lax.with_sharding_constraint(arr, self.named())
+
+    def shard_heads(self, arr):
+        """Pin a [B, S, H, D] q/k/v tensor head-sharded."""
+        import jax
+        return jax.lax.with_sharding_constraint(
+            arr, self.named(None, None, self.axis, None))
+
+    def shard_pool(self, pool):
+        """Pin a KV page pool head-sharded; int8 pools are
+        (codes [NP, PS, KV, D], scales [NP, PS, KV]) tuples and the
+        scales ride the SAME head split (round-15 rule)."""
+        import jax
+        if isinstance(pool, tuple):
+            codes, scales = pool
+            return (jax.lax.with_sharding_constraint(
+                        codes, self.named(None, None, self.axis, None)),
+                    jax.lax.with_sharding_constraint(
+                        scales, self.named(None, None, self.axis)))
+        return jax.lax.with_sharding_constraint(
+            pool, self.named(None, None, self.axis, None))
+
+    # -- build-time placement ----------------------------------------------
+    def shard_model_weights(self, model, replicate=False):
+        """Commit every generation-state tensor of ``model`` to its
+        mesh placement (``replicate=True`` pins everything replicated
+        — the draft-model mode: a distinct draft runs as its own
+        non-TP dispatch, and replicated weights keep that program's
+        numerics byte-identical to the TP=1 engine's draft)."""
+        import jax
+        for t in model._gen_state_tensors():
+            shape = tuple(int(s) for s in t._data.shape)
+            spec = () if replicate else self.param_spec(
+                shape, getattr(t, "dist_spec", None))
+            t._data = jax.device_put(t._data, self.named(*tuple(spec)))  # noqa: E501 # graftlint: disable=weight-swap-lock (same-value placement commit, not a weight swap: runs at engine build and inside set_weights AFTER its validation/flush, both under the blessed paths)
+
+    def shard_cache_pools(self, cache):
+        """Commit a :class:`PagedKVCache`'s pools to the head-axis
+        sharding (codes AND scales; the allocator, page tables and
+        every other host-side structure stay replicated/host-only)."""
+        import jax
+        head_nd = self.named(None, None, self.axis, None)
+        head_sc = self.named(None, None, self.axis)
+        cache.k_pages = [jax.device_put(p, head_nd)
+                         for p in cache.k_pages]
+        cache.v_pages = [jax.device_put(p, head_nd)
+                         for p in cache.v_pages]
+        if cache.quantized:
+            cache.k_scales = [jax.device_put(p, head_sc)
+                              for p in cache.k_scales]
+            cache.v_scales = [jax.device_put(p, head_sc)
+                              for p in cache.v_scales]

@@ -256,6 +256,30 @@ _PALLAS_BLOCKSPEC_TOK_GOOD = """
 """
 
 
+# round 23: pallas_call mixed with GSPMD sharding machinery in one
+# module — pallas_call has no GSPMD partitioning rule (the serving TP
+# step pins the jnp gather path; tp.py vs attention.py is the split)
+_PALLAS_SPMD_MIX_BAD = """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def run(x, mesh, kernel):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec()))
+        return pl.pallas_call(kernel, out_shape=x)(x)
+"""
+
+_PALLAS_SPMD_SPLIT_GOOD = """
+    from jax.experimental import pallas as pl
+
+    def run(x, kernel):
+        # sharding machinery lives in its own module (serving/tp.py);
+        # this module only owns the kernel entry
+        return pl.pallas_call(kernel, out_shape=x)(x)
+"""
+
+
 class TestPallasHazards:
     def test_program_id_in_fori_loop_body_flags(self):
         fs = lint(_PALLAS_LOOP_BAD, "paddle_tpu/ops/pallas/k.py",
@@ -289,6 +313,16 @@ class TestPallasHazards:
 
     def test_token_cell_blockspec_passes(self):
         assert lint(_PALLAS_BLOCKSPEC_TOK_GOOD,
+                    "paddle_tpu/serving/attention.py",
+                    "pallas-hazards") == []
+
+    def test_pallas_mixed_with_sharding_flags(self):
+        fs = lint(_PALLAS_SPMD_MIX_BAD,
+                  "paddle_tpu/serving/attention.py", "pallas-hazards")
+        assert len(fs) == 1 and "GSPMD" in fs[0].message
+
+    def test_pallas_without_sharding_passes(self):
+        assert lint(_PALLAS_SPMD_SPLIT_GOOD,
                     "paddle_tpu/serving/attention.py",
                     "pallas-hazards") == []
 

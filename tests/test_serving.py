@@ -666,6 +666,10 @@ class TestServingSweep:
             assert name in sv.__all__, name
         # round-22 ragged step surface
         assert "ragged_paged_attention" in sv.__all__
+        # round-23 tensor-parallel surface
+        import paddle_tpu.serving.tp  # noqa: F401
+        for name in ("TPContext", "resolve_tp", "TP_AXIS"):
+            assert name in sv.__all__, name
 
     def test_deploy_surface(self):
         from paddle_tpu.serving import (DraftDistiller, DistillBuffer,
@@ -696,8 +700,10 @@ class TestServingSweep:
                      "cache", "scheduler", "cancel", "drain",
                      "start_drain", "draining", "release_live",
                      "on_event", "request", "draft", "spec_k",
-                     "ragged"):
+                     "ragged", "tp_degree", "tp_mesh_shape"):
             assert hasattr(eng, attr), attr
+        # TP off by default: degree 1, no mesh advertised
+        assert eng.tp_degree == 1 and eng.tp_mesh_shape is None
 
     def test_frontend_server_surface(self):
         from paddle_tpu.serving import ServingFrontend, ServingServer

@@ -415,8 +415,9 @@ class TestMetricsEndpoint:
             assert h["status"] == "ok"
             for key in ("waiting", "live", "free_pages",
                         "requests_finished", "cache_dtype",
-                        "weight_quant"):
+                        "weight_quant", "tp_degree", "tp_mesh"):
                 assert key in h, key
+            assert h["tp_degree"] == 1  # non-TP engine advertises 1
 
 
 # ---------------------------------------------------------------------------

@@ -96,7 +96,11 @@ LIVENESS_S = 60.0  # the no-deadlock deadline per stream/wave
 # internal fault-point rates for the fuzz fleets (latencies kept tiny:
 # the schedules, not the waits, are under test)
 ENGINE_RATES = {"step_fault": 0.03, "step_latency": 0.05,
-                "alloc_pressure": 0.03}
+                "alloc_pressure": 0.03,
+                # tensor-parallel serving (round 23): tp-skewed page
+                # geometry on adopt/import — must bounce to the
+                # re-prefill/recompute fallback, never fail a request
+                "shard_geometry_mismatch": 0.10}
 ROUTER_RATES = {"migrate_export_fail": 0.10,
                 "migrate_import_bounce": 0.20,
                 "migrate_transfer_kill": 0.20,

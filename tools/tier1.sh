@@ -34,6 +34,10 @@ bash tools/deploy_smoke.sh || exit 1
 # + <= 2 step program classes — runtime-bounded, CPU-only; never banks
 # BENCH_serving_ragged.json.
 bash tools/ragged_smoke.sh || exit 1
+# tp smoke (ISSUE 19): TP=1 vs TP=2 SPMD step replay on the 8-device
+# CPU mesh, token-exact across degrees — runtime-bounded, CPU-only;
+# never banks BENCH_serving_tp.json.
+bash tools/tp_smoke.sh || exit 1
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' \
